@@ -1,0 +1,41 @@
+"""sparkglm-tpu: TPU-native linear & generalized linear models.
+
+A from-scratch JAX/XLA/pjit framework with the capability surface of
+cafreeman/sparkGLM (reference at /root/reference): formula-driven OLS and
+IRLS-fitted GLMs on row-sharded data over a device mesh, with R-style
+summaries, prediction with training-time column matching, and model
+persistence.
+
+Quick start::
+
+    import sparkglm_tpu as sg
+    model = sg.glm("y ~ x1 + x2 + group", data, family="binomial")
+    print(model.summary())
+    mu = sg.predict(model, new_data)
+"""
+
+from .api import glm, lm, predict
+from .config import DEFAULT, NumericConfig
+from .data.formula import Formula, parse_formula
+from .data.frame import as_columns, omit_na
+from .data.model_matrix import Terms, build_terms, model_matrix, transform
+from .families.families import FAMILIES, Family, get_family
+from .families.links import LINKS, Link, get_link
+from .models.glm import GLMModel
+from .models.glm import fit as glm_fit
+from .models.lm import LMModel
+from .models.lm import fit as lm_fit
+from .models.serialize import load_model, save_model
+from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "lm", "glm", "predict", "lm_fit", "glm_fit",
+    "LMModel", "GLMModel", "load_model", "save_model",
+    "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
+    "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",
+    "transform", "as_columns", "omit_na",
+    "make_mesh", "shard_rows", "single_device_mesh",
+    "NumericConfig", "DEFAULT",
+]

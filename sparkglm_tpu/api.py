@@ -1,0 +1,91 @@
+"""Formula-driven user API: ``lm()`` / ``glm()`` / ``predict()``.
+
+Mirrors the reference's R front-end — ``sparkLM.formula``
+(/root/reference/R/pkg/R/LM.R:24-44): parse formula -> NA-omit -> build model
+matrices -> fit -> wrap — with keyword arguments replacing the reference's
+16 ``GLM.fit`` overloads (GLM.scala:597-995) and with the intercept flag
+actually honoured (the reference computes it and drops it, R/pkg/R/utils.R:19
+vs LM.R:37-38).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .config import DEFAULT, NumericConfig
+from .data.formula import parse_formula
+from .data.frame import as_columns, is_categorical, omit_na
+from .data.model_matrix import build_terms, transform
+from .models import glm as glm_mod
+from .models import lm as lm_mod
+
+
+def _design(formula: str, data, *, na_omit: bool, dtype):
+    f = parse_formula(formula)
+    cols = as_columns(data)
+    predictors = f.resolve_predictors(list(cols))
+    used = [f.response] + predictors
+    if na_omit:
+        cols, _ = omit_na(cols, used)  # omitNA, R/pkg/R/utils.R:24-27
+    yraw = cols[f.response]
+    if is_categorical(yraw):
+        # two-level factor response: first (sorted) level = failure, as in R
+        lv = sorted(np.unique(yraw.astype(str)))
+        if len(lv) != 2:
+            raise ValueError(
+                f"categorical response {f.response!r} must have exactly 2 levels, got {lv}")
+        y = (yraw.astype(str) == lv[1]).astype(np.float64)
+    else:
+        y = yraw.astype(np.float64)
+    terms = build_terms(cols, predictors, intercept=f.intercept)
+    X = transform(cols, terms, dtype=dtype)
+    return f, X, y, terms, cols
+
+
+def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
+       config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
+    """R-style ``lm(formula, data)`` (ref: sparkLM, R/pkg/R/LM.R:24-44)."""
+    f, X, y, terms, _ = _design(formula, data, na_omit=na_omit, dtype=np.dtype(config.dtype))
+    model = lm_mod.fit(
+        X, y, weights=weights, xnames=terms.xnames, yname=f.response,
+        has_intercept=f.intercept, mesh=mesh, config=config)
+    import dataclasses
+    return dataclasses.replace(model, formula=str(f), terms=terms)
+
+
+def glm(formula: str, data, *, family="binomial", link=None, weights=None,
+        offset=None, m=None, tol: float = 1e-6, max_iter: int = 100,
+        criterion: str = "absolute", na_omit: bool = True, mesh=None,
+        verbose: bool = False, config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
+    """R-style ``glm(formula, data, family, link, ...)``.
+
+    ``offset``/``m`` may be column names in ``data`` or arrays."""
+    f, X, y, terms, cols = _design(formula, data, na_omit=na_omit, dtype=np.dtype(config.dtype))
+
+    def _col_or_array(v):
+        if isinstance(v, str):
+            return cols[v]  # post-NA-omit columns, so lengths stay aligned
+        return None if v is None else np.asarray(v)
+
+    model = glm_mod.fit(
+        X, y, family=family, link=link, weights=_col_or_array(weights),
+        offset=_col_or_array(offset), m=_col_or_array(m), tol=tol,
+        max_iter=max_iter, criterion=criterion, xnames=terms.xnames,
+        yname=f.response, has_intercept=f.intercept, mesh=mesh,
+        verbose=verbose, config=config)
+    import dataclasses
+    return dataclasses.replace(model, formula=str(f), terms=terms)
+
+
+def predict(model, data, **kwargs) -> np.ndarray:
+    """Score new column-data through a formula-fitted model.
+
+    Equivalent of ``predict.sparkLM`` (R/pkg/R/LM.R:87-100): rebuild the
+    design matrix under the training ``Terms`` (which embeds the matchCols
+    zero-filling, utils.scala:21-33) then X·beta."""
+    if model.terms is None:
+        raise ValueError(
+            "model was fit from arrays, not a formula; call model.predict(X) "
+            "with an aligned design matrix instead")
+    X = transform(as_columns(data), model.terms)
+    return model.predict(X, **kwargs)

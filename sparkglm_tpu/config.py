@@ -1,0 +1,44 @@
+"""Global numeric configuration for sparkglm-tpu.
+
+The reference leans on driver-side LAPACK float64 for every solve
+(/root/reference/src/main/scala/com/Alteryx/sparkGLM/utils.scala:103,
+LM.scala:197).  On TPU the MXU wants float32/bfloat16 inputs, so we keep the
+*data* dtype configurable and always accumulate Gramians in `accum_dtype`
+(float32 by default; float64 when x64 is enabled, e.g. in CPU tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class NumericConfig:
+    """Numeric policy threaded through fits.
+
+    Attributes:
+      dtype: storage/compute dtype for the design matrix and per-row vectors.
+      accum_dtype: accumulation dtype for Gramian einsums
+        (``preferred_element_type``) and the normal-equations solve.
+      jitter: ridge added to the Gramian diagonal before Cholesky, *scaled by
+        the mean diagonal magnitude*; 0 disables.  The reference uses a plain
+        LAPACK ``inv`` with no regularisation (utils.scala:103) which fails on
+        near-singular designs.
+      refine_steps: iterative-refinement sweeps after the Cholesky solve; buys
+        back float64-like accuracy for the p-dimensional solve while the heavy
+        Gramian stays in float32 on the MXU.
+    """
+
+    dtype: jnp.dtype = jnp.float32
+    accum_dtype: jnp.dtype = jnp.float32
+    jitter: float = 0.0
+    refine_steps: int = 1
+
+
+DEFAULT = NumericConfig()
+
+
+def x64_enabled() -> bool:
+    return jnp.zeros((), jnp.float64).dtype == jnp.float64

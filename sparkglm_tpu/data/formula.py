@@ -1,0 +1,75 @@
+"""R-style formula parsing.
+
+Mirrors the reference R front-end's ``parseFormula``
+(/root/reference/R/pkg/R/utils.R:8-22): ``y ~ x1 + x2 + cat`` with only
+``+``-separated terms and ``1``/``-1``/``0`` intercept markers — and then
+actually *uses* the intercept flag (the reference computes it but every
+caller drops it, so no intercept column is ever added; SURVEY.md §7 L5).
+
+Extension over the reference: ``.`` expands to "all columns except the
+response" (standard R semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+
+@dataclasses.dataclass(frozen=True)
+class Formula:
+    response: str
+    predictors: tuple
+    intercept: bool
+    source: str
+
+    def __str__(self) -> str:
+        return self.source
+
+    def resolve_predictors(self, available: list[str]) -> list[str]:
+        """Expand '.' and validate every named term exists."""
+        out: list[str] = []
+        for t in self.predictors:
+            if t == ".":
+                out.extend(c for c in available if c != self.response and c not in out)
+            else:
+                if t not in available:
+                    raise KeyError(
+                        f"formula term {t!r} not found in data columns {available}")
+                if t not in out:
+                    out.append(t)
+        if not out:
+            raise ValueError(f"formula {self.source!r} has no predictor terms")
+        return out
+
+
+def parse_formula(formula: str) -> Formula:
+    s = formula.strip()
+    if "~" not in s:
+        raise ValueError(f"formula must contain '~': {formula!r}")
+    lhs, rhs = s.split("~", 1)
+    response = lhs.strip()
+    if not response:
+        raise ValueError(f"formula needs a response on the left of '~': {formula!r}")
+    if not re.fullmatch(r"[A-Za-z_.][A-Za-z0-9_.]*", response):
+        raise ValueError(f"invalid response name {response!r}")
+
+    intercept = True
+    predictors: list[str] = []
+    # split on '+' and '-' keeping the sign of each term (utils.R:12-21 keeps
+    # only '+' terms; '-1' removes the intercept)
+    tokens = re.findall(r"([+-]?)\s*([A-Za-z_.][A-Za-z0-9_.]*|[01])", rhs)
+    if not tokens:
+        raise ValueError(f"no terms on the right of '~': {formula!r}")
+    for sign, term in tokens:
+        if term == "1":
+            intercept = sign != "-"
+        elif term == "0":
+            intercept = False
+        elif sign == "-":
+            raise ValueError(
+                f"term removal '-{term}' is not supported (only -1/0 for the intercept)")
+        else:
+            predictors.append(term)
+    return Formula(response=response, predictors=tuple(predictors),
+                   intercept=intercept, source=s)

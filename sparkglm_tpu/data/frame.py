@@ -1,0 +1,64 @@
+"""Column-frame normalisation + NA omission.
+
+The reference's data container is a Spark DataFrame; ours is anything
+column-shaped: a pandas DataFrame, a mapping of name -> 1-D array, or a numpy
+structured array.  ``omit_na`` mirrors the R front-end's
+``omitNA``/``df.drop("any")`` (/root/reference/R/pkg/R/utils.R:24-27).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+
+def as_columns(data) -> dict[str, np.ndarray]:
+    """Normalise supported inputs to an ordered dict of 1-D numpy columns."""
+    if hasattr(data, "columns") and hasattr(data, "__getitem__"):  # pandas
+        return {str(c): np.asarray(data[c]) for c in data.columns}
+    if isinstance(data, Mapping):
+        out = {}
+        for k, v in data.items():
+            arr = np.asarray(v)
+            if arr.ndim != 1:
+                raise ValueError(f"column {k!r} must be 1-D, got shape {arr.shape}")
+            out[str(k)] = arr
+        lens = {len(v) for v in out.values()}
+        if len(lens) > 1:
+            raise ValueError(f"columns have unequal lengths: { {k: len(v) for k, v in out.items()} }")
+        return out
+    arr = np.asarray(data)
+    if arr.dtype.names:  # structured array
+        return {n: arr[n] for n in arr.dtype.names}
+    raise TypeError(
+        "data must be a pandas DataFrame, a mapping of name -> 1-D array, or "
+        f"a numpy structured array; got {type(data).__name__}")
+
+
+def is_categorical(col: np.ndarray) -> bool:
+    """String/object/bool/categorical columns get dummy-coded; numerics pass
+    through (modelMatrix.popVarArrays split, modelMatrix.scala:33-43)."""
+    return col.dtype.kind in ("U", "S", "O", "b")
+
+
+def na_mask(col: np.ndarray) -> np.ndarray:
+    """True where the value is missing (NaN for floats, None/'nan' for objects)."""
+    if col.dtype.kind == "f":
+        return np.isnan(col)
+    if col.dtype.kind == "O":
+        return np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in col])
+    return np.zeros(len(col), dtype=bool)
+
+
+def omit_na(cols: dict[str, np.ndarray], subset=None) -> tuple[dict[str, np.ndarray], np.ndarray]:
+    """Drop rows with any missing value in ``subset`` (default: all columns).
+    Returns (filtered columns, boolean keep-mask)."""
+    names = list(subset) if subset is not None else list(cols)
+    n = len(next(iter(cols.values()))) if cols else 0
+    keep = np.ones(n, dtype=bool)
+    for nm in names:
+        keep &= ~na_mask(cols[nm])
+    if keep.all():
+        return cols, keep
+    return {k: v[keep] for k, v in cols.items()}, keep

@@ -1,0 +1,126 @@
+"""Design-matrix construction — R ``model.matrix`` semantics.
+
+Mirrors the reference's ``modelMatrix``
+(/root/reference/src/main/scala/com/Alteryx/sparkGLM/modelMatrix.scala:18-85):
+categorical (string) columns are k-1 dummy-coded with lexicographically
+sorted levels and the first level dropped (``getLevels``, :56-58), dummies
+named ``{col}_{level}`` (``explodeField``, :71-75), numeric columns pass
+through, everything cast to the float dtype (``castAll``, :79-85).  Like the
+reference, ``model_matrix`` itself never adds an intercept — the formula
+front-end does (fixing the reference's dropped-intercept-flag bug,
+SURVEY.md §7 L5).
+
+Scoring-time column matching mirrors ``utils.matchCols``
+(utils.scala:21-33): a fitted ``Terms`` carries the training levels, and
+transforming new data with it zero-fills dummy columns for categories absent
+from the new data.  Unlike the reference (one ``distinct.collect`` Spark
+action per categorical column, modelMatrix.scala:56-58 — SURVEY.md §3.4),
+level discovery is a single vectorised host pass per column feeding the
+device once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .frame import as_columns, is_categorical
+
+INTERCEPT_NAME = "intercept"
+
+
+@dataclasses.dataclass(frozen=True)
+class Terms:
+    """Fitted design-matrix recipe (the reference's xnames + the level maps
+    it forgets, forcing matchCols at every scoring call)."""
+
+    columns: tuple            # source columns, in design order
+    levels: dict              # categorical column -> tuple of KEPT levels (k-1)
+    intercept: bool
+    xnames: tuple             # output design column names
+
+    def to_dict(self) -> dict:
+        return {
+            "columns": list(self.columns),
+            "levels": {k: list(v) for k, v in self.levels.items()},
+            "intercept": self.intercept,
+            "xnames": list(self.xnames),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Terms":
+        return cls(
+            columns=tuple(d["columns"]),
+            levels={k: tuple(v) for k, v in d["levels"].items()},
+            intercept=bool(d["intercept"]),
+            xnames=tuple(d["xnames"]),
+        )
+
+
+def _levels_of(col: np.ndarray) -> list:
+    # sorted distinct, drop first (k-1 coding) — modelMatrix.scala:56-58
+    lv = sorted(np.unique(col.astype(str)))
+    return lv[1:]
+
+
+def build_terms(data, columns=None, *, intercept: bool = False) -> Terms:
+    """Learn the design recipe (levels, names) from training data."""
+    cols = as_columns(data)
+    names = list(columns) if columns is not None else list(cols)
+    levels: dict[str, tuple] = {}
+    xnames: list[str] = [INTERCEPT_NAME] if intercept else []
+    for nm in names:
+        if nm not in cols:
+            raise KeyError(f"column {nm!r} not in data ({list(cols)})")
+        c = cols[nm]
+        if is_categorical(c):
+            kept = tuple(_levels_of(c))
+            levels[nm] = kept
+            xnames.extend(f"{nm}_{lv}" for lv in kept)
+        else:
+            xnames.append(nm)
+    return Terms(columns=tuple(names), levels=levels, intercept=intercept,
+                 xnames=tuple(xnames))
+
+
+def transform(data, terms: Terms, *, dtype=np.float32) -> np.ndarray:
+    """Materialise the (n, p) design matrix for ``data`` under ``terms``.
+
+    Categories unseen at training time map to all-zero dummies; training
+    categories absent from the new data yield zero columns (the
+    ``matchCols`` contract, utils.scala:28-33; tested by utils$Test.scala:10-24).
+    """
+    cols = as_columns(data)
+    n = len(next(iter(cols.values()))) if cols else 0
+    out = np.empty((n, len(terms.xnames)), dtype=dtype)
+    j = 0
+    if terms.intercept:
+        out[:, j] = 1.0
+        j += 1
+    for nm in terms.columns:
+        if nm not in cols:
+            raise KeyError(f"column {nm!r} required by the model is missing from data")
+        c = cols[nm]
+        if nm in terms.levels:
+            cs = c.astype(str)
+            for lv in terms.levels[nm]:
+                out[:, j] = (cs == lv).astype(dtype)
+                j += 1
+        else:
+            out[:, j] = c.astype(dtype)
+            j += 1
+    return out
+
+
+def model_matrix(data, columns=None, *, intercept: bool = False,
+                 terms: Terms | None = None, dtype=np.float32):
+    """One-shot: build (or reuse) ``Terms`` and materialise the matrix.
+
+    Returns ``(X, terms)``.  Equivalent of ``modelMatrix.apply``
+    (modelMatrix.scala:9-11) at training time and ``modelMatrix + matchCols``
+    (R/pkg/R/LM.R:94 + utils.scala:21-33) at scoring time.
+    """
+    if terms is None:
+        terms = build_terms(data, columns, intercept=intercept)
+    return transform(data, terms, dtype=dtype), terms

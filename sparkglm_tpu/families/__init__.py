@@ -1,0 +1,3 @@
+from .families import (FAMILIES, Family, binomial, gamma, gaussian,
+                       get_family, inverse_gaussian, poisson, resolve)
+from .links import LINKS, Link, get_link

@@ -1,0 +1,114 @@
+"""Link functions as pure jnp records.
+
+Generalises the reference's copy-pasted per-link objects — logit
+(/root/reference/src/main/scala/com/Alteryx/sparkGLM/GLM.scala:190-204),
+probit (GLM.scala:207-234, which loops rowwise over Gaussian distribution
+objects) and cloglog (GLM.scala:237-251) — into one ``Link`` record of three
+element-wise functions that XLA fuses straight into the IRLS step.  This also
+fixes the reference's 3-4x recomputation of ``unlink``/``lPrime`` per row per
+iteration inside one map closure (GLM.scala:370-371): here each quantity is a
+named intermediate computed once and fused.
+
+Each link provides:
+  * ``link(mu)     -> eta``    (g)
+  * ``inverse(eta) -> mu``     (g^-1)
+  * ``deriv(mu)    -> g'(mu)`` (dg/dmu — the IRLS working-response slope)
+
+Saturation guards: probit/cloglog/logit inverses clamp eta (and mu away from
+{0,1}) so IRLS weights ``w = 1/(Var(mu) g'(mu)^2)`` stay finite — the
+reference's only guard is a ``max(y,1)`` inside the deviance
+(GLM.scala:167); SURVEY.md §7 "hard parts" #5 calls out the rest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+from jax.scipy.stats import norm
+
+_EPS = 1e-7  # mu clamp for (0,1)-valued families
+_ETA_MAX = 30.0  # |eta| clamp for exp-overflow links
+
+
+@dataclasses.dataclass(frozen=True)
+class Link:
+    name: str
+    link: Callable
+    inverse: Callable
+    deriv: Callable
+
+
+def _clip_unit(mu):
+    return jnp.clip(mu, _EPS, 1.0 - _EPS)
+
+
+def _logit(mu):
+    mu = _clip_unit(mu)
+    return jnp.log(mu) - jnp.log1p(-mu)
+
+
+def _logit_inv(eta):
+    return _clip_unit(jnp.where(eta >= 0, 1.0 / (1.0 + jnp.exp(-eta)),
+                                jnp.exp(eta) / (1.0 + jnp.exp(eta))))
+
+
+def _probit_inv(eta):
+    return _clip_unit(norm.cdf(eta))
+
+
+def _probit_deriv(mu):
+    # dg/dmu = 1/phi(g(mu)) — reference computes the same rowwise with
+    # Gaussian objects (GLM.scala:219-224).
+    return 1.0 / jnp.maximum(norm.pdf(ndtri(_clip_unit(mu))), 1e-30)
+
+
+def _cloglog(mu):
+    return jnp.log(-jnp.log1p(-_clip_unit(mu)))
+
+
+def _cloglog_inv(eta):
+    eta = jnp.clip(eta, -_ETA_MAX, _ETA_MAX)
+    return _clip_unit(-jnp.expm1(-jnp.exp(eta)))
+
+
+def _cloglog_deriv(mu):
+    mu = _clip_unit(mu)
+    return -1.0 / ((1.0 - mu) * jnp.log1p(-mu))
+
+
+def _log_inv(eta):
+    return jnp.exp(jnp.clip(eta, -_ETA_MAX, _ETA_MAX))
+
+
+identity = Link("identity", lambda mu: mu, lambda eta: eta,
+                lambda mu: jnp.ones_like(mu))
+log = Link("log", lambda mu: jnp.log(jnp.maximum(mu, 1e-30)), _log_inv,
+           lambda mu: 1.0 / jnp.maximum(mu, 1e-30))
+logit = Link("logit", _logit, _logit_inv,
+             lambda mu: 1.0 / jnp.maximum(_clip_unit(mu) * (1.0 - _clip_unit(mu)), 1e-30))
+probit = Link("probit", lambda mu: ndtri(_clip_unit(mu)), _probit_inv, _probit_deriv)
+cloglog = Link("cloglog", _cloglog, _cloglog_inv, _cloglog_deriv)
+inverse = Link("inverse", lambda mu: 1.0 / mu, lambda eta: 1.0 / eta,
+               lambda mu: -1.0 / (mu * mu))
+sqrt = Link("sqrt", jnp.sqrt, lambda eta: eta * eta,
+            lambda mu: 0.5 / jnp.sqrt(jnp.maximum(mu, 1e-30)))
+inverse_squared = Link("inverse_squared", lambda mu: 1.0 / (mu * mu),
+                       lambda eta: 1.0 / jnp.sqrt(jnp.maximum(eta, 1e-30)),
+                       lambda mu: -2.0 / (mu * mu * mu))
+
+LINKS: dict[str, Link] = {
+    l.name: l for l in (identity, log, logit, probit, cloglog, inverse, sqrt,
+                        inverse_squared)
+}
+
+
+def get_link(link: str | Link) -> Link:
+    if isinstance(link, Link):
+        return link
+    try:
+        return LINKS[link]
+    except KeyError:
+        raise ValueError(f"unknown link {link!r}; available: {sorted(LINKS)}") from None

@@ -1,0 +1,5 @@
+from . import glm, lm
+from .glm import GLMModel
+from .lm import LMModel
+from .serialize import load_model, save_model
+from .summary import GLMSummary, LMSummary

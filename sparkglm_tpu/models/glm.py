@@ -1,0 +1,320 @@
+"""Generalized linear models via IRLS / Fisher scoring — TPU-native.
+
+Reference: /root/reference/src/main/scala/com/Alteryx/sparkGLM/GLM.scala —
+``fitSingleBinomial`` driver loop (:254-315), distributed ``fitMultipleBinomial``
+(:410-468) with per-iteration ``zwCreateBinomial`` (:359-395), ``wlsMultiple``
+(utils.scala:129-138), ``etaCreate``/``muCreate`` (:321-355), deviance
+collect (:397-408), and the 16 telescoping ``fit`` overloads (:597-995).
+
+Design deltas (deliberate, TPU-first):
+  * The entire IRLS loop is ONE jitted ``lax.while_loop``: state (beta, eta,
+    mu, dev, ...) stays resident in HBM; each iteration is per-shard fused
+    elementwise work (z, w) + one MXU Gramian + one psum + a replicated
+    Cholesky solve.  The reference pays >= 2 network round-trips per
+    iteration and — with no ``cache()`` anywhere — recomputes the full RDD
+    lineage for each (SURVEY.md §2.4, §3.2).
+  * All families x links from families/ — not just binomial (the reference's
+    every family branch falls through to binomial, GLM.scala:486-490,586-590).
+  * ``offset`` / group sizes ``m`` / prior weights work in the sharded path
+    too (the reference silently falls back to single-partition when offset/m
+    are given, GLM.scala:640-642 "Will change to fitDouble").
+  * A ``max_iter`` guard the reference lacks (its ``while (|ddev| > tol)``
+    can spin forever, GLM.scala:452).
+  * Convergence criteria: "absolute" |ddev| < tol (reference semantics,
+    GLM.scala:452,459) or "relative" |ddev|/(|dev|+0.1) < tol (R's
+    ``glm.control`` semantics — the better default at scale).
+  * The 16-overload matrix becomes keyword arguments (SURVEY.md §5 config).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import DEFAULT, NumericConfig
+from ..families.families import Family, resolve
+from ..families.links import Link
+from ..ops.gramian import weighted_gramian
+from ..ops.solve import diag_inv_from_cho, solve_normal
+from ..parallel import mesh as meshlib
+
+_BIG = jnp.inf
+
+
+def _sanitize(x, valid, fill=0.0):
+    """Padded (weight-0) rows can produce inf/nan in link space (e.g. the
+    gamma inverse link at eta=0); 0 * nan would poison the psum, so select
+    before reducing."""
+    return jnp.where(valid, jnp.nan_to_num(x, nan=fill, posinf=fill, neginf=fill), fill)
+
+
+@partial(jax.jit, static_argnames=("family", "link", "criterion", "refine_steps"))
+def _irls_kernel(
+    X, y, wt, offset,
+    tol, max_iter, jitter,
+    family: Family, link: Link,
+    criterion: str = "absolute",
+    refine_steps: int = 1,
+):
+    """Full IRLS to convergence in one compiled while_loop.
+
+    Args mirror the reference fit surface: y (response; proportions for
+    binomial-with-m), wt (prior weights * group sizes, 0 on padding rows),
+    offset (GLM.scala:254-315).
+    """
+    acc = X.dtype if X.dtype == jnp.float64 else jnp.float32
+    p = X.shape[1]
+    valid = wt > 0
+
+    def dev_of(mu):
+        return jnp.sum(_sanitize(family.dev_resids(y, mu, wt), valid))
+
+    mu0 = jnp.where(valid, family.init_mu(y, jnp.maximum(wt, 1e-30)), 1.0)
+    eta0 = link.link(mu0)
+    dev0 = dev_of(mu0)
+
+    state0 = dict(
+        it=jnp.zeros((), jnp.int32),
+        beta=jnp.zeros((p,), X.dtype),
+        eta=eta0.astype(X.dtype),
+        mu=mu0.astype(X.dtype),
+        dev=dev0.astype(acc),
+        ddev=jnp.asarray(_BIG, acc),
+        diag_inv=jnp.zeros((p,), acc),
+        singular=jnp.zeros((), jnp.bool_),
+    )
+
+    def not_converged(s):
+        d = s["ddev"]
+        if criterion == "relative":
+            d = d / (jnp.abs(s["dev"]) + 0.1)
+        return (s["it"] < max_iter) & (d > tol) & ~s["singular"]
+
+    def body(s):
+        mu, eta = s["mu"], s["eta"]
+        g = link.deriv(mu)                       # ref: lPrime, GLM.scala:370
+        var = family.variance(mu)                # ref: GLM.scala:125-129
+        w = _sanitize(wt / jnp.maximum(var * g * g, 1e-30), valid)
+        z = _sanitize(eta - offset + (y - mu) * g, valid)  # ref: GLM.scala:371-373
+        XtWX, XtWz = weighted_gramian(X, z, w, accum_dtype=acc)
+        beta, cho = solve_normal(XtWX, XtWz, jitter=jitter, refine_steps=refine_steps)
+        singular = ~jnp.all(jnp.isfinite(beta))
+        beta = jnp.where(singular, s["beta"], beta)
+        eta_new = (X @ beta + offset).astype(X.dtype)      # ref: etaCreate :321-332
+        mu_new = jnp.where(valid, link.inverse(eta_new), 1.0).astype(X.dtype)  # ref: muCreate :334-355
+        dev_new = dev_of(mu_new)
+        return dict(
+            it=s["it"] + 1,
+            beta=beta.astype(X.dtype),
+            eta=eta_new,
+            mu=mu_new,
+            dev=dev_new,
+            ddev=jnp.abs(dev_new - s["dev"]),
+            diag_inv=diag_inv_from_cho(cho, p, acc),
+            singular=singular,
+        )
+
+    s = jax.lax.while_loop(not_converged, body, state0)
+
+    # ---- post-loop statistics (one more fused pass + psum) ------------------
+    mu = s["mu"]
+    pearson = jnp.sum(_sanitize(wt * (y - mu) ** 2 / jnp.maximum(family.variance(mu), 1e-30), valid))  # ref: GLM.scala:104-118
+    loglik = jnp.sum(_sanitize(family.loglik_terms(y, mu, wt), valid))          # ref: GLM.scala:146-159
+    wt_sum = jnp.sum(wt)
+    mu_null = jnp.sum(jnp.where(valid, wt * y, 0.0)) / wt_sum
+    null_dev = dev_of(jnp.where(valid, mu_null, 1.0))                            # ref: nullDev via ybar
+    d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
+    converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"]
+
+    return dict(beta=s["beta"], diag_inv=s["diag_inv"], dev=s["dev"],
+                null_dev=null_dev, pearson=pearson, loglik=loglik,
+                iters=s["it"], converged=converged, singular=s["singular"],
+                wt_sum=wt_sum)
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMModel:
+    """Fitted GLM — the reference's ``GLM`` case class (GLM.scala:35-51)
+    carried as host numpy plus the summary ingredients ``createObj`` derives
+    (GLM.scala:59-88)."""
+
+    coefficients: np.ndarray
+    std_errors: np.ndarray
+    xnames: tuple
+    yname: str
+    family: str
+    link: str
+    deviance: float
+    null_deviance: float
+    pearson_chi2: float
+    loglik: float
+    aic: float
+    dispersion: float
+    df_residual: int
+    df_null: int
+    iterations: int
+    converged: bool
+    n_obs: int
+    n_params: int
+    n_shards: int
+    tol: float
+    has_intercept: bool
+    formula: str | None = None
+    terms: object | None = None
+
+    def predict(self, X, type: str = "response", offset=None) -> np.ndarray:
+        """eta = X·beta (+ offset); type="response" applies the inverse link."""
+        X = np.asarray(X)
+        if X.ndim != 2 or X.shape[1] != self.n_params:
+            raise ValueError(
+                f"predict expects (n, {self.n_params}) aligned to xnames; got {X.shape}")
+        eta = X @ self.coefficients
+        if offset is not None:
+            eta = eta + np.asarray(offset)
+        if type == "link":
+            return eta
+        if type == "response":
+            from ..families.links import get_link
+            return np.asarray(get_link(self.link).inverse(jnp.asarray(eta)))
+        raise ValueError(f"type must be 'link' or 'response', got {type!r}")
+
+    def summary(self):
+        from .summary import GLMSummary
+        return GLMSummary.from_model(self)
+
+    def save(self, path: str) -> None:
+        from .serialize import save_model
+        save_model(self, path)
+
+    def z_values(self) -> np.ndarray:
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return self.coefficients / self.std_errors
+
+    def p_values(self) -> np.ndarray:
+        # ref: z-tests via Gaussian, GLM.scala:1002-1008
+        from scipy import stats
+        return 2.0 * stats.norm.sf(np.abs(self.z_values()))
+
+
+def fit(
+    X,
+    y,
+    *,
+    family: str | Family = "binomial",
+    link: str | Link | None = None,
+    weights=None,
+    offset=None,
+    m=None,
+    tol: float = 1e-6,
+    max_iter: int = 100,
+    criterion: str = "absolute",
+    xnames: Sequence[str] | None = None,
+    yname: str = "y",
+    has_intercept: bool | None = None,
+    mesh=None,
+    shard_features: bool = False,
+    verbose: bool = False,
+    config: NumericConfig = DEFAULT,
+) -> GLMModel:
+    """Fit a GLM by IRLS on the device mesh.
+
+    Keyword surface replaces the reference's 16 ``fit`` overloads over
+    {offset, m, tol, verbose} (GLM.scala:597-995, defaults tol=1e-6
+    GLM.scala:610).  ``m`` is binomial group sizes: ``y`` is then success
+    *counts* out of ``m`` (converted to proportions + weights, matching both
+    the reference's (y, m) surface and R's proportion+weights convention).
+    """
+    from .lm import _detect_intercept
+
+    fam, lnk = resolve(family, link)
+    X = np.asarray(X)
+    y = np.asarray(y)
+    if y.ndim == 2:
+        if y.shape[1] != 1:
+            raise ValueError("y must be a single column (GLM.scala:606-607)")
+        y = y[:, 0]
+    if X.ndim != 2 or X.shape[0] != y.shape[0]:
+        raise ValueError("X must be (n,p) with rows matching y (GLM.scala:602-609)")
+    n, p = X.shape
+    if xnames is None:
+        xnames = tuple(f"x{i}" for i in range(p))
+    xnames = tuple(xnames)
+    if has_intercept is None:
+        has_intercept = _detect_intercept(X, xnames)
+
+    if mesh is None:
+        mesh = meshlib.make_mesh()
+    use_f64 = X.dtype == np.float64 and jnp.zeros((), jnp.float64).dtype == jnp.float64
+    dtype = np.float64 if use_f64 else np.dtype(config.dtype)
+
+    wt = np.ones((n,), dtype=dtype) if weights is None else np.asarray(weights, dtype=dtype).copy()
+    y = y.astype(dtype, copy=True)
+    if m is not None:
+        m_arr = np.asarray(m, dtype=dtype)
+        if fam.name != "binomial":
+            raise ValueError("group sizes m only apply to the binomial family")
+        y = y / np.maximum(m_arr, 1e-30)   # counts -> proportions
+        wt = wt * m_arr
+    off = np.zeros((n,), dtype=dtype) if offset is None else np.asarray(offset, dtype=dtype)
+
+    Xd = meshlib.shard_rows(X.astype(dtype, copy=False), mesh, shard_features=shard_features)
+    yd = meshlib.shard_rows(y, mesh)
+    wd = meshlib.shard_rows(wt, mesh)      # padding rows get wt=0 -> inert
+    od = meshlib.shard_rows(off, mesh)
+
+    out = _irls_kernel(
+        Xd, yd, wd, od,
+        jnp.asarray(tol, jnp.float32 if not use_f64 else jnp.float64),
+        jnp.asarray(max_iter, jnp.int32),
+        jnp.asarray(config.jitter, dtype),
+        family=fam, link=lnk, criterion=criterion,
+        refine_steps=config.refine_steps,
+    )
+    out = jax.tree.map(np.asarray, out)
+    if bool(out["singular"]):
+        raise np.linalg.LinAlgError(
+            "singular weighted Gramian during IRLS; consider jitter in NumericConfig")
+
+    dev = float(out["dev"])
+    iters = int(out["iters"])
+    df_resid = n - p
+    df_null = n - (1 if has_intercept else 0)
+    if fam.dispersion_fixed:
+        dispersion = 1.0
+    else:
+        dispersion = float(out["pearson"]) / df_resid  # ref: createObj GLM.scala:74-79
+    std_err = np.sqrt(np.maximum(dispersion * out["diag_inv"], 0.0))
+    ll = float(out["loglik"])
+    aic = float(fam.aic(dev, ll, float(n), float(p), float(out["wt_sum"])))
+    if verbose:
+        print(f"IRLS finished: {iters} iterations, deviance={dev:.8g}, "
+              f"converged={bool(out['converged'])}")
+
+    return GLMModel(
+        coefficients=out["beta"].astype(np.float64),
+        std_errors=std_err.astype(np.float64),
+        xnames=xnames,
+        yname=yname,
+        family=fam.name,
+        link=lnk.name,
+        deviance=dev,
+        null_deviance=float(out["null_dev"]),
+        pearson_chi2=float(out["pearson"]),
+        loglik=ll,
+        aic=aic,
+        dispersion=dispersion,
+        df_residual=df_resid,
+        df_null=df_null,
+        iterations=iters,
+        converged=bool(out["converged"]),
+        n_obs=n,
+        n_params=p,
+        n_shards=mesh.shape[meshlib.DATA_AXIS],
+        tol=tol,
+        has_intercept=bool(has_intercept),
+    )

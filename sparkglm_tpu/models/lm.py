@@ -1,0 +1,215 @@
+"""Linear model (OLS / WLS) — TPU-native analogue of the reference LM.
+
+Reference: /root/reference/src/main/scala/com/Alteryx/sparkGLM/LM.scala —
+``fit`` dispatcher (:241-274), ``fitSingle`` (:191-214), ``fitMultiple``
+(:217-237), ``rowPartitionedComponents`` (:141-155), ``rowPartitionedSSE``
+(:160-188), ``predict`` (:29-61), ``SummaryLM`` (:66-137).
+
+Design deltas (deliberate, TPU-first):
+  * No single-vs-multi partition dispatch: one jitted SPMD kernel runs on a
+    1-device mesh exactly as it runs on N devices; GSPMD inserts the psum
+    when the row axis is actually sharded.  (The reference maintains two
+    divergent code paths and tests they agree, lmPredict$Test.scala:11-35.)
+  * The Gramian, solve, SSE and SST passes are one fused jit step with a
+    single all-reduce, instead of two network round-trips + driver LAPACK.
+  * Cholesky + iterative refinement instead of an explicit float64 inverse
+    (LM.scala:197).
+  * Prior weights (WLS) are first-class — the reference's LM is OLS-only even
+    though its WLS core supports weights (utils.scala:98-138).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import DEFAULT, NumericConfig
+from ..ops.gramian import weighted_gramian, weighted_moments
+from ..ops.solve import diag_inv_from_cho, inv_from_cho, solve_normal
+from ..parallel import mesh as meshlib
+
+
+@partial(jax.jit, static_argnames=("refine_steps", "compute_cov"))
+def _lm_kernel(X, y, w, jitter, refine_steps: int = 1, compute_cov: bool = True):
+    """One fused pass: (X'WX, X'Wy) -> solve -> residual stats.
+
+    With X/y/w row-sharded this is per-shard MXU work + one psum; the
+    reference needs two distributed actions (Gramian treeReduce LM.scala:150,
+    SSE collect LM.scala:167) plus driver-side LAPACK per fit.
+    """
+    acc = X.dtype if X.dtype == jnp.float64 else jnp.float32
+    XtWX, XtWy = weighted_gramian(X, y, w, accum_dtype=acc)
+    beta, cho = solve_normal(XtWX, XtWy, jitter=jitter, refine_steps=refine_steps)
+    resid = y - X @ beta
+    sse = jnp.sum(w.astype(acc) * resid.astype(acc) ** 2)
+    n, ybar, sst_centered = weighted_moments(y, w, accum_dtype=acc)
+    sst_raw = sst_centered + n * ybar * ybar  # uncentered sum of squares
+    p = X.shape[1]
+    diag_inv = diag_inv_from_cho(cho, p, XtWX.dtype)
+    cov_unscaled = inv_from_cho(cho, p, XtWX.dtype) if compute_cov else jnp.zeros((p, p), XtWX.dtype)
+    return dict(beta=beta, diag_inv=diag_inv, cov_unscaled=cov_unscaled,
+                sse=sse, sst_centered=sst_centered, sst_raw=sst_raw,
+                n=n, ybar=ybar)
+
+
+@dataclasses.dataclass(frozen=True)
+class LMModel:
+    """Fitted linear model — the reference's ``LM`` class (LM.scala:16-64)
+    plus the inference stats its ``SummaryLM`` recomputes lazily."""
+
+    coefficients: np.ndarray
+    std_errors: np.ndarray
+    xnames: tuple
+    yname: str
+    n_obs: int
+    n_params: int
+    df_model: int
+    df_resid: int
+    sse: float
+    sst: float
+    r_squared: float
+    adj_r_squared: float
+    sigma: float
+    f_statistic: float
+    has_intercept: bool
+    n_shards: int
+    cov_unscaled: np.ndarray | None = None
+    # formula front-end metadata (None for array-level fits)
+    formula: str | None = None
+    terms: object | None = None
+
+    # -- scoring (LM.scala:29-61) --------------------------------------------
+    def predict(self, X, mesh=None) -> np.ndarray:
+        """X·beta. Accepts an (n,p) array aligned to ``xnames``; the formula
+        front-end (api.py) handles model-matrix/column matching first."""
+        X = np.asarray(X)
+        if X.ndim != 2 or X.shape[1] != self.n_params:
+            raise ValueError(
+                f"predict expects (n, {self.n_params}) design matrix aligned to "
+                f"xnames={list(self.xnames)}; got {X.shape}")
+        beta = jnp.asarray(self.coefficients, dtype=X.dtype if X.dtype != np.float64 else None)
+        return np.asarray(_predict_jit(jnp.asarray(X), beta))
+
+    def summary(self):
+        from .summary import LMSummary
+        return LMSummary.from_model(self)
+
+    # -- persistence (absent from the reference: SURVEY.md §5 "Checkpoint /
+    # resume: none") ---------------------------------------------------------
+    def save(self, path: str) -> None:
+        from .serialize import save_model
+        save_model(self, path)
+
+    def t_values(self) -> np.ndarray:
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return self.coefficients / self.std_errors
+
+    def p_values(self) -> np.ndarray:
+        from scipy import stats
+        return 2.0 * stats.t.sf(np.abs(self.t_values()), self.df_resid)
+
+
+@jax.jit
+def _predict_jit(X, beta):
+    return X @ beta
+
+
+def _detect_intercept(X: np.ndarray, xnames: Sequence[str] | None) -> bool:
+    """The reference never adds an intercept — fixtures carry an explicit
+    ``intercept`` ones-column (testData.scala:84-87).  Mirror that: intercept
+    present iff some column is constant 1 (or is named 'intercept')."""
+    if xnames is not None and any(n.lower() in ("intercept", "(intercept)") for n in xnames):
+        return True
+    head = X[: min(len(X), 1024)]
+    return bool(np.any(np.all(head == 1.0, axis=0)))
+
+
+def fit(
+    X,
+    y,
+    *,
+    weights=None,
+    xnames: Sequence[str] | None = None,
+    yname: str = "y",
+    has_intercept: bool | None = None,
+    mesh=None,
+    shard_features: bool = False,
+    config: NumericConfig = DEFAULT,
+) -> LMModel:
+    """Fit OLS/WLS by the normal equations on the device mesh.
+
+    Mirrors ``LM.fit`` (LM.scala:241-274) including its input validation, with
+    one SPMD path instead of the npart dispatch.
+    """
+    X = np.asarray(X)
+    y = np.asarray(y)
+    if y.ndim == 2:
+        if y.shape[1] != 1:
+            raise ValueError("y must be a single column (LM.scala:249-250)")
+        y = y[:, 0]
+    if X.ndim != 2:
+        raise ValueError("X must be 2-D")
+    if X.shape[0] != y.shape[0]:
+        raise ValueError(
+            f"X and y row counts differ: {X.shape[0]} vs {y.shape[0]} (LM.scala:247-248)")
+    n, p = X.shape
+    if n <= p:
+        raise ValueError(f"need n > p for OLS inference; got n={n}, p={p}")
+    if xnames is None:
+        xnames = tuple(f"x{i}" for i in range(p))
+    xnames = tuple(xnames)
+    if has_intercept is None:
+        has_intercept = _detect_intercept(X, xnames)
+
+    if mesh is None:
+        mesh = meshlib.make_mesh()
+    dtype = np.float64 if X.dtype == np.float64 and jnp.zeros((), jnp.float64).dtype == jnp.float64 else np.dtype(config.dtype)
+
+    w_host = np.ones((n,), dtype=dtype) if weights is None else np.asarray(weights, dtype=dtype)
+    if w_host.shape != (n,):
+        raise ValueError("weights must be shape (n,)")
+
+    Xd = meshlib.shard_rows(X.astype(dtype, copy=False), mesh, shard_features=shard_features)
+    yd = meshlib.shard_rows(y.astype(dtype, copy=False), mesh)
+    # zero weight on padding rows keeps them inert in every reduction
+    wd = meshlib.shard_rows(w_host, mesh)
+
+    out = _lm_kernel(Xd, yd, wd, jnp.asarray(config.jitter, dtype),
+                     refine_steps=config.refine_steps)
+    out = jax.tree.map(np.asarray, out)
+
+    n_eff = float(n)  # true observation count (host-side; padding rows carry w=0)
+    df_model = p - (1 if has_intercept else 0)
+    df_resid = n - p
+    sse = float(out["sse"])
+    sst = float(out["sst_centered"] if has_intercept else out["sst_raw"])
+    sigma2 = sse / df_resid if df_resid > 0 else np.nan
+    r2 = 1.0 - sse / sst if sst > 0 else np.nan
+    adj_r2 = 1.0 - (1.0 - r2) * (n_eff - (1 if has_intercept else 0)) / df_resid if df_resid > 0 else np.nan
+    f_stat = ((sst - sse) / df_model) / sigma2 if df_model > 0 and sigma2 > 0 else np.nan
+    std_err = np.sqrt(np.maximum(sigma2 * out["diag_inv"], 0.0))
+
+    return LMModel(
+        coefficients=out["beta"].astype(np.float64),
+        std_errors=std_err.astype(np.float64),
+        xnames=xnames,
+        yname=yname,
+        n_obs=int(round(n_eff)),
+        n_params=p,
+        df_model=df_model,
+        df_resid=df_resid,
+        sse=sse,
+        sst=sst,
+        r_squared=float(r2),
+        adj_r_squared=float(adj_r2),
+        sigma=float(np.sqrt(sigma2)),
+        f_statistic=float(f_stat),
+        has_intercept=bool(has_intercept),
+        n_shards=mesh.shape[meshlib.DATA_AXIS],
+        cov_unscaled=out["cov_unscaled"].astype(np.float64),
+    )

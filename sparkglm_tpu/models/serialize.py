@@ -1,0 +1,66 @@
+"""Model persistence — save/load fitted models to disk.
+
+The reference has NO checkpoint/persistence story: "Model persistence =
+keeping the JVM object alive" (SURVEY.md §5; the R side can only re-wrap a
+live jobj, /root/reference/R/pkg/R/LM.R:52).  Here models are frozen
+dataclasses of host numpy + JSON-able metadata, stored as a single ``.npz``
+with a JSON header — loadable in a fresh process with no device state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+
+import numpy as np
+
+_FORMAT_VERSION = 1
+
+
+def _split(model) -> tuple[dict, dict]:
+    arrays, meta = {}, {}
+    for f in dataclasses.fields(model):
+        v = getattr(model, f.name)
+        if isinstance(v, np.ndarray):
+            arrays[f.name] = v
+        elif f.name == "terms" and v is not None:
+            meta["terms"] = v.to_dict() if hasattr(v, "to_dict") else None
+        elif isinstance(v, tuple):
+            meta[f.name] = list(v)
+        else:
+            meta[f.name] = v
+    return arrays, meta
+
+
+def save_model(model, path: str) -> None:
+    arrays, meta = _split(model)
+    meta["__class__"] = type(model).__name__
+    meta["__format__"] = _FORMAT_VERSION
+    header = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(path, __meta__=header, **arrays)
+
+
+def load_model(path: str):
+    from .glm import GLMModel
+    from .lm import LMModel
+
+    with np.load(path if str(path).endswith(".npz") else str(path) + ".npz") as z:
+        meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    cls_name = meta.pop("__class__")
+    meta.pop("__format__", None)
+    cls = {"LMModel": LMModel, "GLMModel": GLMModel}[cls_name]
+    terms_meta = meta.pop("terms", None)
+    if terms_meta is not None:
+        from ..data.model_matrix import Terms
+        meta["terms"] = Terms.from_dict(terms_meta)
+    else:
+        meta["terms"] = None
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {k: v for k, v in meta.items() if k in field_names}
+    for k in ("xnames",):
+        if k in kwargs and isinstance(kwargs[k], list):
+            kwargs[k] = tuple(kwargs[k])
+    kwargs.update(arrays)
+    return cls(**kwargs)

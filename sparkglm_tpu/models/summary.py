@@ -1,0 +1,143 @@
+"""R-style summary objects and text rendering.
+
+LM block mirrors ``SummaryLM``/``print`` (/root/reference/src/main/scala/com/
+Alteryx/sparkGLM/LM.scala:66-137): Model / Coefficients / RSE / R² / F-stat.
+GLM block mirrors the static ``GLM.summary`` printer (GLM.scala:998-1025):
+coefficient z-table, null & residual deviance, AIC, Fisher iterations.
+
+Unlike the reference, the summary is also available *structured* — the
+``summary_array``/``as_dict`` accessors implement the ``summaryArray``
+host-bridge contract the reference's R layer calls but Scala never shipped
+(R/pkg/R/LM.R:122-127, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+from scipy import stats as _st
+
+from ..utils.format import coef_table, sig_digits
+
+
+@dataclasses.dataclass(frozen=True)
+class LMSummary:
+    model: object  # LMModel
+
+    @classmethod
+    def from_model(cls, model):
+        return cls(model=model)
+
+    def coefficients(self) -> dict[str, np.ndarray]:
+        m = self.model
+        t = m.t_values()
+        p = m.p_values()
+        return {
+            "Estimate": m.coefficients,
+            "Std. Error": m.std_errors,
+            "t value": t,
+            "Pr(>|t|)": p,
+        }
+
+    def f_p_value(self) -> float:
+        m = self.model
+        return float(_st.f.sf(m.f_statistic, m.df_model, m.df_resid))
+
+    def as_dict(self) -> dict:
+        m = self.model
+        return {
+            "call": m.formula or f"{m.yname} ~ {' + '.join(m.xnames)}",
+            "coefficients": {k: v.tolist() for k, v in self.coefficients().items()},
+            "xnames": list(m.xnames),
+            "rse": m.sigma,
+            "df_resid": m.df_resid,
+            "r_squared": m.r_squared,
+            "adj_r_squared": m.adj_r_squared,
+            "f_statistic": m.f_statistic,
+            "f_p_value": self.f_p_value(),
+            "n_obs": m.n_obs,
+        }
+
+    def summary_array(self) -> list[str]:
+        """The 5-element ('call','coefficients','RSE','R2','Fstat') string
+        array the reference's R bridge expects (R/pkg/R/LM.R:122-127)."""
+        d = self.as_dict()
+        m = self.model
+        return [
+            d["call"],
+            coef_table(m.xnames, self.coefficients(), stars_from="Pr(>|t|)"),
+            f"Residual standard error: {sig_digits(m.sigma)} on {m.df_resid} degrees of freedom",
+            f"Multiple R-Squared: {sig_digits(m.r_squared)}, Adjusted R-Squared: {sig_digits(m.adj_r_squared)}",
+            (f"F-statistic: {sig_digits(m.f_statistic)} on {m.df_model} and "
+             f"{m.df_resid} DF, p-value: {sig_digits(self.f_p_value())}"),
+        ]
+
+    def __str__(self) -> str:  # print block, LM.scala:128-136
+        arr = self.summary_array()
+        return (
+            f"Model:\n{arr[0]}\n\nCoefficients:\n{arr[1]}\n\n"
+            f"{arr[2]}\n{arr[3]}\n{arr[4]}\n"
+        )
+
+    def _repr_pretty_(self, p, cycle):
+        p.text(str(self))
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMSummary:
+    model: object  # GLMModel
+
+    @classmethod
+    def from_model(cls, model):
+        return cls(model=model)
+
+    def coefficients(self) -> dict[str, np.ndarray]:
+        m = self.model
+        return {
+            "Estimate": m.coefficients,
+            "Std. Error": m.std_errors,
+            "z value": m.z_values(),
+            "Pr(>|z|)": m.p_values(),
+        }
+
+    def as_dict(self) -> dict:
+        m = self.model
+        return {
+            "call": m.formula or f"{m.yname} ~ {' + '.join(m.xnames)}",
+            "family": m.family,
+            "link": m.link,
+            "coefficients": {k: v.tolist() for k, v in self.coefficients().items()},
+            "xnames": list(m.xnames),
+            "null_deviance": m.null_deviance,
+            "df_null": m.df_null,
+            "deviance": m.deviance,
+            "df_resid": m.df_residual,
+            "dispersion": m.dispersion,
+            "aic": m.aic,
+            "loglik": m.loglik,
+            "pearson_chi2": m.pearson_chi2,
+            "iterations": m.iterations,
+            "converged": m.converged,
+            "n_obs": m.n_obs,
+        }
+
+    def __str__(self) -> str:  # println block, GLM.scala:1009-1024
+        m = self.model
+        tbl = coef_table(m.xnames, self.coefficients(), stars_from="Pr(>|z|)")
+        disp = (f"(Dispersion parameter for {m.family} family taken to be "
+                f"{sig_digits(m.dispersion)})")
+        call = m.formula or (m.yname + " ~ " + " + ".join(m.xnames))
+        return (
+            f"Call:\n{call}\n"
+            f"Family: {m.family}  Link: {m.link}\n\n"
+            f"Coefficients:\n{tbl}\n\n"
+            f"{disp}\n\n"
+            f"    Null deviance: {sig_digits(m.null_deviance)}  on {m.df_null}  degrees of freedom\n"
+            f"Residual deviance: {sig_digits(m.deviance)}  on {m.df_residual}  degrees of freedom\n"
+            f"AIC: {sig_digits(m.aic)}\n\n"
+            f"Number of Fisher Scoring iterations: {m.iterations}\n"
+        )
+
+    def _repr_pretty_(self, p, cycle):
+        p.text(str(self))

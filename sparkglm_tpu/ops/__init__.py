@@ -1,0 +1,2 @@
+from .gramian import gramian, weighted_gramian, weighted_moments
+from .solve import solve_normal, wls

@@ -1,0 +1,65 @@
+"""Fused weighted-Gramian accumulation — the framework's hot op.
+
+Replaces the reference's per-partition Breeze GEMMs plus tree aggregation:
+``utils.partitionComponents`` (X'WX, X'Wz per partition,
+/root/reference/src/main/scala/com/Alteryx/sparkGLM/utils.scala:84-92),
+``reduceNormal`` + ``treeReduce`` (utils.scala:58-64,121-123) and the LM
+variants ``rowPartitionedComponents`` (LM.scala:141-155) /
+``rowPartitionedSSE`` (LM.scala:160-188).
+
+On TPU all of those collapse into one jitted einsum pair: with X row-sharded
+over the ``"data"`` mesh axis and the outputs requested replicated, GSPMD
+lowers the contraction over the row axis to a per-shard MXU matmul followed by
+an ICI all-reduce (``psum``) — the hardware-native analogue of ``treeReduce``
+with its branching factor chosen by the topology rather than a SparkConf knob
+(utils.scala:121-122).
+
+``leftMultDiag`` (utils.scala:68-80) — scaling rows by a diagonal weight
+without materialising the diagonal matrix — is the broadcasted ``X * w[:,
+None]`` below, which XLA fuses into the matmul's operand load.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def weighted_gramian(X, z, w, *, accum_dtype=jnp.float32):
+    """Return ``(X'WX, X'Wz)`` for diagonal weights ``w``.
+
+    Args:
+      X: (n, p) design matrix, row-sharded or local.
+      z: (n,) response / working response.
+      w: (n,) non-negative weights.  Zero-weight rows (e.g. shard padding)
+        contribute nothing.
+      accum_dtype: einsum accumulation dtype (``preferred_element_type``).
+    """
+    Xw = X * w[:, None]
+    XtWX = jnp.einsum("np,nq->pq", Xw, X, preferred_element_type=accum_dtype)
+    XtWz = jnp.einsum("np,n->p", Xw, z, preferred_element_type=accum_dtype)
+    return XtWX, XtWz
+
+
+def gramian(X, y, *, accum_dtype=jnp.float32):
+    """Unweighted ``(X'X, X'y)`` — the OLS fast path (LM.scala:146-148)."""
+    XtX = jnp.einsum("np,nq->pq", X, X, preferred_element_type=accum_dtype)
+    Xty = jnp.einsum("np,n->p", X, y, preferred_element_type=accum_dtype)
+    return XtX, Xty
+
+
+def weighted_moments(y, w, *, accum_dtype=jnp.float32):
+    """Weighted count, mean and centred sum of squares of ``y`` in one pass.
+
+    Covers the reference's scalar ``collect.reduce(_+_)`` round-trips — the
+    mean-of-y init (GLM.scala:420-423) and the SST accumulation inside
+    ``rowPartitionedSSE`` (LM.scala:160-188) — as shard-local partial sums
+    that GSPMD turns into a single fused psum.
+    """
+    w = w.astype(accum_dtype)
+    ya = y.astype(accum_dtype)
+    n = jnp.sum(w)
+    s1 = jnp.sum(w * ya)
+    s2 = jnp.sum(w * ya * ya)
+    mean = s1 / n
+    ss_centered = s2 - s1 * s1 / n
+    return n, mean, ss_centered

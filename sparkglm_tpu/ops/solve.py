@@ -1,0 +1,72 @@
+"""Normal-equations solve: Cholesky + iterative refinement.
+
+The reference computes an explicit LAPACK float64 inverse on the driver for
+every solve — ``inv(X'X)`` (LM.scala:197,225) and ``inv(X'WX)``
+(utils.scala:103) — then multiplies.  On TPU we instead:
+
+  * add optional scaled jitter to the diagonal (the reference has no guard
+    against near-singular designs at all);
+  * Cholesky-factor once (`cho_factor`) and solve (`cho_solve`) — cheaper and
+    numerically better than an explicit inverse;
+  * optionally run iterative-refinement sweeps to recover float64-like
+    accuracy for the p-vector solution while the O(n p^2) Gramian work stays
+    in float32 on the MXU (SURVEY.md §7 "hard parts" #1);
+  * expose ``diag((X'WX)^-1)`` for standard errors
+    (sqrt(sigma^2 * diag) — LM.scala:260-263, utils.scala:95,134-137) via a
+    triangular solve against the identity, never forming the inverse
+    off-diagonal products in user code.
+
+The solve is replicated across the mesh (p x p is tiny: p <= a few thousand),
+which is the SPMD analogue of the reference's driver-local solve — except
+there is no host round-trip: it stays inside the jitted step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.linalg import cho_factor, cho_solve
+
+
+def _prepare(XtWX, jitter):
+    p = XtWX.shape[0]
+    A = 0.5 * (XtWX + XtWX.T)  # symmetrise against accumulation noise
+    # jitter may be a traced scalar under jit, so add unconditionally
+    # (jitter == 0.0 is a no-op).
+    scale = jnp.mean(jnp.diag(A))
+    return A + (jnp.asarray(jitter, A.dtype) * scale) * jnp.eye(p, dtype=A.dtype)
+
+
+def solve_normal(XtWX, XtWz, *, jitter: float = 0.0, refine_steps: int = 1):
+    """Solve ``(X'WX) beta = X'Wz``; returns ``(beta, cho)`` so callers can
+    reuse the factorisation for covariance diagnostics."""
+    A = _prepare(XtWX, jitter)
+    cho = cho_factor(A)
+    beta = cho_solve(cho, XtWz)
+    for _ in range(max(refine_steps, 0)):
+        r = XtWz - A @ beta
+        beta = beta + cho_solve(cho, r)
+    return beta, cho
+
+
+def inv_from_cho(cho, p: int, dtype):
+    """Full ``(X'WX)^-1`` from a Cholesky factorisation (p x p, replicated)."""
+    return cho_solve(cho, jnp.eye(p, dtype=dtype))
+
+
+def diag_inv_from_cho(cho, p: int, dtype):
+    """``diag((X'WX)^-1)`` — the standard-error ingredient (utils.scala:95)."""
+    return jnp.diag(inv_from_cho(cho, p, dtype))
+
+
+@partial(jax.jit, static_argnames=("refine_steps",))
+def wls(XtWX, XtWz, jitter=0.0, refine_steps: int = 1):
+    """One weighted-least-squares solve returning ``(coefs, diag_inv)`` — the
+    analogue of ``utils.WLSObj`` (coefs + sqrt diag, utils.scala:95-107),
+    except we return the un-sqrt'd diagonal so callers can apply their own
+    dispersion."""
+    beta, cho = solve_normal(XtWX, XtWz, jitter=jitter, refine_steps=refine_steps)
+    d = diag_inv_from_cho(cho, XtWX.shape[0], XtWX.dtype)
+    return beta, d

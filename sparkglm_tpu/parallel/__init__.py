@@ -1,0 +1,2 @@
+from .mesh import (DATA_AXIS, MODEL_AXIS, make_mesh, pad_mask, padded_rows,
+                   replicate, row_spec, shard_rows, single_device_mesh)

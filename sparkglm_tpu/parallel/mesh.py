@@ -1,0 +1,111 @@
+"""Device mesh + row-sharding utilities.
+
+TPU-native replacement for the reference's distributed-matrix container:
+ml-matrix ``RowPartitionedMatrix`` (used at
+/root/reference/src/main/scala/com/Alteryx/sparkGLM/utils.scala:36-39 and
+LM.scala:220-221).  A "row-partitioned matrix" here is simply a
+``jax.Array`` laid out with ``NamedSharding(mesh, P("data", ...))`` over a
+named device mesh; partition alignment (the reference's ``RDD.zip``,
+GLM.scala:365-367) is free because every per-row tensor shares the same
+sharding.
+
+Two mesh axes:
+  * ``"data"``  — row (observation) sharding; the reference's only strategy.
+  * ``"model"`` — optional feature-axis sharding (tensor parallelism) for
+    very wide designs; size 1 by default.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_data: int | None = None,
+    n_model: int = 1,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ``(data, model)`` mesh over the available devices."""
+    if devices is None:
+        devices = jax.devices()
+    n_dev = len(devices)
+    if n_data is None:
+        if n_dev % n_model:
+            raise ValueError(f"{n_dev} devices not divisible by n_model={n_model}")
+        n_data = n_dev // n_model
+    need = n_data * n_model
+    if need > n_dev:
+        raise ValueError(f"mesh {n_data}x{n_model} needs {need} devices, have {n_dev}")
+    dev_grid = np.asarray(devices[:need]).reshape(n_data, n_model)
+    return Mesh(dev_grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    """A 1x1 mesh — the analogue of the reference's npart==1 fast path
+    (LM.scala:254, GLM.scala:613-617); same code path, trivial collectives."""
+    return make_mesh(n_data=1, n_model=1, devices=jax.devices()[:1])
+
+
+def row_spec(ndim: int, shard_features: bool = False) -> P:
+    """PartitionSpec for a row-sharded array: rows on "data", features on
+    "model" when ``shard_features`` (only meaningful for ndim >= 2)."""
+    if ndim == 1:
+        return P(DATA_AXIS)
+    trailing = (MODEL_AXIS,) if shard_features else (None,) * (ndim - 1)
+    return P(DATA_AXIS, *trailing)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def padded_rows(n: int, mesh: Mesh) -> int:
+    """Rows after padding ``n`` up to a multiple of the data-axis size."""
+    d = mesh.shape[DATA_AXIS]
+    return ((n + d - 1) // d) * d
+
+
+def shard_rows(
+    x: np.ndarray | jax.Array,
+    mesh: Mesh,
+    *,
+    shard_features: bool = False,
+    pad_value: float = 0.0,
+) -> jax.Array:
+    """Place an array on the mesh, row-sharded, zero-padding the row axis to a
+    multiple of the data-axis size.
+
+    Padded rows are made inert by giving them zero *weight* in every fit (the
+    WLS core always carries a per-row weight vector, so a zero-weight row
+    contributes nothing to X'WX, X'Wz, deviance, or SSE).  Callers that build
+    weights themselves must use :func:`pad_mask`.
+    """
+    x = np.asarray(x)
+    n = x.shape[0]
+    n_pad = padded_rows(n, mesh)
+    if n_pad != n:
+        pad_width = [(0, n_pad - n)] + [(0, 0)] * (x.ndim - 1)
+        x = np.pad(x, pad_width, constant_values=pad_value)
+    spec = row_spec(x.ndim, shard_features)
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def replicate(x, mesh: Mesh) -> jax.Array:
+    return jax.device_put(np.asarray(x), NamedSharding(mesh, P()))
+
+
+def pad_mask(n: int, mesh: Mesh, dtype=np.float32) -> np.ndarray:
+    """1.0 for real rows, 0.0 for padding rows (host-side; shard it with
+    :func:`shard_rows`)."""
+    n_pad = padded_rows(n, mesh)
+    m = np.zeros((n_pad,), dtype=dtype)
+    m[:n] = 1.0
+    return m

@@ -1,0 +1,72 @@
+"""Number/table formatting for R-style summaries.
+
+Mirrors the reference's print helpers — ``roundDigits``/``sigDigits``
+(/root/reference/src/main/scala/com/Alteryx/sparkGLM/utils.scala:146-169) and
+the fixed-width coefficient table assembly in ``SummaryLM``
+(LM.scala:100-114) / ``GLM.summary`` (GLM.scala:1009-1024).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def sig_digits(x: float, digits: int = 4) -> str:
+    """Significant-digit formatting like R's ``signif`` (utils.scala:157-169)."""
+    if x is None or (isinstance(x, float) and (math.isnan(x) or math.isinf(x))):
+        return str(x)
+    if x == 0:
+        return "0"
+    mag = math.floor(math.log10(abs(x)))
+    if mag < -4 or mag >= digits + 3:
+        return f"{x:.{max(digits - 1, 0)}e}"
+    decimals = max(digits - 1 - mag, 0)
+    s = f"{x:.{decimals}f}"
+    return s
+
+
+def round_digits(x: float, digits: int = 4) -> str:
+    """Fixed decimal rounding (utils.scala:146-154)."""
+    return f"{x:.{digits}f}"
+
+
+def p_stars(p: float) -> str:
+    """R's significance codes."""
+    if p < 0.001:
+        return "***"
+    if p < 0.01:
+        return "**"
+    if p < 0.05:
+        return "*"
+    if p < 0.1:
+        return "."
+    return " "
+
+
+def coef_table(
+    names,
+    columns: dict[str, np.ndarray],
+    *,
+    stars_from: str | None = None,
+    digits: int = 4,
+) -> str:
+    """Fixed-width coefficient table: one row per name, one column per stat."""
+    headers = list(columns)
+    cells = {
+        h: [sig_digits(float(v), digits) for v in columns[h]] for h in headers
+    }
+    name_w = max([len(str(n)) for n in names] + [0])
+    widths = {h: max([len(h)] + [len(c) for c in cells[h]]) for h in headers}
+    lines = [" " * name_w + "  " + "  ".join(h.rjust(widths[h]) for h in headers)]
+    for i, nm in enumerate(names):
+        row = str(nm).ljust(name_w) + "  " + "  ".join(
+            cells[h][i].rjust(widths[h]) for h in headers)
+        if stars_from is not None:
+            row += " " + p_stars(float(columns[stars_from][i]))
+        lines.append(row)
+    if stars_from is not None:
+        lines.append("---")
+        lines.append("Signif. codes:  0 '***' 0.001 '**' 0.01 '*' 0.05 '.' 0.1 ' ' 1")
+    return "\n".join(lines)

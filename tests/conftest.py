@@ -1,0 +1,49 @@
+"""Test harness: virtual 8-device CPU mesh + float64.
+
+The reference simulates a cluster with local-mode Spark and explicit
+partition counts (testData.scala:82, lmPredict$Test.scala:11-35 fits on 1 vs
+4 partitions).  Our analogue (SURVEY.md §4): force 8 virtual CPU devices via
+XLA_FLAGS and assert 1-device and 8-device meshes agree.  x64 is enabled so
+CPU tests can check 1e-6+ parity against float64 oracles; the TPU path runs
+float32 (bench.py exercises that).
+"""
+
+import os
+
+# belt-and-braces for subprocesses; the in-process settings below are what
+# actually matter (this image preloads jax via sitecustomize, so env vars
+# alone are too late)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh1():
+    import sparkglm_tpu as sg
+    return sg.make_mesh(n_data=1, devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import sparkglm_tpu as sg
+    return sg.make_mesh(n_data=8)
+
+
+@pytest.fixture(scope="session")
+def mesh42():
+    """4-way data x 2-way feature sharding."""
+    import sparkglm_tpu as sg
+    return sg.make_mesh(n_data=4, n_model=2)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

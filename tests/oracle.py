@@ -1,0 +1,99 @@
+"""Independent float64 numpy/scipy oracles for OLS and GLM-IRLS.
+
+Deliberately does NOT import sparkglm_tpu's family/link code — these are the
+textbook formulas implemented separately (scipy.special based), matching R's
+glm()/lm() semantics, which is the reference's stated correctness oracle
+(SURVEY.md §4: "match R glm() coefficients to 1e-6").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy import special as sp
+
+
+class L:
+    @staticmethod
+    def make(name):
+        return {
+            "identity": (lambda m: m, lambda e: e, lambda m: np.ones_like(m)),
+            "log": (np.log, np.exp, lambda m: 1 / m),
+            "logit": (sp.logit, sp.expit, lambda m: 1 / (m * (1 - m))),
+            "probit": (sp.ndtri, sp.ndtr,
+                       lambda m: 1 / np.maximum(np.exp(-0.5 * sp.ndtri(m) ** 2) / np.sqrt(2 * np.pi), 1e-300)),
+            "cloglog": (lambda m: np.log(-np.log1p(-m)),
+                        lambda e: -np.expm1(-np.exp(e)),
+                        lambda m: -1 / ((1 - m) * np.log1p(-m))),
+            "inverse": (lambda m: 1 / m, lambda e: 1 / e, lambda m: -1 / m**2),
+            "sqrt": (np.sqrt, lambda e: e**2, lambda m: 0.5 / np.sqrt(m)),
+        }[name]
+
+
+class F:
+    @staticmethod
+    def make(name):
+        def xlogy(x, y):
+            return sp.xlogy(x, y)
+
+        if name == "gaussian":
+            return dict(var=lambda m: np.ones_like(m),
+                        dev=lambda y, m, w: w * (y - m) ** 2,
+                        init=lambda y, w: y)
+        if name == "binomial":
+            return dict(var=lambda m: m * (1 - m),
+                        dev=lambda y, m, w: 2 * w * (xlogy(y, y) - xlogy(y, m)
+                                                     + xlogy(1 - y, 1 - y) - xlogy(1 - y, 1 - m)),
+                        init=lambda y, w: (w * y + 0.5) / (w + 1))
+        if name == "poisson":
+            return dict(var=lambda m: m,
+                        dev=lambda y, m, w: 2 * w * (xlogy(y, y) - xlogy(y, m) - (y - m)),
+                        init=lambda y, w: y + 0.1)
+        if name == "gamma":
+            return dict(var=lambda m: m**2,
+                        dev=lambda y, m, w: -2 * w * (np.log(np.maximum(y, 1e-300) / m) - (y - m) / m),
+                        init=lambda y, w: np.maximum(y, 1e-10))
+        raise KeyError(name)
+
+
+def ols_np(X, y, w=None):
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.float64)
+    if w is None:
+        w = np.ones_like(y)
+    Xw = X * w[:, None]
+    beta = np.linalg.solve(Xw.T @ X, Xw.T @ y)
+    return beta
+
+
+def irls_np(X, y, family, link, wt=None, offset=None, tol=1e-12, max_iter=200):
+    """R-style IRLS to tight tolerance; returns (beta, deviance, iters, cov)."""
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.float64)
+    n = len(y)
+    wt = np.ones(n) if wt is None else np.asarray(wt, np.float64)
+    off = np.zeros(n) if offset is None else np.asarray(offset, np.float64)
+    g, ginv, gprime = L.make(link)
+    fam = F.make(family)
+    mu = fam["init"](y, wt)
+    eta = g(mu)
+    dev = fam["dev"](y, mu, wt).sum()
+    beta = np.zeros(X.shape[1])
+    XtWXi = None
+    for it in range(1, max_iter + 1):
+        gp = gprime(mu)
+        w = wt / (fam["var"](mu) * gp**2)
+        z = eta - off + (y - mu) * gp
+        Xw = X * w[:, None]
+        XtWX = Xw.T @ X
+        beta = np.linalg.solve(XtWX, Xw.T @ z)
+        XtWXi = np.linalg.inv(XtWX)
+        eta = X @ beta + off
+        mu = ginv(eta)
+        if family == "binomial":
+            mu = np.clip(mu, 1e-10, 1 - 1e-10)
+        dev_new = fam["dev"](y, mu, wt).sum()
+        if abs(dev_new - dev) < tol * (abs(dev_new) + 0.1):
+            dev = dev_new
+            break
+        dev = dev_new
+    return beta, dev, it, XtWXi

@@ -1,0 +1,155 @@
+"""Formula / model-matrix / frame front-end tests.
+
+Mirrors the reference's modelMatrix$Test.scala (dummy coding on mixed /
+numeric-only / string-only frames) and utils$Test.scala (matchCols
+zero-fill), plus formula semantics from R/pkg/R/utils.R:8-22.
+"""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.data.formula import parse_formula
+from sparkglm_tpu.data.frame import omit_na
+
+
+def _mixed(n=9):
+    return {
+        "y": np.arange(n, dtype=np.float64),
+        "x1": np.linspace(0, 1, n),
+        "x7": np.array(["a", "b", "c"] * (n // 3)),
+    }
+
+
+# -- formula (utils.R:8-22) ---------------------------------------------------
+
+def test_parse_formula_basic():
+    f = parse_formula("y ~ x1 + x2 + cat")
+    assert f.response == "y"
+    assert f.predictors == ("x1", "x2", "cat")
+    assert f.intercept
+
+
+def test_parse_formula_no_intercept():
+    assert not parse_formula("y ~ x1 - 1").intercept
+    assert not parse_formula("y ~ 0 + x1").intercept
+    assert parse_formula("y ~ 1 + x1").intercept
+
+
+def test_parse_formula_dot():
+    f = parse_formula("y ~ .")
+    assert f.resolve_predictors(["y", "a", "b"]) == ["a", "b"]
+
+
+def test_parse_formula_errors():
+    with pytest.raises(ValueError):
+        parse_formula("y + x1")
+    with pytest.raises(ValueError):
+        parse_formula("~ x1")
+    with pytest.raises(ValueError):
+        parse_formula("y ~ x1 - x2")
+    with pytest.raises(KeyError):
+        parse_formula("y ~ nope").resolve_predictors(["y", "x1"])
+
+
+# -- model matrix (modelMatrix.scala:18-85) -----------------------------------
+
+def test_dummy_coding_mixed():
+    X, terms = sg.model_matrix(_mixed(), ["x1", "x7"])
+    # sorted levels a,b,c -> drop 'a' (modelMatrix.scala:56-58)
+    assert terms.xnames == ("x1", "x7_b", "x7_c")
+    assert X.shape == (9, 3)
+    np.testing.assert_array_equal(X[:3, 1], [0, 1, 0])  # rows a,b,c
+    np.testing.assert_array_equal(X[:3, 2], [0, 0, 1])
+    assert X.dtype == np.float32  # castAll
+
+
+def test_numeric_only_passthrough():
+    d = {"a": np.arange(4.0), "b": np.arange(4.0) * 2}
+    X, terms = sg.model_matrix(d)
+    assert terms.xnames == ("a", "b")
+    np.testing.assert_allclose(X[:, 1], d["b"])
+
+
+def test_intercept_column():
+    X, terms = sg.model_matrix(_mixed(), ["x1"], intercept=True)
+    assert terms.xnames[0] == "intercept"
+    np.testing.assert_array_equal(X[:, 0], np.ones(9))
+
+
+def test_match_cols_zero_fill():
+    """utils$Test.scala:10-24: scoring data missing a training category gets
+    an all-zero dummy column."""
+    train = {"x7": np.array(["a", "b", "c"]), "x1": np.ones(3)}
+    _, terms = sg.model_matrix(train, ["x1", "x7"])
+    test_d = {"x7": np.array(["a", "b", "b"]), "x1": np.ones(3)}
+    Xs = sg.transform(test_d, terms)
+    assert Xs.shape == (3, 3)
+    np.testing.assert_array_equal(Xs[:, 2], [0, 0, 0])  # x7_c zero-filled
+
+
+def test_unseen_level_maps_to_baseline():
+    train = {"x7": np.array(["a", "b", "c"])}
+    _, terms = sg.model_matrix(train)
+    Xs = sg.transform({"x7": np.array(["zz"])}, terms)
+    np.testing.assert_array_equal(Xs, [[0.0, 0.0]])
+
+
+def test_missing_column_raises():
+    _, terms = sg.model_matrix(_mixed(), ["x1", "x7"])
+    with pytest.raises(KeyError):
+        sg.transform({"x1": np.ones(2)}, terms)
+
+
+# -- NA omission (utils.R:24-27) ----------------------------------------------
+
+def test_omit_na():
+    cols = {"a": np.array([1.0, np.nan, 3.0]), "b": np.array([1.0, 2.0, 3.0])}
+    out, keep = omit_na(cols)
+    assert keep.tolist() == [True, False, True]
+    np.testing.assert_array_equal(out["a"], [1.0, 3.0])
+
+
+# -- end-to-end formula API ---------------------------------------------------
+
+def test_lm_formula_end_to_end(mesh8):
+    rng = np.random.default_rng(0)
+    n = 240
+    species = np.array(["setosa", "versicolor", "virginica"])[rng.integers(0, 3, n)]
+    x = rng.normal(size=n)
+    y = 2.0 + 1.5 * x + (species == "versicolor") * 0.7 + (species == "virginica") * (-0.4) + 0.05 * rng.normal(size=n)
+    data = {"y": y, "x": x, "species": species}
+    m = sg.lm("y ~ x + species", data, mesh=mesh8)
+    assert m.xnames == ("intercept", "x", "species_versicolor", "species_virginica")
+    np.testing.assert_allclose(
+        m.coefficients, [2.0, 1.5, 0.7, -0.4], atol=0.05)
+    pred = sg.predict(m, data)
+    assert pred.shape == (n,)
+    np.testing.assert_allclose(pred, y, atol=0.25)
+    s = str(m.summary())
+    assert "Coefficients" in s and "R-Squared" in s
+
+
+def test_glm_formula_categorical_response(mesh8):
+    rng = np.random.default_rng(1)
+    n = 400
+    x = rng.normal(size=n)
+    p = 1 / (1 + np.exp(-(0.5 + 1.2 * x)))
+    yes = rng.uniform(size=n) < p
+    data = {"outcome": np.where(yes, "yes", "no"), "x": x}
+    m = sg.glm("outcome ~ x", data, family="binomial", mesh=mesh8)
+    assert m.xnames == ("intercept", "x")
+    assert abs(m.coefficients[1] - 1.2) < 0.5
+    mu = sg.predict(m, data)
+    assert np.all((mu > 0) & (mu < 1))
+    eta = sg.predict(m, data, type="link")
+    np.testing.assert_allclose(mu, 1 / (1 + np.exp(-eta)), rtol=1e-6)
+
+
+def test_formula_na_omission_end_to_end(mesh1):
+    data = {
+        "y": np.array([1.0, 2.0, np.nan, 4.0, 5.0, 6.0]),
+        "x": np.array([1.0, 2.0, 3.0, np.nan, 5.0, 6.0]),
+    }
+    m = sg.lm("y ~ x", data, mesh=mesh1)
+    assert m.n_obs == 4

@@ -1,0 +1,136 @@
+"""GLM / IRLS parity tests against a float64 numpy oracle (R semantics).
+
+The reference has NO GLM tests at all (SURVEY.md §4: "none at all for
+GLM/IRLS") — its stated oracle is R glm() to 1e-6; oracle.irls_np implements
+exactly those semantics independently.
+"""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from oracle import irls_np
+
+
+def _logistic_data(rng, n=2000, p=6):
+    X = rng.normal(size=(n, p)).astype(np.float64)
+    X[:, 0] = 1.0
+    beta = rng.normal(size=p) * 0.7
+    prob = 1 / (1 + np.exp(-(X @ beta)))
+    y = (rng.uniform(size=n) < prob).astype(np.float64)
+    return X, y
+
+
+@pytest.mark.parametrize("link", ["logit", "probit", "cloglog"])
+def test_binomial_links_match_oracle(rng, mesh8, link):
+    X, y = _logistic_data(rng)
+    m = sg.glm_fit(X, y, family="binomial", link=link, tol=1e-10, mesh=mesh8)
+    beta_ref, dev_ref, _, _ = irls_np(X, y, "binomial", link)
+    np.testing.assert_allclose(m.coefficients, beta_ref, rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(m.deviance, dev_ref, rtol=1e-8)
+    assert m.converged
+
+
+def test_single_vs_eight_shards_agree(rng, mesh1, mesh8):
+    X, y = _logistic_data(rng, n=1001)  # padding path
+    m1 = sg.glm_fit(X, y, family="binomial", tol=1e-9, mesh=mesh1)
+    m8 = sg.glm_fit(X, y, family="binomial", tol=1e-9, mesh=mesh8)
+    np.testing.assert_allclose(m1.coefficients, m8.coefficients, rtol=1e-8)
+    np.testing.assert_allclose(m1.deviance, m8.deviance, rtol=1e-10)
+    np.testing.assert_allclose(m1.loglik, m8.loglik, rtol=1e-10)
+    assert m1.iterations == m8.iterations
+
+
+def test_poisson_log(rng, mesh8):
+    n, p = 1500, 5
+    X = rng.normal(size=(n, p)) * 0.5
+    X[:, 0] = 1.0
+    beta = rng.normal(size=p) * 0.4
+    y = rng.poisson(np.exp(X @ beta)).astype(np.float64)
+    m = sg.glm_fit(X, y, family="poisson", tol=1e-10, mesh=mesh8)
+    beta_ref, dev_ref, _, _ = irls_np(X, y, "poisson", "log")
+    np.testing.assert_allclose(m.coefficients, beta_ref, rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(m.deviance, dev_ref, rtol=1e-7)
+    assert m.dispersion == 1.0  # fixed for poisson
+
+
+def test_gamma_inverse_with_weights_and_offset(rng, mesh8):
+    """BASELINE config 5: gamma + prior weights + offset through the sharded
+    path (the reference falls back to single-partition here, GLM.scala:640-642)."""
+    n, p = 1200, 4
+    X = np.abs(rng.normal(size=(n, p))) + 0.5
+    X[:, 0] = 1.0
+    beta = np.abs(rng.normal(size=p)) * 0.3 + 0.2
+    off = rng.uniform(0.0, 0.3, size=n)
+    mu = 1 / (X @ beta + off)
+    shape = 5.0
+    y = rng.gamma(shape, mu / shape, size=n)
+    wt = rng.uniform(0.5, 2.0, size=n)
+    m = sg.glm_fit(X, y, family="gamma", link="inverse", weights=wt,
+                   offset=off, tol=1e-11, mesh=mesh8)
+    beta_ref, dev_ref, _, _ = irls_np(X, y, "gamma", "inverse", wt=wt, offset=off)
+    np.testing.assert_allclose(m.coefficients, beta_ref, rtol=1e-6)
+    np.testing.assert_allclose(m.deviance, dev_ref, rtol=1e-7)
+    assert not np.isnan(m.dispersion) and m.dispersion > 0
+
+
+def test_gaussian_identity_one_iteration(rng, mesh8):
+    """Gaussian/identity IRLS == OLS in a single Fisher step."""
+    X = rng.normal(size=(800, 5))
+    X[:, 0] = 1.0
+    y = X @ rng.normal(size=5) + rng.normal(size=800)
+    mg = sg.glm_fit(X, y, family="gaussian", tol=1e-9, mesh=mesh8)
+    ml = sg.lm_fit(X, y, mesh=mesh8)
+    np.testing.assert_allclose(mg.coefficients, ml.coefficients, rtol=1e-8)
+
+
+def test_binomial_group_sizes_m(rng, mesh8):
+    """Counts y out of group sizes m — the reference's (y, m) surface
+    (GLM.scala:254-315), equivalent to R's proportion+weights form."""
+    n, p = 600, 4
+    X = rng.normal(size=(n, p))
+    X[:, 0] = 1.0
+    beta = rng.normal(size=p) * 0.5
+    mm = rng.integers(1, 20, size=n).astype(np.float64)
+    prob = 1 / (1 + np.exp(-(X @ beta)))
+    counts = rng.binomial(mm.astype(int), prob).astype(np.float64)
+    m = sg.glm_fit(X, counts, family="binomial", m=mm, tol=1e-10, mesh=mesh8)
+    beta_ref, dev_ref, _, _ = irls_np(X, counts / mm, "binomial", "logit", wt=mm)
+    np.testing.assert_allclose(m.coefficients, beta_ref, rtol=1e-6)
+    np.testing.assert_allclose(m.deviance, dev_ref, rtol=1e-7)
+
+
+def test_std_errors_match_fisher_information(rng, mesh8):
+    X, y = _logistic_data(rng, n=1000, p=4)
+    m = sg.glm_fit(X, y, family="binomial", tol=1e-11, mesh=mesh8)
+    _, _, _, cov = irls_np(X, y, "binomial", "logit")
+    np.testing.assert_allclose(m.std_errors, np.sqrt(np.diag(cov)), rtol=1e-5)
+
+
+def test_max_iter_guard(rng, mesh1):
+    X, y = _logistic_data(rng, n=300, p=3)
+    m = sg.glm_fit(X, y, family="binomial", tol=0.0, max_iter=3, mesh=mesh1)
+    assert m.iterations == 3
+    assert not m.converged  # the guard the reference lacks (GLM.scala:452)
+
+
+def test_perfect_separation_does_not_nan(rng, mesh1):
+    """Saturating logistic fit must stay finite (mu clipping)."""
+    n = 200
+    x = np.linspace(-2, 2, n)
+    X = np.stack([np.ones(n), x], axis=1)
+    y = (x > 0).astype(np.float64)
+    m = sg.glm_fit(X, y, family="binomial", max_iter=25, mesh=mesh1)
+    assert np.all(np.isfinite(m.coefficients))
+    assert np.isfinite(m.deviance)
+
+
+def test_aic_and_loglik_binomial(rng, mesh8):
+    X, y = _logistic_data(rng, n=800, p=4)
+    m = sg.glm_fit(X, y, family="binomial", tol=1e-10, mesh=mesh8)
+    # exact Bernoulli loglik at the fitted probabilities
+    eta = X @ m.coefficients
+    mu = 1 / (1 + np.exp(-eta))
+    ll = float(np.sum(y * np.log(mu) + (1 - y) * np.log1p(-mu)))
+    np.testing.assert_allclose(m.loglik, ll, rtol=1e-7)
+    np.testing.assert_allclose(m.aic, -2 * ll + 2 * X.shape[1], rtol=1e-7)
