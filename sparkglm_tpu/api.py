@@ -45,7 +45,9 @@ def _design(formula: str, data, *, na_omit: bool, dtype):
 def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
        config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """R-style ``lm(formula, data)`` (ref: sparkLM, R/pkg/R/LM.R:24-44)."""
-    f, X, y, terms, _ = _design(formula, data, na_omit=na_omit, dtype=np.dtype(config.dtype))
+    f, X, y, terms, cols = _design(formula, data, na_omit=na_omit, dtype=np.dtype(config.dtype))
+    if isinstance(weights, str):
+        weights = cols[weights]  # column name, post-NA-omit (same as glm)
     model = lm_mod.fit(
         X, y, weights=weights, xnames=terms.xnames, yname=f.response,
         has_intercept=f.intercept, mesh=mesh, config=config)

@@ -57,7 +57,16 @@ def parse_formula(formula: str) -> Formula:
     intercept = True
     predictors: list[str] = []
     # split on '+' and '-' keeping the sign of each term (utils.R:12-21 keeps
-    # only '+' terms; '-1' removes the intercept)
+    # only '+' terms; '-1' removes the intercept).  Reject anything the
+    # grammar doesn't cover ('*', ':', '^', 'I(...)', numeric terms) instead
+    # of silently fitting a different model.
+    leftover = re.sub(r"([+-]?)\s*([A-Za-z_.][A-Za-z0-9_.]*|[01])", "", rhs)
+    leftover = re.sub(r"[\s+]", "", leftover)
+    if leftover:
+        raise ValueError(
+            f"unsupported formula syntax {leftover!r} in {formula!r}: only "
+            "'+'-separated terms, '.', and 1/-1/0 intercept markers are "
+            "supported (no interactions '*'/':' or transforms)")
     tokens = re.findall(r"([+-]?)\s*([A-Za-z_.][A-Za-z0-9_.]*|[01])", rhs)
     if not tokens:
         raise ValueError(f"no terms on the right of '~': {formula!r}")

@@ -53,13 +53,15 @@ def _sanitize(x, valid, fill=0.0):
     return jnp.where(valid, jnp.nan_to_num(x, nan=fill, posinf=fill, neginf=fill), fill)
 
 
-@partial(jax.jit, static_argnames=("family", "link", "criterion", "refine_steps"))
+@partial(jax.jit, static_argnames=("family", "link", "criterion", "refine_steps",
+                                   "null_mean"))
 def _irls_kernel(
     X, y, wt, offset,
     tol, max_iter, jitter,
     family: Family, link: Link,
     criterion: str = "absolute",
     refine_steps: int = 1,
+    null_mean: bool = True,
 ):
     """Full IRLS to convergence in one compiled while_loop.
 
@@ -126,8 +128,14 @@ def _irls_kernel(
     pearson = jnp.sum(_sanitize(wt * (y - mu) ** 2 / jnp.maximum(family.variance(mu), 1e-30), valid))  # ref: GLM.scala:104-118
     loglik = jnp.sum(_sanitize(family.loglik_terms(y, mu, wt), valid))          # ref: GLM.scala:146-159
     wt_sum = jnp.sum(wt)
-    mu_null = jnp.sum(jnp.where(valid, wt * y, 0.0)) / wt_sum
-    null_dev = dev_of(jnp.where(valid, mu_null, 1.0))                            # ref: nullDev via ybar
+    if null_mean:
+        # intercept model, no offset: null mu is the weighted mean of y
+        # (ref: nullDev via ybar, GLM.scala:420-424)
+        mu_null = jnp.sum(jnp.where(valid, wt * y, 0.0)) / wt_sum
+        null_dev = dev_of(jnp.where(valid, mu_null, 1.0))
+    else:
+        # R semantics for a no-intercept model: null mu = linkinv(offset)
+        null_dev = dev_of(jnp.where(valid, link.inverse(offset), 1.0))
     d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
     converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"]
 
@@ -231,6 +239,9 @@ def fit(
     """
     from .lm import _detect_intercept
 
+    if criterion not in ("absolute", "relative"):
+        raise ValueError(
+            f"criterion must be 'absolute' or 'relative', got {criterion!r}")
     fam, lnk = resolve(family, link)
     X = np.asarray(X)
     y = np.asarray(y)
@@ -267,15 +278,28 @@ def fit(
     wd = meshlib.shard_rows(wt, mesh)      # padding rows get wt=0 -> inert
     od = meshlib.shard_rows(off, mesh)
 
+    has_offset = offset is not None and bool(np.any(off != 0))
+    tol_dev = jnp.asarray(tol, jnp.float32 if not use_f64 else jnp.float64)
     out = _irls_kernel(
-        Xd, yd, wd, od,
-        jnp.asarray(tol, jnp.float32 if not use_f64 else jnp.float64),
+        Xd, yd, wd, od, tol_dev,
         jnp.asarray(max_iter, jnp.int32),
         jnp.asarray(config.jitter, dtype),
         family=fam, link=lnk, criterion=criterion,
         refine_steps=config.refine_steps,
+        null_mean=has_intercept and not has_offset,
     )
     out = jax.tree.map(np.asarray, out)
+    if has_intercept and has_offset:
+        # R semantics: with an offset, the null model is an intercept-only
+        # GLM honouring the offset — run the same kernel on a ones design.
+        ones_d = meshlib.shard_rows(np.ones((n, 1), dtype), mesh)
+        null_out = _irls_kernel(
+            ones_d, yd, wd, od, tol_dev,
+            jnp.asarray(max_iter, jnp.int32),
+            jnp.asarray(config.jitter, dtype),
+            family=fam, link=lnk, criterion=criterion,
+            refine_steps=config.refine_steps, null_mean=True)
+        out["null_dev"] = np.asarray(null_out["dev"])
     if bool(out["singular"]):
         raise np.linalg.LinAlgError(
             "singular weighted Gramian during IRLS; consider jitter in NumericConfig")

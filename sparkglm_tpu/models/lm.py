@@ -92,7 +92,9 @@ class LMModel:
             raise ValueError(
                 f"predict expects (n, {self.n_params}) design matrix aligned to "
                 f"xnames={list(self.xnames)}; got {X.shape}")
-        beta = jnp.asarray(self.coefficients, dtype=X.dtype if X.dtype != np.float64 else None)
+        if not np.issubdtype(X.dtype, np.floating):
+            X = X.astype(np.float32)  # int designs must not truncate beta
+        beta = jnp.asarray(self.coefficients, dtype=X.dtype)
         return np.asarray(_predict_jit(jnp.asarray(X), beta))
 
     def summary(self):
@@ -125,8 +127,7 @@ def _detect_intercept(X: np.ndarray, xnames: Sequence[str] | None) -> bool:
     present iff some column is constant 1 (or is named 'intercept')."""
     if xnames is not None and any(n.lower() in ("intercept", "(intercept)") for n in xnames):
         return True
-    head = X[: min(len(X), 1024)]
-    return bool(np.any(np.all(head == 1.0, axis=0)))
+    return bool(np.any(np.all(X == 1.0, axis=0)))
 
 
 def fit(

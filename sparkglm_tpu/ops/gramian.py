@@ -58,8 +58,10 @@ def weighted_moments(y, w, *, accum_dtype=jnp.float32):
     w = w.astype(accum_dtype)
     ya = y.astype(accum_dtype)
     n = jnp.sum(w)
-    s1 = jnp.sum(w * ya)
-    s2 = jnp.sum(w * ya * ya)
-    mean = s1 / n
-    ss_centered = s2 - s1 * s1 / n
+    mean = jnp.sum(w * ya) / n
+    # two-pass centered SS: the one-pass s2 - s1^2/n form cancels
+    # catastrophically in float32 when |mean| >> std (XLA fuses both passes
+    # into the same HBM read anyway)
+    d = ya - mean
+    ss_centered = jnp.sum(w * d * d)
     return n, mean, ss_centered
