@@ -1,0 +1,105 @@
+"""Headline benchmark: binomial/logit IRLS time-to-convergence.
+
+Config 2 of BASELINE.json — logistic regression on 1M x 100 synthetic —
+timed as the on-device IRLS kernel (data resident in HBM, one compiled
+``lax.while_loop`` to convergence; see sparkglm_tpu/models/glm.py).
+
+Prints ONE JSON line::
+
+    {"metric": ..., "value": <seconds>, "unit": "s", "vs_baseline": <ratio>}
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+yardstick is BASELINE.json's north-star target — 10M x 1000 logistic to
+convergence in 60 s on v5e-8.  We extrapolate this run to that config with a
+per-iteration n*p^2 cost model and perfect 8-chip data-parallel scaling:
+``vs_baseline = 60 / est_headline_seconds`` (>1 means beating the target).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _make_data(n: int, p: int, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    X = np.empty((n, p), np.float32)
+    X[:, 0] = 1.0
+    X[:, 1:] = rng.standard_normal((n, p - 1), dtype=np.float32)
+    beta_true = (rng.standard_normal(p) / (2.0 * np.sqrt(p))).astype(np.float32)
+    prob = 1.0 / (1.0 + np.exp(-(X @ beta_true)))
+    y = (rng.random(n) < prob).astype(np.float32)
+    return X, y
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import sparkglm_tpu as sg
+    from sparkglm_tpu.families.families import resolve
+    from sparkglm_tpu.models.glm import _irls_kernel
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu"
+    n, p = (1_000_000, 100) if on_tpu else (100_000, 20)
+
+    X, y = _make_data(n, p)
+    mesh = sg.make_mesh()  # all local devices on the "data" axis
+    from sparkglm_tpu.parallel import mesh as meshlib
+
+    Xd = meshlib.shard_rows(X, mesh)
+    yd = meshlib.shard_rows(y, mesh)
+    wd = meshlib.shard_rows(np.ones((n,), np.float32), mesh)
+    od = meshlib.shard_rows(np.zeros((n,), np.float32), mesh)
+
+    fam, lnk = resolve("binomial", "logit")
+    kw = dict(family=fam, link=lnk, criterion="relative", refine_steps=1,
+              null_mean=True)
+    args = (Xd, yd, wd, od, jnp.float32(1e-8), jnp.int32(25), jnp.float32(0.0))
+
+    # Warm-up: compile (cached) + one full run.
+    out = _irls_kernel(*args, **kw)
+    jax.block_until_ready(out)
+    if not bool(out["converged"]):
+        print(f"warning: warm-up did not converge in 25 iters "
+              f"(iters={int(out['iters'])})", file=sys.stderr)
+
+    # Timed: best of 3 full IRLS-to-convergence runs, data resident in HBM.
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = _irls_kernel(*args, **kw)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    t = min(times)
+    iters = int(out["iters"])
+
+    # Extrapolate to the north-star config: 10M x 1000 on 8 chips, same
+    # iteration count, per-iteration cost ~ n*p^2 (Gramian-dominated).
+    # est = t * (headline work per chip) / (bench work per chip)
+    n_chips = len(jax.devices()) if on_tpu else 1
+    work_headline = 10_000_000 * 1000**2
+    work_bench = n * p**2
+    est_headline = t * (work_headline / 8) / (work_bench / n_chips)
+    vs_baseline = 60.0 / est_headline if est_headline > 0 else 0.0
+
+    print(json.dumps({
+        "metric": f"logistic_{n//1000}kx{p}_irls_time_to_convergence"
+                  + ("" if on_tpu else f"_{platform}"),
+        "value": round(t, 4),
+        "unit": "s",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+    print(f"platform={platform} devices={len(jax.devices())} iters={iters} "
+          f"converged={bool(out['converged'])} deviance={float(out['dev']):.6g} "
+          f"runs={[round(x, 4) for x in times]} "
+          f"est_headline_10Mx1000_8chip={est_headline:.2f}s",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,13 +20,30 @@ from .models import glm as glm_mod
 from .models import lm as lm_mod
 
 
-def _design(formula: str, data, *, na_omit: bool, dtype):
+def _subset_extra(v, keep: np.ndarray, what: str) -> np.ndarray:
+    """Align an array-valued weights/offset/m argument with the NA-omitted
+    rows: it must match the *pre-omit* length and gets the same keep-mask."""
+    arr = np.asarray(v)
+    if arr.shape != keep.shape:
+        raise ValueError(
+            f"{what} has length {arr.shape[0] if arr.ndim else 'scalar'}, "
+            f"expected {keep.shape[0]} (the pre-NA-omit row count)")
+    return arr[keep]
+
+
+def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
     f = parse_formula(formula)
     cols = as_columns(data)
     predictors = f.resolve_predictors(list(cols))
-    used = [f.response] + predictors
+    # by-name weights/offset/m columns join the NA-omit scan so a NaN weight
+    # drops its row instead of poisoning the weighted Gramian (R model-frame
+    # semantics)
+    used = [f.response] + predictors + [c for c in extra_cols
+                                        if isinstance(c, str)]
+    n_in = len(next(iter(cols.values()))) if cols else 0
+    keep = np.ones(n_in, dtype=bool)
     if na_omit:
-        cols, _ = omit_na(cols, used)  # omitNA, R/pkg/R/utils.R:24-27
+        cols, keep = omit_na(cols, used)  # omitNA, R/pkg/R/utils.R:24-27
     yraw = cols[f.response]
     if is_categorical(yraw):
         # two-level factor response: first (sorted) level = failure, as in R
@@ -39,15 +56,19 @@ def _design(formula: str, data, *, na_omit: bool, dtype):
         y = yraw.astype(np.float64)
     terms = build_terms(cols, predictors, intercept=f.intercept)
     X = transform(cols, terms, dtype=dtype)
-    return f, X, y, terms, cols
+    return f, X, y, terms, cols, keep
 
 
 def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
        config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """R-style ``lm(formula, data)`` (ref: sparkLM, R/pkg/R/LM.R:24-44)."""
-    f, X, y, terms, cols = _design(formula, data, na_omit=na_omit, dtype=np.dtype(config.dtype))
+    f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
+                                         dtype=np.dtype(config.dtype),
+                                         extra_cols=(weights,))
     if isinstance(weights, str):
         weights = cols[weights]  # column name, post-NA-omit (same as glm)
+    elif weights is not None:
+        weights = _subset_extra(weights, keep, "weights")
     model = lm_mod.fit(
         X, y, weights=weights, xnames=terms.xnames, yname=f.response,
         has_intercept=f.intercept, mesh=mesh, config=config)
@@ -62,16 +83,19 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
     """R-style ``glm(formula, data, family, link, ...)``.
 
     ``offset``/``m`` may be column names in ``data`` or arrays."""
-    f, X, y, terms, cols = _design(formula, data, na_omit=na_omit, dtype=np.dtype(config.dtype))
+    f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
+                                         dtype=np.dtype(config.dtype),
+                                         extra_cols=(weights, offset, m))
 
-    def _col_or_array(v):
+    def _col_or_array(v, what):
         if isinstance(v, str):
             return cols[v]  # post-NA-omit columns, so lengths stay aligned
-        return None if v is None else np.asarray(v)
+        return None if v is None else _subset_extra(v, keep, what)
 
     model = glm_mod.fit(
-        X, y, family=family, link=link, weights=_col_or_array(weights),
-        offset=_col_or_array(offset), m=_col_or_array(m), tol=tol,
+        X, y, family=family, link=link,
+        weights=_col_or_array(weights, "weights"),
+        offset=_col_or_array(offset, "offset"), m=_col_or_array(m, "m"), tol=tol,
         max_iter=max_iter, criterion=criterion, xnames=terms.xnames,
         yname=f.response, has_intercept=f.intercept, mesh=mesh,
         verbose=verbose, config=config)

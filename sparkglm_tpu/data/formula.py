@@ -60,17 +60,22 @@ def parse_formula(formula: str) -> Formula:
     # only '+' terms; '-1' removes the intercept).  Reject anything the
     # grammar doesn't cover ('*', ':', '^', 'I(...)', numeric terms) instead
     # of silently fitting a different model.
-    leftover = re.sub(r"([+-]?)\s*([A-Za-z_.][A-Za-z0-9_.]*|[01])", "", rhs)
+    token_re = r"([+-]?)\s*([A-Za-z_.][A-Za-z0-9_.]*|\d+)"
+    leftover = re.sub(token_re, "", rhs)
     leftover = re.sub(r"[\s+]", "", leftover)
     if leftover:
         raise ValueError(
             f"unsupported formula syntax {leftover!r} in {formula!r}: only "
             "'+'-separated terms, '.', and 1/-1/0 intercept markers are "
             "supported (no interactions '*'/':' or transforms)")
-    tokens = re.findall(r"([+-]?)\s*([A-Za-z_.][A-Za-z0-9_.]*|[01])", rhs)
+    tokens = re.findall(token_re, rhs)
     if not tokens:
         raise ValueError(f"no terms on the right of '~': {formula!r}")
     for sign, term in tokens:
+        if term.isdigit() and term not in ("0", "1"):
+            raise ValueError(
+                f"numeric term {term!r} in {formula!r}: only 1/-1/0 intercept "
+                "markers are supported")
         if term == "1":
             intercept = sign != "-"
         elif term == "0":

@@ -263,15 +263,23 @@ def fit(
     use_f64 = X.dtype == np.float64 and jnp.zeros((), jnp.float64).dtype == jnp.float64
     dtype = np.float64 if use_f64 else np.dtype(config.dtype)
 
-    wt = np.ones((n,), dtype=dtype) if weights is None else np.asarray(weights, dtype=dtype).copy()
+    def _check_len(v, what):
+        v = np.asarray(v)
+        if v.shape != (n,):
+            raise ValueError(f"{what} must have shape ({n},), got {v.shape}")
+        return v
+
+    wt = (np.ones((n,), dtype=dtype) if weights is None
+          else _check_len(weights, "weights").astype(dtype).copy())
     y = y.astype(dtype, copy=True)
     if m is not None:
-        m_arr = np.asarray(m, dtype=dtype)
+        m_arr = _check_len(m, "m").astype(dtype)
         if fam.name != "binomial":
             raise ValueError("group sizes m only apply to the binomial family")
         y = y / np.maximum(m_arr, 1e-30)   # counts -> proportions
         wt = wt * m_arr
-    off = np.zeros((n,), dtype=dtype) if offset is None else np.asarray(offset, dtype=dtype)
+    off = (np.zeros((n,), dtype=dtype) if offset is None
+           else _check_len(offset, "offset").astype(dtype))
 
     Xd = meshlib.shard_rows(X.astype(dtype, copy=False), mesh, shard_features=shard_features)
     yd = meshlib.shard_rows(y, mesh)

@@ -93,9 +93,12 @@ class LMModel:
                 f"predict expects (n, {self.n_params}) design matrix aligned to "
                 f"xnames={list(self.xnames)}; got {X.shape}")
         if not np.issubdtype(X.dtype, np.floating):
-            X = X.astype(np.float32)  # int designs must not truncate beta
-        beta = jnp.asarray(self.coefficients, dtype=X.dtype)
-        return np.asarray(_predict_jit(jnp.asarray(X), beta))
+            X = X.astype(np.float64)
+        # jnp.asarray canonicalizes per the x64 setting without the
+        # explicit-dtype truncation warning; beta then matches X's device dtype
+        Xj = jnp.asarray(X)
+        beta = jnp.asarray(self.coefficients, dtype=Xj.dtype)
+        return np.asarray(_predict_jit(Xj, beta))
 
     def summary(self):
         from .summary import LMSummary
@@ -127,7 +130,11 @@ def _detect_intercept(X: np.ndarray, xnames: Sequence[str] | None) -> bool:
     present iff some column is constant 1 (or is named 'intercept')."""
     if xnames is not None and any(n.lower() in ("intercept", "(intercept)") for n in xnames):
         return True
-    return bool(np.any(np.all(X == 1.0, axis=0)))
+    # O(1) endpoint guard per column, full O(n) scan only on survivors;
+    # stops at the first constant-ones column (usually column 0)
+    return any(
+        X[0, j] == 1.0 and X[-1, j] == 1.0 and bool(np.all(X[:, j] == 1.0))
+        for j in range(X.shape[1]))
 
 
 def fit(

@@ -14,6 +14,67 @@ def test_formula_rejects_interactions():
             parse_formula(bad)
 
 
+def test_formula_rejects_multidigit_numerals():
+    """'10' must not tokenize as '1','0' and silently drop the intercept."""
+    for bad in ("y ~ x + 10", "y ~ x + 11", "y ~ x - 10", "y ~ 100 + x"):
+        with pytest.raises(ValueError, match="numeric term"):
+            parse_formula(bad)
+    assert parse_formula("y ~ x + 1").intercept
+    assert not parse_formula("y ~ x - 1").intercept
+    assert not parse_formula("y ~ x + 0").intercept
+
+
+def test_nan_weight_column_row_dropped(mesh1, rng):
+    """A NaN in a by-name weights column drops the row (R model-frame
+    semantics) instead of producing all-NaN coefficients."""
+    n = 200
+    d = {"y": rng.normal(size=n), "x": rng.normal(size=n),
+         "w": rng.uniform(0.5, 2.0, size=n)}
+    d["w"][7] = np.nan
+    m = sg.lm("y ~ x", d, weights="w", mesh=mesh1)
+    assert np.all(np.isfinite(m.coefficients))
+    assert m.n_obs == n - 1
+    keep = np.ones(n, bool)
+    keep[7] = False
+    m_ref = sg.lm("y ~ x", {k: v[keep] for k, v in d.items()},
+                  weights="w", mesh=mesh1)
+    np.testing.assert_allclose(m.coefficients, m_ref.coefficients, rtol=1e-12)
+
+
+def test_array_offset_realigned_after_na_omit(mesh8, rng):
+    """Array-valued offset/weights get the same keep-mask as the design."""
+    n = 200
+    x = rng.normal(size=n)
+    off = rng.uniform(0, 1, size=n)
+    y = rng.poisson(np.exp(0.2 + 0.4 * x + off)).astype(float)
+    d = {"y": y, "x": x.copy()}
+    d["x"][5] = np.nan
+    m = sg.glm("y ~ x", d, family="poisson", offset=off, mesh=mesh8)
+    keep = np.ones(n, bool)
+    keep[5] = False
+    m_ref = sg.glm("y ~ x", {k: v[keep] for k, v in d.items()},
+                   family="poisson", offset=off[keep], mesh=mesh8)
+    np.testing.assert_allclose(m.coefficients, m_ref.coefficients, rtol=1e-10)
+    # wrong-length extras fail loudly at both API levels
+    with pytest.raises(ValueError, match="offset"):
+        sg.glm("y ~ x", d, family="poisson", offset=off[:-3], mesh=mesh8)
+    with pytest.raises(ValueError, match="weights"):
+        sg.glm_fit(np.stack([np.ones(n), x], 1), y,
+                   family="poisson", weights=np.ones(n + 1), mesh=mesh8)
+
+
+def test_nan_offset_column_row_dropped(mesh1, rng):
+    n = 300
+    x = rng.normal(size=n)
+    off = rng.uniform(0, 1, size=n)
+    y = rng.poisson(np.exp(0.2 + 0.4 * x + off)).astype(float)
+    d = {"y": y, "x": x, "off": off}
+    d["off"][11] = np.nan
+    m = sg.glm("y ~ x", d, family="poisson", offset="off", mesh=mesh1)
+    assert np.all(np.isfinite(m.coefficients))
+    assert m.n_obs == n - 1
+
+
 def test_predict_int_design(mesh1, rng):
     X = rng.normal(size=(50, 2))
     X[:, 0] = 1.0
