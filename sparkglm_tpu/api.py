@@ -79,7 +79,8 @@ def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
 def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         offset=None, m=None, tol: float = 1e-6, max_iter: int = 100,
         criterion: str = "absolute", na_omit: bool = True, mesh=None,
-        verbose: bool = False, config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
+        engine: str = "auto", verbose: bool = False,
+        config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """R-style ``glm(formula, data, family, link, ...)``.
 
     ``offset``/``m`` may be column names in ``data`` or arrays."""
@@ -98,7 +99,7 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         offset=_col_or_array(offset, "offset"), m=_col_or_array(m, "m"), tol=tol,
         max_iter=max_iter, criterion=criterion, xnames=terms.xnames,
         yname=f.response, has_intercept=f.intercept, mesh=mesh,
-        verbose=verbose, config=config)
+        engine=engine, verbose=verbose, config=config)
     import dataclasses
     return dataclasses.replace(model, formula=str(f), terms=terms)
 

@@ -36,9 +36,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax.sharding import PartitionSpec as P
+
 from ..config import DEFAULT, NumericConfig
 from ..families.families import Family, resolve
 from ..families.links import Link
+from ..ops.fused import fused_fisher_pass, fused_fisher_pass_ref
 from ..ops.gramian import weighted_gramian
 from ..ops.solve import diag_inv_from_cho, solve_normal
 from ..parallel import mesh as meshlib
@@ -145,6 +148,124 @@ def _irls_kernel(
                 wt_sum=wt_sum)
 
 
+def _fused_block_rows(p: int) -> int:
+    """Largest power-of-two row block that keeps the fused kernel's VMEM
+    footprint (~12 float32 copies of a (b, p) block: double-buffered input,
+    Xw scratch, accumulators) within ~10 MB of the 16 MB/core budget."""
+    budget = 10 * 1024 * 1024
+    b = max(128, budget // (48 * p))
+    return min(2048, 1 << (int(b).bit_length() - 1))
+
+
+@partial(jax.jit, static_argnames=("family", "link", "criterion", "refine_steps",
+                                   "null_mean", "mesh", "block_rows",
+                                   "use_pallas"))
+def _irls_fused_kernel(
+    X, y, wt, offset,
+    tol, max_iter, jitter,
+    family: Family, link: Link,
+    criterion: str = "absolute",
+    refine_steps: int = 1,
+    null_mean: bool = True,
+    mesh=None,
+    block_rows: int = 512,
+    use_pallas: bool = True,
+):
+    """IRLS where each iteration's data touch is ONE fused pass over X
+    (ops/fused.py): eta, mu, z, w, Gramian and deviance per row block, then a
+    psum over the data axis and a replicated solve.  The deviance measured in
+    a pass belongs to the *incoming* beta, so convergence lags the einsum
+    kernel by one half-step with identical |ddev| semantics.
+    """
+    acc = X.dtype if X.dtype == jnp.float64 else jnp.float32
+    p = X.shape[1]
+    valid = wt > 0
+    pass_fn = fused_fisher_pass if use_pallas else fused_fisher_pass_ref
+
+    def spmd_pass(first):
+        def f(Xs, ys, ws, os_, beta):
+            XtWX, XtWz, dev = pass_fn(Xs, ys, ws, os_, beta, family=family,
+                                      link=link, first=first,
+                                      block_rows=block_rows)
+            return (jax.lax.psum(XtWX, meshlib.DATA_AXIS),
+                    jax.lax.psum(XtWz, meshlib.DATA_AXIS),
+                    jax.lax.psum(dev, meshlib.DATA_AXIS))
+        d = meshlib.DATA_AXIS
+        return jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(d, None), P(d), P(d), P(d), P()),
+            out_specs=(P(), P(), P()), check_vma=False)
+
+    def solve(XtWX, XtWz, beta_prev):
+        beta, cho = solve_normal(XtWX, XtWz, jitter=jitter,
+                                 refine_steps=refine_steps)
+        singular = ~jnp.all(jnp.isfinite(beta))
+        beta = jnp.where(singular, beta_prev, beta)
+        return beta, diag_inv_from_cho(cho, p, acc), singular
+
+    beta0 = jnp.zeros((p,), X.dtype)
+    XtWX0, XtWz0, dev0 = spmd_pass(True)(X, y, wt, offset, beta0)
+    beta1, diag0, sing0 = solve(XtWX0, XtWz0, beta0)
+
+    state0 = dict(
+        # counts deviance-measured updates, matching the einsum kernel's
+        # iteration numbering (the hoisted init solve is iteration 0)
+        it=jnp.zeros((), jnp.int32),
+        beta=beta1.astype(X.dtype),
+        dev=dev0.astype(acc),
+        ddev=jnp.asarray(_BIG, acc),
+        diag_inv=diag0.astype(acc),
+        singular=sing0,
+    )
+    step = spmd_pass(False)
+
+    def not_converged(s):
+        d = s["ddev"]
+        if criterion == "relative":
+            d = d / (jnp.abs(s["dev"]) + 0.1)
+        return (s["it"] < max_iter) & (d > tol) & ~s["singular"]
+
+    def body(s):
+        XtWX, XtWz, dev = step(X, y, wt, offset, s["beta"])
+        beta_new, diag_inv, singular = solve(XtWX, XtWz, s["beta"])
+        return dict(
+            it=s["it"] + 1,
+            beta=beta_new.astype(X.dtype),
+            dev=dev.astype(acc),
+            ddev=jnp.abs(dev.astype(acc) - s["dev"]),
+            diag_inv=diag_inv,
+            singular=singular,
+        )
+
+    s = jax.lax.while_loop(not_converged, body, state0)
+
+    # ---- final stats at the converged beta (one GSPMD pass) -----------------
+    beta_f = s["beta"]
+    eta = (X @ beta_f + offset).astype(X.dtype)
+    mu = jnp.where(valid, link.inverse(eta), 1.0).astype(X.dtype)
+
+    def dev_of(m):
+        return jnp.sum(_sanitize(family.dev_resids(y, m, wt), valid))
+
+    dev_final = dev_of(mu)
+    pearson = jnp.sum(_sanitize(
+        wt * (y - mu) ** 2 / jnp.maximum(family.variance(mu), 1e-30), valid))
+    loglik = jnp.sum(_sanitize(family.loglik_terms(y, mu, wt), valid))
+    wt_sum = jnp.sum(wt)
+    if null_mean:
+        mu_null = jnp.sum(jnp.where(valid, wt * y, 0.0)) / wt_sum
+        null_dev = dev_of(jnp.where(valid, mu_null, 1.0))
+    else:
+        null_dev = dev_of(jnp.where(valid, link.inverse(offset), 1.0))
+    d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
+    converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"]
+
+    return dict(beta=beta_f, diag_inv=s["diag_inv"], dev=dev_final,
+                null_dev=null_dev, pearson=pearson, loglik=loglik,
+                iters=s["it"], converged=converged,
+                singular=s["singular"], wt_sum=wt_sum)
+
+
 @dataclasses.dataclass(frozen=True)
 class GLMModel:
     """Fitted GLM — the reference's ``GLM`` case class (GLM.scala:35-51)
@@ -226,6 +347,7 @@ def fit(
     has_intercept: bool | None = None,
     mesh=None,
     shard_features: bool = False,
+    engine: str = "auto",
     verbose: bool = False,
     config: NumericConfig = DEFAULT,
 ) -> GLMModel:
@@ -236,6 +358,14 @@ def fit(
     GLM.scala:610).  ``m`` is binomial group sizes: ``y`` is then success
     *counts* out of ``m`` (converted to proportions + weights, matching both
     the reference's (y, m) surface and R's proportion+weights convention).
+
+    ``engine`` selects the per-iteration kernel:
+      * ``"einsum"`` — GSPMD-autosharded einsum Gramian (works everywhere,
+        float64-capable).
+      * ``"fused"`` — single-HBM-pass fused Fisher step (ops/fused.py):
+        Pallas on TPU, its XLA twin elsewhere.  Requires an unsharded feature
+        axis and float32.
+      * ``"auto"`` — ``"fused"`` on TPU when eligible, else ``"einsum"``.
     """
     from .lm import _detect_intercept
 
@@ -281,6 +411,32 @@ def fit(
     off = (np.zeros((n,), dtype=dtype) if offset is None
            else _check_len(offset, "offset").astype(dtype))
 
+    n_data = mesh.shape[meshlib.DATA_AXIS]
+    on_tpu = jax.default_backend() == "tpu"
+    if engine == "auto":
+        # fused wins where the pass is HBM-bandwidth-bound (narrow designs);
+        # for wide designs the einsum path is MXU-bound and XLA's scheduling
+        # of the f32 multi-pass matmul beats the hand-tiled kernel
+        fused_ok = (not shard_features and p <= 128
+                    and mesh.shape[meshlib.MODEL_AXIS] == 1 and not use_f64)
+        engine = "fused" if (on_tpu and fused_ok) else "einsum"
+    if engine not in ("einsum", "fused"):
+        raise ValueError(f"engine must be 'auto', 'einsum' or 'fused', got {engine!r}")
+    if engine == "fused" and (shard_features or mesh.shape[meshlib.MODEL_AXIS] != 1):
+        raise ValueError("engine='fused' does not support a sharded feature axis")
+
+    block_rows = _fused_block_rows(p)
+    if engine == "fused":
+        # the fused kernel streams whole blocks, so every shard's row count
+        # must divide into block_rows; extra rows carry wt=0 and stay inert
+        mult = block_rows * n_data
+        n_pad = ((n + mult - 1) // mult) * mult
+        if n_pad != n:
+            X = np.pad(X.astype(dtype, copy=False), [(0, n_pad - n), (0, 0)])
+            y = np.pad(y, (0, n_pad - n))
+            wt = np.pad(wt, (0, n_pad - n))
+            off = np.pad(off, (0, n_pad - n))
+
     Xd = meshlib.shard_rows(X.astype(dtype, copy=False), mesh, shard_features=shard_features)
     yd = meshlib.shard_rows(y, mesh)
     wd = meshlib.shard_rows(wt, mesh)      # padding rows get wt=0 -> inert
@@ -288,19 +444,31 @@ def fit(
 
     has_offset = offset is not None and bool(np.any(off != 0))
     tol_dev = jnp.asarray(tol, jnp.float32 if not use_f64 else jnp.float64)
-    out = _irls_kernel(
-        Xd, yd, wd, od, tol_dev,
-        jnp.asarray(max_iter, jnp.int32),
-        jnp.asarray(config.jitter, dtype),
-        family=fam, link=lnk, criterion=criterion,
-        refine_steps=config.refine_steps,
-        null_mean=has_intercept and not has_offset,
-    )
+    if engine == "fused":
+        out = _irls_fused_kernel(
+            Xd, yd, wd, od, tol_dev,
+            jnp.asarray(max_iter, jnp.int32),
+            jnp.asarray(config.jitter, dtype),
+            family=fam, link=lnk, criterion=criterion,
+            refine_steps=config.refine_steps,
+            null_mean=has_intercept and not has_offset,
+            mesh=mesh, block_rows=block_rows,
+            use_pallas=on_tpu and p <= 1024,
+        )
+    else:
+        out = _irls_kernel(
+            Xd, yd, wd, od, tol_dev,
+            jnp.asarray(max_iter, jnp.int32),
+            jnp.asarray(config.jitter, dtype),
+            family=fam, link=lnk, criterion=criterion,
+            refine_steps=config.refine_steps,
+            null_mean=has_intercept and not has_offset,
+        )
     out = jax.tree.map(np.asarray, out)
     if has_intercept and has_offset:
         # R semantics: with an offset, the null model is an intercept-only
         # GLM honouring the offset — run the same kernel on a ones design.
-        ones_d = meshlib.shard_rows(np.ones((n, 1), dtype), mesh)
+        ones_d = meshlib.shard_rows(np.ones((int(yd.shape[0]), 1), dtype), mesh)
         null_out = _irls_kernel(
             ones_d, yd, wd, od, tol_dev,
             jnp.asarray(max_iter, jnp.int32),
