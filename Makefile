@@ -1,0 +1,27 @@
+# sparkglm-tpu build glue — the deployment-story analogue of the reference's
+# Makefile (sbt assembly + R CMD INSTALL, /root/reference/Makefile:17-25).
+# The Python package needs no build step; `native` compiles the C++ IO layer
+# (it is also auto-built on first use by sparkglm_tpu/data/io.py).
+
+CXX ?= g++
+CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
+SO := sparkglm_tpu/data/_libsparkglm_io.so
+
+.PHONY: all native test bench clean
+
+all: native
+
+native: $(SO)
+
+$(SO): native/loader.cpp
+	$(CXX) $(CXXFLAGS) -shared -fPIC -o $@ $<
+
+test: native
+	python -m pytest tests/ -q
+
+bench: native
+	python bench.py
+
+clean:
+	rm -f $(SO)
+	find . -name __pycache__ -type d -exec rm -rf {} +

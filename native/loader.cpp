@@ -1,0 +1,338 @@
+// sparkglm-tpu native IO: CSV loader with single-scan categorical level
+// discovery and shard-aware byte-range splitting.
+//
+// Role parity: the reference ingests data through Spark DataFrames (JSON/CSV
+// readers feeding row partitions; SURVEY.md §2.3 "Spark core/SQL JARs") and
+// discovers categorical levels with one distinct.collect Spark action PER
+// COLUMN on the driver (/root/reference/src/main/scala/com/Alteryx/sparkGLM/
+// modelMatrix.scala:56-58).  Here the loader makes two streaming passes over
+// its byte range — one to infer column kinds and count rows, one to fill
+// contiguous buffers (numeric columns into double arrays, string columns
+// dictionary-encoded into int32 codes + a level table) — so level discovery
+// for ALL categorical columns rides the same scan, and peak memory is the
+// output buffers only.  A (shard_index, num_shards) byte-range split aligned
+// to newlines lets each host of a multi-host pod read just its slice; no
+// driver collect anywhere.
+//
+// C ABI (consumed by sparkglm_tpu/data/io.py via ctypes):
+//   sgio_read_csv(path, shard_index, num_shards) -> SgioTable*
+//   sgio_error / sgio_n_rows / sgio_n_cols / sgio_col_* accessors
+//   sgio_free(table)
+//
+// Missing values: empty fields, "NA", "NaN", "nan", "null", "NULL" become
+// NaN (numeric) or code -1 (categorical) — the front-end's omit_na treats
+// both as missing (R's na.omit semantics, R/pkg/R/utils.R:24-27).
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <limits>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Column {
+  std::string name;
+  bool is_categorical = false;
+  std::vector<double> nums;
+  std::vector<int32_t> codes;
+  std::vector<std::string> levels;
+  std::unordered_map<std::string, int32_t> level_ids;
+
+  int32_t intern(const char* b, size_t len) {
+    std::string s(b, len);
+    auto it = level_ids.find(s);
+    if (it != level_ids.end()) return it->second;
+    int32_t id = static_cast<int32_t>(levels.size());
+    levels.push_back(s);
+    level_ids.emplace(std::move(s), id);
+    return id;
+  }
+};
+
+struct Table {
+  std::vector<Column> cols;
+  int64_t n_rows = 0;
+  std::string error;
+};
+
+bool is_missing(const char* b, size_t len) {
+  if (len == 0) return true;
+  if (len == 2 && std::memcmp(b, "NA", 2) == 0) return true;
+  if (len == 3 && (std::memcmp(b, "NaN", 3) == 0 || std::memcmp(b, "nan", 3) == 0)) return true;
+  if (len == 4 && (std::memcmp(b, "null", 4) == 0 || std::memcmp(b, "NULL", 4) == 0)) return true;
+  return false;
+}
+
+bool parse_double(const char* b, size_t len, double* out) {
+  char buf[64];  // strtod needs NUL termination; CSV fields are tiny
+  if (len == 0 || len >= sizeof(buf)) return false;
+  // strtod accepts hex floats ("0x1A"); Python float() does not — reject so
+  // both loaders type such columns identically (categorical)
+  for (size_t i = 0; i + 1 < len; ++i) {
+    if (b[i] == '0' && (b[i + 1] == 'x' || b[i + 1] == 'X')) return false;
+  }
+  std::memcpy(buf, b, len);
+  buf[len] = '\0';
+  char* end = nullptr;
+  errno = 0;
+  double v = std::strtod(buf, &end);
+  if (end != buf + len || errno == ERANGE) return false;
+  *out = v;
+  return true;
+}
+
+void clean_field(const char*& b, size_t& len) {
+  while (len && (*b == ' ' || *b == '\t' || *b == '\r')) { ++b; --len; }
+  while (len && (b[len - 1] == ' ' || b[len - 1] == '\t' || b[len - 1] == '\r')) --len;
+  if (len >= 2 && b[0] == '"' && b[len - 1] == '"') { ++b; len -= 2; }
+}
+
+// Stream [begin, end_pos) of f in chunks, calling on_line(ptr, len) for each
+// newline-terminated (or final partial) line.
+template <typename F>
+void for_each_line(FILE* f, int64_t begin, int64_t end_pos, F&& on_line) {
+  std::fseek(f, begin, SEEK_SET);
+  std::vector<char> buf(static_cast<size_t>(
+      std::min<int64_t>(std::max<int64_t>(end_pos - begin, 1), 8 << 20)));
+  std::string carry;
+  int64_t pos = begin;
+  while (pos < end_pos) {
+    size_t want = static_cast<size_t>(std::min<int64_t>(
+        end_pos - pos, static_cast<int64_t>(buf.size())));
+    size_t got = std::fread(buf.data(), 1, want, f);
+    if (got == 0) break;
+    pos += static_cast<int64_t>(got);
+    const char* b = buf.data();
+    const char* bend = b + got;
+    while (b < bend) {
+      const char* nl = static_cast<const char*>(
+          std::memchr(b, '\n', static_cast<size_t>(bend - b)));
+      if (!nl) {
+        carry.append(b, static_cast<size_t>(bend - b));
+        break;
+      }
+      if (!carry.empty()) {
+        carry.append(b, static_cast<size_t>(nl - b));
+        on_line(carry.data(), carry.size());
+        carry.clear();
+      } else {
+        on_line(b, static_cast<size_t>(nl - b));
+      }
+      b = nl + 1;
+    }
+  }
+  if (!carry.empty()) on_line(carry.data(), carry.size());
+}
+
+// Call on_field(col_idx, ptr, len) for every field of a line, padding short
+// rows with empty (missing) trailing fields.  Double-quoted fields may
+// contain commas (embedded newlines are not supported — they would defeat
+// byte-range sharding).  Returns false for blank lines.
+template <typename F>
+bool for_each_field(const char* lb, size_t llen, size_t ncol, F&& on_field) {
+  if (llen == 0 || (llen == 1 && lb[0] == '\r')) return false;
+  const char* b = lb;
+  const char* lend = lb + llen;
+  size_t col = 0;
+  while (col < ncol) {
+    const char* q = b;
+    bool in_quote = false;
+    while (q < lend && (in_quote || *q != ',')) {
+      if (*q == '"') in_quote = !in_quote;
+      ++q;
+    }
+    const char* fb = b;
+    size_t len = static_cast<size_t>(q - b);
+    clean_field(fb, len);
+    on_field(col, fb, len);
+    ++col;
+    if (q >= lend) break;
+    b = q + 1;
+  }
+  for (; col < ncol; ++col) on_field(col, "", 0);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct SgioTable;  // opaque
+
+// kinds: optional per-column override, -1 = infer, 0 = numeric,
+// 1 = categorical (pass nullptr or n_kinds=0 to infer everything).  Fixing
+// kinds from a schema scan keeps multi-host sharded reads consistent when a
+// shard's slice would infer differently.  schema_only skips the fill pass —
+// the cheap way to learn global kinds before sharded reads.
+SgioTable* sgio_read_csv(const char* path, int64_t shard_index,
+                         int64_t num_shards, const int32_t* kinds,
+                         int64_t n_kinds, int32_t schema_only) {
+  auto* t = new Table();
+  FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    t->error = std::string("cannot open ") + path;
+    return reinterpret_cast<SgioTable*>(t);
+  }
+  std::fseek(f, 0, SEEK_END);
+  const int64_t fsize = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+
+  // ---- header (always read from byte 0) -----------------------------------
+  std::string header;
+  {
+    int ch;
+    while ((ch = std::fgetc(f)) != EOF && ch != '\n') header.push_back((char)ch);
+  }
+  const int64_t data_start = std::ftell(f);
+  {
+    const char* b = header.data();
+    const char* hend = b + header.size();
+    while (true) {
+      const char* q = b;
+      bool in_quote = false;
+      while (q < hend && (in_quote || *q != ',')) {
+        if (*q == '"') in_quote = !in_quote;
+        ++q;
+      }
+      const char* fb = b;
+      size_t len = static_cast<size_t>(q - b);
+      clean_field(fb, len);
+      Column c;
+      c.name.assign(fb, len);
+      t->cols.push_back(std::move(c));
+      if (q >= hend) break;
+      b = q + 1;
+    }
+  }
+  const size_t ncol = t->cols.size();
+  if (ncol == 0) {
+    t->error = "empty header";
+    std::fclose(f);
+    return reinterpret_cast<SgioTable*>(t);
+  }
+
+  // ---- shard byte range, aligned forward to newline boundaries ------------
+  if (num_shards < 1) num_shards = 1;
+  if (shard_index < 0 || shard_index >= num_shards) {
+    t->error = "shard_index out of range";
+    std::fclose(f);
+    return reinterpret_cast<SgioTable*>(t);
+  }
+  const int64_t span = fsize - data_start;
+  auto align_forward = [&](int64_t pos) -> int64_t {
+    if (pos <= data_start) return data_start;
+    if (pos >= fsize) return fsize;
+    std::fseek(f, pos - 1, SEEK_SET);  // scan from pos-1 to the next newline
+    int ch;
+    while ((ch = std::fgetc(f)) != EOF && ch != '\n') {}
+    return std::ftell(f);
+  };
+  const int64_t begin = align_forward(data_start + span * shard_index / num_shards);
+  const int64_t end_pos =
+      align_forward(data_start + span * (shard_index + 1) / num_shards);
+
+  // ---- pass 1: row count + kind inference ---------------------------------
+  std::vector<char> numeric_ok(ncol, 1);
+  std::vector<char> fixed(ncol, 0);
+  for (size_t i = 0; i < ncol && static_cast<int64_t>(i) < n_kinds; ++i) {
+    if (kinds && kinds[i] >= 0) {
+      fixed[i] = 1;
+      numeric_ok[i] = kinds[i] == 0;
+    }
+  }
+  int64_t n_rows = 0;
+  for_each_line(f, begin, end_pos, [&](const char* lb, size_t llen) {
+    double v;
+    bool any = for_each_field(lb, llen, ncol,
+        [&](size_t col, const char* b, size_t len) {
+          if (!fixed[col] && numeric_ok[col] && !is_missing(b, len) &&
+              !parse_double(b, len, &v)) {
+            numeric_ok[col] = 0;
+          }
+        });
+    if (any) ++n_rows;
+  });
+  for (size_t i = 0; i < ncol; ++i) {
+    t->cols[i].is_categorical = !numeric_ok[i];
+  }
+  if (schema_only) {
+    t->n_rows = n_rows;
+    std::fclose(f);
+    return reinterpret_cast<SgioTable*>(t);
+  }
+
+  // ---- pass 2: fill contiguous buffers ------------------------------------
+  for (size_t i = 0; i < ncol; ++i) {
+    if (numeric_ok[i]) t->cols[i].nums.reserve(static_cast<size_t>(n_rows));
+    else t->cols[i].codes.reserve(static_cast<size_t>(n_rows));
+  }
+  for_each_line(f, begin, end_pos, [&](const char* lb, size_t llen) {
+    bool any = for_each_field(lb, llen, ncol,
+        [&](size_t col, const char* b, size_t len) {
+          Column& c = t->cols[col];
+          if (!c.is_categorical) {
+            double v;
+            if (is_missing(b, len) || !parse_double(b, len, &v)) {
+              v = std::numeric_limits<double>::quiet_NaN();
+            }
+            c.nums.push_back(v);
+          } else if (is_missing(b, len)) {
+            c.codes.push_back(-1);
+          } else {
+            c.codes.push_back(c.intern(b, len));
+          }
+        });
+    if (any) ++t->n_rows;
+  });
+  std::fclose(f);
+  return reinterpret_cast<SgioTable*>(t);
+}
+
+const char* sgio_error(SgioTable* h) {
+  auto* t = reinterpret_cast<Table*>(h);
+  return t->error.empty() ? nullptr : t->error.c_str();
+}
+
+int64_t sgio_n_rows(SgioTable* h) {
+  return reinterpret_cast<Table*>(h)->n_rows;
+}
+
+int64_t sgio_n_cols(SgioTable* h) {
+  return static_cast<int64_t>(reinterpret_cast<Table*>(h)->cols.size());
+}
+
+const char* sgio_col_name(SgioTable* h, int64_t i) {
+  return reinterpret_cast<Table*>(h)->cols[i].name.c_str();
+}
+
+// 0 = numeric (double buffer), 1 = categorical (int32 codes + levels)
+int32_t sgio_col_kind(SgioTable* h, int64_t i) {
+  return reinterpret_cast<Table*>(h)->cols[i].is_categorical ? 1 : 0;
+}
+
+const double* sgio_col_data(SgioTable* h, int64_t i) {
+  return reinterpret_cast<Table*>(h)->cols[i].nums.data();
+}
+
+const int32_t* sgio_col_codes(SgioTable* h, int64_t i) {
+  return reinterpret_cast<Table*>(h)->cols[i].codes.data();
+}
+
+int64_t sgio_col_n_levels(SgioTable* h, int64_t i) {
+  return static_cast<int64_t>(
+      reinterpret_cast<Table*>(h)->cols[i].levels.size());
+}
+
+const char* sgio_col_level(SgioTable* h, int64_t i, int64_t j) {
+  return reinterpret_cast<Table*>(h)->cols[i].levels[j].c_str();
+}
+
+void sgio_free(SgioTable* h) { delete reinterpret_cast<Table*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,275 @@
+"""CSV ingestion: ctypes binding to the native loader, with a pure-Python
+fallback.
+
+The native side (native/loader.cpp) replaces the reference's Spark
+DataFrame ingestion + per-column ``distinct.collect`` level discovery
+(modelMatrix.scala:56-58) with a two-pass streaming parse: numeric columns
+land in contiguous float64 buffers, string columns are dictionary-encoded
+(int32 codes + level table) during the same scan, and ``shard_index`` /
+``num_shards`` split the file by newline-aligned byte ranges so each host of
+a multi-host pod reads only its slice.
+
+Multi-host consistency: column *kinds* are inferred from whatever slice a
+process reads, so different shards of a file whose column is numeric in one
+slice and stringy in another could disagree.  ``scan_csv_schema`` does the
+cheap global inference pass; pass its result as ``schema=`` to every sharded
+``read_csv`` call to pin kinds.  (Categorical *level order* may still differ
+per shard — harmless: columns decode to strings and ``model_matrix`` sorts
+levels itself, modelMatrix.scala:57.)
+
+``read_csv`` returns a plain ``dict[str, np.ndarray]`` — exactly what
+``as_columns`` (frame.py) accepts, so ``sg.glm("y ~ x", sg.read_csv(path))``
+is the end-to-end path.
+"""
+
+from __future__ import annotations
+
+import csv
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SRC = os.path.join(_REPO, "native", "loader.cpp")
+_SO = os.path.join(_HERE, "_libsparkglm_io.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_error: str | None = None
+
+NUMERIC, CATEGORICAL = 0, 1
+
+
+def _build() -> None:
+    # compile to a temp file then rename: concurrent processes must never
+    # dlopen a half-written library
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True, capture_output=True, text=True)
+        os.replace(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _load():
+    """Load (building on first use) the native library; None if unavailable."""
+    global _lib, _lib_error
+    with _lock:
+        if _lib is not None or _lib_error is not None:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or (os.path.exists(_SRC)
+                        and os.path.getmtime(_SRC) > os.path.getmtime(_SO))):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.CalledProcessError) as e:
+            _lib_error = str(e)
+            return None
+        lib.sgio_read_csv.restype = ctypes.c_void_p
+        lib.sgio_read_csv.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32]
+        lib.sgio_error.restype = ctypes.c_char_p
+        lib.sgio_error.argtypes = [ctypes.c_void_p]
+        for name, res in [("sgio_n_rows", ctypes.c_int64),
+                          ("sgio_n_cols", ctypes.c_int64)]:
+            fn = getattr(lib, name)
+            fn.restype = res
+            fn.argtypes = [ctypes.c_void_p]
+        lib.sgio_col_name.restype = ctypes.c_char_p
+        lib.sgio_col_name.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sgio_col_kind.restype = ctypes.c_int32
+        lib.sgio_col_kind.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sgio_col_data.restype = ctypes.POINTER(ctypes.c_double)
+        lib.sgio_col_data.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sgio_col_codes.restype = ctypes.POINTER(ctypes.c_int32)
+        lib.sgio_col_codes.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sgio_col_n_levels.restype = ctypes.c_int64
+        lib.sgio_col_n_levels.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sgio_col_level.restype = ctypes.c_char_p
+        lib.sgio_col_level.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_int64]
+        lib.sgio_free.restype = None
+        lib.sgio_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _kinds_array(schema: dict[str, int] | None, names: list[str]):
+    if schema is None:
+        return None
+    kinds = np.full(len(names), -1, np.int32)
+    for i, nm in enumerate(names):
+        if nm in schema:
+            kinds[i] = schema[nm]
+    return kinds
+
+
+def scan_csv_schema(path: str, *, native: bool | None = None) -> dict[str, int]:
+    """One cheap global pass: column name -> NUMERIC (0) | CATEGORICAL (1).
+
+    Run this once on the whole file and pass the result as ``schema=`` to
+    per-shard ``read_csv`` calls so every host types columns identically.
+    """
+    lib = _load() if native in (None, True) else None
+    if native is True and lib is None:
+        raise RuntimeError(f"native loader unavailable: {_lib_error}")
+    if lib is None:
+        cols = _read_csv_py(path, 0, 1, None)
+        return {k: (CATEGORICAL if v.dtype == object else NUMERIC)
+                for k, v in cols.items()}
+    h = lib.sgio_read_csv(path.encode(), 0, 1, None, 0, 1)
+    try:
+        err = lib.sgio_error(h)
+        if err:
+            raise OSError(err.decode())
+        return {lib.sgio_col_name(h, i).decode(): int(lib.sgio_col_kind(h, i))
+                for i in range(lib.sgio_n_cols(h))}
+    finally:
+        lib.sgio_free(h)
+
+
+def read_csv(path: str, *, shard_index: int = 0, num_shards: int = 1,
+             schema: dict[str, int] | None = None,
+             native: bool | None = None) -> dict[str, np.ndarray]:
+    """Read a CSV into name -> column arrays (float64 or str).
+
+    ``shard_index``/``num_shards`` select a newline-aligned byte-range slice
+    of the file — the per-host loading pattern for multi-host meshes; pass a
+    ``scan_csv_schema`` result as ``schema=`` to pin column kinds across
+    shards.  ``native=None`` auto-selects the C++ loader when it
+    builds/loads.
+    """
+    if num_shards < 1 or not (0 <= shard_index < num_shards):
+        raise ValueError(
+            f"need 0 <= shard_index < num_shards, got {shard_index}/{num_shards}")
+    lib = _load() if native in (None, True) else None
+    if native is True and lib is None:
+        raise RuntimeError(f"native loader unavailable: {_lib_error}")
+    if lib is None:
+        return _read_csv_py(path, shard_index, num_shards, schema)
+
+    # learn names first (cheap: header only matters) to map schema -> kinds
+    kinds_ptr, n_kinds = None, 0
+    if schema is not None:
+        with open(path, "rb") as fh:
+            header = fh.readline().decode()
+        names = next(csv.reader([header]))
+        kinds = _kinds_array(schema, [s.strip() for s in names])
+        kinds_ptr = kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        n_kinds = len(kinds)
+
+    h = lib.sgio_read_csv(path.encode(), shard_index, num_shards,
+                          kinds_ptr, n_kinds, 0)
+    try:
+        err = lib.sgio_error(h)
+        if err:
+            raise OSError(err.decode())
+        n = lib.sgio_n_rows(h)
+        out: dict[str, np.ndarray] = {}
+        for i in range(lib.sgio_n_cols(h)):
+            name = lib.sgio_col_name(h, i).decode()
+            if lib.sgio_col_kind(h, i) == NUMERIC:
+                buf = (np.ctypeslib.as_array(lib.sgio_col_data(h, i),
+                                             shape=(n,)) if n
+                       else np.empty(0))
+                out[name] = np.array(buf, dtype=np.float64)  # owned copy
+            else:
+                codes = (np.ctypeslib.as_array(lib.sgio_col_codes(h, i),
+                                               shape=(n,)) if n
+                         else np.empty(0, np.int32))
+                levels = np.array(
+                    [lib.sgio_col_level(h, i, j).decode()
+                     for j in range(lib.sgio_col_n_levels(h, i))],
+                    dtype=object)
+                col = np.empty((n,), dtype=object)
+                missing = codes < 0
+                if len(levels):
+                    col[~missing] = levels[codes[~missing]]
+                col[missing] = None
+                out[name] = col
+        return out
+    finally:
+        lib.sgio_free(h)
+
+
+_MISSING = {"", "NA", "NaN", "nan", "null", "NULL"}
+
+
+def _parse_float(v: str):
+    """float() aligned with the native strtod rules: no underscores (Python
+    extension) — hex is rejected by both sides."""
+    if "_" in v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
+def _read_csv_py(path: str, shard_index: int, num_shards: int,
+                 schema: dict[str, int] | None) -> dict[str, np.ndarray]:
+    """Pure-Python fallback with identical semantics (incl. byte sharding)."""
+    with open(path, "rb") as f:
+        header = f.readline().decode()
+        data_start = f.tell()
+        f.seek(0, os.SEEK_END)
+        fsize = f.tell()
+        span = fsize - data_start
+
+        def align(pos):
+            if pos <= data_start:
+                return data_start
+            if pos >= fsize:
+                return fsize
+            f.seek(pos - 1)
+            f.readline()
+            return f.tell()
+
+        begin = align(data_start + span * shard_index // num_shards)
+        end = align(data_start + span * (shard_index + 1) // num_shards)
+        f.seek(begin)
+        blob = f.read(end - begin).decode()
+
+    names = [s.strip() for s in next(csv.reader([header]))]
+    rows = [r for r in csv.reader(blob.splitlines()) if any(s.strip() for s in r)]
+    ncol = len(names)
+    cols = [[r[j].strip() if j < len(r) else "" for r in rows]
+            for j in range(ncol)]
+    out: dict[str, np.ndarray] = {}
+    for name, vals in zip(names, cols):
+        forced = None if schema is None else schema.get(name)
+        numeric = forced != CATEGORICAL
+        parsed = np.empty(len(vals))
+        for k, v in enumerate(vals):
+            if v in _MISSING:
+                parsed[k] = np.nan
+                continue
+            fv = _parse_float(v)
+            if fv is None:
+                if forced == NUMERIC:
+                    parsed[k] = np.nan
+                    continue
+                numeric = False
+                break
+            parsed[k] = fv
+        if numeric:
+            out[name] = parsed
+        else:
+            out[name] = np.array(
+                [None if v in _MISSING else v for v in vals], dtype=object)
+    return out

@@ -453,7 +453,8 @@ def fit(
             refine_steps=config.refine_steps,
             null_mean=has_intercept and not has_offset,
             mesh=mesh, block_rows=block_rows,
-            use_pallas=on_tpu and p <= 1024,
+            # the Mosaic kernel is float32; float64 (x64) runs the XLA twin
+            use_pallas=on_tpu and p <= 1024 and dtype == np.float32,
         )
     else:
         out = _irls_kernel(
