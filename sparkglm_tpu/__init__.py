@@ -27,12 +27,14 @@ from .models.glm import fit as glm_fit
 from .models.lm import LMModel
 from .models.lm import fit as lm_fit
 from .models.serialize import load_model, save_model
+from .models.streaming import glm_fit_streaming, lm_fit_streaming
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
 
 __version__ = "0.1.0"
 
 __all__ = [
     "lm", "glm", "predict", "lm_fit", "glm_fit",
+    "lm_fit_streaming", "glm_fit_streaming",
     "LMModel", "GLMModel", "load_model", "save_model",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
     "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",

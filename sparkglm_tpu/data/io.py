@@ -246,7 +246,10 @@ def _read_csv_py(path: str, shard_index: int, num_shards: int,
         blob = f.read(end - begin).decode()
 
     names = [s.strip() for s in next(csv.reader([header]))]
-    rows = [r for r in csv.reader(blob.splitlines()) if any(s.strip() for s in r)]
+    # drop only truly blank lines; a ',,' line is a row of missing values,
+    # exactly as the native loader counts it
+    rows = [r for r in csv.reader(blob.splitlines())
+            if r and not (len(r) == 1 and not r[0].strip())]
     ncol = len(names)
     cols = [[r[j].strip() if j < len(r) else "" for r in rows]
             for j in range(ncol)]
