@@ -1,0 +1,171 @@
+"""Benchmark harness for the five BASELINE.json capability configs.
+
+    1. Gaussian/identity lm() 10k x 20        (OLS closed form)
+    2. Binomial/logit glm() 1M x 100          (logistic)
+    3. Poisson/log glm() 1M x 100             (counts)
+    4. Binomial/logit glm() 2M x 512          (Gramian stress; 10M x 1000
+       needs v5e-8 HBM — scaled to one chip, extrapolation printed)
+    5. Gamma/inverse glm() + prior weights + offset, streamed
+       (50M x 500 is ~100 GB — run via glm_fit_streaming on a synthetic
+       chunk generator; row count scaled by --scale)
+
+Usage::
+
+    python benchmarks/run.py [--scale S] [--cpu] [--json PATH]
+
+``--scale`` multiplies row counts (default 1.0; use e.g. 0.01 for a smoke
+run).  Each config reports seconds (min of 3 runs for resident fits, single
+run for streaming) plus iterations, as JSON lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import sparkglm_tpu as sg
+    from sparkglm_tpu.families.families import resolve
+    from sparkglm_tpu.models.glm import _irls_kernel
+    from sparkglm_tpu.models.lm import _lm_kernel
+    from sparkglm_tpu.parallel import mesh as meshlib
+
+    mesh = sg.make_mesh()
+    row_s = NamedSharding(mesh, P(meshlib.DATA_AXIS))
+    mat_s = NamedSharding(mesh, P(meshlib.DATA_AXIS, None))
+    results = []
+
+    def emit(rec):
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    def rows(base: int) -> int:
+        return max(4096, int(base * args.scale))
+
+    def make_xy(key, n, p, kind):
+        """Generate (X, y) on device; returns sharded device arrays."""
+        @jax.jit
+        def gen(key):
+            kx, kb, ku = jax.random.split(key, 3)
+            X = jax.random.normal(kx, (n, p), jnp.float32).at[:, 0].set(1.0)
+            bt = jax.random.normal(kb, (p,), jnp.float32) / (2.0 * p ** 0.5)
+            eta = X @ bt
+            if kind == "gaussian":
+                y = eta + 0.3 * jax.random.normal(ku, (n,), jnp.float32)
+            elif kind == "logistic":
+                y = (jax.random.uniform(ku, (n,))
+                     < jax.nn.sigmoid(eta)).astype(jnp.float32)
+            elif kind == "poisson":
+                y = jax.random.poisson(ku, jnp.exp(0.5 * eta)).astype(jnp.float32)
+            else:
+                raise ValueError(kind)
+            return jax.device_put(X, mat_s), jax.device_put(y, row_s)
+        return gen(jax.random.PRNGKey(0))
+
+    def timed(fn, reps=3):
+        fn()  # warm-up/compile
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            ts.append(time.perf_counter() - t0)
+        return min(ts), out
+
+    ones = lambda n: jnp.ones((n,), jnp.float32)
+    zeros = lambda n: jnp.zeros((n,), jnp.float32)
+
+    # ---- 1. OLS 10k x 20 ---------------------------------------------------
+    n, p = rows(10_000), 20
+    X, y = make_xy(jax.random.PRNGKey(1), n, p, "gaussian")
+    w = ones(n)
+
+    def run_ols():
+        out = _lm_kernel(X, y, w, jnp.float32(0.0), refine_steps=1)
+        float(out["sse"])
+        return out
+    t, _ = timed(run_ols)
+    emit({"config": f"ols_gaussian_{n}x{p}", "seconds": round(t, 5)})
+
+    # ---- 2/3/4: resident IRLS configs --------------------------------------
+    irls_cfgs = [
+        ("logistic", rows(1_000_000), 100, "logistic", "binomial", "logit"),
+        ("poisson", rows(1_000_000), 100, "poisson", "poisson", "log"),
+        ("logistic_gramian_stress", rows(2_000_000), 512, "logistic",
+         "binomial", "logit"),
+    ]
+    for label, n, p, kind, famname, linkname in irls_cfgs:
+        name = f"{label}_{n}x{p}"
+        X, y = make_xy(jax.random.PRNGKey(2), n, p, kind)
+        w, o = ones(n), zeros(n)
+        fam, lnk = resolve(famname, linkname)
+
+        def run_irls():
+            out = _irls_kernel(X, y, w, o, jnp.float32(1e-8), jnp.int32(25),
+                               jnp.float32(0.0), family=fam, link=lnk,
+                               criterion="relative", refine_steps=1,
+                               null_mean=True)
+            float(out["dev"])
+            return out
+        t, out = timed(run_irls)
+        emit({"config": name, "seconds": round(t, 4),
+              "iters": int(out["iters"]), "converged": bool(out["converged"])})
+        del X, y
+
+    # ---- 5. Gamma + prior weights + offset, streamed -----------------------
+    # full config is 50M x 500 (~100 GB); chunked generator, scaled rows
+    p5 = 500
+    chunk = 1_048_576 // 4
+    n5 = rows(8_000_000)
+    n_chunks = max(1, n5 // chunk)
+    bt5 = np.linspace(-0.2, 0.2, p5); bt5[0] = 1.5  # keep eta > 0 for inverse link
+
+    def source():
+        for i in range(n_chunks):
+            r = np.random.default_rng(1000 + i)
+            Xc = r.standard_normal((chunk, p5), dtype=np.float32) * 0.02
+            Xc[:, 0] = 1.0
+            eta = Xc @ bt5 + 0.05
+            mu = 1.0 / np.maximum(eta, 0.1)
+            yc = r.gamma(2.0, mu / 2.0).astype(np.float32) + 1e-3
+            wc = r.uniform(0.5, 2.0, chunk).astype(np.float32)
+            oc = np.full(chunk, 0.05, np.float32)
+            yield Xc, yc, wc, oc
+
+    t0 = time.perf_counter()
+    m = sg.glm_fit_streaming(source, family="gamma", link="inverse",
+                             tol=1e-8, criterion="relative", max_iter=25,
+                             chunk_rows=chunk, mesh=mesh)
+    t5 = time.perf_counter() - t0
+    emit({"config": f"gamma_weights_offset_streamed_{n_chunks * chunk}x{p5}",
+          "seconds": round(t5, 2), "iters": m.iterations,
+          "converged": bool(m.converged)})
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+    print(f"platform={jax.default_backend()} devices={len(jax.devices())}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,4 +41,5 @@ DEFAULT = NumericConfig()
 
 
 def x64_enabled() -> bool:
-    return jnp.zeros((), jnp.float64).dtype == jnp.float64
+    import jax
+    return bool(jax.config.jax_enable_x64)

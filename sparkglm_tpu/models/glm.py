@@ -57,7 +57,7 @@ def _sanitize(x, valid, fill=0.0):
 
 
 @partial(jax.jit, static_argnames=("family", "link", "criterion", "refine_steps",
-                                   "null_mean"))
+                                   "null_mean", "trace"))
 def _irls_kernel(
     X, y, wt, offset,
     tol, max_iter, jitter,
@@ -65,6 +65,7 @@ def _irls_kernel(
     criterion: str = "absolute",
     refine_steps: int = 1,
     null_mean: bool = True,
+    trace: bool = False,
 ):
     """Full IRLS to convergence in one compiled while_loop.
 
@@ -113,6 +114,11 @@ def _irls_kernel(
         eta_new = (X @ beta + offset).astype(X.dtype)      # ref: etaCreate :321-332
         mu_new = jnp.where(valid, link.inverse(eta_new), 1.0).astype(X.dtype)  # ref: muCreate :334-355
         dev_new = dev_of(mu_new)
+        if trace:
+            # the reference's verbose "iter\tddev" line (GLM.scala:304,461)
+            jax.debug.print("iter {i}\tdeviance {d}\tddev {dd}",
+                            i=s["it"] + 1, d=dev_new,
+                            dd=jnp.abs(dev_new - s["dev"]))
         return dict(
             it=s["it"] + 1,
             beta=beta.astype(X.dtype),
@@ -159,7 +165,7 @@ def _fused_block_rows(p: int) -> int:
 
 @partial(jax.jit, static_argnames=("family", "link", "criterion", "refine_steps",
                                    "null_mean", "mesh", "block_rows",
-                                   "use_pallas"))
+                                   "use_pallas", "trace"))
 def _irls_fused_kernel(
     X, y, wt, offset,
     tol, max_iter, jitter,
@@ -170,6 +176,7 @@ def _irls_fused_kernel(
     mesh=None,
     block_rows: int = 512,
     use_pallas: bool = True,
+    trace: bool = False,
 ):
     """IRLS where each iteration's data touch is ONE fused pass over X
     (ops/fused.py): eta, mu, z, w, Gramian and deviance per row block, then a
@@ -228,6 +235,10 @@ def _irls_fused_kernel(
     def body(s):
         XtWX, XtWz, dev = step(X, y, wt, offset, s["beta"])
         beta_new, diag_inv, singular = solve(XtWX, XtWz, s["beta"])
+        if trace:
+            jax.debug.print("iter {i}\tdeviance {d}\tddev {dd}",
+                            i=s["it"] + 1, d=dev,
+                            dd=jnp.abs(dev.astype(acc) - s["dev"]))
         return dict(
             it=s["it"] + 1,
             beta=beta_new.astype(X.dtype),
@@ -390,7 +401,8 @@ def fit(
 
     if mesh is None:
         mesh = meshlib.make_mesh()
-    use_f64 = X.dtype == np.float64 and jnp.zeros((), jnp.float64).dtype == jnp.float64
+    from ..config import x64_enabled
+    use_f64 = X.dtype == np.float64 and x64_enabled()
     dtype = np.float64 if use_f64 else np.dtype(config.dtype)
 
     def _check_len(v, what):
@@ -455,6 +467,7 @@ def fit(
             mesh=mesh, block_rows=block_rows,
             # the Mosaic kernel is float32; float64 (x64) runs the XLA twin
             use_pallas=on_tpu and p <= 1024 and dtype == np.float32,
+            trace=verbose,
         )
     else:
         out = _irls_kernel(
@@ -464,6 +477,7 @@ def fit(
             family=fam, link=lnk, criterion=criterion,
             refine_steps=config.refine_steps,
             null_mean=has_intercept and not has_offset,
+            trace=verbose,
         )
     out = jax.tree.map(np.asarray, out)
     if has_intercept and has_offset:

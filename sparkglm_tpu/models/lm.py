@@ -176,7 +176,9 @@ def fit(
 
     if mesh is None:
         mesh = meshlib.make_mesh()
-    dtype = np.float64 if X.dtype == np.float64 and jnp.zeros((), jnp.float64).dtype == jnp.float64 else np.dtype(config.dtype)
+    from ..config import x64_enabled
+    dtype = (np.float64 if X.dtype == np.float64 and x64_enabled()
+             else np.dtype(config.dtype))
 
     w_host = np.ones((n,), dtype=dtype) if weights is None else np.asarray(weights, dtype=dtype)
     if w_host.shape != (n,):

@@ -48,10 +48,27 @@ from ..families.links import Link
 from ..ops.fused import fused_fisher_pass_ref
 from ..ops.gramian import weighted_gramian
 from ..parallel import mesh as meshlib
-from .glm import GLMModel
-from .lm import LMModel, _detect_intercept
+from .glm import GLMModel, _sanitize
+from .lm import LMModel
 
 DEFAULT_CHUNK_ROWS = 262_144
+
+
+def _resolve_dtype(Xc, config: NumericConfig) -> np.dtype:
+    """Honour float64 input + x64 exactly like the resident fits
+    (models/lm.py / glm.py): f64 chunks stay f64 when x64 is on."""
+    from ..config import x64_enabled
+    if np.asarray(Xc).dtype == np.float64 and x64_enabled():
+        return np.dtype(np.float64)
+    return np.dtype(config.dtype)
+
+
+def _ones_colmask(Xc) -> np.ndarray:
+    """Per-column 'every value is exactly 1.0' for this chunk — AND-ed
+    across chunks so streaming intercept detection sees ALL rows, matching
+    the resident full-matrix scan (lm.py::_detect_intercept)."""
+    Xc = np.asarray(Xc)
+    return (Xc.min(axis=0) == 1.0) & (Xc.max(axis=0) == 1.0)
 
 
 # ---------------------------------------------------------------------------
@@ -66,9 +83,9 @@ def _as_source(source, chunk_rows: int) -> Callable[[], Iterator]:
         raise TypeError(
             "source must be (X, y[, weights[, offset]]) arrays or a callable "
             "returning an iterator of (X, y, w, off) chunks")
-    X, y = source[0], source[1]
-    w = source[2] if len(source) > 2 else None
-    off = source[3] if len(source) > 3 else None
+    X, y = source[0], np.asarray(source[1])
+    w = None if len(source) <= 2 or source[2] is None else np.asarray(source[2])
+    off = None if len(source) <= 3 or source[3] is None else np.asarray(source[3])
     n = X.shape[0]
     if y.shape[0] != n:
         raise ValueError(f"X has {n} rows but y has {y.shape[0]}")
@@ -124,16 +141,12 @@ def _glm_stats_pass(Xc, yc, wc, oc, beta, *, family: Family, link: Link):
     valid = wc > 0
     eta = Xc @ beta + oc
     mu = jnp.where(valid, link.inverse(eta), 1.0)
-
-    def _san(v):
-        return jnp.sum(jnp.where(
-            valid, jnp.nan_to_num(v, nan=0.0, posinf=0.0, neginf=0.0), 0.0))
-
     return dict(
-        dev=_san(family.dev_resids(yc, mu, wc)),
-        pearson=_san(wc * (yc - mu) ** 2
-                     / jnp.maximum(family.variance(mu), 1e-30)),
-        loglik=_san(family.loglik_terms(yc, mu, wc)),
+        dev=jnp.sum(_sanitize(family.dev_resids(yc, mu, wc), valid)),
+        pearson=jnp.sum(_sanitize(
+            wc * (yc - mu) ** 2 / jnp.maximum(family.variance(mu), 1e-30),
+            valid)),
+        loglik=jnp.sum(_sanitize(family.loglik_terms(yc, mu, wc), valid)),
         wt_sum=jnp.sum(wc), wy=jnp.sum(wc * yc))
 
 
@@ -144,23 +157,24 @@ def _null_dev_pass(yc, wc, oc, mu_null, *, family: Family, link: Link,
     no-intercept model (R semantics), else the constant weighted mean."""
     valid = wc > 0
     mu = link.inverse(oc) if from_offset else jnp.full_like(yc, mu_null)
-    return jnp.sum(jnp.where(
-        valid,
-        jnp.nan_to_num(family.dev_resids(yc, mu, wc),
-                       nan=0.0, posinf=0.0, neginf=0.0), 0.0))
+    return jnp.sum(_sanitize(family.dev_resids(yc, mu, wc), valid))
 
 
 def _solve64(XtWX: np.ndarray, XtWz: np.ndarray, jitter: float):
-    """Host float64 Cholesky solve + diag of the inverse (the reference's
-    driver-local LAPACK role, utils.scala:102-105, without the explicit
-    inverse)."""
+    """Host float64 Cholesky solve (the reference's driver-local LAPACK
+    role, utils.scala:102-105, without the explicit inverse).  Returns the
+    factorization so callers can derive diag((X'WX)^-1) once, after the
+    loop — not O(p^3) per iteration."""
     A = 0.5 * (XtWX + XtWX.T)
     if jitter:
         A = A + jitter * np.mean(np.diag(A)) * np.eye(A.shape[0])
     cho = scipy.linalg.cho_factor(A)
     beta = scipy.linalg.cho_solve(cho, XtWz)
-    diag_inv = np.diag(scipy.linalg.cho_solve(cho, np.eye(A.shape[0])))
-    return beta, diag_inv
+    return beta, cho
+
+
+def _diag_inv64(cho) -> np.ndarray:
+    return np.diag(scipy.linalg.cho_solve(cho, np.eye(cho[0].shape[0])))
 
 
 # ---------------------------------------------------------------------------
@@ -180,19 +194,22 @@ def lm_fit_streaming(
     """OLS/WLS in ONE streaming pass (host-f64 accumulation + solve)."""
     if mesh is None:
         mesh = meshlib.make_mesh()
-    dtype = np.dtype(config.dtype)
     chunks = _as_source(source, chunk_rows)
 
     acc = None
-    first_chunk = None
+    dtype = None
+    ones_mask = None
     n = 0
     for Xc, yc, wc, oc in chunks():
         if oc is not None and np.any(np.asarray(oc) != 0):
             raise ValueError(
                 "lm_fit_streaming does not support an offset (linear models "
                 "have no offset; absorb it by regressing y - offset)")
-        if first_chunk is None:
-            first_chunk = np.asarray(Xc[: min(len(Xc), 64)])
+        if dtype is None:
+            dtype = _resolve_dtype(Xc, config)
+        if has_intercept is None:
+            cm = _ones_colmask(Xc)
+            ones_mask = cm if ones_mask is None else ones_mask & cm
         n += int(Xc.shape[0])  # true row count (device padding carries w=0)
         d = _lm_chunk_pass(*_put_chunk(Xc, yc, wc, oc, mesh, dtype)[:3])
         d = {k: np.asarray(v, np.float64) for k, v in d.items()}
@@ -205,9 +222,12 @@ def lm_fit_streaming(
         xnames = tuple(f"x{i}" for i in range(p))
     xnames = tuple(xnames)
     if has_intercept is None:
-        has_intercept = _detect_intercept(first_chunk, xnames)
+        has_intercept = (
+            any(nm.lower() in ("intercept", "(intercept)") for nm in xnames)
+            or bool(ones_mask.any()))
 
-    beta, diag_inv = _solve64(acc["XtWX"], acc["XtWy"], config.jitter)
+    beta, cho = _solve64(acc["XtWX"], acc["XtWy"], config.jitter)
+    diag_inv = _diag_inv64(cho)
     # SSE via the normal equations: SSE = y'Wy - beta'X'Wy (f64 accumulators
     # keep the cancellation safe); SST from the moment sums
     sse = float(acc["ytWy"] - beta @ acc["XtWy"])
@@ -260,21 +280,25 @@ def glm_fit_streaming(
     fam, lnk = resolve(family, link)
     if mesh is None:
         mesh = meshlib.make_mesh()
-    dtype = np.dtype(config.dtype)
     chunks = _as_source(source, chunk_rows)
 
     n_total = 0
     saw_offset = False
+    dtype = None
+    ones_mask = None
+    scan_intercept = has_intercept is None
 
     def full_pass(beta, first):
-        nonlocal n_total, saw_offset
+        nonlocal n_total, saw_offset, dtype, ones_mask
         XtWX = XtWz = None
         dev = 0.0
-        nonlocal_first = None
         count = 0
         for Xc, yc, wc, oc in chunks():
-            if nonlocal_first is None:
-                nonlocal_first = np.asarray(Xc[: min(len(Xc), 64)])
+            if dtype is None:
+                dtype = _resolve_dtype(Xc, config)
+            if first and scan_intercept:
+                cm = _ones_colmask(Xc)
+                ones_mask = cm if ones_mask is None else ones_mask & cm
             count += int(Xc.shape[0])
             if first and oc is not None and np.any(np.asarray(oc) != 0):
                 saw_offset = True
@@ -291,22 +315,24 @@ def glm_fit_streaming(
         if XtWX is None:
             raise ValueError("source yielded no chunks")
         n_total = count
-        return XtWX, XtWz, dev, nonlocal_first
+        return XtWX, XtWz, dev
 
     # init pass from family starting values (first=True ignores beta)
-    XtWX, XtWz, dev_prev, first_chunk = full_pass(None, True)
+    XtWX, XtWz, dev_prev = full_pass(None, True)
     p = XtWX.shape[0]
     if xnames is None:
         xnames = tuple(f"x{i}" for i in range(p))
     xnames = tuple(xnames)
     if has_intercept is None:
-        has_intercept = _detect_intercept(first_chunk, xnames)
-    beta, diag_inv = _solve64(XtWX, XtWz, config.jitter)
+        has_intercept = (
+            any(nm.lower() in ("intercept", "(intercept)") for nm in xnames)
+            or bool(ones_mask.any()))
+    beta, cho = _solve64(XtWX, XtWz, config.jitter)
 
     iters = 0
     converged = False
     for it in range(max_iter):
-        XtWX, XtWz, dev, _ = full_pass(beta, False)
+        XtWX, XtWz, dev = full_pass(beta, False)
         ddev = abs(dev - dev_prev)
         crit = ddev / (abs(dev) + 0.1) if criterion == "relative" else ddev
         dev_prev = dev
@@ -316,10 +342,11 @@ def glm_fit_streaming(
         # solve before the convergence break so beta and the SE ingredient
         # diag((X'WX)^-1) come from the same final pass, exactly like the
         # resident fused engine's loop body
-        beta, diag_inv = _solve64(XtWX, XtWz, config.jitter)
+        beta, cho = _solve64(XtWX, XtWz, config.jitter)
         if crit <= tol:
             converged = True
             break
+    diag_inv = _diag_inv64(cho)  # once, from the final factorization
 
     # final stats pass at the converged beta
     stats = None

@@ -153,6 +153,39 @@ def test_lm_streaming_rejects_offset(mesh1, rng):
         sg.lm_fit_streaming((X, y, None, off), mesh=mesh1)
 
 
+def test_streaming_intercept_scans_all_chunks(mesh8, rng):
+    """A column constant-1 in early chunks but not later must NOT be taken
+    for an intercept (the resident engines scan the full matrix)."""
+    n = 2000
+    flag = np.zeros(n)
+    flag[:1500] = 1.0  # first chunks all-ones, later chunks not
+    X = np.stack([flag, rng.normal(size=n)], axis=1)
+    y = X @ [1.0, 2.0] + 0.1 * rng.normal(size=n)
+    m_r = sg.lm_fit(X, y, mesh=mesh8)
+    m_s = sg.lm_fit_streaming((X, y), chunk_rows=500, mesh=mesh8)
+    assert m_s.has_intercept == m_r.has_intercept == False  # noqa: E712
+    np.testing.assert_allclose(m_s.r_squared, m_r.r_squared, rtol=1e-6)
+
+
+def test_streaming_honors_float64(mesh1, rng):
+    """float64 input + x64 stays float64 through the chunks, matching the
+    resident engine's precision."""
+    n, p = 3000, 4
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    y = X @ [1e5, 0.5, -0.2, 0.1] + 1e-3 * rng.normal(size=n)
+    m_r = sg.lm_fit(X, y, mesh=mesh1)
+    m_s = sg.lm_fit_streaming((X, y), chunk_rows=512, mesh=mesh1)
+    np.testing.assert_allclose(m_s.coefficients, m_r.coefficients,
+                               rtol=1e-10, atol=1e-8)
+
+
+def test_streaming_accepts_list_weights(mesh1, rng):
+    X, bt = _data(rng, n=300)
+    y = X @ bt
+    m = sg.lm_fit_streaming((X, y, [1.0] * 300), mesh=mesh1)
+    assert np.all(np.isfinite(m.coefficients))
+
+
 def test_streaming_validation(mesh1, rng):
     X = rng.normal(size=(100, 3))
     y = rng.normal(size=99)
