@@ -28,7 +28,9 @@ from .models.lm import LMModel
 from .models.lm import fit as lm_fit
 from .models.serialize import load_model, save_model
 from .models.streaming import glm_fit_streaming, lm_fit_streaming
+from .parallel import distributed
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
+from .utils import profiling
 
 __version__ = "0.1.0"
 
@@ -40,6 +42,7 @@ __all__ = [
     "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",
     "transform", "as_columns", "omit_na", "read_csv", "scan_csv_schema",
     "native_available",
-    "make_mesh", "shard_rows", "single_device_mesh",
+    "make_mesh", "shard_rows", "single_device_mesh", "distributed",
+    "profiling",
     "NumericConfig", "DEFAULT",
 ]

@@ -184,12 +184,28 @@ inverse_gaussian = Family(
 )
 
 
+# ----------------------------------------------------------------------------
+# quasi families (R's quasipoisson/quasibinomial): same mean/variance model,
+# dispersion estimated by Pearson chi^2 / df instead of fixed at 1, AIC
+# undefined (R reports NA)
+# ----------------------------------------------------------------------------
+
+_NAN_AIC = lambda dev, ll, n, p, wt_sum: jnp.nan
+
+quasipoisson = dataclasses.replace(
+    poisson, name="quasipoisson", dispersion_fixed=False, aic=_NAN_AIC)
+quasibinomial = dataclasses.replace(
+    binomial, name="quasibinomial", dispersion_fixed=False, aic=_NAN_AIC)
+
+
 FAMILIES: dict[str, Family] = {
     "gaussian": gaussian,
     "binomial": binomial,
     "poisson": poisson,
     "gamma": gamma,
     "inverse_gaussian": inverse_gaussian,
+    "quasipoisson": quasipoisson,
+    "quasibinomial": quasibinomial,
 }
 
 

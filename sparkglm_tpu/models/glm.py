@@ -416,8 +416,9 @@ def fit(
     y = y.astype(dtype, copy=True)
     if m is not None:
         m_arr = _check_len(m, "m").astype(dtype)
-        if fam.name != "binomial":
-            raise ValueError("group sizes m only apply to the binomial family")
+        if fam.name not in ("binomial", "quasibinomial"):
+            raise ValueError(
+                "group sizes m only apply to the (quasi)binomial family")
         y = y / np.maximum(m_arr, 1e-30)   # counts -> proportions
         wt = wt * m_arr
     off = (np.zeros((n,), dtype=dtype) if offset is None

@@ -230,7 +230,8 @@ def lm_fit_streaming(
     diag_inv = _diag_inv64(cho)
     # SSE via the normal equations: SSE = y'Wy - beta'X'Wy (f64 accumulators
     # keep the cancellation safe); SST from the moment sums
-    sse = float(acc["ytWy"] - beta @ acc["XtWy"])
+    # clamp: for near-exact fits the identity can go epsilon-negative
+    sse = max(float(acc["ytWy"] - beta @ acc["XtWy"]), 0.0)
     sst_raw = float(acc["ytWy"])
     sst_centered = float(acc["ytWy"] - acc["swy"] ** 2 / acc["sw"])
     sst = sst_centered if has_intercept else sst_raw

@@ -1,0 +1,58 @@
+"""Profiling / tracing hooks.
+
+The reference has NO instrumentation at all — its only progress signal is
+the optional per-iteration ``iter\\tddev`` print (SURVEY.md §5 "Tracing /
+profiling: none").  We carry that trace (``verbose=True`` on the fits) and
+add what a TPU workload actually needs: ``jax.profiler`` capture around a
+region, viewable in TensorBoard/Perfetto, plus a simple wall-clock timer
+that forces device completion (host read) so numbers are honest even on
+asynchronous dispatch backends.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a ``jax.profiler`` trace of the enclosed region::
+
+        with sg.profiling.trace("/tmp/jax-trace"):
+            sg.glm_fit(X, y, family="binomial")
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Timer:
+    """Wall-clock timing that blocks on device results.
+
+    ``jax.block_until_ready`` can be unreliable over remote-device
+    transports, so ``stop(out)`` forces a host read of one element of the
+    result before taking the time.
+    """
+
+    def __init__(self):
+        self.t0 = None
+        self.elapsed = None
+
+    def start(self) -> "Timer":
+        self.t0 = time.perf_counter()
+        return self
+
+    def stop(self, out=None) -> float:
+        if out is not None:
+            # sync EVERY leaf: separately dispatched results complete
+            # independently, so reading one is not enough
+            for leaf in jax.tree.leaves(out):
+                if hasattr(leaf, "ravel") and getattr(leaf, "size", 0):
+                    float(leaf.ravel()[0])
+        self.elapsed = time.perf_counter() - self.t0
+        return self.elapsed

@@ -1,0 +1,101 @@
+"""Quasi families, inverse-gaussian, GLM predict types, count/Bernoulli
+equivalence, profiling timer."""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+
+
+def test_quasipoisson_matches_poisson_coefs(mesh8, rng):
+    """Same coefficients as poisson; dispersion estimated, AIC NaN (R)."""
+    n, p = 1500, 4
+    X = rng.normal(size=(n, p)) * 0.5
+    X[:, 0] = 1.0
+    y = rng.poisson(np.exp(X @ (rng.normal(size=p) * 0.4)) * 2).astype(float)
+    mp = sg.glm_fit(X, y, family="poisson", tol=1e-10, mesh=mesh8)
+    mq = sg.glm_fit(X, y, family="quasipoisson", tol=1e-10, mesh=mesh8)
+    np.testing.assert_allclose(mq.coefficients, mp.coefficients, rtol=1e-9)
+    assert mp.dispersion == 1.0
+    assert mq.dispersion != 1.0 and np.isfinite(mq.dispersion)
+    assert np.isnan(mq.aic)
+    # SEs scale by sqrt(dispersion)
+    np.testing.assert_allclose(
+        mq.std_errors, mp.std_errors * np.sqrt(mq.dispersion), rtol=1e-6)
+
+
+def test_quasibinomial(mesh8, rng):
+    n, p = 1000, 3
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ [0.2, 0.5, -0.3])))).astype(float)
+    mq = sg.glm_fit(X, y, family="quasibinomial", tol=1e-10, mesh=mesh8)
+    mb = sg.glm_fit(X, y, family="binomial", tol=1e-10, mesh=mesh8)
+    np.testing.assert_allclose(mq.coefficients, mb.coefficients, rtol=1e-9)
+    assert np.isnan(mq.aic) and np.isfinite(mb.aic)
+
+
+def test_inverse_gaussian_family(mesh8, rng):
+    n, p = 1200, 3
+    X = np.abs(rng.normal(size=(n, p))) * 0.2 + 0.1
+    X[:, 0] = 1.0
+    mu_true = 1.0 / np.sqrt(X @ [1.0, 0.5, 0.8])
+    y = np.abs(rng.normal(loc=mu_true, scale=0.05 * mu_true))
+    m = sg.glm_fit(X, y, family="inverse_gaussian", tol=1e-10, mesh=mesh8)
+    assert m.converged
+    assert np.all(np.isfinite(m.coefficients))
+    assert m.link == "inverse_squared"
+
+
+def test_counts_m_equals_expanded_bernoulli(mesh8, rng):
+    """y successes out of m per row must fit identically to the expanded
+    one-row-per-trial Bernoulli data (the classic aggregation identity)."""
+    n, p = 120, 3
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    mm = rng.integers(1, 8, size=n)
+    prob = 1 / (1 + np.exp(-(X @ [0.3, -0.5, 0.4])))
+    counts = rng.binomial(mm, prob).astype(float)
+    mg = sg.glm_fit(X, counts, family="binomial", m=mm.astype(float),
+                    tol=1e-11, mesh=mesh8)
+    Xe = np.repeat(X, mm, axis=0)
+    ye = np.concatenate([
+        np.r_[np.ones(int(c)), np.zeros(int(t - c))]
+        for c, t in zip(counts, mm)])
+    me = sg.glm_fit(Xe, ye, family="binomial", tol=1e-11, mesh=mesh8)
+    np.testing.assert_allclose(mg.coefficients, me.coefficients,
+                               rtol=1e-7, atol=1e-9)
+    np.testing.assert_allclose(mg.std_errors, me.std_errors, rtol=1e-6)
+
+
+def test_quasibinomial_accepts_group_sizes(mesh8, rng):
+    n, p = 400, 3
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    mm = rng.integers(1, 10, size=n).astype(float)
+    prob = 1 / (1 + np.exp(-(X @ [0.2, 0.4, -0.3])))
+    counts = rng.binomial(mm.astype(int), prob).astype(float)
+    mq = sg.glm_fit(X, counts, family="quasibinomial", m=mm, tol=1e-10,
+                    mesh=mesh8)
+    mb = sg.glm_fit(X, counts, family="binomial", m=mm, tol=1e-10, mesh=mesh8)
+    np.testing.assert_allclose(mq.coefficients, mb.coefficients, rtol=1e-9)
+    with pytest.raises(ValueError, match="binomial"):
+        sg.glm_fit(X, counts, family="poisson", m=mm, mesh=mesh8)
+
+
+def test_glm_predict_types(mesh8, rng):
+    n = 800
+    d = {"y": (rng.random(n) < 0.4).astype(float), "x": rng.normal(size=n)}
+    m = sg.glm("y ~ x", d, family="binomial", mesh=mesh8)
+    new = {"x": np.linspace(-2, 2, 9)}
+    eta = sg.predict(m, new, type="link")
+    mu = sg.predict(m, new, type="response")
+    np.testing.assert_allclose(mu, 1 / (1 + np.exp(-eta)), rtol=1e-6)
+    assert np.all((mu > 0) & (mu < 1))
+    with pytest.raises(ValueError, match="type"):
+        sg.predict(m, new, type="terms")
+
+
+def test_profiling_timer(mesh1, rng):
+    import jax.numpy as jnp
+    t = sg.profiling.Timer().start()
+    out = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+    dt = t.stop(out)
+    assert dt > 0 and t.elapsed == dt
