@@ -29,12 +29,18 @@ class NumericConfig:
       refine_steps: iterative-refinement sweeps after the Cholesky solve; buys
         back float64-like accuracy for the p-dimensional solve while the heavy
         Gramian stays in float32 on the MXU.
+      matmul_precision: XLA dot precision for the Gramian einsums — None
+        (backend default), "default", "high" (≈bf16x3 on the MXU: roughly
+        f32-quality inner products at higher throughput) or "highest".
+        A speed/accuracy lever for very wide designs; coefficient parity
+        tests run at None/highest.
     """
 
     dtype: jnp.dtype = jnp.float32
     accum_dtype: jnp.dtype = jnp.float32
     jitter: float = 0.0
     refine_steps: int = 1
+    matmul_precision: str | None = None
 
 
 DEFAULT = NumericConfig()

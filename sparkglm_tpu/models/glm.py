@@ -43,7 +43,7 @@ from ..families.families import Family, resolve
 from ..families.links import Link
 from ..ops.fused import fused_fisher_pass, fused_fisher_pass_ref
 from ..ops.gramian import weighted_gramian
-from ..ops.solve import diag_inv_from_cho, solve_normal
+from ..ops.solve import inv_from_cho, solve_normal
 from ..parallel import mesh as meshlib
 
 _BIG = jnp.inf
@@ -57,7 +57,7 @@ def _sanitize(x, valid, fill=0.0):
 
 
 @partial(jax.jit, static_argnames=("family", "link", "criterion", "refine_steps",
-                                   "null_mean", "trace"))
+                                   "null_mean", "trace", "precision"))
 def _irls_kernel(
     X, y, wt, offset,
     tol, max_iter, jitter,
@@ -66,6 +66,7 @@ def _irls_kernel(
     refine_steps: int = 1,
     null_mean: bool = True,
     trace: bool = False,
+    precision=None,
 ):
     """Full IRLS to convergence in one compiled while_loop.
 
@@ -91,7 +92,7 @@ def _irls_kernel(
         mu=mu0.astype(X.dtype),
         dev=dev0.astype(acc),
         ddev=jnp.asarray(_BIG, acc),
-        diag_inv=jnp.zeros((p,), acc),
+        cov_inv=jnp.zeros((p, p), acc),
         singular=jnp.zeros((), jnp.bool_),
     )
 
@@ -107,7 +108,8 @@ def _irls_kernel(
         var = family.variance(mu)                # ref: GLM.scala:125-129
         w = _sanitize(wt / jnp.maximum(var * g * g, 1e-30), valid)
         z = _sanitize(eta - offset + (y - mu) * g, valid)  # ref: GLM.scala:371-373
-        XtWX, XtWz = weighted_gramian(X, z, w, accum_dtype=acc)
+        XtWX, XtWz = weighted_gramian(X, z, w, accum_dtype=acc,
+                                      precision=precision)
         beta, cho = solve_normal(XtWX, XtWz, jitter=jitter, refine_steps=refine_steps)
         singular = ~jnp.all(jnp.isfinite(beta))
         beta = jnp.where(singular, s["beta"], beta)
@@ -126,7 +128,7 @@ def _irls_kernel(
             mu=mu_new,
             dev=dev_new,
             ddev=jnp.abs(dev_new - s["dev"]),
-            diag_inv=diag_inv_from_cho(cho, p, acc),
+            cov_inv=inv_from_cho(cho, p, acc),
             singular=singular,
         )
 
@@ -148,7 +150,7 @@ def _irls_kernel(
     d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
     converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"]
 
-    return dict(beta=s["beta"], diag_inv=s["diag_inv"], dev=s["dev"],
+    return dict(beta=s["beta"], cov_inv=s["cov_inv"], dev=s["dev"],
                 null_dev=null_dev, pearson=pearson, loglik=loglik,
                 iters=s["it"], converged=converged, singular=s["singular"],
                 wt_sum=wt_sum)
@@ -208,11 +210,11 @@ def _irls_fused_kernel(
                                  refine_steps=refine_steps)
         singular = ~jnp.all(jnp.isfinite(beta))
         beta = jnp.where(singular, beta_prev, beta)
-        return beta, diag_inv_from_cho(cho, p, acc), singular
+        return beta, inv_from_cho(cho, p, acc), singular
 
     beta0 = jnp.zeros((p,), X.dtype)
     XtWX0, XtWz0, dev0 = spmd_pass(True)(X, y, wt, offset, beta0)
-    beta1, diag0, sing0 = solve(XtWX0, XtWz0, beta0)
+    beta1, cov0, sing0 = solve(XtWX0, XtWz0, beta0)
 
     state0 = dict(
         # counts deviance-measured updates, matching the einsum kernel's
@@ -221,7 +223,7 @@ def _irls_fused_kernel(
         beta=beta1.astype(X.dtype),
         dev=dev0.astype(acc),
         ddev=jnp.asarray(_BIG, acc),
-        diag_inv=diag0.astype(acc),
+        cov_inv=cov0.astype(acc),
         singular=sing0,
     )
     step = spmd_pass(False)
@@ -234,7 +236,7 @@ def _irls_fused_kernel(
 
     def body(s):
         XtWX, XtWz, dev = step(X, y, wt, offset, s["beta"])
-        beta_new, diag_inv, singular = solve(XtWX, XtWz, s["beta"])
+        beta_new, cov_inv, singular = solve(XtWX, XtWz, s["beta"])
         if trace:
             jax.debug.print("iter {i}\tdeviance {d}\tddev {dd}",
                             i=s["it"] + 1, d=dev,
@@ -244,7 +246,7 @@ def _irls_fused_kernel(
             beta=beta_new.astype(X.dtype),
             dev=dev.astype(acc),
             ddev=jnp.abs(dev.astype(acc) - s["dev"]),
-            diag_inv=diag_inv,
+            cov_inv=cov_inv,
             singular=singular,
         )
 
@@ -271,7 +273,7 @@ def _irls_fused_kernel(
     d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
     converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"]
 
-    return dict(beta=beta_f, diag_inv=s["diag_inv"], dev=dev_final,
+    return dict(beta=beta_f, cov_inv=s["cov_inv"], dev=dev_final,
                 null_dev=null_dev, pearson=pearson, loglik=loglik,
                 iters=s["it"], converged=converged,
                 singular=s["singular"], wt_sum=wt_sum)
@@ -304,6 +306,7 @@ class GLMModel:
     n_shards: int
     tol: float
     has_intercept: bool
+    cov_unscaled: np.ndarray | None = None
     formula: str | None = None
     terms: object | None = None
 
@@ -339,6 +342,47 @@ class GLMModel:
         # ref: z-tests via Gaussian, GLM.scala:1002-1008
         from scipy import stats
         return 2.0 * stats.norm.sf(np.abs(self.z_values()))
+
+    def vcov(self) -> np.ndarray:
+        """dispersion * (X'WX)^-1 — R's vcov(glm)."""
+        if self.cov_unscaled is None:
+            raise ValueError("model was fit without the unscaled covariance "
+                             "(streaming fits keep only its diagonal)")
+        return self.dispersion * self.cov_unscaled
+
+    def confint(self, level: float = 0.95) -> np.ndarray:
+        """(p, 2) Wald normal-quantile intervals (the summary's z-tests,
+        GLM.scala:1002-1008, turned into intervals)."""
+        from scipy import stats
+        half = stats.norm.ppf(0.5 + level / 2.0) * self.std_errors
+        return np.stack([self.coefficients - half,
+                         self.coefficients + half], axis=1)
+
+    def residuals(self, X, y, type: str = "deviance",
+                  offset=None, weights=None) -> np.ndarray:
+        """Per-row residuals at the fitted coefficients (models do not
+        retain training data; pass it back in).  Types follow R's
+        ``residuals.glm``: deviance, pearson, response, working."""
+        from ..families.families import resolve as _resolve
+        fam, lnk = _resolve(self.family, self.link)
+        y = np.asarray(y, np.float64)
+        wt = np.ones_like(y) if weights is None else np.asarray(weights, np.float64)
+        mu = np.asarray(self.predict(X, type="response", offset=offset),
+                        np.float64)
+        if type == "response":
+            return y - mu
+        if type == "pearson":
+            v = np.asarray(fam.variance(jnp.asarray(mu)))
+            return (y - mu) * np.sqrt(wt) / np.sqrt(np.maximum(v, 1e-300))
+        if type == "deviance":
+            d = np.asarray(fam.dev_resids(jnp.asarray(y), jnp.asarray(mu),
+                                          jnp.asarray(wt)))
+            return np.sign(y - mu) * np.sqrt(np.maximum(d, 0.0))
+        if type == "working":
+            g = np.asarray(lnk.deriv(jnp.asarray(mu)))
+            return (y - mu) * g
+        raise ValueError(
+            f"type must be deviance/pearson/response/working, got {type!r}")
 
 
 def fit(
@@ -479,6 +523,7 @@ def fit(
             refine_steps=config.refine_steps,
             null_mean=has_intercept and not has_offset,
             trace=verbose,
+            precision=config.matmul_precision,
         )
     out = jax.tree.map(np.asarray, out)
     if has_intercept and has_offset:
@@ -490,7 +535,8 @@ def fit(
             jnp.asarray(max_iter, jnp.int32),
             jnp.asarray(config.jitter, dtype),
             family=fam, link=lnk, criterion=criterion,
-            refine_steps=config.refine_steps, null_mean=True)
+            refine_steps=config.refine_steps, null_mean=True,
+            precision=config.matmul_precision)
         out["null_dev"] = np.asarray(null_out["dev"])
     if bool(out["singular"]):
         raise np.linalg.LinAlgError(
@@ -504,7 +550,7 @@ def fit(
         dispersion = 1.0
     else:
         dispersion = float(out["pearson"]) / df_resid  # ref: createObj GLM.scala:74-79
-    std_err = np.sqrt(np.maximum(dispersion * out["diag_inv"], 0.0))
+    std_err = np.sqrt(np.maximum(dispersion * np.diag(out["cov_inv"]), 0.0))
     ll = float(out["loglik"])
     aic = float(fam.aic(dev, ll, float(n), float(p), float(out["wt_sum"])))
     if verbose:
@@ -533,4 +579,5 @@ def fit(
         n_shards=mesh.shape[meshlib.DATA_AXIS],
         tol=tol,
         has_intercept=bool(has_intercept),
+        cov_unscaled=out["cov_inv"].astype(np.float64),
     )

@@ -34,8 +34,9 @@ from ..ops.solve import diag_inv_from_cho, inv_from_cho, solve_normal
 from ..parallel import mesh as meshlib
 
 
-@partial(jax.jit, static_argnames=("refine_steps", "compute_cov"))
-def _lm_kernel(X, y, w, jitter, refine_steps: int = 1, compute_cov: bool = True):
+@partial(jax.jit, static_argnames=("refine_steps", "compute_cov", "precision"))
+def _lm_kernel(X, y, w, jitter, refine_steps: int = 1, compute_cov: bool = True,
+               precision=None):
     """One fused pass: (X'WX, X'Wy) -> solve -> residual stats.
 
     With X/y/w row-sharded this is per-shard MXU work + one psum; the
@@ -43,7 +44,7 @@ def _lm_kernel(X, y, w, jitter, refine_steps: int = 1, compute_cov: bool = True)
     SSE collect LM.scala:167) plus driver-side LAPACK per fit.
     """
     acc = X.dtype if X.dtype == jnp.float64 else jnp.float32
-    XtWX, XtWy = weighted_gramian(X, y, w, accum_dtype=acc)
+    XtWX, XtWy = weighted_gramian(X, y, w, accum_dtype=acc, precision=precision)
     beta, cho = solve_normal(XtWX, XtWy, jitter=jitter, refine_steps=refine_steps)
     resid = y - X @ beta
     sse = jnp.sum(w.astype(acc) * resid.astype(acc) ** 2)
@@ -118,6 +119,25 @@ class LMModel:
         from scipy import stats
         return 2.0 * stats.t.sf(np.abs(self.t_values()), self.df_resid)
 
+    def vcov(self) -> np.ndarray:
+        """sigma^2 (X'WX)^-1 — R's vcov(lm)."""
+        if self.cov_unscaled is None:
+            raise ValueError("model was fit without the unscaled covariance "
+                             "(streaming fits keep only its diagonal)")
+        return self.sigma ** 2 * self.cov_unscaled
+
+    def confint(self, level: float = 0.95) -> np.ndarray:
+        """(p, 2) t-based confidence intervals — R's confint(lm)."""
+        from scipy import stats
+        half = stats.t.ppf(0.5 + level / 2.0, self.df_resid) * self.std_errors
+        return np.stack([self.coefficients - half,
+                         self.coefficients + half], axis=1)
+
+    def residuals(self, X, y) -> np.ndarray:
+        """Response residuals y - X beta (models do not retain training
+        data; pass it back in)."""
+        return np.asarray(y) - self.predict(X)
+
 
 @jax.jit
 def _predict_jit(X, beta):
@@ -190,7 +210,8 @@ def fit(
     wd = meshlib.shard_rows(w_host, mesh)
 
     out = _lm_kernel(Xd, yd, wd, jnp.asarray(config.jitter, dtype),
-                     refine_steps=config.refine_steps)
+                     refine_steps=config.refine_steps,
+                     precision=config.matmul_precision)
     out = jax.tree.map(np.asarray, out)
 
     n_eff = float(n)  # true observation count (host-side; padding rows carry w=0)

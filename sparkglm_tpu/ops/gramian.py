@@ -24,7 +24,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def weighted_gramian(X, z, w, *, accum_dtype=jnp.float32):
+def weighted_gramian(X, z, w, *, accum_dtype=jnp.float32, precision=None):
     """Return ``(X'WX, X'Wz)`` for diagonal weights ``w``.
 
     Args:
@@ -33,17 +33,23 @@ def weighted_gramian(X, z, w, *, accum_dtype=jnp.float32):
       w: (n,) non-negative weights.  Zero-weight rows (e.g. shard padding)
         contribute nothing.
       accum_dtype: einsum accumulation dtype (``preferred_element_type``).
+      precision: XLA dot precision (None = backend default; "high" trades a
+        little Gramian accuracy for MXU throughput on wide designs).
     """
     Xw = X * w[:, None]
-    XtWX = jnp.einsum("np,nq->pq", Xw, X, preferred_element_type=accum_dtype)
-    XtWz = jnp.einsum("np,n->p", Xw, z, preferred_element_type=accum_dtype)
+    XtWX = jnp.einsum("np,nq->pq", Xw, X, preferred_element_type=accum_dtype,
+                      precision=precision)
+    XtWz = jnp.einsum("np,n->p", Xw, z, preferred_element_type=accum_dtype,
+                      precision=precision)
     return XtWX, XtWz
 
 
-def gramian(X, y, *, accum_dtype=jnp.float32):
+def gramian(X, y, *, accum_dtype=jnp.float32, precision=None):
     """Unweighted ``(X'X, X'y)`` — the OLS fast path (LM.scala:146-148)."""
-    XtX = jnp.einsum("np,nq->pq", X, X, preferred_element_type=accum_dtype)
-    Xty = jnp.einsum("np,n->p", X, y, preferred_element_type=accum_dtype)
+    XtX = jnp.einsum("np,nq->pq", X, X, preferred_element_type=accum_dtype,
+                     precision=precision)
+    Xty = jnp.einsum("np,n->p", X, y, preferred_element_type=accum_dtype,
+                     precision=precision)
     return XtX, Xty
 
 

@@ -93,6 +93,40 @@ def test_glm_predict_types(mesh8, rng):
         sg.predict(m, new, type="terms")
 
 
+def test_glm_vcov_confint_residuals(mesh8, rng):
+    from oracle import irls_np
+    n, p = 1000, 4
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ [0.3, 0.5, -0.4, 0.2])))).astype(float)
+    m = sg.glm_fit(X, y, family="binomial", tol=1e-11, mesh=mesh8)
+    _, _, _, cov = irls_np(X, y, "binomial", "logit")
+    np.testing.assert_allclose(m.vcov(), cov, rtol=1e-4, atol=1e-10)
+    ci = m.confint(0.95)
+    np.testing.assert_allclose(ci[:, 1] - ci[:, 0],
+                               2 * 1.959963985 * m.std_errors, rtol=1e-9)
+    # residual identities
+    mu = 1 / (1 + np.exp(-(X @ m.coefficients)))
+    np.testing.assert_allclose(m.residuals(X, y, type="response"), y - mu,
+                               rtol=1e-6, atol=1e-9)
+    rp = m.residuals(X, y, type="pearson")
+    np.testing.assert_allclose(np.sum(rp ** 2), m.pearson_chi2, rtol=1e-6)
+    rd = m.residuals(X, y, type="deviance")
+    np.testing.assert_allclose(np.sum(rd ** 2), m.deviance, rtol=1e-6)
+
+
+def test_lm_vcov_confint_residuals(mesh8, rng):
+    n, p = 800, 3
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    y = X @ [1.0, 0.5, -0.3] + 0.2 * rng.normal(size=n)
+    m = sg.lm_fit(X, y, mesh=mesh8)
+    np.testing.assert_allclose(np.sqrt(np.diag(m.vcov())), m.std_errors,
+                               rtol=1e-9)
+    ci = m.confint()
+    assert np.all(ci[:, 0] < m.coefficients) and np.all(ci[:, 1] > m.coefficients)
+    r = m.residuals(X, y)
+    np.testing.assert_allclose(np.sum(r ** 2), m.sse, rtol=1e-6)
+
+
 def test_profiling_timer(mesh1, rng):
     import jax.numpy as jnp
     t = sg.profiling.Timer().start()
