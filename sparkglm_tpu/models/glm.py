@@ -310,8 +310,13 @@ class GLMModel:
     formula: str | None = None
     terms: object | None = None
 
-    def predict(self, X, type: str = "response", offset=None) -> np.ndarray:
-        """eta = X·beta (+ offset); type="response" applies the inverse link."""
+    def predict(self, X, type: str = "response", offset=None,
+                se_fit: bool = False):
+        """eta = X·beta (+ offset); type="response" applies the inverse link.
+
+        With ``se_fit`` returns ``(fit, se)``: link-scale se_i =
+        sqrt(x_i' V x_i); response-scale multiplies by |dmu/deta| (the delta
+        method, matching R's ``predict.glm(se.fit=TRUE)``)."""
         X = np.asarray(X)
         if X.ndim != 2 or X.shape[1] != self.n_params:
             raise ValueError(
@@ -319,12 +324,21 @@ class GLMModel:
         eta = X @ self.coefficients
         if offset is not None:
             eta = eta + np.asarray(offset)
-        if type == "link":
-            return eta
+        if type not in ("link", "response"):
+            raise ValueError(f"type must be 'link' or 'response', got {type!r}")
+        from ..families.links import get_link
+        from .lm import _row_quadform
+        lnk = get_link(self.link)
+        need_mu = type == "response" or se_fit
+        mu = np.asarray(lnk.inverse(jnp.asarray(eta))) if need_mu else None
+        fit = eta if type == "link" else mu
+        if not se_fit:
+            return fit
+        se = _row_quadform(X, self.vcov())
         if type == "response":
-            from ..families.links import get_link
-            return np.asarray(get_link(self.link).inverse(jnp.asarray(eta)))
-        raise ValueError(f"type must be 'link' or 'response', got {type!r}")
+            # delta method: dmu/deta = 1 / g'(mu)
+            se = se / np.abs(np.asarray(lnk.deriv(jnp.asarray(mu))))
+        return fit, se
 
     def summary(self):
         from .summary import GLMSummary
@@ -359,14 +373,21 @@ class GLMModel:
                          self.coefficients + half], axis=1)
 
     def residuals(self, X, y, type: str = "deviance",
-                  offset=None, weights=None) -> np.ndarray:
+                  offset=None, weights=None, m=None) -> np.ndarray:
         """Per-row residuals at the fitted coefficients (models do not
-        retain training data; pass it back in).  Types follow R's
-        ``residuals.glm``: deviance, pearson, response, working."""
+        retain training data; pass the SAME y/weights/offset/m you fit
+        with).  Types follow R's ``residuals.glm``: deviance, pearson,
+        response, working.  For grouped-binomial fits pass ``m`` so counts
+        convert to proportions + weights exactly as in ``fit``."""
         from ..families.families import resolve as _resolve
+        from .lm import _squeeze_column
         fam, lnk = _resolve(self.family, self.link)
-        y = np.asarray(y, np.float64)
-        wt = np.ones_like(y) if weights is None else np.asarray(weights, np.float64)
+        y = _squeeze_column(y)
+        wt = np.ones_like(y) if weights is None else _squeeze_column(weights)
+        if m is not None:
+            m_arr = _squeeze_column(m)
+            y = y / np.maximum(m_arr, 1e-30)  # counts -> proportions, as fit
+            wt = wt * m_arr
         mu = np.asarray(self.predict(X, type="response", offset=offset),
                         np.float64)
         if type == "response":
@@ -473,10 +494,18 @@ def fit(
     if engine == "auto":
         # fused wins where the pass is HBM-bandwidth-bound (narrow designs);
         # for wide designs the einsum path is MXU-bound and XLA's scheduling
-        # of the f32 multi-pass matmul beats the hand-tiled kernel
+        # of the f32 multi-pass matmul beats the hand-tiled kernel.  The
+        # fused kernel has a fixed internal precision, so an explicit
+        # matmul_precision request routes to the einsum engine that honours it.
         fused_ok = (not shard_features and p <= 128
-                    and mesh.shape[meshlib.MODEL_AXIS] == 1 and not use_f64)
+                    and mesh.shape[meshlib.MODEL_AXIS] == 1 and not use_f64
+                    and config.matmul_precision is None)
         engine = "fused" if (on_tpu and fused_ok) else "einsum"
+    if engine == "fused" and config.matmul_precision is not None:
+        import warnings
+        warnings.warn("engine='fused' uses a fixed internal matmul precision; "
+                      "config.matmul_precision is ignored on this path",
+                      stacklevel=2)
     if engine not in ("einsum", "fused"):
         raise ValueError(f"engine must be 'auto', 'einsum' or 'fused', got {engine!r}")
     if engine == "fused" and (shard_features or mesh.shape[meshlib.MODEL_AXIS] != 1):

@@ -85,14 +85,18 @@ class LMModel:
     terms: object | None = None
 
     # -- scoring (LM.scala:29-61) --------------------------------------------
-    def predict(self, X, mesh=None) -> np.ndarray:
+    def predict(self, X, mesh=None, se_fit: bool = False):
         """X·beta. Accepts an (n,p) array aligned to ``xnames``; the formula
-        front-end (api.py) handles model-matrix/column matching first."""
+        front-end (api.py) handles model-matrix/column matching first.
+        With ``se_fit`` returns ``(fit, se)`` where se_i = sqrt(x_i' V x_i)
+        (R's ``predict.lm(se.fit=TRUE)``)."""
         X = np.asarray(X)
         if X.ndim != 2 or X.shape[1] != self.n_params:
             raise ValueError(
                 f"predict expects (n, {self.n_params}) design matrix aligned to "
                 f"xnames={list(self.xnames)}; got {X.shape}")
+        if se_fit:
+            return self.predict(X, mesh=mesh), _row_quadform(X, self.vcov())
         if not np.issubdtype(X.dtype, np.floating):
             X = X.astype(np.float64)
         # jnp.asarray canonicalizes per the x64 setting without the
@@ -136,12 +140,26 @@ class LMModel:
     def residuals(self, X, y) -> np.ndarray:
         """Response residuals y - X beta (models do not retain training
         data; pass it back in)."""
-        return np.asarray(y) - self.predict(X)
+        return _squeeze_column(y) - self.predict(X)
 
 
 @jax.jit
 def _predict_jit(X, beta):
     return X @ beta
+
+
+def _row_quadform(X: np.ndarray, V: np.ndarray) -> np.ndarray:
+    """sqrt(x_i' V x_i) per row — the se.fit ingredient shared by LM/GLM."""
+    Xf = X.astype(np.float64)
+    return np.sqrt(np.maximum(np.einsum("np,pq,nq->n", Xf, V, Xf), 0.0))
+
+
+def _squeeze_column(y: np.ndarray) -> np.ndarray:
+    """Accept the (n,1) column shape the fit functions accept."""
+    y = np.asarray(y, np.float64)
+    if y.ndim == 2 and y.shape[1] == 1:
+        return y[:, 0]
+    return y
 
 
 def _detect_intercept(X: np.ndarray, xnames: Sequence[str] | None) -> bool:

@@ -127,6 +127,29 @@ def test_lm_vcov_confint_residuals(mesh8, rng):
     np.testing.assert_allclose(np.sum(r ** 2), m.sse, rtol=1e-6)
 
 
+def test_residuals_column_y_and_grouped_m(mesh1, rng):
+    """(n,1) y must not broadcast to (n,n); grouped-binomial residuals need
+    the m argument to reproduce training stats."""
+    n, p = 150, 3
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    y = (rng.random(n) < 0.5).astype(float)
+    m = sg.glm_fit(X, y.reshape(-1, 1), family="binomial", tol=1e-10,
+                   mesh=mesh1)
+    r = m.residuals(X, y.reshape(-1, 1), type="response")
+    assert r.shape == (n,)
+    ml = sg.lm_fit(X, y.reshape(-1, 1), mesh=mesh1)
+    assert ml.residuals(X, y.reshape(-1, 1)).shape == (n,)
+    # grouped binomial
+    mm = rng.integers(1, 9, size=n).astype(float)
+    counts = rng.binomial(mm.astype(int),
+                          1 / (1 + np.exp(-(X @ [0.2, 0.4, -0.3])))).astype(float)
+    mg = sg.glm_fit(X, counts, family="binomial", m=mm, tol=1e-11, mesh=mesh1)
+    rp = mg.residuals(X, counts, type="pearson", m=mm)
+    np.testing.assert_allclose(np.sum(rp ** 2), mg.pearson_chi2, rtol=1e-5)
+    rd = mg.residuals(X, counts, type="deviance", m=mm)
+    np.testing.assert_allclose(np.sum(rd ** 2), mg.deviance, rtol=1e-5)
+
+
 def test_profiling_timer(mesh1, rng):
     import jax.numpy as jnp
     t = sg.profiling.Timer().start()
