@@ -60,8 +60,12 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
 
 
 def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
+       singular: str = "drop",
        config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
-    """R-style ``lm(formula, data)`` (ref: sparkLM, R/pkg/R/LM.R:24-44)."""
+    """R-style ``lm(formula, data)`` (ref: sparkLM, R/pkg/R/LM.R:24-44).
+
+    Like R, rank-deficient designs drop later aliased columns and report
+    NaN coefficients (``singular="error"`` to raise instead)."""
     f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
                                          dtype=np.dtype(config.dtype),
                                          extra_cols=(weights,))
@@ -71,7 +75,7 @@ def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
         weights = _subset_extra(weights, keep, "weights")
     model = lm_mod.fit(
         X, y, weights=weights, xnames=terms.xnames, yname=f.response,
-        has_intercept=f.intercept, mesh=mesh, config=config)
+        has_intercept=f.intercept, mesh=mesh, singular=singular, config=config)
     import dataclasses
     return dataclasses.replace(model, formula=str(f), terms=terms)
 
@@ -79,7 +83,7 @@ def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
 def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         offset=None, m=None, tol: float = 1e-6, max_iter: int = 100,
         criterion: str = "absolute", na_omit: bool = True, mesh=None,
-        engine: str = "auto", verbose: bool = False,
+        engine: str = "auto", singular: str = "drop", verbose: bool = False,
         config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """R-style ``glm(formula, data, family, link, ...)``.
 
@@ -99,7 +103,7 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         offset=_col_or_array(offset, "offset"), m=_col_or_array(m, "m"), tol=tol,
         max_iter=max_iter, criterion=criterion, xnames=terms.xnames,
         yname=f.response, has_intercept=f.intercept, mesh=mesh,
-        engine=engine, verbose=verbose, config=config)
+        engine=engine, singular=singular, verbose=verbose, config=config)
     import dataclasses
     return dataclasses.replace(model, formula=str(f), terms=terms)
 

@@ -307,6 +307,8 @@ class GLMModel:
     tol: float
     has_intercept: bool
     cov_unscaled: np.ndarray | None = None
+    # True where a column was dropped as linearly dependent (R's NA coefs)
+    aliased: np.ndarray | None = None
     formula: str | None = None
     terms: object | None = None
 
@@ -321,7 +323,8 @@ class GLMModel:
         if X.ndim != 2 or X.shape[1] != self.n_params:
             raise ValueError(
                 f"predict expects (n, {self.n_params}) aligned to xnames; got {X.shape}")
-        eta = X @ self.coefficients
+        # aliased (NaN) coefficients contribute nothing (R reduced basis)
+        eta = X @ np.nan_to_num(self.coefficients)
         if offset is not None:
             eta = eta + np.asarray(offset)
         if type not in ("link", "response"):
@@ -329,8 +332,8 @@ class GLMModel:
         from ..families.links import get_link
         from .lm import _row_quadform
         lnk = get_link(self.link)
-        need_mu = type == "response" or se_fit
-        mu = np.asarray(lnk.inverse(jnp.asarray(eta))) if need_mu else None
+        mu = (np.asarray(lnk.inverse(jnp.asarray(eta)))
+              if type == "response" else None)
         fit = eta if type == "link" else mu
         if not se_fit:
             return fit
@@ -424,6 +427,7 @@ def fit(
     mesh=None,
     shard_features: bool = False,
     engine: str = "auto",
+    singular: str = "error",
     verbose: bool = False,
     config: NumericConfig = DEFAULT,
 ) -> GLMModel:
@@ -448,6 +452,8 @@ def fit(
     if criterion not in ("absolute", "relative"):
         raise ValueError(
             f"criterion must be 'absolute' or 'relative', got {criterion!r}")
+    if singular not in ("error", "drop"):
+        raise ValueError(f"singular must be 'error' or 'drop', got {singular!r}")
     fam, lnk = resolve(family, link)
     X = np.asarray(X)
     y = np.asarray(y)
@@ -528,6 +534,30 @@ def fit(
     wd = meshlib.shard_rows(wt, mesh)      # padding rows get wt=0 -> inert
     od = meshlib.shard_rows(off, mesh)
 
+    if singular == "drop":
+        # proactive rank check on the prior-weights Gramian (one extra data
+        # pass): rank deficiency is a property of X's columns, and an f32
+        # Gramian of exact duplicates can be barely positive-definite,
+        # producing finite garbage the in-loop singular flag misses
+        from ..ops.solve import independent_columns
+        from .lm import expand_aliased
+        acc0 = jnp.float64 if use_f64 else jnp.float32
+        XtWX0 = np.asarray(weighted_gramian(Xd, yd, wd, accum_dtype=acc0)[0],
+                           np.float64)
+        rank_tol = 1e-5 if dtype == np.float32 else 1e-9
+        mask = independent_columns(XtWX0, tol=rank_tol)
+        if not mask.all() and mask.any():
+            # slice back to the unpadded rows; wt/y already carry any m
+            # conversion, so the recursive fit must not re-apply it
+            sub = fit(X[:n, mask], y[:n], family=fam, link=lnk,
+                      weights=wt[:n], offset=off[:n], tol=tol,
+                      max_iter=max_iter, criterion=criterion,
+                      xnames=tuple(np.asarray(xnames)[mask]), yname=yname,
+                      has_intercept=has_intercept, mesh=mesh,
+                      shard_features=shard_features, engine=engine,
+                      singular="error", verbose=verbose, config=config)
+            return expand_aliased(sub, mask, xnames)
+
     has_offset = offset is not None and bool(np.any(off != 0))
     tol_dev = jnp.asarray(tol, jnp.float32 if not use_f64 else jnp.float64)
     if engine == "fused":
@@ -569,7 +599,8 @@ def fit(
         out["null_dev"] = np.asarray(null_out["dev"])
     if bool(out["singular"]):
         raise np.linalg.LinAlgError(
-            "singular weighted Gramian during IRLS; consider jitter in NumericConfig")
+            "singular weighted Gramian during IRLS; pass singular='drop' for "
+            "R-style aliasing or consider jitter in NumericConfig")
 
     dev = float(out["dev"])
     iters = int(out["iters"])

@@ -30,8 +30,36 @@ import numpy as np
 
 from ..config import DEFAULT, NumericConfig
 from ..ops.gramian import weighted_gramian, weighted_moments
-from ..ops.solve import diag_inv_from_cho, inv_from_cho, solve_normal
+from ..ops.solve import (diag_inv_from_cho, independent_columns, inv_from_cho,
+                         solve_normal)
 from ..parallel import mesh as meshlib
+
+
+def expand_aliased(model, mask: np.ndarray, xnames: tuple):
+    """Re-expand a model fit on the independent-column subset back to the
+    full design: aliased positions get NaN coefficients/SEs (R's NA) and
+    NaN covariance rows/columns.  ``predict`` treats NaN coefficients as
+    zero — the aliased term's effect is absorbed by the columns it depends
+    on, exactly as in R's reduced-basis prediction."""
+    p = len(mask)
+
+    def expand_vec(v):
+        out = np.full((p,), np.nan)
+        out[mask] = v
+        return out
+
+    changes = dict(
+        coefficients=expand_vec(model.coefficients),
+        std_errors=expand_vec(model.std_errors),
+        xnames=tuple(xnames),
+        n_params=p,
+        aliased=~mask,
+    )
+    if getattr(model, "cov_unscaled", None) is not None:
+        cov = np.full((p, p), np.nan)
+        cov[np.ix_(mask, mask)] = model.cov_unscaled
+        changes["cov_unscaled"] = cov
+    return dataclasses.replace(model, **changes)
 
 
 @partial(jax.jit, static_argnames=("refine_steps", "compute_cov", "precision"))
@@ -54,8 +82,8 @@ def _lm_kernel(X, y, w, jitter, refine_steps: int = 1, compute_cov: bool = True,
     diag_inv = diag_inv_from_cho(cho, p, XtWX.dtype)
     cov_unscaled = inv_from_cho(cho, p, XtWX.dtype) if compute_cov else jnp.zeros((p, p), XtWX.dtype)
     return dict(beta=beta, diag_inv=diag_inv, cov_unscaled=cov_unscaled,
-                sse=sse, sst_centered=sst_centered, sst_raw=sst_raw,
-                n=n, ybar=ybar)
+                XtWX=XtWX, sse=sse, sst_centered=sst_centered,
+                sst_raw=sst_raw, n=n, ybar=ybar)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +108,8 @@ class LMModel:
     has_intercept: bool
     n_shards: int
     cov_unscaled: np.ndarray | None = None
+    # True where a column was dropped as linearly dependent (R's NA coefs)
+    aliased: np.ndarray | None = None
     # formula front-end metadata (None for array-level fits)
     formula: str | None = None
     terms: object | None = None
@@ -102,7 +132,8 @@ class LMModel:
         # jnp.asarray canonicalizes per the x64 setting without the
         # explicit-dtype truncation warning; beta then matches X's device dtype
         Xj = jnp.asarray(X)
-        beta = jnp.asarray(self.coefficients, dtype=Xj.dtype)
+        # aliased (NaN) coefficients contribute nothing (R reduced basis)
+        beta = jnp.asarray(np.nan_to_num(self.coefficients), dtype=Xj.dtype)
         return np.asarray(_predict_jit(Xj, beta))
 
     def summary(self):
@@ -149,8 +180,14 @@ def _predict_jit(X, beta):
 
 
 def _row_quadform(X: np.ndarray, V: np.ndarray) -> np.ndarray:
-    """sqrt(x_i' V x_i) per row — the se.fit ingredient shared by LM/GLM."""
+    """sqrt(x_i' V x_i) per row — the se.fit ingredient shared by LM/GLM.
+
+    Aliased models carry NaN covariance rows/columns; on the reduced basis
+    the quadform equals the same sum with those rows/columns zeroed, so
+    NaNs are zeroed here (mirroring the NaN-as-zero coefficients in
+    ``predict``)."""
     Xf = X.astype(np.float64)
+    V = np.nan_to_num(V)
     return np.sqrt(np.maximum(np.einsum("np,pq,nq->n", Xf, V, Xf), 0.0))
 
 
@@ -185,13 +222,20 @@ def fit(
     has_intercept: bool | None = None,
     mesh=None,
     shard_features: bool = False,
+    singular: str = "error",
     config: NumericConfig = DEFAULT,
 ) -> LMModel:
     """Fit OLS/WLS by the normal equations on the device mesh.
 
     Mirrors ``LM.fit`` (LM.scala:241-274) including its input validation, with
     one SPMD path instead of the npart dispatch.
+
+    ``singular``: "error" raises on a rank-deficient design; "drop" applies
+    R's aliasing rule — later linearly dependent columns are dropped, their
+    coefficients reported NaN (R's NA).
     """
+    if singular not in ("error", "drop"):
+        raise ValueError(f"singular must be 'error' or 'drop', got {singular!r}")
     X = np.asarray(X)
     y = np.asarray(y)
     if y.ndim == 2:
@@ -231,6 +275,25 @@ def fit(
                      refine_steps=config.refine_steps,
                      precision=config.matmul_precision)
     out = jax.tree.map(np.asarray, out)
+
+    if singular == "drop":
+        # proactive rank check: an f32 Gramian of exactly-duplicated columns
+        # can come out barely positive-definite, yielding finite garbage that
+        # non-finite detection would miss
+        rank_tol = 1e-5 if dtype == np.float32 else 1e-9
+        mask = independent_columns(out["XtWX"].astype(np.float64),
+                                   tol=rank_tol)
+        if not mask.all() and mask.any():
+            sub = fit(X[:, mask], y, weights=weights,
+                      xnames=tuple(np.asarray(xnames)[mask]), yname=yname,
+                      has_intercept=has_intercept, mesh=mesh,
+                      shard_features=shard_features, singular="error",
+                      config=config)
+            return expand_aliased(sub, mask, xnames)
+    if not np.all(np.isfinite(out["beta"])):
+        raise np.linalg.LinAlgError(
+            "singular design in OLS solve; pass singular='drop' for R-style "
+            "aliasing or set NumericConfig(jitter=...)")
 
     n_eff = float(n)  # true observation count (host-side; padding rows carry w=0)
     df_model = p - (1 if has_intercept else 0)

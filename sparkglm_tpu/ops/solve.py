@@ -61,6 +61,29 @@ def diag_inv_from_cho(cho, p: int, dtype):
     return jnp.diag(inv_from_cho(cho, p, dtype))
 
 
+def independent_columns(A, tol: float = 1e-7):
+    """In-order greedy rank detection on a PSD Gramian (host float64).
+
+    Returns a boolean mask of columns forming a full-rank subset, keeping
+    the EARLIER column of any linearly dependent set — R's aliasing rule
+    (``lm``/``glm`` drop later aliased terms and report NA).  O(p^3) host
+    work, used only on the singular-fit recovery path.
+    """
+    import numpy as np
+
+    A = np.array(A, np.float64)
+    p = A.shape[0]
+    scale = np.maximum(np.abs(np.diag(A)), 1e-300)
+    mask = np.zeros(p, bool)
+    for j in range(p):
+        d = A[j, j]
+        if d > tol * scale[j]:
+            mask[j] = True
+            col = A[:, j] / d
+            A = A - np.outer(col, A[j, :])  # Schur complement: eliminate j
+    return mask
+
+
 @partial(jax.jit, static_argnames=("refine_steps",))
 def wls(XtWX, XtWz, jitter=0.0, refine_steps: int = 1):
     """One weighted-least-squares solve returning ``(coefs, diag_inv)`` — the

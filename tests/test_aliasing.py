@@ -1,0 +1,102 @@
+"""Rank-deficient designs: R's aliasing rule (drop later dependent columns,
+NaN coefficients) vs the explicit singular error."""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+
+
+def _collinear(rng, n=600):
+    X = rng.normal(size=(n, 5))
+    X[:, 0] = 1.0
+    X[:, 3] = X[:, 1] + X[:, 2]  # aliased: later column dependent
+    return X
+
+
+def test_lm_singular_error_default(mesh8, rng):
+    X = _collinear(rng)
+    y = X[:, :3] @ [1.0, 0.5, -0.3] + 0.1 * rng.normal(size=len(X))
+    with pytest.raises(np.linalg.LinAlgError, match="singular"):
+        sg.lm_fit(X, y, mesh=mesh8)
+
+
+def test_lm_drop_matches_reduced_fit(mesh8, rng):
+    X = _collinear(rng)
+    n = len(X)
+    y = X[:, :3] @ [1.0, 0.5, -0.3] + 0.1 * rng.normal(size=n)
+    m = sg.lm_fit(X, y, mesh=mesh8, singular="drop")
+    assert np.isnan(m.coefficients[3]) and np.isnan(m.std_errors[3])
+    assert list(m.aliased) == [False, False, False, True, False]
+    keep = [0, 1, 2, 4]
+    m_red = sg.lm_fit(X[:, keep], y, mesh=mesh8)
+    np.testing.assert_allclose(m.coefficients[keep], m_red.coefficients,
+                               rtol=1e-8)
+    np.testing.assert_allclose(m.std_errors[keep], m_red.std_errors, rtol=1e-8)
+    assert m.df_resid == n - 4  # rank, not p
+    # predict ignores the NaN coefficient (reduced-basis semantics)
+    pred = m.predict(X[:5])
+    np.testing.assert_allclose(pred, m_red.predict(X[:5][:, keep]), rtol=1e-6)
+    assert m.n_params == 5 and m.xnames == ("x0", "x1", "x2", "x3", "x4")
+
+
+def test_glm_drop_aliased(mesh8, rng):
+    X = _collinear(rng)
+    n = len(X)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X[:, :3] @ [0.3, 0.5, -0.4])))).astype(float)
+    with pytest.raises(np.linalg.LinAlgError):
+        sg.glm_fit(X, y, family="binomial", mesh=mesh8)
+    m = sg.glm_fit(X, y, family="binomial", mesh=mesh8, singular="drop",
+                   tol=1e-10)
+    assert np.isnan(m.coefficients[3])
+    keep = [0, 1, 2, 4]
+    m_red = sg.glm_fit(X[:, keep], y, family="binomial", mesh=mesh8, tol=1e-10)
+    np.testing.assert_allclose(m.coefficients[keep], m_red.coefficients,
+                               rtol=1e-7)
+    np.testing.assert_allclose(m.deviance, m_red.deviance, rtol=1e-9)
+    assert m.converged
+
+
+def test_formula_api_drops_by_default(mesh8, rng):
+    """Duplicated predictor through the formula front-end: R drops it."""
+    n = 400
+    x = rng.normal(size=n)
+    d = {"y": x * 2 + 0.1 * rng.normal(size=n), "a": x, "b": x}  # b aliased
+    m = sg.lm("y ~ a + b", d, mesh=mesh8)
+    assert np.isnan(m.coefficients[list(m.xnames).index("b")])
+    assert abs(m.coefficients[list(m.xnames).index("a")] - 2.0) < 0.1
+
+
+def test_aliased_model_se_fit_not_nan(mesh8, rng):
+    """se.fit on an aliased model uses the reduced basis, not NaN."""
+    X = _collinear(rng)
+    y = X[:, :3] @ [1.0, 0.5, -0.3] + 0.1 * rng.normal(size=len(X))
+    m = sg.lm_fit(X, y, mesh=mesh8, singular="drop")
+    fit, se = m.predict(X[:7], se_fit=True)
+    assert np.all(np.isfinite(se)) and np.all(se > 0)
+    keep = [0, 1, 2, 4]
+    m_red = sg.lm_fit(X[:, keep], y, mesh=mesh8)
+    _, se_red = m_red.predict(X[:7][:, keep], se_fit=True)
+    np.testing.assert_allclose(se, se_red, rtol=1e-7)
+
+
+def test_glm_drop_float64_derived_collinear(mesh1, rng):
+    """f64 fits must detect a derived collinear column too (f64-accumulated
+    rank check)."""
+    n = 500
+    X = rng.normal(size=(n, 4))
+    X[:, 0] = 1.0
+    X[:, 3] = 2.0 * X[:, 1] - X[:, 2]
+    y = (rng.random(n) < 0.5).astype(float)
+    m = sg.glm_fit(X, y, family="binomial", mesh=mesh1, singular="drop")
+    assert np.isnan(m.coefficients[3])
+    assert np.all(np.isfinite(m.coefficients[:3]))
+
+
+def test_singular_validated(mesh1, rng):
+    X = rng.normal(size=(50, 2))
+    y = rng.normal(size=50)
+    with pytest.raises(ValueError, match="singular"):
+        sg.lm_fit(X, y, mesh=mesh1, singular="maybe")
+    with pytest.raises(ValueError, match="singular"):
+        sg.glm_fit(X, y, family="gaussian", mesh=mesh1, singular="whatever")
