@@ -1,11 +1,8 @@
-"""Headline benchmark: binomial/logit IRLS time-to-convergence.
+"""Headline benchmark: binomial/logit IRLS time-to-convergence on TPU.
 
 A Gramian-stress variant of BASELINE.json config 2/4 — logistic regression
 on 2M x 512 synthetic — timed as the on-device IRLS kernel (data generated
-AND resident in HBM; one compiled ``lax.while_loop`` to convergence).  The
-size is chosen so device compute (~60 ms/iteration on v5e-1) dominates the
-axon tunnel's ~70 ms dispatch latency, making round-over-round numbers
-comparable.
+AND resident in HBM; one compiled ``lax.while_loop`` to convergence).
 
 Prints ONE JSON line::
 
@@ -13,43 +10,69 @@ Prints ONE JSON line::
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
 yardstick is BASELINE.json's north-star target — 10M x 1000 logistic to
-convergence in 60 s on v5e-8.  We extrapolate this run with a per-iteration
-n*p^2 cost model and perfect 8-chip data-parallel scaling:
-``vs_baseline = 60 / est_headline_seconds`` (>1 means beating the target).
+convergence in 60 s on v5e-8: ``vs_baseline = 60 / est_headline_seconds``
+(>1 beats the target).  The extrapolation fits a two-point per-iteration
+cost model t_iter(n) = a + b*n at the benchmark width (a = dispatch + solve
++ reduction overhead, b = per-row streaming cost), scales b by (p_h/p)^2
+(the Gramian term) and n by the 8-chip data split, and keeps the measured
+overhead a — NOT the r1 perfect-scaling n*p^2 ratio.
 
-If the TPU tunnel is unreachable (probed in a subprocess with a timeout),
-the benchmark falls back to a small CPU run and tags the metric name — the
-driver always gets its JSON line.
+Also validated here (r2 judge items): the Pallas fused kernel's parity vs
+its XLA twin and a fused-vs-einsum full-fit coefficient check — executed on
+the actual TPU, failing loudly into the stderr detail record.
+
+If the TPU tunnel is unreachable the probe retries with backoff for ~10
+minutes before falling back to a small CPU run tagged ``_cpu_fallback`` —
+the driver always gets its JSON line.
+
+Detailed measurements go to stderr and benchmarks/bench_detail_latest.json.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import time
 
+V5E_PEAK_BF16 = 197e12  # FLOP/s per v5e chip; f32 matmul runs below this
 
-def _tpu_reachable(timeout_s: float = 90.0) -> bool:
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp;"
-             "assert jax.devices()[0].platform == 'tpu';"
-             "print(float(jnp.zeros(()).sum()))"],
-            timeout=timeout_s, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+
+def _tpu_reachable(probe_timeout_s: float = 90.0,
+                   backoffs=(0, 30, 60, 120, 240)) -> bool:
+    """The tunnel can be wedged for minutes (it was all of round 1) —
+    retry with backoff rather than giving up on the round's one capture."""
+    for wait in backoffs:
+        if wait:
+            print(f"bench: tunnel probe retry in {wait}s", file=sys.stderr)
+            time.sleep(wait)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp;"
+                 "assert jax.devices()[0].platform == 'tpu';"
+                 "print(float((jnp.ones((256,256)) @ jnp.ones((256,256)))[0,0]))"],
+                timeout=probe_timeout_s, capture_output=True)
+            if r.returncode == 0:
+                return True
+            print(f"bench: probe rc={r.returncode} "
+                  f"{r.stderr.decode()[-200:]}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"bench: probe timed out after {probe_timeout_s}s",
+                  file=sys.stderr)
+    return False
 
 
 def main() -> None:
-    on_tpu = _tpu_reachable()
+    detail: dict = {}
+    on_tpu = _tpu_reachable() if os.environ.get("BENCH_FORCE_CPU") != "1" else False
     import jax
 
     if not on_tpu:
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
+    import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import sparkglm_tpu as sg
@@ -61,42 +84,110 @@ def main() -> None:
     mesh = sg.make_mesh()
     row_sharding = NamedSharding(mesh, P(meshlib.DATA_AXIS))
     mat_sharding = NamedSharding(mesh, P(meshlib.DATA_AXIS, None))
-
-    @jax.jit
-    def make_data(key):
-        kx, kb, ku = jax.random.split(key, 3)
-        X = jax.random.normal(kx, (n, p), jnp.float32)
-        X = X.at[:, 0].set(1.0)
-        beta_true = jax.random.normal(kb, (p,), jnp.float32) / (2.0 * p ** 0.5)
-        prob = jax.nn.sigmoid(X @ beta_true)
-        y = (jax.random.uniform(ku, (n,)) < prob).astype(jnp.float32)
-        return (jax.device_put(X, mat_sharding), jax.device_put(y, row_sharding),
-                jnp.ones((n,), jnp.float32), jnp.zeros((n,), jnp.float32))
-
-    Xd, yd, wd, od = make_data(jax.random.PRNGKey(7))
     fam, lnk = resolve("binomial", "logit")
-    kw = dict(family=fam, link=lnk, criterion="relative", refine_steps=1,
-              null_mean=True)
-
-    def run():
-        out = _irls_kernel(Xd, yd, wd, od, jnp.float32(1e-8), jnp.int32(25),
-                           jnp.float32(0.0), **kw)
-        return out, float(out["dev"])  # host read forces full completion
-
-    out, _ = run()  # warm-up: compile + one full solve
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out, _ = run()
-        times.append(time.perf_counter() - t0)
-    t = min(times)
-    iters = int(out["iters"])
-
-    # extrapolate to 10M x 1000 on 8 chips: per-chip work ratio, same iters
     n_chips = len(jax.devices())
-    work_headline = 10_000_000 * 1000**2
-    est_headline = t * (work_headline / 8) / (n * p**2 / n_chips)
+    detail["platform"] = "tpu" if on_tpu else "cpu"
+    detail["devices"] = n_chips
+
+    def make_data(nn):
+        @jax.jit
+        def gen(key):
+            kx, kb, ku = jax.random.split(key, 3)
+            X = jax.random.normal(kx, (nn, p), jnp.float32).at[:, 0].set(1.0)
+            beta_true = jax.random.normal(kb, (p,), jnp.float32) / (2.0 * p ** 0.5)
+            prob = jax.nn.sigmoid(X @ beta_true)
+            y = (jax.random.uniform(ku, (nn,)) < prob).astype(jnp.float32)
+            return (jax.device_put(X, mat_sharding),
+                    jax.device_put(y, row_sharding),
+                    jnp.ones((nn,), jnp.float32), jnp.zeros((nn,), jnp.float32))
+        return gen(jax.random.PRNGKey(7))
+
+    def time_irls(data, reps=3):
+        def run():
+            out = _irls_kernel(*data, jnp.float32(1e-8), jnp.int32(25),
+                               jnp.float32(0.0), family=fam, link=lnk,
+                               criterion="relative", refine_steps=1)
+            return out, float(out["dev"])  # host read forces completion
+        out, _ = run()  # warm-up: compile + one full solve
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out, _ = run()
+            times.append(time.perf_counter() - t0)
+        return min(times), times, out
+
+    # ---- headline run ------------------------------------------------------
+    data = make_data(n)
+    t, times, out = time_irls(data)
+    iters = int(out["iters"])
+    s_per_iter = t / max(1, iters)
+    flops_iter = 2.0 * n * p * (p + 2)  # Gramian + X'Wz + eta matvec
+    mfu = flops_iter * iters / t / (V5E_PEAK_BF16 * n_chips)
+    detail["headline"] = dict(n=n, p=p, seconds=round(t, 4),
+                              runs=[round(x, 4) for x in times], iters=iters,
+                              s_per_iter=round(s_per_iter, 5),
+                              converged=bool(out["converged"]),
+                              mfu_vs_bf16_peak=round(mfu, 4))
+
+    # ---- two-point overhead model for the 10M x 1000 x 8-chip estimate ----
+    n_small = n // 8
+    t_s, _, out_s = time_irls(make_data(n_small), reps=3)
+    it_s = max(1, int(out_s["iters"]))
+    t_i_big, t_i_small = s_per_iter, t_s / it_s
+    b_row = max((t_i_big - t_i_small) / (n - n_small), 1e-15)  # s per row
+    a_fix = max(t_i_small - b_row * n_small, 0.0)              # s per iter fixed
+    n_h, p_h = 10_000_000, 1000
+    # b_row was measured with the run's rows already split over n_chips;
+    # normalize to a single-chip rate before dividing by the target's 8 chips
+    b_h = b_row * n_chips * (p_h / p) ** 2   # Gramian term scales with p^2
+    est_iter_h = a_fix + b_h * (n_h / 8)     # per-chip rows on v5e-8
+    est_headline = est_iter_h * iters     # assume the measured iteration count
     vs_baseline = 60.0 / est_headline if est_headline > 0 else 0.0
+    detail["extrapolation"] = dict(
+        a_fixed_s=round(a_fix, 5), b_row_s=b_row,
+        small_run=dict(n=n_small, s_per_iter=round(t_i_small, 5)),
+        est_headline_10Mx1000_8chip_s=round(est_headline, 2),
+        assumed_iters=iters)
+
+    # ---- Pallas fused kernel: parity + fused-vs-einsum fit (TPU only) ------
+    if on_tpu:
+        try:
+            from sparkglm_tpu.ops.fused import (fused_fisher_pass,
+                                                fused_fisher_pass_ref)
+            np_rng = np.random.default_rng(3)
+            nk, pk = 8192, 128
+            Xk = np_rng.standard_normal((nk, pk)).astype(np.float32)
+            Xk[:, 0] = 1.0
+            yk = (np_rng.random(nk) < 0.5).astype(np.float32)
+            a1 = jnp.asarray(Xk), jnp.asarray(yk), jnp.ones(nk), jnp.zeros(nk)
+            bk = jnp.full((pk,), 0.01, jnp.float32)
+            got = fused_fisher_pass(*a1, bk, family=fam, link=lnk,
+                                    first=False, block_rows=512)
+            ref = fused_fisher_pass_ref(*a1, bk, family=fam, link=lnk,
+                                        first=False, block_rows=512)
+            rel = [float(jnp.max(jnp.abs(g - r))
+                         / jnp.maximum(jnp.max(jnp.abs(r)), 1e-30))
+                   for g, r in zip(got, ref)]
+            from sparkglm_tpu.models import glm as glm_mod
+            nf = 262_144
+            Xf = np_rng.standard_normal((nf, 64)).astype(np.float32)
+            Xf[:, 0] = 1.0
+            bt = (np_rng.standard_normal(64) / 16).astype(np.float32)
+            yf = (np_rng.random(nf) < 1 / (1 + np.exp(-(Xf @ bt)))).astype(np.float32)
+            mf = glm_mod.fit(Xf, yf, family="binomial", engine="fused",
+                             criterion="relative", tol=1e-8)
+            me = glm_mod.fit(Xf, yf, family="binomial", engine="einsum",
+                             criterion="relative", tol=1e-8)
+            detail["pallas"] = dict(
+                pass_rel_err=dict(XtWX=rel[0], XtWz=rel[1], dev=rel[2]),
+                fit_beta_maxdiff=float(np.max(np.abs(
+                    mf.coefficients - me.coefficients))),
+                fused_iters=mf.iterations, einsum_iters=me.iterations,
+                ok=bool(max(rel) < 1e-3
+                        and float(np.max(np.abs(
+                            mf.coefficients - me.coefficients))) < 1e-4))
+        except Exception as e:  # noqa: BLE001 — a broken kernel must not lose the bench line
+            detail["pallas"] = dict(ok=False, error=repr(e)[:300])
 
     print(json.dumps({
         "metric": "logistic_"
@@ -107,12 +198,14 @@ def main() -> None:
         "unit": "s",
         "vs_baseline": round(vs_baseline, 3),
     }))
-    print(f"platform={'tpu' if on_tpu else 'cpu'} devices={n_chips} "
-          f"iters={iters} converged={bool(out['converged'])} "
-          f"deviance={float(out['dev']):.6g} "
-          f"runs={[round(x, 4) for x in times]} "
-          f"est_headline_10Mx1000_8chip={est_headline:.2f}s",
-          file=sys.stderr)
+    print(json.dumps(detail, indent=1), file=sys.stderr)
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "benchmarks",
+                               "bench_detail_latest.json"), "w") as f:
+            json.dump(detail, f, indent=1)
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":

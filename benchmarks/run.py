@@ -122,8 +122,7 @@ def main() -> None:
         def run_irls():
             out = _irls_kernel(X, y, w, o, jnp.float32(1e-8), jnp.int32(25),
                                jnp.float32(0.0), family=fam, link=lnk,
-                               criterion="relative", refine_steps=1,
-                               null_mean=True)
+                               criterion="relative", refine_steps=1)
             float(out["dev"])
             return out
         t, out = timed(run_irls)

@@ -1,0 +1,103 @@
+"""On-TPU validation + engine timing: Pallas fused kernel vs einsum engine.
+
+Run on real TPU hardware (axon tunnel).  Produces JSON on stdout:
+  - pallas_vs_ref: max abs diff of (XtWX, XtWz, dev) Pallas vs XLA twin
+  - fused_vs_einsum_beta: coefficient parity of full fits at f32
+  - timing table per p in {32, 128, 512, 1024}: fused vs einsum s/iter
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.families.families import resolve
+from sparkglm_tpu.models import glm as glm_mod
+from sparkglm_tpu.ops.fused import fused_fisher_pass, fused_fisher_pass_ref
+
+OUT = {}
+
+
+def make_logistic(n, p, seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, p)).astype(np.float32)
+    X[:, 0] = 1.0
+    beta = (rng.standard_normal(p) / (2 * np.sqrt(p))).astype(np.float32)
+    prob = 1.0 / (1.0 + np.exp(-(X @ beta)))
+    y = (rng.random(n) < prob).astype(np.float32)
+    return X, y
+
+
+def main():
+    dev = jax.devices()[0]
+    OUT["platform"] = dev.platform
+    OUT["device"] = str(dev)
+    fam, lnk = resolve("binomial", "logit")
+
+    # ---- 1. Pallas kernel vs XLA twin, raw pass parity ----
+    n, p = 8192, 128
+    X, y = make_logistic(n, p)
+    Xj, yj = jnp.asarray(X), jnp.asarray(y)
+    wt = jnp.ones((n,), jnp.float32)
+    off = jnp.zeros((n,), jnp.float32)
+    beta = jnp.zeros((p,), jnp.float32)
+    for first in (True, False):
+        b = beta if first else jnp.full((p,), 0.01, jnp.float32)
+        a = fused_fisher_pass(Xj, yj, wt, off, b, family=fam, link=lnk,
+                              first=first, block_rows=512)
+        r = fused_fisher_pass_ref(Xj, yj, wt, off, b, family=fam, link=lnk,
+                                  first=first, block_rows=512)
+        diffs = [float(jnp.max(jnp.abs(x - z))) for x, z in zip(a, r)]
+        rel = [d / max(1.0, float(jnp.max(jnp.abs(z))))
+               for d, z in zip(diffs, r)]
+        OUT[f"pallas_vs_ref_first={first}"] = {
+            "abs": [round(d, 8) for d in diffs],
+            "rel": [round(d, 10) for d in rel]}
+
+    # ---- 2. full-fit coefficient parity: fused vs einsum at f32 ----
+    n2, p2 = 262_144, 64
+    X2, y2 = make_logistic(n2, p2, seed=11)
+    m_fused = glm_mod.fit(X2, y2, family="binomial", engine="fused",
+                          criterion="relative", tol=1e-8)
+    m_eins = glm_mod.fit(X2, y2, family="binomial", engine="einsum",
+                         criterion="relative", tol=1e-8)
+    OUT["fused_vs_einsum_beta_maxdiff"] = float(
+        np.max(np.abs(m_fused.coefficients - m_eins.coefficients)))
+    OUT["fused_iters"] = m_fused.iterations
+    OUT["einsum_iters"] = m_eins.iterations
+
+    # ---- 3. engine timing sweep ----
+    timing = {}
+    for p3 in (32, 128, 512, 1024):
+        n3 = max(1 << 21, 1 << 25 >> max(0, (p3.bit_length() - 6)))  # keep work bounded
+        n3 = min(n3, 2 * 1 << 20 if p3 >= 512 else 1 << 22)
+        X3, y3 = make_logistic(n3, p3, seed=p3)
+        row = {}
+        for engine in ("fused", "einsum"):
+            try:
+                t0 = time.perf_counter()
+                m = glm_mod.fit(X3, y3, family="binomial", engine=engine,
+                                criterion="relative", tol=1e-8, max_iter=8)
+                warm = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                m = glm_mod.fit(X3, y3, family="binomial", engine=engine,
+                                criterion="relative", tol=1e-8, max_iter=8)
+                hot = time.perf_counter() - t0
+                row[engine] = {"hot_s": round(hot, 4), "warm_s": round(warm, 4),
+                               "iters": m.iterations,
+                               "s_per_iter": round(hot / max(1, m.iterations), 5)}
+            except Exception as e:  # noqa: BLE001
+                row[engine] = {"error": repr(e)[:200]}
+        timing[f"n={n3},p={p3}"] = row
+        print(f"  timed p={p3}: {row}", file=sys.stderr)
+    OUT["timing"] = timing
+    print(json.dumps(OUT, indent=1))
+
+
+if __name__ == "__main__":
+    main()

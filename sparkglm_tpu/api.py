@@ -105,7 +105,9 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         yname=f.response, has_intercept=f.intercept, mesh=mesh,
         engine=engine, singular=singular, verbose=verbose, config=config)
     import dataclasses
-    return dataclasses.replace(model, formula=str(f), terms=terms)
+    return dataclasses.replace(
+        model, formula=str(f), terms=terms,
+        offset_col=offset if isinstance(offset, str) else None)
 
 
 def predict(model, data, **kwargs) -> np.ndarray:
@@ -118,5 +120,22 @@ def predict(model, data, **kwargs) -> np.ndarray:
         raise ValueError(
             "model was fit from arrays, not a formula; call model.predict(X) "
             "with an aligned design matrix instead")
-    X = transform(as_columns(data), model.terms)
+    cols = as_columns(data)
+    X = transform(cols, model.terms)
+    # a fit-time by-name offset travels with the model (R's predict.glm uses
+    # the stored model-frame offset); an explicit offset kwarg overrides
+    off_col = getattr(model, "offset_col", None)
+    if off_col is not None and "offset" not in kwargs:
+        if off_col not in cols:
+            raise ValueError(
+                f"model was fit with offset column {off_col!r}, which is "
+                "missing from the new data; pass offset= explicitly to override")
+        kwargs["offset"] = np.asarray(cols[off_col], np.float64)
+    elif getattr(model, "has_offset", False) and "offset" not in kwargs:
+        # fit-time offset was an array, so it cannot be recovered from new
+        # data — refuse to silently predict without it
+        raise ValueError(
+            "model was fit with an array offset; pass offset= to predict "
+            "(or fit with the offset as a named column so it travels with "
+            "the model)")
     return model.predict(X, **kwargs)

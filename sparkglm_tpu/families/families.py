@@ -12,14 +12,15 @@ IRLS step):
   * ``variance(mu)`` — V(mu)                 (ref: varianceBinomial GLM.scala:125-129)
   * ``dev_resids(y, mu, wt)`` — per-row deviance contributions
                                               (ref: devBinomial GLM.scala:162-170)
-  * ``loglik_terms(y, mu, wt)`` — per-row exact log-likelihood
-                                              (ref: llBinomial GLM.scala:132-143,
-                                               which builds a Breeze Binomial
-                                               object per row; here a stable
-                                               gammaln form)
   * ``init_mu(y, wt)`` — IRLS starting mean  (ref: ybar*ones GLM.scala:420-424)
   * ``aic(dev, loglik, n, p, wt_sum)``        (ref: createObj GLM.scala:59-88,
                                                aic = -2 ll + 2 p)
+
+Log-likelihoods (ref: llBinomial GLM.scala:132-143) are NOT device code:
+reported statistics are computed in host float64 (models/hoststats.py) from
+the final linear predictor, because TPU f32 transcendentals are too
+approximate for R-parity scalars.  The jnp functions here are what the
+compiled IRLS loop itself needs: variance, deviance (convergence), init.
 
 Conventions follow R's ``glm`` (the reference's stated oracle, SURVEY.md §4):
 for binomial with group sizes m, ``y`` is the *proportion* of successes and
@@ -33,16 +34,21 @@ import dataclasses
 from typing import Callable
 
 import jax.numpy as jnp
-from jax.scipy.special import gammaln
-
 from .links import Link, get_link
 
 _EPS = 1e-10
 
 
-def _xlogy(x, y):
-    """x * log(y) with 0*log(0) = 0."""
-    return jnp.where(x == 0.0, 0.0, x * jnp.log(jnp.maximum(y, _EPS)))
+def _ylogyd(y, mu):
+    """y * log(y/mu) with 0*log(0) = 0, as a SINGLE log of a near-1 ratio.
+
+    Deviance formulas must not expand this into xlogy(y,y) - xlogy(y,mu):
+    those two terms are each O(y*log y) and cancel to O(residual), so the
+    TPU's few-ulp f32 ``log`` error gets amplified ~100x (measured 2.5e-4
+    relative deviance error on the Dobson fixture vs 1e-6 in ratio form)."""
+    return jnp.where(
+        y == 0.0, 0.0,
+        y * jnp.log(jnp.maximum(y, _EPS) / jnp.maximum(mu, _EPS)))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,11 +56,11 @@ class Family:
     name: str
     variance: Callable
     dev_resids: Callable          # (y, mu, wt) -> per-row deviance
-    loglik_terms: Callable        # (y, mu, wt) -> per-row log-likelihood
     init_mu: Callable             # (y, wt) -> mu0 per row
     default_link: str
     dispersion_fixed: bool        # True: dispersion == 1 (binomial, poisson)
-    # aic(dev_total, loglik_total, n_obs, n_params, wt) -> scalar
+    # aic(dev_total, loglik_total, n_obs, n_params, wt) -> scalar; the ll
+    # argument is the exact host-f64 R logLik from models/hoststats.py
     aic: Callable = None  # type: ignore[assignment]
 
     def __post_init__(self):
@@ -68,23 +74,16 @@ class Family:
 # gaussian
 # ----------------------------------------------------------------------------
 
-def _gaussian_ll(y, mu, wt):
-    # matches R: profile out sigma^2 at the MLE — handled at the aggregate
-    # level in glm.py via the gaussian aic; per-row terms carry wt*(y-mu)^2.
-    return -0.5 * wt * (y - mu) ** 2
-
-
 gaussian = Family(
     name="gaussian",
     variance=lambda mu: jnp.ones_like(mu),
     dev_resids=lambda y, mu, wt: wt * (y - mu) ** 2,
-    loglik_terms=_gaussian_ll,
     init_mu=lambda y, wt: y,
     default_link="identity",
     dispersion_fixed=False,
-    # R: aic = n*(log(2*pi*dev/n)+1) + 2  -> plus 2*(p+1) for params+sigma
-    aic=lambda dev, ll, n, p, wt_sum:
-        n * (jnp.log(2.0 * jnp.pi * dev / n) + 1.0) + 2.0 * (p + 1.0),
+    # R: gaussian()$aic + 2*rank = n*(log(2*pi*dev/n)+1) + 2 - sum(log wt)
+    # + 2*p, i.e. -2*logLik + 2*(p+1): the estimated sigma^2 is a parameter
+    aic=lambda dev, ll, n, p, wt_sum: -2.0 * ll + 2.0 * (p + 1.0),
 )
 
 
@@ -93,25 +92,16 @@ gaussian = Family(
 # ----------------------------------------------------------------------------
 
 def _binom_dev(y, mu, wt):
-    # 2*wt*[y log(y/mu) + (1-y) log((1-y)/(1-mu))], with xlogy guards — the
-    # reference guards only via max(y,1) on counts (GLM.scala:167).
-    return 2.0 * wt * (_xlogy(y, y) - _xlogy(y, mu)
-                       + _xlogy(1.0 - y, 1.0 - y) - _xlogy(1.0 - y, 1.0 - mu))
-
-
-def _binom_ll(y, mu, wt):
-    # exact Binomial(m, mu) log-pmf at counts k = wt*y via gammaln
-    # (ref llBinomial builds a distribution object per row, GLM.scala:132-143)
-    k = wt * y
-    comb = gammaln(wt + 1.0) - gammaln(k + 1.0) - gammaln(wt - k + 1.0)
-    return comb + _xlogy(k, mu) + _xlogy(wt - k, 1.0 - mu)
+    # 2*wt*[y log(y/mu) + (1-y) log((1-y)/(1-mu))], each as a single
+    # ratio-log (see _ylogyd) — the reference guards only via max(y,1) on
+    # counts (GLM.scala:167).
+    return 2.0 * wt * (_ylogyd(y, mu) + _ylogyd(1.0 - y, 1.0 - mu))
 
 
 binomial = Family(
     name="binomial",
     variance=lambda mu: mu * (1.0 - mu),
     dev_resids=_binom_dev,
-    loglik_terms=_binom_ll,
     # R's binomial initialize: mustart = (wt*y + 0.5)/(wt + 1)
     init_mu=lambda y, wt: (wt * y + 0.5) / (wt + 1.0),
     default_link="logit",
@@ -124,18 +114,13 @@ binomial = Family(
 # ----------------------------------------------------------------------------
 
 def _pois_dev(y, mu, wt):
-    return 2.0 * wt * (_xlogy(y, y) - _xlogy(y, mu) - (y - mu))
-
-
-def _pois_ll(y, mu, wt):
-    return wt * (_xlogy(y, mu) - mu - gammaln(y + 1.0))
+    return 2.0 * wt * (_ylogyd(y, mu) - (y - mu))
 
 
 poisson = Family(
     name="poisson",
     variance=lambda mu: mu,
     dev_resids=_pois_dev,
-    loglik_terms=_pois_ll,
     init_mu=lambda y, wt: y + 0.1,
     default_link="log",
     dispersion_fixed=True,
@@ -151,21 +136,16 @@ def _gamma_dev(y, mu, wt):
     return -2.0 * wt * (jnp.log(yc / jnp.maximum(mu, _EPS)) - (y - mu) / jnp.maximum(mu, _EPS))
 
 
-def _gamma_ll(y, mu, wt):
-    # Profile form used only for reporting; R's Gamma aic additionally
-    # estimates shape by MLE — we report the moment-based version (documented
-    # deviation; deviance/coefs are unaffected).
-    return wt * (-y / jnp.maximum(mu, _EPS) - jnp.log(jnp.maximum(mu, _EPS)))
-
-
 gamma = Family(
     name="gamma",
     variance=lambda mu: mu * mu,
     dev_resids=_gamma_dev,
-    loglik_terms=_gamma_ll,
     init_mu=lambda y, wt: jnp.maximum(y, _EPS),
     default_link="inverse",
     dispersion_fixed=False,
+    # -2*logLik + 2*(p+1): R's Gamma()$aic "+2" is the dispersion parameter
+    # (exact logLik with R's disp = dev/sum(wt) plug-in: hoststats.loglik)
+    aic=lambda dev, ll, n, p, wt_sum: -2.0 * ll + 2.0 * (p + 1.0),
 )
 
 
@@ -177,10 +157,12 @@ inverse_gaussian = Family(
     name="inverse_gaussian",
     variance=lambda mu: mu ** 3,
     dev_resids=lambda y, mu, wt: wt * (y - mu) ** 2 / (y * mu * mu),
-    loglik_terms=lambda y, mu, wt: -0.5 * wt * (y - mu) ** 2 / (y * mu * mu),
     init_mu=lambda y, wt: jnp.maximum(y, _EPS),
     default_link="inverse_squared",
     dispersion_fixed=False,
+    # R inverse.gaussian()$aic + 2*rank, i.e. -2*logLik + 2*(p+1) with the
+    # exact logLik (incl. the 3*sum(wt*log y) constant) from hoststats
+    aic=lambda dev, ll, n, p, wt_sum: -2.0 * ll + 2.0 * (p + 1.0),
 )
 
 

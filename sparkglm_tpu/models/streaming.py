@@ -48,7 +48,7 @@ from ..families.links import Link
 from ..ops.fused import fused_fisher_pass_ref
 from ..ops.gramian import weighted_gramian
 from ..parallel import mesh as meshlib
-from .glm import GLMModel, _sanitize
+from .glm import GLMModel
 from .lm import LMModel
 
 DEFAULT_CHUNK_ROWS = 262_144
@@ -136,28 +136,16 @@ def _lm_chunk_pass(Xc, yc, wc):
                 sw=jnp.sum(wa), swy=jnp.sum(wa * ya))
 
 
-@partial(jax.jit, static_argnames=("family", "link"))
-def _glm_stats_pass(Xc, yc, wc, oc, beta, *, family: Family, link: Link):
-    valid = wc > 0
-    eta = Xc @ beta + oc
-    mu = jnp.where(valid, link.inverse(eta), 1.0)
-    return dict(
-        dev=jnp.sum(_sanitize(family.dev_resids(yc, mu, wc), valid)),
-        pearson=jnp.sum(_sanitize(
-            wc * (yc - mu) ** 2 / jnp.maximum(family.variance(mu), 1e-30),
-            valid)),
-        loglik=jnp.sum(_sanitize(family.loglik_terms(yc, mu, wc), valid)),
-        wt_sum=jnp.sum(wc), wy=jnp.sum(wc * yc))
-
-
-@partial(jax.jit, static_argnames=("family", "link", "from_offset"))
-def _null_dev_pass(yc, wc, oc, mu_null, *, family: Family, link: Link,
-                   from_offset: bool):
-    """Null-deviance contribution: mu = linkinv(offset) per row for a
-    no-intercept model (R semantics), else the constant weighted mean."""
-    valid = wc > 0
-    mu = link.inverse(oc) if from_offset else jnp.full_like(yc, mu_null)
-    return jnp.sum(_sanitize(family.dev_resids(yc, mu, wc), valid))
+def _host_chunk(yc, wc, oc):
+    """Normalize one chunk's per-row vectors to host float64."""
+    yc = np.asarray(yc, np.float64)
+    nc = yc.shape[0]
+    yc = yc.reshape(nc)
+    wc = (np.ones(nc) if wc is None else
+          np.asarray(wc, np.float64).reshape(nc))
+    oc = (np.zeros(nc) if oc is None else
+          np.asarray(oc, np.float64).reshape(nc))
+    return yc, wc, oc
 
 
 def _solve64(XtWX: np.ndarray, XtWz: np.ndarray, jitter: float):
@@ -260,7 +248,7 @@ def glm_fit_streaming(
     family: str | Family = "binomial",
     link: str | Link | None = None,
     tol: float = 1e-6,
-    max_iter: int = 25,
+    max_iter: int = 100,
     criterion: str = "absolute",
     chunk_rows: int = DEFAULT_CHUNK_ROWS,
     xnames: Sequence[str] | None = None,
@@ -348,28 +336,31 @@ def glm_fit_streaming(
             converged = True
             break
     diag_inv = _diag_inv64(cho)  # once, from the final factorization
+    if not converged and not _null_model:
+        import warnings
+        warnings.warn(
+            f"streaming IRLS did not converge in {iters} iterations "
+            f"(criterion {criterion!r}, tol={tol:g}); estimates may be "
+            "unreliable — raise max_iter or loosen tol", stacklevel=2)
 
-    # final stats pass at the converged beta
+    # ---- final stats pass at the converged beta: HOST float64 -------------
+    # (models/hoststats.py docstring: device-f32 transcendentals are too
+    # approximate for R-parity scalars; the chunks are host data anyway, so
+    # the linear predictor is one numpy dgemm per chunk)
+    from . import hoststats
     stats = None
-    bj = jnp.asarray(beta, dtype)
     for Xc, yc, wc, oc in chunks():
-        dX, dy, dw, do = _put_chunk(Xc, yc, wc, oc, mesh, dtype)
-        d = _glm_stats_pass(dX, dy, dw, do, bj, family=fam, link=lnk)
-        d = {k: float(v) for k, v in d.items()}
+        yc, wc, oc = _host_chunk(yc, wc, oc)
+        eta = np.asarray(Xc, np.float64) @ beta + oc
+        d = hoststats.glm_chunk_stats(fam.name, lnk.name, yc, eta, wc)
         stats = d if stats is None else {k: stats[k] + d[k] for k in stats}
 
     n = n_total
 
-    def _put_vec(v, nc, fill):
-        arr = (np.full((nc,), fill, dtype) if v is None
-               else np.asarray(v, dtype=dtype).reshape(nc))
-        return meshlib.shard_rows(arr, mesh)
-
     # null deviance, matching the resident engine's R semantics
     # (models/glm.py): weighted-mean null for intercept+no-offset; an
     # intercept-only streaming IRLS honouring the offset otherwise; and
-    # mu = linkinv(offset) for no-intercept models.  Only the per-row
-    # vectors are transferred — X never leaves the host here.
+    # mu = linkinv(offset) for no-intercept models.  X never re-enters.
     if _null_model:
         null_dev = np.nan  # the caller only wants .deviance
     elif has_intercept and saw_offset:
@@ -381,37 +372,30 @@ def glm_fit_streaming(
             ones_source, family=fam, link=lnk, tol=tol, max_iter=max_iter,
             criterion=criterion, chunk_rows=chunk_rows, has_intercept=True,
             mesh=mesh, config=config, _null_model=True).deviance
-    elif has_intercept:
-        mu_null = stats["wy"] / stats["wt_sum"]
-        null_dev = 0.0
-        for Xc, yc, wc, oc in chunks():
-            nc = np.asarray(yc).shape[0]
-            null_dev += float(_null_dev_pass(
-                _put_vec(yc, nc, 0.0), _put_vec(wc, nc, 1.0),
-                _put_vec(oc, nc, 0.0), jnp.asarray(mu_null, dtype),
-                family=fam, link=lnk, from_offset=False))
     else:
+        mu_null = stats["wy"] / stats["wt_sum"] if has_intercept else None
         null_dev = 0.0
         for Xc, yc, wc, oc in chunks():
-            nc = np.asarray(yc).shape[0]
-            null_dev += float(_null_dev_pass(
-                _put_vec(yc, nc, 0.0), _put_vec(wc, nc, 1.0),
-                _put_vec(oc, nc, 0.0), jnp.asarray(0.0, dtype),
-                family=fam, link=lnk, from_offset=True))
+            yc, wc, oc = _host_chunk(yc, wc, oc)
+            null_dev += hoststats.null_dev_chunk(fam.name, lnk.name, yc, wc,
+                                                 oc, mu_const=mu_null)
 
-    df_resid = n - p
+    # stats["n"] counts weights > 0 rows — R's n.ok (see hoststats)
+    df_resid = stats["n"] - p
     dispersion = 1.0 if fam.dispersion_fixed else stats["pearson"] / df_resid
     dev_final = stats["dev"]
-    aic = float(fam.aic(dev_final, stats["loglik"], float(n), float(p),
+    ll = hoststats.ll_finalize(fam.name, stats["ll_stat"], dev_final,
+                               stats["wt_sum"], float(stats["n"]))
+    aic = float(fam.aic(dev_final, ll, float(stats["n"]), float(p),
                         stats["wt_sum"]))
     return GLMModel(
         coefficients=beta,
         std_errors=np.sqrt(np.maximum(dispersion * diag_inv, 0.0)),
         xnames=xnames, yname=yname, family=fam.name, link=lnk.name,
         deviance=dev_final, null_deviance=null_dev,
-        pearson_chi2=stats["pearson"], loglik=stats["loglik"], aic=aic,
+        pearson_chi2=stats["pearson"], loglik=ll, aic=aic,
         dispersion=float(dispersion), df_residual=df_resid,
-        df_null=n - (1 if has_intercept else 0), iterations=iters,
+        df_null=stats["n"] - (1 if has_intercept else 0), iterations=iters,
         converged=bool(converged), n_obs=n, n_params=p,
         n_shards=mesh.shape[meshlib.DATA_AXIS], tol=tol,
-        has_intercept=bool(has_intercept))
+        has_intercept=bool(has_intercept), has_offset=bool(saw_offset))

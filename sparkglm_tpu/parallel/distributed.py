@@ -125,6 +125,55 @@ def host_shard_to_global(local_rows: np.ndarray, mesh: Mesh) -> jax.Array:
     return jax.make_array_from_process_local_data(sharding, local_rows)
 
 
+def allsum_f64(values) -> np.ndarray:
+    """Sum a small float64 host vector across processes.
+
+    Transport rides a jax allgather, which truncates to f32 when x64 is
+    off (the TPU default) — so each value travels as an (hi, lo) float32
+    pair and recombines to ~2^-48 relative accuracy.  This is how the
+    host-f64 reported statistics (models/hoststats.py) stay R-exact on a
+    multi-host fit.  Single-process: identity.
+    """
+    v = np.atleast_1d(np.asarray(values, np.float64))
+    if jax.process_count() == 1:
+        return v
+    from jax.experimental import multihost_utils as mh
+    hi = v.astype(np.float32)
+    lo = (v - hi).astype(np.float32)
+    g = np.asarray(mh.process_allgather(np.stack([hi, lo])), np.float64)
+    return np.sum(g[:, 0, :] + g[:, 1, :], axis=0)
+
+
+def sync_max_rows(n_local: int, mesh: Mesh | None = None) -> int:
+    """Agree on a common per-host row count — the max across processes,
+    rounded up so the GLOBAL row count divides evenly over the mesh's data
+    axis (host_shard_to_global requires both equal per-host counts and an
+    even device split).  Pad the difference with zero-weight rows
+    (:func:`pad_host_shard`)."""
+    if jax.process_count() == 1:
+        n = int(n_local)
+    else:
+        from jax.experimental import multihost_utils as mh
+        g = np.asarray(mh.process_allgather(np.asarray([n_local], np.int32)))
+        n = int(g.max())
+    if mesh is not None:
+        d_local = max(1, mesh.shape[meshlib.DATA_AXIS] // jax.process_count())
+        n = ((n + d_local - 1) // d_local) * d_local
+    return n
+
+
+def local_rows_of(global_array: jax.Array) -> np.ndarray:
+    """This process's rows of a row-sharded global array, in global row
+    order (deduplicated when a model axis replicates row shards)."""
+    seen = {}
+    for s in global_array.addressable_shards:
+        idx = s.index[0]
+        start = 0 if idx.start is None else int(idx.start)
+        if start not in seen:
+            seen[start] = np.asarray(s.data)
+    return np.concatenate([seen[k] for k in sorted(seen)], axis=0)
+
+
 def pad_host_shard(local_rows: np.ndarray, target_rows: int,
                    weights: np.ndarray | None = None):
     """Pad this host's shard to ``target_rows`` with zero-weight rows so

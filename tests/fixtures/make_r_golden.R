@@ -1,0 +1,62 @@
+# Regenerate / verify tests/fixtures/r_golden.json with real R.
+#
+# R is not installed in the build image, so the committed JSON was produced
+# by gen_golden.py (an independent float64 IRLS with R's exact family
+# formulas), anchored by the two cases whose outputs are printed in R's own
+# ?glm documentation (dobson_poisson, clotting_gamma_lot1).  Run this script
+# anywhere R exists to confirm every number:
+#
+#   Rscript tests/fixtures/make_r_golden.R
+#
+# and compare the printed values against r_golden.json.
+
+show <- function(name, fit, quasi = FALSE) {
+  s <- summary(fit)
+  cat("== ", name, "\n")
+  cat("coefficients:", format(coef(fit), digits = 10), "\n")
+  cat("std_errors:  ", format(s$coefficients[, 2], digits = 10), "\n")
+  cat("deviance:    ", format(deviance(fit), digits = 10), "\n")
+  cat("null_dev:    ", format(fit$null.deviance, digits = 10), "\n")
+  cat("dispersion:  ", format(s$dispersion, digits = 10), "\n")
+  if (!quasi) {
+    cat("loglik:      ", format(as.numeric(logLik(fit)), digits = 10), "\n")
+    cat("aic:         ", format(AIC(fit), digits = 10), "\n")
+  }
+  cat("df_residual: ", fit$df.residual, " df_null:", fit$df.null, "\n\n")
+}
+
+j <- jsonlite::fromJSON(file.path(dirname(sys.frame(1)$ofile %||% "tests/fixtures"), "r_golden.json"))
+`%||%` <- function(a, b) if (is.null(a)) b else a
+
+# 1. Dobson poisson (?glm)
+counts <- c(18, 17, 15, 20, 10, 20, 25, 13, 12)
+outcome <- gl(3, 1, 9); treatment <- gl(3, 3)
+show("dobson_poisson", glm(counts ~ outcome + treatment, family = poisson()))
+
+# 2. clotting gamma (?glm)
+clotting <- data.frame(u = c(5, 10, 15, 20, 30, 40, 60, 80, 100),
+                       lot1 = c(118, 58, 42, 35, 27, 25, 21, 19, 18),
+                       lot2 = c(69, 35, 26, 21, 18, 16, 13, 12, 9))
+show("clotting_gamma_lot1", glm(lot1 ~ log(u), data = clotting, family = Gamma))
+show("clotting_gamma_lot2", glm(lot2 ~ log(u), data = clotting, family = Gamma))
+
+# 3-8. synthetic cases: data vectors live in r_golden.json$<case>$data
+d <- j$grouped_binomial_logit$data
+show("grouped_binomial_logit",
+     glm(cbind(d$successes, d$m - d$successes) ~ d$x1, family = binomial()))
+
+d <- j$poisson_offset$data
+show("poisson_offset",
+     glm(d$y ~ d$x1 + offset(log(d$exposure)), family = poisson()))
+
+d <- j$quasipoisson$data
+show("quasipoisson", glm(d$y ~ d$x1, family = quasipoisson()), quasi = TRUE)
+
+d <- j$gaussian_weighted$data
+show("gaussian_weighted", glm(d$y ~ d$x1, family = gaussian(), weights = d$w))
+
+d <- j$inverse_gaussian$data
+show("inverse_gaussian", glm(d$y ~ d$x, family = inverse.gaussian()))
+
+d <- j$bernoulli_cloglog$data
+show("bernoulli_cloglog", glm(d$y ~ d$x, family = binomial(link = "cloglog")))

@@ -26,6 +26,8 @@ class L:
                         lambda m: -1 / ((1 - m) * np.log1p(-m))),
             "inverse": (lambda m: 1 / m, lambda e: 1 / e, lambda m: -1 / m**2),
             "sqrt": (np.sqrt, lambda e: e**2, lambda m: 0.5 / np.sqrt(m)),
+            "inverse_squared": (lambda m: 1 / m**2, lambda e: 1 / np.sqrt(e),
+                                lambda m: -2 / m**3),
         }[name]
 
 
@@ -51,6 +53,10 @@ class F:
         if name == "gamma":
             return dict(var=lambda m: m**2,
                         dev=lambda y, m, w: -2 * w * (np.log(np.maximum(y, 1e-300) / m) - (y - m) / m),
+                        init=lambda y, w: np.maximum(y, 1e-10))
+        if name == "inverse_gaussian":
+            return dict(var=lambda m: m**3,
+                        dev=lambda y, m, w: w * (y - m) ** 2 / (y * m * m),
                         init=lambda y, w: np.maximum(y, 1e-10))
         raise KeyError(name)
 
