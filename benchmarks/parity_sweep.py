@@ -1,0 +1,169 @@
+"""f32 coefficient-parity sweep vs the float64 oracle (VERDICT r2 item #3).
+
+Quantifies SURVEY.md §7 hard part #1 — "match R glm() coefficients to 1e-6
+at TPU dtype" — by fitting float32 designs of controlled conditioning
+against tests/oracle.py's independent f64 IRLS and reporting max |Δβ|, with
+``refine_steps`` (iterative refinement of the normal-equations solve) as the
+lever.  Prints a markdown table (pasted into PARITY.md) plus a JSON record.
+
+Run on CPU (x64 available for the oracle) or TPU:
+    python benchmarks/parity_sweep.py [--json PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests"))
+
+
+def conditioned_design(rng, n, p, kappa):
+    """X with singular values log-spaced over [1, 1/kappa] (plus an
+    intercept), so the Gramian's condition number is ~kappa^2."""
+    Z = rng.standard_normal((n, p - 1))
+    # mix columns through a spectrum-shaping matrix: Z V diag(s) V'
+    V, _ = np.linalg.qr(rng.standard_normal((p - 1, p - 1)))
+    s = np.logspace(0, -np.log10(kappa), p - 1)
+    X = np.column_stack([np.ones(n), (Z @ V) * s @ V.T])
+    return X
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import jax
+    if jax.default_backend() == "cpu":
+        jax.config.update("jax_enable_x64", True)  # oracle + f64 control runs
+
+    import sparkglm_tpu as sg
+    from sparkglm_tpu.config import NumericConfig
+    from oracle import irls_np, ols_np
+
+    rng = np.random.default_rng(99)
+    rows = []
+
+    def record(config, family, link, X, y, kappa, refine, extra=""):
+        cfg = NumericConfig(dtype="float32", refine_steps=refine)
+        try:
+            m = sg.glm_fit(X.astype(np.float32), y.astype(np.float32),
+                           family=family, link=link, tol=1e-12,
+                           criterion="relative", max_iter=100, config=cfg)
+        except np.linalg.LinAlgError:
+            # the f32 solver refuses Gramians with kappa^2 beyond f32 range
+            # (ops/solve.py::factor_singular) instead of returning garbage
+            rows.append(dict(config=config, family=family, n=X.shape[0],
+                             p=X.shape[1], kappa=kappa, refine_steps=refine,
+                             max_abs_dbeta=None, max_rel_dbeta=None,
+                             note="refused: singular at f32 (use float64/x64)"))
+            print(f"  {config}: refused (singular at f32)", file=sys.stderr)
+            return
+        beta64, _, _, _ = irls_np(X, y, family if family != "gaussian" else "gaussian",
+                                  link, tol=1e-14)
+        err = float(np.max(np.abs(m.coefficients - beta64)))
+        rel = float(np.max(np.abs(m.coefficients - beta64)
+                           / np.maximum(np.abs(beta64), 1e-3)))
+        rows.append(dict(config=config, family=family, n=X.shape[0],
+                         p=X.shape[1], kappa=kappa, refine_steps=refine,
+                         max_abs_dbeta=err, max_rel_dbeta=rel, note=extra))
+        print(f"  {config}: max|dβ|={err:.3g} rel={rel:.3g}", file=sys.stderr)
+
+    def logistic_y(X, scale=1.0):
+        bt = rng.standard_normal(X.shape[1]) * scale / np.sqrt(X.shape[1])
+        return (rng.random(X.shape[0]) < 1 / (1 + np.exp(-(X @ bt)))).astype(float), bt
+
+    # 1-2: well-conditioned logistic, growing n
+    for n in (50_000, 500_000):
+        X = np.column_stack([np.ones(n), rng.standard_normal((n, 19))])
+        y, _ = logistic_y(X)
+        record(f"logistic_{n//1000}kx20_k1e0", "binomial", "logit", X, y, 1, 1)
+
+    # 3: wide logistic
+    X = np.column_stack([np.ones(20_000), rng.standard_normal((20_000, 199))])
+    y, _ = logistic_y(X)
+    record("logistic_20kx200_k1e0", "binomial", "logit", X, y, 1, 1)
+
+    # 4-7: ill-conditioned designs, refine lever
+    for kappa in (1e3, 1e5):
+        X = conditioned_design(rng, 100_000, 20, kappa)
+        y, _ = logistic_y(X)
+        for refine in (0, 1, 2):
+            record(f"logistic_100kx20_k{kappa:.0e}_r{refine}",
+                   "binomial", "logit", X, y, kappa, refine)
+
+    # 8: poisson
+    X = np.column_stack([np.ones(100_000), rng.standard_normal((100_000, 19))])
+    bt = rng.standard_normal(20) / 10
+    y = rng.poisson(np.exp(np.clip(X @ bt, -4, 4))).astype(float)
+    record("poisson_100kx20_k1e0", "poisson", "log", X, y, 1, 1)
+
+    # 9: gaussian OLS, moderately ill-conditioned
+    X = conditioned_design(rng, 100_000, 20, 1e4)
+    bt = rng.standard_normal(20)
+    y = X @ bt + 0.1 * rng.standard_normal(100_000)
+    cfg = NumericConfig(dtype="float32", refine_steps=1)
+    m = sg.lm_fit(X.astype(np.float32), y.astype(np.float32), config=cfg)
+    beta64 = ols_np(X, y)
+    err = float(np.max(np.abs(m.coefficients - beta64)))
+    rows.append(dict(config="ols_100kx20_k1e4", family="gaussian",
+                     n=100_000, p=20, kappa=1e4, refine_steps=1,
+                     max_abs_dbeta=err,
+                     max_rel_dbeta=float(np.max(np.abs(m.coefficients - beta64)
+                                                / np.maximum(np.abs(beta64), 1e-3))),
+                     note=""))
+    print(f"  ols_100kx20_k1e4: max|dβ|={err:.3g}", file=sys.stderr)
+
+    # 10: streaming lm 1M x 100 (f32 chunks, host-f64 accumulation)
+    n, p = 1_000_000, 100
+    bt = rng.standard_normal(p)
+    chunk = 131_072
+
+    def source():
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
+            r2 = np.random.default_rng(lo)
+            Xc = np.column_stack([np.ones(hi - lo),
+                                  r2.standard_normal((hi - lo, p - 1))]).astype(np.float32)
+            yc = (Xc @ bt + 0.1 * r2.standard_normal(hi - lo)).astype(np.float32)
+            yield Xc, yc, None, None
+
+    ms = sg.lm_fit_streaming(source, chunk_rows=chunk)
+    Xfull = np.concatenate([c[0] for c in source()]).astype(np.float64)
+    yfull = np.concatenate([c[1] for c in source()]).astype(np.float64)
+    beta64 = ols_np(Xfull, yfull)
+    err = float(np.max(np.abs(ms.coefficients - beta64)))
+    rows.append(dict(config="ols_streaming_1Mx100", family="gaussian",
+                     n=n, p=p, kappa=1, refine_steps=1, max_abs_dbeta=err,
+                     max_rel_dbeta=float(np.max(
+                         np.abs(ms.coefficients - beta64)
+                         / np.maximum(np.abs(beta64), 1e-3))),
+                     note="f32 chunks, host-f64 accumulation"))
+    print(f"  ols_streaming_1Mx100: max|dβ|={err:.3g}", file=sys.stderr)
+
+    import jax
+    print("\n| config | n | p | κ(X) | refine | max \\|Δβ\\| | max rel Δβ |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        if r["max_abs_dbeta"] is None:
+            err_s = rel_s = "refused (singular at f32)"
+        else:
+            err_s = f"{r['max_abs_dbeta']:.2e}"
+            rel_s = f"{r['max_rel_dbeta']:.2e}"
+        print(f"| {r['config']} | {r['n']:,} | {r['p']} | {r['kappa']:.0e} "
+              f"| {r['refine_steps']} | {err_s} | {rel_s} |")
+    out = dict(platform=jax.default_backend(), rows=rows)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -131,33 +131,47 @@ def main() -> None:
         del X, y
 
     # ---- 5. Gamma + prior weights + offset, streamed -----------------------
-    # full config is 50M x 500 (~100 GB); chunked generator, scaled rows
+    # full config is 50M x 500 (~100 GB, beyond any single host's run
+    # budget here); measure a 2M-row slice of the identical pipeline and
+    # report rows/s — wall-clock for the full 50M is linear in rows.
+    # Chunks are pre-generated and held in host RAM (2M x 500 f32 = 4 GB)
+    # so the measurement is the streaming pipeline (H2D + device compute +
+    # host-f64 stats), not numpy's RNG throughput.
     p5 = 500
     chunk = 1_048_576 // 4
-    n5 = rows(8_000_000)
+    n5 = rows(2_000_000)
     n_chunks = max(1, n5 // chunk)
     bt5 = np.linspace(-0.2, 0.2, p5); bt5[0] = 1.5  # keep eta > 0 for inverse link
 
+    cached = []
+    for i in range(n_chunks):
+        r = np.random.default_rng(1000 + i)
+        Xc = r.standard_normal((chunk, p5)).astype(np.float32) * 0.02
+        Xc[:, 0] = 1.0
+        eta = Xc @ bt5 + 0.05
+        mu = 1.0 / np.maximum(eta, 0.1)
+        yc = r.gamma(2.0, mu / 2.0).astype(np.float32) + 1e-3
+        wc = r.uniform(0.5, 2.0, chunk).astype(np.float32)
+        oc = np.full(chunk, 0.05, np.float32)
+        cached.append((Xc, yc, wc, oc))
+
     def source():
-        for i in range(n_chunks):
-            r = np.random.default_rng(1000 + i)
-            Xc = r.standard_normal((chunk, p5), dtype=np.float32) * 0.02
-            Xc[:, 0] = 1.0
-            eta = Xc @ bt5 + 0.05
-            mu = 1.0 / np.maximum(eta, 0.1)
-            yc = r.gamma(2.0, mu / 2.0).astype(np.float32) + 1e-3
-            wc = r.uniform(0.5, 2.0, chunk).astype(np.float32)
-            oc = np.full(chunk, 0.05, np.float32)
-            yield Xc, yc, wc, oc
+        yield from cached
 
     t0 = time.perf_counter()
     m = sg.glm_fit_streaming(source, family="gamma", link="inverse",
                              tol=1e-8, criterion="relative", max_iter=25,
                              chunk_rows=chunk, mesh=mesh)
     t5 = time.perf_counter() - t0
-    emit({"config": f"gamma_weights_offset_streamed_{n_chunks * chunk}x{p5}",
+    n5_real = n_chunks * chunk
+    # wall-clock includes the intercept-only null-model streaming IRLS the
+    # offset triggers (R semantics), so per-pass throughput is not derivable
+    # here; the 50M estimate is valid because every component is linear in
+    # rows
+    emit({"config": f"gamma_weights_offset_streamed_{n5_real}x{p5}",
           "seconds": round(t5, 2), "iters": m.iterations,
-          "converged": bool(m.converged)})
+          "converged": bool(m.converged),
+          "est_50Mx500_s": round(t5 * 50_000_000 / n5_real, 1)})
 
     if args.json:
         with open(args.json, "w") as f:

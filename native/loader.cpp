@@ -87,10 +87,26 @@ bool parse_double(const char* b, size_t len, double* out) {
   return true;
 }
 
-void clean_field(const char*& b, size_t& len) {
+// Trim -> unquote -> collapse RFC-4180 escaped quotes ("" -> ").  The
+// Python fallback's _clean_field mirrors these steps exactly; a quoted CSV
+// must parse identically whether or not the .so builds.  scratch backs the
+// (rare) collapsed copy until the next call.
+void clean_field(const char*& b, size_t& len, std::string& scratch) {
   while (len && (*b == ' ' || *b == '\t' || *b == '\r')) { ++b; --len; }
   while (len && (b[len - 1] == ' ' || b[len - 1] == '\t' || b[len - 1] == '\r')) --len;
-  if (len >= 2 && b[0] == '"' && b[len - 1] == '"') { ++b; len -= 2; }
+  if (len >= 2 && b[0] == '"' && b[len - 1] == '"') {
+    ++b;
+    len -= 2;
+    if (std::memchr(b, '"', len)) {
+      scratch.clear();
+      for (size_t i = 0; i < len; ++i) {
+        scratch.push_back(b[i]);
+        if (b[i] == '"' && i + 1 < len && b[i + 1] == '"') ++i;
+      }
+      b = scratch.data();
+      len = scratch.size();
+    }
+  }
 }
 
 // Stream [begin, end_pos) of f in chunks, calling on_line(ptr, len) for each
@@ -140,6 +156,7 @@ bool for_each_field(const char* lb, size_t llen, size_t ncol, F&& on_field) {
   const char* b = lb;
   const char* lend = lb + llen;
   size_t col = 0;
+  std::string scratch;
   while (col < ncol) {
     const char* q = b;
     bool in_quote = false;
@@ -149,7 +166,7 @@ bool for_each_field(const char* lb, size_t llen, size_t ncol, F&& on_field) {
     }
     const char* fb = b;
     size_t len = static_cast<size_t>(q - b);
-    clean_field(fb, len);
+    clean_field(fb, len, scratch);
     on_field(col, fb, len);
     ++col;
     if (q >= lend) break;
@@ -193,6 +210,7 @@ SgioTable* sgio_read_csv(const char* path, int64_t shard_index,
   {
     const char* b = header.data();
     const char* hend = b + header.size();
+    std::string scratch;
     while (true) {
       const char* q = b;
       bool in_quote = false;
@@ -202,7 +220,7 @@ SgioTable* sgio_read_csv(const char* path, int64_t shard_index,
       }
       const char* fb = b;
       size_t len = static_cast<size_t>(q - b);
-      clean_field(fb, len);
+      clean_field(fb, len, scratch);
       Column c;
       c.name.assign(fb, len);
       t->cols.push_back(std::move(c));

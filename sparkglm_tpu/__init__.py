@@ -18,7 +18,8 @@ from .api import glm, lm, predict
 from .config import DEFAULT, NumericConfig
 from .data.formula import Formula, parse_formula
 from .data.frame import as_columns, omit_na
-from .data.io import native_available, read_csv, scan_csv_schema
+from .data.io import (native_available, read_csv, scan_csv_levels,
+                      scan_csv_schema)
 from .data.model_matrix import Terms, build_terms, model_matrix, transform
 from .families.families import FAMILIES, Family, get_family
 from .families.links import LINKS, Link, get_link
@@ -41,6 +42,7 @@ __all__ = [
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
     "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",
     "transform", "as_columns", "omit_na", "read_csv", "scan_csv_schema",
+    "scan_csv_levels",
     "native_available",
     "make_mesh", "shard_rows", "single_device_mesh", "distributed",
     "profiling",

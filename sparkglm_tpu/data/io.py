@@ -24,7 +24,6 @@ is the end-to-end path.
 
 from __future__ import annotations
 
-import csv
 import ctypes
 import os
 import subprocess
@@ -143,6 +142,40 @@ def scan_csv_schema(path: str, *, native: bool | None = None) -> dict[str, int]:
         lib.sgio_free(h)
 
 
+def scan_csv_levels(path: str, *, native: bool | None = None
+                    ) -> dict[str, list[str]]:
+    """One GLOBAL pass returning the full sorted level list of every
+    categorical column.
+
+    Multi-host fits must pass this to ``build_terms(levels=...)`` on every
+    host: a shard missing (or adding) a factor level would otherwise
+    dummy-code a design with different columns than its peers, silently
+    misaligning the global Gramian (ADVICE r1).  Missing values do not
+    become levels.
+    """
+    lib = _load() if native in (None, True) else None
+    if native is True and lib is None:
+        raise RuntimeError(f"native loader unavailable: {_lib_error}")
+    if lib is None:
+        cols = _read_csv_py(path, 0, 1, None)
+        return {k: sorted({str(x) for x in v if x is not None})
+                for k, v in cols.items() if v.dtype == object}
+    h = lib.sgio_read_csv(path.encode(), 0, 1, None, 0, 0)
+    try:
+        err = lib.sgio_error(h)
+        if err:
+            raise OSError(err.decode())
+        out: dict[str, list[str]] = {}
+        for i in range(lib.sgio_n_cols(h)):
+            if lib.sgio_col_kind(h, i) == CATEGORICAL:
+                out[lib.sgio_col_name(h, i).decode()] = sorted(
+                    lib.sgio_col_level(h, i, j).decode()
+                    for j in range(lib.sgio_col_n_levels(h, i)))
+        return out
+    finally:
+        lib.sgio_free(h)
+
+
 def read_csv(path: str, *, shard_index: int = 0, num_shards: int = 1,
              schema: dict[str, int] | None = None,
              native: bool | None = None) -> dict[str, np.ndarray]:
@@ -168,8 +201,7 @@ def read_csv(path: str, *, shard_index: int = 0, num_shards: int = 1,
     if schema is not None:
         with open(path, "rb") as fh:
             header = fh.readline().decode()
-        names = next(csv.reader([header]))
-        kinds = _kinds_array(schema, [s.strip() for s in names])
+        kinds = _kinds_array(schema, _split_line(header.rstrip("\n")))
         kinds_ptr = kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
         n_kinds = len(kinds)
 
@@ -221,6 +253,39 @@ def _parse_float(v: str):
         return None
 
 
+def _clean_field(s: str) -> str:
+    """Trim -> unquote -> collapse "" -> " — step-for-step the native
+    loader's clean_field, so the same file parses (and types columns)
+    identically whether or not the .so builds (ADVICE r1)."""
+    s = s.strip(" \t\r")
+    if len(s) >= 2 and s[0] == '"' and s[-1] == '"':
+        s = s[1:-1]
+        if '""' in s:
+            s = s.replace('""', '"')
+    return s
+
+
+def _split_line(line: str, ncol: int | None = None) -> list[str]:
+    """Field splitter mirroring the native for_each_field: commas inside
+    double quotes do not split; short rows pad with missing fields."""
+    fields: list[str] = []
+    b, n = 0, len(line)
+    while ncol is None or len(fields) < ncol:
+        q = b
+        in_quote = False
+        while q < n and (in_quote or line[q] != ","):
+            if line[q] == '"':
+                in_quote = not in_quote
+            q += 1
+        fields.append(_clean_field(line[b:q]))
+        if q >= n:
+            break
+        b = q + 1
+    if ncol is not None:
+        fields.extend([""] * (ncol - len(fields)))
+    return fields
+
+
 def _read_csv_py(path: str, shard_index: int, num_shards: int,
                  schema: dict[str, int] | None) -> dict[str, np.ndarray]:
     """Pure-Python fallback with identical semantics (incl. byte sharding)."""
@@ -245,14 +310,13 @@ def _read_csv_py(path: str, shard_index: int, num_shards: int,
         f.seek(begin)
         blob = f.read(end - begin).decode()
 
-    names = [s.strip() for s in next(csv.reader([header]))]
+    names = _split_line(header.rstrip("\n"))
     # drop only truly blank lines; a ',,' line is a row of missing values,
     # exactly as the native loader counts it
-    rows = [r for r in csv.reader(blob.splitlines())
-            if r and not (len(r) == 1 and not r[0].strip())]
     ncol = len(names)
-    cols = [[r[j].strip() if j < len(r) else "" for r in rows]
-            for j in range(ncol)]
+    rows = [_split_line(ln, ncol) for ln in blob.split("\n")
+            if ln not in ("", "\r")]
+    cols = [[r[j] for r in rows] for j in range(ncol)]
     out: dict[str, np.ndarray] = {}
     for name, vals in zip(names, cols):
         forced = None if schema is None else schema.get(name)

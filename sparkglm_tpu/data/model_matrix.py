@@ -57,6 +57,14 @@ class Terms:
             xnames=tuple(d["xnames"]),
         )
 
+    def signature(self) -> str:
+        """Stable content hash — multi-host fits compare it across processes
+        to catch shards that built divergent designs (ADVICE r1)."""
+        import hashlib
+        import json
+        return hashlib.sha256(
+            json.dumps(self.to_dict(), sort_keys=True).encode()).hexdigest()
+
 
 def _levels_of(col: np.ndarray) -> list:
     # sorted distinct, drop first (k-1 coding) — modelMatrix.scala:56-58
@@ -64,23 +72,36 @@ def _levels_of(col: np.ndarray) -> list:
     return lv[1:]
 
 
-def build_terms(data, columns=None, *, intercept: bool = False) -> Terms:
-    """Learn the design recipe (levels, names) from training data."""
+def build_terms(data, columns=None, *, intercept: bool = False,
+                levels=None) -> Terms:
+    """Learn the design recipe (levels, names) from training data.
+
+    ``levels`` optionally overrides level discovery with externally known
+    FULL sorted level lists per categorical column (the first is dropped
+    here, k-1 coding).  This is required on multi-host fits: each host sees
+    only its shard, and a shard missing a factor level would otherwise
+    build a design with different columns (use ``io.scan_csv_levels`` for
+    the one global pass; ADVICE r1).
+    """
     cols = as_columns(data)
     names = list(columns) if columns is not None else list(cols)
-    levels: dict[str, tuple] = {}
+    lv_out: dict[str, tuple] = {}
     xnames: list[str] = [INTERCEPT_NAME] if intercept else []
     for nm in names:
         if nm not in cols:
             raise KeyError(f"column {nm!r} not in data ({list(cols)})")
         c = cols[nm]
-        if is_categorical(c):
+        if levels is not None and nm in levels:
+            kept = tuple(str(v) for v in sorted(levels[nm]))[1:]
+            lv_out[nm] = kept
+            xnames.extend(f"{nm}_{lv}" for lv in kept)
+        elif is_categorical(c):
             kept = tuple(_levels_of(c))
-            levels[nm] = kept
+            lv_out[nm] = kept
             xnames.extend(f"{nm}_{lv}" for lv in kept)
         else:
             xnames.append(nm)
-    return Terms(columns=tuple(names), levels=levels, intercept=intercept,
+    return Terms(columns=tuple(names), levels=lv_out, intercept=intercept,
                  xnames=tuple(xnames))
 
 

@@ -43,7 +43,7 @@ from ..families.families import Family, resolve
 from ..families.links import Link
 from ..ops.fused import fused_fisher_pass, fused_fisher_pass_ref
 from ..ops.gramian import weighted_gramian
-from ..ops.solve import inv_from_cho, solve_normal
+from ..ops.solve import factor_singular, inv_from_cho, solve_normal
 from ..parallel import mesh as meshlib
 
 _BIG = jnp.inf
@@ -93,6 +93,9 @@ def _irls_kernel(
         ddev=jnp.asarray(_BIG, acc),
         cov_inv=jnp.zeros((p, p), acc),
         singular=jnp.zeros((), jnp.bool_),
+        # first iteration's Gramian, kept for the singular='drop' host rank
+        # check — saves the dedicated pre-pass over the data (ADVICE r1)
+        XtWX0=jnp.zeros((p, p), acc),
     )
 
     def not_converged(s):
@@ -110,7 +113,7 @@ def _irls_kernel(
         XtWX, XtWz = weighted_gramian(X, z, w, accum_dtype=acc,
                                       precision=precision)
         beta, cho = solve_normal(XtWX, XtWz, jitter=jitter, refine_steps=refine_steps)
-        singular = ~jnp.all(jnp.isfinite(beta))
+        singular = ~jnp.all(jnp.isfinite(beta)) | factor_singular(cho)
         beta = jnp.where(singular, s["beta"], beta)
         eta_new = (X @ beta + offset).astype(X.dtype)      # ref: etaCreate :321-332
         mu_new = jnp.where(valid, link.inverse(eta_new), 1.0).astype(X.dtype)  # ref: muCreate :334-355
@@ -129,6 +132,7 @@ def _irls_kernel(
             ddev=jnp.abs(dev_new - s["dev"]),
             cov_inv=inv_from_cho(cho, p, acc),
             singular=singular,
+            XtWX0=jnp.where(s["it"] == 0, XtWX.astype(acc), s["XtWX0"]),
         )
 
     s = jax.lax.while_loop(not_converged, body, state0)
@@ -144,7 +148,7 @@ def _irls_kernel(
 
     return dict(beta=s["beta"], cov_inv=s["cov_inv"], dev=s["dev"],
                 eta=s["eta"], iters=s["it"], converged=converged,
-                singular=s["singular"])
+                singular=s["singular"], XtWX0=s["XtWX0"])
 
 
 def _fused_block_rows(p: int) -> int:
@@ -198,7 +202,7 @@ def _irls_fused_kernel(
     def solve(XtWX, XtWz, beta_prev):
         beta, cho = solve_normal(XtWX, XtWz, jitter=jitter,
                                  refine_steps=refine_steps)
-        singular = ~jnp.all(jnp.isfinite(beta))
+        singular = ~jnp.all(jnp.isfinite(beta)) | factor_singular(cho)
         beta = jnp.where(singular, beta_prev, beta)
         return beta, inv_from_cho(cho, p, acc), singular
 
@@ -251,7 +255,7 @@ def _irls_fused_kernel(
 
     return dict(beta=beta_f, cov_inv=s["cov_inv"], dev=s["dev"],
                 eta=eta, iters=s["it"], converged=converged,
-                singular=s["singular"])
+                singular=s["singular"], XtWX0=XtWX0.astype(acc))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -410,7 +414,9 @@ def _finalize_model(
             f"{criterion!r}, tol={tol:g}); estimates may be unreliable — "
             "raise max_iter or loosen tol", stacklevel=3)
     df_resid = n_ok - p
-    dispersion = 1.0 if fam.dispersion_fixed else pearson / df_resid
+    # R reports NaN dispersion on a saturated fit (df 0), not a crash
+    dispersion = (1.0 if fam.dispersion_fixed
+                  else (pearson / df_resid if df_resid > 0 else float("nan")))
     cov_inv = np.asarray(cov_inv, np.float64)
     std_err = np.sqrt(np.maximum(dispersion * np.diag(cov_inv), 0.0))
     aic = float(fam.aic(dev, loglik, float(n_ok), float(p), wt_sum))
@@ -456,17 +462,20 @@ def _fit_global(
     wd = jax.jit(jnp.ones_like)(y) if weights is None else weights
     od = jax.jit(jnp.zeros_like)(y) if offset is None else offset
 
-    X_loc = np.asarray(dist.local_rows_of(X), np.float64)
     wt_pre = np.asarray(dist.local_rows_of(wd), np.float64)
     off_pre = np.asarray(dist.local_rows_of(od), np.float64)
     valid_pre = wt_pre > 0
     if has_intercept is None:
         # the resident path's _detect_intercept, distributed: a column is an
-        # intercept iff NO process sees a non-1.0 entry on a weighted row
+        # intercept iff NO process sees a non-1.0 entry on a weighted row.
+        # Only THIS branch pulls the local design shard to the host — pass
+        # has_intercept explicitly to keep the fit free of X host copies.
+        X_loc = np.asarray(dist.local_rows_of(X), np.float64)
         viol = np.array([np.sum(valid_pre & (X_loc[:, j] != 1.0))
                          for j in range(p)], np.float64)
         has_intercept = bool((dist.allsum_f64(viol) == 0).any()) or any(
             nm.lower() in ("intercept", "(intercept)") for nm in xnames)
+        del X_loc
     has_offset = offset is not None and bool(
         dist.allsum_f64([float(np.any(off_pre != 0.0))])[0] > 0)
 
@@ -681,30 +690,6 @@ def fit(
     wd = meshlib.shard_rows(wt, mesh)      # padding rows get wt=0 -> inert
     od = meshlib.shard_rows(off, mesh)
 
-    if singular == "drop":
-        # proactive rank check on the prior-weights Gramian (one extra data
-        # pass): rank deficiency is a property of X's columns, and an f32
-        # Gramian of exact duplicates can be barely positive-definite,
-        # producing finite garbage the in-loop singular flag misses
-        from ..ops.solve import independent_columns
-        from .lm import expand_aliased
-        acc0 = jnp.float64 if use_f64 else jnp.float32
-        XtWX0 = np.asarray(weighted_gramian(Xd, yd, wd, accum_dtype=acc0)[0],
-                           np.float64)
-        rank_tol = 1e-5 if dtype == np.float32 else 1e-9
-        mask = independent_columns(XtWX0, tol=rank_tol)
-        if not mask.all() and mask.any():
-            # slice back to the unpadded rows; wt64/y64 already carry any m
-            # conversion, so the recursive fit must not re-apply it
-            sub = fit(X[:n, mask], y64, family=fam, link=lnk,
-                      weights=wt64, offset=off64, tol=tol,
-                      max_iter=max_iter, criterion=criterion,
-                      xnames=tuple(np.asarray(xnames)[mask]), yname=yname,
-                      has_intercept=has_intercept, mesh=mesh,
-                      shard_features=shard_features, engine=engine,
-                      singular="error", verbose=verbose, config=config)
-            return expand_aliased(sub, mask, xnames)
-
     has_offset = offset is not None and bool(np.any(off64 != 0))
     tol_dev = jnp.asarray(tol, jnp.float32 if not use_f64 else jnp.float64)
     if engine == "fused":
@@ -730,6 +715,28 @@ def fit(
             precision=config.matmul_precision,
         )
     out = jax.tree.map(np.asarray, out)
+    if singular == "drop":
+        # host rank check on the FIRST iteration's Gramian, captured by the
+        # kernel — no dedicated pre-pass over the data (ADVICE r1).  The
+        # check is unconditional because an f32 Gramian of exact duplicates
+        # can be barely positive-definite, producing finite garbage the
+        # in-loop singular flag misses.
+        from ..ops.solve import independent_columns
+        from .lm import expand_aliased
+        rank_tol = 1e-5 if dtype == np.float32 else 1e-9
+        mask = independent_columns(np.asarray(out["XtWX0"], np.float64),
+                                   tol=rank_tol)
+        if not mask.all() and mask.any():
+            # slice back to the unpadded rows; wt64/y64 already carry any m
+            # conversion, so the recursive fit must not re-apply it
+            sub = fit(X[:n, mask], y64, family=fam, link=lnk,
+                      weights=wt64, offset=off64, tol=tol,
+                      max_iter=max_iter, criterion=criterion,
+                      xnames=tuple(np.asarray(xnames)[mask]), yname=yname,
+                      has_intercept=has_intercept, mesh=mesh,
+                      shard_features=shard_features, engine=engine,
+                      singular="error", verbose=verbose, config=config)
+            return expand_aliased(sub, mask, xnames)
     if bool(out["singular"]):
         raise np.linalg.LinAlgError(
             "singular weighted Gramian during IRLS; pass singular='drop' for "

@@ -30,8 +30,8 @@ import numpy as np
 
 from ..config import DEFAULT, NumericConfig
 from ..ops.gramian import weighted_gramian, weighted_moments
-from ..ops.solve import (diag_inv_from_cho, independent_columns, inv_from_cho,
-                         solve_normal)
+from ..ops.solve import (diag_inv_from_cho, factor_singular,
+                         independent_columns, inv_from_cho, solve_normal)
 from ..parallel import mesh as meshlib
 
 
@@ -81,9 +81,10 @@ def _lm_kernel(X, y, w, jitter, refine_steps: int = 1, compute_cov: bool = True,
     p = X.shape[1]
     diag_inv = diag_inv_from_cho(cho, p, XtWX.dtype)
     cov_unscaled = inv_from_cho(cho, p, XtWX.dtype) if compute_cov else jnp.zeros((p, p), XtWX.dtype)
+    singular = ~jnp.all(jnp.isfinite(beta)) | factor_singular(cho)
     return dict(beta=beta, diag_inv=diag_inv, cov_unscaled=cov_unscaled,
                 XtWX=XtWX, sse=sse, sst_centered=sst_centered,
-                sst_raw=sst_raw, n=n, ybar=ybar)
+                sst_raw=sst_raw, n=n, ybar=ybar, singular=singular)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -290,19 +291,20 @@ def fit(
                       shard_features=shard_features, singular="error",
                       config=config)
             return expand_aliased(sub, mask, xnames)
-    if not np.all(np.isfinite(out["beta"])):
+    if bool(out["singular"]) or not np.all(np.isfinite(out["beta"])):
         raise np.linalg.LinAlgError(
             "singular design in OLS solve; pass singular='drop' for R-style "
             "aliasing or set NumericConfig(jitter=...)")
 
-    n_eff = float(n)  # true observation count (host-side; padding rows carry w=0)
+    # R's lm drops zero-weight rows from df (summary.lm's n is sum(w != 0))
+    n_ok = int(np.sum(w_host > 0))
     df_model = p - (1 if has_intercept else 0)
-    df_resid = n - p
+    df_resid = n_ok - p
     sse = float(out["sse"])
     sst = float(out["sst_centered"] if has_intercept else out["sst_raw"])
     sigma2 = sse / df_resid if df_resid > 0 else np.nan
     r2 = 1.0 - sse / sst if sst > 0 else np.nan
-    adj_r2 = 1.0 - (1.0 - r2) * (n_eff - (1 if has_intercept else 0)) / df_resid if df_resid > 0 else np.nan
+    adj_r2 = 1.0 - (1.0 - r2) * (n_ok - (1 if has_intercept else 0)) / df_resid if df_resid > 0 else np.nan
     f_stat = ((sst - sse) / df_model) / sigma2 if df_model > 0 and sigma2 > 0 else np.nan
     std_err = np.sqrt(np.maximum(sigma2 * out["diag_inv"], 0.0))
 
@@ -311,7 +313,7 @@ def fit(
         std_errors=std_err.astype(np.float64),
         xnames=xnames,
         yname=yname,
-        n_obs=int(round(n_eff)),
+        n_obs=n,
         n_params=p,
         df_model=df_model,
         df_resid=df_resid,

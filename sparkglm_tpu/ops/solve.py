@@ -31,34 +31,68 @@ from jax.scipy.linalg import cho_factor, cho_solve
 
 
 def _prepare(XtWX, jitter):
+    """Symmetrise, Jacobi-equilibrate, and jitter the Gramian.
+
+    Equilibration (van der Sluis): with D = diag(A)^(-1/2), the scaled
+    system D A D has unit diagonal and the condition number of the
+    CORRELATION matrix — scale heterogeneity across predictors (age vs
+    income vs dummies) stops eating float32 solve precision.  Exactly
+    reversible: beta = D u, inv(A) = D inv(DAD) D.
+    """
     p = XtWX.shape[0]
     A = 0.5 * (XtWX + XtWX.T)  # symmetrise against accumulation noise
+    dinv = 1.0 / jnp.sqrt(jnp.clip(jnp.diag(A), 1e-30, None))
+    As = A * dinv[:, None] * dinv[None, :]
     # jitter may be a traced scalar under jit, so add unconditionally
-    # (jitter == 0.0 is a no-op).
-    scale = jnp.mean(jnp.diag(A))
-    return A + (jnp.asarray(jitter, A.dtype) * scale) * jnp.eye(p, dtype=A.dtype)
+    # (jitter == 0.0 is a no-op); As has unit diagonal, so it is relative
+    As = As + jnp.asarray(jitter, A.dtype) * jnp.eye(p, dtype=A.dtype)
+    return A, As, dinv
 
 
 def solve_normal(XtWX, XtWz, *, jitter: float = 0.0, refine_steps: int = 1):
-    """Solve ``(X'WX) beta = X'Wz``; returns ``(beta, cho)`` so callers can
-    reuse the factorisation for covariance diagnostics."""
-    A = _prepare(XtWX, jitter)
-    cho = cho_factor(A)
-    beta = cho_solve(cho, XtWz)
+    """Solve ``(X'WX) beta = X'Wz``; returns ``(beta, factor)`` — pass the
+    factor to :func:`inv_from_cho` / :func:`diag_inv_from_cho` for
+    covariance diagnostics."""
+    A, As, dinv = _prepare(XtWX, jitter)
+    cho = cho_factor(As)
+    beta = dinv * cho_solve(cho, dinv * XtWz)
     for _ in range(max(refine_steps, 0)):
+        # residual against the ORIGINAL system; correction solved in the
+        # equilibrated basis
         r = XtWz - A @ beta
-        beta = beta + cho_solve(cho, r)
-    return beta, cho
+        beta = beta + dinv * cho_solve(cho, dinv * r)
+    return beta, (cho, dinv)
 
 
-def inv_from_cho(cho, p: int, dtype):
-    """Full ``(X'WX)^-1`` from a Cholesky factorisation (p x p, replicated)."""
-    return cho_solve(cho, jnp.eye(p, dtype=dtype))
+def factor_singular(factor):
+    """Numerical rank-deficiency flag from the equilibrated Cholesky pivots.
+
+    The scaled system has unit diagonal, so its pivots are scale-free:
+    an exactly collinear design's smallest pivot is O(sqrt(p*eps)) — often
+    FINITE (the old NaN-based detection misses it after equilibration).
+    Thresholds: float64 flags only truly degenerate systems (kappa^2 >
+    ~1e14); float32 flags kappa^2 > ~1e8, where an f32 solve has no
+    correct digits anyway (use float64/x64 or singular='drop' for those).
+    """
+    cho, _ = factor
+    c = cho[0]
+    import numpy as _np
+    tol = 4.0 * _np.sqrt(_np.finfo(c.dtype).eps) if c.dtype == jnp.float64 \
+        else 1e-4
+    return jnp.min(jnp.abs(jnp.diag(c))) < tol
 
 
-def diag_inv_from_cho(cho, p: int, dtype):
+def inv_from_cho(factor, p: int, dtype):
+    """Full ``(X'WX)^-1`` from a :func:`solve_normal` factor (p x p,
+    replicated): D inv(DAD) D."""
+    cho, dinv = factor
+    inv_s = cho_solve(cho, jnp.eye(p, dtype=dtype))
+    return inv_s * dinv[:, None] * dinv[None, :]
+
+
+def diag_inv_from_cho(factor, p: int, dtype):
     """``diag((X'WX)^-1)`` — the standard-error ingredient (utils.scala:95)."""
-    return jnp.diag(inv_from_cho(cho, p, dtype))
+    return jnp.diag(inv_from_cho(factor, p, dtype))
 
 
 def independent_columns(A, tol: float = 1e-7):

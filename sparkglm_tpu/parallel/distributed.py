@@ -30,12 +30,19 @@ Typical multi-host flow::
     dist.initialize()                       # once per process
     mesh = dist.global_mesh()
     schema = sg.scan_csv_schema(path)       # same result on every host
+    levels = sg.scan_csv_levels(path)       # GLOBAL factor levels (one pass)
     cols = sg.read_csv(path, shard_index=dist.process_index(),
                        num_shards=dist.process_count(), schema=schema)
-    X, y = ...                              # per-host model matrix
-    Xg = dist.host_shard_to_global(X, mesh)
-    yg = dist.host_shard_to_global(y, mesh)
-    model = sg.glm_fit(Xg, yg, family="binomial", mesh=mesh)
+    terms = sg.build_terms(cols, predictors, intercept=True, levels=levels)
+    X = sg.transform(cols, terms)           # identical design on every host
+    y = cols[target]
+    tgt = dist.sync_max_rows(X.shape[0], mesh)
+    Xp, w = dist.pad_host_shard(X, tgt)     # zero-weight padding rows
+    yp, _ = dist.pad_host_shard(y.astype(X.dtype), tgt)
+    Xg = dist.host_shard_to_global(Xp, mesh)
+    yg = dist.host_shard_to_global(yp, mesh)
+    wg = dist.host_shard_to_global(w, mesh)
+    model = sg.glm_fit(Xg, yg, weights=wg, family="binomial", mesh=mesh)
 
 Single-chip / CPU-mesh sessions can use everything here too — each helper
 degrades to the local equivalent.
@@ -122,6 +129,20 @@ def host_shard_to_global(local_rows: np.ndarray, mesh: Mesh) -> jax.Array:
     sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:
         return meshlib.shard_rows(local_rows, mesh)
+    # catch divergent per-host designs BEFORE they misalign the global
+    # Gramian: every process must agree on the trailing (feature) shape —
+    # e.g. a CSV shard missing a factor level dummy-codes fewer columns
+    # (ADVICE r1; pass scan_csv_levels to build_terms to avoid it)
+    sig = np.asarray([local_rows.ndim] + list(local_rows.shape[1:]), np.int64)
+    from jax.experimental import multihost_utils as mh
+    sigs = np.asarray(mh.process_allgather(sig.astype(np.int32)))
+    if not (sigs == sigs[0]).all():
+        raise ValueError(
+            "host shards disagree on the feature dimension: "
+            f"{[list(s) for s in sigs]} (ndim, trailing shape) — did each "
+            "host build its model matrix from locally discovered factor "
+            "levels? Use scan_csv_levels + build_terms(levels=...) so every "
+            "host codes the same design, and compare Terms.signature().")
     return jax.make_array_from_process_local_data(sharding, local_rows)
 
 

@@ -74,6 +74,41 @@ def test_native_matches_python(csv_path):
             np.testing.assert_allclose(a[k], b[k])
 
 
+QUOTED_CSV = (
+    'name,"v",label\n'
+    '"plain",1,"a,b"\n'
+    '"esc""aped",2,"say ""hi"" now"\n'
+    '  "spaced"  ,3,"  inner kept  "\n'
+    '"",4,unquoted\n'
+    '"last",5,"x"\n'
+)
+
+
+def test_quoted_field_parity_native_vs_python(tmp_path):
+    """Escaped quotes, quoted commas, quoted headers and whitespace around
+    quotes must parse identically through both loaders (ADVICE r1: they
+    diverged on escaped quotes and strip order)."""
+    p = tmp_path / "q.csv"
+    p.write_text(QUOTED_CSV)
+    expected_name = ["plain", 'esc"aped', "spaced", None, "last"]
+    expected_label = ["a,b", 'say "hi" now', "  inner kept  ", "unquoted", "x"]
+    for native in (True, False):
+        if native and not sg.native_available():
+            pytest.skip("native loader unavailable")
+        cols = sg.read_csv(str(p), native=native)
+        assert list(cols) == ["name", "v", "label"]
+        assert list(cols["name"]) == expected_name
+        assert list(cols["label"]) == expected_label
+        np.testing.assert_allclose(cols["v"], [1, 2, 3, 4, 5])
+
+
+def test_scan_csv_levels_global(tmp_path, use_native):
+    p = tmp_path / "lv.csv"
+    p.write_text("y,g,h\n1,zz,5\n2,aa,6\n3,mm,7\n4,aa,8\n")
+    lv = sg.scan_csv_levels(str(p), native=use_native)
+    assert lv == {"g": ["aa", "mm", "zz"]}
+
+
 def test_read_csv_to_glm_end_to_end(tmp_path, mesh8, rng):
     """CSV -> formula -> fit: the full ingestion path."""
     n = 400

@@ -41,8 +41,16 @@ assert len(jax.devices()) == 4  # 2 processes x 2 local cpu devices
 mesh = dist.global_mesh()
 
 cols = sg.read_csv(csv_path, shard_index=dist.process_index(), num_shards=2)
-X = np.column_stack([np.ones(len(cols["x1"])), cols["x1"], cols["x2"]])
+# global level discovery (ADVICE r1): level "c" exists only in shard 0's
+# byte range — without scan_csv_levels the two hosts would dummy-code
+# designs with different column counts
+levels = sg.scan_csv_levels(csv_path)
+assert levels == {"grp": ["a", "b", "c"]}, levels
+terms = sg.build_terms(cols, ["x1", "x2", "grp"], intercept=True,
+                       levels=levels)
+X = sg.transform(cols, terms).astype(np.float64)
 y = np.asarray(cols["y"], np.float64)
+sig = terms.signature()
 
 tgt = dist.sync_max_rows(X.shape[0], mesh)
 Xp, w = dist.pad_host_shard(X.astype(np.float32), tgt)
@@ -53,11 +61,12 @@ yg = dist.host_shard_to_global(yp, mesh)
 wg = dist.host_shard_to_global(w.astype(np.float32), mesh)
 
 model = sg.glm_fit(Xg, yg, weights=wg, family="poisson", mesh=mesh,
-                   has_intercept=True, xnames=("intercept", "x1", "x2"),
+                   has_intercept=True, xnames=terms.xnames,
                    criterion="relative", tol=1e-10)
 if dist.process_index() == 0:
     with open(out_path, "w") as f:
         json.dump({
+            "terms_signature": sig,
             "coefficients": model.coefficients.tolist(),
             "std_errors": model.std_errors.tolist(),
             "deviance": model.deviance,
@@ -84,12 +93,17 @@ def test_two_process_csv_fit(tmp_path):
     n = 4001  # odd: byte-range shards are uneven -> exercises padding
     x1 = rng.standard_normal(n)
     x2 = rng.standard_normal(n)
-    y = rng.poisson(np.exp(0.4 + 0.5 * x1 - 0.3 * x2)).astype(np.float64)
+    # factor level "c" confined to the first rows: only shard 0 sees it
+    grp = np.where(np.arange(n) < 120, "c",
+                   np.where(rng.random(n) < 0.5, "a", "b"))
+    eff = {"a": 0.0, "b": 0.2, "c": -0.4}
+    y = rng.poisson(np.exp(0.4 + 0.5 * x1 - 0.3 * x2
+                           + np.vectorize(eff.get)(grp))).astype(np.float64)
     csv_path = tmp_path / "data.csv"
     with open(csv_path, "w") as f:
-        f.write("y,x1,x2\n")
+        f.write("y,x1,x2,grp\n")
         for i in range(n):
-            f.write(f"{y[i]:.1f},{x1[i]:.17g},{x2[i]:.17g}\n")
+            f.write(f"{y[i]:.1f},{x1[i]:.17g},{x2[i]:.17g},{grp[i]}\n")
 
     port = _free_port()
     out_path = tmp_path / "result.json"
@@ -125,13 +139,15 @@ def test_two_process_csv_fit(tmp_path):
     with open(out_path) as f:
         got = json.load(f)
 
-    # single-process reference fit on the full file
+    # single-process reference fit on the full file (same Terms recipe)
     import sparkglm_tpu as sg
     cols = sg.read_csv(str(csv_path))
-    X = np.column_stack([np.ones(n), cols["x1"], cols["x2"]]).astype(np.float32)
+    terms = sg.build_terms(cols, ["x1", "x2", "grp"], intercept=True,
+                           levels=sg.scan_csv_levels(str(csv_path)))
+    assert got["terms_signature"] == terms.signature()
+    X = sg.transform(cols, terms).astype(np.float32)
     ref = sg.glm_fit(X, np.asarray(cols["y"], np.float32), family="poisson",
-                     criterion="relative", tol=1e-10,
-                     xnames=("intercept", "x1", "x2"))
+                     criterion="relative", tol=1e-10, xnames=terms.xnames)
 
     assert got["converged"]
     assert got["n_shards"] == 4
