@@ -38,9 +38,12 @@ def conditioned_design(rng, n, p, kappa):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", default=None)
+    ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
     import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
     if jax.default_backend() == "cpu":
         jax.config.update("jax_enable_x64", True)  # oracle + f64 control runs
 
@@ -51,12 +54,15 @@ def main():
     rng = np.random.default_rng(99)
     rows = []
 
-    def record(config, family, link, X, y, kappa, refine, extra=""):
-        cfg = NumericConfig(dtype="float32", refine_steps=refine)
+    def record(config, family, link, X, y, kappa, refine, extra="",
+               polish=None, engine="auto"):
+        cfg = NumericConfig(dtype="float32", refine_steps=refine,
+                            polish=polish)
         try:
             m = sg.glm_fit(X.astype(np.float32), y.astype(np.float32),
                            family=family, link=link, tol=1e-12,
-                           criterion="relative", max_iter=100, config=cfg)
+                           criterion="relative", max_iter=100, config=cfg,
+                           engine=engine)
         except np.linalg.LinAlgError:
             # the f32 solver refuses Gramians with kappa^2 beyond f32 range
             # (ops/solve.py::factor_singular) instead of returning garbage
@@ -91,13 +97,19 @@ def main():
     y, _ = logistic_y(X)
     record("logistic_20kx200_k1e0", "binomial", "logit", X, y, 1, 1)
 
-    # 4-7: ill-conditioned designs, refine lever
+    # 4-7: ill-conditioned designs; refine and csne-polish levers
     for kappa in (1e3, 1e5):
         X = conditioned_design(rng, 100_000, 20, kappa)
         y, _ = logistic_y(X)
-        for refine in (0, 1, 2):
+        for refine in (0, 1):
             record(f"logistic_100kx20_k{kappa:.0e}_r{refine}",
                    "binomial", "logit", X, y, kappa, refine)
+        record(f"logistic_100kx20_k{kappa:.0e}_csne",
+               "binomial", "logit", X, y, kappa, 1, polish="csne",
+               extra="polish=csne")
+        record(f"logistic_100kx20_k{kappa:.0e}_qr",
+               "binomial", "logit", X, y, kappa, 1, engine="qr",
+               extra="engine=qr")
 
     # 8: poisson
     X = np.column_stack([np.ones(100_000), rng.standard_normal((100_000, 19))])

@@ -34,6 +34,13 @@ class NumericConfig:
         f32-quality inner products at higher throughput) or "highest".
         A speed/accuracy lever for very wide designs; coefficient parity
         tests run at None/highest.
+      polish: post-convergence coefficient polish.  ``"csne"`` runs a
+        TSQR + corrected-seminormal-equations pass at the final weights
+        (ops/tsqr.py): coefficient error drops from ~eps*kappa(X)^2 (the
+        f32 normal-equations floor) to ~eps*kappa(X), at the cost of one
+        distributed QR plus two fused data passes.  The lever for matching
+        R's f64 results on ill-conditioned designs without x64.  None (the
+        default) skips it.
     """
 
     dtype: jnp.dtype = jnp.float32
@@ -41,6 +48,7 @@ class NumericConfig:
     jitter: float = 0.0
     refine_steps: int = 1
     matmul_precision: str | None = None
+    polish: str | None = None
 
 
 DEFAULT = NumericConfig()

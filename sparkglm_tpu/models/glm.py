@@ -43,7 +43,8 @@ from ..families.families import Family, resolve
 from ..families.links import Link
 from ..ops.fused import fused_fisher_pass, fused_fisher_pass_ref
 from ..ops.gramian import weighted_gramian
-from ..ops.solve import factor_singular, inv_from_cho, solve_normal
+from ..ops.solve import (factor_singular, inv_from_cho, min_pivot,
+                         solve_normal)
 from ..parallel import mesh as meshlib
 
 _BIG = jnp.inf
@@ -57,7 +58,7 @@ def _sanitize(x, valid, fill=0.0):
 
 
 @partial(jax.jit, static_argnames=("family", "link", "criterion", "refine_steps",
-                                   "trace", "precision"))
+                                   "trace", "precision", "solver", "mesh"))
 def _irls_kernel(
     X, y, wt, offset,
     tol, max_iter, jitter,
@@ -66,6 +67,8 @@ def _irls_kernel(
     refine_steps: int = 1,
     trace: bool = False,
     precision=None,
+    solver: str = "chol",
+    mesh=None,
 ):
     """Full IRLS to convergence in one compiled while_loop.
 
@@ -93,6 +96,7 @@ def _irls_kernel(
         ddev=jnp.asarray(_BIG, acc),
         cov_inv=jnp.zeros((p, p), acc),
         singular=jnp.zeros((), jnp.bool_),
+        pivot=jnp.ones((), acc),  # equilibrated min pivot ~ 1/kappa(X)
         # first iteration's Gramian, kept for the singular='drop' host rank
         # check — saves the dedicated pre-pass over the data (ADVICE r1)
         XtWX0=jnp.zeros((p, p), acc),
@@ -110,10 +114,24 @@ def _irls_kernel(
         var = family.variance(mu)                # ref: GLM.scala:125-129
         w = _sanitize(wt / jnp.maximum(var * g * g, 1e-30), valid)
         z = _sanitize(eta - offset + (y - mu) * g, valid)  # ref: GLM.scala:371-373
-        XtWX, XtWz = weighted_gramian(X, z, w, accum_dtype=acc,
-                                      precision=precision)
-        beta, cho = solve_normal(XtWX, XtWz, jitter=jitter, refine_steps=refine_steps)
-        singular = ~jnp.all(jnp.isfinite(beta)) | factor_singular(cho)
+        if solver == "qr":
+            # TSQR + corrected seminormal solve: error ~eps*kappa(X), for
+            # designs whose f32 GRAMIAN is noise-dominated (ops/tsqr.py)
+            from ..ops.tsqr import qr_wls, rinv_gram
+            beta, R, singular = qr_wls(X, z, w, mesh=mesh)
+            XtWX = (R.T @ R).astype(acc)  # Gramian for the drop-path rank check
+            cov = rinv_gram(R, p, acc)
+            col = jnp.sqrt(jnp.clip(jnp.sum(R * R, axis=0), 1e-30, None))
+            pivot = jnp.min(jnp.abs(jnp.diag(R)) / col)
+        else:
+            XtWX, XtWz = weighted_gramian(X, z, w, accum_dtype=acc,
+                                          precision=precision)
+            beta, cho = solve_normal(XtWX, XtWz, jitter=jitter,
+                                     refine_steps=refine_steps)
+            cov = inv_from_cho(cho, p, acc)
+            singular = factor_singular(cho)
+            pivot = min_pivot(cho)
+        singular = ~jnp.all(jnp.isfinite(beta)) | singular
         beta = jnp.where(singular, s["beta"], beta)
         eta_new = (X @ beta + offset).astype(X.dtype)      # ref: etaCreate :321-332
         mu_new = jnp.where(valid, link.inverse(eta_new), 1.0).astype(X.dtype)  # ref: muCreate :334-355
@@ -130,8 +148,9 @@ def _irls_kernel(
             mu=mu_new,
             dev=dev_new,
             ddev=jnp.abs(dev_new - s["dev"]),
-            cov_inv=inv_from_cho(cho, p, acc),
+            cov_inv=cov,
             singular=singular,
+            pivot=pivot.astype(acc),
             XtWX0=jnp.where(s["it"] == 0, XtWX.astype(acc), s["XtWX0"]),
         )
 
@@ -148,7 +167,27 @@ def _irls_kernel(
 
     return dict(beta=s["beta"], cov_inv=s["cov_inv"], dev=s["dev"],
                 eta=s["eta"], iters=s["it"], converged=converged,
-                singular=s["singular"], XtWX0=s["XtWX0"])
+                singular=s["singular"], pivot=s["pivot"], XtWX0=s["XtWX0"])
+
+
+@partial(jax.jit, static_argnames=("family", "link", "mesh", "steps"))
+def _csne_post(X, y, wt, off, beta, *, family: Family, link: Link,
+               mesh, steps: int = 2):
+    """Post-convergence CSNE polish (ops/tsqr.py): rebuild (z, w) at the
+    converged beta and tighten the final weighted LS solve — one extra,
+    more accurate, Fisher step.  Returns (beta, eta, cov_inv) polished;
+    the covariance comes from the TSQR factor so SEs match the polished
+    coefficients' accuracy."""
+    from ..ops.tsqr import csne_polish, rinv_gram
+    valid = wt > 0
+    eta = X @ beta + off
+    mu = jnp.where(valid, link.inverse(eta), 1.0)
+    g = link.deriv(mu)
+    w = _sanitize(wt / jnp.maximum(family.variance(mu) * g * g, 1e-30), valid)
+    z = _sanitize(eta - off + (y - mu) * g, valid)
+    beta_p, R = csne_polish(X, z, w, beta, mesh=mesh, steps=steps)
+    acc = X.dtype if X.dtype == jnp.float64 else jnp.float32
+    return beta_p, X @ beta_p + off, rinv_gram(R, X.shape[1], acc)
 
 
 def _fused_block_rows(p: int) -> int:
@@ -204,11 +243,11 @@ def _irls_fused_kernel(
                                  refine_steps=refine_steps)
         singular = ~jnp.all(jnp.isfinite(beta)) | factor_singular(cho)
         beta = jnp.where(singular, beta_prev, beta)
-        return beta, inv_from_cho(cho, p, acc), singular
+        return beta, inv_from_cho(cho, p, acc), singular, min_pivot(cho)
 
     beta0 = jnp.zeros((p,), X.dtype)
     XtWX0, XtWz0, dev0 = spmd_pass(True)(X, y, wt, offset, beta0)
-    beta1, cov0, sing0 = solve(XtWX0, XtWz0, beta0)
+    beta1, cov0, sing0, piv0 = solve(XtWX0, XtWz0, beta0)
 
     state0 = dict(
         # counts deviance-measured updates, matching the einsum kernel's
@@ -219,6 +258,7 @@ def _irls_fused_kernel(
         ddev=jnp.asarray(_BIG, acc),
         cov_inv=cov0.astype(acc),
         singular=sing0,
+        pivot=piv0.astype(acc),
     )
     step = spmd_pass(False)
 
@@ -230,7 +270,7 @@ def _irls_fused_kernel(
 
     def body(s):
         XtWX, XtWz, dev = step(X, y, wt, offset, s["beta"])
-        beta_new, cov_inv, singular = solve(XtWX, XtWz, s["beta"])
+        beta_new, cov_inv, singular, pivot = solve(XtWX, XtWz, s["beta"])
         if trace:
             jax.debug.print("iter {i}\tdeviance {d}\tddev {dd}",
                             i=s["it"] + 1, d=dev,
@@ -242,6 +282,7 @@ def _irls_fused_kernel(
             ddev=jnp.abs(dev.astype(acc) - s["dev"]),
             cov_inv=cov_inv,
             singular=singular,
+            pivot=pivot.astype(acc),
         )
 
     s = jax.lax.while_loop(not_converged, body, state0)
@@ -255,7 +296,8 @@ def _irls_fused_kernel(
 
     return dict(beta=beta_f, cov_inv=s["cov_inv"], dev=s["dev"],
                 eta=eta, iters=s["it"], converged=converged,
-                singular=s["singular"], XtWX0=XtWX0.astype(acc))
+                singular=s["singular"], pivot=s["pivot"],
+                XtWX0=XtWX0.astype(acc))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -578,6 +620,12 @@ def fit(
       * ``"fused"`` — single-HBM-pass fused Fisher step (ops/fused.py):
         Pallas on TPU, its XLA twin elsewhere.  Requires an unsharded feature
         axis and float32.
+      * ``"qr"`` — per-iteration TSQR + corrected-seminormal solve
+        (ops/tsqr.py): coefficient error ~eps*kappa(X) instead of the
+        Gramian engines' ~eps*kappa(X)^2 — for ill-conditioned designs
+        (kappa ≳ 1e2 at float32) where the f32 Gramian itself is
+        noise-dominated.  Slower per iteration (Householder QR instead of
+        one MXU matmul).
       * ``"auto"`` — ``"fused"`` on TPU when eligible, else ``"einsum"``.
     """
     from .lm import _detect_intercept
@@ -587,6 +635,8 @@ def fit(
             f"criterion must be 'absolute' or 'relative', got {criterion!r}")
     if singular not in ("error", "drop"):
         raise ValueError(f"singular must be 'error' or 'drop', got {singular!r}")
+    if config.polish not in (None, "csne"):
+        raise ValueError(f"polish must be None or 'csne', got {config.polish!r}")
     fam, lnk = resolve(family, link)
     if isinstance(X, jax.Array) and not X.is_fully_addressable:
         # global arrays spanning processes (parallel/distributed.py flow):
@@ -603,6 +653,10 @@ def fit(
             raise ValueError("global-array fits use the einsum engine")
         if mesh is None:
             raise ValueError("pass the global mesh the arrays are sharded on")
+        if config.polish == "csne":
+            import warnings
+            warnings.warn("polish='csne' is not yet supported on "
+                          "global-array fits and is ignored", stacklevel=2)
         return _fit_global(X, y, weights, offset, fam, lnk, tol, max_iter,
                            criterion, xnames, yname, has_intercept, mesh,
                            verbose, config)
@@ -668,10 +722,20 @@ def fit(
         warnings.warn("engine='fused' uses a fixed internal matmul precision; "
                       "config.matmul_precision is ignored on this path",
                       stacklevel=2)
-    if engine not in ("einsum", "fused"):
-        raise ValueError(f"engine must be 'auto', 'einsum' or 'fused', got {engine!r}")
-    if engine == "fused" and (shard_features or mesh.shape[meshlib.MODEL_AXIS] != 1):
-        raise ValueError("engine='fused' does not support a sharded feature axis")
+    if engine not in ("einsum", "fused", "qr"):
+        raise ValueError(
+            f"engine must be 'auto', 'einsum', 'fused' or 'qr', got {engine!r}")
+    if engine in ("fused", "qr") and (shard_features
+                                      or mesh.shape[meshlib.MODEL_AXIS] != 1):
+        raise ValueError(
+            f"engine={engine!r} does not support a sharded feature axis")
+    polish_active = config.polish == "csne"
+    if polish_active and (shard_features
+                          or mesh.shape[meshlib.MODEL_AXIS] != 1):
+        import warnings
+        warnings.warn("polish='csne' is not supported with a sharded "
+                      "feature axis; skipping the polish", stacklevel=2)
+        polish_active = False
 
     block_rows = _fused_block_rows(p)
     if engine == "fused":
@@ -713,8 +777,27 @@ def fit(
             refine_steps=config.refine_steps,
             trace=verbose,
             precision=config.matmul_precision,
+            solver="qr" if engine == "qr" else "chol",
+            mesh=mesh if engine == "qr" else None,
         )
     out = jax.tree.map(np.asarray, out)
+    if (dtype == np.float32 and float(out["pivot"]) < 0.03
+            and engine != "qr" and not polish_active):
+        # conditioning beyond f32 normal-equations fidelity: the fit is not
+        # refused (kappa ~1e4..1e5 is recoverable) but must not pass silently
+        import warnings
+        warnings.warn(
+            f"design is ill-conditioned for float32 normal equations "
+            f"(equilibrated pivot {float(out['pivot']):.1e} ~ 1/kappa(X)); "
+            "coefficients may lose digits — use engine='qr', "
+            "NumericConfig(polish='csne'), or the float64 path", stacklevel=2)
+    if polish_active and not bool(out["singular"]):
+        beta_p, eta_p, cov_p = _csne_post(Xd, yd, wd, od,
+                                          jnp.asarray(out["beta"]),
+                                          family=fam, link=lnk, mesh=mesh)
+        out["beta"] = np.asarray(beta_p)
+        out["eta"] = np.asarray(eta_p)
+        out["cov_inv"] = np.asarray(cov_p)
     if singular == "drop":
         # host rank check on the FIRST iteration's Gramian, captured by the
         # kernel — no dedicated pre-pass over the data (ADVICE r1).  The

@@ -31,7 +31,8 @@ import numpy as np
 from ..config import DEFAULT, NumericConfig
 from ..ops.gramian import weighted_gramian, weighted_moments
 from ..ops.solve import (diag_inv_from_cho, factor_singular,
-                         independent_columns, inv_from_cho, solve_normal)
+                         independent_columns, inv_from_cho, min_pivot,
+                         solve_normal)
 from ..parallel import mesh as meshlib
 
 
@@ -62,29 +63,48 @@ def expand_aliased(model, mask: np.ndarray, xnames: tuple):
     return dataclasses.replace(model, **changes)
 
 
-@partial(jax.jit, static_argnames=("refine_steps", "compute_cov", "precision"))
+@partial(jax.jit, static_argnames=("refine_steps", "compute_cov", "precision",
+                                   "solver", "mesh"))
 def _lm_kernel(X, y, w, jitter, refine_steps: int = 1, compute_cov: bool = True,
-               precision=None):
+               precision=None, solver: str = "chol", mesh=None):
     """One fused pass: (X'WX, X'Wy) -> solve -> residual stats.
 
     With X/y/w row-sharded this is per-shard MXU work + one psum; the
     reference needs two distributed actions (Gramian treeReduce LM.scala:150,
     SSE collect LM.scala:167) plus driver-side LAPACK per fit.
+    ``solver="qr"`` replaces the normal equations with TSQR + a corrected
+    seminormal step (ops/tsqr.py) for ill-conditioned designs.
     """
     acc = X.dtype if X.dtype == jnp.float64 else jnp.float32
-    XtWX, XtWy = weighted_gramian(X, y, w, accum_dtype=acc, precision=precision)
-    beta, cho = solve_normal(XtWX, XtWy, jitter=jitter, refine_steps=refine_steps)
+    p = X.shape[1]
+    if solver == "qr":
+        from ..ops.tsqr import qr_wls, rinv_gram
+        beta, R, singular = qr_wls(X, y, w, mesh=mesh)
+        XtWX = (R.T @ R).astype(acc)
+        cov_full = rinv_gram(R, p, acc)
+        diag_inv = jnp.diag(cov_full)
+        cov_unscaled = cov_full if compute_cov else jnp.zeros((p, p), acc)
+        singular = ~jnp.all(jnp.isfinite(beta)) | singular
+        col = jnp.sqrt(jnp.clip(jnp.sum(R * R, axis=0), 1e-30, None))
+        pivot = jnp.min(jnp.abs(jnp.diag(R)) / col)
+    else:
+        XtWX, XtWy = weighted_gramian(X, y, w, accum_dtype=acc,
+                                      precision=precision)
+        beta, cho = solve_normal(XtWX, XtWy, jitter=jitter,
+                                 refine_steps=refine_steps)
+        diag_inv = diag_inv_from_cho(cho, p, XtWX.dtype)
+        cov_unscaled = (inv_from_cho(cho, p, XtWX.dtype) if compute_cov
+                        else jnp.zeros((p, p), XtWX.dtype))
+        singular = ~jnp.all(jnp.isfinite(beta)) | factor_singular(cho)
+        pivot = min_pivot(cho)
     resid = y - X @ beta
     sse = jnp.sum(w.astype(acc) * resid.astype(acc) ** 2)
     n, ybar, sst_centered = weighted_moments(y, w, accum_dtype=acc)
     sst_raw = sst_centered + n * ybar * ybar  # uncentered sum of squares
-    p = X.shape[1]
-    diag_inv = diag_inv_from_cho(cho, p, XtWX.dtype)
-    cov_unscaled = inv_from_cho(cho, p, XtWX.dtype) if compute_cov else jnp.zeros((p, p), XtWX.dtype)
-    singular = ~jnp.all(jnp.isfinite(beta)) | factor_singular(cho)
     return dict(beta=beta, diag_inv=diag_inv, cov_unscaled=cov_unscaled,
                 XtWX=XtWX, sse=sse, sst_centered=sst_centered,
-                sst_raw=sst_raw, n=n, ybar=ybar, singular=singular)
+                sst_raw=sst_raw, n=n, ybar=ybar, singular=singular,
+                pivot=pivot)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -224,6 +244,7 @@ def fit(
     mesh=None,
     shard_features: bool = False,
     singular: str = "error",
+    engine: str = "auto",
     config: NumericConfig = DEFAULT,
 ) -> LMModel:
     """Fit OLS/WLS by the normal equations on the device mesh.
@@ -234,9 +255,21 @@ def fit(
     ``singular``: "error" raises on a rank-deficient design; "drop" applies
     R's aliasing rule — later linearly dependent columns are dropped, their
     coefficients reported NaN (R's NA).
+
+    ``engine``: "auto"/"gramian" solves the normal equations (one MXU pass);
+    "qr" replaces the solve with TSQR + a corrected seminormal step
+    (ops/tsqr.py) — error ~eps*kappa(X) instead of ~eps*kappa^2, for
+    ill-conditioned designs at float32.
     """
     if singular not in ("error", "drop"):
         raise ValueError(f"singular must be 'error' or 'drop', got {singular!r}")
+    if engine not in ("auto", "gramian", "qr"):
+        raise ValueError(
+            f"engine must be 'auto', 'gramian' or 'qr', got {engine!r}")
+    if engine == "qr" and shard_features:
+        raise ValueError("engine='qr' does not support a sharded feature axis")
+    if config.polish not in (None, "csne"):
+        raise ValueError(f"polish must be None or 'csne', got {config.polish!r}")
     X = np.asarray(X)
     y = np.asarray(y)
     if y.ndim == 2:
@@ -274,7 +307,9 @@ def fit(
 
     out = _lm_kernel(Xd, yd, wd, jnp.asarray(config.jitter, dtype),
                      refine_steps=config.refine_steps,
-                     precision=config.matmul_precision)
+                     precision=config.matmul_precision,
+                     solver="qr" if engine == "qr" else "chol",
+                     mesh=mesh if engine == "qr" else None)
     out = jax.tree.map(np.asarray, out)
 
     if singular == "drop":
@@ -295,6 +330,36 @@ def fit(
         raise np.linalg.LinAlgError(
             "singular design in OLS solve; pass singular='drop' for R-style "
             "aliasing or set NumericConfig(jitter=...)")
+
+    if (dtype == np.float32 and float(out["pivot"]) < 0.03
+            and engine != "qr" and config.polish != "csne"):
+        import warnings
+        warnings.warn(
+            f"design is ill-conditioned for float32 normal equations "
+            f"(equilibrated pivot {float(out['pivot']):.1e} ~ 1/kappa(X)); "
+            "coefficients may lose digits — use engine='qr', "
+            "NumericConfig(polish='csne'), or the float64 path", stacklevel=2)
+    if config.polish == "csne" and not shard_features:
+        # TSQR + corrected seminormal equations at the final weights
+        # (ops/tsqr.py): error ~eps*kappa instead of the normal equations'
+        # ~eps*kappa^2; residual statistics recomputed exactly on host, and
+        # the covariance rebuilt from the TSQR factor so SEs match the
+        # polished coefficients' accuracy
+        from ..ops.tsqr import csne_polish, rinv_gram
+        beta_j, R = csne_polish(Xd, yd, wd, jnp.asarray(out["beta"]),
+                                mesh=mesh)
+        beta_p = np.asarray(beta_j, np.float64)
+        out["beta"] = beta_p
+        cov_p = np.asarray(rinv_gram(R, p, R.dtype), np.float64)
+        out["cov_unscaled"] = cov_p
+        out["diag_inv"] = np.diag(cov_p)
+        resid = y.astype(np.float64) - X.astype(np.float64) @ beta_p
+        out["sse"] = np.float64(
+            np.sum(w_host.astype(np.float64) * resid * resid))
+    elif config.polish == "csne":
+        import warnings
+        warnings.warn("polish='csne' is not supported with a sharded "
+                      "feature axis; skipping the polish", stacklevel=2)
 
     # R's lm drops zero-weight rows from df (summary.lm's n is sum(w != 0))
     n_ok = int(np.sum(w_host > 0))

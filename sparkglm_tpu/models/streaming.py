@@ -54,6 +54,18 @@ from .lm import LMModel
 DEFAULT_CHUNK_ROWS = 262_144
 
 
+def _check_polish(config: NumericConfig) -> None:
+    """Streaming solves run on host float64 already — the csne polish is
+    neither needed nor applicable; invalid values still raise like the
+    resident fits."""
+    if config.polish not in (None, "csne"):
+        raise ValueError(f"polish must be None or 'csne', got {config.polish!r}")
+    if config.polish == "csne":
+        import warnings
+        warnings.warn("streaming fits solve on host float64; polish='csne' "
+                      "is not applicable and is ignored", stacklevel=3)
+
+
 def _resolve_dtype(Xc, config: NumericConfig) -> np.dtype:
     """Honour float64 input + x64 exactly like the resident fits
     (models/lm.py / glm.py): f64 chunks stay f64 when x64 is on."""
@@ -181,6 +193,7 @@ def lm_fit_streaming(
     config: NumericConfig = DEFAULT,
 ) -> LMModel:
     """OLS/WLS in ONE streaming pass (host-f64 accumulation + solve)."""
+    _check_polish(config)
     if mesh is None:
         mesh = meshlib.make_mesh()
     chunks = _as_source(source, chunk_rows)
@@ -281,6 +294,7 @@ def glm_fit_streaming(
     if criterion not in ("absolute", "relative"):
         raise ValueError(
             f"criterion must be 'absolute' or 'relative', got {criterion!r}")
+    _check_polish(config)
     fam, lnk = resolve(family, link)
     if mesh is None:
         mesh = meshlib.make_mesh()

@@ -1,0 +1,137 @@
+"""Q-less TSQR + corrected seminormal equations (CSNE) — the f32
+conditioning escape hatch.
+
+Why: the IRLS/WLS core solves the NORMAL equations, whose f32 error grows
+like eps * kappa(X)^2 — measured ~1e-6 coefficient parity for
+well-conditioned designs but garbage past kappa(X) ~ 1e2
+(benchmarks/parity_sweep.py; SURVEY.md §7 hard part #1).  R runs f64 LAPACK
+(the reference inherits that via Breeze, utils.scala:103), so matching R on
+ill-conditioned data needs better than f32 normal equations on TPU.
+
+TSQR (tall-skinny QR, Demmel et al.): each row shard QR-factors locally on
+device, the (p, p) R factors are all-gathered and re-factored — communication
+is one all-gather of p^2 floats, and the R factor is obtained at backward
+error ~eps * kappa(X), NOT kappa^2.  Corrected seminormal equations
+(Bjorck 1987): solve R'R beta = X'Wz, then refine with the TRUE residual
+
+    delta = (R'R)^{-1} X'W (z - X beta)
+
+each correction is one fused data pass (MXU matvec + psum) plus two p x p
+triangular solves; one step already gives near-QR accuracy (error
+~ eps*kappa + eps^2*kappa^3).
+
+Used as a POLISH after IRLS converges: the while_loop keeps its cheap
+Cholesky solve per iteration (its errors are transient — the fixed point,
+not the path, determines the final coefficients), then ``csne_polish``
+tightens the converged beta at the final weights.  Enable with
+``NumericConfig(polish="csne")``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+from jax.sharding import PartitionSpec as P
+
+from ..parallel import mesh as meshlib
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def tsqr_r(Xw, mesh=None):
+    """Upper-triangular R with R'R = Xw'Xw for a row-sharded Xw.
+
+    Per-shard ``qr(mode="r")`` + all-gather of the (p, p) partial factors +
+    one final QR of the stacked factors, computed identically (hence
+    replicated) on every device.  Without a mesh: plain local QR.
+    """
+    if mesh is None:
+        return jnp.linalg.qr(Xw, mode="r")
+    d = meshlib.DATA_AXIS
+
+    def f(Xs):
+        R = jnp.linalg.qr(Xs, mode="r")
+        Rs = jax.lax.all_gather(R, d)          # (n_data, p, p), replicated
+        return jnp.linalg.qr(Rs.reshape(-1, R.shape[1]), mode="r")
+
+    return jax.shard_map(
+        f, mesh=mesh, in_specs=(P(d, None),), out_specs=P(),
+        check_vma=False)(Xw)
+
+
+def qr_wls(X, z, w, *, mesh=None):
+    """Weighted least squares ``min ||sqrt(w)(z - X beta)||`` solved via
+    Q-less TSQR + one corrected-seminormal step — backward error
+    ~eps*kappa(X) instead of the normal equations' ~eps*kappa^2.
+
+    Returns ``(beta, R, singular)``: R upper-triangular with R'R = X'WX
+    (covariance follows as R^{-1} R^{-T}), and a scale-free rank-deficiency
+    flag from R's pivots.  The per-iteration solve of the ``engine="qr"``
+    IRLS path (models/glm.py).
+    """
+    sw = jnp.sqrt(w)
+    Xw = X * sw[:, None]
+    R = tsqr_r(Xw, mesh)
+    col = jnp.sqrt(jnp.clip(jnp.sum(R * R, axis=0), 1e-30, None))
+    singular = jnp.min(jnp.abs(jnp.diag(R)) / col) < 1e-6
+
+    def solve_rr(v):
+        return solve_triangular(
+            R, solve_triangular(R.T, v, lower=True), lower=False)
+
+    c = jnp.einsum("np,n->p", X, w * z, preferred_element_type=X.dtype)
+    beta = solve_rr(c)                                   # seminormal
+    r = (z - X @ beta) * w
+    g = jnp.einsum("np,n->p", X, r, preferred_element_type=X.dtype)
+    beta = beta + solve_rr(g)                            # corrected step
+    return beta, R, singular
+
+
+def rinv_gram(R, p: int, dtype):
+    """``(X'WX)^{-1} = R^{-1} R^{-T}`` from a TSQR factor."""
+    eye = jnp.eye(p, dtype=dtype)
+    return solve_triangular(
+        R, solve_triangular(R.T, eye, lower=True), lower=False)
+
+
+@partial(jax.jit, static_argnames=("mesh", "steps"))
+def csne_polish(X, z, w, beta, *, mesh=None, steps: int = 2):
+    """Refine a WLS solution ``beta`` of ``min ||sqrt(w)(z - X beta)||`` via
+    TSQR + corrected seminormal equations.
+
+    Args are row-sharded (X (n,p), z/w (n,)); ``beta`` replicated.  Padding
+    rows must carry w == 0.  Returns ``(beta, R)``: the polished beta
+    (replicated; falls back to the input if R is numerically singular or a
+    step fails to reduce the weighted gradient norm) and the TSQR factor —
+    callers should rebuild the covariance from it (:func:`rinv_gram`) so
+    SEs carry the same ~eps*kappa accuracy as the polished coefficients.
+    """
+    sw = jnp.sqrt(w)
+    Xw = X * sw[:, None]
+    R = tsqr_r(Xw, mesh)
+    p = X.shape[1]
+    # scale-free singularity guard on R's diagonal (R'R has Xw's Gramian
+    # diagonal, so compare pivots to their column norms)
+    col = jnp.sqrt(jnp.clip(jnp.sum(R * R, axis=0), 1e-30, None))
+    ok = jnp.min(jnp.abs(jnp.diag(R)) / col) > 1e-6
+
+    def grad(b):
+        # X'W(z - Xb): one fused data pass (GSPMD inserts the psum)
+        r = (z - X @ b) * w
+        return jnp.einsum("np,n->p", X, r, preferred_element_type=X.dtype)
+
+    g = grad(beta)
+    gn = jnp.sum(g * g)
+    for _ in range(steps):
+        delta = solve_triangular(
+            R, solve_triangular(R.T, g, lower=True), lower=False)
+        cand = beta + delta
+        g_c = grad(cand)
+        gn_c = jnp.sum(g_c * g_c)
+        better = ok & (gn_c < gn)
+        beta = jnp.where(better, cand, beta)
+        g = jnp.where(better, g_c, g)
+        gn = jnp.where(better, gn_c, gn)
+    return beta, R

@@ -1,0 +1,206 @@
+"""TSQR + CSNE polish (ops/tsqr.py): the f32 conditioning lever.
+
+SURVEY.md §7 hard part #1: f32 normal equations lose ~eps*kappa(X)^2 —
+measured garbage past kappa ~1e2 (benchmarks/parity_sweep.py).  The polish
+must recover ~eps*kappa accuracy, and must be a no-op-or-better everywhere.
+"""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.config import NumericConfig
+from oracle import irls_np, ols_np
+
+
+def _conditioned(rng, n, p, kappa):
+    Z = rng.standard_normal((n, p - 1))
+    V, _ = np.linalg.qr(rng.standard_normal((p - 1, p - 1)))
+    s = np.logspace(0, -np.log10(kappa), p - 1)
+    return np.column_stack([np.ones(n), (Z @ V) * s @ V.T])
+
+
+def test_tsqr_r_matches_host_qr(mesh8, rng):
+    import jax.numpy as jnp
+    from sparkglm_tpu.ops.tsqr import tsqr_r
+    from sparkglm_tpu.parallel import mesh as meshlib
+    X = rng.standard_normal((4096, 12))
+    Xd = meshlib.shard_rows(X, mesh8)
+    R = np.asarray(tsqr_r(Xd, mesh8), np.float64)
+    Rh = np.linalg.qr(X, mode="r")
+    # R is unique up to row signs; compare R'R
+    np.testing.assert_allclose(R.T @ R, Rh.T @ Rh, rtol=1e-10, atol=1e-10)
+
+
+def test_csne_rescues_ill_conditioned_logistic_f32(mesh8, rng):
+    n, p, kappa = 40_000, 12, 1e3
+    X = _conditioned(rng, n, p, kappa)
+    bt = rng.standard_normal(p) / np.sqrt(p)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(np.float64)
+    b64, _, _, _ = irls_np(X, y, "binomial", "logit", tol=1e-14)
+    kw = dict(family="binomial", tol=1e-12, criterion="relative", mesh=mesh8)
+    m0 = sg.glm_fit(X.astype(np.float32), y.astype(np.float32),
+                    config=NumericConfig(dtype="float32"), **kw)
+    m1 = sg.glm_fit(X.astype(np.float32), y.astype(np.float32),
+                    config=NumericConfig(dtype="float32", polish="csne"), **kw)
+    e0 = np.max(np.abs(m0.coefficients - b64))
+    e1 = np.max(np.abs(m1.coefficients - b64))
+    assert e1 <= e0          # never worse
+    assert e1 < 5e-3         # and absolutely tight (measured ~1e-3)
+
+
+def test_csne_rescues_ill_conditioned_ols_f32(mesh1, rng):
+    n, p, kappa = 40_000, 12, 1e3
+    X = _conditioned(rng, n, p, kappa)
+    bt = rng.standard_normal(p)
+    y = X @ bt + 0.1 * rng.standard_normal(n)
+    b64 = ols_np(X, y)
+    m0 = sg.lm_fit(X.astype(np.float32), y.astype(np.float32),
+                   config=NumericConfig(dtype="float32"), mesh=mesh1)
+    m1 = sg.lm_fit(X.astype(np.float32), y.astype(np.float32),
+                   config=NumericConfig(dtype="float32", polish="csne"),
+                   mesh=mesh1)
+    e0 = np.max(np.abs(m0.coefficients - b64))
+    e1 = np.max(np.abs(m1.coefficients - b64))
+    assert e1 < e0 / 5
+    # polished residual stats are host-f64 exact at the polished beta (and
+    # the f32-rounded X the fit actually saw)
+    Xf = X.astype(np.float32).astype(np.float64)
+    yf = y.astype(np.float32).astype(np.float64)
+    resid = yf - Xf @ m1.coefficients
+    assert m1.sse == pytest.approx(float(np.sum(resid**2)), rel=1e-9)
+
+
+def test_csne_noop_on_well_conditioned(mesh8, rng):
+    n, p = 20_000, 8
+    X = np.column_stack([np.ones(n), rng.standard_normal((n, p - 1))])
+    bt = rng.standard_normal(p) / np.sqrt(p)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(np.float64)
+    b64, _, _, _ = irls_np(X, y, "binomial", "logit", tol=1e-14)
+    m1 = sg.glm_fit(X.astype(np.float32), y.astype(np.float32),
+                    family="binomial", tol=1e-12, criterion="relative",
+                    mesh=mesh8,
+                    config=NumericConfig(dtype="float32", polish="csne"))
+    assert np.max(np.abs(m1.coefficients - b64)) < 5e-5
+    assert m1.converged
+
+
+def test_polish_f64_path_unharmed(mesh8, rng):
+    # x64 CPU fits are already ~1e-12; polish must not degrade them
+    n, p = 5_000, 6
+    X = np.column_stack([np.ones(n), rng.standard_normal((n, p - 1))])
+    bt = rng.standard_normal(p)
+    y = X @ bt + 0.5 * rng.standard_normal(n)
+    b64 = ols_np(X, y)
+    m = sg.lm_fit(X, y, mesh=mesh8,
+                  config=NumericConfig(dtype="float64", polish="csne"))
+    np.testing.assert_allclose(m.coefficients, b64, rtol=1e-10, atol=1e-12)
+
+
+def test_qr_engine_matches_oracle_where_gramian_refuses(mesh8, rng):
+    """engine='qr' (per-iteration TSQR+CSNE) fits designs whose f32 Gramian
+    is numerically singular, at ~eps*kappa accuracy."""
+    n, p, kappa = 40_000, 12, 1e4
+    X = _conditioned(rng, n, p, kappa)
+    bt = rng.standard_normal(p) / np.sqrt(p)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(np.float64)
+    b64, _, _, _ = irls_np(X, y, "binomial", "logit", tol=1e-14)
+    m = sg.glm_fit(X.astype(np.float32), y.astype(np.float32),
+                   family="binomial", engine="qr", tol=1e-12,
+                   criterion="relative", mesh=mesh8,
+                   config=NumericConfig(dtype="float32"))
+    assert m.converged
+    # eps_f32 * kappa * |beta| scale tolerance, with slack
+    assert np.max(np.abs(m.coefficients - b64)) < 0.3
+
+
+def test_qr_engine_well_conditioned_parity(mesh8, rng):
+    """On well-conditioned data the qr engine agrees with einsum tightly
+    (f64 x64 path here: both near-exact), including SEs from R^-1 R^-T."""
+    n, p = 5_000, 6
+    X = np.column_stack([np.ones(n), rng.standard_normal((n, p - 1))])
+    bt = rng.standard_normal(p) / np.sqrt(p)
+    y = rng.poisson(np.exp(np.clip(X @ bt, -4, 4))).astype(np.float64)
+    kw = dict(family="poisson", tol=1e-12, criterion="relative", mesh=mesh8)
+    m_e = sg.glm_fit(X, y, engine="einsum", **kw)
+    m_q = sg.glm_fit(X, y, engine="qr", **kw)
+    np.testing.assert_allclose(m_q.coefficients, m_e.coefficients,
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(m_q.std_errors, m_e.std_errors, rtol=1e-8)
+    assert m_q.deviance == pytest.approx(m_e.deviance, rel=1e-10)
+
+
+def test_qr_engine_rejects_feature_sharding(mesh42, rng):
+    X = np.column_stack([np.ones(800), rng.standard_normal((800, 7))])
+    y = (rng.random(800) < 0.5).astype(float)
+    with pytest.raises(ValueError, match="qr"):
+        sg.glm_fit(X, y, engine="qr", mesh=mesh42, shard_features=True)
+
+
+def test_lm_qr_engine_public_api(mesh8, rng):
+    n, p, kappa = 40_000, 12, 1e3
+    X = _conditioned(rng, n, p, kappa)
+    bt = rng.standard_normal(p)
+    y = X @ bt + 0.1 * rng.standard_normal(n)
+    b64 = ols_np(X, y)
+    m0 = sg.lm_fit(X.astype(np.float32), y.astype(np.float32), mesh=mesh8,
+                   config=NumericConfig(dtype="float32"))
+    mq = sg.lm_fit(X.astype(np.float32), y.astype(np.float32), mesh=mesh8,
+                   engine="qr", config=NumericConfig(dtype="float32"))
+    e0 = np.max(np.abs(m0.coefficients - b64))
+    eq = np.max(np.abs(mq.coefficients - b64))
+    assert eq < e0 / 5
+    with pytest.raises(ValueError, match="engine"):
+        sg.lm_fit(X.astype(np.float32), y.astype(np.float32), engine="lu")
+
+
+def test_ill_conditioned_f32_warns(mesh1, rng):
+    """kappa beyond f32 normal-equations fidelity (> ~1e2) must not pass
+    silently — at kappa=1e3 the measured coefficient error is ~3e-2."""
+    n, p, kappa = 20_000, 10, 1e3
+    X = _conditioned(rng, n, p, kappa)
+    bt = rng.standard_normal(p)
+    y = X @ bt + 0.1 * rng.standard_normal(n)
+    with pytest.warns(UserWarning, match="ill-conditioned"):
+        sg.lm_fit(X.astype(np.float32), y.astype(np.float32), mesh=mesh1,
+                  config=NumericConfig(dtype="float32"))
+    # the qr engine on the same data does NOT warn (its accuracy is ~eps*kappa)
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        sg.lm_fit(X.astype(np.float32), y.astype(np.float32), mesh=mesh1,
+                  engine="qr", config=NumericConfig(dtype="float32"))
+
+
+def test_polished_ses_consistent_with_qr_covariance(mesh1, rng):
+    """polish='csne' must rebuild the covariance from the TSQR factor, not
+    keep the kappa^2-noise Cholesky inverse (review r2 finding)."""
+    n, p, kappa = 40_000, 10, 1e3
+    X = _conditioned(rng, n, p, kappa)
+    bt = rng.standard_normal(p)
+    y = X @ bt + 0.5 * rng.standard_normal(n)
+    mq = sg.lm_fit(X.astype(np.float32), y.astype(np.float32), mesh=mesh1,
+                   engine="qr", config=NumericConfig(dtype="float32"))
+    mp = sg.lm_fit(X.astype(np.float32), y.astype(np.float32), mesh=mesh1,
+                   config=NumericConfig(dtype="float32", polish="csne"))
+    # both covariance routes come from a TSQR factor now: SEs agree closely
+    np.testing.assert_allclose(mp.std_errors, mq.std_errors, rtol=1e-3)
+
+
+def test_streaming_rejects_bad_polish(rng):
+    from sparkglm_tpu.models.streaming import glm_fit_streaming
+    X = np.column_stack([np.ones(100), rng.standard_normal(100)])
+    y = np.abs(rng.standard_normal(100)) + 1
+    with pytest.raises(ValueError, match="polish"):
+        glm_fit_streaming((X, y), family="gamma", link="log",
+                          config=NumericConfig(polish="bogus"))
+    with pytest.warns(UserWarning, match="not applicable"):
+        glm_fit_streaming((X, y), family="gamma", link="log",
+                          config=NumericConfig(polish="csne"))
+
+
+def test_polish_validated():
+    X = np.column_stack([np.ones(50), np.arange(50.0)])
+    y = np.arange(50.0)
+    with pytest.raises(ValueError, match="polish"):
+        sg.lm_fit(X, y, config=NumericConfig(polish="nope"))
