@@ -71,11 +71,11 @@ def main():
     OUT["fused_iters"] = m_fused.iterations
     OUT["einsum_iters"] = m_eins.iterations
 
-    # ---- 3. engine timing sweep ----
+    # ---- 3. engine timing sweep: n chosen so n*p^2 work stays ~5e11 ----
     timing = {}
     for p3 in (32, 128, 512, 1024):
-        n3 = max(1 << 21, 1 << 25 >> max(0, (p3.bit_length() - 6)))  # keep work bounded
-        n3 = min(n3, 2 * 1 << 20 if p3 >= 512 else 1 << 22)
+        n3 = int(min(4_194_304, max(262_144, 5e11 / p3 ** 2)))
+        n3 = (n3 // 4096) * 4096
         X3, y3 = make_logistic(n3, p3, seed=p3)
         row = {}
         for engine in ("fused", "einsum"):

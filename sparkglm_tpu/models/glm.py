@@ -118,11 +118,10 @@ def _irls_kernel(
             # TSQR + corrected seminormal solve: error ~eps*kappa(X), for
             # designs whose f32 GRAMIAN is noise-dominated (ops/tsqr.py)
             from ..ops.tsqr import qr_wls, rinv_gram
-            beta, R, singular = qr_wls(X, z, w, mesh=mesh)
+            beta, R, pivot = qr_wls(X, z, w, mesh=mesh)
+            singular = pivot < 1e-6
             XtWX = (R.T @ R).astype(acc)  # Gramian for the drop-path rank check
             cov = rinv_gram(R, p, acc)
-            col = jnp.sqrt(jnp.clip(jnp.sum(R * R, axis=0), 1e-30, None))
-            pivot = jnp.min(jnp.abs(jnp.diag(R)) / col)
         else:
             XtWX, XtWz = weighted_gramian(X, z, w, accum_dtype=acc,
                                           precision=precision)
@@ -729,7 +728,9 @@ def fit(
                                       or mesh.shape[meshlib.MODEL_AXIS] != 1):
         raise ValueError(
             f"engine={engine!r} does not support a sharded feature axis")
-    polish_active = config.polish == "csne"
+    # the qr engine's corrected-seminormal solve already delivers the
+    # polish's ~eps*kappa accuracy every iteration — skip the redundant TSQR
+    polish_active = config.polish == "csne" and engine != "qr"
     if polish_active and (shard_features
                           or mesh.shape[meshlib.MODEL_AXIS] != 1):
         import warnings

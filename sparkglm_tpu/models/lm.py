@@ -79,14 +79,12 @@ def _lm_kernel(X, y, w, jitter, refine_steps: int = 1, compute_cov: bool = True,
     p = X.shape[1]
     if solver == "qr":
         from ..ops.tsqr import qr_wls, rinv_gram
-        beta, R, singular = qr_wls(X, y, w, mesh=mesh)
+        beta, R, pivot = qr_wls(X, y, w, mesh=mesh)
         XtWX = (R.T @ R).astype(acc)
         cov_full = rinv_gram(R, p, acc)
         diag_inv = jnp.diag(cov_full)
         cov_unscaled = cov_full if compute_cov else jnp.zeros((p, p), acc)
-        singular = ~jnp.all(jnp.isfinite(beta)) | singular
-        col = jnp.sqrt(jnp.clip(jnp.sum(R * R, axis=0), 1e-30, None))
-        pivot = jnp.min(jnp.abs(jnp.diag(R)) / col)
+        singular = ~jnp.all(jnp.isfinite(beta)) | (pivot < 1e-6)
     else:
         XtWX, XtWy = weighted_gramian(X, y, w, accum_dtype=acc,
                                       precision=precision)
@@ -324,22 +322,30 @@ def fit(
                       xnames=tuple(np.asarray(xnames)[mask]), yname=yname,
                       has_intercept=has_intercept, mesh=mesh,
                       shard_features=shard_features, singular="error",
-                      config=config)
+                      engine=engine, config=config)
             return expand_aliased(sub, mask, xnames)
     if bool(out["singular"]) or not np.all(np.isfinite(out["beta"])):
         raise np.linalg.LinAlgError(
             "singular design in OLS solve; pass singular='drop' for R-style "
             "aliasing or set NumericConfig(jitter=...)")
 
+    # the qr engine's corrected-seminormal solve already delivers the
+    # polish's ~eps*kappa accuracy — a second TSQR would be pure waste
+    polish_active = config.polish == "csne" and engine != "qr"
+    if polish_active and shard_features:
+        import warnings
+        warnings.warn("polish='csne' is not supported with a sharded "
+                      "feature axis; skipping the polish", stacklevel=2)
+        polish_active = False
     if (dtype == np.float32 and float(out["pivot"]) < 0.03
-            and engine != "qr" and config.polish != "csne"):
+            and engine != "qr" and not polish_active):
         import warnings
         warnings.warn(
             f"design is ill-conditioned for float32 normal equations "
             f"(equilibrated pivot {float(out['pivot']):.1e} ~ 1/kappa(X)); "
             "coefficients may lose digits — use engine='qr', "
             "NumericConfig(polish='csne'), or the float64 path", stacklevel=2)
-    if config.polish == "csne" and not shard_features:
+    if polish_active:
         # TSQR + corrected seminormal equations at the final weights
         # (ops/tsqr.py): error ~eps*kappa instead of the normal equations'
         # ~eps*kappa^2; residual statistics recomputed exactly on host, and
@@ -356,10 +362,6 @@ def fit(
         resid = y.astype(np.float64) - X.astype(np.float64) @ beta_p
         out["sse"] = np.float64(
             np.sum(w_host.astype(np.float64) * resid * resid))
-    elif config.polish == "csne":
-        import warnings
-        warnings.warn("polish='csne' is not supported with a sharded "
-                      "feature axis; skipping the polish", stacklevel=2)
 
     # R's lm drops zero-weight rows from df (summary.lm's n is sum(w != 0))
     n_ok = int(np.sum(w_host > 0))

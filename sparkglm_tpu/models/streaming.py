@@ -284,12 +284,22 @@ def glm_fit_streaming(
     has_intercept: bool | None = None,
     mesh=None,
     verbose: bool = False,
+    beta0=None,
+    on_iteration=None,
     config: NumericConfig = DEFAULT,
     _null_model: bool = False,
 ) -> GLMModel:
     """IRLS with one streaming pass per iteration; beta is the only carried
     state.  Deviance measured in a pass belongs to the incoming beta (same
     lagged-|ddev| convergence as the fused resident engine, models/glm.py).
+
+    Because beta IS the whole working state, long fits checkpoint/resume
+    trivially (the reference has no recovery story at all, SURVEY.md §5):
+    ``on_iteration(iter, beta, deviance)`` is called after every pass —
+    persist beta there — and ``beta0`` warm-starts a fresh call from the
+    last checkpoint, skipping the family-init pass.  A warm-started run
+    continues exactly where the interrupted one stopped (same fixed point;
+    iteration counts restart).
     """
     if criterion not in ("absolute", "relative"):
         raise ValueError(
@@ -305,9 +315,11 @@ def glm_fit_streaming(
     dtype = None
     ones_mask = None
     scan_intercept = has_intercept is None
+    scanned = False  # metadata (intercept/offset) scan done on the 1st pass
 
     def full_pass(beta, first):
-        nonlocal n_total, saw_offset, dtype, ones_mask
+        nonlocal n_total, saw_offset, dtype, ones_mask, scanned
+        scan_now = not scanned
         XtWX = XtWz = None
         dev = 0.0
         count = 0
@@ -325,11 +337,11 @@ def glm_fit_streaming(
         for Xc, yc, wc, oc in chunks():
             if dtype is None:
                 dtype = _resolve_dtype(Xc, config)
-            if first and scan_intercept:
+            if scan_now and scan_intercept:
                 cm = _ones_colmask(Xc)
                 ones_mask = cm if ones_mask is None else ones_mask & cm
             count += int(Xc.shape[0])
-            if first and oc is not None and np.any(np.asarray(oc) != 0):
+            if scan_now and oc is not None and np.any(np.asarray(oc) != 0):
                 saw_offset = True
             dX, dy, dw, do = _put_chunk(Xc, yc, wc, oc, mesh, dtype)
             b = jnp.zeros((dX.shape[1],), dX.dtype) if beta is None else \
@@ -347,10 +359,16 @@ def glm_fit_streaming(
         if XtWX is None:
             raise ValueError("source yielded no chunks")
         n_total = count
+        scanned = True
         return XtWX, XtWz, dev
 
-    # init pass from family starting values (first=True ignores beta)
-    XtWX, XtWz, dev_prev = full_pass(None, True)
+    if beta0 is not None:
+        # warm start (resume from a checkpointed beta): the first pass is a
+        # regular IRLS pass at beta0 instead of the family-init pass
+        XtWX, XtWz, dev_prev = full_pass(np.asarray(beta0, np.float64), False)
+    else:
+        # init pass from family starting values (first=True ignores beta)
+        XtWX, XtWz, dev_prev = full_pass(None, True)
     p = XtWX.shape[0]
     if xnames is None:
         xnames = tuple(f"x{i}" for i in range(p))
@@ -375,6 +393,8 @@ def glm_fit_streaming(
         # diag((X'WX)^-1) come from the same final pass, exactly like the
         # resident fused engine's loop body
         beta, cho = _solve64(XtWX, XtWz, config.jitter)
+        if on_iteration is not None:
+            on_iteration(iters, beta.copy(), dev)  # checkpoint hook
         if crit <= tol:
             converged = True
             break

@@ -99,9 +99,10 @@ def factor_singular(factor):
 
 def min_pivot(factor):
     """Smallest equilibrated Cholesky pivot — a scale-free conditioning
-    probe (~1/kappa(X)).  Fit paths warn when it drops below f32 fidelity
-    (pivot < 1e-4, i.e. kappa ≳ 1e4) without refusing, pointing at the
-    engine='qr' / polish='csne' / float64 levers."""
+    probe (~1/kappa(X)).  The f32 fit paths warn (without refusing) when it
+    drops below 0.03 — i.e. estimated coefficient error eps32/pivot^2
+    beyond ~1e-4 — pointing at the engine='qr' / polish='csne' / float64
+    levers."""
     cho, _ = factor
     return jnp.min(jnp.abs(jnp.diag(cho[0])))
 

@@ -61,21 +61,28 @@ def tsqr_r(Xw, mesh=None):
         check_vma=False)(Xw)
 
 
+def r_pivot(R):
+    """Scale-free conditioning probe of a TSQR factor: min |diag(R)| over
+    the column norms (~1/kappa(X)).  Single home for the rank-deficiency
+    threshold: pivot < 1e-6 means no recoverable digits even via CSNE."""
+    col = jnp.sqrt(jnp.clip(jnp.sum(R * R, axis=0), 1e-30, None))
+    return jnp.min(jnp.abs(jnp.diag(R)) / col)
+
+
 def qr_wls(X, z, w, *, mesh=None):
     """Weighted least squares ``min ||sqrt(w)(z - X beta)||`` solved via
     Q-less TSQR + one corrected-seminormal step — backward error
     ~eps*kappa(X) instead of the normal equations' ~eps*kappa^2.
 
-    Returns ``(beta, R, singular)``: R upper-triangular with R'R = X'WX
-    (covariance follows as R^{-1} R^{-T}), and a scale-free rank-deficiency
-    flag from R's pivots.  The per-iteration solve of the ``engine="qr"``
-    IRLS path (models/glm.py).
+    Returns ``(beta, R, pivot)``: R upper-triangular with R'R = X'WX
+    (covariance follows as R^{-1} R^{-T}) and the scale-free
+    :func:`r_pivot`; rank deficiency is ``pivot < 1e-6``.  The
+    per-iteration solve of the ``engine="qr"`` IRLS path (models/glm.py).
     """
     sw = jnp.sqrt(w)
     Xw = X * sw[:, None]
     R = tsqr_r(Xw, mesh)
-    col = jnp.sqrt(jnp.clip(jnp.sum(R * R, axis=0), 1e-30, None))
-    singular = jnp.min(jnp.abs(jnp.diag(R)) / col) < 1e-6
+    pivot = r_pivot(R)
 
     def solve_rr(v):
         return solve_triangular(
@@ -86,7 +93,7 @@ def qr_wls(X, z, w, *, mesh=None):
     r = (z - X @ beta) * w
     g = jnp.einsum("np,n->p", X, r, preferred_element_type=X.dtype)
     beta = beta + solve_rr(g)                            # corrected step
-    return beta, R, singular
+    return beta, R, pivot
 
 
 def rinv_gram(R, p: int, dtype):
@@ -111,11 +118,7 @@ def csne_polish(X, z, w, beta, *, mesh=None, steps: int = 2):
     sw = jnp.sqrt(w)
     Xw = X * sw[:, None]
     R = tsqr_r(Xw, mesh)
-    p = X.shape[1]
-    # scale-free singularity guard on R's diagonal (R'R has Xw's Gramian
-    # diagonal, so compare pivots to their column norms)
-    col = jnp.sqrt(jnp.clip(jnp.sum(R * R, axis=0), 1e-30, None))
-    ok = jnp.min(jnp.abs(jnp.diag(R)) / col) > 1e-6
+    ok = r_pivot(R) > 1e-6  # singularity guard (see r_pivot)
 
     def grad(b):
         # X'W(z - Xb): one fused data pass (GSPMD inserts the psum)

@@ -254,6 +254,17 @@ def main():
         fit=r_fit(X2, yb, "binomial", "cloglog"),
         provenance="synthetic; R: glm(y ~ x, binomial(cloglog))")
 
+    # -- 9. grouped binomial probit ------------------------------------------
+    from scipy.stats import norm as _norm
+    m9 = rng.integers(8, 30, n).astype(float)
+    pr9 = _norm.cdf(-0.2 + 0.6 * x1)
+    s9 = rng.binomial(m9.astype(int), pr9).astype(float)
+    cases["grouped_binomial_probit"] = dict(
+        data=dict(x1=x1.tolist(), m=m9.tolist(), successes=s9.tolist()),
+        family="binomial", link="probit",
+        fit=r_fit(Xb, s9, "binomial", "probit", m=m9),
+        provenance="synthetic; R: glm(cbind(s, m-s) ~ x1, binomial(probit))")
+
     out = os.path.join(HERE, "r_golden.json")
     with open(out, "w") as f:
         json.dump(cases, f, indent=1)

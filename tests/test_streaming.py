@@ -101,6 +101,44 @@ def test_streaming_memmap_source(tmp_path, mesh8, rng):
                                rtol=1e-6, atol=1e-9)
 
 
+def test_streaming_checkpoint_resume(mesh8, rng):
+    """Interrupt-and-resume via the on_iteration checkpoint hook + beta0
+    warm start: the resumed fit reaches the same solution as an unbroken
+    one (SURVEY.md §5: the reference has no recovery story at all)."""
+    X, bt = _data(rng, n=3000)
+    lam = np.exp(np.clip(X @ (bt / 4), -4, 4))
+    y = rng.poisson(lam).astype(np.float64)
+    kw = dict(family="poisson", tol=1e-12, criterion="relative",
+              chunk_rows=512, mesh=mesh8)
+
+    full = sg.glm_fit_streaming((X, y), **kw)
+
+    # run 1: "crash" after two iterations, keeping the checkpoint
+    ckpt = {}
+
+    class Crash(Exception):
+        pass
+
+    def hook(it, beta, dev):
+        ckpt.update(it=it, beta=beta, dev=dev)
+        if it == 2:
+            raise Crash
+
+    try:
+        sg.glm_fit_streaming((X, y), on_iteration=hook, **kw)
+        raise AssertionError("hook should have interrupted the fit")
+    except Crash:
+        pass
+    assert ckpt["it"] == 2
+
+    # run 2: resume from the checkpointed beta
+    resumed = sg.glm_fit_streaming((X, y), beta0=ckpt["beta"], **kw)
+    np.testing.assert_allclose(resumed.coefficients, full.coefficients,
+                               rtol=1e-10, atol=1e-12)
+    assert resumed.deviance == pytest.approx(full.deviance, rel=1e-12)
+    assert resumed.iterations < full.iterations  # warm start saved work
+
+
 def test_streaming_zero_weight_rows_match_resident(mesh8, rng):
     """User zero-weight rows must count toward n_obs/df exactly as the
     resident engines count them (they are not shard padding)."""
