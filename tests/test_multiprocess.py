@@ -63,10 +63,21 @@ wg = dist.host_shard_to_global(w.astype(np.float32), mesh)
 model = sg.glm_fit(Xg, yg, weights=wg, family="poisson", mesh=mesh,
                    has_intercept=True, xnames=terms.xnames,
                    criterion="relative", tol=1e-10)
+
+# offset variant: exercises _fit_global's intercept+offset null model
+# (second collective IRLS on a ones design) and the all-zero-offset check
+off = np.full(tgt, 0.1, np.float32); off[len(cols["x1"]):] = 0.0
+og = dist.host_shard_to_global(off, mesh)
+model_off = sg.glm_fit(Xg, yg, weights=wg, offset=og, family="poisson",
+                       mesh=mesh, has_intercept=True, xnames=terms.xnames,
+                       criterion="relative", tol=1e-10)
 if dist.process_index() == 0:
     with open(out_path, "w") as f:
         json.dump({
             "terms_signature": sig,
+            "off_coefficients": model_off.coefficients.tolist(),
+            "off_null_deviance": model_off.null_deviance,
+            "off_has_offset": model_off.has_offset,
             "coefficients": model.coefficients.tolist(),
             "std_errors": model.std_errors.tolist(),
             "deviance": model.deviance,
@@ -159,3 +170,15 @@ def test_two_process_csv_fit(tmp_path):
     assert got["null_deviance"] == pytest.approx(ref.null_deviance, rel=1e-5)
     assert got["loglik"] == pytest.approx(ref.loglik, rel=1e-5)
     assert got["aic"] == pytest.approx(ref.aic, rel=1e-5)
+
+    # offset variant: parity incl. the offset-aware null deviance (an
+    # intercept-only collective IRLS inside _fit_global)
+    ref_off = sg.glm_fit(X, np.asarray(cols["y"], np.float32),
+                         offset=np.full(n, 0.1, np.float32),
+                         family="poisson", criterion="relative", tol=1e-10,
+                         xnames=terms.xnames)
+    assert got["off_has_offset"] is True
+    np.testing.assert_allclose(got["off_coefficients"], ref_off.coefficients,
+                               rtol=0, atol=5e-6)
+    assert got["off_null_deviance"] == pytest.approx(ref_off.null_deviance,
+                                                     rel=1e-5)
