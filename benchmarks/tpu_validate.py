@@ -3,7 +3,10 @@
 Run on real TPU hardware (axon tunnel).  Produces JSON on stdout:
   - pallas_vs_ref: max abs diff of (XtWX, XtWz, dev) Pallas vs XLA twin
   - fused_vs_einsum_beta: coefficient parity of full fits at f32
-  - timing table per p in {32, 128, 512, 1024}: fused vs einsum s/iter
+  - timing table per p in {32, 128, 512, 1024}, three variants per row:
+    "fused" (Pallas), "einsum" (default f32 precision) and "einsum_high"
+    (matmul_precision="high", ~bf16x3 on the MXU) — the data for setting
+    engine="auto"'s crossover and the precision/speed trade.
 """
 from __future__ import annotations
 
@@ -73,26 +76,32 @@ def main():
 
     # ---- 3. engine timing sweep: n chosen so n*p^2 work stays ~5e11 ----
     timing = {}
+    from sparkglm_tpu.config import NumericConfig
+    variants = [("fused", "fused", {}), ("einsum", "einsum", {}),
+                ("einsum_high", "einsum",
+                 dict(config=NumericConfig(matmul_precision="high")))]
     for p3 in (32, 128, 512, 1024):
         n3 = int(min(4_194_304, max(262_144, 5e11 / p3 ** 2)))
         n3 = (n3 // 4096) * 4096
         X3, y3 = make_logistic(n3, p3, seed=p3)
         row = {}
-        for engine in ("fused", "einsum"):
+        for label, engine, extra in variants:
             try:
                 t0 = time.perf_counter()
                 m = glm_mod.fit(X3, y3, family="binomial", engine=engine,
-                                criterion="relative", tol=1e-8, max_iter=8)
+                                criterion="relative", tol=1e-8, max_iter=8,
+                                **extra)
                 warm = time.perf_counter() - t0
                 t0 = time.perf_counter()
                 m = glm_mod.fit(X3, y3, family="binomial", engine=engine,
-                                criterion="relative", tol=1e-8, max_iter=8)
+                                criterion="relative", tol=1e-8, max_iter=8,
+                                **extra)
                 hot = time.perf_counter() - t0
-                row[engine] = {"hot_s": round(hot, 4), "warm_s": round(warm, 4),
-                               "iters": m.iterations,
-                               "s_per_iter": round(hot / max(1, m.iterations), 5)}
+                row[label] = {"hot_s": round(hot, 4), "warm_s": round(warm, 4),
+                              "iters": m.iterations,
+                              "s_per_iter": round(hot / max(1, m.iterations), 5)}
             except Exception as e:  # noqa: BLE001
-                row[engine] = {"error": repr(e)[:200]}
+                row[label] = {"error": repr(e)[:200]}
         timing[f"n={n3},p={p3}"] = row
         print(f"  timed p={p3}: {row}", file=sys.stderr)
     OUT["timing"] = timing

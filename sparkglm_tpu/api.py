@@ -60,7 +60,7 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
 
 
 def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
-       singular: str = "drop",
+       singular: str = "drop", engine: str = "auto",
        config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """R-style ``lm(formula, data)`` (ref: sparkLM, R/pkg/R/LM.R:24-44).
 
@@ -75,7 +75,8 @@ def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
         weights = _subset_extra(weights, keep, "weights")
     model = lm_mod.fit(
         X, y, weights=weights, xnames=terms.xnames, yname=f.response,
-        has_intercept=f.intercept, mesh=mesh, singular=singular, config=config)
+        has_intercept=f.intercept, mesh=mesh, singular=singular,
+        engine=engine, config=config)
     import dataclasses
     return dataclasses.replace(model, formula=str(f), terms=terms)
 
