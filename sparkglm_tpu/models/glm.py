@@ -539,11 +539,12 @@ def _fit_global(
     wt_loc, off_loc = wt_pre, off_pre
     eta_loc = np.asarray(dist.local_rows_of(out["eta"]), np.float64)
     cs = hoststats.glm_chunk_stats(fam.name, lnk.name, y_loc, eta_loc, wt_loc)
-    keys = ("dev", "pearson", "wt_sum", "wy", "ll_stat", "n")
+    keys = ("dev", "pearson", "wt_sum", "wy", "ll_stat", "n", "n_boundary")
     tot = dict(zip(keys, dist.allsum_f64([cs[k] for k in keys])))
     dev = tot["dev"]
     ll = hoststats.ll_finalize(fam.name, tot["ll_stat"], dev, tot["wt_sum"],
                                tot["n"])
+    hoststats.warn_separation(tot["n_boundary"])
 
     if has_intercept and has_offset:
         ones_g = jax.jit(lambda v: jnp.ones_like(v)[:, None])(y)
@@ -833,6 +834,7 @@ def fit(
     eta = np.asarray(out["eta"], np.float64)[:n]
     hs = hoststats.glm_stats(fam.name, lnk.name, y64, eta, wt64)
     dev = hs["dev"]
+    hoststats.warn_separation(hs["n_boundary"])
     if has_intercept and has_offset:
         # R semantics: with an offset, the null model is an intercept-only
         # GLM honouring the offset — run the same kernel on a ones design.

@@ -56,21 +56,26 @@ def _mask_sum(x, valid) -> float:
     return float(np.sum(x))
 
 
-def link_inverse(name: str, eta: np.ndarray) -> np.ndarray:
+def link_inverse(name: str, eta: np.ndarray, *, raw: bool = False) -> np.ndarray:
     """f64 inverse link, mirroring the saturation guards in families/links.py
-    so host mu agrees with device mu up to transcendental precision."""
+    so host mu agrees with device mu up to transcendental precision.
+    ``raw=True`` skips the (0,1) clip — used by the separation check, which
+    needs R's ~1e-15 threshold, far inside the 1e-7 display clamp."""
     eta = np.asarray(eta, np.float64)
     if name == "identity":
         return eta
     if name == "log":
         return np.exp(np.clip(eta, -_ETA_MAX, _ETA_MAX))
     if name == "logit":
-        return np.clip(sp.expit(eta), _MU_EPS, 1.0 - _MU_EPS)
+        m = sp.expit(eta)
+        return m if raw else np.clip(m, _MU_EPS, 1.0 - _MU_EPS)
     if name == "probit":
-        return np.clip(sp.ndtr(eta), _MU_EPS, 1.0 - _MU_EPS)
+        m = sp.ndtr(eta)
+        return m if raw else np.clip(m, _MU_EPS, 1.0 - _MU_EPS)
     if name == "cloglog":
         e = np.clip(eta, -_ETA_MAX, _ETA_MAX)
-        return np.clip(-np.expm1(-np.exp(e)), _MU_EPS, 1.0 - _MU_EPS)
+        m = -np.expm1(-np.exp(e))
+        return m if raw else np.clip(m, _MU_EPS, 1.0 - _MU_EPS)
     if name == "inverse":
         return 1.0 / eta
     if name == "sqrt":
@@ -209,6 +214,32 @@ def loglik(family: str, y, mu, wt, dev: float) -> float:
                        float(wt.sum()), float(np.asarray(y).shape[0]))
 
 
+_R_BOUNDARY_EPS = 10.0 * np.finfo(np.float64).eps  # R glm.fit's eps
+
+
+def _count_boundary(family: str, link: str, eta, valid) -> int:
+    """Rows whose UNCLIPPED fitted probability is numerically 0 or 1, at
+    R's threshold (10*.Machine$double.eps) — the 1e-7 display clamp in
+    link_inverse is ~8 orders looser and would flag legitimate rare-event
+    fits R stays silent about."""
+    if _base(family) != "binomial":
+        return 0
+    mu_raw = link_inverse(link, eta, raw=True)
+    return int(np.sum(valid & ((mu_raw < _R_BOUNDARY_EPS)
+                               | (mu_raw > 1.0 - _R_BOUNDARY_EPS))))
+
+
+def warn_separation(n_boundary) -> None:
+    """R's glm.fit separation warning — one home for the message every
+    engine (resident, streaming, multi-process) emits."""
+    if n_boundary > 0:
+        import warnings
+        warnings.warn(
+            f"fitted probabilities numerically 0 or 1 occurred "
+            f"({int(n_boundary)} rows) — possible separation; "
+            "coefficients/SEs may be unstable", stacklevel=3)
+
+
 def glm_chunk_stats(family: str, link: str, y, eta, wt) -> dict:
     """Summable per-chunk aggregates (the streaming engine adds these across
     chunks; ``ll_stat`` is finalized against the TOTAL deviance afterwards
@@ -228,6 +259,10 @@ def glm_chunk_stats(family: str, link: str, y, eta, wt) -> dict:
         # R's n.ok: zero-weight rows are excluded from df and from the
         # gaussian logLik's nobs (glm.fit subsets on weights > 0)
         n=int(np.sum(valid)),
+        # ingredient for R's "fitted probabilities numerically 0 or 1
+        # occurred" separation warning, at R's own threshold
+        # (10 * double eps on the UNCLIPPED mu — glm.fit semantics)
+        n_boundary=_count_boundary(family, link, eta, valid),
     )
 
 
@@ -259,6 +294,7 @@ def glm_stats(family: str, link: str, y, eta, wt) -> dict:
         loglik=ll_finalize(family, s["ll_stat"], s["dev"], s["wt_sum"],
                            float(s["n"])),
         wt_sum=s["wt_sum"],
+        n_boundary=s["n_boundary"],
     )
 
 

@@ -419,6 +419,8 @@ def glm_fit_streaming(
         stats = d if stats is None else {k: stats[k] + d[k] for k in stats}
 
     n = n_total
+    if not _null_model:
+        hoststats.warn_separation(stats["n_boundary"])
 
     # null deviance, matching the resident engine's R semantics
     # (models/glm.py): weighted-mean null for intercept+no-offset; an

@@ -123,6 +123,48 @@ def test_zero_weight_rows_do_not_poison_host_stats(rng):
     assert m.aic == pytest.approx(m2.aic, rel=1e-8)
 
 
+def test_separation_warns_like_r(rng):
+    """Complete separation: R warns 'fitted probabilities numerically 0 or
+    1 occurred'; so do we (resident and streaming engines)."""
+    n = 400
+    x = np.concatenate([rng.uniform(-2, -0.5, n // 2),
+                        rng.uniform(0.5, 2, n // 2)])
+    y = (x > 0).astype(np.float64)  # perfectly separated
+    X = np.column_stack([np.ones(n), x])
+    with pytest.warns(UserWarning, match="numerically 0 or 1"):
+        glm_mod.fit(X, y, family="binomial", max_iter=30)
+    from sparkglm_tpu.models.streaming import glm_fit_streaming
+    with pytest.warns(UserWarning, match="numerically 0 or 1"):
+        glm_fit_streaming((X, y), family="binomial", max_iter=30,
+                          chunk_rows=128)
+
+
+def test_no_separation_warning_on_clean_fit(rng):
+    n = 500
+    x = rng.standard_normal(n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-0.5 * x))).astype(np.float64)
+    X = np.column_stack([np.ones(n), x])
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        glm_mod.fit(X, y, family="binomial")
+
+
+def test_no_separation_warning_on_rare_events(rng):
+    """Legit rare-event model (all fitted p ~ 1e-8): R stays silent — the
+    detection threshold is R's ~2e-15 on the UNCLIPPED mu, not the 1e-7
+    display clamp (r2 review finding)."""
+    n = 5000
+    x = rng.standard_normal(n)
+    y = np.zeros(n)
+    y[:3] = 1.0  # a few events, no separation structure
+    X = np.column_stack([np.ones(n), 0.01 * x])
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        m = glm_mod.fit(X, y, family="binomial", max_iter=60, tol=1e-8,
+                        criterion="relative")
+    assert m.coefficients[0] < -5  # intercept ~ log(3/n), fitted p tiny
+
+
 def test_offset_col_roundtrips_through_save(tmp_path, rng):
     n = 200
     expo = rng.uniform(0.5, 3.0, n)
