@@ -80,6 +80,12 @@ def main() -> None:
     from sparkglm_tpu.models.glm import _irls_kernel
     from sparkglm_tpu.parallel import mesh as meshlib
 
+    if not on_tpu:
+        print("bench: TPU tunnel unreachable after all retries — running the "
+              "CPU fallback.  The round's real TPU capture (incl. Pallas "
+              "parity) is committed at benchmarks/bench_detail_latest.json; "
+              "this run writes benchmarks/bench_detail_cpu_fallback.json "
+              "and does NOT overwrite it.", file=sys.stderr)
     n, p = (2_097_152, 512) if on_tpu else (65_536, 32)
     mesh = sg.make_mesh()
     row_sharding = NamedSharding(mesh, P(meshlib.DATA_AXIS))
@@ -201,8 +207,10 @@ def main() -> None:
     print(json.dumps(detail, indent=1), file=sys.stderr)
     try:
         here = os.path.dirname(os.path.abspath(__file__))
-        with open(os.path.join(here, "benchmarks",
-                               "bench_detail_latest.json"), "w") as f:
+        # a CPU fallback must never clobber the committed TPU capture
+        name = ("bench_detail_latest.json" if on_tpu
+                else "bench_detail_cpu_fallback.json")
+        with open(os.path.join(here, "benchmarks", name), "w") as f:
             json.dump(detail, f, indent=1)
     except OSError:
         pass
