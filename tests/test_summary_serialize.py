@@ -73,3 +73,31 @@ def test_save_load_roundtrip_glm_with_terms(tmp_path, mesh1):
     assert m2.family == "binomial" and m2.link == "logit"
     assert m2.terms is not None and m2.terms.xnames == m.terms.xnames
     np.testing.assert_allclose(sg.predict(m2, data), sg.predict(m, data))
+
+
+def test_glm_summary_golden_layout_dobson(mesh1):
+    """Golden-string summary check on the Dobson ?glm fixture — the
+    reference's own test mechanism (test_LM.R:44), pointed at output that
+    matches R's summary.glm layout and numbers at print precision."""
+    counts = np.array([18, 17, 15, 20, 10, 20, 25, 13, 12], float)
+    o = np.array(["1", "2", "3"] * 3)
+    t = np.array(["1"] * 3 + ["2"] * 3 + ["3"] * 3)
+    m = sg.glm("counts ~ o + t", {"counts": counts, "o": o, "t": t},
+               family="poisson", mesh=mesh1)
+    text = str(m.summary())
+    for needle in (
+        "Family: poisson  Link: log",
+        "Coefficients:",
+        "Estimate  Std. Error",
+        "Pr(>|z|)",
+        "3.045",      # intercept estimate (R: 3.0445)
+        "0.1709",     # its SE (R: 0.1709)
+        "-0.4543",    # o_2 (R outcome2: -0.4543)
+        "Signif. codes:",
+        "(Dispersion parameter for poisson family taken to be 1",
+        "Null deviance: 10.58",
+        "Residual deviance: 5.129",
+        "AIC: 56.76",
+        "Number of Fisher Scoring iterations:",
+    ):
+        assert needle in text, f"summary missing {needle!r}:\n{text}"
