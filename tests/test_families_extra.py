@@ -156,3 +156,25 @@ def test_profiling_timer(mesh1, rng):
     out = jnp.ones((64, 64)) @ jnp.ones((64, 64))
     dt = t.stop(out)
     assert dt > 0 and t.elapsed == dt
+
+
+def test_gaussian_log_negative_y_fits_where_r_needs_mustart(mesh1, rng):
+    """gaussian/log with negative responses: R's glm errors ('cannot find
+    valid starting values') because its init takes log(y); our guarded init
+    self-starts and converges to the true nonlinear-LS optimum (verified
+    against scipy.optimize.least_squares to 1e-9 in r2)."""
+    import warnings as _w
+    from scipy.optimize import least_squares
+    n = 1000
+    X = np.column_stack([np.ones(n), rng.normal(size=(n, 2))])
+    bt = np.array([-0.5, 0.4, -0.3])
+    y = np.exp(X @ bt) + 0.5 * rng.normal(size=n)
+    assert (y <= 0).sum() > 50  # the regime R cannot self-start in
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        m = sg.glm_fit(X, y, family="gaussian", link="log", tol=1e-12,
+                       criterion="relative", max_iter=200, mesh=mesh1)
+    r = least_squares(lambda b: np.exp(X @ b) - y, np.zeros(3),
+                      xtol=1e-15, ftol=1e-15)
+    np.testing.assert_allclose(m.coefficients, r.x, atol=1e-6)
+    assert m.deviance == pytest.approx(float(np.sum(r.fun ** 2)), rel=1e-9)
