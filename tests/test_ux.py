@@ -123,6 +123,20 @@ def test_zero_weight_rows_do_not_poison_host_stats(rng):
     assert m.aic == pytest.approx(m2.aic, rel=1e-8)
 
 
+def test_verbose_trace_runs_under_jit(rng, capfd):
+    """verbose=True turns on the in-loop jax.debug.print trace (the
+    reference's only progress signal, GLM.scala:304,461) — it must compile
+    and emit per-iteration lines, plus the host-side completion summary."""
+    X, y = _poisson_data(rng, n=300)
+    m = glm_mod.fit(X, y, family="poisson", verbose=True, max_iter=50)
+    import jax
+    jax.effects_barrier()
+    out = capfd.readouterr().out  # capfd sees both print and debug.print
+    assert "IRLS finished" in out
+    assert "deviance" in out and "iter" in out
+    assert m.converged
+
+
 def test_separation_warns_like_r(rng):
     """Complete separation: R warns 'fitted probabilities numerically 0 or
     1 occurred'; so do we (resident and streaming engines)."""
