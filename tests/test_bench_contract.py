@@ -1,0 +1,31 @@
+"""The driver contract: ``python bench.py`` must print EXACTLY one JSON
+line on stdout with {metric, value, unit, vs_baseline} — even when the TPU
+tunnel is unreachable (the CPU fallback path).  A malformed line loses the
+round's benchmark record, so the contract is CI-enforced."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_cpu_fallback_contract():
+    env = dict(os.environ)
+    env["BENCH_FORCE_CPU"] = "1"
+    r = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py")],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "s" and rec["value"] > 0
+    assert rec["metric"].endswith("_cpu_fallback")
+    # the fallback must not clobber the committed TPU capture
+    detail = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                          "bench_detail_latest.json")
+    with open(detail) as f:
+        assert json.load(f)["platform"] == "tpu"
