@@ -39,14 +39,11 @@ from jax.sharding import PartitionSpec as P
 from ..parallel import mesh as meshlib
 
 
-@partial(jax.jit, static_argnames=("mesh",))
-def tsqr_r(Xw, mesh=None):
-    """Upper-triangular R with R'R = Xw'Xw for a row-sharded Xw.
-
-    Per-shard ``qr(mode="r")`` + all-gather of the (p, p) partial factors +
-    one final QR of the stacked factors, computed identically (hence
-    replicated) on every device.  Without a mesh: plain local QR.
-    """
+def _householder_tsqr(Xw, mesh=None):
+    """Per-shard ``qr(mode="r")`` + all-gather of the (p, p) partial
+    factors + one final QR of the stacked factors, computed identically
+    (hence replicated) on every device.  Without a mesh: plain local QR.
+    The robust path — works at any kappa the data can express."""
     if mesh is None:
         return jnp.linalg.qr(Xw, mode="r")
     d = meshlib.DATA_AXIS
@@ -59,6 +56,64 @@ def tsqr_r(Xw, mesh=None):
     return jax.shard_map(
         f, mesh=mesh, in_specs=(P(d, None),), out_specs=P(),
         check_vma=False)(Xw)
+
+
+def _cholqr2_r(Xw):
+    """R factor via CholeskyQR2 (Fukaya et al.): R1 = chol(Xw'Xw), then
+    re-orthogonalize Y = Xw R1^{-1} and R = chol(Y'Y) R1.
+
+    Everything is MXU work (two Gramian einsums GSPMD turns into
+    matmul+psum, two p x p Choleskys, one triangular solve with n RHS) —
+    no Householder reflections, so it is the fast path on TPU.  Numerically
+    equivalent to Householder QR while the FIRST Gramian is numerically PD,
+    i.e. kappa(Xw) ≲ 1/sqrt(eps); beyond that chol produces NaN and the
+    caller falls back.  Returns (R, ok).
+    """
+    # full-precision dots: the accuracy contract is ~eps_f32*kappa, and a
+    # reduced-precision (bf16-multiply) Gramian would either NaN the first
+    # Cholesky at modest kappa or silently degrade R (ops/fused.py sets the
+    # same for the same reason); accumulate at least in f32
+    acc = Xw.dtype if Xw.dtype == jnp.float64 else jnp.float32
+    hi = jax.lax.Precision.HIGHEST
+    A1 = jnp.einsum("np,nq->pq", Xw, Xw, preferred_element_type=acc,
+                    precision=hi)
+    U1 = jnp.linalg.cholesky(0.5 * (A1 + A1.T)).T      # upper: U1'U1 = A1
+    ok1 = jnp.all(jnp.isfinite(U1))
+    U1s = jnp.where(ok1, U1, jnp.eye(U1.shape[0], dtype=acc))
+    # Y = Xw U1^{-1}  via  Y' = U1^{-T} Xw'
+    Y = solve_triangular(U1s.T.astype(Xw.dtype), Xw.T, lower=True).T
+    A2 = jnp.einsum("np,nq->pq", Y, Y, preferred_element_type=acc,
+                    precision=hi)
+    U2 = jnp.linalg.cholesky(0.5 * (A2 + A2.T)).T
+    R = U2 @ U1s
+    ok = ok1 & jnp.all(jnp.isfinite(R))
+    return R, ok
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def tsqr_r(Xw, mesh=None):
+    """Upper-triangular R with R'R = Xw'Xw for a row-sharded Xw.
+
+    Fast path: CholeskyQR2 (all-MXU).  When its first Cholesky detects a
+    kappa beyond ~1/sqrt(eps) (NaN factor), fall back to the Householder
+    tree QR, which is stable at any representable kappa.  Both give R at
+    backward error ~eps*kappa(Xw).
+    """
+    R_fast, ok = _cholqr2_r(Xw)
+
+    # sign-normalize (non-negative diagonal) so the two paths agree — QR's
+    # R is unique up to row signs
+    def norm_sign(R):
+        s = jnp.where(jnp.diag(R) < 0, -1.0, 1.0).astype(R.dtype)
+        return R * s[:, None]
+
+    # `ok` is replicated (derived from the psum'd Gramian), so every device
+    # takes the same branch and the Householder path's collectives only run
+    # when actually needed
+    return jax.lax.cond(
+        ok,
+        lambda: norm_sign(R_fast),
+        lambda: norm_sign(_householder_tsqr(Xw, mesh)))
 
 
 def r_pivot(R):
@@ -88,10 +143,13 @@ def qr_wls(X, z, w, *, mesh=None):
         return solve_triangular(
             R, solve_triangular(R.T, v, lower=True), lower=False)
 
-    c = jnp.einsum("np,n->p", X, w * z, preferred_element_type=X.dtype)
+    hi = jax.lax.Precision.HIGHEST
+    c = jnp.einsum("np,n->p", X, w * z, preferred_element_type=X.dtype,
+                   precision=hi)
     beta = solve_rr(c)                                   # seminormal
     r = (z - X @ beta) * w
-    g = jnp.einsum("np,n->p", X, r, preferred_element_type=X.dtype)
+    g = jnp.einsum("np,n->p", X, r, preferred_element_type=X.dtype,
+                   precision=hi)
     beta = beta + solve_rr(g)                            # corrected step
     return beta, R, pivot
 
@@ -123,7 +181,8 @@ def csne_polish(X, z, w, beta, *, mesh=None, steps: int = 2):
     def grad(b):
         # X'W(z - Xb): one fused data pass (GSPMD inserts the psum)
         r = (z - X @ b) * w
-        return jnp.einsum("np,n->p", X, r, preferred_element_type=X.dtype)
+        return jnp.einsum("np,n->p", X, r, preferred_element_type=X.dtype,
+                          precision=jax.lax.Precision.HIGHEST)
 
     g = grad(beta)
     gn = jnp.sum(g * g)

@@ -20,6 +20,33 @@ def _conditioned(rng, n, p, kappa):
     return np.column_stack([np.ones(n), (Z @ V) * s @ V.T])
 
 
+@pytest.mark.parametrize("kappa", [1e1, 1e3, 3e4])
+def test_tsqr_r_accurate_across_cholqr2_fallback(mesh1, rng, kappa):
+    """tsqr_r's CholeskyQR2 fast path covers kappa up to ~1/sqrt(eps) and
+    must hand off to Householder beyond it (f32: the first Gramian goes
+    numerically non-PD around kappa ~3e3).  Either way R'R must reproduce
+    Xw'Xw at ~eps*kappa accuracy."""
+    import jax.numpy as jnp
+    from sparkglm_tpu.ops.tsqr import tsqr_r
+    from sparkglm_tpu.parallel import mesh as meshlib
+    n, p = 8192, 10
+    X = _conditioned(rng, n, p, kappa).astype(np.float32)
+    Xd = meshlib.shard_rows(X, mesh1)
+    R = np.asarray(tsqr_r(Xd, mesh1), np.float64)
+    assert np.all(np.isfinite(R))
+    assert np.all(np.diag(R) >= 0)  # sign-normalized
+    G64 = X.astype(np.float64).T @ X.astype(np.float64)
+    scale = np.max(np.abs(G64))
+    assert np.max(np.abs(R.T @ R - G64)) / scale < 3e-6
+    # FORWARD error vs the true f64 QR factor — the property CSNE's error
+    # bound needs; backward error alone is satisfied even by a degraded
+    # normal-equations factor (r2 review finding)
+    R64 = np.linalg.qr(X.astype(np.float64), mode="r")
+    R64 = R64 * np.where(np.diag(R64) < 0, -1.0, 1.0)[:, None]
+    fwd = np.max(np.abs(R - R64)) / np.max(np.abs(R64))
+    assert fwd < 3e-7 * max(kappa, 10.0)  # ~eps32 * kappa with slack
+
+
 def test_tsqr_r_matches_host_qr(mesh8, rng):
     import jax.numpy as jnp
     from sparkglm_tpu.ops.tsqr import tsqr_r
