@@ -535,9 +535,18 @@ def _fit_global(
             "no aliasing path; drop dependent columns before sharding)")
 
     # host-f64 statistics from per-process partial sums
+    from .validate import check_finite_design, check_finite_vector
     y_loc = np.asarray(dist.local_rows_of(y), np.float64)
-    wt_loc, off_loc = wt_pre, off_pre
+    check_finite_vector("y", y_loc[wt_pre > 0])
+    check_finite_vector("weights", wt_pre)
+    check_finite_vector("offset", off_pre)
     eta_loc = np.asarray(dist.local_rows_of(out["eta"]), np.float64)
+    if not np.all(np.isfinite(eta_loc[wt_pre > 0])):
+        check_finite_design(dist.local_rows_of(X))
+        raise FloatingPointError(
+            "non-finite linear predictor at the solution on this process; "
+            "the fit diverged — try rescaled predictors or a smaller max_iter")
+    wt_loc, off_loc = wt_pre, off_pre
     cs = hoststats.glm_chunk_stats(fam.name, lnk.name, y_loc, eta_loc, wt_loc)
     keys = ("dev", "pearson", "wt_sum", "wy", "ll_stat", "n", "n_boundary")
     tot = dict(zip(keys, dist.allsum_f64([cs[k] for k in keys])))
@@ -692,8 +701,12 @@ def fit(
     wt64 = (np.ones((n,), np.float64) if weights is None
             else _check_len(weights, "weights").astype(np.float64))
     y64 = y.astype(np.float64, copy=True)
+    from .validate import check_finite_design, check_finite_vector
+    check_finite_vector("y", y64)
+    check_finite_vector("weights", wt64)
     if m is not None:
         m64 = _check_len(m, "m").astype(np.float64)
+        check_finite_vector("m", m64)  # before it blends into y/weights
         if fam.name not in ("binomial", "quasibinomial"):
             raise ValueError(
                 "group sizes m only apply to the (quasi)binomial family")
@@ -701,6 +714,7 @@ def fit(
         wt64 = wt64 * m64
     off64 = (np.zeros((n,), np.float64) if offset is None
              else _check_len(offset, "offset").astype(np.float64))
+    check_finite_vector("offset", off64)
     y = y64.astype(dtype)
     wt = wt64.astype(dtype)
     off = off64.astype(dtype)
@@ -823,6 +837,9 @@ def fit(
                       singular="error", verbose=verbose, config=config)
             return expand_aliased(sub, mask, xnames)
     if bool(out["singular"]):
+        # vectors were validated up front; name a non-finite design before
+        # claiming singularity (the X scan runs only on this failure path)
+        check_finite_design(X[:n])
         raise np.linalg.LinAlgError(
             "singular weighted Gramian during IRLS; pass singular='drop' for "
             "R-style aliasing or consider jitter in NumericConfig")
@@ -832,6 +849,13 @@ def fit(
     # eta comes back padded (shard/block padding rows at the end); slice to n.
     from . import hoststats
     eta = np.asarray(out["eta"], np.float64)[:n]
+    if not np.all(np.isfinite(eta[wt64 > 0])):
+        # a NaN/Inf in X propagates to eta; the sanitizer would otherwise
+        # silently zero that row out of every statistic (R errors instead)
+        check_finite_design(X[:n])
+        raise FloatingPointError(
+            "non-finite linear predictor at the solution; the fit diverged "
+            "— try engine='qr', a smaller max_iter, or rescaled predictors")
     hs = hoststats.glm_stats(fam.name, lnk.name, y64, eta, wt64)
     dev = hs["dev"]
     hoststats.warn_separation(hs["n_boundary"])

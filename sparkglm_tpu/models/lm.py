@@ -36,6 +36,20 @@ from ..ops.solve import (diag_inv_from_cho, factor_singular,
 from ..parallel import mesh as meshlib
 
 
+def _raise_solve_failure(X, y, w) -> None:
+    """Name the actual problem the way R does: non-finite inputs get
+    'NA/NaN/Inf in ...' (R's model-frame check), everything else is a
+    genuinely singular design.  The scans only run on this failure path —
+    the happy path never pays them."""
+    from .validate import check_finite_design, check_finite_vector
+    check_finite_vector("y", y)
+    check_finite_vector("weights", w)
+    check_finite_design(X)
+    raise np.linalg.LinAlgError(
+        "singular design in OLS solve; pass singular='drop' for R-style "
+        "aliasing or set NumericConfig(jitter=...)")
+
+
 def expand_aliased(model, mask: np.ndarray, xnames: tuple):
     """Re-expand a model fit on the independent-column subset back to the
     full design: aliased positions get NaN coefficients/SEs (R's NA) and
@@ -325,9 +339,7 @@ def fit(
                       engine=engine, config=config)
             return expand_aliased(sub, mask, xnames)
     if bool(out["singular"]) or not np.all(np.isfinite(out["beta"])):
-        raise np.linalg.LinAlgError(
-            "singular design in OLS solve; pass singular='drop' for R-style "
-            "aliasing or set NumericConfig(jitter=...)")
+        _raise_solve_failure(X, y, w_host)
 
     # the qr engine's corrected-seminormal solve already delivers the
     # polish's ~eps*kappa accuracy — a second TSQR would be pure waste

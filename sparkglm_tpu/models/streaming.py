@@ -213,6 +213,11 @@ def lm_fit_streaming(
             cm = _ones_colmask(Xc)
             ones_mask = cm if ones_mask is None else ones_mask & cm
         n += int(Xc.shape[0])  # true row count (device padding carries w=0)
+        from .validate import check_finite_design, check_finite_vector
+        check_finite_vector("y", np.asarray(yc, np.float64))
+        if wc is not None:
+            check_finite_vector("weights", np.asarray(wc, np.float64))
+        check_finite_design(np.asarray(Xc))
         d = _lm_chunk_pass(*_put_chunk(Xc, yc, wc, oc, mesh, dtype)[:3])
         d = {k: np.asarray(v, np.float64) for k, v in d.items()}
         yc64, wc64, _ = _host_chunk(yc, wc, None)
@@ -341,6 +346,17 @@ def glm_fit_streaming(
                 cm = _ones_colmask(Xc)
                 ones_mask = cm if ones_mask is None else ones_mask & cm
             count += int(Xc.shape[0])
+            if scan_now:
+                # R's NA/NaN/Inf model-frame errors — without this the
+                # kernel sanitizer silently excludes non-finite rows
+                # (models/validate.py); first pass only
+                from .validate import check_finite_design, check_finite_vector
+                check_finite_vector("y", np.asarray(yc, np.float64))
+                if wc is not None:
+                    check_finite_vector("weights", np.asarray(wc, np.float64))
+                if oc is not None:
+                    check_finite_vector("offset", np.asarray(oc, np.float64))
+                check_finite_design(np.asarray(Xc))
             if scan_now and oc is not None and np.any(np.asarray(oc) != 0):
                 saw_offset = True
             dX, dy, dw, do = _put_chunk(Xc, yc, wc, oc, mesh, dtype)

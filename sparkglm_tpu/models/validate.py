@@ -1,0 +1,31 @@
+"""Input-finiteness validation — R's model-frame 'NA/NaN/Inf in ...' errors.
+
+Why this must be explicit: the kernels' padding sanitizer (glm.py::_sanitize)
+zeroes non-finite per-row quantities so weight-0 padding stays inert — which
+means a NaN response or predictor would otherwise be SILENTLY EXCLUDED from
+the fit instead of erroring the way R does.  Every entry point (resident,
+streaming, global-array) routes its checks through here so the messages and
+semantics cannot drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_HINT = " (the formula API's na_omit=True drops incomplete rows)"
+
+
+def check_finite_vector(name: str, v) -> None:
+    """Raise R's "NA/NaN/Inf in '<name>'" for a non-finite per-row vector."""
+    if v is not None and not np.all(np.isfinite(v)):
+        raise ValueError(f"NA/NaN/Inf in '{name}' — drop or impute missing "
+                         f"values{_HINT}")
+
+
+def check_finite_design(X) -> None:
+    """Raise for a non-finite design matrix.  Callers run this lazily (on a
+    failure path or a non-finite eta) so the happy path never pays a full
+    scan of X."""
+    if not np.all(np.isfinite(X)):
+        raise ValueError("NA/NaN/Inf in the design matrix — drop or impute "
+                         f"missing predictors{_HINT}")

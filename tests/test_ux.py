@@ -192,3 +192,54 @@ def test_offset_col_roundtrips_through_save(tmp_path, rng):
     m2 = load_model(path)
     assert m2.offset_col == "log_expo"
     np.testing.assert_allclose(sg.predict(m2, data), sg.predict(m, data))
+
+
+def test_nan_inputs_get_r_style_messages(rng):
+    """Non-finite inputs must be named like R's 'NA/NaN/Inf in ...', not
+    misreported as a singular design."""
+    n = 60
+    X = np.column_stack([np.ones(n), rng.standard_normal(n)])
+    y = rng.standard_normal(n)
+    y_bad = y.copy()
+    y_bad[3] = np.nan
+    from sparkglm_tpu.models import lm as lm_mod
+    with pytest.raises(ValueError, match="NA/NaN/Inf in 'y'"):
+        lm_mod.fit(X, y_bad)
+    X_bad = X.copy()
+    X_bad[5, 1] = np.inf
+    with pytest.raises(ValueError, match="design matrix"):
+        lm_mod.fit(X_bad, y)
+    yp = np.abs(y) + 1
+    with pytest.raises(ValueError, match="NA/NaN/Inf in 'y'"):
+        glm_mod.fit(X, np.where(np.arange(n) == 2, np.nan, yp),
+                    family="gamma", link="log")
+    with pytest.raises(ValueError, match="design matrix"):
+        glm_mod.fit(X_bad, yp, family="gamma", link="log")
+
+
+def test_streaming_nan_inputs_error_and_m_named_correctly(rng):
+    """Streaming engines share the R-style NA errors (r2 review: they
+    silently excluded NaN rows); a NaN in m must be blamed on 'm', not on
+    the y/weights it blends into."""
+    n = 200
+    X = np.column_stack([np.ones(n), rng.standard_normal(n)])
+    y = np.abs(rng.standard_normal(n)) + 1
+    y_bad = y.copy()
+    y_bad[7] = np.nan
+    from sparkglm_tpu.models.streaming import glm_fit_streaming, lm_fit_streaming
+    with pytest.raises(ValueError, match="NA/NaN/Inf in 'y'"):
+        glm_fit_streaming((X, y_bad), family="gamma", link="log",
+                          chunk_rows=64)
+    with pytest.raises(ValueError, match="NA/NaN/Inf in 'y'"):
+        lm_fit_streaming((X, y_bad), chunk_rows=64)
+    X_bad = X.copy()
+    X_bad[3, 1] = np.nan
+    with pytest.raises(ValueError, match="design matrix"):
+        glm_fit_streaming((X_bad, y), family="gamma", link="log",
+                          chunk_rows=64)
+    # NaN in m blamed on m (it is divided into y and multiplied into wt)
+    mg = rng.integers(2, 9, n).astype(float)
+    succ = rng.binomial(mg.astype(int), 0.4).astype(float)
+    mg[5] = np.nan
+    with pytest.raises(ValueError, match="NA/NaN/Inf in 'm'"):
+        glm_mod.fit(X, succ, family="binomial", m=mg)
