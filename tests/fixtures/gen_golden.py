@@ -42,6 +42,7 @@ def _linkinv(link, eta):
         "probit": sp.ndtr,
         "cloglog": lambda e: -np.expm1(-np.exp(e)),
         "inverse": lambda e: 1.0 / e,
+        "sqrt": lambda e: e * e,
         "inverse_squared": lambda e: 1.0 / np.sqrt(e),
     }[link](eta)
 
@@ -264,6 +265,35 @@ def main():
         family="binomial", link="probit",
         fit=r_fit(Xb, s9, "binomial", "probit", m=m9),
         provenance="synthetic; R: glm(cbind(s, m-s) ~ x1, binomial(probit))")
+
+    # -- 10. no-intercept binomial (null model is mu = linkinv(0)) ----------
+    xn = rng.standard_normal(n) + 0.5
+    prn = sp.expit(0.8 * xn)
+    yn = (rng.uniform(size=n) < prn).astype(float)
+    cases["binomial_no_intercept"] = dict(
+        data=dict(x=xn.tolist(), y=yn.tolist()),
+        family="binomial", link="logit", no_intercept=True,
+        fit=r_fit(xn[:, None], yn, "binomial", "logit", has_intercept=False),
+        provenance="synthetic; R: glm(y ~ x - 1, binomial)")
+
+    # -- 11. poisson sqrt link ----------------------------------------------
+    mu_s = (1.5 + 0.4 * x1) ** 2
+    ys = rng.poisson(np.clip(mu_s, 0, 60)).astype(float)
+    cases["poisson_sqrt"] = dict(
+        data=dict(x1=x1.tolist(), y=ys.tolist()),
+        family="poisson", link="sqrt",
+        fit=r_fit(Xb, ys, "poisson", "sqrt"),
+        provenance="synthetic; R: glm(y ~ x1, poisson(sqrt))")
+
+    # -- 12. weighted gamma log link ----------------------------------------
+    wg = rng.uniform(0.5, 3.0, n)
+    mu_g = np.exp(0.4 + 0.3 * x1)
+    yg2 = rng.gamma(4.0, mu_g / 4.0)
+    cases["gamma_log_weighted"] = dict(
+        data=dict(x1=x1.tolist(), w=wg.tolist(), y=yg2.tolist()),
+        family="gamma", link="log",
+        fit=r_fit(Xb, yg2, "gamma", "log", wt=wg),
+        provenance="synthetic; R: glm(y ~ x1, Gamma(log), weights = w)")
 
     out = os.path.join(HERE, "r_golden.json")
     with open(out, "w") as f:

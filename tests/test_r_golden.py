@@ -55,7 +55,11 @@ def _design(case):
     else:
         xcol = d.get("x1", d.get("x"))
         x1 = np.asarray(xcol, float)
-        X = np.column_stack([np.ones(len(x1)), x1])
+        if case.get("no_intercept"):
+            X = x1[:, None]
+            kw["has_intercept"] = False
+        else:
+            X = np.column_stack([np.ones(len(x1)), x1])
         y = np.asarray(d["y"], float)
         if "w" in d:
             kw["weights"] = np.asarray(d["w"], float)
