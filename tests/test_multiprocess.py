@@ -1,6 +1,6 @@
 """REAL multi-process distributed fit (VERDICT r1 missing #2 / next #5).
 
-Launches 2 OS processes, each with 2 virtual CPU devices, joined through
+Launches 2 or 3 OS processes, each with 2 virtual CPU devices, joined through
 ``jax.distributed.initialize`` with a localhost coordinator — the analogue
 of the reference testing its distributed path by partition count in
 local-mode Spark (lmPredict$Test.scala:11-35), but with actual separate
@@ -25,7 +25,8 @@ import pytest
 
 _WORKER = r"""
 import json, sys
-port, pid, csv_path, out_path = sys.argv[1:5]
+port, pid, csv_path, out_path, nproc = sys.argv[1:6]
+nproc = int(nproc)
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 2)
@@ -35,12 +36,13 @@ import sparkglm_tpu as sg
 from sparkglm_tpu.parallel import distributed as dist
 
 dist.initialize(coordinator_address=f"127.0.0.1:{port}",
-                num_processes=2, process_id=int(pid))
-assert jax.process_count() == 2, jax.process_count()
-assert len(jax.devices()) == 4  # 2 processes x 2 local cpu devices
+                num_processes=nproc, process_id=int(pid))
+assert jax.process_count() == nproc, jax.process_count()
+assert len(jax.devices()) == 2 * nproc  # nproc processes x 2 cpu devices
 mesh = dist.global_mesh()
 
-cols = sg.read_csv(csv_path, shard_index=dist.process_index(), num_shards=2)
+cols = sg.read_csv(csv_path, shard_index=dist.process_index(),
+                   num_shards=nproc)
 # global level discovery (ADVICE r1): level "c" exists only in shard 0's
 # byte range — without scan_csv_levels the two hosts would dummy-code
 # designs with different column counts
@@ -99,7 +101,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_csv_fit(tmp_path):
+@pytest.mark.parametrize("nproc", [2, 3])
+def test_multi_process_csv_fit(tmp_path, nproc):
     rng = np.random.default_rng(17)
     n = 4001  # odd: byte-range shards are uneven -> exercises padding
     x1 = rng.standard_normal(n)
@@ -130,10 +133,10 @@ def test_two_process_csv_fit(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker_file), str(port), str(i),
-             str(csv_path), str(out_path)],
+             str(csv_path), str(out_path), str(nproc)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
             cwd="/root/repo")
-        for i in range(2)
+        for i in range(nproc)
     ]
     logs = []
     for pr in procs:
@@ -161,7 +164,7 @@ def test_two_process_csv_fit(tmp_path):
                      criterion="relative", tol=1e-10, xnames=terms.xnames)
 
     assert got["converged"]
-    assert got["n_shards"] == 4
+    assert got["n_shards"] == 2 * nproc
     assert got["df_residual"] == ref.df_residual  # padding rows excluded
     np.testing.assert_allclose(got["coefficients"], ref.coefficients,
                                rtol=0, atol=5e-6)
