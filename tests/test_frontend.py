@@ -153,3 +153,28 @@ def test_formula_na_omission_end_to_end(mesh1):
     }
     m = sg.lm("y ~ x", data, mesh=mesh1)
     assert m.n_obs == 4
+
+
+def test_factor_response_binomial(rng):
+    """Two-level string response: R's glm treats the FIRST (sorted) level
+    as failure, the second as success (api._design)."""
+    n = 600
+    x = rng.normal(size=n)
+    pr = 1 / (1 + np.exp(-(0.4 + 0.9 * x)))
+    yy = np.where(rng.random(n) < pr, "yes", "no")  # sorted: no < yes
+    m = sg.glm("outcome ~ x", {"outcome": yy, "x": x}, family="binomial")
+    # success = "yes": slope positive and near the generating 0.9
+    assert 0.5 < m.coefficients[1] < 1.4
+    mu = sg.predict(m, {"outcome": yy, "x": x})
+    assert np.all((mu > 0) & (mu < 1))
+    # numeric check against fitting the 0/1 encoding directly
+    m01 = sg.glm("y01 ~ x", {"y01": (yy == "yes").astype(float), "x": x},
+                 family="binomial")
+    np.testing.assert_allclose(m.coefficients, m01.coefficients, rtol=1e-8)
+
+
+def test_factor_response_three_levels_rejected(rng):
+    yy = np.array(["a", "b", "c"] * 10)
+    x = rng.normal(size=30)
+    with pytest.raises(ValueError, match="exactly 2 levels"):
+        sg.glm("yy ~ x", {"yy": yy, "x": x}, family="binomial")
