@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -34,7 +35,17 @@ def main() -> None:
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--json", default=None)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of configs 1-5 to run")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge into an existing --json file instead of "
+                         "overwriting; an incoming record replaces any prior "
+                         "record of the same config family (the 'config' name "
+                         "with its trailing _NxP dimensions stripped, so a "
+                         "re-run at a different --scale supersedes)")
     args = ap.parse_args()
+    only = (set(int(s) for s in args.only.split(",")) if args.only
+            else {1, 2, 3, 4, 5})
 
     import jax
 
@@ -54,10 +65,20 @@ def main() -> None:
     row_s = NamedSharding(mesh, P(meshlib.DATA_AXIS))
     mat_s = NamedSharding(mesh, P(meshlib.DATA_AXIS, None))
     results = []
+    if args.merge and args.json and os.path.exists(args.json):
+        with open(args.json) as f:
+            results = json.load(f)
 
     def emit(rec):
+        base = lambda name: re.sub(r"_\d+x\d+$", "", name)
+        results[:] = [r for r in results
+                      if base(r["config"]) != base(rec["config"])]
         results.append(rec)
         print(json.dumps(rec), flush=True)
+        # write incrementally so a timeout mid-harness keeps earlier configs
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=1)
 
     def rows(base: int) -> int:
         return max(4096, int(base * args.scale))
@@ -95,25 +116,28 @@ def main() -> None:
     zeros = lambda n: jnp.zeros((n,), jnp.float32)
 
     # ---- 1. OLS 10k x 20 ---------------------------------------------------
-    n, p = rows(10_000), 20
-    X, y = make_xy(jax.random.PRNGKey(1), n, p, "gaussian")
-    w = ones(n)
+    if 1 in only:
+        n, p = rows(10_000), 20
+        X, y = make_xy(jax.random.PRNGKey(1), n, p, "gaussian")
+        w = ones(n)
 
-    def run_ols():
-        out = _lm_kernel(X, y, w, jnp.float32(0.0), refine_steps=1)
-        float(out["sse"])
-        return out
-    t, _ = timed(run_ols)
-    emit({"config": f"ols_gaussian_{n}x{p}", "seconds": round(t, 5)})
+        def run_ols():
+            out = _lm_kernel(X, y, w, jnp.float32(0.0), refine_steps=1)
+            float(out["sse"])
+            return out
+        t, _ = timed(run_ols)
+        emit({"config": f"ols_gaussian_{n}x{p}", "seconds": round(t, 5)})
 
     # ---- 2/3/4: resident IRLS configs --------------------------------------
     irls_cfgs = [
-        ("logistic", rows(1_000_000), 100, "logistic", "binomial", "logit"),
-        ("poisson", rows(1_000_000), 100, "poisson", "poisson", "log"),
-        ("logistic_gramian_stress", rows(2_000_000), 512, "logistic",
+        (2, "logistic", rows(1_000_000), 100, "logistic", "binomial", "logit"),
+        (3, "poisson", rows(1_000_000), 100, "poisson", "poisson", "log"),
+        (4, "logistic_gramian_stress", rows(2_000_000), 512, "logistic",
          "binomial", "logit"),
     ]
-    for label, n, p, kind, famname, linkname in irls_cfgs:
+    for idx, label, n, p, kind, famname, linkname in irls_cfgs:
+        if idx not in only:
+            continue
         name = f"{label}_{n}x{p}"
         X, y = make_xy(jax.random.PRNGKey(2), n, p, kind)
         w, o = ones(n), zeros(n)
@@ -137,6 +161,8 @@ def main() -> None:
     # Chunks are pre-generated and held in host RAM (2M x 500 f32 = 4 GB)
     # so the measurement is the streaming pipeline (H2D + device compute +
     # host-f64 stats), not numpy's RNG throughput.
+    if 5 not in only:
+        return finish(args, results, jax)
     p5 = 500
     chunk = 1_048_576 // 4
     n5 = rows(2_000_000)
@@ -158,10 +184,15 @@ def main() -> None:
     def source():
         yield from cached
 
+    # cache="auto" pins chunks in HBM on the first pass (the .persist() the
+    # reference lacks): later IRLS iterations are HBM-bound, not H2D-bound.
+    # Over the axon tunnel this matters enormously (sustained H2D throttles
+    # to ~100-200 MB/s after ~1 GB); on a real v5e host it still removes
+    # ~iters x dataset-size of PCIe traffic per fit.
     t0 = time.perf_counter()
     m = sg.glm_fit_streaming(source, family="gamma", link="inverse",
                              tol=1e-8, criterion="relative", max_iter=25,
-                             chunk_rows=chunk, mesh=mesh)
+                             chunk_rows=chunk, mesh=mesh, cache="auto")
     t5 = time.perf_counter() - t0
     n5_real = n_chunks * chunk
     # wall-clock includes the intercept-only null-model streaming IRLS the
@@ -171,11 +202,15 @@ def main() -> None:
     emit({"config": f"gamma_weights_offset_streamed_{n5_real}x{p5}",
           "seconds": round(t5, 2), "iters": m.iterations,
           "converged": bool(m.converged),
-          "est_50Mx500_s": round(t5 * 50_000_000 / n5_real, 1)})
+          "est_50Mx500_s": round(t5 * 50_000_000 / n5_real, 1),
+          "note": "wall-clock includes one-time H2D over the axon tunnel "
+                  "(throttles to ~100-200 MB/s sustained) + R-semantics "
+                  "null-model IRLS; chunk cache makes iterations HBM-bound"})
+    finish(args, results, jax)
 
-    if args.json:
-        with open(args.json, "w") as f:
-            json.dump(results, f, indent=1)
+
+def finish(args, results, jax) -> None:
+    # emit() already persists incrementally after every record
     print(f"platform={jax.default_backend()} devices={len(jax.devices())}",
           file=sys.stderr)
 

@@ -139,6 +139,81 @@ def test_streaming_checkpoint_resume(mesh8, rng):
     assert resumed.iterations < full.iterations  # warm start saved work
 
 
+def test_streaming_device_cache_parity(mesh8, rng):
+    """cache='none' / 'auto' / 'device' are pure transport settings — bitwise
+    the same passes run on the same device arrays, so results are identical.
+    The reference re-ships every partition every iteration (no .persist()
+    anywhere, SURVEY.md §2.4); the cache is the TPU-first fix."""
+    X, bt = _data(rng, n=4000)
+    n = X.shape[0]
+    eta = X @ bt
+    y = rng.poisson(np.exp(eta)).astype(float)
+    off = np.full(n, 0.02)
+    kw = dict(family="poisson", tol=1e-12, criterion="relative",
+              chunk_rows=640, mesh=mesh8)
+    m_none = sg.glm_fit_streaming((X, y, None, off), cache="none", **kw)
+    m_auto = sg.glm_fit_streaming((X, y, None, off), cache="auto", **kw)
+    m_dev = sg.glm_fit_streaming((X, y, None, off), cache="device", **kw)
+    for m in (m_auto, m_dev):
+        np.testing.assert_array_equal(m.coefficients, m_none.coefficients)
+        np.testing.assert_array_equal(m.std_errors, m_none.std_errors)
+        assert m.deviance == m_none.deviance
+        assert m.null_deviance == m_none.null_deviance
+        assert m.iterations == m_none.iterations
+        assert m.n_obs == m_none.n_obs == n
+
+
+def test_streaming_partial_cache_hybrid(mesh8, rng):
+    """A budget too small for the whole dataset caches a prefix and
+    re-streams the rest — results still identical to uncached."""
+    X, bt = _data(rng, n=4096)
+    y = (rng.random(4096) < 1 / (1 + np.exp(-(X @ bt)))).astype(float)
+    kw = dict(family="binomial", tol=1e-12, chunk_rows=512, mesh=mesh8)
+    m_none = sg.glm_fit_streaming((X, y), cache="none", **kw)
+    # each 512 x 6 f64 chunk is ~28 KB on device; budget of 100 KB caches
+    # ~3 of the 8 chunks
+    m_part = sg.glm_fit_streaming((X, y), cache="auto",
+                                  cache_budget_bytes=100_000, **kw)
+    np.testing.assert_array_equal(m_part.coefficients, m_none.coefficients)
+    assert m_part.deviance == m_none.deviance
+    assert m_part.n_obs == m_none.n_obs
+
+
+def test_streaming_cache_skips_source_regeneration(mesh8):
+    """With a complete cache, IRLS iterations never re-invoke the source:
+    chunk generation runs for the first pass and the two host stats passes
+    only — not once per iteration."""
+    p, n_chunks, rows = 4, 3, 512
+    bt = np.array([0.2, -0.3, 0.1, 0.4])
+    calls = {"chunks": 0, "passes": 0}
+
+    def source():
+        calls["passes"] += 1
+        for i in range(n_chunks):
+            r = np.random.default_rng(200 + i)
+            X = r.normal(size=(rows, p)); X[:, 0] = 1.0
+            y = (r.random(rows) < 1 / (1 + np.exp(-(X @ bt)))).astype(float)
+            calls["chunks"] += 1
+            yield X, y, None, None
+
+    m = sg.glm_fit_streaming(source, family="binomial", tol=1e-12,
+                             cache="device", mesh=mesh8)
+    assert m.iterations >= 3
+    # pass 1 (init+cache) + final stats pass + null-deviance pass = 3 source
+    # invocations regardless of iteration count; cache="none" would add one
+    # per IRLS iteration
+    assert calls["passes"] == 3
+    assert calls["chunks"] == 3 * n_chunks
+
+
+def test_streaming_cache_invalid_mode(mesh1, rng):
+    X, bt = _data(rng, n=64)
+    y = (rng.random(64) < 0.5).astype(float)
+    with pytest.raises(ValueError, match="cache"):
+        sg.glm_fit_streaming((X, y), family="binomial", cache="hbm",
+                             mesh=mesh1)
+
+
 def test_streaming_zero_weight_rows_match_resident(mesh8, rng):
     """User zero-weight rows must count toward n_obs/df exactly as the
     resident engines count them (they are not shard padding)."""
