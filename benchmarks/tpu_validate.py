@@ -3,16 +3,19 @@
 Run on real TPU hardware (axon tunnel).  Produces JSON on stdout:
   - pallas_vs_ref: max abs diff of (XtWX, XtWz, dev) Pallas vs XLA twin
   - fused_vs_einsum_beta: coefficient parity of full fits at f32
-  - timing table per p in {32, 128, 512, 1024}, three variants per row:
-    "fused" (Pallas), "einsum" (default f32 precision) and "einsum_high"
-    (matmul_precision="high", ~bf16x3 on the MXU) — the data for setting
-    engine="auto"'s crossover and the precision/speed trade.
+  - timing table per p in {32, 128, 512, 1024} on DEVICE-RESIDENT data,
+    three variants per row: "fused" (Pallas kernel), "fused_xla" (the
+    kernel's XLA twin) and "einsum" (GSPMD einsum engine) — the data behind
+    engine="auto" (models/glm.py).  r02 verdict: einsum wins at every p.
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -75,35 +78,82 @@ def main():
     OUT["einsum_iters"] = m_eins.iterations
 
     # ---- 3. engine timing sweep: n chosen so n*p^2 work stays ~5e11 ----
+    # Data is generated ON DEVICE and stays resident, and the jitted IRLS
+    # kernels are timed directly — over the axon tunnel, fitting host arrays
+    # would time the (throttled) H2D transfer instead of the engine, and on
+    # real hardware a resident measurement is what the engine="auto"
+    # crossover needs anyway.
     timing = {}
-    from sparkglm_tpu.config import NumericConfig
-    variants = [("fused", "fused", {}), ("einsum", "einsum", {}),
-                ("einsum_high", "einsum",
-                 dict(config=NumericConfig(matmul_precision="high")))]
+    from functools import partial as _partial
+
+    from sparkglm_tpu.models.glm import (_fused_block_rows, _irls_fused_kernel,
+                                         _irls_kernel)
+
+    def kernel_variant(label, mesh, block_rows):
+        if label == "fused":
+            return _partial(_irls_fused_kernel, mesh=mesh,
+                            block_rows=block_rows, use_pallas=True)
+        if label == "fused_xla":
+            return _partial(_irls_fused_kernel, mesh=mesh,
+                            block_rows=block_rows, use_pallas=False)
+        return _irls_kernel  # "einsum"
+
+    mesh = sg.make_mesh()
+
+    @_partial(jax.jit, static_argnums=(1, 2))
+    def gen_dev(key, n, p):
+        kx, kb, ku = jax.random.split(key, 3)
+        X = jax.random.normal(kx, (n, p), jnp.float32).at[:, 0].set(1.0)
+        bt = jax.random.normal(kb, (p,), jnp.float32) / (2.0 * p ** 0.5)
+        y = (jax.random.uniform(ku, (n,))
+             < jax.nn.sigmoid(X @ bt)).astype(jnp.float32)
+        return X, y
+
     for p3 in (32, 128, 512, 1024):
         n3 = int(min(4_194_304, max(262_144, 5e11 / p3 ** 2)))
-        n3 = (n3 // 4096) * 4096
-        X3, y3 = make_logistic(n3, p3, seed=p3)
+        block_rows = _fused_block_rows(p3)
+        n3 = (n3 // (block_rows * 8)) * block_rows * 8 or block_rows * 8
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from sparkglm_tpu.parallel import mesh as meshlib
+        row_s = NamedSharding(mesh, P(meshlib.DATA_AXIS))
+        mat_s = NamedSharding(mesh, P(meshlib.DATA_AXIS, None))
+        X3, y3 = gen_dev(jax.random.PRNGKey(p3), n3, p3)
+        # identical row sharding for every engine variant — the einsum
+        # kernel GSPMD-autoshards from the input sharding, the fused kernel
+        # shard_maps over the same mesh; on a multi-device host both then
+        # use all chips (apples-to-apples)
+        X3 = jax.device_put(X3, mat_s)
+        y3 = jax.device_put(y3, row_s)
+        jax.block_until_ready((X3, y3))
+        w3 = jax.device_put(jnp.ones((n3,), jnp.float32), row_s)
+        o3 = jax.device_put(jnp.zeros((n3,), jnp.float32), row_s)
         row = {}
-        for label, engine, extra in variants:
+        for label in ("fused", "fused_xla", "einsum"):
+            kern = kernel_variant(label, mesh, block_rows)
             try:
-                t0 = time.perf_counter()
-                m = glm_mod.fit(X3, y3, family="binomial", engine=engine,
-                                criterion="relative", tol=1e-8, max_iter=8,
-                                **extra)
+                def run():
+                    out = kern(X3, y3, w3, o3, jnp.float32(1e-8),
+                               jnp.int32(8), jnp.float32(0.0), family=fam,
+                               link=lnk, criterion="relative", refine_steps=1)
+                    float(out["dev"])  # block
+                    return out
+                t0 = time.perf_counter(); out = run()
                 warm = time.perf_counter() - t0
-                t0 = time.perf_counter()
-                m = glm_mod.fit(X3, y3, family="binomial", engine=engine,
-                                criterion="relative", tol=1e-8, max_iter=8,
-                                **extra)
-                hot = time.perf_counter() - t0
+                ts = []
+                for _ in range(3):
+                    t0 = time.perf_counter(); out = run()
+                    ts.append(time.perf_counter() - t0)
+                hot = min(ts)
+                iters = int(out["iters"])
                 row[label] = {"hot_s": round(hot, 4), "warm_s": round(warm, 4),
-                              "iters": m.iterations,
-                              "s_per_iter": round(hot / max(1, m.iterations), 5)}
+                              "iters": iters,
+                              "s_per_iter": round(hot / max(1, iters), 5)}
             except Exception as e:  # noqa: BLE001
                 row[label] = {"error": repr(e)[:200]}
         timing[f"n={n3},p={p3}"] = row
         print(f"  timed p={p3}: {row}", file=sys.stderr)
+        del X3, y3, w3, o3
     OUT["timing"] = timing
     print(json.dumps(OUT, indent=1))
 

@@ -53,6 +53,24 @@ class NumericConfig:
 
 DEFAULT = NumericConfig()
 
+# Below this many Gramian MAC operations (n*p^2) a fit is latency-bound, so
+# full-f32 MXU passes are free — and on small-n designs they are *required*
+# for R parity: bf16 product rounding doesn't average out over few rows
+# (measured on v5e: 9-row Dobson poisson lands 1.3e-4 off R with the bf16
+# default, exact at "highest"; a 100k-row fit is ~5e-6 off either way).
+# Large fits keep the fast bf16 default: their rounding noise averages down
+# with n and refine_steps/polish recover the solve digits.
+SMALL_PROBLEM_MAC_CAP = 1 << 31
+
+
+def resolve_matmul_precision(config: "NumericConfig", n: int, p: int,
+                             on_tpu: bool) -> str | None:
+    """The precision actually handed to the Gramian einsums: the user's
+    explicit choice if any, else "highest" for small problems on TPU."""
+    if config.matmul_precision is not None or not on_tpu:
+        return config.matmul_precision
+    return "highest" if n * p * p <= SMALL_PROBLEM_MAC_CAP else None
+
 
 def x64_enabled() -> bool:
     import jax

@@ -38,7 +38,7 @@ import numpy as np
 
 from jax.sharding import PartitionSpec as P
 
-from ..config import DEFAULT, NumericConfig
+from ..config import DEFAULT, NumericConfig, resolve_matmul_precision
 from ..families.families import Family, resolve
 from ..families.links import Link
 from ..ops.fused import fused_fisher_pass, fused_fisher_pass_ref
@@ -496,6 +496,10 @@ def _fit_global(
     from . import hoststats
 
     n_global, p = X.shape
+    mmp = resolve_matmul_precision(config, n_global, p,
+                                   jax.default_backend() == "tpu")
+    if mmp != config.matmul_precision:
+        config = dataclasses.replace(config, matmul_precision=mmp)
     if xnames is None:
         xnames = tuple(f"x{i}" for i in range(p))
     xnames = tuple(xnames)
@@ -635,7 +639,10 @@ def fit(
         (kappa ≳ 1e2 at float32) where the f32 Gramian itself is
         noise-dominated.  Slower per iteration (Householder QR instead of
         one MXU matmul).
-      * ``"auto"`` — ``"fused"`` on TPU when eligible, else ``"einsum"``.
+      * ``"auto"`` — ``"einsum"``: the measured winner at every design
+        width on v5e hardware (benchmarks/engine_sweep_r02.json — XLA's own
+        fusion of the elementwise z/w into the Gramian contraction beats the
+        hand-tiled Pallas kernel 2-4x per iteration).
     """
     from .lm import _detect_intercept
 
@@ -722,15 +729,14 @@ def fit(
     n_data = mesh.shape[meshlib.DATA_AXIS]
     on_tpu = jax.default_backend() == "tpu"
     if engine == "auto":
-        # fused wins where the pass is HBM-bandwidth-bound (narrow designs);
-        # for wide designs the einsum path is MXU-bound and XLA's scheduling
-        # of the f32 multi-pass matmul beats the hand-tiled kernel.  The
-        # fused kernel has a fixed internal precision, so an explicit
-        # matmul_precision request routes to the einsum engine that honours it.
-        fused_ok = (not shard_features and p <= 128
-                    and mesh.shape[meshlib.MODEL_AXIS] == 1 and not use_f64
-                    and config.matmul_precision is None)
-        engine = "fused" if (on_tpu and fused_ok) else "einsum"
+        # Measured on a real v5e (benchmarks/engine_sweep_r02.json,
+        # device-resident data, p in {32,128,512,1024}): the einsum engine's
+        # XLA-fused Gramian beats both the hand-tiled Pallas kernel and its
+        # XLA twin at EVERY width (e.g. p=512: 29 ms/iter vs 64/63; p=32:
+        # 12 ms/iter vs 53/11-tie) — XLA already fuses the elementwise z/w
+        # into the contraction, and its matmul scheduling wins.  So "auto"
+        # is simply einsum; "fused"/"qr" remain explicit opt-ins.
+        engine = "einsum"
     if engine == "fused" and config.matmul_precision is not None:
         import warnings
         warnings.warn("engine='fused' uses a fixed internal matmul precision; "
@@ -743,6 +749,12 @@ def fit(
                                       or mesh.shape[meshlib.MODEL_AXIS] != 1):
         raise ValueError(
             f"engine={engine!r} does not support a sharded feature axis")
+    if engine != "fused":
+        # small problems get full-f32 MXU passes for free — and need them
+        # for R parity (config.resolve_matmul_precision)
+        mmp = resolve_matmul_precision(config, n, p, on_tpu)
+        if mmp != config.matmul_precision:
+            config = dataclasses.replace(config, matmul_precision=mmp)
     # the qr engine's corrected-seminormal solve already delivers the
     # polish's ~eps*kappa accuracy every iteration — skip the redundant TSQR
     polish_active = config.polish == "csne" and engine != "qr"

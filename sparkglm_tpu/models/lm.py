@@ -304,9 +304,16 @@ def fit(
 
     if mesh is None:
         mesh = meshlib.make_mesh()
-    from ..config import x64_enabled
+    from ..config import resolve_matmul_precision, x64_enabled
     dtype = (np.float64 if X.dtype == np.float64 and x64_enabled()
              else np.dtype(config.dtype))
+    # small problems get full-f32 MXU passes for free — and need them for
+    # R parity (config.resolve_matmul_precision)
+    mmp = resolve_matmul_precision(config, n, p,
+                                   jax.default_backend() == "tpu")
+    if mmp != config.matmul_precision:
+        import dataclasses
+        config = dataclasses.replace(config, matmul_precision=mmp)
 
     w_host = np.ones((n,), dtype=dtype) if weights is None else np.asarray(weights, dtype=dtype)
     if w_host.shape != (n,):
