@@ -37,9 +37,10 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
     predictors = f.resolve_predictors(list(cols))
     # by-name weights/offset/m columns join the NA-omit scan so a NaN weight
     # drops its row instead of poisoning the weighted Gramian (R model-frame
-    # semantics)
-    used = [f.response] + predictors + [c for c in extra_cols
-                                        if isinstance(c, str)]
+    # semantics); interaction terms scan their component source columns
+    sources = [c for t in predictors for c in t.split(":")]
+    used = list(dict.fromkeys(
+        [f.response] + sources + [c for c in extra_cols if isinstance(c, str)]))
     n_in = len(next(iter(cols.values()))) if cols else 0
     keep = np.ones(n_in, dtype=bool)
     if na_omit:

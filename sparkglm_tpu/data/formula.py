@@ -1,25 +1,36 @@
 """R-style formula parsing.
 
 Mirrors the reference R front-end's ``parseFormula``
-(/root/reference/R/pkg/R/utils.R:8-22): ``y ~ x1 + x2 + cat`` with only
+(/root/reference/R/pkg/R/utils.R:8-22): ``y ~ x1 + x2 + cat`` with
 ``+``-separated terms and ``1``/``-1``/``0`` intercept markers — and then
 actually *uses* the intercept flag (the reference computes it but every
 caller drops it, so no intercept column is ever added; SURVEY.md §7 L5).
 
-Extension over the reference: ``.`` expands to "all columns except the
-response" (standard R semantics).
+Extensions over the reference (standard R semantics):
+  * ``.`` expands to "all columns except the response".
+  * ``a:b`` interaction terms (any arity, ``a:b:c``), and ``a*b`` crossing
+    which expands to all main effects plus all interactions
+    (``a*b*c`` -> ``a + b + c + a:b + a:c + b:c + a:b:c``), exactly R's
+    expansion.  Duplicate terms (including ``b:a`` vs ``a:b``) collapse to
+    the first occurrence, as in R.
+
+Still rejected, loudly: parentheses, ``^``, ``I(...)``, ``-term`` removal,
+and transforms — fitting a silently different model is worse than an error.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import re
+
+_NAME = r"[A-Za-z_.][A-Za-z0-9_.]*"
 
 
 @dataclasses.dataclass(frozen=True)
 class Formula:
     response: str
-    predictors: tuple
+    predictors: tuple  # canonical term strings; interactions as "a:b"
     intercept: bool
     source: str
 
@@ -27,20 +38,68 @@ class Formula:
         return self.source
 
     def resolve_predictors(self, available: list[str]) -> list[str]:
-        """Expand '.' and validate every named term exists."""
+        """Expand '.' and validate every term component exists in ``available``."""
         out: list[str] = []
+        seen = set()
+
+        def add(term: str) -> None:
+            key = frozenset(term.split(":"))
+            if key not in seen:
+                seen.add(key)
+                out.append(term)
+
         for t in self.predictors:
             if t == ".":
-                out.extend(c for c in available if c != self.response and c not in out)
+                for c in available:
+                    if c != self.response:
+                        add(c)
             else:
-                if t not in available:
-                    raise KeyError(
-                        f"formula term {t!r} not found in data columns {available}")
-                if t not in out:
-                    out.append(t)
+                for comp in t.split(":"):
+                    if comp not in available:
+                        raise KeyError(
+                            f"formula term {comp!r} not found in data "
+                            f"columns {available}")
+                add(t)
         if not out:
             raise ValueError(f"formula {self.source!r} has no predictor terms")
         return out
+
+def _expand_term(sign: str, term: str, formula: str):
+    """One '+'-separated chunk -> list of canonical term strings (R's ``*``
+    crossing: all non-empty subsets, ordered by interaction order)."""
+    if re.fullmatch(r"\d+", term):
+        if term not in ("0", "1"):
+            raise ValueError(
+                f"numeric term {term!r} in {formula!r}: only 1/-1/0 "
+                "intercept markers are supported")
+        return [("#intercept", sign != "-" and term == "1")]
+    if sign == "-":
+        raise ValueError(
+            f"term removal '-{term}' is not supported (only -1/0 for the "
+            "intercept)")
+    if "*" in term:
+        comps = [c.strip() for c in term.split("*")]
+        if any(":" in c for c in comps):
+            # a:b*c is ambiguous to most readers; R allows it but demand
+            # the explicit spelling instead
+            raise ValueError(
+                f"mixed '*' and ':' in one term {term!r}: expand the "
+                "crossing explicitly (a*b == a + b + a:b)")
+        bad = [c for c in comps if not re.fullmatch(_NAME, c)]
+        if bad:
+            raise ValueError(f"invalid name {bad[0]!r} in {formula!r}")
+        expanded = []
+        for size in range(1, len(comps) + 1):
+            for combo in itertools.combinations(comps, size):
+                expanded.append((":".join(combo), None))
+        return expanded
+    comps = [c.strip() for c in term.split(":")]
+    bad = [c for c in comps if not re.fullmatch(_NAME, c)]
+    if bad:
+        raise ValueError(f"invalid name {bad[0]!r} in {formula!r}")
+    # a:a collapses to a (R drops the duplicate component)
+    dedup = list(dict.fromkeys(comps))
+    return [(":".join(dedup), None)]
 
 
 def parse_formula(formula: str) -> Formula:
@@ -51,39 +110,41 @@ def parse_formula(formula: str) -> Formula:
     response = lhs.strip()
     if not response:
         raise ValueError(f"formula needs a response on the left of '~': {formula!r}")
-    if not re.fullmatch(r"[A-Za-z_.][A-Za-z0-9_.]*", response):
+    if not re.fullmatch(_NAME, response):
         raise ValueError(f"invalid response name {response!r}")
 
-    intercept = True
-    predictors: list[str] = []
-    # split on '+' and '-' keeping the sign of each term (utils.R:12-21 keeps
-    # only '+' terms; '-1' removes the intercept).  Reject anything the
-    # grammar doesn't cover ('*', ':', '^', 'I(...)', numeric terms) instead
-    # of silently fitting a different model.
-    token_re = r"([+-]?)\s*([A-Za-z_.][A-Za-z0-9_.]*|\d+)"
+    # term := name ((':'|'*') name)* ; chunks are '+'/'-'-separated.  Reject
+    # anything the grammar doesn't cover ('^', 'I(...)', parentheses)
+    # instead of silently fitting a different model.
+    term_re = rf"(?:{_NAME}|\d+)(?:\s*[:*]\s*(?:{_NAME}|\d+))*"
+    token_re = rf"([+-]?)\s*({term_re})"
     leftover = re.sub(token_re, "", rhs)
     leftover = re.sub(r"[\s+]", "", leftover)
     if leftover:
         raise ValueError(
             f"unsupported formula syntax {leftover!r} in {formula!r}: only "
-            "'+'-separated terms, '.', and 1/-1/0 intercept markers are "
-            "supported (no interactions '*'/':' or transforms)")
+            "'+'-separated terms, interactions ':'/'*', '.', and 1/-1/0 "
+            "intercept markers are supported (no parentheses, '^' or "
+            "transforms)")
     tokens = re.findall(token_re, rhs)
     if not tokens:
         raise ValueError(f"no terms on the right of '~': {formula!r}")
-    for sign, term in tokens:
-        if term.isdigit() and term not in ("0", "1"):
-            raise ValueError(
-                f"numeric term {term!r} in {formula!r}: only 1/-1/0 intercept "
-                "markers are supported")
-        if term == "1":
-            intercept = sign != "-"
-        elif term == "0":
-            intercept = False
-        elif sign == "-":
-            raise ValueError(
-                f"term removal '-{term}' is not supported (only -1/0 for the intercept)")
-        else:
+
+    intercept = True
+    predictors: list[str] = []
+    seen = set()
+    for sign, chunk in tokens:
+        for term, icpt in _expand_term(sign, chunk, formula):
+            if term == "#intercept":
+                intercept = bool(icpt)
+                continue
+            # digit components never reach here: pure digits take the
+            # intercept-marker path and digits inside ':'/'*' fail _NAME
+            key = frozenset(term.split(":"))
+            if term != "." and key in seen:
+                continue
+            if term != ".":
+                seen.add(key)
             predictors.append(term)
     return Formula(response=response, predictors=tuple(predictors),
                    intercept=intercept, source=s)

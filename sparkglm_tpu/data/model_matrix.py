@@ -10,6 +10,18 @@ reference, ``model_matrix`` itself never adds an intercept — the formula
 front-end does (fixing the reference's dropped-intercept-flag bug,
 SURVEY.md §7 L5).
 
+Beyond the reference: interaction terms (``"a:b"``, any arity).  A design
+term is a tuple of source columns; its columns are the elementwise products
+of the component codings, first component varying fastest, names joined
+with ``:`` (R's ``model.matrix`` layout).  Numeric×numeric is one product
+column; a factor contributes its k-1 kept dummies.  For every factor ``f``
+inside an interaction ``T`` the model must also contain the margin
+``T\{f}`` and ``f``'s main effect (a hierarchical formula): R's
+marginality rule switches ``f`` to full-k coding when the margin is
+absent, and silently fitting different contrasts than R is worse than an
+error.  With the margins present, products of k-1 dummies are exactly R's
+interaction contrasts.
+
 Scoring-time column matching mirrors ``utils.matchCols``
 (utils.scala:21-33): a fitted ``Terms`` carries the training levels, and
 transforming new data with it zero-fills dummy columns for categories absent
@@ -35,10 +47,16 @@ class Terms:
     """Fitted design-matrix recipe (the reference's xnames + the level maps
     it forgets, forcing matchCols at every scoring call)."""
 
-    columns: tuple            # source columns, in design order
+    columns: tuple            # unique source data columns, in first-use order
     levels: dict              # categorical column -> tuple of KEPT levels (k-1)
     intercept: bool
     xnames: tuple             # output design column names
+    design: tuple = ()        # per-term component tuples, e.g. (("x",), ("x","cat"))
+
+    def __post_init__(self):
+        if not self.design:  # main-effects-only recipes (and legacy dicts)
+            object.__setattr__(
+                self, "design", tuple((c,) for c in self.columns))
 
     def to_dict(self) -> dict:
         return {
@@ -46,6 +64,7 @@ class Terms:
             "levels": {k: list(v) for k, v in self.levels.items()},
             "intercept": self.intercept,
             "xnames": list(self.xnames),
+            "design": [list(t) for t in self.design],
         }
 
     @classmethod
@@ -55,6 +74,7 @@ class Terms:
             levels={k: tuple(v) for k, v in d["levels"].items()},
             intercept=bool(d["intercept"]),
             xnames=tuple(d["xnames"]),
+            design=tuple(tuple(t) for t in d.get("design", ())),
         )
 
     def signature(self) -> str:
@@ -72,9 +92,19 @@ def _levels_of(col: np.ndarray) -> list:
     return lv[1:]
 
 
+def _term_components(term) -> tuple:
+    """'a:b' or ('a','b') -> ('a', 'b'); plain 'a' -> ('a',)."""
+    if isinstance(term, str):
+        return tuple(term.split(":"))
+    return tuple(term)
+
+
 def build_terms(data, columns=None, *, intercept: bool = False,
                 levels=None) -> Terms:
     """Learn the design recipe (levels, names) from training data.
+
+    ``columns`` lists design terms: source column names, or interaction
+    terms as ``"a:b"`` strings / component tuples.
 
     ``levels`` optionally overrides level discovery with externally known
     FULL sorted level lists per categorical column (the first is dropped
@@ -84,25 +114,67 @@ def build_terms(data, columns=None, *, intercept: bool = False,
     the one global pass; ADVICE r1).
     """
     cols = as_columns(data)
-    names = list(columns) if columns is not None else list(cols)
+    terms_in = list(columns) if columns is not None else list(cols)
+    design = tuple(_term_components(t) for t in terms_in)
+
+    # unique source columns in first-use order; level discovery per source
+    sources: list[str] = []
+    for comps in design:
+        for nm in comps:
+            if nm not in cols:
+                raise KeyError(f"column {nm!r} not in data ({list(cols)})")
+            if nm not in sources:
+                sources.append(nm)
     lv_out: dict[str, tuple] = {}
-    xnames: list[str] = [INTERCEPT_NAME] if intercept else []
-    for nm in names:
-        if nm not in cols:
-            raise KeyError(f"column {nm!r} not in data ({list(cols)})")
-        c = cols[nm]
+    for nm in sources:
         if levels is not None and nm in levels:
-            kept = tuple(str(v) for v in sorted(levels[nm]))[1:]
-            lv_out[nm] = kept
-            xnames.extend(f"{nm}_{lv}" for lv in kept)
-        elif is_categorical(c):
-            kept = tuple(_levels_of(c))
-            lv_out[nm] = kept
-            xnames.extend(f"{nm}_{lv}" for lv in kept)
-        else:
-            xnames.append(nm)
-    return Terms(columns=tuple(names), levels=lv_out, intercept=intercept,
-                 xnames=tuple(xnames))
+            lv_out[nm] = tuple(str(v) for v in sorted(levels[nm]))[1:]
+        elif is_categorical(cols[nm]):
+            lv_out[nm] = tuple(_levels_of(cols[nm]))
+
+    present = {frozenset(comps) for comps in design}
+    xnames: list[str] = [INTERCEPT_NAME] if intercept else []
+    for comps in design:
+        if len(comps) > 1:
+            # R's marginality rule: a factor f in term T is coded with k-1
+            # contrasts only when the margin T\{f} is itself in the model
+            # (and we additionally require f's main effect — a hierarchical
+            # formula).  When margins are absent R switches to full-k
+            # coding; rather than silently fitting different contrasts we
+            # demand the margins.
+            for f in comps:
+                if f not in lv_out:
+                    continue
+                rest = [c for c in comps if c != f]
+                for req in ([":".join(rest)] if rest else []) + [f]:
+                    if frozenset(req.split(":")) not in present:
+                        raise ValueError(
+                            f"interaction {':'.join(comps)} involves factor "
+                            f"{f!r} but the model is missing the term "
+                            f"{req!r}; add it (R changes the factor's "
+                            "contrast coding when margins are absent — "
+                            "refusing to fit different contrasts silently)")
+        # coded names per component; product order = first component fastest
+        names = [""]
+        for nm in comps:
+            part = ([f"{nm}_{lv}" for lv in lv_out[nm]] if nm in lv_out
+                    else [nm])
+            names = [f"{a}:{b}" if a else b for b in part for a in names]
+        xnames.extend(names)
+    return Terms(columns=tuple(sources), levels=lv_out, intercept=intercept,
+                 xnames=tuple(xnames), design=design)
+
+
+def _coded_block(c: np.ndarray, nm: str, terms: Terms, dtype) -> np.ndarray:
+    """(n, k) coding of one source column: k-1 dummies or the column itself."""
+    if nm in terms.levels:
+        cs = c.astype(str)
+        kept = terms.levels[nm]
+        out = np.empty((c.shape[0], len(kept)), dtype=dtype)
+        for j, lv in enumerate(kept):
+            out[:, j] = (cs == lv).astype(dtype)
+        return out
+    return np.asarray(c, dtype=dtype).reshape(-1, 1)
 
 
 def transform(data, terms: Terms, *, dtype=np.float32) -> np.ndarray:
@@ -113,24 +185,46 @@ def transform(data, terms: Terms, *, dtype=np.float32) -> np.ndarray:
     ``matchCols`` contract, utils.scala:28-33; tested by utils$Test.scala:10-24).
     """
     cols = as_columns(data)
+    for nm in terms.columns:
+        if nm not in cols:
+            raise KeyError(f"column {nm!r} required by the model is missing from data")
     n = len(next(iter(cols.values()))) if cols else 0
     out = np.empty((n, len(terms.xnames)), dtype=dtype)
     j = 0
     if terms.intercept:
         out[:, j] = 1.0
         j += 1
-    for nm in terms.columns:
-        if nm not in cols:
-            raise KeyError(f"column {nm!r} required by the model is missing from data")
-        c = cols[nm]
-        if nm in terms.levels:
-            cs = c.astype(str)
-            for lv in terms.levels[nm]:
-                out[:, j] = (cs == lv).astype(dtype)
+    # factor codings are cached only when a column appears in an interaction
+    # (main effects write straight into their slice) so peak memory stays one
+    # design matrix plus the interaction components actually reused
+    coded: dict[str, np.ndarray] = {}
+
+    def block_of(nm: str) -> np.ndarray:
+        if nm not in coded:
+            coded[nm] = _coded_block(cols[nm], nm, terms, dtype)
+        return coded[nm]
+
+    for comps in terms.design:
+        if len(comps) == 1:
+            nm = comps[0]
+            if nm in terms.levels:
+                cs = cols[nm].astype(str)
+                for lv in terms.levels[nm]:
+                    out[:, j] = (cs == lv).astype(dtype)
+                    j += 1
+            else:
+                out[:, j] = cols[nm].astype(dtype)
                 j += 1
-        else:
-            out[:, j] = c.astype(dtype)
-            j += 1
+            continue
+        b = block_of(comps[0])
+        for nm in comps[1:]:
+            # first component varies fastest (R's model.matrix layout):
+            # new index = j*K_prev + i
+            cb = block_of(nm)
+            b = (cb[:, :, None] * b[:, None, :]).reshape(n, -1)
+        out[:, j:j + b.shape[1]] = b
+        j += b.shape[1]
+    assert j == len(terms.xnames)
     return out
 
 

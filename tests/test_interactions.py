@@ -1,0 +1,192 @@
+"""Interaction terms (a:b, a*b) — an extension over the reference's
+'+'-only grammar (R/pkg/R/utils.R:8-22), with R model.matrix semantics:
+products of the component codings, first component varying fastest,
+names joined with ':'."""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.data.formula import parse_formula
+from sparkglm_tpu.data.model_matrix import Terms, build_terms, transform
+
+
+# ---------------------------------------------------------------- parser ----
+
+def test_parse_colon_and_star():
+    f = parse_formula("y ~ a + b + a:b")
+    assert f.predictors == ("a", "b", "a:b")
+    f2 = parse_formula("y ~ a*b")
+    assert f2.predictors == ("a", "b", "a:b")
+    f3 = parse_formula("y ~ a*b*c")
+    assert f3.predictors == ("a", "b", "c", "a:b", "a:c", "b:c", "a:b:c")
+
+
+def test_parse_duplicate_terms_collapse():
+    # b:a duplicates a:b (R collapses); a:a collapses to a
+    f = parse_formula("y ~ a + b + a:b + b:a")
+    assert f.predictors == ("a", "b", "a:b")
+    assert parse_formula("y ~ a:a + b").predictors == ("a", "b")
+    # a*b after a + b only adds the interaction
+    assert parse_formula("y ~ a + b + a*b").predictors == ("a", "b", "a:b")
+
+
+def test_parse_rejections():
+    with pytest.raises(ValueError, match="mixed"):
+        parse_formula("y ~ a:b*c")
+    with pytest.raises(ValueError, match="invalid name|numeric component"):
+        parse_formula("y ~ a:2")
+    with pytest.raises(ValueError, match="unsupported formula syntax"):
+        parse_formula("y ~ (a + b)*c")
+    with pytest.raises(ValueError, match="term removal"):
+        parse_formula("y ~ a*b - a")
+
+
+def test_na_scan_sources_flatten():
+    f = parse_formula("y ~ a + a:b + c*d")
+    flat = list(dict.fromkeys(c for t in f.predictors for c in t.split(":")))
+    assert flat == ["a", "b", "c", "d"]
+
+
+# ---------------------------------------------------------- model matrix ----
+
+def _mixed_data(n=60, seed=0):
+    r = np.random.default_rng(seed)
+    return {
+        "x": r.normal(size=n),
+        "z": r.normal(size=n),
+        "cat": r.choice(["a", "b", "c"], size=n),
+        "grp": r.choice(["u", "v"], size=n),
+    }
+
+
+def test_numeric_numeric_interaction():
+    d = _mixed_data()
+    t = build_terms(d, ["x", "z", "x:z"], intercept=True)
+    assert t.xnames == ("intercept", "x", "z", "x:z")
+    X = transform(d, t, dtype=np.float64)
+    np.testing.assert_allclose(X[:, 3], d["x"] * d["z"])
+
+
+def test_numeric_factor_interaction():
+    d = _mixed_data()
+    t = build_terms(d, ["x", "cat", "x:cat"], intercept=True)
+    assert t.xnames == ("intercept", "x", "cat_b", "cat_c", "x:cat_b", "x:cat_c")
+    X = transform(d, t, dtype=np.float64)
+    np.testing.assert_allclose(X[:, 4], d["x"] * (d["cat"] == "b"))
+    np.testing.assert_allclose(X[:, 5], d["x"] * (d["cat"] == "c"))
+
+
+def test_factor_factor_interaction_layout():
+    """First component varies fastest — R's model.matrix column order."""
+    d = _mixed_data()
+    t = build_terms(d, ["cat", "grp", "cat:grp"], intercept=True)
+    assert t.xnames == ("intercept", "cat_b", "cat_c", "grp_v",
+                        "cat_b:grp_v", "cat_c:grp_v")
+    X = transform(d, t, dtype=np.float64)
+    np.testing.assert_allclose(
+        X[:, 4], (d["cat"] == "b") * (d["grp"] == "v"))
+    np.testing.assert_allclose(
+        X[:, 5], (d["cat"] == "c") * (d["grp"] == "v"))
+
+
+def test_three_way_interaction():
+    d = _mixed_data()
+    t = build_terms(d, ["x", "z", "x:z", "cat", "x:z:cat"], intercept=False)
+    assert t.xnames == ("x", "z", "x:z", "cat_b", "cat_c",
+                        "x:z:cat_b", "x:z:cat_c")
+    X = transform(d, t, dtype=np.float64)
+    np.testing.assert_allclose(X[:, 5], d["x"] * d["z"] * (d["cat"] == "b"))
+
+
+def test_factor_interaction_requires_margins():
+    """R's marginality rule: missing margins flip the factor to full-k
+    coding; we refuse non-hierarchical formulas instead of silently
+    fitting different contrasts."""
+    d = _mixed_data()
+    with pytest.raises(ValueError, match="missing the term 'cat'"):
+        build_terms(d, ["x", "x:cat"], intercept=True)
+    with pytest.raises(ValueError, match="missing the term 'x'"):
+        build_terms(d, ["cat", "x:cat"], intercept=True)
+    with pytest.raises(ValueError, match="missing the term 'x:z'"):
+        build_terms(d, ["x", "z", "cat", "x:z:cat"], intercept=True)
+    # numeric-only interactions don't need mains (R codes them identically)
+    t = build_terms(d, ["x:z"], intercept=True)
+    assert t.xnames == ("intercept", "x:z")
+
+
+def test_terms_roundtrip_with_design():
+    d = _mixed_data()
+    t = build_terms(d, ["x", "cat", "x:cat"], intercept=True)
+    t2 = Terms.from_dict(t.to_dict())
+    assert t2 == t
+    np.testing.assert_array_equal(transform(d, t2, dtype=np.float64),
+                                  transform(d, t, dtype=np.float64))
+    # legacy dicts (r1/r2 models serialized without 'design') still load:
+    # every column is its own main-effect term
+    legacy = t.to_dict()
+    legacy.pop("design")
+    legacy["columns"] = ["x", "cat"]
+    legacy["xnames"] = ["intercept", "x", "cat_b", "cat_c"]
+    t3 = Terms.from_dict(legacy)
+    assert t3.design == (("x",), ("cat",))
+    assert transform(d, t3, dtype=np.float64).shape[1] == 4
+
+
+# ------------------------------------------------------------ end to end ----
+
+def test_glm_interaction_matches_manual_design(mesh8, rng):
+    n = 3000
+    d = _mixed_data(n, seed=3)
+    eta = (0.4 + 0.5 * d["x"] - 0.3 * d["z"] + 0.6 * d["x"] * d["z"]
+           + 0.5 * (d["cat"] == "b") - 0.2 * (d["cat"] == "c")
+           + 0.7 * d["x"] * (d["cat"] == "b"))
+    y = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(float)
+    d["y"] = y
+    m = sg.glm("y ~ x*z + cat + x:cat", d, family="binomial",
+               tol=1e-10, mesh=mesh8)
+    # manual design in the same column order
+    Xm = np.column_stack([
+        np.ones(n), d["x"], d["z"], d["x"] * d["z"],
+        (d["cat"] == "b").astype(float), (d["cat"] == "c").astype(float),
+        d["x"] * (d["cat"] == "b"), d["x"] * (d["cat"] == "c")])
+    mm = sg.glm_fit(Xm, y, family="binomial", tol=1e-10, mesh=mesh8)
+    # the formula path materialises X at f32 (config.dtype); the manual
+    # design is f64 under the test harness's x64 — hence ~1e-6 not 1e-10
+    np.testing.assert_allclose(m.coefficients, mm.coefficients,
+                               rtol=1e-4, atol=1e-7)
+    assert m.xnames == ("intercept", "x", "z", "x:z", "cat_b", "cat_c",
+                        "x:cat_b", "x:cat_c")
+
+
+def test_lm_interaction_predict_roundtrip(mesh8, rng, tmp_path):
+    n = 500
+    d = _mixed_data(n, seed=5)
+    d["y"] = (1.0 + 2.0 * d["x"] + 0.5 * (d["grp"] == "v")
+              - 1.5 * d["x"] * (d["grp"] == "v") + 0.1 * rng.normal(size=n))
+    m = sg.lm("y ~ x * grp", d, mesh=mesh8)
+    assert m.xnames == ("intercept", "x", "grp_v", "x:grp_v")
+    # scoring new data, including a category absent from the new batch
+    new = {"x": np.array([1.0, 2.0]), "grp": np.array(["u", "u"])}
+    pred = sg.predict(m, new)
+    b = dict(zip(m.xnames, m.coefficients))
+    np.testing.assert_allclose(
+        pred, b["intercept"] + b["x"] * new["x"], rtol=1e-6)
+    # persistence keeps the interaction recipe
+    path = str(tmp_path / "m.npz")
+    sg.save_model(m, path)
+    m2 = sg.load_model(path)
+    np.testing.assert_allclose(sg.predict(m2, new), pred, rtol=0, atol=0)
+
+
+def test_interaction_na_omission_scans_components(mesh8):
+    import warnings
+    d = _mixed_data(40, seed=7)
+    d["z"][5] = np.nan  # z only appears inside the interaction
+    d["y"] = np.ones(40)
+    d["y"][0] = 0.0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # tiny near-separated fixture
+        m = sg.glm("y ~ x + x:z + z", d, family="binomial", max_iter=5,
+                   mesh=mesh8)
+    assert m.n_obs == 39  # the NaN-z row was dropped
