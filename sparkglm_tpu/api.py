@@ -37,10 +37,21 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
     predictors = f.resolve_predictors(list(cols))
     # by-name weights/offset/m columns join the NA-omit scan so a NaN weight
     # drops its row instead of poisoning the weighted Gramian (R model-frame
-    # semantics); interaction terms scan their component source columns
+    # semantics); interaction terms scan their component source columns, and
+    # cbind()/offset() formula columns join too
     sources = [c for t in predictors for c in t.split(":")]
     used = list(dict.fromkeys(
-        [f.response] + sources + [c for c in extra_cols if isinstance(c, str)]))
+        [f.response]
+        + ([f.response2] if f.response2 else [])
+        + list(f.offsets)
+        + sources
+        + [c for c in extra_cols if isinstance(c, str)]))
+    missing = [c for c in f.offsets + ((f.response2,) if f.response2 else ())
+               if c not in cols]
+    if missing:
+        raise KeyError(
+            f"formula column {missing[0]!r} not found in data columns "
+            f"{list(cols)}")
     n_in = len(next(iter(cols.values()))) if cols else 0
     keep = np.ones(n_in, dtype=bool)
     if na_omit:
@@ -55,7 +66,9 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
         y = (yraw.astype(str) == lv[1]).astype(np.float64)
     else:
         y = yraw.astype(np.float64)
-    terms = build_terms(cols, predictors, intercept=f.intercept)
+    # R's model.matrix coding for '- 1' formulas: first factor keeps all k
+    terms = build_terms(cols, predictors, intercept=f.intercept,
+                        no_intercept_coding="full_k_first")
     X = transform(cols, terms, dtype=dtype)
     return f, X, y, terms, cols, keep
 
@@ -70,6 +83,14 @@ def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
     f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
                                          dtype=np.dtype(config.dtype),
                                          extra_cols=(weights,))
+    if f.response2 is not None:
+        raise ValueError(
+            "cbind() responses are for binomial glm(); lm() fits a single "
+            "numeric response")
+    if f.offsets:
+        raise ValueError(
+            "offset() terms are not supported in lm() (linear models have "
+            "no offset; absorb it by regressing y - offset)")
     if isinstance(weights, str):
         weights = cols[weights]  # column name, post-NA-omit (same as glm)
     elif weights is not None:
@@ -99,17 +120,45 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
             return cols[v]  # post-NA-omit columns, so lengths stay aligned
         return None if v is None else _subset_extra(v, keep, what)
 
+    yname = f.response
+    if f.response2 is not None:
+        # cbind(successes, failures): y is success counts out of
+        # m = successes + failures (R's grouped-binomial response)
+        if m is not None:
+            raise ValueError(
+                "cbind(successes, failures) already defines the group sizes; "
+                "drop the m= argument")
+        m = (np.asarray(cols[f.response], np.float64)
+             + np.asarray(cols[f.response2], np.float64))
+        yname = f"cbind({f.response}, {f.response2})"
+
+    # offset() formula terms sum with any offset= argument (R semantics)
+    off_arr = _col_or_array(offset, "offset")
+    for oc in f.offsets:
+        o = np.asarray(cols[oc], np.float64)
+        off_arr = o if off_arr is None else np.asarray(off_arr, np.float64) + o
+    # by-name offsets travel with the model for predict(); an array offset
+    # cannot be recovered from new data (predict refuses without offset=)
+    if f.offsets and (offset is None or isinstance(offset, str)):
+        offset_names = f.offsets + ((offset,) if isinstance(offset, str) else ())
+    elif isinstance(offset, str) and not f.offsets:
+        offset_names = (offset,)
+    else:
+        offset_names = None
+
     model = glm_mod.fit(
         X, y, family=family, link=link,
         weights=_col_or_array(weights, "weights"),
-        offset=_col_or_array(offset, "offset"), m=_col_or_array(m, "m"), tol=tol,
+        offset=off_arr, m=m if f.response2 is not None else _col_or_array(m, "m"),
+        tol=tol,
         max_iter=max_iter, criterion=criterion, xnames=terms.xnames,
-        yname=f.response, has_intercept=f.intercept, mesh=mesh,
+        yname=yname, has_intercept=f.intercept, mesh=mesh,
         engine=engine, singular=singular, verbose=verbose, config=config)
     import dataclasses
     return dataclasses.replace(
         model, formula=str(f), terms=terms,
-        offset_col=offset if isinstance(offset, str) else None)
+        offset_col=(offset_names[0] if offset_names and len(offset_names) == 1
+                    else offset_names))
 
 
 def predict(model, data, **kwargs) -> np.ndarray:
@@ -128,11 +177,14 @@ def predict(model, data, **kwargs) -> np.ndarray:
     # the stored model-frame offset); an explicit offset kwarg overrides
     off_col = getattr(model, "offset_col", None)
     if off_col is not None and "offset" not in kwargs:
-        if off_col not in cols:
+        names = [off_col] if isinstance(off_col, str) else list(off_col)
+        missing = [nm for nm in names if nm not in cols]
+        if missing:
             raise ValueError(
-                f"model was fit with offset column {off_col!r}, which is "
+                f"model was fit with offset column {missing[0]!r}, which is "
                 "missing from the new data; pass offset= explicitly to override")
-        kwargs["offset"] = np.asarray(cols[off_col], np.float64)
+        kwargs["offset"] = sum(np.asarray(cols[nm], np.float64)
+                               for nm in names)
     elif getattr(model, "has_offset", False) and "offset" not in kwargs:
         # fit-time offset was an array, so it cannot be recovered from new
         # data — refuse to silently predict without it

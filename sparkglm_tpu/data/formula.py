@@ -13,6 +13,10 @@ Extensions over the reference (standard R semantics):
     (``a*b*c`` -> ``a + b + c + a:b + a:c + b:c + a:b:c``), exactly R's
     expansion.  Duplicate terms (including ``b:a`` vs ``a:b``) collapse to
     the first occurrence, as in R.
+  * ``cbind(successes, failures) ~ ...`` grouped-binomial responses
+    (R's canonical form; equivalent to ``m=successes+failures`` with
+    success counts as ``y``).
+  * ``offset(col)`` terms, summed with any ``offset=`` argument like R.
 
 Still rejected, loudly: parentheses, ``^``, ``I(...)``, ``-term`` removal,
 and transforms — fitting a silently different model is worse than an error.
@@ -33,6 +37,8 @@ class Formula:
     predictors: tuple  # canonical term strings; interactions as "a:b"
     intercept: bool
     source: str
+    response2: str | None = None  # failures column of a cbind() response
+    offsets: tuple = ()           # columns named in offset() terms
 
     def __str__(self) -> str:
         return self.source
@@ -48,10 +54,11 @@ class Formula:
                 seen.add(key)
                 out.append(term)
 
+        exclude = {self.response, self.response2, *self.offsets}
         for t in self.predictors:
             if t == ".":
                 for c in available:
-                    if c != self.response:
+                    if c not in exclude:
                         add(c)
             else:
                 for comp in t.split(":"):
@@ -108,10 +115,32 @@ def parse_formula(formula: str) -> Formula:
         raise ValueError(f"formula must contain '~': {formula!r}")
     lhs, rhs = s.split("~", 1)
     response = lhs.strip()
+    response2 = None
     if not response:
         raise ValueError(f"formula needs a response on the left of '~': {formula!r}")
-    if not re.fullmatch(_NAME, response):
-        raise ValueError(f"invalid response name {response!r}")
+    cb = re.fullmatch(rf"cbind\s*\(\s*({_NAME})\s*,\s*({_NAME})\s*\)", response)
+    if cb:
+        # R's grouped-binomial response: cbind(successes, failures)
+        response, response2 = cb.group(1), cb.group(2)
+    elif not re.fullmatch(_NAME, response):
+        raise ValueError(
+            f"invalid response {response!r}: a column name or "
+            "cbind(successes, failures)")
+
+    # offset(col) terms come out before tokenization (R sums them with any
+    # offset= argument); only a plain column name is allowed inside
+    offsets: list[str] = []
+
+    def _grab_offset(mo):
+        inner = mo.group(1).strip()
+        if not re.fullmatch(_NAME, inner):
+            raise ValueError(
+                f"offset() takes a single column name, got {inner!r} "
+                f"({formula!r})")
+        offsets.append(inner)
+        return ""
+
+    rhs = re.sub(r"(?<![A-Za-z0-9_.])offset\s*\(([^)]*)\)", _grab_offset, rhs)
 
     # term := name ((':'|'*') name)* ; chunks are '+'/'-'-separated.  Reject
     # anything the grammar doesn't cover ('^', 'I(...)', parentheses)
@@ -147,4 +176,5 @@ def parse_formula(formula: str) -> Formula:
                 seen.add(key)
             predictors.append(term)
     return Formula(response=response, predictors=tuple(predictors),
-                   intercept=intercept, source=s)
+                   intercept=intercept, source=s, response2=response2,
+                   offsets=tuple(dict.fromkeys(offsets)))

@@ -1,4 +1,4 @@
-"""Design-matrix construction — R ``model.matrix`` semantics.
+r"""Design-matrix construction — R ``model.matrix`` semantics.
 
 Mirrors the reference's ``modelMatrix``
 (/root/reference/src/main/scala/com/Alteryx/sparkGLM/modelMatrix.scala:18-85):
@@ -86,12 +86,6 @@ class Terms:
             json.dumps(self.to_dict(), sort_keys=True).encode()).hexdigest()
 
 
-def _levels_of(col: np.ndarray) -> list:
-    # sorted distinct, drop first (k-1 coding) — modelMatrix.scala:56-58
-    lv = sorted(np.unique(col.astype(str)))
-    return lv[1:]
-
-
 def _term_components(term) -> tuple:
     """'a:b' or ('a','b') -> ('a', 'b'); plain 'a' -> ('a',)."""
     if isinstance(term, str):
@@ -100,11 +94,19 @@ def _term_components(term) -> tuple:
 
 
 def build_terms(data, columns=None, *, intercept: bool = False,
-                levels=None) -> Terms:
+                levels=None, no_intercept_coding: str = "drop_first") -> Terms:
     """Learn the design recipe (levels, names) from training data.
 
     ``columns`` lists design terms: source column names, or interaction
     terms as ``"a:b"`` strings / component tuples.
+
+    ``no_intercept_coding`` governs factor coding when ``intercept`` is
+    False: ``"drop_first"`` (default) always k-1 codes, the reference's
+    ``modelMatrix`` contract (modelMatrix.scala:56-58 — it never adds an
+    intercept and never full-k codes); ``"full_k_first"`` applies R's
+    ``model.matrix`` rule — the first factor main effect keeps all k
+    levels (cell-means coding) — and is what the formula front-end passes
+    for ``y ~ ... - 1``.
 
     ``levels`` optionally overrides level discovery with externally known
     FULL sorted level lists per categorical column (the first is dropped
@@ -125,17 +127,39 @@ def build_terms(data, columns=None, *, intercept: bool = False,
                 raise KeyError(f"column {nm!r} not in data ({list(cols)})")
             if nm not in sources:
                 sources.append(nm)
-    lv_out: dict[str, tuple] = {}
+    full_levels: dict[str, tuple] = {}
     for nm in sources:
         if levels is not None and nm in levels:
-            lv_out[nm] = tuple(str(v) for v in sorted(levels[nm]))[1:]
+            full_levels[nm] = tuple(str(v) for v in sorted(levels[nm]))
         elif is_categorical(cols[nm]):
-            lv_out[nm] = tuple(_levels_of(cols[nm]))
+            full_levels[nm] = tuple(sorted(np.unique(cols[nm].astype(str))))
+    if no_intercept_coding not in ("drop_first", "full_k_first"):
+        raise ValueError(
+            f"no_intercept_coding must be 'drop_first' or 'full_k_first', "
+            f"got {no_intercept_coding!r}")
+    # R's no-intercept rule: the FIRST factor main effect keeps all k levels
+    # (the cell-means coding); later factors stay k-1.  With an intercept,
+    # every factor drops its first sorted level (modelMatrix.scala:56-58).
+    fullk_col = None
+    if not intercept and no_intercept_coding == "full_k_first":
+        for comps in design:
+            if len(comps) == 1 and comps[0] in full_levels:
+                fullk_col = comps[0]
+                break
+    lv_out = {nm: (fl if nm == fullk_col else fl[1:])
+              for nm, fl in full_levels.items()}
 
     present = {frozenset(comps) for comps in design}
     xnames: list[str] = [INTERCEPT_NAME] if intercept else []
     for comps in design:
         if len(comps) > 1:
+            if not intercept and any(c in lv_out for c in comps):
+                raise ValueError(
+                    f"interaction {':'.join(comps)} involves a factor in a "
+                    "no-intercept model; R's contrast coding rules differ "
+                    "there — fit with an intercept or build the design "
+                    "matrix manually (refusing to fit different contrasts "
+                    "silently)")
             # R's marginality rule: a factor f in term T is coded with k-1
             # contrasts only when the margin T\{f} is itself in the model
             # (and we additionally require f's main effect — a hierarchical

@@ -1,0 +1,126 @@
+"""cbind(successes, failures) responses and offset() formula terms —
+R's canonical glm() formula surface (extensions over the reference's
+'+'-only parseFormula, R/pkg/R/utils.R:8-22)."""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.data.formula import parse_formula
+
+
+def test_parse_cbind_and_offset():
+    f = parse_formula("cbind(s, fails) ~ x + offset(lt) + grp")
+    assert f.response == "s" and f.response2 == "fails"
+    assert f.offsets == ("lt",)
+    assert f.predictors == ("x", "grp")
+    # duplicates collapse; offset anywhere in the chain
+    f2 = parse_formula("y ~ offset(a) + x + offset(b) + offset(a)")
+    assert f2.offsets == ("a", "b") and f2.predictors == ("x",)
+
+
+def test_parse_cbind_rejections():
+    with pytest.raises(ValueError, match="invalid response"):
+        parse_formula("cbind(s) ~ x")
+    with pytest.raises(ValueError, match="offset\\(\\) takes a single"):
+        parse_formula("y ~ x + offset(log(t))")
+    # identifiers merely ENDING in 'offset' are not offset() calls — the
+    # call-like residue must fail loudly, not parse as offset + predictor
+    with pytest.raises(ValueError, match="unsupported formula syntax"):
+        parse_formula("y ~ x + my_offset(z)")
+    f = parse_formula("y ~ my_offset + x")  # plain column named *_offset
+    assert f.predictors == ("my_offset", "x") and f.offsets == ()
+
+
+def _grouped_data(rng, n=400):
+    x = rng.normal(size=n)
+    grp = rng.choice(["a", "b"], size=n)
+    m = rng.integers(5, 30, size=n).astype(float)
+    eta = 0.3 + 0.8 * x - 0.5 * (grp == "b")
+    p = 1 / (1 + np.exp(-eta))
+    s = rng.binomial(m.astype(int), p).astype(float)
+    return {"x": x, "grp": grp, "s": s, "fails": m - s, "m": m}
+
+
+def test_cbind_matches_m_argument(mesh8, rng):
+    d = _grouped_data(rng)
+    m1 = sg.glm("cbind(s, fails) ~ x + grp", d, family="binomial", tol=1e-10,
+                mesh=mesh8)
+    m2 = sg.glm("s ~ x + grp", d, family="binomial", m="m", tol=1e-10,
+                mesh=mesh8)
+    np.testing.assert_allclose(m1.coefficients, m2.coefficients,
+                               rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(m1.deviance, m2.deviance, rtol=1e-10)
+    assert m1.yname == "cbind(s, fails)"
+    with pytest.raises(ValueError, match="drop the m="):
+        sg.glm("cbind(s, fails) ~ x", d, family="binomial", m="m", mesh=mesh8)
+
+
+def test_cbind_dot_excludes_response_columns(mesh8, rng):
+    d = _grouped_data(rng)
+    del d["m"]
+    m = sg.glm("cbind(s, fails) ~ .", d, family="binomial", tol=1e-10,
+               mesh=mesh8)
+    assert m.xnames == ("intercept", "x", "grp_b")
+
+
+def test_offset_term_matches_offset_argument(mesh8, rng):
+    n = 500
+    x = rng.normal(size=n)
+    lt = rng.uniform(0.5, 1.5, size=n)
+    lam = np.exp(0.2 + 0.6 * x + lt)
+    y = rng.poisson(lam).astype(float)
+    d = {"x": x, "y": y, "lt": lt}
+    m1 = sg.glm("y ~ x + offset(lt)", d, family="poisson", tol=1e-12,
+                mesh=mesh8)
+    m2 = sg.glm("y ~ x", d, family="poisson", offset="lt", tol=1e-12,
+                mesh=mesh8)
+    np.testing.assert_allclose(m1.coefficients, m2.coefficients,
+                               rtol=1e-10, atol=1e-12)
+    # offset() term + offset= argument SUM, like R
+    d["half"] = 0.5 * lt
+    m3 = sg.glm("y ~ x + offset(half)", d, family="poisson", offset="half",
+                tol=1e-12, mesh=mesh8)
+    np.testing.assert_allclose(m3.coefficients, m1.coefficients,
+                               rtol=1e-10, atol=1e-12)
+
+
+def test_offset_term_travels_to_predict(mesh8, rng, tmp_path):
+    n = 300
+    x = rng.normal(size=n)
+    lt = rng.uniform(0.2, 1.0, size=n)
+    y = rng.poisson(np.exp(0.3 * x + lt)).astype(float)
+    d = {"x": x, "y": y, "lt": lt}
+    m = sg.glm("y ~ x + offset(lt)", d, family="poisson", tol=1e-10,
+               mesh=mesh8)
+    new = {"x": np.array([0.0, 1.0]), "lt": np.array([0.5, 0.5])}
+    pred = sg.predict(m, new)
+    b = dict(zip(m.xnames, m.coefficients))
+    expect = np.exp(b["intercept"] + b["x"] * new["x"] + new["lt"])
+    np.testing.assert_allclose(pred, expect, rtol=1e-6)
+    # persists through save/load
+    path = str(tmp_path / "m.npz")
+    sg.save_model(m, path)
+    np.testing.assert_allclose(sg.predict(sg.load_model(path), new), pred)
+    # missing offset column at scoring is an error, not a silent zero
+    with pytest.raises(ValueError, match="offset column"):
+        sg.predict(m, {"x": np.array([0.0])})
+
+
+def test_lm_rejects_cbind_and_offset(rng):
+    d = {"y": rng.normal(size=10), "y2": rng.normal(size=10),
+         "x": rng.normal(size=10), "t": rng.normal(size=10)}
+    with pytest.raises(ValueError, match="cbind"):
+        sg.lm("cbind(y, y2) ~ x", d)
+    with pytest.raises(ValueError, match="offset"):
+        sg.lm("y ~ x + offset(t)", d)
+
+
+def test_cbind_na_omission(mesh8, rng):
+    d = _grouped_data(rng, n=100)
+    d["fails"][3] = np.nan
+    # relative criterion: the f32 deviance granularity (~2^-16 at dev~110)
+    # cannot meet an absolute 1e-8 under 8-shard summation
+    m = sg.glm("cbind(s, fails) ~ x + grp", d, family="binomial", tol=1e-6,
+               criterion="relative", mesh=mesh8)
+    assert m.converged and m.n_obs == 99

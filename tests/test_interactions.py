@@ -92,11 +92,41 @@ def test_factor_factor_interaction_layout():
 
 def test_three_way_interaction():
     d = _mixed_data()
-    t = build_terms(d, ["x", "z", "x:z", "cat", "x:z:cat"], intercept=False)
-    assert t.xnames == ("x", "z", "x:z", "cat_b", "cat_c",
+    t = build_terms(d, ["x", "z", "x:z", "cat", "x:z:cat"], intercept=True)
+    assert t.xnames == ("intercept", "x", "z", "x:z", "cat_b", "cat_c",
                         "x:z:cat_b", "x:z:cat_c")
     X = transform(d, t, dtype=np.float64)
-    np.testing.assert_allclose(X[:, 5], d["x"] * d["z"] * (d["cat"] == "b"))
+    np.testing.assert_allclose(X[:, 6], d["x"] * d["z"] * (d["cat"] == "b"))
+
+
+def test_no_intercept_first_factor_full_k():
+    """R's '- 1' rule: the first factor main effect keeps all k levels
+    (cell-means coding); later factors stay k-1.  The formula path applies
+    it; bare model_matrix keeps the reference's always-k-1 contract."""
+    d = _mixed_data()
+    t = build_terms(d, ["cat", "grp", "x"], intercept=False,
+                    no_intercept_coding="full_k_first")
+    assert t.xnames == ("cat_a", "cat_b", "cat_c", "grp_v", "x")
+    X = transform(d, t, dtype=np.float64)
+    np.testing.assert_allclose(X[:, 0], (d["cat"] == "a").astype(float))
+    # reference contract unchanged by default
+    t_ref = build_terms(d, ["cat", "grp", "x"], intercept=False)
+    assert t_ref.xnames == ("cat_b", "cat_c", "grp_v", "x")
+    # formula end-to-end: cell means recover per-group rates
+    d["y"] = np.where(d["cat"] == "a", 0.2, 0.9) + 0.0 * d["x"]
+    m = sg.lm("y ~ cat - 1", d)
+    assert m.xnames == ("cat_a", "cat_b", "cat_c")
+    np.testing.assert_allclose(
+        m.coefficients, [0.2, 0.9, 0.9], atol=1e-6)
+
+
+def test_no_intercept_factor_interaction_refused():
+    d = _mixed_data()
+    with pytest.raises(ValueError, match="no-intercept"):
+        build_terms(d, ["x", "cat", "x:cat"], intercept=False,
+                    no_intercept_coding="full_k_first")
+    with pytest.raises(ValueError, match="no_intercept_coding"):
+        build_terms(d, ["x"], intercept=False, no_intercept_coding="bogus")
 
 
 def test_factor_interaction_requires_margins():
