@@ -14,7 +14,7 @@ Quick start::
     mu = sg.predict(model, new_data)
 """
 
-from .api import glm, lm, predict
+from .api import glm, glm_from_csv, lm, lm_from_csv, predict
 from .config import DEFAULT, NumericConfig
 from .data.formula import Formula, parse_formula
 from .data.frame import as_columns, omit_na
@@ -37,6 +37,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "lm", "glm", "predict", "lm_fit", "glm_fit",
+    "lm_from_csv", "glm_from_csv",
     "lm_fit_streaming", "glm_fit_streaming",
     "LMModel", "GLMModel", "load_model", "save_model",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",

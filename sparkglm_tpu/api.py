@@ -31,6 +31,32 @@ def _subset_extra(v, keep: np.ndarray, what: str) -> np.ndarray:
     return arr[keep]
 
 
+def _used_columns(f, predictors, extra_names) -> list[str]:
+    """Every data column the model frame touches — response(s), offset()
+    columns, interaction components, by-name weights/offset/m — for the
+    NA-omit scan and missing-column checks (shared by the in-memory and
+    from-CSV paths)."""
+    sources = [c for t in predictors for c in t.split(":")]
+    return list(dict.fromkeys(
+        [f.response]
+        + ([f.response2] if f.response2 else [])
+        + list(f.offsets)
+        + sources
+        + [c for c in extra_names if isinstance(c, str)]))
+
+
+def _offset_col_value(f, offset):
+    """What travels with the model for predict(): the by-name offset
+    columns (formula offset() terms + a str offset= argument), or None when
+    any offset was an array (unrecoverable from new data)."""
+    if offset is not None and not isinstance(offset, str):
+        return None
+    names = f.offsets + ((offset,) if isinstance(offset, str) else ())
+    if not names:
+        return None
+    return names[0] if len(names) == 1 else names
+
+
 def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
     f = parse_formula(formula)
     cols = as_columns(data)
@@ -39,13 +65,7 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
     # drops its row instead of poisoning the weighted Gramian (R model-frame
     # semantics); interaction terms scan their component source columns, and
     # cbind()/offset() formula columns join too
-    sources = [c for t in predictors for c in t.split(":")]
-    used = list(dict.fromkeys(
-        [f.response]
-        + ([f.response2] if f.response2 else [])
-        + list(f.offsets)
-        + sources
-        + [c for c in extra_cols if isinstance(c, str)]))
+    used = _used_columns(f, predictors, extra_cols)
     missing = [c for c in f.offsets + ((f.response2,) if f.response2 else ())
                if c not in cols]
     if missing:
@@ -137,15 +157,6 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
     for oc in f.offsets:
         o = np.asarray(cols[oc], np.float64)
         off_arr = o if off_arr is None else np.asarray(off_arr, np.float64) + o
-    # by-name offsets travel with the model for predict(); an array offset
-    # cannot be recovered from new data (predict refuses without offset=)
-    if f.offsets and (offset is None or isinstance(offset, str)):
-        offset_names = f.offsets + ((offset,) if isinstance(offset, str) else ())
-    elif isinstance(offset, str) and not f.offsets:
-        offset_names = (offset,)
-    else:
-        offset_names = None
-
     model = glm_mod.fit(
         X, y, family=family, link=link,
         weights=_col_or_array(weights, "weights"),
@@ -157,8 +168,168 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
     import dataclasses
     return dataclasses.replace(
         model, formula=str(f), terms=terms,
-        offset_col=(offset_names[0] if offset_names and len(offset_names) == 1
-                    else offset_names))
+        offset_col=_offset_col_value(f, offset))
+
+
+def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
+                       chunk_bytes, native):
+    """Shared plan for the from-CSV streaming fits: global schema + factor
+    levels in one pass each (native C++ loader when available), a newline-
+    aligned byte-range chunking of the file, and fitted ``Terms`` every
+    chunk transforms through.  Returns ``(f, terms, num_chunks, extract)``
+    where ``extract(chunk_index)`` yields the per-chunk model-frame pieces.
+    """
+    import os
+
+    from .data import io as csv_io
+
+    f = parse_formula(formula)
+    for what, v in named_cols.items():
+        if v is not None and not isinstance(v, str):
+            raise ValueError(
+                f"{what} must be a column NAME for from-CSV streaming fits "
+                "(arrays cannot align with file chunks)")
+    # both global scans are memory-bounded (chunked merge) — the whole point
+    # of this path is files that do not fit
+    schema = csv_io.scan_csv_schema(path, native=native,
+                                    chunk_bytes=chunk_bytes)
+    levels = csv_io.scan_csv_levels(path, native=native,
+                                    chunk_bytes=chunk_bytes)
+    num_chunks = max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
+
+    chunk0 = csv_io.read_csv(path, shard_index=0, num_shards=num_chunks,
+                             schema=schema, native=native)
+    predictors = f.resolve_predictors(list(chunk0))
+    terms = build_terms(chunk0, predictors, intercept=f.intercept,
+                        levels=levels, no_intercept_coding="full_k_first")
+    used = _used_columns(f, predictors, named_cols.values())
+    missing = [c for c in used if c not in chunk0]
+    if missing:
+        raise KeyError(
+            f"formula column {missing[0]!r} not found in CSV columns "
+            f"{list(chunk0)}")
+    # factor response: success level from the GLOBAL level scan — a chunk
+    # holding only one response level must still code consistently
+    resp_levels = None
+    if f.response in levels:
+        lv = levels[f.response]
+        if len(lv) != 2:
+            raise ValueError(
+                f"categorical response {f.response!r} must have exactly 2 "
+                f"levels, got {lv}")
+        resp_levels = lv
+
+    def extract(i: int):
+        cols = csv_io.read_csv(path, shard_index=i, num_shards=num_chunks,
+                               schema=schema, native=native)
+        if na_omit:
+            cols, _ = omit_na(cols, used)
+        yraw = cols[f.response]
+        y = ((yraw.astype(str) == resp_levels[1]).astype(np.float64)
+             if resp_levels is not None else yraw.astype(np.float64))
+        w = (np.asarray(cols[named_cols["weights"]], np.float64)
+             if named_cols.get("weights") else None)
+        off = None
+        off_names = list(f.offsets)
+        if named_cols.get("offset"):
+            off_names.append(named_cols["offset"])
+        for oc in off_names:
+            o = np.asarray(cols[oc], np.float64)
+            off = o if off is None else off + o
+        if f.response2 is not None:
+            # cbind(successes, failures) -> proportions + group-size weights,
+            # the same conversion the resident m= path applies
+            # (models/glm.py::fit)
+            msz = y + np.asarray(cols[f.response2], np.float64)
+            y = y / np.maximum(msz, 1e-30)
+            w = msz if w is None else w * msz
+        X = transform(cols, terms, dtype=dtype)
+        return X, y, w, off
+
+    return f, terms, num_chunks, extract
+
+
+def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
+                 weights=None, offset=None, tol: float = 1e-6,
+                 max_iter: int = 100, criterion: str = "absolute",
+                 na_omit: bool = True, chunk_bytes: int = 256 << 20,
+                 mesh=None, cache: str = "auto", verbose: bool = False,
+                 beta0=None, on_iteration=None, native: bool | None = None,
+                 config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
+    """Fit a GLM by formula straight from a CSV too big to load.
+
+    The end-to-end out-of-memory path: one global schema scan + one factor
+    -level scan (``data/io.py``, C++ loader when built), then the file
+    streams through the device in newline-aligned ~``chunk_bytes`` slices
+    per IRLS pass (``models/streaming.py``) — with ``cache="auto"`` chunks
+    are pinned in accelerator memory after the first pass.  ``weights`` /
+    ``offset`` must be column names; ``cbind()`` responses and ``offset()``
+    terms work as in :func:`glm`.  The fitted model carries the formula and
+    ``Terms``, so :func:`predict` scores new column data directly.
+
+    The reference's closest analogue collects the whole dataset to the
+    driver (``dfToDenseMatrix``, utils.scala:42-49) — there is no
+    out-of-memory story there at all (SURVEY.md §7 hard part #4).
+    """
+    from .models import streaming
+
+    f, terms, num_chunks, extract = _csv_stream_design(
+        formula, path, named_cols={"weights": weights, "offset": offset},
+        na_omit=na_omit, dtype=np.dtype(config.dtype),
+        chunk_bytes=chunk_bytes, native=native)
+
+    def source():
+        # lazy thunks: when the streaming cache holds a chunk, skipping it
+        # costs nothing — no byte-range parse, no transform
+        # (models/streaming.py::_materialize)
+        for i in range(num_chunks):
+            yield lambda i=i: extract(i)
+
+    yname = (f"cbind({f.response}, {f.response2})"
+             if f.response2 is not None else f.response)
+    model = streaming.glm_fit_streaming(
+        source, family=family, link=link, tol=tol, max_iter=max_iter,
+        criterion=criterion, xnames=terms.xnames, yname=yname,
+        has_intercept=f.intercept, mesh=mesh, cache=cache, verbose=verbose,
+        beta0=beta0, on_iteration=on_iteration, config=config)
+    import dataclasses
+    return dataclasses.replace(
+        model, formula=str(f), terms=terms,
+        offset_col=_offset_col_value(f, offset))
+
+
+def lm_from_csv(formula: str, path: str, *, weights=None,
+                na_omit: bool = True, chunk_bytes: int = 256 << 20,
+                mesh=None, native: bool | None = None,
+                config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
+    """OLS/WLS by formula straight from a CSV too big to load (one
+    streaming pass; see :func:`glm_from_csv`)."""
+    from .models import streaming
+
+    pre = parse_formula(formula)  # reject before any file IO
+    if pre.response2 is not None:
+        raise ValueError(
+            "cbind() responses are for binomial glm(); lm() fits a single "
+            "numeric response")
+    if pre.offsets:
+        raise ValueError(
+            "offset() terms are not supported in lm() (linear models have "
+            "no offset; absorb it by regressing y - offset)")
+    f, terms, num_chunks, extract = _csv_stream_design(
+        formula, path, named_cols={"weights": weights},
+        na_omit=na_omit, dtype=np.dtype(config.dtype),
+        chunk_bytes=chunk_bytes, native=native)
+
+    def source():
+        for i in range(num_chunks):
+            X, y, w, _ = extract(i)
+            yield X, y, w, None
+
+    model = streaming.lm_fit_streaming(
+        source, xnames=terms.xnames, yname=f.response,
+        has_intercept=f.intercept, mesh=mesh, config=config)
+    import dataclasses
+    return dataclasses.replace(model, formula=str(f), terms=terms)
 
 
 def predict(model, data, **kwargs) -> np.ndarray:

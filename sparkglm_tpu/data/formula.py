@@ -157,6 +157,11 @@ def parse_formula(formula: str) -> Formula:
             "transforms)")
     tokens = re.findall(token_re, rhs)
     if not tokens:
+        if offsets:
+            raise ValueError(
+                f"{formula!r} has only offset() on the right of '~'; "
+                "intercept-only fits are not supported — add at least one "
+                "predictor term (e.g. 'y ~ x + offset(...)')")
         raise ValueError(f"no terms on the right of '~': {formula!r}")
 
     intercept = True

@@ -118,16 +118,31 @@ def _kinds_array(schema: dict[str, int] | None, names: list[str]):
     return kinds
 
 
-def scan_csv_schema(path: str, *, native: bool | None = None) -> dict[str, int]:
+def scan_csv_schema(path: str, *, native: bool | None = None,
+                    chunk_bytes: int | None = None) -> dict[str, int]:
     """One cheap global pass: column name -> NUMERIC (0) | CATEGORICAL (1).
 
     Run this once on the whole file and pass the result as ``schema=`` to
     per-shard ``read_csv`` calls so every host types columns identically.
+    The native scan streams (schema-only, no value buffers); the Python
+    fallback decodes the file, so pass ``chunk_bytes`` there to bound peak
+    memory (slices are scanned independently and kinds merged —
+    categorical anywhere wins, the same verdict as a whole-file scan).
     """
     lib = _load() if native in (None, True) else None
     if native is True and lib is None:
         raise RuntimeError(f"native loader unavailable: {_lib_error}")
     if lib is None:
+        if chunk_bytes is not None:
+            import os
+            num = max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
+            merged: dict[str, int] = {}
+            for i in range(num):
+                cols = _read_csv_py(path, i, num, None)
+                for k, v in cols.items():
+                    kind = CATEGORICAL if v.dtype == object else NUMERIC
+                    merged[k] = max(merged.get(k, NUMERIC), kind)
+            return merged
         cols = _read_csv_py(path, 0, 1, None)
         return {k: (CATEGORICAL if v.dtype == object else NUMERIC)
                 for k, v in cols.items()}
@@ -142,8 +157,8 @@ def scan_csv_schema(path: str, *, native: bool | None = None) -> dict[str, int]:
         lib.sgio_free(h)
 
 
-def scan_csv_levels(path: str, *, native: bool | None = None
-                    ) -> dict[str, list[str]]:
+def scan_csv_levels(path: str, *, native: bool | None = None,
+                    chunk_bytes: int | None = None) -> dict[str, list[str]]:
     """One GLOBAL pass returning the full sorted level list of every
     categorical column.
 
@@ -152,7 +167,25 @@ def scan_csv_levels(path: str, *, native: bool | None = None
     dummy-code a design with different columns than its peers, silently
     misaligning the global Gramian (ADVICE r1).  Missing values do not
     become levels.
+
+    By default the whole file is decoded in one read — fine up to memory.
+    Pass ``chunk_bytes`` to bound peak memory: the file is scanned in
+    newline-aligned byte-range slices and the per-slice level tables are
+    unioned, which is what the from-CSV streaming fits use on files too
+    big to load.
     """
+    if chunk_bytes is not None:
+        import os
+        schema = scan_csv_schema(path, native=native, chunk_bytes=chunk_bytes)
+        cat_cols = [k for k, v in schema.items() if v == CATEGORICAL]
+        out_sets: dict[str, set] = {k: set() for k in cat_cols}
+        num = max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
+        for i in range(num):
+            cols = read_csv(path, shard_index=i, num_shards=num,
+                            schema=schema, native=native)
+            for k in cat_cols:
+                out_sets[k].update(str(x) for x in cols[k] if x is not None)
+        return {k: sorted(v) for k, v in out_sets.items()}
     lib = _load() if native in (None, True) else None
     if native is True and lib is None:
         raise RuntimeError(f"native loader unavailable: {_lib_error}")

@@ -153,7 +153,11 @@ def build_terms(data, columns=None, *, intercept: bool = False,
     xnames: list[str] = [INTERCEPT_NAME] if intercept else []
     for comps in design:
         if len(comps) > 1:
-            if not intercept and any(c in lv_out for c in comps):
+            if (not intercept and no_intercept_coding == "full_k_first"
+                    and any(c in lv_out for c in comps)):
+                # only the R-coding mode refuses: under "drop_first" the
+                # caller asked for the reference's always-k-1 contract,
+                # which is well-defined (if not R) without an intercept
                 raise ValueError(
                     f"interaction {':'.join(comps)} involves a factor in a "
                     "no-intercept model; R's contrast coding rules differ "

@@ -29,7 +29,10 @@ Sources: pass ``(X, y[, weights, offset])`` arrays (numpy or ``np.memmap``),
 or a zero-argument callable returning an iterator of
 ``(X_chunk, y_chunk, w_chunk_or_None, off_chunk_or_None)`` tuples — the
 callable is re-invoked for every pass, so synthetic benchmark data can be
-generated on the fly without materializing it.
+generated on the fly without materializing it.  The iterator may also yield
+zero-arg THUNKS producing those tuples (``_materialize``): chunks held by
+the device cache are then skipped without paying their production cost
+(api.glm_from_csv yields one thunk per CSV byte range).
 """
 
 from __future__ import annotations
@@ -86,6 +89,19 @@ def _ones_colmask(Xc) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # chunk sources
 # ---------------------------------------------------------------------------
+
+def _materialize(chunk):
+    """Sources may yield lazy THUNKS (zero-arg callables returning the
+    (X, y, w, off) tuple) instead of tuples: with a complete device cache,
+    the cached-prefix skip then never pays the chunk's production cost
+    (e.g. a CSV byte-range parse in api.glm_from_csv)."""
+    return chunk() if callable(chunk) else chunk
+
+
+def _iter_chunks(chunks) -> Iterator:
+    for c in chunks():
+        yield _materialize(c)
+
 
 def _as_source(source, chunk_rows: int) -> Callable[[], Iterator]:
     """Normalize to a re-iterable factory of (X, y, w|None, off|None) chunks."""
@@ -276,7 +292,7 @@ def lm_fit_streaming(
     dtype = None
     ones_mask = None
     n = 0
-    for Xc, yc, wc, oc in chunks():
+    for Xc, yc, wc, oc in _iter_chunks(chunks):
         if oc is not None and np.any(np.asarray(oc) != 0):
             raise ValueError(
                 "lm_fit_streaming does not support an offset (linear models "
@@ -321,7 +337,7 @@ def lm_fit_streaming(
     sse = 0.0
     sst_centered = 0.0
     sst_raw = 0.0
-    for Xc, yc, wc, oc in chunks():
+    for Xc, yc, wc, oc in _iter_chunks(chunks):
         yc64, wc64, _ = _host_chunk(yc, wc, None)
         resid = yc64 - np.asarray(Xc, np.float64) @ beta
         sse += float(np.sum(wc64 * resid * resid))
@@ -425,7 +441,8 @@ def glm_fit_streaming(
                     f"{len(ccache.entries)} were cached from the first pass "
                     "— streaming sources must yield the same chunks every "
                     "invocation")
-        for Xc, yc, wc, oc in it:
+        for raw in it:
+            Xc, yc, wc, oc = _materialize(raw)
             if dtype is None:
                 dtype = _resolve_dtype(Xc, config)
             if scan_now and scan_intercept:
@@ -543,7 +560,7 @@ def glm_fit_streaming(
     # the linear predictor is one numpy dgemm per chunk)
     from . import hoststats
     stats = None
-    for Xc, yc, wc, oc in chunks():
+    for Xc, yc, wc, oc in _iter_chunks(chunks):
         yc, wc, oc = _host_chunk(yc, wc, oc)
         eta = np.asarray(Xc, np.float64) @ beta + oc
         d = hoststats.glm_chunk_stats(fam.name, lnk.name, yc, eta, wc)
@@ -561,7 +578,7 @@ def glm_fit_streaming(
         null_dev = np.nan  # the caller only wants .deviance
     elif has_intercept and saw_offset:
         def ones_source():
-            for Xc, yc, wc, oc in chunks():
+            for Xc, yc, wc, oc in _iter_chunks(chunks):
                 yield (np.ones((np.asarray(yc).shape[0], 1), dtype),
                        yc, wc, oc)
         null_dev = glm_fit_streaming(
@@ -572,7 +589,7 @@ def glm_fit_streaming(
     else:
         mu_null = stats["wy"] / stats["wt_sum"] if has_intercept else None
         null_dev = 0.0
-        for Xc, yc, wc, oc in chunks():
+        for Xc, yc, wc, oc in _iter_chunks(chunks):
             yc, wc, oc = _host_chunk(yc, wc, oc)
             null_dev += hoststats.null_dev_chunk(fam.name, lnk.name, yc, wc,
                                                  oc, mu_const=mu_null)

@@ -127,6 +127,10 @@ def test_no_intercept_factor_interaction_refused():
                     no_intercept_coding="full_k_first")
     with pytest.raises(ValueError, match="no_intercept_coding"):
         build_terms(d, ["x"], intercept=False, no_intercept_coding="bogus")
+    # the default reference contract (always k-1) keeps working without an
+    # intercept — only the R-coding mode refuses
+    t = build_terms(d, ["x", "cat", "x:cat"], intercept=False)
+    assert t.xnames == ("x", "cat_b", "cat_c", "x:cat_b", "x:cat_c")
 
 
 def test_factor_interaction_requires_margins():

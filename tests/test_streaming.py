@@ -206,6 +206,41 @@ def test_streaming_cache_skips_source_regeneration(mesh8):
     assert calls["chunks"] == 3 * n_chunks
 
 
+def test_streaming_thunk_source_skips_lazily(mesh8):
+    """A source may yield zero-arg thunks; with a complete device cache the
+    cached-prefix skip never CALLS them, so per-chunk production cost
+    (e.g. a CSV parse in glm_from_csv) is paid for the first pass and the
+    two host stats passes only."""
+    p, n_chunks, rows = 4, 3, 512
+    bt = np.array([0.2, -0.3, 0.1, 0.4])
+    calls = {"made": 0}
+
+    def make_chunk(i):
+        calls["made"] += 1
+        r = np.random.default_rng(300 + i)
+        X = r.normal(size=(rows, p)); X[:, 0] = 1.0
+        y = (r.random(rows) < 1 / (1 + np.exp(-(X @ bt)))).astype(float)
+        return X, y, None, None
+
+    def source():
+        for i in range(n_chunks):
+            yield lambda i=i: make_chunk(i)
+
+    m = sg.glm_fit_streaming(source, family="binomial", tol=1e-12,
+                             cache="device", mesh=mesh8)
+    assert m.iterations >= 3
+    # init pass + final stats pass + null-deviance pass; IRLS iterations
+    # read from HBM without ever calling the thunks
+    assert calls["made"] == 3 * n_chunks
+    # tuple-yielding parity: identical fit
+    def source_tuples():
+        for i in range(n_chunks):
+            yield make_chunk(i)
+    m2 = sg.glm_fit_streaming(source_tuples, family="binomial", tol=1e-12,
+                              cache="none", mesh=mesh8)
+    np.testing.assert_array_equal(m.coefficients, m2.coefficients)
+
+
 def test_streaming_cache_invalid_mode(mesh1, rng):
     X, bt = _data(rng, n=64)
     y = (rng.random(64) < 0.5).astype(float)
