@@ -124,8 +124,8 @@ def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
 
 
 def glm(formula: str, data, *, family="binomial", link=None, weights=None,
-        offset=None, m=None, tol: float = 1e-6, max_iter: int = 100,
-        criterion: str = "absolute", na_omit: bool = True, mesh=None,
+        offset=None, m=None, tol: float = 1e-8, max_iter: int = 100,
+        criterion: str = "relative", na_omit: bool = True, mesh=None,
         engine: str = "auto", singular: str = "drop", verbose: bool = False,
         config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """R-style ``glm(formula, data, family, link, ...)``.
@@ -250,8 +250,8 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
 
 
 def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
-                 weights=None, offset=None, tol: float = 1e-6,
-                 max_iter: int = 100, criterion: str = "absolute",
+                 weights=None, offset=None, tol: float = 1e-8,
+                 max_iter: int = 100, criterion: str = "relative",
                  na_omit: bool = True, chunk_bytes: int = 256 << 20,
                  mesh=None, cache: str = "auto", verbose: bool = False,
                  beta0=None, on_iteration=None, native: bool | None = None,

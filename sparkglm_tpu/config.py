@@ -72,6 +72,20 @@ def resolve_matmul_precision(config: "NumericConfig", n: int, p: int,
     return "highest" if n * p * p <= SMALL_PROBLEM_MAC_CAP else None
 
 
+def effective_tol(tol: float, criterion: str, dtype) -> float:
+    """The convergence threshold actually used: for the RELATIVE criterion
+    it is floored at 8 ulp of the deviance dtype — below that the
+    per-iteration deviance change is rounding noise, not progress (an f32
+    fit asked for R's 1e-8 would otherwise creep through dozens of no-op
+    iterations before an exact plateau).  float64 paths keep R's 1e-8
+    untouched; the absolute criterion is never clamped (reference
+    semantics, GLM.scala:452)."""
+    import numpy as np
+    if criterion != "relative":
+        return float(tol)
+    return max(float(tol), 8.0 * float(np.finfo(np.dtype(dtype)).eps))
+
+
 def x64_enabled() -> bool:
     import jax
     return bool(jax.config.jax_enable_x64)

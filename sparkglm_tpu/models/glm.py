@@ -20,9 +20,11 @@ Design deltas (deliberate, TPU-first):
     are given, GLM.scala:640-642 "Will change to fitDouble").
   * A ``max_iter`` guard the reference lacks (its ``while (|ddev| > tol)``
     can spin forever, GLM.scala:452).
-  * Convergence criteria: "absolute" |ddev| < tol (reference semantics,
-    GLM.scala:452,459) or "relative" |ddev|/(|dev|+0.1) < tol (R's
-    ``glm.control`` semantics — the better default at scale).
+  * Convergence criteria: "relative" |ddev|/(|dev|+0.1) < tol with
+    tol=1e-8 — R's ``glm.control(epsilon)`` rule, the DEFAULT since R is
+    the stated oracle (BASELINE.md) and an absolute threshold is
+    meaningless at large deviance — or "absolute" |ddev| < tol (the
+    reference's semantics, GLM.scala:452,459,610).
   * The 16-overload matrix becomes keyword arguments (SURVEY.md §5 config).
 """
 
@@ -38,7 +40,8 @@ import numpy as np
 
 from jax.sharding import PartitionSpec as P
 
-from ..config import DEFAULT, NumericConfig, resolve_matmul_precision
+from ..config import (DEFAULT, NumericConfig, effective_tol,
+                      resolve_matmul_precision)
 from ..families.families import Family, resolve
 from ..families.links import Link
 from ..ops.fused import fused_fisher_pass, fused_fisher_pass_ref
@@ -103,6 +106,8 @@ def _irls_kernel(
     )
 
     def not_converged(s):
+        # callers pre-clamp the relative tol to the deviance dtype's
+        # resolution (config.effective_tol)
         d = s["ddev"]
         if criterion == "relative":
             d = d / (jnp.abs(s["dev"]) + 0.1)
@@ -262,6 +267,8 @@ def _irls_fused_kernel(
     step = spmd_pass(False)
 
     def not_converged(s):
+        # callers pre-clamp the relative tol to the deviance dtype's
+        # resolution (config.effective_tol)
         d = s["ddev"]
         if criterion == "relative":
             d = d / (jnp.abs(s["dev"]) + 0.1)
@@ -440,7 +447,7 @@ class GLMModel:
 def _finalize_model(
     *, fam, lnk, beta, cov_inv, dev, pearson, loglik, wt_sum, n_ok,
     null_dev, iters, converged, n_obs, p, xnames, yname, has_intercept,
-    has_offset, n_shards, tol, criterion, verbose,
+    has_offset, n_shards, tol, criterion, verbose, tol_eff=None,
 ) -> GLMModel:
     """Shared tail of every resident fit path: the non-convergence warning,
     dispersion / SEs / AIC (ref: createObj, GLM.scala:59-88) and the model
@@ -450,10 +457,13 @@ def _finalize_model(
         # R warns here ("glm.fit: algorithm did not converge"); a silent
         # converged=False field is too easy to miss (VERDICT r1 weak #7)
         import warnings
+        clamp_note = (f" (effective threshold {tol_eff:g} — the requested "
+                      "tol is below the deviance dtype's resolution)"
+                      if tol_eff is not None and tol_eff != tol else "")
         warnings.warn(
             f"IRLS did not converge in {iters} iterations (|ddev| criterion "
-            f"{criterion!r}, tol={tol:g}); estimates may be unreliable — "
-            "raise max_iter or loosen tol", stacklevel=3)
+            f"{criterion!r}, tol={tol:g}{clamp_note}); estimates may be "
+            "unreliable — raise max_iter or loosen tol", stacklevel=3)
     df_resid = n_ok - p
     # R reports NaN dispersion on a saturated fit (df 0), not a crash
     dispersion = (1.0 if fam.dispersion_fixed
@@ -524,7 +534,9 @@ def _fit_global(
     has_offset = offset is not None and bool(
         dist.allsum_f64([float(np.any(off_pre != 0.0))])[0] > 0)
 
-    tol_dev = jnp.asarray(tol, dtype if dtype == jnp.float64 else jnp.float32)
+    dev_dtype = dtype if dtype == jnp.float64 else jnp.float32
+    tol_run = effective_tol(tol, criterion, dev_dtype)
+    tol_dev = jnp.asarray(tol_run, dev_dtype)
     out = _irls_kernel(
         X, y, wd, od, tol_dev,
         jnp.asarray(max_iter, jnp.int32),
@@ -594,7 +606,7 @@ def _fit_global(
         n_obs=n_ok, p=p, xnames=xnames, yname=yname,
         has_intercept=has_intercept, has_offset=has_offset,
         n_shards=mesh.shape[meshlib.DATA_AXIS], tol=tol,
-        criterion=criterion, verbose=verbose)
+        criterion=criterion, verbose=verbose, tol_eff=tol_run)
 
 
 def fit(
@@ -606,9 +618,9 @@ def fit(
     weights=None,
     offset=None,
     m=None,
-    tol: float = 1e-6,
+    tol: float = 1e-8,
     max_iter: int = 100,
-    criterion: str = "absolute",
+    criterion: str = "relative",
     xnames: Sequence[str] | None = None,
     yname: str = "y",
     has_intercept: bool | None = None,
@@ -622,8 +634,10 @@ def fit(
     """Fit a GLM by IRLS on the device mesh.
 
     Keyword surface replaces the reference's 16 ``fit`` overloads over
-    {offset, m, tol, verbose} (GLM.scala:597-995, defaults tol=1e-6
-    GLM.scala:610).  ``m`` is binomial group sizes: ``y`` is then success
+    {offset, m, tol, verbose} (GLM.scala:597-995).  Convergence defaults
+    are R's (``glm.control``: relative, epsilon=1e-8); the reference's
+    absolute |ddev| < 1e-6 (GLM.scala:452,610) is ``criterion="absolute",
+    tol=1e-6``.  ``m`` is binomial group sizes: ``y`` is then success
     *counts* out of ``m`` (converted to proportions + weights, matching both
     the reference's (y, m) surface and R's proportion+weights convention).
 
@@ -783,7 +797,9 @@ def fit(
     od = meshlib.shard_rows(off, mesh)
 
     has_offset = offset is not None and bool(np.any(off64 != 0))
-    tol_dev = jnp.asarray(tol, jnp.float32 if not use_f64 else jnp.float64)
+    dev_dtype = jnp.float32 if not use_f64 else jnp.float64
+    tol_run = effective_tol(tol, criterion, dev_dtype)
+    tol_dev = jnp.asarray(tol_run, dev_dtype)
     if engine == "fused":
         out = _irls_fused_kernel(
             Xd, yd, wd, od, tol_dev,
@@ -900,4 +916,4 @@ def fit(
         converged=bool(out["converged"]), n_obs=n, p=p,
         xnames=xnames, yname=yname, has_intercept=has_intercept,
         has_offset=has_offset, n_shards=mesh.shape[meshlib.DATA_AXIS],
-        tol=tol, criterion=criterion, verbose=verbose)
+        tol=tol, criterion=criterion, verbose=verbose, tol_eff=tol_run)

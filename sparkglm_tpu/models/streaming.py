@@ -45,7 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.linalg
 
-from ..config import DEFAULT, NumericConfig
+from ..config import DEFAULT, NumericConfig, effective_tol
 from ..families.families import Family, resolve
 from ..families.links import Link
 from ..ops.fused import fused_fisher_pass_ref
@@ -370,9 +370,9 @@ def glm_fit_streaming(
     *,
     family: str | Family = "binomial",
     link: str | Link | None = None,
-    tol: float = 1e-6,
+    tol: float = 1e-8,
     max_iter: int = 100,
-    criterion: str = "absolute",
+    criterion: str = "relative",
     chunk_rows: int = DEFAULT_CHUNK_ROWS,
     xnames: Sequence[str] | None = None,
     yname: str = "y",
@@ -522,6 +522,10 @@ def glm_fit_streaming(
 
     iters = 0
     converged = False
+    # the per-chunk deviance is computed on device at `dtype`; the relative
+    # tolerance is floored at that dtype's resolution (config.effective_tol,
+    # same rule as the resident kernels)
+    tol_eff = effective_tol(tol, criterion, dtype)
     for it in range(max_iter):
         XtWX, XtWz, dev = full_pass(beta, False)
         ddev = abs(dev - dev_prev)
@@ -536,7 +540,7 @@ def glm_fit_streaming(
         beta, cho = _solve64(XtWX, XtWz, config.jitter)
         if on_iteration is not None:
             on_iteration(iters, beta.copy(), dev)  # checkpoint hook
-        if crit <= tol:
+        if crit <= tol_eff:
             converged = True
             break
     diag_inv = _diag_inv64(cho)  # once, from the final factorization
@@ -549,10 +553,13 @@ def glm_fit_streaming(
     ccache.open = False
     if not converged and not _null_model:
         import warnings
+        clamp_note = (f" (effective threshold {tol_eff:g} — the requested "
+                      "tol is below the deviance dtype's resolution)"
+                      if tol_eff != tol else "")
         warnings.warn(
             f"streaming IRLS did not converge in {iters} iterations "
-            f"(criterion {criterion!r}, tol={tol:g}); estimates may be "
-            "unreliable — raise max_iter or loosen tol", stacklevel=2)
+            f"(criterion {criterion!r}, tol={tol:g}{clamp_note}); estimates "
+            "may be unreliable — raise max_iter or loosen tol", stacklevel=2)
 
     # ---- final stats pass at the converged beta: HOST float64 -------------
     # (models/hoststats.py docstring: device-f32 transcendentals are too

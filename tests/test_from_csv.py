@@ -91,8 +91,10 @@ def test_glm_from_csv_factor_levels_span_chunks(tmp_path, mesh8, rng):
     data = sg.read_csv(str(p))
     m_mem = sg.glm("y ~ x + grp", data, family="binomial", tol=1e-8,
                    mesh=mesh8)
+    # both fits stop at the f32 deviance resolution (the relative-criterion
+    # ulp clamp), so they agree to the f32 floor, not to 1e-8
     np.testing.assert_allclose(m.coefficients, m_mem.coefficients,
-                               rtol=1e-6, atol=1e-8)
+                               rtol=1e-5, atol=1e-7)
 
 
 def test_glm_from_csv_cbind_and_na(tmp_path, mesh8, rng):

@@ -36,8 +36,10 @@ def test_fused_matches_einsum(mesh8, rng, family, link):
         y = np.round(y)
     w = rng.uniform(0.5, 2.0, size=n)
     off = 0.05 * rng.normal(size=n)
+    # absolute 1e-12: at dev >> 1 it is tighter than relative 1e-12, and the
+    # engine-equivalence comparison below needs both fully converged
     kw = dict(family=family, link=link, weights=w, offset=off,
-              tol=1e-12, max_iter=60, mesh=mesh8)
+              tol=1e-12, criterion="absolute", max_iter=60, mesh=mesh8)
     m_e = sg.glm_fit(X, y, engine="einsum", **kw)
     m_f = sg.glm_fit(X, y, engine="fused", **kw)
     np.testing.assert_allclose(m_f.coefficients, m_e.coefficients,

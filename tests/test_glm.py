@@ -107,6 +107,29 @@ def test_std_errors_match_fisher_information(rng, mesh8):
     np.testing.assert_allclose(m.std_errors, np.sqrt(np.diag(cov)), rtol=1e-5)
 
 
+def test_relative_tol_ulp_clamp(rng, mesh1):
+    """R's relative epsilon is floored at the deviance dtype's resolution
+    (config.effective_tol): an f32 fit asked for 1e-12 converges at the f32
+    noise floor instead of creeping through no-op iterations, and a
+    non-converged fit's warning names the effective threshold."""
+    import warnings
+    X, y = _logistic_data(rng, n=500, p=4)
+    Xf = X.astype(np.float32)
+    m = sg.glm_fit(Xf, y.astype(np.float32), family="binomial",
+                   criterion="relative", tol=1e-12, mesh=mesh1)
+    assert m.converged and m.iterations < 30
+    with warnings.catch_warnings(record=True) as wrec:
+        warnings.simplefilter("always")
+        sg.glm_fit(Xf, y.astype(np.float32), family="binomial",
+                   criterion="relative", tol=1e-12, max_iter=2, mesh=mesh1)
+    assert any("effective threshold" in str(w.message) for w in wrec)
+    # f64 paths keep the requested epsilon untouched
+    from sparkglm_tpu.config import effective_tol
+    assert effective_tol(1e-8, "relative", np.float64) == 1e-8
+    assert effective_tol(1e-12, "relative", np.float32) > 9e-7
+    assert effective_tol(1e-12, "absolute", np.float32) == 1e-12
+
+
 def test_max_iter_guard(rng, mesh1):
     X, y = _logistic_data(rng, n=300, p=3)
     m = sg.glm_fit(X, y, family="binomial", tol=0.0, max_iter=3, mesh=mesh1)
