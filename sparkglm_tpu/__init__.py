@@ -23,6 +23,7 @@ from .data.io import (native_available, read_csv, scan_csv_levels,
 from .data.model_matrix import Terms, build_terms, model_matrix, transform
 from .families.families import FAMILIES, Family, get_family
 from .families.links import LINKS, Link, get_link
+from .models.anova import AnovaTable, anova, drop1
 from .models.glm import GLMModel
 from .models.glm import fit as glm_fit
 from .models.lm import LMModel
@@ -40,6 +41,7 @@ __all__ = [
     "lm_from_csv", "glm_from_csv",
     "lm_fit_streaming", "glm_fit_streaming",
     "LMModel", "GLMModel", "load_model", "save_model",
+    "anova", "drop1", "AnovaTable",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
     "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",
     "transform", "as_columns", "omit_na", "read_csv", "scan_csv_schema",

@@ -67,9 +67,9 @@ class Formula:
                             f"formula term {comp!r} not found in data "
                             f"columns {available}")
                 add(t)
-        if not out:
+        if not out and not self.intercept:
             raise ValueError(f"formula {self.source!r} has no predictor terms")
-        return out
+        return out  # may be empty: 'y ~ 1' is R's intercept-only null model
 
 def _expand_term(sign: str, term: str, formula: str):
     """One '+'-separated chunk -> list of canonical term strings (R's ``*``
@@ -156,13 +156,10 @@ def parse_formula(formula: str) -> Formula:
             "intercept markers are supported (no parentheses, '^' or "
             "transforms)")
     tokens = re.findall(token_re, rhs)
-    if not tokens:
-        if offsets:
-            raise ValueError(
-                f"{formula!r} has only offset() on the right of '~'; "
-                "intercept-only fits are not supported — add at least one "
-                "predictor term (e.g. 'y ~ x + offset(...)')")
+    if not tokens and not offsets:
         raise ValueError(f"no terms on the right of '~': {formula!r}")
+    # 'y ~ offset(a)' is R's intercept-plus-offset model: no predictor
+    # tokens, intercept defaults to True
 
     intercept = True
     predictors: list[str] = []

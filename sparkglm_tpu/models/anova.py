@@ -1,0 +1,252 @@
+"""Model comparison: ``anova()`` (analysis of deviance / variance) and
+``drop1()`` (single-term deletions).
+
+Extensions over the reference (which has no model-comparison tooling at
+all — its full inference surface is the summary printer,
+GLM.scala:998-1025) following R's ``anova.glm`` / ``anova.lm`` /
+``drop1.glm`` semantics:
+
+  * ``anova(m1, m2, ...)`` — models fitted to the SAME data, usually
+    nested, in increasing complexity order.  GLMs get an Analysis of
+    Deviance table (Resid. Df / Resid. Dev / Df / Deviance, with
+    ``test="Chisq"`` or ``"F"`` p-values; the F denominator dispersion
+    comes from the largest model, as in R).  LMs get the RSS/F table.
+  * ``drop1(model, data)`` — refit dropping each droppable term (those
+    not marginal to a retained term — R's hierarchy rule, which our
+    ``build_terms`` marginality guard enforces anyway), reporting
+    Df / Deviance / AIC and optionally the scaled LRT.
+
+Statistics are host-side scipy on the models' stored scalars; the refits
+in ``drop1`` run the normal fit path (device IRLS).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import scipy.stats
+
+
+@dataclasses.dataclass(frozen=True)
+class AnovaTable:
+    title: str
+    heading: str
+    columns: tuple      # column names
+    row_names: tuple
+    rows: tuple         # tuple of tuples, None for empty cells
+
+    def __str__(self) -> str:
+        from ..utils.format import sig_digits
+        w_name = max((len(r) for r in self.row_names), default=4)
+        cells = [[("" if v is None else
+                   (f"{v:d}" if isinstance(v, (int, np.integer)) else
+                    ("< 2.2e-16" if isinstance(v, float) and 0 <= v < 2.2e-16
+                     and "Pr" in self.columns[j] else sig_digits(v, 5))))
+                  for j, v in enumerate(row)] for row in self.rows]
+        widths = [max([len(self.columns[j])] + [len(r[j]) for r in cells])
+                  for j in range(len(self.columns))]
+        out = [self.title, self.heading, ""]
+        out.append(" " * w_name + "  " +
+                   "  ".join(c.rjust(w) for c, w in zip(self.columns, widths)))
+        for nm, r in zip(self.row_names, cells):
+            out.append(nm.ljust(w_name) + "  " +
+                       "  ".join(v.rjust(w) for v, w in zip(r, widths)))
+        return "\n".join(out)
+
+    def __repr__(self) -> str:  # REPL-friendly, like R's print.anova
+        return self.__str__()
+
+
+def _is_lm(m) -> bool:
+    return hasattr(m, "sse")
+
+
+def anova(*models, test: str | None = None) -> AnovaTable:
+    """R's ``anova(m1, m2, ...)`` for fitted models on the same data.
+
+    ``test``: None (no p-values), ``"Chisq"`` (deviance chi-square; the
+    difference is scaled by the largest model's dispersion for families
+    with estimated dispersion) or ``"F"``.
+    """
+    if len(models) < 2:
+        raise ValueError(
+            "anova needs at least two fitted models (single-model "
+            "sequential tables require the data; use drop1(model, data))")
+    if test not in (None, "Chisq", "F"):
+        raise ValueError(f"test must be None, 'Chisq' or 'F', got {test!r}")
+    kinds = {_is_lm(m) for m in models}
+    if len(kinds) != 1:
+        raise TypeError("cannot mix lm and glm fits in one anova")
+    n_obs = {m.n_obs for m in models}
+    if len(n_obs) != 1:
+        raise ValueError(
+            f"models were fitted to different row counts {sorted(n_obs)}; "
+            "anova compares fits on the same data")
+
+    names = tuple(f"Model {i + 1}" for i in range(len(models)))
+    if _is_lm(models[0]):
+        big = max(models, key=lambda m: m.n_params)
+        s2 = big.sse / big.df_resid  # sigma^2 (scale) of the largest model
+        cols = ["Res.Df", "RSS", "Df", "Sum of Sq"]
+        if test == "F":
+            cols += ["F", "Pr(>F)"]
+        elif test == "Chisq":
+            cols += ["Pr(>Chi)"]  # R: pchisq(SumSq / scale, Df)
+        rows = []
+        prev = None
+        for m in models:
+            row = [int(m.df_resid), float(m.sse), None, None]
+            row += [None] * (len(cols) - 4)
+            if prev is not None:
+                ddf = prev.df_resid - m.df_resid
+                dss = prev.sse - m.sse
+                row[2], row[3] = int(ddf), float(dss)
+                if ddf > 0 and s2 > 0:
+                    if test == "F":
+                        fstat = (dss / ddf) / s2
+                        row[4] = float(fstat)
+                        row[5] = float(scipy.stats.f.sf(fstat, ddf,
+                                                        big.df_resid))
+                    elif test == "Chisq":
+                        row[4] = float(scipy.stats.chi2.sf(
+                            max(dss, 0.0) / s2, ddf))
+            rows.append(tuple(row))
+            prev = m
+        heading = "\n".join(f"Model {i + 1}: {m.formula or m.yname}"
+                            for i, m in enumerate(models))
+        return AnovaTable("Analysis of Variance Table", heading,
+                          tuple(cols), names, tuple(rows))
+
+    # ---- GLM: analysis of deviance ----------------------------------------
+    fams = {m.family for m in models}
+    if len(fams) != 1:
+        raise ValueError(f"models have different families {sorted(fams)}")
+    big = max(models, key=lambda m: m.n_params)
+    disp = float(big.dispersion)
+    cols = ["Resid. Df", "Resid. Dev", "Df", "Deviance"]
+    if test == "Chisq":
+        cols.append("Pr(>Chi)")
+    elif test == "F":
+        cols += ["F", "Pr(>F)"]
+    rows = []
+    prev = None
+    for m in models:
+        row: list = [int(m.df_residual), float(m.deviance), None, None]
+        row += [None] * (len(cols) - 4)
+        if prev is not None:
+            ddf = prev.df_residual - m.df_residual
+            ddev = prev.deviance - m.deviance
+            row[2], row[3] = int(ddf), float(ddev)
+            if ddf > 0:
+                if test == "Chisq":
+                    row[4] = float(scipy.stats.chi2.sf(
+                        max(ddev, 0.0) / disp, ddf))
+                elif test == "F" and disp > 0 and big.df_residual > 0:
+                    fstat = (ddev / ddf) / disp
+                    row[4] = float(fstat)
+                    row[5] = float(scipy.stats.f.sf(fstat, ddf,
+                                                    big.df_residual))
+        rows.append(tuple(row))
+        prev = m
+    heading = "\n".join(f"Model {i + 1}: {m.formula or m.yname}"
+                        for i, m in enumerate(models))
+    return AnovaTable("Analysis of Deviance Table", heading,
+                      tuple(cols), names, tuple(rows))
+
+
+def _droppable_terms(design) -> list:
+    """Terms not marginal to any other term (R's drop1 scope): T is
+    droppable iff no other term's component set strictly contains T's."""
+    sets = [frozenset(t) for t in design]
+    return [t for t, s in zip(design, sets)
+            if not any(s < s2 for s2 in sets)]
+
+
+def drop1(model, data, *, test: str | None = None, weights=None,
+          offset=None, **fit_kw) -> AnovaTable:
+    """R's ``drop1``: refit without each droppable term.
+
+    Needs the training ``data`` (models do not retain it).  Reports the
+    reduced fits' Deviance and AIC; ``test="Chisq"`` adds the
+    dispersion-scaled LRT and its p-value.  ``weights``/``offset`` and
+    extra fit kwargs are forwarded to the refits (a by-name fit-time
+    offset stored on the model is applied automatically).
+    """
+    from .. import api
+    from ..data.frame import as_columns
+
+    if model.terms is None:
+        raise ValueError(
+            "drop1 needs a formula-fitted model (model.terms is None)")
+    if test not in (None, "Chisq"):
+        raise ValueError(f"test must be None or 'Chisq', got {test!r}")
+    is_lm = _is_lm(model)
+    if offset is None:
+        offset = getattr(model, "offset_col", None)
+        if isinstance(offset, (tuple, list)):
+            cols = as_columns(data)
+            offset = sum(np.asarray(cols[nm], np.float64) for nm in offset)
+        if offset is None and getattr(model, "has_offset", False):
+            # same refusal as api.predict: an array offset cannot be
+            # recovered from the data, and refitting without it would
+            # silently inflate every LRT
+            raise ValueError(
+                "model was fit with an array offset; pass offset= to drop1 "
+                "(or fit with the offset as a named column so it travels "
+                "with the model)")
+
+    def refit(term_strings):
+        rhs = (" + ".join(term_strings) if term_strings else "1") \
+            + ("" if model.has_intercept else " - 1")
+        formula = f"{model.yname} ~ {rhs}"  # empty scope -> R's 'y ~ 1'
+        if is_lm:
+            return api.lm(formula, data, weights=weights, **fit_kw)
+        return api.glm(formula, data, family=model.family, link=model.link,
+                       weights=weights, offset=offset, tol=model.tol,
+                       **fit_kw)
+
+    all_terms = [":".join(t) for t in model.terms.design]
+    dropped_names = [":".join(t) for t in _droppable_terms(model.terms.design)]
+    if not dropped_names:
+        raise ValueError("no droppable terms (every term is marginal to "
+                         "another)")
+
+    if is_lm:
+        cols = ["Df", "Sum of Sq", "RSS", "AIC"]
+        # R's stats:::drop1.lm AIC: n*log(RSS/n) + 2*edf (+ constants
+        # dropped — differences are what matter)
+        n = model.n_obs
+
+        def aic_lm(m):
+            return n * np.log(m.sse / n) + 2 * (n - m.df_resid)
+        rows = [(None, None, float(model.sse), float(aic_lm(model)))]
+        row_names = ["<none>"]
+        for nm in dropped_names:
+            sub = refit([t for t in all_terms if t != nm])
+            rows.append((int(sub.df_resid - model.df_resid),
+                         float(sub.sse - model.sse),
+                         float(sub.sse), float(aic_lm(sub))))
+            row_names.append(nm)
+        return AnovaTable("Single term deletions", f"Model: {model.formula}",
+                          tuple(cols), tuple(row_names), tuple(rows))
+
+    disp = float(model.dispersion)
+    cols = ["Df", "Deviance", "AIC"]
+    if test == "Chisq":
+        cols += ["LRT", "Pr(>Chi)"]
+    rows = [(None, float(model.deviance), float(model.aic))
+            + ((None, None) if test == "Chisq" else ())]
+    row_names = ["<none>"]
+    for nm in dropped_names:
+        sub = refit([t for t in all_terms if t != nm])
+        row = [int(sub.df_residual - model.df_residual),
+               float(sub.deviance), float(sub.aic)]
+        if test == "Chisq":
+            lrt = max(sub.deviance - model.deviance, 0.0) / disp
+            row += [float(lrt),
+                    float(scipy.stats.chi2.sf(lrt, row[0]))]
+        rows.append(tuple(row))
+        row_names.append(nm)
+    return AnovaTable("Single term deletions", f"Model: {model.formula}",
+                      tuple(cols), tuple(row_names), tuple(rows))

@@ -1,0 +1,192 @@
+"""anova() / drop1() — R's model-comparison tables (the reference has no
+model comparison at all; its whole inference surface is the summary
+printer, GLM.scala:998-1025)."""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+import sparkglm_tpu as sg
+
+
+@pytest.fixture()
+def pois_data(rng):
+    n = 800
+    x = rng.normal(size=n)
+    z = rng.normal(size=n)
+    grp = rng.choice(["a", "b"], size=n)
+    lam = np.exp(0.3 + 0.5 * x + 0.4 * (grp == "b"))  # z is null
+    return {"x": x, "z": z, "grp": grp,
+            "y": rng.poisson(lam).astype(float)}
+
+
+def test_anova_glm_chisq(pois_data):
+    m1 = sg.glm("y ~ x", pois_data, family="poisson")
+    m2 = sg.glm("y ~ x + grp", pois_data, family="poisson")
+    m3 = sg.glm("y ~ x + grp + z", pois_data, family="poisson")
+    t = sg.anova(m1, m2, m3, test="Chisq")
+    assert t.columns == ("Resid. Df", "Resid. Dev", "Df", "Deviance",
+                         "Pr(>Chi)")
+    assert t.rows[0][2] is None  # first row has no comparison
+    # row 2: m1 -> m2, df diff 1, deviance drop large, p tiny
+    assert t.rows[1][2] == 1
+    dd = t.rows[1][3]
+    np.testing.assert_allclose(dd, m1.deviance - m2.deviance, rtol=1e-12)
+    np.testing.assert_allclose(t.rows[1][4], scipy.stats.chi2.sf(dd, 1),
+                               rtol=1e-10)
+    assert t.rows[1][4] < 1e-6       # grp is a real effect
+    assert t.rows[2][4] > 0.01       # z is null
+    s = str(t)
+    assert "Analysis of Deviance Table" in s and "Pr(>Chi)" in s
+
+
+def test_anova_glm_f_gamma(rng):
+    """Estimated-dispersion family: F test scaled by the largest model's
+    dispersion, as in R."""
+    n = 600
+    x = rng.normal(size=n)
+    z = rng.normal(size=n)
+    mu = np.exp(0.5 + 0.4 * x)
+    d = {"x": x, "z": z, "y": rng.gamma(4.0, mu / 4.0)}
+    m1 = sg.glm("y ~ x", d, family="gamma", link="log")
+    m2 = sg.glm("y ~ x + z", d, family="gamma", link="log")
+    t = sg.anova(m1, m2, test="F")
+    fstat = t.rows[1][4]
+    expect = ((m1.deviance - m2.deviance) / 1) / m2.dispersion
+    np.testing.assert_allclose(fstat, expect, rtol=1e-10)
+    np.testing.assert_allclose(
+        t.rows[1][5], scipy.stats.f.sf(expect, 1, m2.df_residual), rtol=1e-9)
+
+
+def test_anova_lm(rng):
+    n = 400
+    x = rng.normal(size=n)
+    z = rng.normal(size=n)
+    d = {"x": x, "z": z, "y": 1.0 + 2.0 * x + 0.3 * rng.normal(size=n)}
+    m1 = sg.lm("y ~ x", d)
+    m2 = sg.lm("y ~ x + z", d)
+    t = sg.anova(m1, m2, test="F")
+    assert t.columns[:4] == ("Res.Df", "RSS", "Df", "Sum of Sq")
+    s2 = m2.sse / m2.df_resid
+    expect_f = (m1.sse - m2.sse) / s2
+    np.testing.assert_allclose(t.rows[1][4], expect_f, rtol=1e-10)
+    assert t.rows[1][5] > 0.01  # z is noise
+
+
+def test_anova_validation(pois_data, rng):
+    m1 = sg.glm("y ~ x", pois_data, family="poisson")
+    with pytest.raises(ValueError, match="at least two"):
+        sg.anova(m1)
+    d2 = {"x": rng.normal(size=100), "y": np.ones(100)}
+    m_other = sg.lm("y ~ x", d2)
+    with pytest.raises(TypeError, match="mix"):
+        sg.anova(m1, m_other)
+    m_small = sg.glm("y ~ x", {k: v[:300] for k, v in pois_data.items()},
+                     family="poisson")
+    with pytest.raises(ValueError, match="different row counts"):
+        sg.anova(m1, m_small)
+
+
+def test_drop1_glm(pois_data):
+    m = sg.glm("y ~ x + grp + z", pois_data, family="poisson")
+    t = sg.drop1(m, pois_data, test="Chisq")
+    assert t.row_names == ("<none>", "x", "grp", "z")
+    # each reduced fit's deviance must exceed the full model's
+    for row in t.rows[1:]:
+        assert row[1] >= m.deviance
+        assert row[0] == 1
+    # LRT for each dropped term matches an explicit nested-model anova
+    m_no_z = sg.glm("y ~ x + grp", pois_data, family="poisson")
+    z_row = t.rows[t.row_names.index("z")]
+    np.testing.assert_allclose(z_row[3], m_no_z.deviance - m.deviance,
+                               rtol=1e-9, atol=1e-9)
+    assert z_row[4] > 0.01       # z null
+    grp_row = t.rows[t.row_names.index("grp")]
+    assert grp_row[4] < 1e-6     # grp real
+
+
+def test_drop1_respects_marginality(rng):
+    """With x:grp in the model, x and grp are marginal and not droppable —
+    only the interaction appears in the scope (R's hierarchy rule)."""
+    n = 500
+    x = rng.normal(size=n)
+    grp = rng.choice(["a", "b"], size=n)
+    eta = 0.2 + 0.5 * x + 0.3 * (grp == "b") - 0.4 * x * (grp == "b")
+    d = {"x": x, "grp": grp,
+         "y": (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(float)}
+    m = sg.glm("y ~ x * grp", d, family="binomial")
+    t = sg.drop1(m, d, test="Chisq")
+    assert t.row_names == ("<none>", "x:grp")
+
+
+def test_intercept_only_formula(rng):
+    """'y ~ 1' is R's null model; 'y ~ offset(a)' the offset-only variant."""
+    n = 300
+    y = rng.poisson(3.0, size=n).astype(float)
+    m = sg.glm("y ~ 1", {"y": y}, family="poisson")
+    assert m.xnames == ("intercept",)
+    np.testing.assert_allclose(np.exp(m.coefficients[0]), y.mean(), rtol=1e-6)
+    np.testing.assert_allclose(m.deviance, m.null_deviance, rtol=1e-10)
+    lt = rng.uniform(0.2, 0.8, size=n)
+    m2 = sg.glm("y ~ offset(lt)", {"y": y, "lt": lt}, family="poisson")
+    assert m2.xnames == ("intercept",)
+    # a no-predictor, no-intercept formula is still an error
+    with pytest.raises(ValueError, match="no predictor terms"):
+        sg.glm("y ~ -1", {"y": y}, family="poisson")
+
+
+def test_drop1_single_term_refits_null(rng):
+    n = 400
+    x = rng.normal(size=n)
+    d = {"x": x, "y": rng.poisson(np.exp(0.3 + 0.5 * x)).astype(float)}
+    m = sg.glm("y ~ x", d, family="poisson")
+    t = sg.drop1(m, d, test="Chisq")
+    assert t.row_names == ("<none>", "x")
+    # the reduced fit IS the null model
+    np.testing.assert_allclose(t.rows[1][1], m.null_deviance, rtol=1e-8)
+
+
+def test_drop1_refuses_array_offset(rng):
+    n = 300
+    x = rng.normal(size=n)
+    off = rng.uniform(0.1, 0.5, size=n)
+    d = {"x": x, "y": rng.poisson(np.exp(0.2 + 0.4 * x + off)).astype(float)}
+    m = sg.glm("y ~ x", d, family="poisson", offset=off)
+    with pytest.raises(ValueError, match="array offset"):
+        sg.drop1(m, d)
+    # explicitly passing it back works
+    t = sg.drop1(m, d, offset=off, test="Chisq")
+    assert t.row_names == ("<none>", "x")
+
+
+def test_anova_lm_chisq_is_chisq(rng):
+    n = 400
+    x = rng.normal(size=n)
+    z = rng.normal(size=n)
+    d = {"x": x, "z": z, "y": 1.0 + 2.0 * x + 0.3 * rng.normal(size=n)}
+    m1 = sg.lm("y ~ x", d)
+    m2 = sg.lm("y ~ x + z", d)
+    t = sg.anova(m1, m2, test="Chisq")
+    assert t.columns == ("Res.Df", "RSS", "Df", "Sum of Sq", "Pr(>Chi)")
+    s2 = m2.sse / m2.df_resid
+    expect = scipy.stats.chi2.sf((m1.sse - m2.sse) / s2, 1)
+    np.testing.assert_allclose(t.rows[1][4], expect, rtol=1e-10)
+
+
+def test_drop1_lm_and_offset(rng):
+    n = 400
+    x = rng.normal(size=n)
+    z = rng.normal(size=n)
+    d = {"x": x, "z": z, "y": 1.0 + 2.0 * x + 0.3 * rng.normal(size=n)}
+    t = sg.drop1(sg.lm("y ~ x + z", d), d)
+    assert t.columns == ("Df", "Sum of Sq", "RSS", "AIC")
+    assert t.rows[1][2] > t.rows[0][2]  # dropping x raises RSS a lot
+    # a by-name fit-time offset travels into the refits automatically
+    lt = rng.uniform(0.2, 0.8, size=n)
+    dp = {"x": x, "z": z, "lt": lt,
+          "y": rng.poisson(np.exp(0.2 + 0.4 * x + lt)).astype(float)}
+    mp = sg.glm("y ~ x + z + offset(lt)", dp, family="poisson")
+    tp = sg.drop1(mp, dp, test="Chisq")
+    sub = sg.glm("y ~ x + offset(lt)", dp, family="poisson")
+    z_row = tp.rows[tp.row_names.index("z")]
+    np.testing.assert_allclose(z_row[1], sub.deviance, rtol=1e-9)
