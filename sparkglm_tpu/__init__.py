@@ -21,7 +21,7 @@ from .data.frame import as_columns, omit_na
 from .data.io import (native_available, read_csv, scan_csv_levels,
                       scan_csv_schema)
 from .data.model_matrix import Terms, build_terms, model_matrix, transform
-from .families.families import FAMILIES, Family, get_family
+from .families.families import FAMILIES, Family, get_family, quasi
 from .families.links import LINKS, Link, get_link
 from .models.anova import AnovaTable, anova, drop1
 from .models.glm import GLMModel
@@ -43,6 +43,7 @@ __all__ = [
     "LMModel", "GLMModel", "load_model", "save_model",
     "anova", "drop1", "AnovaTable",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
+    "quasi",
     "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",
     "transform", "as_columns", "omit_na", "read_csv", "scan_csv_schema",
     "scan_csv_levels",

@@ -132,8 +132,13 @@ poisson = Family(
 # ----------------------------------------------------------------------------
 
 def _gamma_dev(y, mu, wt):
-    yc = jnp.maximum(y, _EPS)
-    return -2.0 * wt * (jnp.log(yc / jnp.maximum(mu, _EPS)) - (y - mu) / jnp.maximum(mu, _EPS))
+    # R Gamma()$dev.resids: -2*wt*(log(ifelse(y==0, 1, y/mu)) - (y-mu)/mu).
+    # The y==0 guard matters for quasi(mu^2), which R permits on zero
+    # responses (Gamma itself rejects them at init; so do we) — an epsilon
+    # clamp here would add ~log(eps) ~ -690 per zero row to the deviance.
+    mu_c = jnp.maximum(mu, _EPS)
+    ratio = jnp.where(y == 0, 1.0, y / mu_c)
+    return -2.0 * wt * (jnp.log(ratio) - (y - mu) / mu_c)
 
 
 gamma = Family(
@@ -179,6 +184,36 @@ quasipoisson = dataclasses.replace(
 quasibinomial = dataclasses.replace(
     binomial, name="quasibinomial", dispersion_fixed=False, aic=_NAN_AIC)
 
+_QUASI_VARIANCE_BASE = {
+    "constant": lambda: gaussian,
+    "mu": lambda: poisson,
+    "mu(1-mu)": lambda: binomial,
+    "mu^2": lambda: gamma,
+    "mu^3": lambda: inverse_gaussian,
+}
+
+
+def quasi(variance: str = "constant") -> Family:
+    """R's general ``quasi(variance=...)`` family constructor.
+
+    The variance function selects the mean/variance model (and with it the
+    quasi-deviance — R's quasi() uses exactly the matching exponential
+    family's deviance residuals); dispersion is estimated (Pearson/df) and
+    AIC/logLik are NA, as in R.  Combine with any link via the separate
+    ``link=`` argument (R's quasi default link is "identity"):
+
+        sg.glm_fit(X, y, family=sg.quasi("mu^2"), link="log")
+    """
+    try:
+        base = _QUASI_VARIANCE_BASE[variance]()
+    except KeyError:
+        raise ValueError(
+            f"unknown quasi variance {variance!r}; choose from "
+            f"{sorted(_QUASI_VARIANCE_BASE)}") from None
+    return dataclasses.replace(
+        base, name=f"quasi({variance})", default_link="identity",
+        dispersion_fixed=False, aic=_NAN_AIC)
+
 
 FAMILIES: dict[str, Family] = {
     "gaussian": gaussian,
@@ -194,11 +229,19 @@ FAMILIES: dict[str, Family] = {
 def get_family(family: str | Family) -> Family:
     if isinstance(family, Family):
         return family
+    name = family.lower()
+    # "quasi(mu^2)" round-trips through model metadata (serialize.py stores
+    # the name string); "quasi" alone is R's default variance="constant"
+    if name == "quasi":
+        return quasi()
+    if name.startswith("quasi(") and name.endswith(")"):
+        return quasi(name[len("quasi("):-1])
     try:
-        return FAMILIES[family.lower()]
+        return FAMILIES[name]
     except KeyError:
         raise ValueError(
-            f"unknown family {family!r}; available: {sorted(FAMILIES)}") from None
+            f"unknown family {family!r}; available: "
+            f"{sorted(FAMILIES) + ['quasi(<variance>)']}") from None
 
 
 def resolve(family: str | Family, link: str | Link | None) -> tuple[Family, Link]:

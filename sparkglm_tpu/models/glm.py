@@ -551,9 +551,11 @@ def _fit_global(
             "no aliasing path; drop dependent columns before sharding)")
 
     # host-f64 statistics from per-process partial sums
-    from .validate import check_finite_design, check_finite_vector
+    from .validate import (check_finite_design, check_finite_vector,
+                           check_response_domain)
     y_loc = np.asarray(dist.local_rows_of(y), np.float64)
     check_finite_vector("y", y_loc[wt_pre > 0])
+    check_response_domain(fam.name, y_loc[wt_pre > 0])
     check_finite_vector("weights", wt_pre)
     check_finite_vector("offset", off_pre)
     eta_loc = np.asarray(dist.local_rows_of(out["eta"]), np.float64)
@@ -736,6 +738,8 @@ def fit(
     off64 = (np.zeros((n,), np.float64) if offset is None
              else _check_len(offset, "offset").astype(np.float64))
     check_finite_vector("offset", off64)
+    from .validate import check_response_domain
+    check_response_domain(fam.name, y64)  # R's family$initialize checks
     y = y64.astype(dtype)
     wt = wt64.astype(dtype)
     off = off64.astype(dtype)

@@ -31,9 +31,10 @@ Formulas follow R's ``stats::family()`` objects:
     S1 = sum(wt*log y)
   * inverse.gaussian: logLik = -(sum(wt)*(log(2*pi*dev/sum(wt))+1)
     + 3*sum(wt*log y))/2
-  * quasi families: same mean/variance model as the base family; R reports
-    NA for their AIC (families.py sets it NaN) — the base-family logLik is
-    reported for information.
+  * quasi families: same mean/variance model as the base family, but no
+    likelihood is defined — logLik and AIC are both NaN, matching R's
+    ``logLik(<quasi fit>)`` = NA (``ll_finalize``/``ll_chunk_stat`` short-
+    circuit; families.py sets the NaN AIC).
 """
 
 from __future__ import annotations
@@ -112,8 +113,18 @@ def link_deriv(name: str, mu: np.ndarray) -> np.ndarray:
 
 
 def _base(family: str) -> str:
-    return {"quasipoisson": "poisson", "quasibinomial": "binomial"}.get(
-        family, family)
+    """Mean/variance model behind a (possibly quasi) family name: the host
+    deviance/variance formulas are shared, only dispersion/likelihood
+    semantics differ.  The quasi(...) map is derived from the constructor's
+    own table (families/families.py) so a new variance option cannot fall
+    out of sync here."""
+    if family in ("quasipoisson", "quasibinomial"):
+        return family[len("quasi"):]
+    if family.startswith("quasi(") and family.endswith(")"):
+        from ..families.families import _QUASI_VARIANCE_BASE
+        variance = family[len("quasi("):-1]
+        return _QUASI_VARIANCE_BASE[variance]().name
+    return family
 
 
 def variance(family: str, mu: np.ndarray) -> np.ndarray:
@@ -149,8 +160,10 @@ def dev_resids(family: str, y, mu, wt) -> np.ndarray:
             d = sp.xlogy(y, y / mu) - (y - mu)
         return 2.0 * wt * np.nan_to_num(d, nan=0.0, posinf=0.0, neginf=0.0)
     if f == "gamma":
-        yc = np.maximum(y, _TINY)
-        return -2.0 * wt * (np.log(yc / mu) - (y - mu) / mu)
+        # R's y==0 guard (log(ifelse(y==0, 1, y/mu))): exact for
+        # quasi(mu^2) on zero responses; Gamma itself never sees y=0
+        ratio = np.where(y == 0, 1.0, y / mu)
+        return -2.0 * wt * (np.log(ratio) - (y - mu) / mu)
     if f == "inverse_gaussian":
         return wt * (y - mu) ** 2 / (np.maximum(y, _TINY) * mu * mu)
     raise ValueError(f"unknown family {family!r}")
@@ -163,7 +176,11 @@ def ll_chunk_stat(family: str, y, mu, wt) -> float:
       * gaussian: sum(log wt)
       * gamma / inverse-gaussian: sum(wt * log y)
     Zero-weight rows are excluded (R drops them from the likelihood too).
+    Quasi families define no likelihood (ll_finalize returns NaN) — skip
+    the per-row work instead of computing a stat that gets discarded.
     """
+    if family.startswith("quasi"):
+        return 0.0
     f = _base(family)
     y = np.asarray(y, np.float64)
     mu = np.asarray(mu, np.float64)
@@ -190,7 +207,13 @@ def ll_chunk_stat(family: str, y, mu, wt) -> float:
 def ll_finalize(family: str, stat: float, dev: float, wt_sum: float,
                 n: float) -> float:
     """Combine the summed :func:`ll_chunk_stat` with the total deviance into
-    the exact R logLik (module docstring lists the per-family formulas)."""
+    the exact R logLik (module docstring lists the per-family formulas).
+
+    Quasi families have no likelihood — R's ``logLik`` returns NA there
+    (as does AIC); reporting the base family's number would claim a
+    likelihood the model does not define."""
+    if family.startswith("quasi"):
+        return float("nan")
     f = _base(family)
     if f in ("binomial", "poisson"):
         return float(stat)

@@ -452,8 +452,11 @@ def glm_fit_streaming(
                 # R's NA/NaN/Inf model-frame errors — without this the
                 # kernel sanitizer silently excludes non-finite rows
                 # (models/validate.py); first pass only
-                from .validate import check_finite_design, check_finite_vector
+                from .validate import (check_finite_design,
+                                       check_finite_vector,
+                                       check_response_domain)
                 check_finite_vector("y", np.asarray(yc, np.float64))
+                check_response_domain(fam.name, np.asarray(yc, np.float64))
                 if wc is not None:
                     check_finite_vector("weights", np.asarray(wc, np.float64))
                 if oc is not None:

@@ -29,3 +29,27 @@ def check_finite_design(X) -> None:
     if not np.all(np.isfinite(X)):
         raise ValueError("NA/NaN/Inf in the design matrix — drop or impute "
                          f"missing predictors{_HINT}")
+
+
+def check_response_domain(family: str, y: np.ndarray) -> None:
+    """R's ``family$initialize`` response checks (R's error wording):
+    Gamma/inverse-gaussian require positive y, (quasi)poisson non-negative
+    y, (quasi)binomial y in [0, 1] (proportions; counts arrive here already
+    divided by m).  The general ``quasi(variance)`` constructor skips
+    validation exactly as R's ``quasi`` does — that permissiveness is why
+    e.g. quasi(mu^2) may see y == 0."""
+    if family.startswith("quasi("):
+        return
+    if family == "gamma" and np.any(y <= 0):
+        raise ValueError(
+            "non-positive values not allowed for the 'Gamma' family")
+    if family == "inverse_gaussian" and np.any(y <= 0):
+        raise ValueError(
+            "positive values only are allowed for the 'inverse.gaussian' "
+            "family")
+    if family in ("poisson", "quasipoisson") and np.any(y < 0):
+        raise ValueError(
+            f"negative values not allowed for the {family!r} family")
+    if family in ("binomial", "quasibinomial") and (np.any(y < 0)
+                                                    or np.any(y > 1)):
+        raise ValueError("y values must be 0 <= y <= 1")

@@ -34,6 +34,95 @@ def test_quasibinomial(mesh8, rng):
     assert np.isnan(mq.aic) and np.isfinite(mb.aic)
 
 
+def test_quasi_constructor(mesh8, rng):
+    """R's quasi(variance=..., link=...): same coefficients as the matching
+    exponential family, dispersion estimated, AIC and logLik NA."""
+    n, p = 1200, 3
+    X = rng.normal(size=(n, p)) * 0.3
+    X[:, 0] = 1.0
+    mu = np.exp(X @ [0.5, 0.4, -0.3])
+    y = rng.gamma(4.0, mu / 4.0)
+    mg = sg.glm_fit(X, y, family="gamma", link="log", tol=1e-10, mesh=mesh8)
+    mq = sg.glm_fit(X, y, family=sg.quasi("mu^2"), link="log", tol=1e-10,
+                    mesh=mesh8)
+    np.testing.assert_allclose(mq.coefficients, mg.coefficients, rtol=1e-9)
+    assert mq.family == "quasi(mu^2)"
+    assert np.isnan(mq.aic) and np.isnan(mq.loglik)
+    assert np.isfinite(mq.dispersion) and mq.dispersion != 1.0
+    np.testing.assert_allclose(mq.deviance, mg.deviance, rtol=1e-9)
+    # string round-trip (what serialize stores) and the R default
+    assert sg.get_family("quasi(mu^2)").name == "quasi(mu^2)"
+    assert sg.get_family("quasi").name == "quasi(constant)"
+    assert sg.quasi().default_link == "identity"
+    with pytest.raises(ValueError, match="unknown quasi variance"):
+        sg.quasi("mu^4")
+
+
+def test_quasi_constant_matches_wls(mesh8, rng):
+    """quasi(constant, identity) is weighted least squares with estimated
+    dispersion — coefficients match lm_fit exactly."""
+    n, p = 900, 4
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    y = X @ [1.0, 0.5, -0.2, 0.3] + 0.4 * rng.normal(size=n)
+    mq = sg.glm_fit(X, y, family=sg.quasi(), tol=1e-12, mesh=mesh8)
+    ml = sg.lm_fit(X, y, mesh=mesh8)
+    np.testing.assert_allclose(mq.coefficients, ml.coefficients,
+                               rtol=1e-8, atol=1e-10)
+    np.testing.assert_allclose(mq.std_errors, ml.std_errors, rtol=1e-6)
+
+
+def test_quasi_loglik_na_for_quasipoisson(mesh8, rng):
+    """R's logLik(quasipoisson fit) is NA — reporting the poisson number
+    would claim a likelihood the model does not define."""
+    n = 600
+    X = rng.normal(size=(n, 3)); X[:, 0] = 1.0
+    y = rng.poisson(np.exp(X @ [0.3, 0.4, -0.2])).astype(float)
+    mq = sg.glm_fit(X, y, family="quasipoisson", tol=1e-10, mesh=mesh8)
+    assert np.isnan(mq.loglik) and np.isnan(mq.aic)
+
+
+def test_response_domain_validation(mesh1, rng):
+    """R's family$initialize checks: Gamma rejects y <= 0, poisson rejects
+    negatives, binomial demands [0,1]; quasi(variance) skips them like R."""
+    n = 64
+    X = rng.normal(size=(n, 2)); X[:, 0] = 1.0
+    y_pos = rng.gamma(2.0, 1.0, size=n)
+    y0 = y_pos.copy(); y0[3] = 0.0
+    with pytest.raises(ValueError, match="Gamma"):
+        sg.glm_fit(X, y0, family="gamma", link="log", mesh=mesh1)
+    with pytest.raises(ValueError, match="negative values"):
+        sg.glm_fit(X, np.where(np.arange(n) == 5, -1.0, 2.0),
+                   family="poisson", mesh=mesh1)
+    with pytest.raises(ValueError, match="0 <= y <= 1"):
+        sg.glm_fit(X, np.full(n, 1.5), family="binomial", mesh=mesh1)
+    with pytest.raises(ValueError, match="inverse.gaussian"):
+        sg.glm_fit(X, y0, family="inverse_gaussian", link="log", mesh=mesh1)
+    # streaming path raises too
+    with pytest.raises(ValueError, match="Gamma"):
+        sg.glm_fit_streaming((X, y0), family="gamma", link="log",
+                             chunk_rows=32, mesh=mesh1)
+
+
+def test_quasi_mu2_zero_response_matches_r(mesh1, rng):
+    """quasi(mu^2) permits y == 0 (R's quasi has no initialize check) and
+    R's y==0 deviance guard gives exactly -2*wt per zero row at mu — not
+    the ~690 an epsilon-clamped log would add."""
+    from sparkglm_tpu.models import hoststats
+    d = hoststats.dev_resids("quasi(mu^2)", np.array([0.0]),
+                             np.array([1.5]), np.array([1.0]))
+    np.testing.assert_allclose(d, [-2.0], rtol=1e-12)
+    # end-to-end: a quasi(mu^2)/log fit with some zero responses converges
+    n = 400
+    X = rng.normal(size=(n, 2)) * 0.3; X[:, 0] = 1.0
+    mu = np.exp(X @ [0.4, 0.5])
+    y = rng.gamma(2.0, mu / 2.0)
+    y[::50] = 0.0
+    m = sg.glm_fit(X, y, family=sg.quasi("mu^2"), link="log", tol=1e-10,
+                   mesh=mesh1)
+    assert m.converged and np.all(np.isfinite(m.coefficients))
+    assert np.isfinite(m.deviance)
+
+
 def test_inverse_gaussian_family(mesh8, rng):
     n, p = 1200, 3
     X = np.abs(rng.normal(size=(n, p))) * 0.2 + 0.1
