@@ -135,25 +135,44 @@ def main() -> None:
                               converged=bool(out["converged"]),
                               mfu_vs_bf16_peak=round(mfu, 4))
 
-    # ---- two-point overhead model for the 10M x 1000 x 8-chip estimate ----
-    n_small = n // 8
-    t_s, _, out_s = time_irls(make_data(n_small), reps=3)
-    it_s = max(1, int(out_s["iters"]))
-    t_i_big, t_i_small = s_per_iter, t_s / it_s
-    b_row = max((t_i_big - t_i_small) / (n - n_small), 1e-15)  # s per row
-    a_fix = max(t_i_small - b_row * n_small, 0.0)              # s per iter fixed
-    n_h, p_h = 10_000_000, 1000
-    # b_row was measured with the run's rows already split over n_chips;
-    # normalize to a single-chip rate before dividing by the target's 8 chips
-    b_h = b_row * n_chips * (p_h / p) ** 2   # Gramian term scales with p^2
-    est_iter_h = a_fix + b_h * (n_h / 8)     # per-chip rows on v5e-8
-    est_headline = est_iter_h * iters     # assume the measured iteration count
+    # ---- the 10M x 1000 x v5e-8 estimate: MEASURE the per-chip share ------
+    # 10M rows over 8 chips is 1.25M rows/chip at p=1000 (5 GB f32 — fits
+    # one v5e's HBM), so instead of extrapolating from the p=512 run, time
+    # that exact per-chip slice directly on TPU.  The only unmeasured cost
+    # on a real pod is the per-iteration psum of the p x p Gramian (4 MB
+    # f32 over ICI, ~0.1 ms) — add a 10% margin for it.
+    if on_tpu:
+        n_h8, p_h = 1_310_720, 1000
+
+        def make_wide(nn, pp):
+            @jax.jit
+            def gen(key):
+                kx, kb, ku = jax.random.split(key, 3)
+                Xw = jax.random.normal(kx, (nn, pp), jnp.float32).at[:, 0].set(1.0)
+                bt = jax.random.normal(kb, (pp,), jnp.float32) / (2.0 * pp ** 0.5)
+                yw = (jax.random.uniform(ku, (nn,))
+                      < jax.nn.sigmoid(Xw @ bt)).astype(jnp.float32)
+                return (Xw, yw, jnp.ones((nn,), jnp.float32),
+                        jnp.zeros((nn,), jnp.float32))
+            return gen(jax.random.PRNGKey(11))
+
+        t_h, _, out_h = time_irls(make_wide(n_h8, p_h))
+        it_h = max(1, int(out_h["iters"]))
+        est_headline = t_h * 1.10  # +10% collective/overlap margin
+        detail["headline_share_10Mx1000"] = dict(
+            n=n_h8, p=p_h, seconds=round(t_h, 4), iters=it_h,
+            s_per_iter=round(t_h / it_h, 5),
+            mfu_vs_bf16_peak=round(
+                2.0 * n_h8 * p_h * (p_h + 2) * it_h / t_h / V5E_PEAK_BF16, 4),
+            est_10Mx1000_8chip_s=round(est_headline, 3),
+            note="measured per-chip slice of the v5e-8 headline config; "
+                 "est adds 10% for the per-iteration 4 MB Gramian psum")
+    else:
+        # CPU fallback: crude n*p^2 scaling of the per-chip share from the
+        # small run (meaningless for the perf axis, but keeps the JSON shape)
+        est_headline = t * (10_000_000 / 8 / n) * (1000 / p) ** 2
     vs_baseline = 60.0 / est_headline if est_headline > 0 else 0.0
-    detail["extrapolation"] = dict(
-        a_fixed_s=round(a_fix, 5), b_row_s=b_row,
-        small_run=dict(n=n_small, s_per_iter=round(t_i_small, 5)),
-        est_headline_10Mx1000_8chip_s=round(est_headline, 2),
-        assumed_iters=iters)
+    detail["est_headline_10Mx1000_8chip_s"] = round(est_headline, 3)
 
     # ---- Pallas fused kernel: parity + fused-vs-einsum fit (TPU only) ------
     if on_tpu:
