@@ -14,7 +14,8 @@ Quick start::
     mu = sg.predict(model, new_data)
 """
 
-from .api import glm, glm_from_csv, lm, lm_from_csv, predict
+from .api import (confint_profile, glm, glm_from_csv, lm,
+                  lm_from_csv, predict)
 from .config import DEFAULT, NumericConfig
 from .data.formula import Formula, parse_formula
 from .data.frame import as_columns, omit_na
@@ -41,7 +42,7 @@ __all__ = [
     "lm_from_csv", "glm_from_csv",
     "lm_fit_streaming", "glm_fit_streaming",
     "LMModel", "GLMModel", "load_model", "save_model",
-    "anova", "drop1", "AnovaTable",
+    "anova", "drop1", "AnovaTable", "confint_profile",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
     "quasi",
     "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",

@@ -332,6 +332,68 @@ def lm_from_csv(formula: str, path: str, *, weights=None,
     return dataclasses.replace(model, formula=str(f), terms=terms)
 
 
+def confint_profile(model, data, *, level: float = 0.95, which=None,
+                    weights=None, offset=None, m=None, na_omit: bool = True,
+                    **kw) -> np.ndarray:
+    """Profile-likelihood intervals for a formula-fitted GLM (R's default
+    ``confint.glm``).  Pass the TRAINING data — the model frame (NA
+    omission, response coding, cbind group sizes, offsets) is rebuilt
+    through the same ``_design`` path :func:`glm` fit with, and a stored
+    by-name fit-time offset is recovered automatically (an array offset
+    must be re-passed, as in :func:`predict`).  ``weights``/``offset``/``m``
+    accept column names or arrays like :func:`glm`; a non-default
+    ``engine=``/``config=`` used at fit time should be re-passed too (the
+    constrained refits run with fit()'s defaults otherwise)."""
+    from .models.profile import confint_profile as _profile
+
+    if model.terms is None:
+        raise ValueError(
+            "model was fit from arrays; call "
+            "sparkglm_tpu.models.profile.confint_profile(model, X, y, ...) "
+            "directly")
+    f, X, y, terms, cols, keep = _design(
+        model.formula, data, na_omit=na_omit, dtype=np.float32,
+        extra_cols=(weights, offset, m))
+    if terms.xnames != tuple(model.xnames):
+        raise ValueError(
+            f"data rebuilds design columns {terms.xnames} but the model has "
+            f"{tuple(model.xnames)} — pass the data the model was fit on")
+
+    def _col_or_array(v, what):
+        if isinstance(v, str):
+            return np.asarray(cols[v], np.float64)
+        return None if v is None else _subset_extra(v, keep, what)
+
+    if f.response2 is not None:
+        if m is not None:
+            raise ValueError("cbind() already defines group sizes")
+        m = y + np.asarray(cols[f.response2], np.float64)
+    else:
+        m = _col_or_array(m, "m")
+
+    if offset is None:
+        # recover the stored fit-time offset exactly like predict()
+        off_col = getattr(model, "offset_col", None)
+        if off_col is not None:
+            names = [off_col] if isinstance(off_col, str) else list(off_col)
+            off = sum(np.asarray(cols[nm], np.float64) for nm in names)
+        elif getattr(model, "has_offset", False):
+            raise ValueError(
+                "model was fit with an array offset; pass offset= to "
+                "confint_profile (or fit with a named offset column)")
+        else:
+            off = None
+    else:
+        off = _col_or_array(offset, "offset")
+        for oc in f.offsets:
+            o = np.asarray(cols[oc], np.float64)
+            off = o if off is None else off + o
+
+    return _profile(model, X, y, level=level, which=which,
+                    weights=_col_or_array(weights, "weights"),
+                    offset=off, m=m, **kw)
+
+
 def predict(model, data, **kwargs) -> np.ndarray:
     """Score new column-data through a formula-fitted model.
 

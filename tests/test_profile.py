@@ -1,0 +1,122 @@
+"""Profile-likelihood confidence intervals (R's default confint.glm —
+the reference has no interval tooling at all)."""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.models.profile import confint_profile
+
+
+def test_profile_gaussian_identity_equals_wald_t(mesh1, rng):
+    """For gaussian/identity the deviance is exactly quadratic in beta, so
+    the profile interval equals the t-quantile Wald interval — a closed-form
+    correctness anchor."""
+    n, p = 400, 3
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    y = X @ [1.0, 0.5, -0.3] + 0.4 * rng.normal(size=n)
+    m = sg.glm_fit(X, y, family="gaussian", link="identity", tol=1e-12,
+                   criterion="absolute", mesh=mesh1)
+    ci = confint_profile(m, X, y, mesh=mesh1)
+    tq = scipy.stats.t.ppf(0.975, m.df_residual)
+    expect = np.stack([m.coefficients - tq * m.std_errors,
+                       m.coefficients + tq * m.std_errors], axis=1)
+    np.testing.assert_allclose(ci, expect, rtol=2e-3)
+
+
+def test_profile_logistic_properties(mesh1, rng):
+    """Logistic profiles: endpoints bracket the estimate, the deviance at
+    each endpoint sits at the chi-square cutoff, and the interval is
+    asymmetric the way the likelihood is."""
+    n, p = 500, 3
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    bt = np.array([0.3, 0.8, -0.5])
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(float)
+    m = sg.glm_fit(X, y, family="binomial", tol=1e-12,
+                   criterion="absolute", mesh=mesh1)
+    ci = confint_profile(m, X, y, mesh=mesh1)
+    assert np.all(ci[:, 0] < m.coefficients) and np.all(
+        m.coefficients < ci[:, 1])
+    # endpoint correctness: refit with beta_1 fixed at the upper bound; the
+    # deviance rise must equal the 95% chi-square cutoff (z*^2)
+    from sparkglm_tpu.models import glm as glm_mod
+    zstar2 = scipy.stats.norm.ppf(0.975) ** 2
+    keep = [0, 2]
+    sub = glm_mod.fit(X[:, keep], y, family="binomial",
+                      offset=X[:, 1] * ci[1, 1], tol=1e-12,
+                      criterion="absolute", has_intercept=False, mesh=mesh1)
+    np.testing.assert_allclose(sub.deviance - m.deviance, zstar2, rtol=0.02)
+    # profile and Wald agree loosely at this n, but not exactly
+    wald = m.confint()
+    assert np.max(np.abs(ci - wald)) < 0.25
+    assert np.max(np.abs(ci - wald)) > 1e-4
+
+
+def test_profile_formula_api_and_which(rng):
+    n = 300
+    x = rng.normal(size=n)
+    grp = rng.choice(["a", "b"], size=n)
+    eta = 0.2 + 0.7 * x + 0.4 * (grp == "b")
+    d = {"x": x, "grp": grp,
+         "y": (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(float)}
+    m = sg.glm("y ~ x + grp", d, family="binomial", tol=1e-10)
+    ci = sg.confint_profile(m, d, which=["x"])
+    assert ci.shape == (3, 2)
+    assert np.isfinite(ci[1]).all()          # x profiled
+    assert np.isnan(ci[0]).all() and np.isnan(ci[2]).all()  # others skipped
+    assert ci[1, 0] < m.coefficients[1] < ci[1, 1]
+
+
+def test_profile_recovers_stored_offset(rng):
+    """A by-name fit-time offset enters every constrained refit (omitting
+    it would profile the wrong likelihood); array offsets are refused like
+    predict()."""
+    n = 400
+    x = rng.normal(size=n)
+    lt = rng.uniform(0.2, 0.8, size=n)
+    d = {"x": x, "lt": lt,
+         "y": rng.poisson(np.exp(0.3 + 0.5 * x + lt)).astype(float)}
+    m = sg.glm("y ~ x + offset(lt)", d, family="poisson", tol=1e-10)
+    ci = sg.confint_profile(m, d, which=["x"])
+    assert ci[1, 0] < m.coefficients[1] < ci[1, 1]
+    # the offset() term and the named offset= spelling recover identically
+    m2 = sg.glm("y ~ x", d, family="poisson", offset="lt", tol=1e-10)
+    ci2 = sg.confint_profile(m2, d, which=["x"])
+    np.testing.assert_allclose(ci2[1], ci[1], rtol=1e-6)
+    # and the offset genuinely matters: a no-offset model's interval differs
+    m0 = sg.glm("y ~ x", d, family="poisson", tol=1e-10)
+    ci0 = sg.confint_profile(m0, d, which=["x"])
+    assert np.max(np.abs(ci0[1] - ci[1])) > 1e-3
+    m_arr = sg.glm("y ~ x", d, family="poisson", offset=lt, tol=1e-10)
+    with pytest.raises(ValueError, match="array offset"):
+        sg.confint_profile(m_arr, d)
+
+
+def test_profile_na_omission_and_error_surfacing(rng):
+    n = 200
+    x = rng.normal(size=n)
+    d = {"x": x.copy(),
+         "y": (rng.random(n) < 1 / (1 + np.exp(-0.5 * x))).astype(float)}
+    d["x"][7] = np.nan
+    m = sg.glm("y ~ x", d, family="binomial", tol=1e-10)
+    ci = sg.confint_profile(m, d, which=["x"])  # NA row dropped, not NaN-X
+    assert np.isfinite(ci[1]).all()
+    # real input errors surface instead of becoming 'flat likelihood' NaNs
+    from sparkglm_tpu.models.profile import confint_profile
+    X = np.c_[np.ones(100), rng.normal(size=100)]
+    y = (rng.random(100) < 0.5).astype(float)
+    mm = sg.glm_fit(X, y, family="binomial")
+    with pytest.raises(ValueError):
+        confint_profile(mm, X, y, weights=np.ones(7))
+
+
+def test_profile_validation(mesh1, rng):
+    n = 100
+    X = rng.normal(size=(n, 2)); X[:, 0] = 1.0
+    y = (rng.random(n) < 0.5).astype(float)
+    m = sg.glm_fit(X, y, family="binomial", mesh=mesh1)
+    with pytest.raises(ValueError, match="level"):
+        confint_profile(m, X, y, level=1.5, mesh=mesh1)
+    with pytest.raises(ValueError, match="columns"):
+        confint_profile(m, X[:, :1], y, mesh=mesh1)
