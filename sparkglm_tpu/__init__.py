@@ -14,7 +14,7 @@ Quick start::
     mu = sg.predict(model, new_data)
 """
 
-from .api import (confint_profile, glm, glm_from_csv, lm,
+from .api import (confint_profile, glm, glm_from_csv, glm_nb, lm,
                   lm_from_csv, predict)
 from .config import DEFAULT, NumericConfig
 from .data.formula import Formula, parse_formula
@@ -22,11 +22,14 @@ from .data.frame import as_columns, omit_na
 from .data.io import (native_available, read_csv, scan_csv_levels,
                       scan_csv_schema)
 from .data.model_matrix import Terms, build_terms, model_matrix, transform
-from .families.families import FAMILIES, Family, get_family, quasi
+from .families.families import (FAMILIES, Family, get_family,
+                                negative_binomial, quasi)
 from .families.links import LINKS, Link, get_link
 from .models.anova import AnovaTable, anova, drop1
 from .models.glm import GLMModel
 from .models.glm import fit as glm_fit
+from .models.negbin import fit_nb as glm_fit_nb
+from .models.negbin import theta_of
 from .models.lm import LMModel
 from .models.lm import fit as lm_fit
 from .models.serialize import load_model, save_model
@@ -44,7 +47,7 @@ __all__ = [
     "LMModel", "GLMModel", "load_model", "save_model",
     "anova", "drop1", "AnovaTable", "confint_profile",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
-    "quasi",
+    "quasi", "negative_binomial", "glm_nb", "glm_fit_nb", "theta_of",
     "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",
     "transform", "as_columns", "omit_na", "read_csv", "scan_csv_schema",
     "scan_csv_levels",

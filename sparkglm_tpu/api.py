@@ -45,6 +45,24 @@ def _used_columns(f, predictors, extra_names) -> list[str]:
         + [c for c in extra_names if isinstance(c, str)]))
 
 
+def _col_or_subset(cols, keep, v, what):
+    """A by-name extra resolves against the post-NA-omit columns; an array
+    gets the keep-mask (it must match the pre-omit length)."""
+    if isinstance(v, str):
+        return np.asarray(cols[v], np.float64)
+    return None if v is None else _subset_extra(v, keep, what)
+
+
+def _assemble_offset(f, cols, keep, offset):
+    """R's offset semantics: formula offset() terms sum with any offset=
+    argument (array or column name)."""
+    off = _col_or_subset(cols, keep, offset, "offset")
+    for oc in f.offsets:
+        o = np.asarray(cols[oc], np.float64)
+        off = o if off is None else np.asarray(off, np.float64) + o
+    return off
+
+
 def _offset_col_value(f, offset):
     """What travels with the model for predict(): the by-name offset
     columns (formula offset() terms + a str offset= argument), or None when
@@ -135,11 +153,6 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
                                          dtype=np.dtype(config.dtype),
                                          extra_cols=(weights, offset, m))
 
-    def _col_or_array(v, what):
-        if isinstance(v, str):
-            return cols[v]  # post-NA-omit columns, so lengths stay aligned
-        return None if v is None else _subset_extra(v, keep, what)
-
     yname = f.response
     if f.response2 is not None:
         # cbind(successes, failures): y is success counts out of
@@ -152,15 +165,12 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
              + np.asarray(cols[f.response2], np.float64))
         yname = f"cbind({f.response}, {f.response2})"
 
-    # offset() formula terms sum with any offset= argument (R semantics)
-    off_arr = _col_or_array(offset, "offset")
-    for oc in f.offsets:
-        o = np.asarray(cols[oc], np.float64)
-        off_arr = o if off_arr is None else np.asarray(off_arr, np.float64) + o
+    off_arr = _assemble_offset(f, cols, keep, offset)
     model = glm_mod.fit(
         X, y, family=family, link=link,
-        weights=_col_or_array(weights, "weights"),
-        offset=off_arr, m=m if f.response2 is not None else _col_or_array(m, "m"),
+        weights=_col_or_subset(cols, keep, weights, "weights"),
+        offset=off_arr,
+        m=m if f.response2 is not None else _col_or_subset(cols, keep, m, "m"),
         tol=tol,
         max_iter=max_iter, criterion=criterion, xnames=terms.xnames,
         yname=yname, has_intercept=f.intercept, mesh=mesh,
@@ -332,9 +342,41 @@ def lm_from_csv(formula: str, path: str, *, weights=None,
     return dataclasses.replace(model, formula=str(f), terms=terms)
 
 
+def glm_nb(formula: str, data, *, link: str = "log", weights=None,
+           offset=None, theta0: float | None = None, tol: float = 1e-8,
+           max_iter: int = 100, criterion: str = "relative",
+           na_omit: bool = True, mesh=None, verbose: bool = False,
+           config: NumericConfig = DEFAULT, **kw):
+    """MASS-style ``glm.nb(formula, data)``: negative binomial regression
+    with the shape ``theta`` estimated by maximum likelihood
+    (models/negbin.py).  Formula surface matches :func:`glm` (interactions,
+    offset() terms, by-name weights); the returned model's family records
+    the fitted theta."""
+    from .models.negbin import fit_nb
+
+    f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
+                                         dtype=np.dtype(config.dtype),
+                                         extra_cols=(weights, offset))
+    if f.response2 is not None:
+        raise ValueError("cbind() responses are binomial; glm_nb models "
+                         "overdispersed counts")
+
+    off_arr = _assemble_offset(f, cols, keep, offset)
+    model = fit_nb(
+        X, y, link=link, weights=_col_or_subset(cols, keep, weights, "weights"),
+        offset=off_arr, theta0=theta0, tol=tol, max_iter=max_iter,
+        criterion=criterion, xnames=terms.xnames, yname=f.response,
+        has_intercept=f.intercept, mesh=mesh, verbose=verbose,
+        config=config, **kw)
+    import dataclasses
+    return dataclasses.replace(
+        model, formula=str(f), terms=terms,
+        offset_col=_offset_col_value(f, offset))
+
+
 def confint_profile(model, data, *, level: float = 0.95, which=None,
                     weights=None, offset=None, m=None, na_omit: bool = True,
-                    **kw) -> np.ndarray:
+                    config: NumericConfig = DEFAULT, **kw) -> np.ndarray:
     """Profile-likelihood intervals for a formula-fitted GLM (R's default
     ``confint.glm``).  Pass the TRAINING data — the model frame (NA
     omission, response coding, cbind group sizes, offsets) is rebuilt
@@ -342,8 +384,8 @@ def confint_profile(model, data, *, level: float = 0.95, which=None,
     by-name fit-time offset is recovered automatically (an array offset
     must be re-passed, as in :func:`predict`).  ``weights``/``offset``/``m``
     accept column names or arrays like :func:`glm`; a non-default
-    ``engine=``/``config=`` used at fit time should be re-passed too (the
-    constrained refits run with fit()'s defaults otherwise)."""
+    ``engine=``/``config=`` used at fit time should be re-passed too so
+    the constrained refits (and the rebuilt design's dtype) match."""
     from .models.profile import confint_profile as _profile
 
     if model.terms is None:
@@ -351,32 +393,33 @@ def confint_profile(model, data, *, level: float = 0.95, which=None,
             "model was fit from arrays; call "
             "sparkglm_tpu.models.profile.confint_profile(model, X, y, ...) "
             "directly")
+    # a stored by-name fit-time offset must join the NA-omit scan exactly
+    # as it did at fit time (its column was in extra_cols then too)
+    stored_off = getattr(model, "offset_col", None) if offset is None else None
+    stored_names = ([] if stored_off is None else
+                    [stored_off] if isinstance(stored_off, str)
+                    else list(stored_off))
     f, X, y, terms, cols, keep = _design(
-        model.formula, data, na_omit=na_omit, dtype=np.float32,
-        extra_cols=(weights, offset, m))
+        model.formula, data, na_omit=na_omit,
+        dtype=np.dtype(config.dtype),
+        extra_cols=(weights, offset, m, *stored_names))
     if terms.xnames != tuple(model.xnames):
         raise ValueError(
             f"data rebuilds design columns {terms.xnames} but the model has "
             f"{tuple(model.xnames)} — pass the data the model was fit on")
-
-    def _col_or_array(v, what):
-        if isinstance(v, str):
-            return np.asarray(cols[v], np.float64)
-        return None if v is None else _subset_extra(v, keep, what)
 
     if f.response2 is not None:
         if m is not None:
             raise ValueError("cbind() already defines group sizes")
         m = y + np.asarray(cols[f.response2], np.float64)
     else:
-        m = _col_or_array(m, "m")
+        m = _col_or_subset(cols, keep, m, "m")
 
     if offset is None:
         # recover the stored fit-time offset exactly like predict()
-        off_col = getattr(model, "offset_col", None)
-        if off_col is not None:
-            names = [off_col] if isinstance(off_col, str) else list(off_col)
-            off = sum(np.asarray(cols[nm], np.float64) for nm in names)
+        if stored_names:
+            off = sum(np.asarray(cols[nm], np.float64)
+                      for nm in stored_names)
         elif getattr(model, "has_offset", False):
             raise ValueError(
                 "model was fit with an array offset; pass offset= to "
@@ -384,13 +427,11 @@ def confint_profile(model, data, *, level: float = 0.95, which=None,
         else:
             off = None
     else:
-        off = _col_or_array(offset, "offset")
-        for oc in f.offsets:
-            o = np.asarray(cols[oc], np.float64)
-            off = o if off is None else off + o
+        off = _assemble_offset(f, cols, keep, offset)
 
+    kw.setdefault("config", config)
     return _profile(model, X, y, level=level, which=which,
-                    weights=_col_or_array(weights, "weights"),
+                    weights=_col_or_subset(cols, keep, weights, "weights"),
                     offset=off, m=m, **kw)
 
 

@@ -34,6 +34,8 @@ import dataclasses
 from typing import Callable
 
 import jax.numpy as jnp
+import numpy as np
+
 from .links import Link, get_link
 
 _EPS = 1e-10
@@ -184,6 +186,43 @@ quasipoisson = dataclasses.replace(
 quasibinomial = dataclasses.replace(
     binomial, name="quasibinomial", dispersion_fixed=False, aic=_NAN_AIC)
 
+# ----------------------------------------------------------------------------
+# negative binomial with KNOWN theta — MASS::negative.binomial(theta): a
+# proper one-parameter GLM family (variance mu + mu^2/theta); glm_nb
+# (models/negbin.py) wraps it with the ML theta estimation loop
+# ----------------------------------------------------------------------------
+
+def negative_binomial(theta: float) -> Family:
+    """MASS's ``negative.binomial(theta)`` family (fixed shape ``theta``).
+
+    Deviance residuals are MASS's: 2*wt*(y*log(max(y,1)/mu)
+    - (y+theta)*log((y+theta)/(mu+theta))); variance mu + mu^2/theta;
+    default link log; dispersion fixed at 1 (glm.nb reports "dispersion
+    parameter ... taken to be 1"); AIC = -2*logLik + 2*(p+1) — glm.nb
+    counts the estimated theta as a parameter.
+    """
+    th = float(theta)
+    if not np.isfinite(th) or th <= 0:
+        raise ValueError(f"theta must be positive and finite, got {theta!r}")
+
+    def dev(y, mu, wt):
+        mu_c = jnp.maximum(mu, _EPS)
+        return 2.0 * wt * (
+            _ylogyd(y, mu_c)
+            - (y + th) * jnp.log((y + th) / (mu_c + th)))
+
+    return Family(
+        name=f"negative_binomial({th:.10g})",
+        variance=lambda mu: mu + mu * mu / th,
+        dev_resids=dev,
+        # MASS negative.binomial()$initialize: mustart = y + (y == 0)/6
+        init_mu=lambda y, wt: y + (y == 0) / 6.0,
+        default_link="log",
+        dispersion_fixed=True,
+        aic=lambda dev_, ll, n, p, wt_sum: -2.0 * ll + 2.0 * (p + 1.0),
+    )
+
+
 _QUASI_VARIANCE_BASE = {
     "constant": lambda: gaussian,
     "mu": lambda: poisson,
@@ -236,6 +275,8 @@ def get_family(family: str | Family) -> Family:
         return quasi()
     if name.startswith("quasi(") and name.endswith(")"):
         return quasi(name[len("quasi("):-1])
+    if name.startswith("negative_binomial(") and name.endswith(")"):
+        return negative_binomial(float(name[len("negative_binomial("):-1]))
     try:
         return FAMILIES[name]
     except KeyError:

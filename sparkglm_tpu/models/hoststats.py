@@ -127,7 +127,17 @@ def _base(family: str) -> str:
     return family
 
 
+def _nb_theta(family: str) -> float | None:
+    """The fixed shape of a negative_binomial(theta) family name, else None."""
+    if family.startswith("negative_binomial(") and family.endswith(")"):
+        return float(family[len("negative_binomial("):-1])
+    return None
+
+
 def variance(family: str, mu: np.ndarray) -> np.ndarray:
+    th = _nb_theta(family)
+    if th is not None:
+        return mu + mu * mu / th
     f = _base(family)
     if f == "gaussian":
         return np.ones_like(mu)
@@ -148,6 +158,12 @@ def dev_resids(family: str, y, mu, wt) -> np.ndarray:
     y = np.asarray(y, np.float64)
     mu = np.asarray(mu, np.float64)
     wt = np.asarray(wt, np.float64)
+    th = _nb_theta(family)
+    if th is not None:
+        # MASS negative.binomial(theta)$dev.resids
+        with np.errstate(divide="ignore", invalid="ignore"):
+            d = sp.xlogy(y, y / mu) - (y + th) * np.log((y + th) / (mu + th))
+        return 2.0 * wt * np.nan_to_num(d, nan=0.0, posinf=0.0, neginf=0.0)
     if f == "gaussian":
         return wt * (y - mu) ** 2
     if f == "binomial":
@@ -186,6 +202,13 @@ def ll_chunk_stat(family: str, y, mu, wt) -> float:
     mu = np.asarray(mu, np.float64)
     wt = np.asarray(wt, np.float64)
     valid = wt > 0
+    th = _nb_theta(family)
+    if th is not None:
+        # exact NB log-pmf sum (MASS's logLik for glm.nb fits)
+        return _mask_sum(
+            wt * (sp.gammaln(th + y) - sp.gammaln(th) - sp.gammaln(y + 1.0)
+                  + th * np.log(th) + sp.xlogy(y, mu)
+                  - (th + y) * np.log(th + mu)), valid)
     if f == "gaussian":
         return _mask_sum(np.log(np.maximum(wt, _TINY)), valid)
     if f == "binomial":
@@ -214,6 +237,8 @@ def ll_finalize(family: str, stat: float, dev: float, wt_sum: float,
     likelihood the model does not define."""
     if family.startswith("quasi"):
         return float("nan")
+    if _nb_theta(family) is not None:
+        return float(stat)  # the NB chunk stat is the exact log-pmf sum
     f = _base(family)
     if f in ("binomial", "poisson"):
         return float(stat)

@@ -72,13 +72,16 @@ def confint_profile(model, X, y, *, level: float = 0.95, which=None,
     base_off = (np.zeros(X.shape[0], np.float64) if offset is None
                 else np.asarray(offset, np.float64))
 
+    fit_kw.setdefault("singular", "error")
+
     def constrained_dev(j: int, val: float) -> float:
-        keep = [k for k in range(p) if k != j]
+        # aliased (dropped) columns stay out of the refit, as at fit time —
+        # keeping them would make every constrained Gramian singular
+        keep = [k for k in range(p) if k != j and not aliased[k]]
         sub = glm_mod.fit(
             X[:, keep], y, family=model.family, link=model.link,
             weights=weights, offset=base_off + X[:, j] * val, m=m,
-            tol=model.tol, has_intercept=False, mesh=mesh,
-            singular="error", **fit_kw)
+            tol=model.tol, has_intercept=False, mesh=mesh, **fit_kw)
         return float(sub.deviance)
 
     out = np.full((p, 2), np.nan)

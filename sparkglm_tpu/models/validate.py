@@ -47,7 +47,8 @@ def check_response_domain(family: str, y: np.ndarray) -> None:
         raise ValueError(
             "positive values only are allowed for the 'inverse.gaussian' "
             "family")
-    if family in ("poisson", "quasipoisson") and np.any(y < 0):
+    if (family in ("poisson", "quasipoisson")
+            or family.startswith("negative_binomial(")) and np.any(y < 0):
         raise ValueError(
             f"negative values not allowed for the {family!r} family")
     if family in ("binomial", "quasibinomial") and (np.any(y < 0)

@@ -111,6 +111,43 @@ def test_profile_na_omission_and_error_surfacing(rng):
         confint_profile(mm, X, y, weights=np.ones(7))
 
 
+def test_profile_aliased_model(mesh1, rng):
+    """Aliased (dropped) columns stay out of the constrained refits; their
+    own rows are NaN like R's confint on aliased fits."""
+    n = 300
+    x = rng.normal(size=n)
+    X = np.c_[np.ones(n), x, x]  # duplicated column -> aliased
+    y = (rng.random(n) < 1 / (1 + np.exp(-0.5 * x))).astype(float)
+    m = sg.glm_fit(X, y, family="binomial", singular="drop", mesh=mesh1)
+    assert m.aliased[2]
+    ci = confint_profile(m, X, y, mesh=mesh1)
+    assert np.isfinite(ci[1]).all()       # the kept copy profiles fine
+    assert np.isnan(ci[2]).all()          # the aliased one is NaN
+
+
+def test_profile_offset_col_na_scan(rng):
+    """A NaN in the stored offset column must drop its row exactly as the
+    fit did — not crash every constrained refit."""
+    n = 200
+    x = rng.normal(size=n)
+    lt = rng.uniform(0.2, 0.8, size=n)
+    lt[7] = np.nan
+    d = {"x": x, "lt": lt,
+         "y": rng.poisson(np.exp(0.2 + 0.4 * x
+                                 + np.nan_to_num(lt))).astype(float)}
+    m = sg.glm("y ~ x", d, family="poisson", offset="lt", tol=1e-10)
+    assert m.n_obs == n - 1
+    ci = sg.confint_profile(m, d, which=["x"])
+    assert np.isfinite(ci[1]).all()
+
+
+def test_theta_ml_nonfinite_mu_raises():
+    from sparkglm_tpu.models.negbin import _theta_ml
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        _theta_ml(np.array([1.0, 2.0, 3.0]),
+                  np.array([1.0, np.inf, 2.0]), np.ones(3), 1.0)
+
+
 def test_profile_validation(mesh1, rng):
     n = 100
     X = rng.normal(size=(n, 2)); X[:, 0] = 1.0
