@@ -26,6 +26,7 @@ from .families.families import (FAMILIES, Family, get_family,
                                 negative_binomial, quasi)
 from .families.links import LINKS, Link, get_link
 from .models.anova import AnovaTable, anova, drop1
+from .models.diagnostics import cooks_distance, hatvalues, rstandard
 from .models.glm import GLMModel
 from .models.glm import fit as glm_fit
 from .models.negbin import fit_nb as glm_fit_nb
@@ -46,6 +47,7 @@ __all__ = [
     "lm_fit_streaming", "glm_fit_streaming",
     "LMModel", "GLMModel", "load_model", "save_model",
     "anova", "drop1", "AnovaTable", "confint_profile",
+    "hatvalues", "rstandard", "cooks_distance",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
     "quasi", "negative_binomial", "glm_nb", "glm_fit_nb", "theta_of",
     "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",

@@ -223,6 +223,16 @@ def negative_binomial(theta: float) -> Family:
     )
 
 
+def nb_theta(name: str) -> float | None:
+    """The fixed shape of a ``negative_binomial(<theta>)`` family name, else
+    None — the single parser for the name format ``negative_binomial``
+    emits (get_family, models/hoststats.py and models/negbin.py all route
+    through here)."""
+    if name.startswith("negative_binomial(") and name.endswith(")"):
+        return float(name[len("negative_binomial("):-1])
+    return None
+
+
 _QUASI_VARIANCE_BASE = {
     "constant": lambda: gaussian,
     "mu": lambda: poisson,
@@ -275,8 +285,9 @@ def get_family(family: str | Family) -> Family:
         return quasi()
     if name.startswith("quasi(") and name.endswith(")"):
         return quasi(name[len("quasi("):-1])
-    if name.startswith("negative_binomial(") and name.endswith(")"):
-        return negative_binomial(float(name[len("negative_binomial("):-1]))
+    th = nb_theta(name)
+    if th is not None:
+        return negative_binomial(th)
     try:
         return FAMILIES[name]
     except KeyError:

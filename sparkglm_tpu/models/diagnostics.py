@@ -1,0 +1,116 @@
+"""Regression influence diagnostics — R's ``hatvalues`` / ``rstandard`` /
+``cooks.distance`` for LM and GLM fits.
+
+All three derive from the hat (projection) diagonal of the final weighted
+least-squares problem,
+
+    h_i = w_i * x_i' (X'WX)^-1 x_i,
+
+with ``w`` the converged IRLS working weights for a GLM (prior weights /
+(V(mu) g'(mu)^2), exactly what the last Fisher step used) or the prior
+weights for an LM.  The p x p unscaled covariance is already in the model
+(``cov_unscaled``); the per-row quadratic form is one O(n p^2) einsum, so
+no n x n matrix is ever formed — same device-friendly shape as prediction
+SEs (models/lm.py::_row_quadform).
+
+Formulas follow R:
+  * rstandard.lm  = e_i sqrt(w_i) / (sigma sqrt(1 - h_i))
+  * rstandard.glm = deviance resid / sqrt(dispersion (1 - h_i))
+  * cooks.distance.lm  = rstandard_i^2 h_i / ((1 - h_i) p)
+  * cooks.distance.glm = (pearson_i / (1 - h_i))^2 h_i / (dispersion p)
+with p the model rank (aliased columns excluded).
+
+Models do not retain training data — pass the fit-time design/response
+(and weights/offset/m) like :meth:`GLMModel.residuals`; formula-fitted
+models also accept column data, transformed through the stored ``Terms``.
+
+The reference has no diagnostics at all (summary printer only,
+GLM.scala:998-1025)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import hoststats
+
+
+def _design_of(model, data):
+    """An (n, p) ndarray passes through; column data transforms through the
+    model's stored Terms (formula fits)."""
+    if isinstance(data, np.ndarray) and data.ndim == 2:
+        return data
+    if getattr(model, "terms", None) is None:
+        raise ValueError(
+            "model was fit from arrays; pass the (n, p) design matrix")
+    from ..data.frame import as_columns
+    from ..data.model_matrix import transform
+    return transform(as_columns(data), model.terms, dtype=np.float64)
+
+
+def _rank(model) -> int:
+    aliased = getattr(model, "aliased", None)
+    if aliased is None:
+        return int(model.n_params)
+    return int(model.n_params - np.sum(aliased))
+
+
+def _working_weights(model, X, wt, m, offset):
+    """The converged IRLS working weights (prior weights for an LM): what
+    the final Fisher step weighted each row by."""
+    n = X.shape[0]
+    wt = np.ones(n) if wt is None else np.asarray(wt, np.float64).reshape(n)
+    if m is not None:
+        wt = wt * np.asarray(m, np.float64).reshape(n)
+    if not hasattr(model, "family"):  # LM: identity link, unit variance
+        return wt
+    off = (np.zeros(n) if offset is None
+           else np.asarray(offset, np.float64).reshape(n))
+    eta = X @ np.nan_to_num(np.asarray(model.coefficients, np.float64)) + off
+    mu = hoststats.link_inverse(model.link, eta)
+    g = hoststats.link_deriv(model.link, mu)
+    v = hoststats.variance(model.family, mu)
+    return wt / np.maximum(v * g * g, 1e-300)
+
+
+def hatvalues(model, data, *, weights=None, offset=None, m=None) -> np.ndarray:
+    """Leverage h_i of each observation (R ``hatvalues``)."""
+    from .lm import _row_quadform
+
+    X = np.asarray(_design_of(model, data), np.float64)
+    if model.cov_unscaled is None:
+        raise ValueError("model was fit without the unscaled covariance "
+                         "(streaming fits keep only its diagonal)")
+    w = _working_weights(model, X, weights, m, offset)
+    # _row_quadform returns sqrt(x_i' V x_i) (the SE helper) — square it
+    q = np.asarray(_row_quadform(X, model.cov_unscaled), np.float64) ** 2
+    return np.clip(w * q, 0.0, 1.0)
+
+
+def rstandard(model, data, y, *, weights=None, offset=None, m=None) -> np.ndarray:
+    """Standardized residuals (R ``rstandard``: deviance-based for GLMs)."""
+    X = _design_of(model, data)
+    h = hatvalues(model, X, weights=weights, offset=offset, m=m)
+    denom = np.sqrt(np.maximum(1.0 - h, 1e-12))
+    if hasattr(model, "family"):
+        d = model.residuals(X, y, type="deviance", offset=offset,
+                            weights=weights, m=m)
+        return d / (np.sqrt(model.dispersion) * denom)
+    resid = np.asarray(model.residuals(X, y), np.float64)
+    n = X.shape[0]
+    w = np.ones(n) if weights is None else np.asarray(weights, np.float64)
+    return resid * np.sqrt(w) / (model.sigma * denom)
+
+
+def cooks_distance(model, data, y, *, weights=None, offset=None,
+                   m=None) -> np.ndarray:
+    """Cook's distance (R ``cooks.distance``)."""
+    X = _design_of(model, data)
+    h = hatvalues(model, X, weights=weights, offset=offset, m=m)
+    p = max(_rank(model), 1)
+    om = np.maximum(1.0 - h, 1e-12)
+    if hasattr(model, "family"):
+        pe = model.residuals(X, y, type="pearson", offset=offset,
+                             weights=weights, m=m)
+        return (pe / om) ** 2 * h / (model.dispersion * p)
+    rs = rstandard(model, X, y, weights=weights)
+    return rs * rs * h / (om * p)

@@ -128,10 +128,8 @@ def _base(family: str) -> str:
 
 
 def _nb_theta(family: str) -> float | None:
-    """The fixed shape of a negative_binomial(theta) family name, else None."""
-    if family.startswith("negative_binomial(") and family.endswith(")"):
-        return float(family[len("negative_binomial("):-1])
-    return None
+    from ..families.families import nb_theta
+    return nb_theta(family)
 
 
 def variance(family: str, mu: np.ndarray) -> np.ndarray:

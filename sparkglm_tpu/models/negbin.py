@@ -91,15 +91,19 @@ def fit_nb(X, y, *, link: str = "log", weights=None, offset=None,
               yname=yname, has_intercept=has_intercept, mesh=mesh,
               verbose=verbose, config=config, **fit_kw)
 
+    if theta0 is not None and (not np.isfinite(theta0) or theta0 <= 0):
+        raise ValueError(
+            f"theta0 must be positive and finite, got {theta0!r}")
     if theta0 is None:
-        # MASS's start: poisson fit, then theta = n / sum((y/mu - 1)^2)
+        # MASS's start: poisson fit, then theta = n / sum((y/mu - 1)^2);
+        # the clamp only guards this derived start, never a user value
         m0 = glm_mod.fit(X, y, family="poisson", **kw)
         mu = _mu_of(m0, X, off64)
         resid2 = float(np.sum(wt64 * (y64 / np.maximum(mu, 1e-10) - 1.0) ** 2))
         theta = float(np.sum(wt64 > 0)) / max(resid2, 1e-10)
+        theta = min(max(theta, 1e-3), 1e7)
     else:
         theta = float(theta0)
-    theta = min(max(theta, 1e-3), 1e7)
 
     model = None
     for it in range(max_theta_iter):
@@ -128,7 +132,8 @@ def _mu_of(model, X, off64) -> np.ndarray:
 
 def theta_of(model) -> float:
     """The fitted shape recorded in a glm.nb model's family name."""
-    th = hoststats._nb_theta(model.family)
+    from ..families.families import nb_theta
+    th = nb_theta(model.family)
     if th is None:
         raise ValueError(f"not a negative-binomial fit: {model.family!r}")
     return th

@@ -1,0 +1,84 @@
+"""Influence diagnostics (hatvalues / rstandard / cooks.distance) — R
+semantics, validated against the dense hat-matrix computed directly."""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+
+
+def _dense_hat(X, w):
+    """diag of W^(1/2) X (X'WX)^-1 X' W^(1/2) — the O(n^2) way."""
+    XtWX = X.T @ (w[:, None] * X)
+    A = np.linalg.solve(XtWX, X.T)
+    return w * np.einsum("ij,ji->i", X, A)
+
+
+def test_lm_hat_and_cooks(mesh1, rng):
+    n, p = 200, 4
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    w = rng.uniform(0.5, 2.0, size=n)
+    y = X @ [1.0, 0.5, -0.2, 0.3] + 0.3 * rng.normal(size=n)
+    m = sg.lm_fit(X, y, weights=w, mesh=mesh1)
+    h = sg.hatvalues(m, X, weights=w)
+    np.testing.assert_allclose(h, _dense_hat(X, w), rtol=1e-5, atol=1e-8)
+    np.testing.assert_allclose(h.sum(), p, rtol=1e-5)  # trace(H) = rank
+    rs = sg.rstandard(m, X, y, weights=w)
+    resid = y - X @ m.coefficients
+    np.testing.assert_allclose(
+        rs, resid * np.sqrt(w) / (m.sigma * np.sqrt(1 - h)), rtol=1e-6)
+    cd = sg.cooks_distance(m, X, y, weights=w)
+    np.testing.assert_allclose(cd, rs ** 2 * h / ((1 - h) * p), rtol=1e-6)
+
+
+def test_glm_hat_matches_irls_weights(mesh1, rng):
+    n, p = 300, 3
+    X = rng.normal(size=(n, p)); X[:, 0] = 1.0
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ [0.2, 0.6, -0.4])))
+         ).astype(float)
+    m = sg.glm_fit(X, y, family="binomial", tol=1e-12,
+                   criterion="absolute", mesh=mesh1)
+    mu = 1 / (1 + np.exp(-(X @ m.coefficients)))
+    w_irls = mu * (1 - mu)  # binomial/logit working weights
+    h = sg.hatvalues(m, X)
+    np.testing.assert_allclose(h, _dense_hat(X, w_irls), rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(h.sum(), p, rtol=1e-4)
+    # rstandard = deviance resid / sqrt(disp * (1 - h))
+    d = m.residuals(X, y, type="deviance")
+    np.testing.assert_allclose(sg.rstandard(m, X, y),
+                               d / np.sqrt(1 - h), rtol=1e-6)
+    # cooks from pearson residuals
+    pe = m.residuals(X, y, type="pearson")
+    np.testing.assert_allclose(sg.cooks_distance(m, X, y),
+                               (pe / (1 - h)) ** 2 * h / p, rtol=1e-6)
+
+
+def test_outlier_has_large_cooks(mesh1, rng):
+    n = 150
+    x = rng.normal(size=n)
+    y = 1.0 + 2.0 * x + 0.1 * rng.normal(size=n)
+    x[0], y[0] = 4.0, -10.0  # high-leverage outlier
+    X = np.c_[np.ones(n), x]
+    m = sg.lm_fit(X, y, mesh=mesh1)
+    cd = sg.cooks_distance(m, X, y)
+    assert cd[0] == cd.max() and cd[0] > 20 * np.median(cd)
+
+
+def test_diagnostics_formula_data_and_aliased(rng):
+    n = 120
+    x = rng.normal(size=n)
+    grp = rng.choice(["a", "b"], size=n)
+    d = {"x": x, "grp": grp,
+         "y": (rng.random(n) < 1 / (1 + np.exp(-0.5 * x))).astype(float)}
+    m = sg.glm("y ~ x + grp", d, family="binomial")
+    h = sg.hatvalues(m, d)  # column data through the stored Terms
+    assert h.shape == (n,) and np.all((h >= 0) & (h <= 1))
+    np.testing.assert_allclose(h.sum(), 3, rtol=1e-3)
+    # aliased fits: rank excludes dropped columns
+    X = np.c_[np.ones(n), x, x]
+    y = d["y"]
+    ma = sg.glm_fit(X, y, family="binomial", singular="drop")
+    ha = sg.hatvalues(ma, X)
+    np.testing.assert_allclose(ha.sum(), 2, rtol=1e-3)  # rank 2, not 3
+    cd = sg.cooks_distance(ma, X, y)
+    assert np.all(np.isfinite(cd))
