@@ -390,10 +390,21 @@ class GLMModel:
         with np.errstate(divide="ignore", invalid="ignore"):
             return self.coefficients / self.std_errors
 
+    def dispersion_estimated(self) -> bool:
+        """R's summary.glm rule: families with estimated dispersion
+        (gaussian, Gamma, inverse-gaussian, quasi*) get t-tests on
+        df_residual; fixed-dispersion families get z-tests."""
+        from ..families.families import get_family
+        return not get_family(self.family).dispersion_fixed
+
     def p_values(self) -> np.ndarray:
-        # ref: z-tests via Gaussian, GLM.scala:1002-1008
+        # R semantics (summary.glm); the reference used Gaussian z-tests
+        # unconditionally (GLM.scala:1002-1008)
         from scipy import stats
-        return 2.0 * stats.norm.sf(np.abs(self.z_values()))
+        z = np.abs(self.z_values())
+        if self.dispersion_estimated():
+            return 2.0 * stats.t.sf(z, max(self.df_residual, 1))
+        return 2.0 * stats.norm.sf(z)
 
     def vcov(self) -> np.ndarray:
         """dispersion * (X'WX)^-1 — R's vcov(glm)."""
@@ -403,8 +414,11 @@ class GLMModel:
         return self.dispersion * self.cov_unscaled
 
     def confint(self, level: float = 0.95) -> np.ndarray:
-        """(p, 2) Wald normal-quantile intervals (the summary's z-tests,
-        GLM.scala:1002-1008, turned into intervals)."""
+        """(p, 2) Wald intervals with NORMAL quantiles — R's
+        ``confint.default`` uses qnorm for GLMs regardless of family, so
+        for estimated-dispersion families these are deliberately narrower
+        than the summary's t-tests; R's actual ``confint.glm`` default is
+        the profile likelihood (models/profile.py::confint_profile)."""
         from scipy import stats
         half = stats.norm.ppf(0.5 + level / 2.0) * self.std_errors
         return np.stack([self.coefficients - half,

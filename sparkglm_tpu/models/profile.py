@@ -30,7 +30,7 @@ import scipy.stats
 
 def _cutoff(model, level: float) -> float:
     q = 0.5 + level / 2.0
-    if model.dispersion == 1.0:  # fixed-dispersion family
+    if not model.dispersion_estimated():  # fixed-dispersion family
         return float(scipy.stats.norm.ppf(q))
     return float(scipy.stats.t.ppf(q, max(model.df_residual, 1)))
 

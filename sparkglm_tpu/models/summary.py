@@ -94,11 +94,14 @@ class GLMSummary:
 
     def coefficients(self) -> dict[str, np.ndarray]:
         m = self.model
+        # R's summary.glm: t-tests when the dispersion is estimated
+        # (gaussian/Gamma/inverse-gaussian/quasi), z-tests otherwise
+        stat = "t" if m.dispersion_estimated() else "z"
         return {
             "Estimate": m.coefficients,
             "Std. Error": m.std_errors,
-            "z value": m.z_values(),
-            "Pr(>|z|)": m.p_values(),
+            f"{stat} value": m.z_values(),
+            f"Pr(>|{stat}|)": m.p_values(),
         }
 
     def as_dict(self) -> dict:
@@ -124,10 +127,13 @@ class GLMSummary:
 
     def __str__(self) -> str:  # println block, GLM.scala:1009-1024
         m = self.model
-        tbl = coef_table(m.xnames, self.coefficients(), stars_from="Pr(>|z|)")
+        stat = "t" if m.dispersion_estimated() else "z"
+        tbl = coef_table(m.xnames, self.coefficients(),
+                         stars_from=f"Pr(>|{stat}|)")
         disp = (f"(Dispersion parameter for {m.family} family taken to be "
                 f"{sig_digits(m.dispersion)})")
         call = m.formula or (m.yname + " ~ " + " + ".join(m.xnames))
+        aic = "NA" if np.isnan(m.aic) else sig_digits(m.aic)  # R prints NA
         return (
             f"Call:\n{call}\n"
             f"Family: {m.family}  Link: {m.link}\n\n"
@@ -135,7 +141,7 @@ class GLMSummary:
             f"{disp}\n\n"
             f"    Null deviance: {sig_digits(m.null_deviance)}  on {m.df_null}  degrees of freedom\n"
             f"Residual deviance: {sig_digits(m.deviance)}  on {m.df_residual}  degrees of freedom\n"
-            f"AIC: {sig_digits(m.aic)}\n\n"
+            f"AIC: {aic}\n\n"
             f"Number of Fisher Scoring iterations: {m.iterations}\n"
         )
 

@@ -45,6 +45,27 @@ def test_glm_summary_blocks(mesh1):
         assert needle in text, needle
 
 
+def test_glm_summary_t_tests_for_estimated_dispersion(mesh1, rng):
+    """R's summary.glm: t value / Pr(>|t|) with df_residual for families
+    with estimated dispersion (gamma, quasi*), z for fixed (poisson);
+    quasi AIC prints NA, not nan."""
+    import scipy.stats
+    n = 150
+    X = rng.normal(size=(n, 3)); X[:, 0] = 1.0
+    yg = rng.gamma(3.0, np.exp(X @ [0.4, 0.3, -0.2]) / 3.0)
+    mg = sg.glm_fit(X, yg, family="gamma", link="log", mesh=mesh1)
+    sg_text = str(mg.summary())
+    assert "t value" in sg_text and "Pr(>|t|)" in sg_text
+    expect = 2 * scipy.stats.t.sf(np.abs(mg.z_values()), mg.df_residual)
+    np.testing.assert_allclose(mg.p_values(), expect, rtol=1e-12)
+    yq = rng.poisson(np.exp(X @ [0.4, 0.3, -0.2])).astype(float)
+    mq = sg.glm_fit(X, yq, family="quasipoisson", mesh=mesh1)
+    text = str(mq.summary())
+    assert "t value" in text and "AIC: NA" in text and "nan" not in text
+    mp = sg.glm_fit(X, yq, family="poisson", mesh=mesh1)
+    assert "z value" in str(mp.summary())
+
+
 def test_save_load_roundtrip_lm(tmp_path, mesh1):
     m = _lm(mesh1)
     path = str(tmp_path / "model.npz")
