@@ -342,6 +342,10 @@ class GLMModel:
     # silently without one (response predictions would be off by the full
     # exposure factor)
     has_offset: bool = False
+    # the family's dispersion semantics, recorded at fit time so summaries
+    # work for user-constructed Family objects whose names the registry
+    # cannot re-parse (None on models saved before this field existed)
+    dispersion_fixed: bool | None = None
     # the offset's column name when it was given by name to the formula
     # front-end; api.predict re-extracts it from new data (R's predict.glm
     # uses the stored model-frame offset)
@@ -394,8 +398,13 @@ class GLMModel:
         """R's summary.glm rule: families with estimated dispersion
         (gaussian, Gamma, inverse-gaussian, quasi*) get t-tests on
         df_residual; fixed-dispersion families get z-tests."""
+        if self.dispersion_fixed is not None:  # recorded at fit time
+            return not self.dispersion_fixed
         from ..families.families import get_family
-        return not get_family(self.family).dispersion_fixed
+        try:  # models saved before the flag existed
+            return not get_family(self.family).dispersion_fixed
+        except ValueError:  # unregistered custom Family name
+            return self.dispersion != 1.0
 
     def p_values(self) -> np.ndarray:
         # R semantics (summary.glm); the reference used Gaussian z-tests
@@ -497,7 +506,8 @@ def _finalize_model(
         df_null=n_ok - (1 if has_intercept else 0), iterations=iters,
         converged=bool(converged), n_obs=n_obs, n_params=p,
         n_shards=n_shards, tol=tol, has_intercept=bool(has_intercept),
-        cov_unscaled=cov_inv, has_offset=bool(has_offset))
+        cov_unscaled=cov_inv, has_offset=bool(has_offset),
+        dispersion_fixed=bool(fam.dispersion_fixed))
 
 
 def _fit_global(

@@ -623,5 +623,6 @@ def glm_fit_streaming(
         dispersion=float(dispersion), df_residual=df_resid,
         df_null=stats["n"] - (1 if has_intercept else 0), iterations=iters,
         converged=bool(converged), n_obs=n, n_params=p,
+        dispersion_fixed=bool(fam.dispersion_fixed),
         n_shards=mesh.shape[meshlib.DATA_AXIS], tol=tol,
         has_intercept=bool(has_intercept), has_offset=bool(saw_offset))

@@ -127,9 +127,10 @@ class GLMSummary:
 
     def __str__(self) -> str:  # println block, GLM.scala:1009-1024
         m = self.model
-        stat = "t" if m.dispersion_estimated() else "z"
-        tbl = coef_table(m.xnames, self.coefficients(),
-                         stars_from=f"Pr(>|{stat}|)")
+        coefs = self.coefficients()
+        # the t/z rule lives in coefficients(); reuse its key
+        stars_from = next(k for k in coefs if k.startswith("Pr("))
+        tbl = coef_table(m.xnames, coefs, stars_from=stars_from)
         disp = (f"(Dispersion parameter for {m.family} family taken to be "
                 f"{sig_digits(m.dispersion)})")
         call = m.formula or (m.yname + " ~ " + " + ".join(m.xnames))
