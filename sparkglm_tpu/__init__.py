@@ -15,7 +15,7 @@ Quick start::
 """
 
 from .api import (confint_profile, glm, glm_from_csv, glm_nb, lm,
-                  lm_from_csv, predict)
+                  lm_from_csv, predict, update)
 from .config import DEFAULT, NumericConfig
 from .data.formula import Formula, parse_formula
 from .data.frame import as_columns, omit_na
@@ -42,7 +42,7 @@ from .utils import profiling
 __version__ = "0.1.0"
 
 __all__ = [
-    "lm", "glm", "predict", "lm_fit", "glm_fit",
+    "lm", "glm", "predict", "update", "lm_fit", "glm_fit",
     "lm_from_csv", "glm_from_csv",
     "lm_fit_streaming", "glm_fit_streaming",
     "LMModel", "GLMModel", "load_model", "save_model",

@@ -342,6 +342,111 @@ def lm_from_csv(formula: str, path: str, *, weights=None,
     return dataclasses.replace(model, formula=str(f), terms=terms)
 
 
+def update(model, formula: str = "~ .", data=None, **overrides):
+    """R's ``update(model, formula, data)``: refit with a modified formula.
+
+    ``.`` stands for the corresponding part of the original formula:
+    ``"~ . + z"`` adds a term, ``"~ . - x"`` removes one, ``"y2 ~ ."``
+    swaps the response, ``"~ . - 1"`` drops the intercept.  The refit
+    reuses the model's family/link/tol (a glm.nb model re-estimates theta
+    through :func:`glm_nb`, as R's update does); pass fit arguments like
+    ``weights=`` through ``overrides`` — models do not retain them.
+    """
+    import re as _re
+
+    from .data.formula import TERM_RE, _expand_term, extract_offset_terms
+    from .models.lm import LMModel
+
+    if getattr(model, "formula", None) is None:
+        raise ValueError("update needs a formula-fitted model")
+    if data is None:
+        raise ValueError(
+            "pass the training data (models do not retain it): "
+            "update(model, '~ . + z', data)")
+    old = parse_formula(model.formula)
+    if not isinstance(model, LMModel):
+        # fail early with a clear message when the refit could not
+        # reconstruct the family from its stored name (user-built Family
+        # objects); registry + quasi(...)/negative_binomial(...) names pass
+        from .families.families import get_family
+        try:
+            get_family(model.family)
+        except ValueError:
+            raise ValueError(
+                f"update cannot reconstruct family {model.family!r} from "
+                "its name; refit explicitly with the Family object") from None
+    old_lhs = model.formula.split("~", 1)[0].strip()
+    lhs, rhs = (formula.split("~", 1) if "~" in formula else ("", formula))
+    lhs = lhs.strip()
+    resp = old_lhs if lhs in ("", ".") else lhs
+
+    rhs, added_offsets = extract_offset_terms(rhs, formula)
+    offsets = list(old.offsets)
+    # a fit-time offset= COLUMN is part of the model being updated — carry
+    # it as an offset() term (an array offset cannot be recovered: refuse
+    # rather than silently refit unoffset, same rule as predict)
+    stored_off = getattr(model, "offset_col", None)
+    if isinstance(stored_off, str):
+        stored_off = (stored_off,)
+    for nm in stored_off or ():
+        if nm not in offsets:
+            offsets.append(nm)
+    if (not stored_off and getattr(model, "has_offset", False)
+            and "offset" not in overrides):
+        raise ValueError(
+            "model was fit with an array offset; pass offset= to update "
+            "(or fit with a named offset column)")
+    offsets.extend(o for o in added_offsets if o not in offsets)
+
+    leftover = _re.sub(rf"([+-]?)\s*({TERM_RE})", "", rhs)
+    if _re.sub(r"[\s+]", "", leftover):
+        raise ValueError(f"unsupported update syntax in {formula!r}")
+
+    terms: list[str] = []
+    removals: list[frozenset] = []
+    intercept = old.intercept
+    for sign, chunk in _re.findall(rf"([+-]?)\s*({TERM_RE})", rhs):
+        if chunk == ".":
+            terms.extend(t for t in old.predictors if t not in terms)
+            continue
+        if _re.fullmatch(r"\d+", chunk):
+            if chunk == "1":
+                intercept = sign != "-"
+            elif chunk == "0":
+                intercept = False
+            else:
+                raise ValueError(f"numeric term {chunk!r} in {formula!r}")
+            continue
+        if sign == "-":
+            if "*" in chunk:
+                raise ValueError(
+                    f"cannot remove a '*' crossing ({chunk!r}); remove the "
+                    "individual terms")
+            removals.append(frozenset(chunk.split(":")))
+            continue
+        for term, _ in _expand_term(sign, chunk, formula):
+            if term not in terms:
+                terms.append(term)
+    terms = [t for t in terms if frozenset(t.split(":")) not in removals]
+    if not terms and not intercept:
+        raise ValueError(f"update {formula!r} removes every term")
+
+    rhs_out = " + ".join(terms + [f"offset({o})" for o in offsets]) or "1"
+    new_formula = f"{resp} ~ {rhs_out}" + ("" if intercept else " - 1")
+
+    if isinstance(model, LMModel):
+        return lm(new_formula, data, **overrides)
+    from .families.families import nb_theta
+    if nb_theta(model.family) is not None:
+        overrides.setdefault("link", model.link)
+        overrides.setdefault("tol", model.tol)
+        return glm_nb(new_formula, data, **overrides)
+    overrides.setdefault("family", model.family)
+    overrides.setdefault("link", model.link)
+    overrides.setdefault("tol", model.tol)
+    return glm(new_formula, data, **overrides)
+
+
 def glm_nb(formula: str, data, *, link: str = "log", weights=None,
            offset=None, theta0: float | None = None, tol: float = 1e-8,
            max_iter: int = 100, criterion: str = "relative",

@@ -29,6 +29,28 @@ import itertools
 import re
 
 _NAME = r"[A-Za-z_.][A-Za-z0-9_.]*"
+# term := name ((':'|'*') name)* — shared with api.update's tokenizer
+TERM_RE = rf"(?:{_NAME}|\d+)(?:\s*[:*]\s*(?:{_NAME}|\d+))*"
+
+
+def extract_offset_terms(rhs: str, formula: str):
+    """Strip offset(col) terms from an RHS, returning (rhs_without, names)
+    — the one implementation parse_formula and api.update share."""
+    import re as _re
+    names: list[str] = []
+
+    def _grab(mo):
+        inner = mo.group(1).strip()
+        if not _re.fullmatch(_NAME, inner):
+            raise ValueError(
+                f"offset() takes a single column name, got {inner!r} "
+                f"({formula!r})")
+        if inner not in names:
+            names.append(inner)
+        return ""
+
+    rhs = _re.sub(r"(?<![A-Za-z0-9_.])offset\s*\(([^)]*)\)", _grab, rhs)
+    return rhs, names
 
 
 @dataclasses.dataclass(frozen=True)
@@ -129,24 +151,12 @@ def parse_formula(formula: str) -> Formula:
 
     # offset(col) terms come out before tokenization (R sums them with any
     # offset= argument); only a plain column name is allowed inside
-    offsets: list[str] = []
+    rhs, offsets = extract_offset_terms(rhs, formula)
 
-    def _grab_offset(mo):
-        inner = mo.group(1).strip()
-        if not re.fullmatch(_NAME, inner):
-            raise ValueError(
-                f"offset() takes a single column name, got {inner!r} "
-                f"({formula!r})")
-        offsets.append(inner)
-        return ""
-
-    rhs = re.sub(r"(?<![A-Za-z0-9_.])offset\s*\(([^)]*)\)", _grab_offset, rhs)
-
-    # term := name ((':'|'*') name)* ; chunks are '+'/'-'-separated.  Reject
-    # anything the grammar doesn't cover ('^', 'I(...)', parentheses)
-    # instead of silently fitting a different model.
-    term_re = rf"(?:{_NAME}|\d+)(?:\s*[:*]\s*(?:{_NAME}|\d+))*"
-    token_re = rf"([+-]?)\s*({term_re})"
+    # chunks are '+'/'-'-separated terms (TERM_RE).  Reject anything the
+    # grammar doesn't cover ('^', 'I(...)', parentheses) instead of
+    # silently fitting a different model.
+    token_re = rf"([+-]?)\s*({TERM_RE})"
     leftover = re.sub(token_re, "", rhs)
     leftover = re.sub(r"[\s+]", "", leftover)
     if leftover:

@@ -120,6 +120,22 @@ def test_glm_from_csv_cbind_and_na(tmp_path, mesh8, rng):
     np.testing.assert_allclose(m.aic, m_mem.aic, rtol=1e-6)
 
 
+def test_glm_from_csv_interactions(csv_data, mesh8):
+    """Interaction terms work through the chunked path: the design recipe
+    (incl. factor levels for the crossed dummies) is pinned once and every
+    chunk transforms identically."""
+    path, data = csv_data
+    kw = dict(family="poisson", tol=1e-8, criterion="relative", mesh=mesh8)
+    m_csv = sg.glm_from_csv("y ~ x * grp", path, chunk_bytes=16 << 10, **kw)
+    m_mem = sg.glm("y ~ x * grp", data, **kw)
+    assert m_csv.xnames == m_mem.xnames == (
+        "intercept", "x", "grp_b", "grp_c", "x:grp_b", "x:grp_c")
+    # both fits stop at the f32 convergence floor; chunked vs resident
+    # accumulation order leaves ~2e-5 relative
+    np.testing.assert_allclose(m_csv.coefficients, m_mem.coefficients,
+                               rtol=1e-4, atol=1e-7)
+
+
 def test_lm_from_csv_matches_in_memory(csv_data, mesh8):
     path, data = csv_data
     m_csv = sg.lm_from_csv("y ~ x + grp", path, weights="w",

@@ -76,8 +76,6 @@ def test_family_link_grid(mesh8, family, link, tmp_path):
         assert np.isfinite(m.loglik) and np.isfinite(m.aic)
 
     # float64 oracle parity (CPU x64: the fit above ran f64 too)
-    import sys
-    sys.path.insert(0, "/root/repo/tests")
     from oracle import irls_np
     beta64 = irls_np(X, y, family.replace("quasi", "")
                      if family.startswith("quasi") else family,
