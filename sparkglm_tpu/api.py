@@ -36,7 +36,8 @@ def _used_columns(f, predictors, extra_names) -> list[str]:
     columns, interaction components, by-name weights/offset/m — for the
     NA-omit scan and missing-column checks (shared by the in-memory and
     from-CSV paths)."""
-    sources = [c for t in predictors for c in t.split(":")]
+    from .data.formula import component_source
+    sources = [component_source(c) for t in predictors for c in t.split(":")]
     return list(dict.fromkeys(
         [f.response]
         + ([f.response2] if f.response2 else [])
@@ -108,6 +109,32 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
     terms = build_terms(cols, predictors, intercept=f.intercept,
                         no_intercept_coding="full_k_first")
     X = transform(cols, terms, dtype=dtype)
+    # R evaluates transforms IN the model frame, so na.action sees their
+    # output: rows where log(x)/I(x^k)/... produced non-finite values are
+    # dropped (with a warning) exactly like raw-NA rows.  The scan runs
+    # ONLY when the design contains transform components — untransformed
+    # formulas keep the loud fit-entry NA/NaN/Inf error for bad raw data
+    from .data.formula import parse_component
+    has_transform = any(parse_component(c)[0] is not None
+                        for comps in terms.design for c in comps)
+    bad = (~np.isfinite(X).all(axis=1) if has_transform
+           else np.zeros(X.shape[0], bool))
+    if bad.any():
+        if not na_omit:
+            raise ValueError(
+                f"{int(bad.sum())} rows have non-finite transformed "
+                "predictors (e.g. log of a non-positive value); enable "
+                "na_omit or clean the column")
+        import warnings
+        warnings.warn(
+            f"{int(bad.sum())} rows dropped: formula transforms produced "
+            "non-finite values (R's na.action runs after model-frame "
+            "evaluation)", stacklevel=3)
+        good = ~bad
+        X = X[good]
+        y = y[good]
+        cols = {k: np.asarray(v)[good] for k, v in cols.items()}
+        keep[np.flatnonzero(keep)[bad]] = False
     return f, X, y, terms, cols, keep
 
 
@@ -229,6 +256,11 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
                 f"levels, got {lv}")
         resp_levels = lv
 
+    from .data.formula import parse_component
+    has_transform = any(parse_component(c)[0] is not None
+                        for comps in terms.design for c in comps)
+    warned_transform: list = []
+
     def extract(i: int):
         cols = csv_io.read_csv(path, shard_index=i, num_shards=num_chunks,
                                schema=schema, native=native)
@@ -254,6 +286,27 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
             y = y / np.maximum(msz, 1e-30)
             w = msz if w is None else w * msz
         X = transform(cols, terms, dtype=dtype)
+        if has_transform:
+            # same model-frame semantics as _design: na_omit drops rows a
+            # transform made non-finite (warned once), else it is an error
+            bad = ~np.isfinite(X).all(axis=1)
+            if bad.any():
+                if not na_omit:
+                    raise ValueError(
+                        f"{int(bad.sum())} rows in chunk {i} have "
+                        "non-finite transformed predictors; enable na_omit "
+                        "or clean the column")
+                if not warned_transform:
+                    import warnings
+                    warnings.warn(
+                        "rows dropped: formula transforms produced "
+                        "non-finite values (R's na.action runs after "
+                        "model-frame evaluation)", stacklevel=2)
+                    warned_transform.append(True)
+                good = ~bad
+                X, y = X[good], y[good]
+                w = None if w is None else w[good]
+                off = None if off is None else off[good]
         return X, y, w, off
 
     return f, terms, num_chunks, extract
@@ -422,7 +475,9 @@ def update(model, formula: str = "~ .", data=None, **overrides):
                 raise ValueError(
                     f"cannot remove a '*' crossing ({chunk!r}); remove the "
                     "individual terms")
-            removals.append(frozenset(chunk.split(":")))
+            from .data.formula import canonical_component
+            removals.append(frozenset(
+                canonical_component(c) for c in chunk.split(":")))
             continue
         for term, _ in _expand_term(sign, chunk, formula):
             if term not in terms:

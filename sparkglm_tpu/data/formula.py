@@ -17,9 +17,16 @@ Extensions over the reference (standard R semantics):
     (R's canonical form; equivalent to ``m=successes+failures`` with
     success counts as ``y``).
   * ``offset(col)`` terms, summed with any ``offset=`` argument like R.
+  * Whitelisted column transforms evaluated in the model frame like R:
+    ``log/log2/log10/sqrt/exp/abs(col)`` and the literal-power form
+    ``I(col^k)`` — usable inside interactions (``log(x):grp``).  As in R
+    (where na.action runs after model-frame evaluation), rows where a
+    transform produces non-finite values are dropped WITH A WARNING under
+    ``na_omit=True``, and error under ``na_omit=False`` (api._design).
 
-Still rejected, loudly: parentheses, ``^``, ``I(...)``, ``-term`` removal,
-and transforms — fitting a silently different model is worse than an error.
+Still rejected, loudly: general expressions, nesting, ``poly()``,
+free-standing parentheses, and ``-term`` removal outside ``update()`` —
+fitting a silently different model is worse than an error.
 """
 
 from __future__ import annotations
@@ -29,8 +36,54 @@ import itertools
 import re
 
 _NAME = r"[A-Za-z_.][A-Za-z0-9_.]*"
-# term := name ((':'|'*') name)* — shared with api.update's tokenizer
-TERM_RE = rf"(?:{_NAME}|\d+)(?:\s*[:*]\s*(?:{_NAME}|\d+))*"
+# a term component: a column, a whitelisted transform of one (log(x),
+# sqrt(x), ...), or R's literal-power form I(x^k)
+_COMPONENT = rf"(?:{_NAME}\s*\(\s*{_NAME}\s*(?:\^\s*\d+)?\s*\)|{_NAME}|\d+)"
+# term := component ((':'|'*') component)* — shared with api.update
+TERM_RE = rf"{_COMPONENT}(?:\s*[:*]\s*{_COMPONENT})*"
+
+TRANSFORMS = ("log", "log2", "log10", "sqrt", "exp", "abs")
+
+
+def parse_component(comp: str) -> tuple[str | None, str, int | None]:
+    """'log(x)' -> ('log', 'x', None); 'I(x^2)' -> ('I', 'x', 2);
+    'x' -> (None, 'x', None).  Validates the transform whitelist."""
+    comp = comp.strip()
+    mo = re.fullmatch(rf"({_NAME})\s*\(\s*({_NAME})\s*(?:\^\s*(\d+))?\s*\)",
+                      comp)
+    if mo is None:
+        return None, comp, None
+    func, src, power = mo.group(1), mo.group(2), mo.group(3)
+    if func == "I":
+        if power is None:
+            raise ValueError(
+                f"I() supports only the power form I(col^k), got {comp!r}")
+        k = int(power)
+        if not 2 <= k <= 9:
+            raise ValueError(f"I(col^k) needs 2 <= k <= 9, got {comp!r}")
+        return "I", src, k
+    if func in TRANSFORMS:
+        if power is not None:
+            raise ValueError(
+                f"{func}() takes a bare column name, got {comp!r}")
+        return func, src, None
+    raise ValueError(
+        f"unsupported transform {func!r} in {comp!r}; available: "
+        f"{', '.join(TRANSFORMS)}, I(col^k)")
+
+
+def canonical_component(comp: str) -> str:
+    func, src, power = parse_component(comp)
+    if func is None:
+        return src
+    if func == "I":
+        return f"I({src}^{power})"
+    return f"{func}({src})"
+
+
+def component_source(comp: str) -> str:
+    """The data column a (possibly transformed) component reads."""
+    return parse_component(comp)[1]
 
 
 def extract_offset_terms(rhs: str, formula: str):
@@ -84,7 +137,7 @@ class Formula:
                         add(c)
             else:
                 for comp in t.split(":"):
-                    if comp not in available:
+                    if component_source(comp) not in available:
                         raise KeyError(
                             f"formula term {comp!r} not found in data "
                             f"columns {available}")
@@ -106,26 +159,34 @@ def _expand_term(sign: str, term: str, formula: str):
         raise ValueError(
             f"term removal '-{term}' is not supported (only -1/0 for the "
             "intercept)")
-    if "*" in term:
-        comps = [c.strip() for c in term.split("*")]
-        if any(":" in c for c in comps):
+    def _canon(c: str) -> str:
+        c = c.strip()
+        if re.fullmatch(r"\d+", c):
+            raise ValueError(
+                f"numeric component in {term!r} ({formula!r})")
+        if not re.fullmatch(_COMPONENT, c):
+            raise ValueError(f"invalid name {c!r} in {formula!r}")
+        try:
+            return canonical_component(c)
+        except ValueError as e:
+            raise ValueError(f"{e} (in {formula!r})") from None
+
+    # operators split outside parentheses only (log(x):z, I(x^2)*z)
+    star_split = re.split(r"\*(?![^(]*\))", term)
+    if len(star_split) > 1:
+        if any(re.search(r":(?![^(]*\))", c) for c in star_split):
             # a:b*c is ambiguous to most readers; R allows it but demand
             # the explicit spelling instead
             raise ValueError(
                 f"mixed '*' and ':' in one term {term!r}: expand the "
                 "crossing explicitly (a*b == a + b + a:b)")
-        bad = [c for c in comps if not re.fullmatch(_NAME, c)]
-        if bad:
-            raise ValueError(f"invalid name {bad[0]!r} in {formula!r}")
+        comps = [_canon(c) for c in star_split]
         expanded = []
         for size in range(1, len(comps) + 1):
             for combo in itertools.combinations(comps, size):
                 expanded.append((":".join(combo), None))
         return expanded
-    comps = [c.strip() for c in term.split(":")]
-    bad = [c for c in comps if not re.fullmatch(_NAME, c)]
-    if bad:
-        raise ValueError(f"invalid name {bad[0]!r} in {formula!r}")
+    comps = [_canon(c) for c in re.split(r":(?![^(]*\))", term)]
     # a:a collapses to a (R drops the duplicate component)
     dedup = list(dict.fromkeys(comps))
     return [(":".join(dedup), None)]
@@ -162,9 +223,9 @@ def parse_formula(formula: str) -> Formula:
     if leftover:
         raise ValueError(
             f"unsupported formula syntax {leftover!r} in {formula!r}: only "
-            "'+'-separated terms, interactions ':'/'*', '.', and 1/-1/0 "
-            "intercept markers are supported (no parentheses, '^' or "
-            "transforms)")
+            "'+'-separated terms, interactions ':'/'*', '.', whitelisted "
+            "transforms (log(x), I(x^2), ...) and 1/-1/0 intercept markers "
+            "are supported")
     tokens = re.findall(token_re, rhs)
     if not tokens and not offsets:
         raise ValueError(f"no terms on the right of '~': {formula!r}")

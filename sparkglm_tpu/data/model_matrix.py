@@ -115,16 +115,25 @@ def build_terms(data, columns=None, *, intercept: bool = False,
     build a design with different columns (use ``io.scan_csv_levels`` for
     the one global pass; ADVICE r1).
     """
+    from .formula import component_source, parse_component
+
     cols = as_columns(data)
     terms_in = list(columns) if columns is not None else list(cols)
     design = tuple(_term_components(t) for t in terms_in)
 
-    # unique source columns in first-use order; level discovery per source
+    # unique source columns in first-use order; level discovery per source.
+    # components may be transforms — "log(x)", "I(x^2)" — whose source is
+    # the inner column (numeric only; R evaluates them in the model frame)
     sources: list[str] = []
     for comps in design:
-        for nm in comps:
+        for comp in comps:
+            func, nm, _ = parse_component(comp)
             if nm not in cols:
                 raise KeyError(f"column {nm!r} not in data ({list(cols)})")
+            if func is not None and is_categorical(cols[nm]):
+                raise ValueError(
+                    f"transform {comp!r} applies to a categorical column; "
+                    "transforms take numeric columns only")
             if nm not in sources:
                 sources.append(nm)
     full_levels: dict[str, tuple] = {}
@@ -193,16 +202,39 @@ def build_terms(data, columns=None, *, intercept: bool = False,
                  xnames=tuple(xnames), design=design)
 
 
-def _coded_block(c: np.ndarray, nm: str, terms: Terms, dtype) -> np.ndarray:
-    """(n, k) coding of one source column: k-1 dummies or the column itself."""
-    if nm in terms.levels:
-        cs = c.astype(str)
-        kept = terms.levels[nm]
-        out = np.empty((c.shape[0], len(kept)), dtype=dtype)
+def _transform_fn(func: str):
+    # derived from the single whitelist in formula.TRANSFORMS — a name
+    # added there resolves here automatically (all are numpy ufuncs)
+    return getattr(np, func)
+
+
+def _component_values(cols, comp: str) -> np.ndarray:
+    """Evaluate one numeric component — the raw column or its transform
+    (R evaluates these in the model frame).  A transform that produces
+    non-finite values (log of a non-positive, say) surfaces later through
+    the fit's non-finite-design check rather than silently dropping rows."""
+    from .formula import parse_component
+    func, nm, power = parse_component(comp)
+    c = np.asarray(cols[nm], np.float64)
+    if func is None:
+        return c
+    if func == "I":
+        return c ** power
+    with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+        return _transform_fn(func)(c)
+
+
+def _coded_block(cols, comp: str, terms: Terms, dtype) -> np.ndarray:
+    """(n, k) coding of one component: k-1 dummies for a factor, else the
+    (possibly transformed) numeric column."""
+    if comp in terms.levels:
+        cs = np.asarray(cols[comp]).astype(str)
+        kept = terms.levels[comp]
+        out = np.empty((cs.shape[0], len(kept)), dtype=dtype)
         for j, lv in enumerate(kept):
             out[:, j] = (cs == lv).astype(dtype)
         return out
-    return np.asarray(c, dtype=dtype).reshape(-1, 1)
+    return _component_values(cols, comp).astype(dtype).reshape(-1, 1)
 
 
 def transform(data, terms: Terms, *, dtype=np.float32) -> np.ndarray:
@@ -227,28 +259,28 @@ def transform(data, terms: Terms, *, dtype=np.float32) -> np.ndarray:
     # design matrix plus the interaction components actually reused
     coded: dict[str, np.ndarray] = {}
 
-    def block_of(nm: str) -> np.ndarray:
-        if nm not in coded:
-            coded[nm] = _coded_block(cols[nm], nm, terms, dtype)
-        return coded[nm]
+    def block_of(comp: str) -> np.ndarray:
+        if comp not in coded:
+            coded[comp] = _coded_block(cols, comp, terms, dtype)
+        return coded[comp]
 
     for comps in terms.design:
         if len(comps) == 1:
             nm = comps[0]
             if nm in terms.levels:
-                cs = cols[nm].astype(str)
+                cs = np.asarray(cols[nm]).astype(str)
                 for lv in terms.levels[nm]:
                     out[:, j] = (cs == lv).astype(dtype)
                     j += 1
             else:
-                out[:, j] = cols[nm].astype(dtype)
+                out[:, j] = _component_values(cols, nm).astype(dtype)
                 j += 1
             continue
         b = block_of(comps[0])
-        for nm in comps[1:]:
+        for comp in comps[1:]:
             # first component varies fastest (R's model.matrix layout):
             # new index = j*K_prev + i
-            cb = block_of(nm)
+            cb = block_of(comp)
             b = (cb[:, :, None] * b[:, None, :]).reshape(n, -1)
         out[:, j:j + b.shape[1]] = b
         j += b.shape[1]

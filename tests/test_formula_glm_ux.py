@@ -26,7 +26,8 @@ def test_parse_cbind_rejections():
         parse_formula("y ~ x + offset(log(t))")
     # identifiers merely ENDING in 'offset' are not offset() calls — the
     # call-like residue must fail loudly, not parse as offset + predictor
-    with pytest.raises(ValueError, match="unsupported formula syntax"):
+    with pytest.raises(ValueError,
+                       match="unsupported (formula syntax|transform)"):
         parse_formula("y ~ x + my_offset(z)")
     f = parse_formula("y ~ my_offset + x")  # plain column named *_offset
     assert f.predictors == ("my_offset", "x") and f.offsets == ()

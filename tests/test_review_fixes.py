@@ -9,9 +9,10 @@ from sparkglm_tpu.data.formula import parse_formula
 
 
 def test_formula_rejects_unsupported_syntax():
-    # interactions ':' / '*' are supported since r2 (tests/test_interactions.py);
-    # '^', bare numerals, parentheses and transforms still fail loudly
-    for bad in ("y ~ x^2", "y ~ x + 2", "y ~ (a + b)", "y ~ log(x)"):
+    # interactions ':'/'*' and whitelisted transforms (log(x), I(x^2)) are
+    # supported since r2; bare '^', numerals, free parentheses and unknown
+    # functions still fail loudly
+    for bad in ("y ~ x^2", "y ~ x + 2", "y ~ (a + b)", "y ~ poly(x)"):
         with pytest.raises(ValueError):
             parse_formula(bad)
 

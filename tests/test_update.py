@@ -102,7 +102,10 @@ def test_update_validation(d):
     with pytest.raises(ValueError, match="training data"):
         sg.update(m, "~ . + z")
     with pytest.raises(ValueError, match="unsupported update syntax"):
-        sg.update(m, "~ . + log(z)", d)
+        sg.update(m, "~ . + (x + z)", d)
+    # transforms are legal in updates since they are legal in formulas
+    m_t = sg.update(m, "~ . + I(x^2)", d)
+    assert "I(x^2)" in m_t.xnames
     mm = sg.glm_fit(np.c_[np.ones(10), np.arange(10.)],
                     np.arange(10.) % 2, family="binomial")
     with pytest.raises(ValueError, match="formula-fitted"):
