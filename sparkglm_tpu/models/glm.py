@@ -422,6 +422,13 @@ class GLMModel:
                              "(streaming fits keep only its diagonal)")
         return self.dispersion * self.cov_unscaled
 
+    def correlation(self) -> np.ndarray:
+        """Correlation matrix of the coefficient estimates — what R's
+        ``summary(fit, correlation=TRUE)`` prints: vcov scaled to unit
+        diagonal.  Aliased rows/columns are NaN."""
+        from .lm import _cov2cor
+        return _cov2cor(self.vcov())
+
     def confint(self, level: float = 0.95) -> np.ndarray:
         """(p, 2) Wald intervals with NORMAL quantiles — R's
         ``confint.default`` uses qnorm for GLMs regardless of family, so

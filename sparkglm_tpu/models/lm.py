@@ -194,6 +194,12 @@ class LMModel:
                              "(streaming fits keep only its diagonal)")
         return self.sigma ** 2 * self.cov_unscaled
 
+    def correlation(self) -> np.ndarray:
+        """Correlation matrix of the coefficient estimates — what R's
+        ``summary(fit, correlation=TRUE)`` prints: vcov scaled to unit
+        diagonal.  Aliased rows/columns are NaN."""
+        return _cov2cor(self.vcov())
+
     def confint(self, level: float = 0.95) -> np.ndarray:
         """(p, 2) t-based confidence intervals — R's confint(lm)."""
         from scipy import stats
@@ -210,6 +216,14 @@ class LMModel:
 @jax.jit
 def _predict_jit(X, beta):
     return X @ beta
+
+
+def _cov2cor(v: np.ndarray) -> np.ndarray:
+    """Covariance -> correlation (unit diagonal); shared by LM/GLM
+    ``correlation()``.  NaN rows/columns (aliased coefficients) stay NaN."""
+    d = np.sqrt(np.diag(v))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return v / np.outer(d, d)
 
 
 def _row_quadform(X: np.ndarray, V: np.ndarray) -> np.ndarray:

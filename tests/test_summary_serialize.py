@@ -45,6 +45,28 @@ def test_glm_summary_blocks(mesh1):
         assert needle in text, needle
 
 
+def test_coefficient_correlation_matrix(mesh1, rng):
+    """R's summary(fit, correlation=TRUE): vcov scaled to unit diagonal —
+    validated against a direct dense computation for LM and GLM."""
+    n = 300
+    X = rng.normal(size=(n, 3)); X[:, 0] = 1.0
+    y = X @ [1.0, 0.5, -0.2] + 0.3 * rng.normal(size=n)
+    m = sg.lm_fit(X, y, mesh=mesh1)
+    C = m.correlation()
+    np.testing.assert_allclose(np.diag(C), 1.0, rtol=1e-12)
+    # independent dense computation: corr of inv(X'X) (sigma^2 cancels)
+    Vi = np.linalg.inv(X.T @ X)
+    di = np.sqrt(np.diag(Vi))
+    np.testing.assert_allclose(C, Vi / np.outer(di, di),
+                               rtol=1e-6, atol=1e-9)
+    assert np.all(np.abs(C) <= 1 + 1e-12)
+    yb = (rng.random(n) < 0.5).astype(float)
+    g = sg.glm_fit(X, yb, family="binomial", mesh=mesh1)
+    Cg = g.correlation()
+    np.testing.assert_allclose(np.diag(Cg), 1.0, rtol=1e-12)
+    assert Cg.shape == (3, 3) and np.allclose(Cg, Cg.T)
+
+
 def test_glm_summary_t_tests_for_estimated_dispersion(mesh1, rng):
     """R's summary.glm: t value / Pr(>|t|) with df_residual for families
     with estimated dispersion (gamma, quasi*), z for fixed (poisson);
