@@ -1,0 +1,183 @@
+"""Decompose the einsum-IRLS iteration at 2Mx512 into its component costs.
+
+VERDICT r2 #1: headline 40 ms/iter at MFU 0.14 with an unexplained ~25 ms.
+Hypotheses to measure, each timed as an isolated jitted op on the real chip:
+
+  H1  the Gramian einsum pair itself (default precision)      ~5-10 ms
+  H2  materialising Xw = X * w[:, None] costs an extra        ~10 ms
+      write+read pass vs the symmetric sqrt(w) form
+  H3  the eta matvec X @ beta                                  ~5 ms
+  H4  elementwise z/w/deviance                                 ~1 ms
+  H5  cho_factor (p=512, replicated)                           ?
+  H6  inv_from_cho = cho_solve against eye(p) EVERY iteration  ?  <-- suspect
+  H7  solve_normal incl. refine_steps=1                        ?
+
+Run exactly one TPU client at a time (memory: tpu-tunnel-fragility).
+"""
+import json
+import time
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from sparkglm_tpu.ops.gramian import weighted_gramian
+from sparkglm_tpu.ops.solve import solve_normal, inv_from_cho, cho_factor, cho_solve  # noqa
+
+
+def _fetch_scalar(out):
+    """Force completion of everything enqueued so far (device executes
+    in-order; a host fetch of any later result waits for all of it)."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(jnp.asarray(leaf).ravel()[0])
+
+
+def timeit(fn, *args, reps=12):
+    """Slope timing: the axon tunnel's block_until_ready is a no-op and a
+    per-call device_get pays ~200 ms RPC latency, so time K enqueues + one
+    scalar fetch at two K values and difference out the constant RPC cost."""
+    out = fn(*args)
+    _fetch_scalar(out)  # warm compile
+
+    def run(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = fn(*args)
+        _fetch_scalar(out)
+        return time.perf_counter() - t0
+
+    k1, k2 = 2, 2 + reps
+    t1 = min(run(k1), run(k1))
+    t2 = min(run(k2), run(k2))
+    return max((t2 - t1) / (k2 - k1), 0.0)
+
+
+def main():
+    n, p = 2_097_152, 512
+    key = jax.random.PRNGKey(0)
+    kx, kb = jax.random.split(key)
+    X = jax.random.normal(kx, (n, p), jnp.float32)
+    X = X.at[:, 0].set(1.0)
+    beta_true = jax.random.normal(kb, (p,), jnp.float32) * 0.1
+    eta = X @ beta_true
+    mu = jax.nn.sigmoid(eta)
+    y = (jax.random.uniform(jax.random.PRNGKey(1), (n,)) < mu).astype(jnp.float32)
+    wt = jnp.ones((n,), jnp.float32)
+    off = jnp.zeros((n,), jnp.float32)
+    beta = jnp.zeros((p,), jnp.float32)
+    jax.block_until_ready((X, y))
+
+    res = {"n": n, "p": p, "device": str(jax.devices()[0])}
+
+    # H1: gramian pair as shipped (Xw materialised form)
+    g_asis = jax.jit(lambda X, z, w: weighted_gramian(X, z, w))
+    res["gramian_asis_ms"] = timeit(g_asis, X, eta, wt) * 1e3
+
+    # H2: symmetric sqrt(w) form — same operand twice
+    @jax.jit
+    def g_sym(X, z, w):
+        s = jnp.sqrt(w)
+        Xs = X * s[:, None]
+        G = jnp.einsum("np,nq->pq", Xs, Xs, preferred_element_type=jnp.float32)
+        b = jnp.einsum("np,n->p", Xs, s * z, preferred_element_type=jnp.float32)
+        return G, b
+
+    res["gramian_sym_ms"] = timeit(g_sym, X, eta, wt) * 1e3
+
+    # H3: eta matvec
+    mv = jax.jit(lambda X, b, o: X @ b + o)
+    res["matvec_ms"] = timeit(mv, X, beta_true, off) * 1e3
+
+    # H4: elementwise z/w/dev for logistic
+    @jax.jit
+    def elem(eta, y, wt):
+        mu = jax.nn.sigmoid(eta)
+        g = 1.0 / jnp.maximum(mu * (1 - mu), 1e-30)
+        w = wt / jnp.maximum((mu * (1 - mu)) * g * g, 1e-30)
+        z = eta + (y - mu) * g
+        ylog = jnp.where(y > 0, y * jnp.log(jnp.maximum(y / mu, 1e-30)), 0.0)
+        y1 = jnp.where(y < 1, (1 - y) * jnp.log(jnp.maximum((1 - y) / (1 - mu), 1e-30)), 0.0)
+        dev = 2.0 * jnp.sum(wt * (ylog + y1))
+        return z, w, dev
+
+    res["elementwise_ms"] = timeit(elem, eta, y, wt) * 1e3
+
+    # H5-H7: the p x p solve chain
+    G, b = g_asis(X, eta, wt)
+    jax.block_until_ready((G, b))
+
+    chof = jax.jit(lambda A: cho_factor(A))
+    res["cho_factor_ms"] = timeit(chof, G) * 1e3
+
+    cmat, lower = cho_factor(G)
+    jax.block_until_ready(cmat)
+    inv_eye = jax.jit(lambda c: cho_solve((c, lower), jnp.eye(p, dtype=jnp.float32)))
+    res["cho_solve_eye_ms"] = timeit(inv_eye, cmat) * 1e3
+    solve1 = jax.jit(lambda c, b: cho_solve((c, lower), b))
+    res["cho_solve_1rhs_ms"] = timeit(solve1, cmat, b) * 1e3
+
+    sn0 = jax.jit(lambda G, b: solve_normal(G, b, refine_steps=0)[0])
+    res["solve_normal_r0_ms"] = timeit(sn0, G, b) * 1e3
+    sn1 = jax.jit(lambda G, b: solve_normal(G, b, refine_steps=1)[0])
+    res["solve_normal_r1_ms"] = timeit(sn1, G, b) * 1e3
+
+    @jax.jit
+    def solve_plus_inv(G, b):
+        beta, cho = solve_normal(G, b, refine_steps=1)
+        return beta, inv_from_cho(cho, p, jnp.float32)
+
+    res["solve_plus_inv_ms"] = timeit(solve_plus_inv, G, b) * 1e3
+
+    # full shipped body equivalent, one iteration (gramian + solve + inv +
+    # matvec + elementwise + dev)
+    @jax.jit
+    def body(X, y, wt, off, beta):
+        eta = X @ beta + off
+        mu = jax.nn.sigmoid(eta)
+        gd = 1.0 / jnp.maximum(mu * (1 - mu), 1e-30)
+        w = wt / jnp.maximum((mu * (1 - mu)) * gd * gd, 1e-30)
+        z = eta - off + (y - mu) * gd
+        G, bb = weighted_gramian(X, z, w)
+        beta_n, cho = solve_normal(G, bb, refine_steps=1)
+        cov = inv_from_cho(cho, p, jnp.float32)
+        eta_n = X @ beta_n + off
+        mu_n = jax.nn.sigmoid(eta_n)
+        ylog = jnp.where(y > 0, y * jnp.log(jnp.maximum(y / mu_n, 1e-30)), 0.0)
+        y1 = jnp.where(y < 1, (1 - y) * jnp.log(jnp.maximum((1 - y) / (1 - mu_n), 1e-30)), 0.0)
+        dev = 2.0 * jnp.sum(wt * (ylog + y1))
+        return beta_n, cov, dev
+
+    res["full_body_ms"] = timeit(body, X, y, wt, off, beta) * 1e3
+
+    # body without the in-loop inverse (factor carried; cov post-loop)
+    @jax.jit
+    def body_noinv(X, y, wt, off, beta):
+        eta = X @ beta + off
+        mu = jax.nn.sigmoid(eta)
+        gd = 1.0 / jnp.maximum(mu * (1 - mu), 1e-30)
+        w = wt / jnp.maximum((mu * (1 - mu)) * gd * gd, 1e-30)
+        z = eta - off + (y - mu) * gd
+        s = jnp.sqrt(w)
+        Xs = X * s[:, None]
+        G = jnp.einsum("np,nq->pq", Xs, Xs, preferred_element_type=jnp.float32)
+        bb = jnp.einsum("np,n->p", Xs, s * z, preferred_element_type=jnp.float32)
+        beta_n, cho = solve_normal(G, bb, refine_steps=0)
+        ylog = jnp.where(y > 0, y * jnp.log(jnp.maximum(y / mu, 1e-30)), 0.0)
+        y1 = jnp.where(y < 1, (1 - y) * jnp.log(jnp.maximum((1 - y) / (1 - mu), 1e-30)), 0.0)
+        dev = 2.0 * jnp.sum(wt * (ylog + y1))
+        return beta_n, dev
+
+    try:
+        res["body_noinv_ms"] = timeit(body_noinv, X, y, wt, off, beta_true) * 1e3
+    except Exception as e:  # pragma: no cover
+        res["body_noinv_error"] = str(e)
+
+    print(json.dumps(res, indent=1))
+    with open("/root/repo/benchmarks/hotloop_decomp_r03.json", "w") as f:
+        json.dump(res, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+"""Which part of the 16 ms single-pass kernel is not the Gramian?
+
+Variants (all DEFAULT-precision Gramian, block 1024, logistic 2Mx512):
+  v0_gramian_only : z, w precomputed outside; kernel = Xw=X*w; G += Xw'X; b += sum
+  v1_mxu_eta      : + eta via MXU dot_general HIGHEST (matvec FLOPs trivial)
+  v2_vpu_eta      : + eta via VPU lane-reduce (shipped kernel's form)
+  v3_full_mxu     : full kernel (eta MXU HIGHEST + elementwise + dev)
+  v4_full_vpu     : full kernel (eta VPU) == shipped structure at DEFAULT
+"""
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+
+def _fetch(out):
+    return float(jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+
+
+def timeit(fn, *args, reps=12):
+    out = fn(*args)
+    _fetch(out)
+
+    def run(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = fn(*args)
+        _fetch(out)
+        return time.perf_counter() - t0
+
+    t1 = min(run(2), run(2))
+    t2 = min(run(2 + reps), run(2 + reps))
+    return max((t2 - t1) / reps, 0.0)
+
+
+P_DEF = jax.lax.Precision.DEFAULT
+P_HI = jax.lax.Precision.HIGHEST
+
+
+def build(variant, block_rows, p):
+    def kern(*refs):
+        if variant == "v0":
+            x_ref, z_ref, w_ref, xtwx_ref, xtwz_ref, dev_ref = refs
+        else:
+            x_ref, y_ref, wt_ref, off_ref, beta_ref, xtwx_ref, xtwz_ref, dev_ref = refs
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            xtwx_ref[:] = jnp.zeros_like(xtwx_ref)
+            xtwz_ref[:] = jnp.zeros_like(xtwz_ref)
+            dev_ref[:] = jnp.zeros_like(dev_ref)
+
+        X = x_ref[:]
+        if variant == "v0":
+            z, w = z_ref[:], w_ref[:]
+            dev = jnp.zeros((1, 1), jnp.float32)
+        else:
+            y, wt, off, beta_row = y_ref[:], wt_ref[:], off_ref[:], beta_ref[:]
+            valid = wt > 0.0
+            if variant in ("v1", "v3"):
+                eta = jax.lax.dot_general(
+                    X, beta_row, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32, precision=P_HI) + off
+            else:
+                eta = jnp.sum(X * beta_row, axis=1, keepdims=True) + off
+            mu = jnp.where(valid, jax.nn.sigmoid(eta), 0.5)
+            if variant in ("v1", "v2"):
+                v = jnp.maximum(mu * (1.0 - mu), 1e-30)
+                w = jnp.where(valid, wt * v, 0.0)
+                z = jnp.where(valid, eta + (y - mu) / v, 0.0)
+                dev = jnp.zeros((1, 1), jnp.float32)
+            else:
+                v = jnp.maximum(mu * (1.0 - mu), 1e-30)
+                g = 1.0 / v
+                w = jnp.where(valid, wt * v, 0.0)
+                z = jnp.where(valid, eta - off + (y - mu) * g, 0.0)
+                ylog = jnp.where(y > 0, y * jnp.log(jnp.maximum(y / mu, 1e-30)), 0.0)
+                y1 = jnp.where(y < 1, (1 - y) * jnp.log(jnp.maximum((1 - y) / (1 - mu), 1e-30)), 0.0)
+                dev = jnp.sum(jnp.where(valid, 2.0 * wt * (ylog + y1), 0.0)).reshape(1, 1)
+        Xw = X * w
+        xtwx_ref[:] += jax.lax.dot_general(
+            Xw, X, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=P_DEF)
+        xtwz_ref[:] += jnp.sum(Xw * z, axis=0, keepdims=True)
+        dev_ref[:] += dev
+
+    vec = lambda: pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+    xspec = pl.BlockSpec((block_rows, p), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    outspecs = [
+        pl.BlockSpec((p, p), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, p), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    outshape = [
+        jax.ShapeDtypeStruct((p, p), jnp.float32),
+        jax.ShapeDtypeStruct((1, p), jnp.float32),
+        jax.ShapeDtypeStruct((1, 1), jnp.float32),
+    ]
+
+    if variant == "v0":
+        @jax.jit
+        def run(X, z, w):
+            n = X.shape[0]
+            return pl.pallas_call(
+                kern, grid=(n // block_rows,),
+                in_specs=[xspec, vec(), vec()],
+                out_specs=outspecs, out_shape=outshape,
+                cost_estimate=pl.CostEstimate(
+                    flops=2 * n * p * (p + 2),
+                    bytes_accessed=4 * (n * p + 2 * n + p * p + p),
+                    transcendentals=0),
+            )(X, z.reshape(n, 1), w.reshape(n, 1))
+    else:
+        @jax.jit
+        def run(X, y, wt, off, beta):
+            n = X.shape[0]
+            return pl.pallas_call(
+                kern, grid=(n // block_rows,),
+                in_specs=[xspec, vec(), vec(), vec(),
+                          pl.BlockSpec((1, p), lambda i: (0, 0),
+                                       memory_space=pltpu.VMEM)],
+                out_specs=outspecs, out_shape=outshape,
+                cost_estimate=pl.CostEstimate(
+                    flops=2 * n * p * (p + 2),
+                    bytes_accessed=4 * (n * p + 4 * n + p * p + 2 * p),
+                    transcendentals=4 * n),
+            )(X, y.reshape(n, 1), wt.reshape(n, 1), off.reshape(n, 1),
+              beta.reshape(1, p))
+    return run
+
+
+def main():
+    n, p = 2_097_152, 512
+    kx, kb = jax.random.split(jax.random.PRNGKey(0))
+    X = jax.random.normal(kx, (n, p), jnp.float32).at[:, 0].set(1.0)
+    beta_t = jax.random.normal(kb, (p,), jnp.float32) * 0.1
+    eta = X @ beta_t
+    mu = jax.nn.sigmoid(eta)
+    y = (jax.random.uniform(jax.random.PRNGKey(1), (n,)) < mu).astype(jnp.float32)
+    wt = jnp.ones((n,), jnp.float32)
+    off = jnp.zeros((n,), jnp.float32)
+    v = jnp.maximum(mu * (1 - mu), 1e-30)
+    w = wt * v
+    z = eta + (y - mu) / v
+    res = {"n": n, "p": p}
+
+    for variant in ("v0", "v1", "v2", "v3", "v4"):
+        for blk in (512, 1024):
+            tag = f"{variant}_b{blk}"
+            try:
+                k = build(variant, blk, p)
+                args = (X, z, w) if variant == "v0" else (X, y, wt, off, beta_t)
+                res[f"{tag}_ms"] = timeit(k, *args) * 1e3
+            except Exception as e:
+                res[f"{tag}_error"] = str(e).split("\n")[0][:120]
+            print(tag, res.get(f"{tag}_ms", res.get(f"{tag}_error")), flush=True)
+
+    print(json.dumps(res, indent=1))
+    with open("/root/repo/benchmarks/proto_variants_r03.json", "w") as f:
+        json.dump(res, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
