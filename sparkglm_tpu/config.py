@@ -39,8 +39,13 @@ class NumericConfig:
         (ops/tsqr.py): coefficient error drops from ~eps*kappa(X)^2 (the
         f32 normal-equations floor) to ~eps*kappa(X), at the cost of one
         distributed QR plus two fused data passes.  The lever for matching
-        R's f64 results on ill-conditioned designs without x64.  None (the
-        default) skips it.
+        R's f64 results on ill-conditioned designs without x64.
+        ``None`` (the default) = AUTO: the polish runs exactly when the
+        fit's equilibrated pivot shows the f32 normal equations losing
+        digits (pivot < 0.03 ~ kappa(X) beyond ~30), with a warning —
+        on paths that can run it (resident fits with an unsharded feature
+        axis; global multi-process and streaming fits warn instead).
+        ``"off"`` never polishes (r02's warn-only behaviour).
     """
 
     dtype: jnp.dtype = jnp.float32

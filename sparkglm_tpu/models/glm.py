@@ -46,8 +46,8 @@ from ..families.families import Family, resolve
 from ..families.links import Link
 from ..ops.fused import fused_fisher_pass, fused_fisher_pass_ref
 from ..ops.gramian import weighted_gramian
-from ..ops.solve import (factor_singular, inv_from_cho, min_pivot,
-                         solve_normal)
+from ..ops.solve import (factor_parts, factor_singular, inv_from_parts,
+                         min_pivot, solve_normal)
 from ..parallel import mesh as meshlib
 
 _BIG = jnp.inf
@@ -97,7 +97,12 @@ def _irls_kernel(
         mu=mu0.astype(X.dtype),
         dev=dev0.astype(acc),
         ddev=jnp.asarray(_BIG, acc),
-        cov_inv=jnp.zeros((p, p), acc),
+        # the solve FACTOR (Cholesky of the equilibrated Gramian + its
+        # scaling, or the TSQR R) rides the loop; the p-RHS triangular
+        # solve producing (X'WX)^-1 runs ONCE post-loop — in-loop it cost
+        # ~2.8 ms/iteration at p=512 (benchmarks/HOTLOOP_r03.md)
+        fac_a=jnp.eye(p, dtype=acc),
+        fac_d=jnp.ones((p,), acc),
         singular=jnp.zeros((), jnp.bool_),
         pivot=jnp.ones((), acc),  # equilibrated min pivot ~ 1/kappa(X)
         # first iteration's Gramian, kept for the singular='drop' host rank
@@ -122,21 +127,23 @@ def _irls_kernel(
         if solver == "qr":
             # TSQR + corrected seminormal solve: error ~eps*kappa(X), for
             # designs whose f32 GRAMIAN is noise-dominated (ops/tsqr.py)
-            from ..ops.tsqr import qr_wls, rinv_gram
+            from ..ops.tsqr import qr_wls
             beta, R, pivot = qr_wls(X, z, w, mesh=mesh)
             singular = pivot < 1e-6
             XtWX = (R.T @ R).astype(acc)  # Gramian for the drop-path rank check
-            cov = rinv_gram(R, p, acc)
+            fac_a, fac_d = R.astype(acc), s["fac_d"]
         else:
             XtWX, XtWz = weighted_gramian(X, z, w, accum_dtype=acc,
                                           precision=precision)
             beta, cho = solve_normal(XtWX, XtWz, jitter=jitter,
                                      refine_steps=refine_steps)
-            cov = inv_from_cho(cho, p, acc)
+            fac_a, fac_d = factor_parts(cho)
             singular = factor_singular(cho)
             pivot = min_pivot(cho)
         singular = ~jnp.all(jnp.isfinite(beta)) | singular
         beta = jnp.where(singular, s["beta"], beta)
+        fac_a = jnp.where(singular, s["fac_a"], fac_a)
+        fac_d = jnp.where(singular, s["fac_d"], fac_d)
         eta_new = (X @ beta + offset).astype(X.dtype)      # ref: etaCreate :321-332
         mu_new = jnp.where(valid, link.inverse(eta_new), 1.0).astype(X.dtype)  # ref: muCreate :334-355
         dev_new = dev_of(mu_new)
@@ -152,7 +159,8 @@ def _irls_kernel(
             mu=mu_new,
             dev=dev_new,
             ddev=jnp.abs(dev_new - s["dev"]),
-            cov_inv=cov,
+            fac_a=fac_a,
+            fac_d=fac_d,
             singular=singular,
             pivot=pivot.astype(acc),
             XtWX0=jnp.where(s["it"] == 0, XtWX.astype(acc), s["XtWX0"]),
@@ -165,11 +173,17 @@ def _irls_kernel(
     # deviance) is recomputed on the host in f64 from eta
     # (models/hoststats.py) — TPU f32 transcendentals are too approximate
     # for R-parity scalars.  The in-loop f32 deviance drives convergence
-    # only (its error is consistent across iterations).
+    # only (its error is consistent across iterations).  (X'WX)^-1 comes
+    # from the carried factor, once.
+    if solver == "qr":
+        from ..ops.tsqr import rinv_gram
+        cov_final = rinv_gram(s["fac_a"], p, acc)
+    else:
+        cov_final = inv_from_parts(s["fac_a"], s["fac_d"], p, acc)
     d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
     converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"]
 
-    return dict(beta=s["beta"], cov_inv=s["cov_inv"], dev=s["dev"],
+    return dict(beta=s["beta"], cov_inv=cov_final, dev=s["dev"],
                 eta=s["eta"], iters=s["it"], converged=converged,
                 singular=s["singular"], pivot=s["pivot"], XtWX0=s["XtWX0"])
 
@@ -194,18 +208,14 @@ def _csne_post(X, y, wt, off, beta, *, family: Family, link: Link,
     return beta_p, X @ beta_p + off, rinv_gram(R, X.shape[1], acc)
 
 
-def _fused_block_rows(p: int) -> int:
-    """Largest power-of-two row block that keeps the fused kernel's VMEM
-    footprint (~12 float32 copies of a (b, p) block: double-buffered input,
-    Xw scratch, accumulators) within ~10 MB of the 16 MB/core budget."""
-    budget = 10 * 1024 * 1024
-    b = max(128, budget // (48 * p))
-    return min(2048, 1 << (int(b).bit_length() - 1))
+# precision-aware VMEM sizing lives with the kernel now (ops/fused.py);
+# keep the old name importable for the benchmark harnesses
+from ..ops.fused import fused_block_rows as _fused_block_rows  # noqa: E402
 
 
 @partial(jax.jit, static_argnames=("family", "link", "criterion", "refine_steps",
                                    "mesh", "block_rows",
-                                   "use_pallas", "trace"))
+                                   "use_pallas", "trace", "precision"))
 def _irls_fused_kernel(
     X, y, wt, offset,
     tol, max_iter, jitter,
@@ -216,6 +226,7 @@ def _irls_fused_kernel(
     block_rows: int = 512,
     use_pallas: bool = True,
     trace: bool = False,
+    precision=None,
 ):
     """IRLS where each iteration's data touch is ONE fused pass over X
     (ops/fused.py): eta, mu, z, w, Gramian and deviance per row block, then a
@@ -232,7 +243,8 @@ def _irls_fused_kernel(
         def f(Xs, ys, ws, os_, beta):
             XtWX, XtWz, dev = pass_fn(Xs, ys, ws, os_, beta, family=family,
                                       link=link, first=first,
-                                      block_rows=block_rows)
+                                      block_rows=block_rows,
+                                      precision=precision)
             return (jax.lax.psum(XtWX, meshlib.DATA_AXIS),
                     jax.lax.psum(XtWz, meshlib.DATA_AXIS),
                     jax.lax.psum(dev, meshlib.DATA_AXIS))
@@ -242,16 +254,20 @@ def _irls_fused_kernel(
             in_specs=(P(d, None), P(d), P(d), P(d), P()),
             out_specs=(P(), P(), P()), check_vma=False)
 
-    def solve(XtWX, XtWz, beta_prev):
+    def solve(XtWX, XtWz, beta_prev, fac_prev):
         beta, cho = solve_normal(XtWX, XtWz, jitter=jitter,
                                  refine_steps=refine_steps)
+        fac_a, fac_d = factor_parts(cho)
         singular = ~jnp.all(jnp.isfinite(beta)) | factor_singular(cho)
         beta = jnp.where(singular, beta_prev, beta)
-        return beta, inv_from_cho(cho, p, acc), singular, min_pivot(cho)
+        fac_a = jnp.where(singular, fac_prev[0], fac_a)
+        fac_d = jnp.where(singular, fac_prev[1], fac_d)
+        return beta, (fac_a, fac_d), singular, min_pivot(cho)
 
     beta0 = jnp.zeros((p,), X.dtype)
+    fac_init = (jnp.eye(p, dtype=acc), jnp.ones((p,), acc))
     XtWX0, XtWz0, dev0 = spmd_pass(True)(X, y, wt, offset, beta0)
-    beta1, cov0, sing0, piv0 = solve(XtWX0, XtWz0, beta0)
+    beta1, fac0, sing0, piv0 = solve(XtWX0, XtWz0, beta0, fac_init)
 
     state0 = dict(
         # counts deviance-measured updates, matching the einsum kernel's
@@ -260,7 +276,8 @@ def _irls_fused_kernel(
         beta=beta1.astype(X.dtype),
         dev=dev0.astype(acc),
         ddev=jnp.asarray(_BIG, acc),
-        cov_inv=cov0.astype(acc),
+        fac_a=fac0[0],
+        fac_d=fac0[1],
         singular=sing0,
         pivot=piv0.astype(acc),
     )
@@ -276,7 +293,8 @@ def _irls_fused_kernel(
 
     def body(s):
         XtWX, XtWz, dev = step(X, y, wt, offset, s["beta"])
-        beta_new, cov_inv, singular, pivot = solve(XtWX, XtWz, s["beta"])
+        beta_new, fac, singular, pivot = solve(XtWX, XtWz, s["beta"],
+                                               (s["fac_a"], s["fac_d"]))
         if trace:
             jax.debug.print("iter {i}\tdeviance {d}\tddev {dd}",
                             i=s["it"] + 1, d=dev,
@@ -286,7 +304,8 @@ def _irls_fused_kernel(
             beta=beta_new.astype(X.dtype),
             dev=dev.astype(acc),
             ddev=jnp.abs(dev.astype(acc) - s["dev"]),
-            cov_inv=cov_inv,
+            fac_a=fac[0],
+            fac_d=fac[1],
             singular=singular,
             pivot=pivot.astype(acc),
         )
@@ -294,13 +313,15 @@ def _irls_fused_kernel(
     s = jax.lax.while_loop(not_converged, body, state0)
 
     # ---- post-loop: only eta leaves the device; reported statistics are
-    # host-f64 (models/hoststats.py — see _irls_kernel's post-loop note)
+    # host-f64 (models/hoststats.py — see _irls_kernel's post-loop note).
+    # (X'WX)^-1 from the carried factor, once (HOTLOOP_r03.md).
+    cov_final = inv_from_parts(s["fac_a"], s["fac_d"], p, acc)
     beta_f = s["beta"]
     eta = (X @ beta_f + offset).astype(X.dtype)
     d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
     converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"]
 
-    return dict(beta=beta_f, cov_inv=s["cov_inv"], dev=s["dev"],
+    return dict(beta=beta_f, cov_inv=cov_final, dev=s["dev"],
                 eta=eta, iters=s["it"], converged=converged,
                 singular=s["singular"], pivot=s["pivot"],
                 XtWX0=XtWX0.astype(acc))
@@ -580,6 +601,13 @@ def _fit_global(
         raise np.linalg.LinAlgError(
             "singular weighted Gramian during IRLS (multi-process fit has "
             "no aliasing path; drop dependent columns before sharding)")
+    # the CSNE polish has no global-array implementation yet, so the AUTO
+    # policy degrades to the loud warning here (can_polish=False)
+    from .conditioning import resolve_ill_conditioning
+    resolve_ill_conditioning(
+        float(np.asarray(out["pivot"])), is_f32=dtype != jnp.float64,
+        engine="einsum", polish_active=False, polish_cfg=config.polish,
+        can_polish=False)
 
     # host-f64 statistics from per-process partial sums
     from .validate import (check_finite_design, check_finite_vector,
@@ -686,10 +714,13 @@ def fit(
         (kappa ≳ 1e2 at float32) where the f32 Gramian itself is
         noise-dominated.  Slower per iteration (Householder QR instead of
         one MXU matmul).
-      * ``"auto"`` — ``"einsum"``: the measured winner at every design
-        width on v5e hardware (benchmarks/engine_sweep_r02.json — XLA's own
-        fusion of the elementwise z/w into the Gramian contraction beats the
-        hand-tiled Pallas kernel 2-4x per iteration).
+      * ``"auto"`` — the fused single-pass kernel on TPU for large float32
+        fits (one HBM pass/iteration ≈ 16 ms vs the einsum engine's
+        ~26-40 ms at 2Mx512 — measured r03 after un-crippling the kernel's
+        Gramian precision, benchmarks/HOTLOOP_r03.md); ``"einsum"``
+        everywhere else (CPU meshes, float64, sharded feature axis, very
+        wide designs, and the small-n regime where the R-parity precision
+        gate makes HIGHEST passes mandatory anyway).
     """
     from .lm import _detect_intercept
 
@@ -698,8 +729,9 @@ def fit(
             f"criterion must be 'absolute' or 'relative', got {criterion!r}")
     if singular not in ("error", "drop"):
         raise ValueError(f"singular must be 'error' or 'drop', got {singular!r}")
-    if config.polish not in (None, "csne"):
-        raise ValueError(f"polish must be None or 'csne', got {config.polish!r}")
+    if config.polish not in (None, "csne", "off"):
+        raise ValueError(
+            f"polish must be None (auto), 'csne' or 'off', got {config.polish!r}")
     fam, lnk = resolve(family, link)
     if isinstance(X, jax.Array) and not X.is_fully_addressable:
         # global arrays spanning processes (parallel/distributed.py flow):
@@ -777,20 +809,28 @@ def fit(
 
     n_data = mesh.shape[meshlib.DATA_AXIS]
     on_tpu = jax.default_backend() == "tpu"
+    # small problems get full-f32 MXU passes for free — and need them
+    # for R parity (config.resolve_matmul_precision); both engines honour it
+    mmp = resolve_matmul_precision(config, n, p, on_tpu)
+    if mmp != config.matmul_precision:
+        config = dataclasses.replace(config, matmul_precision=mmp)
     if engine == "auto":
-        # Measured on a real v5e (benchmarks/engine_sweep_r02.json,
-        # device-resident data, p in {32,128,512,1024}): the einsum engine's
-        # XLA-fused Gramian beats both the hand-tiled Pallas kernel and its
-        # XLA twin at EVERY width (e.g. p=512: 29 ms/iter vs 64/63; p=32:
-        # 12 ms/iter vs 53/11-tie) — XLA already fuses the elementwise z/w
-        # into the contraction, and its matmul scheduling wins.  So "auto"
-        # is simply einsum; "fused"/"qr" remain explicit opt-ins.
-        engine = "einsum"
-    if engine == "fused" and config.matmul_precision is not None:
-        import warnings
-        warnings.warn("engine='fused' uses a fixed internal matmul precision; "
-                      "config.matmul_precision is ignored on this path",
-                      stacklevel=2)
+        # Measured r03 on a v5e (benchmarks/HOTLOOP_r03.md,
+        # proto_fused_r03.json): the single-HBM-pass Pallas kernel at
+        # DEFAULT Gramian precision runs ~16 ms/iter at 2Mx512 vs the
+        # einsum engine's ~26-40 (whose Gramian alone costs 17 ms — the
+        # Xw materialisation makes it ~4 HBM passes).  The r02 sweep that
+        # picked einsum was measuring the kernel 6x-overworked at
+        # Precision.HIGHEST.  Auto picks fused exactly where that holds:
+        # TPU, float32, unsharded feature axis, p small enough for the
+        # (p,p) VMEM accumulator, and the large-n regime (small-n parity
+        # fits force HIGHEST passes, where einsum's XLA schedule wins).
+        big = n * p * p > (1 << 31)
+        engine = ("fused" if on_tpu and big and dtype == np.float32
+                  and config.matmul_precision is None
+                  and not shard_features and mesh.shape[meshlib.MODEL_AXIS] == 1
+                  and p <= 1024
+                  else "einsum")
     if engine not in ("einsum", "fused", "qr"):
         raise ValueError(
             f"engine must be 'auto', 'einsum', 'fused' or 'qr', got {engine!r}")
@@ -798,12 +838,6 @@ def fit(
                                       or mesh.shape[meshlib.MODEL_AXIS] != 1):
         raise ValueError(
             f"engine={engine!r} does not support a sharded feature axis")
-    if engine != "fused":
-        # small problems get full-f32 MXU passes for free — and need them
-        # for R parity (config.resolve_matmul_precision)
-        mmp = resolve_matmul_precision(config, n, p, on_tpu)
-        if mmp != config.matmul_precision:
-            config = dataclasses.replace(config, matmul_precision=mmp)
     # the qr engine's corrected-seminormal solve already delivers the
     # polish's ~eps*kappa accuracy every iteration — skip the redundant TSQR
     polish_active = config.polish == "csne" and engine != "qr"
@@ -814,7 +848,7 @@ def fit(
                       "feature axis; skipping the polish", stacklevel=2)
         polish_active = False
 
-    block_rows = _fused_block_rows(p)
+    block_rows = _fused_block_rows(p, config.matmul_precision)
     if engine == "fused":
         # the fused kernel streams whole blocks, so every shard's row count
         # must divide into block_rows; extra rows carry wt=0 and stay inert
@@ -846,6 +880,7 @@ def fit(
             # the Mosaic kernel is float32; float64 (x64) runs the XLA twin
             use_pallas=on_tpu and p <= 1024 and dtype == np.float32,
             trace=verbose,
+            precision=config.matmul_precision,
         )
     else:
         out = _irls_kernel(
@@ -860,23 +895,6 @@ def fit(
             mesh=mesh if engine == "qr" else None,
         )
     out = jax.tree.map(np.asarray, out)
-    if (dtype == np.float32 and float(out["pivot"]) < 0.03
-            and engine != "qr" and not polish_active):
-        # conditioning beyond f32 normal-equations fidelity: the fit is not
-        # refused (kappa ~1e4..1e5 is recoverable) but must not pass silently
-        import warnings
-        warnings.warn(
-            f"design is ill-conditioned for float32 normal equations "
-            f"(equilibrated pivot {float(out['pivot']):.1e} ~ 1/kappa(X)); "
-            "coefficients may lose digits — use engine='qr', "
-            "NumericConfig(polish='csne'), or the float64 path", stacklevel=2)
-    if polish_active and not bool(out["singular"]):
-        beta_p, eta_p, cov_p = _csne_post(Xd, yd, wd, od,
-                                          jnp.asarray(out["beta"]),
-                                          family=fam, link=lnk, mesh=mesh)
-        out["beta"] = np.asarray(beta_p)
-        out["eta"] = np.asarray(eta_p)
-        out["cov_inv"] = np.asarray(cov_p)
     if singular == "drop":
         # host rank check on the FIRST iteration's Gramian, captured by the
         # kernel — no dedicated pre-pass over the data (ADVICE r1).  The
@@ -906,6 +924,26 @@ def fit(
         raise np.linalg.LinAlgError(
             "singular weighted Gramian during IRLS; pass singular='drop' for "
             "R-style aliasing or consider jitter in NumericConfig")
+
+    # ill-conditioning policy AFTER the drop/singular paths, so an aliased
+    # design never pays (and then discards) the escalation TSQR pass
+    from .conditioning import resolve_ill_conditioning
+    polish_active = resolve_ill_conditioning(
+        float(out["pivot"]), is_f32=dtype == np.float32, engine=engine,
+        polish_active=polish_active, polish_cfg=config.polish,
+        can_polish=not shard_features
+        and mesh.shape[meshlib.MODEL_AXIS] == 1)
+    if polish_active:
+        # TSQR + corrected seminormal equations at the final weights
+        # (ops/tsqr.py): error ~eps*kappa instead of ~eps*kappa^2 (measured
+        # kappa=1e3: 3.6e-2 -> ~2e-4, PARITY.md); covariance rebuilt from
+        # the TSQR factor so SEs match the polished accuracy
+        beta_p, eta_p, cov_p = _csne_post(Xd, yd, wd, od,
+                                          jnp.asarray(out["beta"]),
+                                          family=fam, link=lnk, mesh=mesh)
+        out["beta"] = np.asarray(beta_p)
+        out["eta"] = np.asarray(eta_p)
+        out["cov_inv"] = np.asarray(cov_p)
 
     # ---- reported statistics: host f64 from the final linear predictor
     # (hoststats module docstring explains why they cannot stay on device).

@@ -61,8 +61,9 @@ def _check_polish(config: NumericConfig) -> None:
     """Streaming solves run on host float64 already — the csne polish is
     neither needed nor applicable; invalid values still raise like the
     resident fits."""
-    if config.polish not in (None, "csne"):
-        raise ValueError(f"polish must be None or 'csne', got {config.polish!r}")
+    if config.polish not in (None, "csne", "off"):
+        raise ValueError(
+            f"polish must be None (auto), 'csne' or 'off', got {config.polish!r}")
     if config.polish == "csne":
         import warnings
         warnings.warn("streaming fits solve on host float64; polish='csne' "
@@ -150,8 +151,12 @@ def _put_chunk(Xc, yc, wc, oc, mesh, dtype):
 @partial(jax.jit, static_argnames=("family", "link", "first"))
 def _glm_chunk_pass(Xc, yc, wc, oc, beta, *, family: Family, link: Link,
                     first: bool):
+    # HIGHEST is pinned: streaming is H2D-bandwidth-bound, so the full-f32
+    # Gramian passes are free and keep chunked accumulation at r02 accuracy
+    # (the twin's None default now mirrors the fast Mosaic kernel instead)
     return fused_fisher_pass_ref(Xc, yc, wc, oc, beta,
-                                 family=family, link=link, first=first)
+                                 family=family, link=link, first=first,
+                                 precision="highest")
 
 
 @jax.jit
@@ -252,20 +257,40 @@ def _host_chunk(yc, wc, oc):
 
 
 def _solve64(XtWX: np.ndarray, XtWz: np.ndarray, jitter: float):
-    """Host float64 Cholesky solve (the reference's driver-local LAPACK
-    role, utils.scala:102-105, without the explicit inverse).  Returns the
-    factorization so callers can derive diag((X'WX)^-1) once, after the
+    """Host float64 equilibrated Cholesky solve (the reference's
+    driver-local LAPACK role, utils.scala:102-105, without the explicit
+    inverse).  Jacobi equilibration mirrors ops/solve.py::_prepare: the
+    scaled system's minimum pivot is the same scale-free ~1/kappa(X)
+    conditioning probe the resident fits report.  Returns
+    ``(beta, (cho, dinv), pivot)``; derive diag((X'WX)^-1) once, after the
     loop — not O(p^3) per iteration."""
+    p = XtWX.shape[0]
     A = 0.5 * (XtWX + XtWX.T)
+    dinv = 1.0 / np.sqrt(np.clip(np.diag(A), 1e-300, None))
+    As = A * dinv[:, None] * dinv[None, :]
     if jitter:
-        A = A + jitter * np.mean(np.diag(A)) * np.eye(A.shape[0])
-    cho = scipy.linalg.cho_factor(A)
-    beta = scipy.linalg.cho_solve(cho, XtWz)
-    return beta, cho
+        As = As + jitter * np.eye(p)
+    cho = scipy.linalg.cho_factor(As)
+    beta = dinv * scipy.linalg.cho_solve(cho, dinv * XtWz)
+    pivot = float(np.min(np.abs(np.diag(cho[0]))))
+    return beta, (cho, dinv), pivot
 
 
-def _diag_inv64(cho) -> np.ndarray:
-    return np.diag(scipy.linalg.cho_solve(cho, np.eye(cho[0].shape[0])))
+def _diag_inv64(factor) -> np.ndarray:
+    cho, dinv = factor
+    return np.diag(scipy.linalg.cho_solve(cho, np.eye(cho[0].shape[0]))) * dinv * dinv
+
+
+def _warn_streaming_conditioning(pivot: float, dtype, config) -> None:
+    """Chunk Gramians are computed in f32 on device (accumulation is host
+    f64, but the per-chunk products already carry ~eps32 noise), so the
+    resident fits' conditioning warning applies here too; the CSNE polish
+    has no streaming implementation, hence can_polish=False (warn-only)."""
+    from .conditioning import resolve_ill_conditioning
+    resolve_ill_conditioning(pivot, is_f32=np.dtype(dtype) == np.float32,
+                             engine="einsum", polish_active=False,
+                             polish_cfg=config.polish, can_polish=False,
+                             stacklevel=4)
 
 
 # ---------------------------------------------------------------------------
@@ -327,7 +352,8 @@ def lm_fit_streaming(
             any(nm.lower() in ("intercept", "(intercept)") for nm in xnames)
             or bool(ones_mask.any()))
 
-    beta, cho = _solve64(acc["XtWX"], acc["XtWy"], config.jitter)
+    beta, cho, pivot = _solve64(acc["XtWX"], acc["XtWy"], config.jitter)
+    _warn_streaming_conditioning(pivot, dtype, config)
     diag_inv = _diag_inv64(cho)
     # residual statistics in a second HOST float64 pass at the solved beta —
     # the one-pass y'Wy - beta'X'Wy identity loses every significant digit
@@ -521,7 +547,7 @@ def glm_fit_streaming(
         has_intercept = (
             any(nm.lower() in ("intercept", "(intercept)") for nm in xnames)
             or bool(ones_mask.any()))
-    beta, cho = _solve64(XtWX, XtWz, config.jitter)
+    beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
 
     iters = 0
     converged = False
@@ -540,12 +566,14 @@ def glm_fit_streaming(
         # solve before the convergence break so beta and the SE ingredient
         # diag((X'WX)^-1) come from the same final pass, exactly like the
         # resident fused engine's loop body
-        beta, cho = _solve64(XtWX, XtWz, config.jitter)
+        beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
         if on_iteration is not None:
             on_iteration(iters, beta.copy(), dev)  # checkpoint hook
         if crit <= tol_eff:
             converged = True
             break
+    if not _null_model:
+        _warn_streaming_conditioning(pivot, dtype, config)
     diag_inv = _diag_inv64(cho)  # once, from the final factorization
     # the IRLS loop is the cache's only reader; release the pinned device
     # chunks NOW so the host-side stats passes and the recursive null-model

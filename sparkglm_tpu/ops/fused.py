@@ -29,6 +29,15 @@ Layout notes (Mosaic): per-row vectors are carried as (n, 1) columns —
 matvecs must keep the contracting dim last on the lhs and vector-like rhs,
 and (blk, 1) blocks keep every elementwise op 2-D.  Scalars accumulate into a
 (1, 1) VMEM block.
+
+Gramian precision (measured on v5e, benchmarks/HOTLOOP_r03.md): the r02
+kernel hard-coded ``Precision.HIGHEST`` — 6 bf16 MXU passes — which made it
+3x slower than its own compute floor (43 ms vs 16 ms per pass at 2Mx512).
+``precision`` is now a parameter wired to ``config.resolve_matmul_precision``:
+large-n fits run DEFAULT (one bf16-multiply pass, f32 accumulation — the
+same product rounding the einsum engine's default has), small-n R-parity
+fits keep HIGHEST.  eta and X'Wz stay f32 on the VPU at either setting
+(a bf16 eta amplifies into ~1e-3 relative X'Wz error — measured in r02).
 """
 
 from __future__ import annotations
@@ -41,6 +50,29 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _TINY = 1e-30
+
+
+def resolve_kernel_precision(precision) -> jax.lax.Precision:
+    """Map a config-level precision name to what Mosaic supports (DEFAULT
+    and HIGHEST only — HIGH is rejected by the Mosaic lowering, measured
+    r03): anything asking for more than one bf16 pass gets HIGHEST."""
+    if precision in (None, "default", jax.lax.Precision.DEFAULT):
+        return jax.lax.Precision.DEFAULT
+    return jax.lax.Precision.HIGHEST
+
+
+def fused_block_rows(p: int, precision=None) -> int:
+    """Largest power-of-two row block fitting the kernel's VMEM budget
+    (~10 MB of the 16 MB/core).  DEFAULT precision holds the f32 block
+    (double-buffered input + Xw scratch = ~12 bytes/element) plus the
+    (p, p) f32 accumulator; HIGHEST additionally splits both dot operands
+    into 3 bf16 passes (~48 bytes/element, r02 formula — block 1024 at
+    p=512 OOMs scoped vmem, measured)."""
+    budget = 10 * 1024 * 1024
+    per_elem = 48 if resolve_kernel_precision(precision) != jax.lax.Precision.DEFAULT else 12
+    avail = budget - 4 * p * p  # the f32 Gramian accumulator stays resident
+    b = max(128, avail // (per_elem * p)) if avail > 0 else 128
+    return min(1024, 1 << (int(b).bit_length() - 1))
 
 
 def _step_math(X, y, wt, off, beta_row, *, family, link, first):
@@ -73,7 +105,8 @@ def _step_math(X, y, wt, off, beta_row, *, family, link, first):
 
 
 def _fisher_kernel(x_ref, y_ref, wt_ref, off_ref, beta_ref,
-                   xtwx_ref, xtwz_ref, dev_ref, *, family, link, first):
+                   xtwx_ref, xtwz_ref, dev_ref, *, family, link, first,
+                   precision):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -88,17 +121,17 @@ def _fisher_kernel(x_ref, y_ref, wt_ref, off_ref, beta_ref,
     X = x_ref[:]
     xtwx_ref[:] += jax.lax.dot_general(
         Xw, X, (((0,), (0,)), ((), ())), preferred_element_type=X.dtype,
-        precision=jax.lax.Precision.HIGHEST)
+        precision=precision)
     # X'Wz as a VPU sublane reduction — full f32 (see _step_math docstring)
     xtwz_ref[:] += jnp.sum(Xw * z, axis=0, keepdims=True)
     dev_ref[:] += dev
 
 
 @partial(jax.jit, static_argnames=("family", "link", "first", "block_rows",
-                                   "interpret"))
+                                   "interpret", "precision"))
 def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
                       first: bool = False, block_rows: int = 512,
-                      interpret: bool = False):
+                      interpret: bool = False, precision=None):
     """One fused IRLS data pass over a *local* (unsharded) row block.
 
     Args:
@@ -113,7 +146,8 @@ def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
         raise ValueError(f"n={n} must be a multiple of block_rows={block_rows}")
     yc, wc, oc = (a.reshape(n, 1) for a in (y, wt, offset))
     bc = beta.reshape(1, p)
-    kern = partial(_fisher_kernel, family=family, link=link, first=first)
+    kern = partial(_fisher_kernel, family=family, link=link, first=first,
+                   precision=resolve_kernel_precision(precision))
     vec = lambda: pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)
     XtWX, XtWz, dev = pl.pallas_call(
@@ -146,16 +180,23 @@ def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
 
 
 def fused_fisher_pass_ref(X, y, wt, offset, beta, *, family, link,
-                          first: bool = False, block_rows: int = 512):
+                          first: bool = False, block_rows: int = 512,
+                          precision=None):
     """Plain-XLA twin of :func:`fused_fisher_pass` (identical math/signature);
-    used on CPU meshes and as the correctness oracle for the kernel."""
+    used on CPU meshes and as the correctness oracle for the kernel.  The
+    Gramian precision default MIRRORS the Mosaic kernel (None -> DEFAULT for
+    f32) so the parity harnesses compare the same computation; float64
+    (which the kernel cannot run) always gets HIGHEST.  X'Wz stays HIGHEST
+    either way — it is one matvec, and the kernel keeps it f32 on the VPU."""
     n, p = X.shape
     yc, wc, oc = (a.reshape(n, 1) for a in (y, wt, offset))
     Xw, z, _, dev = _step_math(X, yc, wc, oc, beta.reshape(1, p),
                                family=family, link=link, first=first)
+    gram_prec = (jax.lax.Precision.HIGHEST if X.dtype == jnp.float64
+                 else resolve_kernel_precision(precision))
     XtWX = jax.lax.dot_general(Xw, X, (((0,), (0,)), ((), ())),
                                preferred_element_type=X.dtype,
-                               precision=jax.lax.Precision.HIGHEST)
+                               precision=gram_prec)
     XtWz = jax.lax.dot_general(Xw, z, (((0,), (0,)), ((), ())),
                                preferred_element_type=X.dtype,
                                precision=jax.lax.Precision.HIGHEST)

@@ -115,6 +115,21 @@ def inv_from_cho(factor, p: int, dtype):
     return inv_s * dinv[:, None] * dinv[None, :]
 
 
+def factor_parts(factor):
+    """Split a :func:`solve_normal` factor into plain arrays ``(c, dinv)``
+    that can ride a ``lax.while_loop`` state (the boolean ``lower`` flag is
+    this module's cho_factor convention, not data)."""
+    (c, _), dinv = factor
+    return c, dinv
+
+
+def inv_from_parts(c, dinv, p: int, dtype):
+    """Rebuild the covariance from :func:`factor_parts` output.  Keeps the
+    cho_factor triangle convention (lower=False) in THIS module so loop
+    kernels never hard-code it."""
+    return inv_from_cho(((c, False), dinv), p, dtype)
+
+
 def diag_inv_from_cho(factor, p: int, dtype):
     """``diag((X'WX)^-1)`` — the standard-error ingredient (utils.scala:95)."""
     return jnp.diag(inv_from_cho(factor, p, dtype))

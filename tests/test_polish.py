@@ -66,8 +66,11 @@ def test_csne_rescues_ill_conditioned_logistic_f32(mesh8, rng):
     y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(np.float64)
     b64, _, _, _ = irls_np(X, y, "binomial", "logit", tol=1e-14)
     kw = dict(family="binomial", tol=1e-12, criterion="relative", mesh=mesh8)
+    # polish="off" pins the UNpolished baseline (default args now
+    # auto-escalate to the polish at this conditioning — see
+    # test_default_args_auto_polish_at_kappa_1e3)
     m0 = sg.glm_fit(X.astype(np.float32), y.astype(np.float32),
-                    config=NumericConfig(dtype="float32"), **kw)
+                    config=NumericConfig(dtype="float32", polish="off"), **kw)
     m1 = sg.glm_fit(X.astype(np.float32), y.astype(np.float32),
                     config=NumericConfig(dtype="float32", polish="csne"), **kw)
     e0 = np.max(np.abs(m0.coefficients - b64))
@@ -83,7 +86,8 @@ def test_csne_rescues_ill_conditioned_ols_f32(mesh1, rng):
     y = X @ bt + 0.1 * rng.standard_normal(n)
     b64 = ols_np(X, y)
     m0 = sg.lm_fit(X.astype(np.float32), y.astype(np.float32),
-                   config=NumericConfig(dtype="float32"), mesh=mesh1)
+                   config=NumericConfig(dtype="float32", polish="off"),
+                   mesh=mesh1)
     m1 = sg.lm_fit(X.astype(np.float32), y.astype(np.float32),
                    config=NumericConfig(dtype="float32", polish="csne"),
                    mesh=mesh1)
@@ -171,7 +175,7 @@ def test_lm_qr_engine_public_api(mesh8, rng):
     y = X @ bt + 0.1 * rng.standard_normal(n)
     b64 = ols_np(X, y)
     m0 = sg.lm_fit(X.astype(np.float32), y.astype(np.float32), mesh=mesh8,
-                   config=NumericConfig(dtype="float32"))
+                   config=NumericConfig(dtype="float32", polish="off"))
     mq = sg.lm_fit(X.astype(np.float32), y.astype(np.float32), mesh=mesh8,
                    engine="qr", config=NumericConfig(dtype="float32"))
     e0 = np.max(np.abs(m0.coefficients - b64))
@@ -191,6 +195,10 @@ def test_ill_conditioned_f32_warns(mesh1, rng):
     with pytest.warns(UserWarning, match="ill-conditioned"):
         sg.lm_fit(X.astype(np.float32), y.astype(np.float32), mesh=mesh1,
                   config=NumericConfig(dtype="float32"))
+    # opting out of the auto-polish still warns (warn-only r02 behaviour)
+    with pytest.warns(UserWarning, match="may lose digits"):
+        sg.lm_fit(X.astype(np.float32), y.astype(np.float32), mesh=mesh1,
+                  config=NumericConfig(dtype="float32", polish="off"))
     # the qr engine on the same data does NOT warn (its accuracy is ~eps*kappa)
     import warnings as _w
     with _w.catch_warnings():
@@ -231,3 +239,45 @@ def test_polish_validated():
     y = np.arange(50.0)
     with pytest.raises(ValueError, match="polish"):
         sg.lm_fit(X, y, config=NumericConfig(polish="nope"))
+
+
+def test_streaming_warns_on_ill_conditioning(rng):
+    """Streaming fits have no CSNE polish, so the AUTO policy degrades to
+    the loud warning (config.py polish docstring contract); chunk Gramians
+    are f32 on device even though accumulation is host f64."""
+    from sparkglm_tpu.models.streaming import glm_fit_streaming, lm_fit_streaming
+    n, p, kappa = 20_000, 10, 1e3
+    X = _conditioned(rng, n, p, kappa).astype(np.float32)
+    yl = (X @ rng.standard_normal(p) + 0.1 * rng.standard_normal(n)).astype(np.float32)
+    with pytest.warns(UserWarning, match="may lose digits"):
+        lm_fit_streaming((X, yl), config=NumericConfig(dtype="float32"))
+    yg = (rng.random(n) < 1 / (1 + np.exp(-np.clip(X @ rng.standard_normal(p), -8, 8)))
+          ).astype(np.float32)
+    with pytest.warns(UserWarning, match="may lose digits"):
+        glm_fit_streaming((X, yg), family="binomial",
+                          config=NumericConfig(dtype="float32"))
+
+
+def test_default_args_auto_polish_at_kappa_1e3(mesh8, rng):
+    """VERDICT r2 #6: with DEFAULT arguments an f32 fit at kappa=1e3 must
+    auto-escalate to the CSNE polish and land within ~1e-3 of the f64
+    oracle (the r02 warn-only default measured ~3.6e-2), for both the GLM
+    and LM paths.  Hopeless conditioning (kappa beyond ~3e5) still errors
+    via factor_singular — unchanged."""
+    n, p, kappa = 40_000, 12, 1e3
+    X = _conditioned(rng, n, p, kappa)
+    bt = rng.standard_normal(p) / np.sqrt(p)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(np.float64)
+    b64, _, _, _ = irls_np(X, y, "binomial", "logit", tol=1e-14)
+    with pytest.warns(UserWarning, match="auto-applying the CSNE polish"):
+        mg = sg.glm_fit(X.astype(np.float32), y.astype(np.float32),
+                        family="binomial", tol=1e-12, criterion="relative",
+                        mesh=mesh8, config=NumericConfig(dtype="float32"))
+    assert np.max(np.abs(mg.coefficients - b64)) < 1e-3
+
+    yl = X @ bt + 0.1 * rng.standard_normal(n)
+    bl = ols_np(X, yl)
+    with pytest.warns(UserWarning, match="auto-applying the CSNE polish"):
+        ml = sg.lm_fit(X.astype(np.float32), yl.astype(np.float32),
+                       mesh=mesh8, config=NumericConfig(dtype="float32"))
+    assert np.max(np.abs(ml.coefficients - bl)) < 1e-3
