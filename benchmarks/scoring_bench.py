@@ -1,0 +1,81 @@
+"""Scoring-path benchmark (VERDICT r2 #4): a 10M-row sharded predict pass.
+
+Uses device-resident X (same convention as the fit benchmarks — the axon
+tunnel's H2D is ~100-200 MB/s sustained and would swamp any kernel
+measurement; memory: engine-and-precision-findings #4) and times
+models/scoring._score_kernel — the exact jitted pass ``predict_sharded``
+runs after ``device_put``.  Slope timing (K enqueues + scalar fetch).
+One TPU client at a time.
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from sparkglm_tpu.models.scoring import _score_kernel
+from sparkglm_tpu.families.links import get_link
+from sparkglm_tpu.parallel import mesh as meshlib
+
+
+def _fetch(out):
+    return float(jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+
+
+def timeit(fn, *args, reps=10):
+    out = fn(*args)
+    _fetch(out)
+
+    def run(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = fn(*args)
+        _fetch(out)
+        return time.perf_counter() - t0
+
+    t1 = min(run(2), run(2))
+    t2 = min(run(2 + reps), run(2 + reps))
+    return max((t2 - t1) / reps, 0.0)
+
+
+def bench(n, p, se_fit, response):
+    mesh = meshlib.make_mesh()
+    key = jax.random.PRNGKey(0)
+    X = jax.device_put(
+        jax.random.normal(key, (n, p), jnp.float32),
+        jax.sharding.NamedSharding(mesh, meshlib.row_spec(2)))
+    beta = jnp.zeros((p,), jnp.float32).at[0].set(0.3)
+    off = jnp.zeros((1,), jnp.float32)  # dummy: has_offset=False
+    V = (jnp.eye(p, dtype=jnp.float32) * 1e-4 if se_fit
+         else jnp.zeros((1, 1), jnp.float32))
+    lnk = get_link("logit")
+
+    def run(X, beta, off, V):
+        return _score_kernel(X, beta, off, V, inverse=lnk.inverse,
+                             deriv=lnk.deriv, want_se=se_fit,
+                             response=response, has_offset=False,
+                             quad_precision=None)
+
+    t = timeit(run, X, beta, off, V)
+    gb = n * p * 4 / 1e9
+    return {"n": n, "p": p, "se_fit": se_fit, "response": response,
+            "seconds": t, "rows_per_s": n / t, "GB_read": gb,
+            "eff_GBps": gb * (2 if se_fit else 1) / t}
+
+
+def main():
+    res = {"device": str(jax.devices()[0])}
+    res["predict_10Mx100_response"] = bench(10_000_000, 100, False, True)
+    res["predict_10Mx100_se_fit"] = bench(10_000_000, 100, True, True)
+    res["predict_2Mx512_response"] = bench(2_097_152, 512, False, True)
+    res["predict_2Mx512_se_fit"] = bench(2_097_152, 512, True, True)
+    print(json.dumps(res, indent=1))
+    with open("/root/repo/benchmarks/scoring_r03.json", "w") as f:
+        json.dump(res, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -373,25 +373,36 @@ class GLMModel:
     offset_col: str | None = None
 
     def predict(self, X, type: str = "response", offset=None,
-                se_fit: bool = False):
+                se_fit: bool = False, mesh=None):
         """eta = X·beta (+ offset); type="response" applies the inverse link.
 
         With ``se_fit`` returns ``(fit, se)``: link-scale se_i =
         sqrt(x_i' V x_i); response-scale multiplies by |dmu/deta| (the delta
-        method, matching R's ``predict.glm(se.fit=TRUE)``)."""
+        method, matching R's ``predict.glm(se.fit=TRUE)``).
+
+        ``mesh``: score over a device mesh as one row-sharded SPMD pass
+        (models/scoring.py: X·β + inverse link + quadform on device — the
+        reference's executor-side path, LM.scala:52-61); None keeps the
+        host path."""
         X = np.asarray(X)
         if X.ndim != 2 or X.shape[1] != self.n_params:
             raise ValueError(
                 f"predict expects (n, {self.n_params}) aligned to xnames; got {X.shape}")
+        if type not in ("link", "response"):
+            raise ValueError(f"type must be 'link' or 'response', got {type!r}")
+        from ..families.links import get_link
+        lnk = get_link(self.link)
+        if mesh is not None:
+            from .scoring import predict_sharded
+            return predict_sharded(
+                X, self.coefficients, mesh=mesh, offset=offset,
+                vcov=self.vcov() if se_fit else None, link=lnk,
+                type=type, se_fit=se_fit)
+        from .lm import _row_quadform
         # aliased (NaN) coefficients contribute nothing (R reduced basis)
         eta = X @ np.nan_to_num(self.coefficients)
         if offset is not None:
             eta = eta + np.asarray(offset)
-        if type not in ("link", "response"):
-            raise ValueError(f"type must be 'link' or 'response', got {type!r}")
-        from ..families.links import get_link
-        from .lm import _row_quadform
-        lnk = get_link(self.link)
         mu = (np.asarray(lnk.inverse(jnp.asarray(eta)))
               if type == "response" else None)
         fit = eta if type == "link" else mu

@@ -152,16 +152,29 @@ class LMModel:
         """X·beta. Accepts an (n,p) array aligned to ``xnames``; the formula
         front-end (api.py) handles model-matrix/column matching first.
         With ``se_fit`` returns ``(fit, se)`` where se_i = sqrt(x_i' V x_i)
-        (R's ``predict.lm(se.fit=TRUE)``)."""
+        (R's ``predict.lm(se.fit=TRUE)``).
+
+        ``mesh``: score over a device mesh as one row-sharded SPMD pass
+        (models/scoring.py — the reference's executor-side
+        ``predictMultiple``, LM.scala:52-61), including the se.fit
+        quadform on device.  None keeps the single-device path."""
         X = np.asarray(X)
         if X.ndim != 2 or X.shape[1] != self.n_params:
             raise ValueError(
                 f"predict expects (n, {self.n_params}) design matrix aligned to "
                 f"xnames={list(self.xnames)}; got {X.shape}")
+        if mesh is not None:
+            from .scoring import predict_sharded
+            return predict_sharded(
+                X, self.coefficients, mesh=mesh,
+                vcov=self.vcov() if se_fit else None, se_fit=se_fit)
         if se_fit:
-            return self.predict(X, mesh=mesh), _row_quadform(X, self.vcov())
-        if not np.issubdtype(X.dtype, np.floating):
-            X = X.astype(np.float64)
+            return self.predict(X), _row_quadform(X, self.vcov())
+        from ..config import x64_enabled
+        if not np.issubdtype(X.dtype, np.floating) or x64_enabled():
+            # f64 whenever x64 allows it — the same precision contract as
+            # the GLM host path (numpy f64) and the sharded scorer
+            X = X.astype(np.float64, copy=False)
         # jnp.asarray canonicalizes per the x64 setting without the
         # explicit-dtype truncation warning; beta then matches X's device dtype
         Xj = jnp.asarray(X)

@@ -1,0 +1,85 @@
+"""Distributed scoring (models/scoring.py) — VERDICT r2 missing #1.
+
+The reference scores on the cluster (predictMultiple, LM.scala:52-61) and
+tests 1-vs-4-partition equivalence (lmPredict$Test.scala:11-35); here the
+same contract is 1-vs-8-device: the sharded SPMD pass must reproduce the
+host predict bit-for-bit-ish (f64 on the CPU x64 mesh) including response
+scale, offsets, se.fit, and aliased (NaN) coefficients.
+"""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+
+
+@pytest.fixture
+def lm_model(rng):
+    X = np.column_stack([np.ones(4000), rng.standard_normal((4000, 5))])
+    y = X @ rng.standard_normal(6) + 0.3 * rng.standard_normal(4000)
+    return sg.lm_fit(X, y), X
+
+
+def test_lm_predict_sharded_matches_host(lm_model, mesh8, mesh1, rng):
+    m, _ = lm_model
+    Xn = np.column_stack([np.ones(1003), rng.standard_normal((1003, 5))])
+    host = m.predict(Xn)
+    np.testing.assert_allclose(m.predict(Xn, mesh=mesh8), host,
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(m.predict(Xn, mesh=mesh1), host,
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_lm_predict_sharded_se_fit(lm_model, mesh8, rng):
+    m, _ = lm_model
+    Xn = np.column_stack([np.ones(997), rng.standard_normal((997, 5))])
+    fit_h, se_h = m.predict(Xn, se_fit=True)
+    fit_d, se_d = m.predict(Xn, mesh=mesh8, se_fit=True)
+    np.testing.assert_allclose(fit_d, fit_h, rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(se_d, se_h, rtol=1e-9, atol=1e-12)
+
+
+def test_glm_predict_sharded_matches_host(mesh8, rng):
+    X = np.column_stack([np.ones(3000), rng.standard_normal((3000, 4))])
+    bt = rng.standard_normal(5) / 3
+    y = rng.poisson(np.exp(np.clip(X @ bt, -4, 4))).astype(np.float64)
+    off = rng.uniform(0, 0.5, 3000)
+    m = sg.glm_fit(X, y, family="poisson", offset=off)
+    Xn = np.column_stack([np.ones(1001), rng.standard_normal((1001, 4))])
+    offn = rng.uniform(0, 0.5, 1001)
+    for type_ in ("link", "response"):
+        host = m.predict(Xn, type=type_, offset=offn)
+        dev = m.predict(Xn, type=type_, offset=offn, mesh=mesh8)
+        np.testing.assert_allclose(dev, host, rtol=1e-12, atol=1e-12)
+    fit_h, se_h = m.predict(Xn, type="response", offset=offn, se_fit=True)
+    fit_d, se_d = m.predict(Xn, type="response", offset=offn,
+                            mesh=mesh8, se_fit=True)
+    np.testing.assert_allclose(fit_d, fit_h, rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(se_d, se_h, rtol=1e-9, atol=1e-12)
+
+
+def test_sharded_predict_aliased_nan_coefficients(mesh8, rng):
+    """Aliased models carry NaN coefficients and NaN covariance rows; the
+    sharded path must reproduce R's reduced-basis prediction (NaNs as
+    zeros), not propagate NaN through the matvec."""
+    Xb = np.column_stack([np.ones(2000), rng.standard_normal((2000, 3))])
+    X = np.column_stack([Xb, Xb[:, 1]])          # exact duplicate column
+    y = Xb @ rng.standard_normal(4) + 0.1 * rng.standard_normal(2000)
+    m = sg.lm_fit(X, y, singular="drop")
+    assert np.isnan(m.coefficients).any()
+    host = m.predict(X)
+    fit_d, se_d = m.predict(X, mesh=mesh8, se_fit=True)
+    np.testing.assert_allclose(fit_d, host, rtol=1e-12, atol=1e-12)
+    assert np.all(np.isfinite(se_d))
+
+
+def test_api_predict_through_mesh(mesh8, rng):
+    """The formula front-end forwards mesh= to the sharded scorer."""
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, 2000)]
+    x = rng.standard_normal(2000)
+    y = 1.0 + 0.5 * x + (g == "b") * 0.7 + 0.2 * rng.standard_normal(2000)
+    m = sg.lm("y ~ x + g", {"y": y, "x": x, "g": g})
+    new = {"x": x[:500], "g": g[:500]}
+    host = sg.predict(m, new)
+    np.testing.assert_allclose(sg.predict(m, new, mesh=mesh8), host,
+                               rtol=1e-12, atol=1e-12)
