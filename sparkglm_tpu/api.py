@@ -156,6 +156,7 @@ def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
         raise ValueError(
             "offset() terms are not supported in lm() (linear models have "
             "no offset; absorb it by regressing y - offset)")
+    weights_arg = weights
     if isinstance(weights, str):
         weights = cols[weights]  # column name, post-NA-omit (same as glm)
     elif weights is not None:
@@ -165,7 +166,10 @@ def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
         has_intercept=f.intercept, mesh=mesh, singular=singular,
         engine=engine, config=config)
     import dataclasses
-    return dataclasses.replace(model, formula=str(f), terms=terms)
+    return dataclasses.replace(
+        model, formula=str(f), terms=terms,
+        weights_col=weights_arg if isinstance(weights_arg, str) else None,
+        has_weights=weights_arg is not None)
 
 
 def glm(formula: str, data, *, family="binomial", link=None, weights=None,
@@ -180,6 +184,7 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
                                          dtype=np.dtype(config.dtype),
                                          extra_cols=(weights, offset, m))
 
+    weights_arg, m_arg = weights, m  # pre-resolution, for the model record
     yname = f.response
     if f.response2 is not None:
         # cbind(successes, failures): y is success counts out of
@@ -205,7 +210,12 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
     import dataclasses
     return dataclasses.replace(
         model, formula=str(f), terms=terms,
-        offset_col=_offset_col_value(f, offset))
+        offset_col=_offset_col_value(f, offset),
+        weights_col=weights_arg if isinstance(weights_arg, str) else None,
+        m_col=m_arg if isinstance(m_arg, str) else None,
+        has_weights=weights_arg is not None,
+        # cbind() group sizes travel with the formula itself, not m=
+        has_m=m_arg is not None)
 
 
 def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
@@ -358,7 +368,8 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
     import dataclasses
     return dataclasses.replace(
         model, formula=str(f), terms=terms,
-        offset_col=_offset_col_value(f, offset))
+        offset_col=_offset_col_value(f, offset),
+        weights_col=weights, has_weights=weights is not None)
 
 
 def lm_from_csv(formula: str, path: str, *, weights=None,
@@ -392,7 +403,28 @@ def lm_from_csv(formula: str, path: str, *, weights=None,
         source, xnames=terms.xnames, yname=f.response,
         has_intercept=f.intercept, mesh=mesh, config=config)
     import dataclasses
-    return dataclasses.replace(model, formula=str(f), terms=terms)
+    return dataclasses.replace(model, formula=str(f), terms=terms,
+                               weights_col=weights,
+                               has_weights=weights is not None)
+
+
+def _carry_fit_arg(model, key: str, current, verb: str):
+    """R re-evaluates the original call in its refitting verbs (update,
+    drop1, profile): a by-NAME weights/m column recorded on the model
+    (weights_col/m_col, like offset_col) is recovered automatically; an
+    array-valued one cannot be, so the verb refuses rather than silently
+    refitting without it (ADVICE r2)."""
+    if current is not None:
+        return current
+    col = getattr(model, f"{key}_col", None)
+    if col is not None:
+        return col
+    if getattr(model, f"has_{key}", False):
+        raise ValueError(
+            f"model was fit with an array {key}=; pass {key}= to {verb} "
+            f"(or fit with a named {key} column so it travels with the "
+            "model)")
+    return None
 
 
 def update(model, formula: str = "~ .", data=None, **overrides):
@@ -401,9 +433,13 @@ def update(model, formula: str = "~ .", data=None, **overrides):
     ``.`` stands for the corresponding part of the original formula:
     ``"~ . + z"`` adds a term, ``"~ . - x"`` removes one, ``"y2 ~ ."``
     swaps the response, ``"~ . - 1"`` drops the intercept.  The refit
-    reuses the model's family/link/tol (a glm.nb model re-estimates theta
-    through :func:`glm_nb`, as R's update does); pass fit arguments like
-    ``weights=`` through ``overrides`` — models do not retain them.
+    re-evaluates the original call the way R does: family/link/tol and
+    by-NAME weights/offset/m columns travel with the model (a glm.nb
+    model re-estimates theta through :func:`glm_nb`); array-valued
+    weights/offset/m cannot be recovered from new data, so they must be
+    re-passed through ``overrides`` — update refuses to silently drop
+    them.  Other fit arguments (engine=, config=, ...) pass through
+    ``overrides`` too.
     """
     import re as _re
 
@@ -450,6 +486,13 @@ def update(model, formula: str = "~ .", data=None, **overrides):
             "model was fit with an array offset; pass offset= to update "
             "(or fit with a named offset column)")
     offsets.extend(o for o in added_offsets if o not in offsets)
+
+    # R's update() re-evaluates the original call INCLUDING weights= and
+    # m= — a weighted fit must not silently refit unweighted (ADVICE r2)
+    for key in ("weights", "m"):
+        v = _carry_fit_arg(model, key, overrides.get(key), "update")
+        if v is not None:
+            overrides[key] = v
 
     leftover = _re.sub(rf"([+-]?)\s*({TERM_RE})", "", rhs)
     if _re.sub(r"[\s+]", "", leftover):
@@ -531,7 +574,9 @@ def glm_nb(formula: str, data, *, link: str = "log", weights=None,
     import dataclasses
     return dataclasses.replace(
         model, formula=str(f), terms=terms,
-        offset_col=_offset_col_value(f, offset))
+        offset_col=_offset_col_value(f, offset),
+        weights_col=weights if isinstance(weights, str) else None,
+        has_weights=weights is not None)
 
 
 def confint_profile(model, data, *, level: float = 0.95, which=None,
@@ -553,6 +598,12 @@ def confint_profile(model, data, *, level: float = 0.95, which=None,
             "model was fit from arrays; call "
             "sparkglm_tpu.models.profile.confint_profile(model, X, y, ...) "
             "directly")
+    # stored by-name fit-time weights/m are recovered (or their array
+    # originals refused) exactly like update() — profiling a weighted
+    # model against unweighted constrained refits would silently produce
+    # wrong intervals
+    weights = _carry_fit_arg(model, "weights", weights, "confint_profile")
+    m = _carry_fit_arg(model, "m", m, "confint_profile")
     # a stored by-name fit-time offset must join the NA-omit scan exactly
     # as it did at fit time (its column was in extra_cols then too)
     stored_off = getattr(model, "offset_col", None) if offset is None else None

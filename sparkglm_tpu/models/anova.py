@@ -164,14 +164,16 @@ def _droppable_terms(design) -> list:
 
 
 def drop1(model, data, *, test: str | None = None, weights=None,
-          offset=None, **fit_kw) -> AnovaTable:
+          offset=None, m=None, **fit_kw) -> AnovaTable:
     """R's ``drop1``: refit without each droppable term.
 
     Needs the training ``data`` (models do not retain it).  Reports the
     reduced fits' Deviance and AIC; ``test="Chisq"`` adds the
-    dispersion-scaled LRT and its p-value.  ``weights``/``offset`` and
-    extra fit kwargs are forwarded to the refits (a by-name fit-time
-    offset stored on the model is applied automatically).
+    dispersion-scaled LRT and its p-value.  ``weights``/``offset``/``m``
+    and extra fit kwargs are forwarded to the refits; by-name fit-time
+    offset/weights/m columns stored on the model are applied
+    automatically, and array-valued ones must be re-passed (refusing
+    beats silently deflating every LRT).
     """
     from .. import api
     from ..data.frame import as_columns
@@ -182,6 +184,8 @@ def drop1(model, data, *, test: str | None = None, weights=None,
     if test not in (None, "Chisq"):
         raise ValueError(f"test must be None or 'Chisq', got {test!r}")
     is_lm = _is_lm(model)
+    weights = api._carry_fit_arg(model, "weights", weights, "drop1")
+    m = api._carry_fit_arg(model, "m", m, "drop1")
     if offset is None:
         offset = getattr(model, "offset_col", None)
         if isinstance(offset, (tuple, list)):
@@ -203,7 +207,7 @@ def drop1(model, data, *, test: str | None = None, weights=None,
         if is_lm:
             return api.lm(formula, data, weights=weights, **fit_kw)
         return api.glm(formula, data, family=model.family, link=model.link,
-                       weights=weights, offset=offset, tol=model.tol,
+                       weights=weights, offset=offset, m=m, tol=model.tol,
                        **fit_kw)
 
     all_terms = [":".join(t) for t in model.terms.design]

@@ -371,6 +371,15 @@ class GLMModel:
     # front-end; api.predict re-extracts it from new data (R's predict.glm
     # uses the stored model-frame offset)
     offset_col: str | None = None
+    # by-name weights / group-size columns, recorded like offset_col so
+    # update() re-evaluates the original call including weights= (R
+    # semantics, ADVICE r2); has_weights/has_m flag array-valued arguments
+    # that cannot be recovered from new data (update then refuses rather
+    # than silently refitting unweighted)
+    weights_col: str | None = None
+    m_col: str | None = None
+    has_weights: bool = False
+    has_m: bool = False
 
     def predict(self, X, type: str = "response", offset=None,
                 se_fit: bool = False, mesh=None):
@@ -444,7 +453,11 @@ class GLMModel:
         from scipy import stats
         z = np.abs(self.z_values())
         if self.dispersion_estimated():
-            return 2.0 * stats.t.sf(z, max(self.df_residual, 1))
+            # a saturated fit (df_residual == 0) has no t-reference:
+            # R's summary.glm prints NaN, not df=1 p-values (ADVICE r2)
+            if self.df_residual <= 0:
+                return np.full_like(z, np.nan)
+            return 2.0 * stats.t.sf(z, self.df_residual)
         return 2.0 * stats.norm.sf(z)
 
     def vcov(self) -> np.ndarray:

@@ -146,6 +146,10 @@ class LMModel:
     # formula front-end metadata (None for array-level fits)
     formula: str | None = None
     terms: object | None = None
+    # by-name weights column / array-weights flag, recorded so update()
+    # re-evaluates the original call including weights= (ADVICE r2)
+    weights_col: str | None = None
+    has_weights: bool = False
 
     # -- scoring (LM.scala:29-61) --------------------------------------------
     def predict(self, X, mesh=None, se_fit: bool = False):

@@ -32,7 +32,11 @@ def _cutoff(model, level: float) -> float:
     q = 0.5 + level / 2.0
     if not model.dispersion_estimated():  # fixed-dispersion family
         return float(scipy.stats.norm.ppf(q))
-    return float(scipy.stats.t.ppf(q, max(model.df_residual, 1)))
+    if model.df_residual <= 0:
+        # saturated fit: no t-reference exists; R's confint profile is
+        # NaN/undefined here, not a df=1 interval (ADVICE r2)
+        return float("nan")
+    return float(scipy.stats.t.ppf(q, model.df_residual))
 
 
 def confint_profile(model, X, y, *, level: float = 0.95, which=None,
@@ -61,6 +65,12 @@ def confint_profile(model, X, y, *, level: float = 0.95, which=None,
     se = np.asarray(model.std_errors, np.float64)
     disp = float(model.dispersion)
     zstar = _cutoff(model, level)
+    if not np.isfinite(zstar):
+        warnings.warn(
+            "profile intervals are undefined for a saturated fit "
+            "(df_residual == 0 with estimated dispersion); returning NaN",
+            stacklevel=2)
+        return np.full((p, 2), np.nan)
     dev_hat = float(model.deviance)
 
     idx = range(p) if which is None else [
@@ -96,7 +106,13 @@ def confint_profile(model, X, y, *, level: float = 0.95, which=None,
                 v = beta[j] + side * k * step
                 try:
                     dd = max(constrained_dev(j, v) - dev_hat, 0.0)
-                except Exception:  # noqa: BLE001
+                except (np.linalg.LinAlgError, FloatingPointError,
+                        ValueError):
+                    # the failure modes an extreme constraint legitimately
+                    # produces: singular constrained Gramian, diverged
+                    # IRLS, response-domain violation.  Anything else
+                    # (OOM, backend faults, bad kwargs) propagates instead
+                    # of silently becoming a NaN endpoint (ADVICE r2).
                     if k == 1:
                         # one quarter-cutoff SE from the estimate is not an
                         # extreme constraint — a failure here is a real

@@ -15,7 +15,11 @@ import json
 
 import numpy as np
 
-_FORMAT_VERSION = 1
+# v2: models record weights/m provenance (weights_col/m_col/has_weights/
+# has_m) so update()/drop1()/confint_profile can re-evaluate the original
+# call or refuse.  v1 models predate the flags — their absence is
+# indistinguishable from "fit unweighted", so loading one warns.
+_FORMAT_VERSION = 2
 
 
 def _split(model) -> tuple[dict, dict]:
@@ -49,8 +53,15 @@ def load_model(path: str):
         meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
         arrays = {k: z[k] for k in z.files if k != "__meta__"}
     cls_name = meta.pop("__class__")
-    meta.pop("__format__", None)
+    fmt = meta.pop("__format__", 1)
     cls = {"LMModel": LMModel, "GLMModel": GLMModel}[cls_name]
+    if fmt < 2:
+        import warnings
+        warnings.warn(
+            "model was saved before weights/m provenance was recorded "
+            "(format v1): update()/drop1()/confint_profile cannot detect a "
+            "fit-time weights= or m= argument on it — re-pass those "
+            "explicitly if the original fit used them", stacklevel=2)
     terms_meta = meta.pop("terms", None)
     if terms_meta is not None:
         from ..data.model_matrix import Terms

@@ -99,6 +99,29 @@ def _materialize(chunk):
     return chunk() if callable(chunk) else chunk
 
 
+def _fingerprint(Xc, yc, wc=None, oc=None) -> tuple:
+    """Cheap per-chunk identity: shape plus corner samples of EVERY per-row
+    array (chunks can differ only in weights or offsets — bootstrap
+    replication weights, per-chunk exposures).  Catches a generator that
+    yields the same chunks in a DIFFERENT order (or changed content) on a
+    later pass — which the cached-prefix skip would otherwise silently
+    double-count (ADVICE r2).  Scalar indexing only: costs nothing even on
+    multi-GB chunks."""
+    Xc = np.asarray(Xc)
+    n = int(Xc.shape[0])
+    if n == 0:
+        return (0, int(Xc.shape[1]))
+
+    def corners(v):
+        if v is None:
+            return (None, None)
+        v = np.ravel(np.asarray(v))
+        return (float(v[0]), float(v[-1]))
+
+    return (n, int(Xc.shape[1]), float(Xc[0, 0]), float(Xc[-1, -1]),
+            *corners(yc), *corners(wc), *corners(oc))
+
+
 def _iter_chunks(chunks) -> Iterator:
     for c in chunks():
         yield _materialize(c)
@@ -222,6 +245,9 @@ class _ChunkCache:
                 f"cache must be 'auto', 'device' or 'none', got {mode!r}")
         self.mode = mode
         self.entries: list = []
+        # per-entry host fingerprints: the cached-prefix skip verifies a
+        # later pass yields the SAME chunks in the SAME order (ADVICE r2)
+        self.fingerprints: list = []
         self.bytes = 0
         self.open = mode != "none"
         self.complete = False  # set once a full pass cached every chunk
@@ -232,7 +258,7 @@ class _ChunkCache:
         else:
             self.budget = _device_cache_budget(mesh) if mode == "auto" else 0
 
-    def offer(self, dchunk: tuple, n_true: int) -> None:
+    def offer(self, dchunk: tuple, n_true: int, fingerprint=None) -> None:
         """Pin one freshly-transferred chunk if the budget allows."""
         if not self.open:
             return
@@ -241,6 +267,7 @@ class _ChunkCache:
             self.open = False  # keep the cached prefix contiguous
             return
         self.entries.append((*dchunk, n_true))
+        self.fingerprints.append(fingerprint)
         self.bytes += nbytes
 
 
@@ -461,12 +488,26 @@ def glm_fit_streaming(
             return  # every chunk is in HBM; skip the host source entirely
         it = chunks()
         for k in range(len(ccache.entries)):  # skip the cached prefix
-            if next(it, None) is None:
+            raw = next(it, None)
+            if raw is None:
                 raise ValueError(
                     f"source yielded only {k} chunks on a later pass but "
                     f"{len(ccache.entries)} were cached from the first pass "
                     "— streaming sources must yield the same chunks every "
                     "invocation")
+            # verify order/content stability where it costs nothing: a
+            # non-thunk chunk's arrays already exist, so corner samples are
+            # free.  Thunks stay unverified (materializing one would pay
+            # the parse the skip exists to avoid) — documented contract.
+            fp0 = ccache.fingerprints[k]
+            if not callable(raw) and fp0 is not None:
+                Xc, yc, wc, oc = raw
+                if _fingerprint(Xc, yc, wc, oc) != fp0:
+                    raise ValueError(
+                        f"source yielded a different chunk at position {k} "
+                        "on a later pass (shape or corner values changed) — "
+                        "the cached-prefix skip requires the same chunks in "
+                        "the same order every invocation")
         for raw in it:
             Xc, yc, wc, oc = _materialize(raw)
             if dtype is None:
@@ -491,7 +532,8 @@ def glm_fit_streaming(
                 if oc is not None and np.any(np.asarray(oc) != 0):
                     saw_offset = True
             dchunk = _put_chunk(Xc, yc, wc, oc, mesh, dtype)
-            ccache.offer(dchunk, int(Xc.shape[0]))
+            ccache.offer(dchunk, int(Xc.shape[0]),
+                         fingerprint=_fingerprint(Xc, yc, wc, oc))
             yield (*dchunk, int(Xc.shape[0]))
 
     def full_pass(beta, first):
@@ -580,6 +622,7 @@ def glm_fit_streaming(
     # fit (which builds its own cache under the same budget) don't run with
     # the whole dataset still occupying HBM
     ccache.entries.clear()
+    ccache.fingerprints.clear()
     ccache.bytes = 0
     ccache.open = False
     if not converged and not _null_model:

@@ -344,3 +344,43 @@ def test_streaming_validation(mesh1, rng):
     with pytest.raises(ValueError, match="criterion"):
         sg.glm_fit_streaming((X, rng.normal(size=100)), criterion="bogus",
                              mesh=mesh1)
+
+
+def test_cache_prefix_skip_detects_reordered_chunks(rng):
+    """ADVICE r2: a generator that yields the same chunks in a DIFFERENT
+    order on a later pass must error, not silently double-count the cached
+    prefix.  Budget admits only the first chunk, so passes 2+ skip one and
+    re-read the rest."""
+    from sparkglm_tpu.models.streaming import glm_fit_streaming
+
+    n, p = 600, 4
+    X = np.column_stack([np.ones(n), rng.standard_normal((n, p - 1))])
+    y = (rng.random(n) < 0.5).astype(np.float64)
+    chunks = [(X[i:i + 200], y[i:i + 200], None, None)
+              for i in range(0, n, 200)]
+    calls = {"k": 0}
+
+    def source():
+        calls["k"] += 1
+        order = [0, 1, 2] if calls["k"] == 1 else [1, 0, 2]  # prefix swapped
+        for i in order:
+            yield chunks[i]
+
+    # budget sized to admit exactly one device chunk (X + y + w + off)
+    one_chunk = X[0:200].nbytes + 3 * y[0:200].nbytes
+    with pytest.raises(ValueError, match="different chunk at position"):
+        glm_fit_streaming(source, family="binomial",
+                          cache_budget_bytes=one_chunk + 1000)
+
+    # the same budget with a STABLE order fits fine (the check is not
+    # tripping on correct sources)
+    calls["k"] = 0
+
+    def stable():
+        calls["k"] += 1
+        for c in chunks:
+            yield c
+
+    m = glm_fit_streaming(stable, family="binomial",
+                          cache_budget_bytes=one_chunk + 1000)
+    assert m.converged

@@ -110,3 +110,55 @@ def test_update_validation(d):
                     np.arange(10.) % 2, family="binomial")
     with pytest.raises(ValueError, match="formula-fitted"):
         sg.update(mm, "~ .", d)
+
+
+def test_update_carries_named_weights_and_m(d, rng):
+    """ADVICE r2: R's update() re-evaluates the original call including
+    weights= — a by-NAME weights column travels with the model."""
+    d = dict(d)
+    d["w"] = rng.uniform(0.5, 2.0, len(d["x"]))
+    m = sg.glm("y ~ x", d, family="poisson", weights="w")
+    assert m.weights_col == "w" and m.has_weights
+    m2 = sg.update(m, "~ . + z", d)
+    direct = sg.glm("y ~ x + z", d, family="poisson", weights="w")
+    np.testing.assert_array_equal(m2.coefficients, direct.coefficients)
+    # grouped binomial with by-name m carries too
+    d["succ"] = rng.integers(0, 5, len(d["x"])).astype(float)
+    d["tot"] = d["succ"] + rng.integers(1, 5, len(d["x"]))
+    mb = sg.glm("succ ~ x", d, family="binomial", m="tot")
+    assert mb.m_col == "tot"
+    mb2 = sg.update(mb, "~ . + z", d)
+    directb = sg.glm("succ ~ x + z", d, family="binomial", m="tot")
+    np.testing.assert_array_equal(mb2.coefficients, directb.coefficients)
+    # lm weights carry
+    ml = sg.lm("y ~ x", d, weights="w")
+    ml2 = sg.update(ml, "~ . + z", d)
+    directl = sg.lm("y ~ x + z", d, weights="w")
+    np.testing.assert_array_equal(ml2.coefficients, directl.coefficients)
+
+
+def test_update_refuses_dropped_array_weights(d, rng):
+    """An array weights= cannot be recovered from new data: update must
+    refuse rather than silently refit unweighted (ADVICE r2)."""
+    w = rng.uniform(0.5, 2.0, len(d["x"]))
+    m = sg.glm("y ~ x", d, family="poisson", weights=w)
+    assert m.has_weights and m.weights_col is None
+    with pytest.raises(ValueError, match="array weights"):
+        sg.update(m, "~ . + z", d)
+    # re-passing restores the refit
+    m2 = sg.update(m, "~ . + z", d, weights=w)
+    direct = sg.glm("y ~ x + z", d, family="poisson", weights=w)
+    np.testing.assert_array_equal(m2.coefficients, direct.coefficients)
+
+
+def test_saturated_fit_p_values_nan(rng):
+    """df_residual == 0 with estimated dispersion: R prints NaN, not df=1
+    p-values (ADVICE r2)."""
+    X = np.column_stack([np.ones(3), np.array([1.0, 2.0, 4.0]),
+                         np.array([1.0, 4.0, 16.0])])
+    y = np.array([1.0, 2.0, 5.0])
+    with np.testing.suppress_warnings() as sup:
+        sup.filter(UserWarning)
+        m = sg.glm_fit(X, y, family="gaussian", link="identity")
+    assert m.df_residual == 0
+    assert np.all(np.isnan(m.p_values()))
