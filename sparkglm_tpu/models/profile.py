@@ -39,9 +39,9 @@ def _cutoff(model, level: float) -> float:
     return float(scipy.stats.t.ppf(q, model.df_residual))
 
 
-def confint_profile(model, X, y, *, level: float = 0.95, which=None,
+def confint_profile(model, X=None, y=None, *, level: float = 0.95, which=None,
                     weights=None, offset=None, m=None, max_steps: int = 30,
-                    mesh=None, **fit_kw) -> np.ndarray:
+                    mesh=None, constrained_dev_fn=None, **fit_kw) -> np.ndarray:
     """(p, 2) profile-likelihood interval matrix, rows ordered like
     ``model.xnames`` (NaN rows for aliased or skipped parameters).
 
@@ -51,16 +51,27 @@ def confint_profile(model, X, y, *, level: float = 0.95, which=None,
     by name or index (default: all non-aliased).  For formula-fitted
     models, :func:`sparkglm_tpu.api.confint_profile` rebuilds the design
     from column data first.
+
+    ``constrained_dev_fn(j, val) -> deviance`` replaces the default
+    resident constrained refit — the hook the out-of-core path uses to
+    profile a from-CSV model by STREAMING each constrained fit
+    (api.py::_csv_constrained_dev) instead of materializing the design.
+    With it, ``X``/``y`` are not needed.
     """
     from . import glm as glm_mod
 
     if not 0.0 < level < 1.0:
         raise ValueError(f"level must be in (0, 1), got {level}")
-    X = np.asarray(X)
-    p = X.shape[1]
-    if p != model.n_params:
-        raise ValueError(
-            f"X has {p} columns but the model has {model.n_params}")
+    p = model.n_params
+    if constrained_dev_fn is None:
+        if X is None or y is None:
+            raise ValueError(
+                "pass the training X and y (or a constrained_dev_fn for "
+                "out-of-core models)")
+        X = np.asarray(X)
+        if X.shape[1] != p:
+            raise ValueError(
+                f"X has {X.shape[1]} columns but the model has {p}")
     beta = np.nan_to_num(np.asarray(model.coefficients, np.float64))
     se = np.asarray(model.std_errors, np.float64)
     disp = float(model.dispersion)
@@ -79,20 +90,24 @@ def confint_profile(model, X, y, *, level: float = 0.95, which=None,
     aliased = (np.zeros(p, bool) if getattr(model, "aliased", None) is None
                else np.asarray(model.aliased, bool))
 
-    base_off = (np.zeros(X.shape[0], np.float64) if offset is None
-                else np.asarray(offset, np.float64))
+    if constrained_dev_fn is not None:
+        constrained_dev = constrained_dev_fn
+    else:
+        base_off = (np.zeros(X.shape[0], np.float64) if offset is None
+                    else np.asarray(offset, np.float64))
 
-    fit_kw.setdefault("singular", "error")
+        fit_kw.setdefault("singular", "error")
 
-    def constrained_dev(j: int, val: float) -> float:
-        # aliased (dropped) columns stay out of the refit, as at fit time —
-        # keeping them would make every constrained Gramian singular
-        keep = [k for k in range(p) if k != j and not aliased[k]]
-        sub = glm_mod.fit(
-            X[:, keep], y, family=model.family, link=model.link,
-            weights=weights, offset=base_off + X[:, j] * val, m=m,
-            tol=model.tol, has_intercept=False, mesh=mesh, **fit_kw)
-        return float(sub.deviance)
+        def constrained_dev(j: int, val: float) -> float:
+            # aliased (dropped) columns stay out of the refit, as at fit
+            # time — keeping them would make every constrained Gramian
+            # singular
+            keep = [k for k in range(p) if k != j and not aliased[k]]
+            sub = glm_mod.fit(
+                X[:, keep], y, family=model.family, link=model.link,
+                weights=weights, offset=base_off + X[:, j] * val, m=m,
+                tol=model.tol, has_intercept=False, mesh=mesh, **fit_kw)
+            return float(sub.deviance)
 
     out = np.full((p, 2), np.nan)
     for j in idx:

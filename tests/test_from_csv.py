@@ -153,3 +153,67 @@ def test_from_csv_rejects_array_args(csv_data):
         sg.glm_from_csv("y ~ x", path, weights=np.ones(2000))
     with pytest.raises(KeyError, match="nope"):
         sg.glm_from_csv("y ~ x", path, weights="nope")
+
+
+def test_update_on_from_csv_model(tmp_path, rng):
+    """VERDICT r2 missing #4: update() works on the out-of-core flagship
+    path — a from-CSV model refits by streaming the file again."""
+    import sparkglm_tpu as sg
+    n = 500
+    x = rng.standard_normal(n)
+    z = rng.standard_normal(n)
+    w = rng.uniform(0.5, 2.0, n)
+    y = rng.poisson(np.exp(0.2 + 0.5 * x + 0.2 * z)).astype(float)
+    p = tmp_path / "d.csv"
+    with open(p, "w") as fh:
+        fh.write("y,x,z,w\n")
+        for i in range(n):
+            fh.write(f"{y[i]},{x[i]},{z[i]},{w[i]}\n")
+    m = sg.glm_from_csv("y ~ x", str(p), family="poisson", weights="w",
+                        chunk_bytes=4096)
+    m2 = sg.update(m, "~ . + z", str(p), chunk_bytes=4096)
+    direct = sg.glm("y ~ x + z", {"y": y, "x": x, "z": z, "w": w},
+                    family="poisson", weights="w")
+    np.testing.assert_allclose(m2.coefficients, direct.coefficients,
+                               rtol=1e-6, atol=1e-8)
+    assert m2.weights_col == "w"  # provenance carried through the refit
+
+
+def test_drop1_on_from_csv_model(tmp_path, rng):
+    import sparkglm_tpu as sg
+    from sparkglm_tpu.models.anova import drop1
+    n = 400
+    x = rng.standard_normal(n)
+    z = rng.standard_normal(n)
+    y = rng.poisson(np.exp(0.3 + 0.6 * x)).astype(float)
+    p = tmp_path / "d.csv"
+    with open(p, "w") as fh:
+        fh.write("y,x,z\n")
+        for i in range(n):
+            fh.write(f"{y[i]},{x[i]},{z[i]}\n")
+    m = sg.glm_from_csv("y ~ x + z", str(p), family="poisson",
+                        chunk_bytes=2048)
+    t_csv = drop1(m, str(p), test="Chisq", chunk_bytes=2048)
+    m_res = sg.glm("y ~ x + z", {"y": y, "x": x, "z": z}, family="poisson")
+    t_res = drop1(m_res, {"y": y, "x": x, "z": z}, test="Chisq")
+    assert t_csv.row_names == t_res.row_names
+    for r_csv, r_res in zip(t_csv.rows[1:], t_res.rows[1:]):
+        np.testing.assert_allclose(r_csv[1], r_res[1], rtol=1e-6)  # deviance
+        np.testing.assert_allclose(r_csv[3], r_res[3], rtol=1e-5)  # LRT
+
+
+def test_confint_profile_on_from_csv_model(tmp_path, rng):
+    import sparkglm_tpu as sg
+    n = 300
+    x = rng.standard_normal(n)
+    y = rng.poisson(np.exp(0.4 + 0.5 * x)).astype(float)
+    p = tmp_path / "d.csv"
+    with open(p, "w") as fh:
+        fh.write("y,x\n")
+        for i in range(n):
+            fh.write(f"{y[i]},{x[i]}\n")
+    m = sg.glm_from_csv("y ~ x", str(p), family="poisson", chunk_bytes=2048)
+    ci_csv = sg.confint_profile(m, str(p), chunk_bytes=2048)
+    m_res = sg.glm("y ~ x", {"y": y, "x": x}, family="poisson")
+    ci_res = sg.confint_profile(m_res, {"y": y, "x": x})
+    np.testing.assert_allclose(ci_csv, ci_res, rtol=1e-5, atol=1e-7)
