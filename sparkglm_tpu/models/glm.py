@@ -61,7 +61,8 @@ def _sanitize(x, valid, fill=0.0):
 
 
 @partial(jax.jit, static_argnames=("family", "link", "criterion", "refine_steps",
-                                   "trace", "precision", "solver", "mesh"))
+                                   "trace", "precision", "solver", "mesh",
+                                   "warm"))
 def _irls_kernel(
     X, y, wt, offset,
     tol, max_iter, jitter,
@@ -72,12 +73,21 @@ def _irls_kernel(
     precision=None,
     solver: str = "chol",
     mesh=None,
+    beta0=None,
+    warm: bool = False,
+    it_base=None,
 ):
     """Full IRLS to convergence in one compiled while_loop.
 
     Args mirror the reference fit surface: y (response; proportions for
     binomial-with-m), wt (prior weights * group sizes, 0 on padding rows),
     offset (GLM.scala:254-315).
+
+    ``warm`` starts from ``beta0`` instead of the family init — the
+    checkpoint/resume and segmented-checkpointing entry (fit's
+    ``beta0``/``on_iteration``): the warm state's deviance belongs to
+    beta0, so the first iteration's |ddev| continues the interrupted
+    run's convergence sequence exactly.
     """
     acc = X.dtype if X.dtype == jnp.float64 else jnp.float32
     p = X.shape[1]
@@ -86,13 +96,21 @@ def _irls_kernel(
     def dev_of(mu):
         return jnp.sum(_sanitize(family.dev_resids(y, mu, wt), valid))
 
-    mu0 = jnp.where(valid, family.init_mu(y, jnp.maximum(wt, 1e-30)), 1.0)
-    eta0 = link.link(mu0)
+    if warm:
+        # NaN entries (aliased coefficients from a checkpointed drop-path
+        # fit) contribute nothing, as in predict's reduced basis
+        beta_init = jnp.nan_to_num(beta0).astype(X.dtype)
+        eta0 = (X @ beta_init + offset).astype(X.dtype)
+        mu0 = jnp.where(valid, link.inverse(eta0), 1.0)
+    else:
+        beta_init = jnp.zeros((p,), X.dtype)
+        mu0 = jnp.where(valid, family.init_mu(y, jnp.maximum(wt, 1e-30)), 1.0)
+        eta0 = link.link(mu0)
     dev0 = dev_of(mu0)
 
     state0 = dict(
         it=jnp.zeros((), jnp.int32),
-        beta=jnp.zeros((p,), X.dtype),
+        beta=beta_init,
         eta=eta0.astype(X.dtype),
         mu=mu0.astype(X.dtype),
         dev=dev0.astype(acc),
@@ -148,9 +166,11 @@ def _irls_kernel(
         mu_new = jnp.where(valid, link.inverse(eta_new), 1.0).astype(X.dtype)  # ref: muCreate :334-355
         dev_new = dev_of(mu_new)
         if trace:
-            # the reference's verbose "iter\tddev" line (GLM.scala:304,461)
+            # the reference's verbose "iter\tddev" line (GLM.scala:304,461);
+            # it_base keeps numbering monotone across checkpoint segments
             jax.debug.print("iter {i}\tdeviance {d}\tddev {dd}",
-                            i=s["it"] + 1, d=dev_new,
+                            i=s["it"] + 1 + (0 if it_base is None else it_base),
+                            d=dev_new,
                             dd=jnp.abs(dev_new - s["dev"]))
         return dict(
             it=s["it"] + 1,
@@ -186,6 +206,51 @@ def _irls_kernel(
     return dict(beta=s["beta"], cov_inv=cov_final, dev=s["dev"],
                 eta=s["eta"], iters=s["it"], converged=converged,
                 singular=s["singular"], pivot=s["pivot"], XtWX0=s["XtWX0"])
+
+
+def _segmented_irls(run_kernel, *, p, dtype, max_iter: int,
+                    beta0=None, on_iteration=None, checkpoint_every: int = 0):
+    """Drive :func:`_irls_kernel` in host-visible segments.
+
+    The compiled while_loop is the fast path, but it is opaque: a
+    multi-hour resident/multi-host fit that loses a process loses every
+    iteration (the reference leans on Spark lineage here, SURVEY.md §2.4 —
+    we make checkpointing EXPLICIT instead).  ``checkpoint_every`` runs at
+    most that many iterations per compiled call, then surfaces
+    ``(total_iters, beta, dev)`` to ``on_iteration`` — persist beta there.
+    A later call with ``beta0=`` resumes from the checkpoint: the warm
+    kernel's deviance sequence continues exactly where the lost run
+    stopped, so a crash costs the iterations since the last checkpoint,
+    not the fit.  All processes of a multi-host fit run the same segments
+    in lockstep (the kernel's collectives are inside the segment).
+
+    ``run_kernel(seg_iters, beta_arr, warm, it_base) -> out`` wraps the
+    engine call (``it_base`` keeps verbose iteration numbering monotone).
+    """
+    import jax.numpy as _jnp
+    seg = int(checkpoint_every) if checkpoint_every else int(max_iter)
+    seg = max(1, seg)
+    warm = beta0 is not None
+    b = (_jnp.zeros((p,), dtype) if beta0 is None
+         else _jnp.asarray(np.nan_to_num(np.asarray(beta0, np.float64)), dtype))
+    iters_total = 0
+    while True:
+        seg_iters = min(seg, int(max_iter) - iters_total)
+        out = run_kernel(seg_iters, b, warm, iters_total)
+        it = int(np.asarray(out["iters"]))
+        iters_total += it
+        warm = True
+        b = out["beta"]
+        if on_iteration is not None:
+            on_iteration(iters_total,
+                         np.asarray(out["beta"], np.float64).copy(),
+                         float(np.asarray(out["dev"])))
+        if (bool(np.asarray(out["converged"]))
+                or bool(np.asarray(out["singular"]))
+                or iters_total >= int(max_iter) or it == 0):
+            break
+    out["iters"] = np.asarray(iters_total, np.int32)
+    return out
 
 
 @partial(jax.jit, static_argnames=("family", "link", "mesh", "steps"))
@@ -565,6 +630,7 @@ def _finalize_model(
 def _fit_global(
     X, y, weights, offset, fam, lnk, tol, max_iter, criterion,
     xnames, yname, has_intercept, mesh, verbose, config,
+    beta0=None, on_iteration=None, checkpoint_every: int = 0,
 ) -> GLMModel:
     """Multi-process fit on already-global row-sharded jax.Arrays.
 
@@ -613,14 +679,29 @@ def _fit_global(
     dev_dtype = dtype if dtype == jnp.float64 else jnp.float32
     tol_run = effective_tol(tol, criterion, dev_dtype)
     tol_dev = jnp.asarray(tol_run, dev_dtype)
-    out = _irls_kernel(
-        X, y, wd, od, tol_dev,
-        jnp.asarray(max_iter, jnp.int32),
-        jnp.asarray(config.jitter, dtype),
-        family=fam, link=lnk, criterion=criterion,
-        refine_steps=config.refine_steps, trace=verbose,
-        precision=config.matmul_precision,
-    )
+
+    def run_kernel(seg_iters, beta_arr, warm, it_base=0):
+        return _irls_kernel(
+            X, y, wd, od, tol_dev,
+            jnp.asarray(seg_iters, jnp.int32),
+            jnp.asarray(config.jitter, dtype),
+            family=fam, link=lnk, criterion=criterion,
+            refine_steps=config.refine_steps, trace=verbose,
+            precision=config.matmul_precision,
+            beta0=jnp.asarray(np.asarray(beta_arr), dtype), warm=warm,
+            it_base=jnp.asarray(it_base, jnp.int32),
+        )
+
+    if beta0 is not None or on_iteration is not None or checkpoint_every:
+        # segmented checkpointing: the multi-host recovery story — every
+        # process persists beta in its on_iteration and a restarted job
+        # resumes from the last checkpoint (_segmented_irls docstring)
+        out = _segmented_irls(run_kernel, p=p, dtype=dtype,
+                              max_iter=max_iter, beta0=beta0,
+                              on_iteration=on_iteration,
+                              checkpoint_every=checkpoint_every)
+    else:
+        out = run_kernel(max_iter, np.zeros((p,), dtype), False)
     if bool(np.asarray(out["singular"])):
         raise np.linalg.LinAlgError(
             "singular weighted Gramian during IRLS (multi-process fit has "
@@ -714,9 +795,21 @@ def fit(
     engine: str = "auto",
     singular: str = "error",
     verbose: bool = False,
+    beta0=None,
+    on_iteration=None,
+    checkpoint_every: int = 0,
     config: NumericConfig = DEFAULT,
 ) -> GLMModel:
     """Fit a GLM by IRLS on the device mesh.
+
+    Checkpoint/resume (the explicit replacement for Spark lineage
+    recovery, SURVEY.md §2.4): ``checkpoint_every=k`` surfaces
+    ``on_iteration(total_iters, beta, deviance)`` every k iterations
+    (persist beta there); ``beta0=`` warm-starts a fresh call from the
+    last checkpoint, continuing the interrupted convergence sequence —
+    a lost process costs the iterations since the last checkpoint, not
+    the fit.  Works on the multi-host global-array path too (all
+    processes run the same segments in lockstep).
 
     Keyword surface replaces the reference's 16 ``fit`` overloads over
     {offset, m, tol, verbose} (GLM.scala:597-995).  Convergence defaults
@@ -778,7 +871,9 @@ def fit(
                           "global-array fits and is ignored", stacklevel=2)
         return _fit_global(X, y, weights, offset, fam, lnk, tol, max_iter,
                            criterion, xnames, yname, has_intercept, mesh,
-                           verbose, config)
+                           verbose, config, beta0=beta0,
+                           on_iteration=on_iteration,
+                           checkpoint_every=checkpoint_every)
     X = np.asarray(X)
     y = np.asarray(y)
     if y.ndim == 2:
@@ -838,6 +933,8 @@ def fit(
     mmp = resolve_matmul_precision(config, n, p, on_tpu)
     if mmp != config.matmul_precision:
         config = dataclasses.replace(config, matmul_precision=mmp)
+    checkpointing = (beta0 is not None or on_iteration is not None
+                     or checkpoint_every)
     if engine == "auto":
         # Measured r03 on a v5e (benchmarks/HOTLOOP_r03.md,
         # proto_fused_r03.json): the single-HBM-pass Pallas kernel at
@@ -847,13 +944,15 @@ def fit(
         # picked einsum was measuring the kernel 6x-overworked at
         # Precision.HIGHEST.  Auto picks fused exactly where that holds:
         # TPU, float32, unsharded feature axis, p small enough for the
-        # (p,p) VMEM accumulator, and the large-n regime (small-n parity
-        # fits force HIGHEST passes, where einsum's XLA schedule wins).
+        # (p,p) VMEM accumulator, the large-n regime (small-n parity
+        # fits force HIGHEST passes, where einsum's XLA schedule wins),
+        # and no checkpointing (the fused init pass is not warm-startable,
+        # so auto demotes to einsum rather than refusing).
         big = n * p * p > (1 << 31)
         engine = ("fused" if on_tpu and big and dtype == np.float32
                   and config.matmul_precision is None
                   and not shard_features and mesh.shape[meshlib.MODEL_AXIS] == 1
-                  and p <= 1024
+                  and p <= 1024 and not checkpointing
                   else "einsum")
     if engine not in ("einsum", "fused", "qr"):
         raise ValueError(
@@ -893,6 +992,10 @@ def fit(
     dev_dtype = jnp.float32 if not use_f64 else jnp.float64
     tol_run = effective_tol(tol, criterion, dev_dtype)
     tol_dev = jnp.asarray(tol_run, dev_dtype)
+    if engine == "fused" and checkpointing:
+        raise ValueError(
+            "beta0/on_iteration/checkpoint_every need the einsum or qr "
+            "engine (the fused kernel's init pass is not warm-startable)")
     if engine == "fused":
         out = _irls_fused_kernel(
             Xd, yd, wd, od, tol_dev,
@@ -907,17 +1010,27 @@ def fit(
             precision=config.matmul_precision,
         )
     else:
-        out = _irls_kernel(
-            Xd, yd, wd, od, tol_dev,
-            jnp.asarray(max_iter, jnp.int32),
-            jnp.asarray(config.jitter, dtype),
-            family=fam, link=lnk, criterion=criterion,
-            refine_steps=config.refine_steps,
-            trace=verbose,
-            precision=config.matmul_precision,
-            solver="qr" if engine == "qr" else "chol",
-            mesh=mesh if engine == "qr" else None,
-        )
+        def run_kernel(seg_iters, beta_arr, warm, it_base=0):
+            return _irls_kernel(
+                Xd, yd, wd, od, tol_dev,
+                jnp.asarray(seg_iters, jnp.int32),
+                jnp.asarray(config.jitter, dtype),
+                family=fam, link=lnk, criterion=criterion,
+                refine_steps=config.refine_steps,
+                trace=verbose,
+                precision=config.matmul_precision,
+                solver="qr" if engine == "qr" else "chol",
+                mesh=mesh if engine == "qr" else None,
+                beta0=jnp.asarray(beta_arr, dtype), warm=warm,
+                it_base=jnp.asarray(it_base, jnp.int32),
+            )
+        if checkpointing:
+            out = _segmented_irls(run_kernel, p=p, dtype=dtype,
+                                  max_iter=max_iter, beta0=beta0,
+                                  on_iteration=on_iteration,
+                                  checkpoint_every=checkpoint_every)
+        else:
+            out = run_kernel(max_iter, np.zeros((p,), dtype), False)
     out = jax.tree.map(np.asarray, out)
     if singular == "drop":
         # host rank check on the FIRST iteration's Gramian, captured by the
@@ -931,6 +1044,19 @@ def fit(
         mask = independent_columns(np.asarray(out["XtWX0"], np.float64),
                                    tol=rank_tol)
         if not mask.all() and mask.any():
+            # checkpointing survives the recursion: the hook keeps firing
+            # (betas expanded to full width, NaN at aliased positions — the
+            # warm-start init treats NaN as zero, so those checkpoints
+            # resume cleanly), and a full-width beta0 is sliced to the kept
+            # columns
+            sub_hook = None
+            if on_iteration is not None:
+                def sub_hook(i, b, d):
+                    full = np.full(p, np.nan)
+                    full[mask] = b
+                    on_iteration(i, full, d)
+            sub_beta0 = (None if beta0 is None
+                         else np.asarray(beta0, np.float64)[mask])
             # slice back to the unpadded rows; wt64/y64 already carry any m
             # conversion, so the recursive fit must not re-apply it
             sub = fit(X[:n, mask], y64, family=fam, link=lnk,
@@ -939,7 +1065,9 @@ def fit(
                       xnames=tuple(np.asarray(xnames)[mask]), yname=yname,
                       has_intercept=has_intercept, mesh=mesh,
                       shard_features=shard_features, engine=engine,
-                      singular="error", verbose=verbose, config=config)
+                      singular="error", verbose=verbose, config=config,
+                      beta0=sub_beta0, on_iteration=sub_hook,
+                      checkpoint_every=checkpoint_every)
             return expand_aliased(sub, mask, xnames)
     if bool(out["singular"]):
         # vectors were validated up front; name a non-finite design before

@@ -1,0 +1,77 @@
+"""Checkpoint/resume for resident and multi-host fits (VERDICT r2 #8).
+
+The explicit replacement for Spark lineage recovery: checkpoint_every
+surfaces (iters, beta, deviance) to on_iteration mid-fit; beta0 resumes
+the convergence sequence from the last checkpoint.
+"""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+
+
+@pytest.fixture
+def prob(rng):
+    n, p = 20_000, 8
+    X = np.column_stack([np.ones(n), rng.standard_normal((n, p - 1))])
+    bt = rng.standard_normal(p) / np.sqrt(p)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(np.float64)
+    return X, y
+
+
+def test_segmented_equals_plain(prob, mesh8):
+    X, y = prob
+    kw = dict(family="binomial", tol=1e-10, criterion="relative", mesh=mesh8)
+    plain = sg.glm_fit(X, y, **kw)
+    trace = []
+    seg = sg.glm_fit(X, y, checkpoint_every=1,
+                     on_iteration=lambda i, b, d: trace.append((i, b, d)),
+                     **kw)
+    assert seg.iterations == plain.iterations
+    assert len(trace) == seg.iterations
+    np.testing.assert_allclose(seg.coefficients, plain.coefficients,
+                               rtol=0, atol=1e-12)
+    assert seg.deviance == pytest.approx(plain.deviance, rel=1e-12)
+    # the checkpoint stream is monotone in iteration count
+    assert [t[0] for t in trace] == list(range(1, seg.iterations + 1))
+
+
+def test_interrupt_and_resume(prob, mesh8):
+    """Kill the fit after 2 iterations; resuming from the checkpointed
+    beta reaches the same solution with only the REMAINING iterations."""
+    X, y = prob
+    kw = dict(family="binomial", tol=1e-10, criterion="relative", mesh=mesh8)
+    plain = sg.glm_fit(X, y, **kw)
+
+    ckpt = {}
+
+    class Crash(Exception):
+        pass
+
+    def hook(i, b, d):
+        ckpt["beta"], ckpt["iters"] = b, i
+        if i == 2:
+            raise Crash  # the process dies mid-fit
+
+    with pytest.raises(Crash):
+        sg.glm_fit(X, y, checkpoint_every=1, on_iteration=hook, **kw)
+    assert ckpt["iters"] == 2
+
+    with np.testing.suppress_warnings() as sup:
+        sup.filter(UserWarning)
+        resumed = sg.glm_fit(X, y, beta0=ckpt["beta"], **kw)
+    np.testing.assert_allclose(resumed.coefficients, plain.coefficients,
+                               rtol=0, atol=5e-10)
+    assert resumed.deviance == pytest.approx(plain.deviance, rel=1e-10)
+    assert resumed.converged
+    # resume cost: the remaining iterations (+ at most one verification
+    # step), not a from-scratch refit
+    assert resumed.iterations <= plain.iterations - ckpt["iters"] + 1
+
+
+def test_checkpoint_rejected_on_fused_engine(prob, mesh8):
+    X, y = prob
+    with pytest.raises(ValueError, match="einsum or qr"):
+        sg.glm_fit(X, y, family="binomial", mesh=mesh8, engine="fused",
+                   checkpoint_every=1, on_iteration=lambda *a: None)

@@ -185,3 +185,254 @@ def test_multi_process_csv_fit(tmp_path, nproc):
                                rtol=0, atol=5e-6)
     assert got["off_null_deviance"] == pytest.approx(ref_off.null_deviance,
                                                      rel=1e-5)
+
+
+_STREAM_WORKER = r"""
+import json, sys
+port, pid, csv_path, out_path, nproc = sys.argv[1:6]
+nproc = int(nproc)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import sparkglm_tpu as sg
+from sparkglm_tpu.models.streaming import glm_fit_streaming, lm_fit_streaming
+from sparkglm_tpu.parallel import distributed as dist
+
+dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                num_processes=nproc, process_id=int(pid))
+mesh = None  # streaming uses the per-process LOCAL mesh
+
+# each process streams ITS OWN byte-range shard of the file — out-of-core
+# and multi-host COMPOSE (VERDICT r2 missing #2)
+cols = sg.read_csv(csv_path, shard_index=dist.process_index(),
+                   num_shards=nproc)
+levels = sg.scan_csv_levels(csv_path)
+terms = sg.build_terms(cols, ["x1", "x2", "grp"], intercept=True,
+                       levels=levels)
+X = sg.transform(cols, terms).astype(np.float32)
+y = np.asarray(cols["y"], np.float32)
+
+m = glm_fit_streaming((X, y), family="poisson", chunk_rows=700,
+                      xnames=terms.xnames, criterion="relative", tol=1e-10)
+ml = lm_fit_streaming((X, y), chunk_rows=700, xnames=terms.xnames)
+if dist.process_index() == 0:
+    with open(out_path, "w") as f:
+        json.dump({
+            "coefficients": m.coefficients.tolist(),
+            "std_errors": m.std_errors.tolist(),
+            "deviance": m.deviance,
+            "null_deviance": m.null_deviance,
+            "aic": m.aic,
+            "df_residual": m.df_residual,
+            "converged": m.converged,
+            "n_obs": m.n_obs,
+            "lm_coefficients": ml.coefficients.tolist(),
+            "lm_sse": ml.sse,
+            "lm_r2": ml.r_squared,
+            "lm_n_obs": ml.n_obs,
+        }, f)
+print("stream worker", pid, "done", flush=True)
+"""
+
+
+def test_multi_process_streaming_fit(tmp_path):
+    """VERDICT r2 missing #2 / next #5: per-process chunk sources feeding
+    the global accumulation — a 2-process STREAMING fit must match the
+    single-process streamed fit of the same file."""
+    nproc = 2
+    rng = np.random.default_rng(23)
+    n = 3001
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    grp = np.where(np.arange(n) < 100, "c",
+                   np.where(rng.random(n) < 0.5, "a", "b"))
+    eff = {"a": 0.0, "b": 0.2, "c": -0.4}
+    y = rng.poisson(np.exp(0.3 + 0.4 * x1 - 0.2 * x2
+                           + np.vectorize(eff.get)(grp))).astype(np.float64)
+    csv_path = tmp_path / "data.csv"
+    with open(csv_path, "w") as f:
+        f.write("y,x1,x2,grp\n")
+        for i in range(n):
+            f.write(f"{y[i]:.1f},{x1[i]:.17g},{x2[i]:.17g},{grp[i]}\n")
+
+    port = _free_port()
+    out_path = tmp_path / "result.json"
+    worker_file = tmp_path / "worker.py"
+    worker_file.write_text(_STREAM_WORKER)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = "/root/repo" + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker_file), str(port), str(i),
+             str(csv_path), str(out_path), str(nproc)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            cwd="/root/repo")
+        for i in range(nproc)
+    ]
+    logs = []
+    for pr in procs:
+        try:
+            out, _ = pr.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("streaming workers timed out")
+        logs.append(out.decode())
+    for i, pr in enumerate(procs):
+        assert pr.returncode == 0, f"worker {i} failed:\n{logs[i][-3000:]}"
+
+    with open(out_path) as f:
+        got = json.load(f)
+
+    # single-process streamed reference on the full file
+    import sparkglm_tpu as sg
+    from sparkglm_tpu.models.streaming import glm_fit_streaming, lm_fit_streaming
+    cols = sg.read_csv(str(csv_path))
+    terms = sg.build_terms(cols, ["x1", "x2", "grp"], intercept=True,
+                           levels=sg.scan_csv_levels(str(csv_path)))
+    X = sg.transform(cols, terms).astype(np.float32)
+    yf = np.asarray(cols["y"], np.float32)
+    ref = glm_fit_streaming((X, yf), family="poisson", chunk_rows=700,
+                            xnames=terms.xnames, criterion="relative",
+                            tol=1e-10)
+    refl = lm_fit_streaming((X, yf), chunk_rows=700, xnames=terms.xnames)
+
+    assert got["converged"]
+    assert got["n_obs"] == n and got["lm_n_obs"] == n
+    assert got["df_residual"] == ref.df_residual
+    np.testing.assert_allclose(got["coefficients"], ref.coefficients,
+                               rtol=0, atol=5e-6)
+    np.testing.assert_allclose(got["std_errors"], ref.std_errors, rtol=1e-4)
+    assert got["deviance"] == pytest.approx(ref.deviance, rel=1e-6)
+    assert got["null_deviance"] == pytest.approx(ref.null_deviance, rel=1e-6)
+    assert got["aic"] == pytest.approx(ref.aic, rel=1e-6)
+    np.testing.assert_allclose(got["lm_coefficients"], refl.coefficients,
+                               rtol=0, atol=5e-6)
+    assert got["lm_sse"] == pytest.approx(refl.sse, rel=1e-6)
+    assert got["lm_r2"] == pytest.approx(refl.r_squared, rel=1e-6)
+
+
+_RECOVERY_WORKER = r"""
+import json, os, sys
+port, pid, csv_path, out_path, nproc, phase, ckpt_path = sys.argv[1:8]
+nproc = int(nproc)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import sparkglm_tpu as sg
+from sparkglm_tpu.parallel import distributed as dist
+
+dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                num_processes=nproc, process_id=int(pid))
+mesh = dist.global_mesh()
+cols = sg.read_csv(csv_path, shard_index=dist.process_index(),
+                   num_shards=nproc)
+terms = sg.build_terms(cols, ["x1", "x2"], intercept=True)
+X = sg.transform(cols, terms).astype(np.float32)
+y = np.asarray(cols["y"], np.float32)
+tgt = dist.sync_max_rows(X.shape[0], mesh)
+Xp, w = dist.pad_host_shard(X, tgt)
+yp, _ = dist.pad_host_shard(y, tgt)
+Xg = dist.host_shard_to_global(Xp, mesh)
+yg = dist.host_shard_to_global(yp, mesh)
+wg = dist.host_shard_to_global(w.astype(np.float32), mesh)
+kw = dict(family="poisson", mesh=mesh, xnames=terms.xnames,
+          has_intercept=True, criterion="relative", tol=1e-10)
+
+def hook(i, beta, dev):
+    # every process persists the checkpoint (any copy suffices to resume)
+    np.save(f"{ckpt_path}.{pid}.npy", beta)
+    if phase == "crash" and i == 2:
+        os._exit(3)  # the pod loses a process mid-fit
+
+if phase == "crash":
+    sg.glm_fit(Xg, yg, weights=wg, checkpoint_every=1, on_iteration=hook, **kw)
+    os._exit(9)  # should never get here
+else:
+    beta0 = np.load(f"{ckpt_path}.0.npy")
+    model = sg.glm_fit(Xg, yg, weights=wg, beta0=beta0, **kw)
+    if dist.process_index() == 0:
+        with open(out_path, "w") as f:
+            json.dump({"coefficients": model.coefficients.tolist(),
+                       "deviance": model.deviance,
+                       "iterations": model.iterations,
+                       "converged": model.converged}, f)
+print("recovery worker", pid, phase, "done", flush=True)
+"""
+
+
+def test_multi_process_crash_resume(tmp_path):
+    """VERDICT r2 #8: a multi-host fit that loses a process resumes from
+    the last beta checkpoint — costing the iterations since the
+    checkpoint, not the fit."""
+    nproc = 2
+    rng = np.random.default_rng(29)
+    n = 2000
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    y = rng.poisson(np.exp(0.4 + 0.5 * x1 - 0.3 * x2)).astype(np.float64)
+    csv_path = tmp_path / "data.csv"
+    with open(csv_path, "w") as f:
+        f.write("y,x1,x2\n")
+        for i in range(n):
+            f.write(f"{y[i]:.1f},{x1[i]:.17g},{x2[i]:.17g}\n")
+    worker_file = tmp_path / "worker.py"
+    worker_file.write_text(_RECOVERY_WORKER)
+    out_path = tmp_path / "result.json"
+    ckpt = tmp_path / "ckpt"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = "/root/repo" + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+
+    def launch(phase):
+        port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(worker_file), str(port), str(i),
+                 str(csv_path), str(out_path), str(nproc), phase, str(ckpt)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+                cwd="/root/repo")
+            for i in range(nproc)
+        ]
+        outs = []
+        for pr in procs:
+            try:
+                out, _ = pr.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail(f"{phase} workers timed out")
+            outs.append(out.decode())
+        return procs, outs
+
+    procs, outs = launch("crash")
+    for i, pr in enumerate(procs):
+        assert pr.returncode == 3, f"crash worker {i}: rc={pr.returncode}\n{outs[i][-2000:]}"
+    assert (tmp_path / "ckpt.0.npy").exists()
+
+    procs, outs = launch("resume")
+    for i, pr in enumerate(procs):
+        assert pr.returncode == 0, f"resume worker {i} failed:\n{outs[i][-3000:]}"
+    with open(out_path) as f:
+        got = json.load(f)
+
+    # single-process fit of the full file as the truth
+    import sparkglm_tpu as sg
+    cols = sg.read_csv(str(csv_path))
+    terms = sg.build_terms(cols, ["x1", "x2"], intercept=True)
+    X = sg.transform(cols, terms).astype(np.float32)
+    ref = sg.glm_fit(X, np.asarray(cols["y"], np.float32), family="poisson",
+                     criterion="relative", tol=1e-10, xnames=terms.xnames)
+    assert got["converged"]
+    np.testing.assert_allclose(got["coefficients"], ref.coefficients,
+                               rtol=0, atol=5e-6)
+    assert got["deviance"] == pytest.approx(ref.deviance, rel=1e-5)
+    # resume cost: remaining iterations only (2 were done before the crash)
+    assert got["iterations"] <= ref.iterations - 1
