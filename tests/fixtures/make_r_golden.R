@@ -25,8 +25,14 @@ show <- function(name, fit, quasi = FALSE) {
   cat("df_residual: ", fit$df.residual, " df_null:", fit$df.null, "\n\n")
 }
 
-j <- jsonlite::fromJSON(file.path(dirname(sys.frame(1)$ofile %||% "tests/fixtures"), "r_golden.json"))
-`%||%` <- function(a, b) if (is.null(a)) b else a
+# locate r_golden.json next to this script under Rscript OR source(); fall
+# back to the repo-relative path when neither reveals a file name
+args <- commandArgs(trailingOnly = FALSE)
+script <- sub("^--file=", "", grep("^--file=", args, value = TRUE))
+if (length(script) == 0) script <- NULL
+if (is.null(script)) script <- tryCatch(sys.frame(1)$ofile, error = function(e) NULL)
+dir <- if (is.null(script)) "tests/fixtures" else dirname(script)
+j <- jsonlite::fromJSON(file.path(dir, "r_golden.json"))
 
 # 1. Dobson poisson (?glm)
 counts <- c(18, 17, 15, 20, 10, 20, 25, 13, 12)
@@ -60,3 +66,46 @@ show("inverse_gaussian", glm(d$y ~ d$x, family = inverse.gaussian()))
 
 d <- j$bernoulli_cloglog$data
 show("bernoulli_cloglog", glm(d$y ~ d$x, family = binomial(link = "cloglog")))
+
+# ---------------------------------------------------------------------------
+# formula_cases (round 3): verify the FORMULA-driven golden tier — run the
+# same R formulas the fixtures promise and compare summary() output with
+# r_golden.json$formula_cases$<name>$fit / $r_doc / $summary_contains
+# ---------------------------------------------------------------------------
+
+fc <- j$formula_cases
+
+# F1 Dobson through factors (the exact ?glm code)
+d <- fc$dobson_factors$data
+show("dobson_factors",
+     glm(d$counts ~ factor(d$outcome) + factor(d$treatment),
+         family = poisson()))
+
+# F2 clotting with the log(u) transform in the formula
+d <- fc$clotting_log_transform$data
+show("clotting_log_transform", glm(d$lot1 ~ log(d$u), family = Gamma))
+
+# F3 R's ?lm plant-weight example (lm.D9)
+d <- fc$lm_D9_factor$data
+print(summary(lm(d$weight ~ factor(d$group))))
+
+# F4 numeric x factor interaction
+d <- fc$interaction_poisson$data
+show("interaction_poisson",
+     glm(d$y ~ d$x * factor(d$g), family = poisson()))
+
+# F5 weights + offset() by name
+d <- fc$gamma_weights_offset$data
+show("gamma_weights_offset",
+     glm(d$y ~ d$x + offset(d$log_e), family = Gamma(link = "log"),
+         weights = d$w))
+
+# F6 cbind response
+d <- fc$cbind_binomial$data
+show("cbind_binomial",
+     glm(cbind(d$s, d$f) ~ d$x1 + d$x2, family = binomial()))
+
+# F7 transforms: log + power
+d <- fc$gaussian_transforms$data
+show("gaussian_transforms",
+     glm(d$y ~ log(d$u) + I(d$u^2), family = gaussian()))

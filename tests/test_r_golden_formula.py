@@ -1,0 +1,110 @@
+"""R-golden parity for the FORMULA front-end (VERDICT r2 weak #5).
+
+Every case goes through data/formula.py -> model_matrix.py -> fit
+end-to-end — factors, interactions, transforms, weights + offset(),
+cbind() — and is asserted three ways:
+
+  * ``xnames`` — the design the formula must build (coding, order, names);
+  * ``fit`` — full-precision R-semantics values (tests/fixtures/
+    gen_golden.py oracle64 tier; verify anywhere R is installed with
+    tests/fixtures/make_r_golden.R);
+  * ``r_doc`` + ``summary_contains`` — numbers R ITSELF prints in its
+    ?glm / ?lm documentation (the Dobson poisson, the clotting Gamma,
+    the lm.D9 plant-weight example), asserted both numerically at
+    printed precision and as substrings of our rendered summary — the
+    reference's own golden-string pattern (test_LM.R:44) pointed at
+    correct values.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "r_golden.json")
+
+with open(FIXTURES) as f:
+    FORMULA_GOLDEN = json.load(f)["formula_cases"]
+
+
+def _fit(case):
+    from sparkglm_tpu.config import NumericConfig
+    data = {k: np.asarray(v) for k, v in case["data"].items()}
+    cfg = NumericConfig(dtype="float64")  # full-precision golden parity
+    if case.get("model") == "lm":
+        return sg.lm(case["formula"], data, config=cfg)
+    kw = dict(family=case["family"], link=case["link"],
+              tol=1e-12, criterion="relative", max_iter=200, config=cfg)
+    if "weights" in case:
+        kw["weights"] = case["weights"]
+    return sg.glm(case["formula"], data, **kw)
+
+
+@pytest.mark.parametrize("name", sorted(FORMULA_GOLDEN))
+def test_formula_golden(name):
+    case = FORMULA_GOLDEN[name]
+    model = _fit(case)
+    g = case["fit"]
+
+    assert list(model.xnames) == case["xnames"]
+    np.testing.assert_allclose(model.coefficients, g["coefficients"],
+                               rtol=1e-6, atol=1e-8)
+    if case.get("model") == "lm":
+        assert model.sse == pytest.approx(g["sse"], rel=1e-9)
+        assert model.sigma == pytest.approx(g["sigma"], rel=1e-9)
+        assert model.r_squared == pytest.approx(g["r_squared"], rel=1e-9)
+        assert model.df_resid == g["df_resid"]
+    else:
+        np.testing.assert_allclose(model.std_errors, g["std_errors"],
+                                   rtol=1e-6, atol=1e-10)
+        assert model.deviance == pytest.approx(g["deviance"], rel=1e-7,
+                                               abs=1e-10)
+        assert model.null_deviance == pytest.approx(g["null_deviance"],
+                                                    rel=1e-7)
+        assert model.dispersion == pytest.approx(g["dispersion"], rel=1e-6)
+        assert model.df_residual == g["df_residual"]
+        assert model.aic == pytest.approx(g["aic"], rel=1e-7)
+
+    # documentation-printed R values, at printed precision
+    rd = case.get("r_doc")
+    if rd:
+        for got, want in zip(model.coefficients, rd.get("coefficients", [])):
+            if want is not None:
+                assert got == pytest.approx(want, abs=1.5e-3 * max(
+                    1e-3, abs(want)) + 1.5e-6)
+        for got, want in zip(model.std_errors, rd.get("std_errors", [])):
+            assert got == pytest.approx(want, abs=1.5e-4)
+        for key, attr in (("deviance", "deviance"),
+                          ("null_deviance", "null_deviance"),
+                          ("aic", "aic"), ("sigma", "sigma"),
+                          ("r_squared", "r_squared"),
+                          ("adj_r_squared", "adj_r_squared"),
+                          ("f_statistic", "f_statistic")):
+            if key in rd:
+                assert getattr(model, attr) == pytest.approx(
+                    rd[key], rel=1e-3)
+
+    # golden-STRING summary assertion (the reference's test pattern):
+    # the rendered table must contain the R-printed numbers
+    text = str(model.summary())
+    for snippet in case.get("summary_contains", []):
+        assert snippet in text, f"{snippet!r} not in summary:\n{text}"
+
+
+def test_formula_golden_covers_required_shapes():
+    """The case set exercises every front-end feature VERDICT r2 #7 lists."""
+    formulas = [c["formula"] for c in FORMULA_GOLDEN.values()]
+    assert len(formulas) >= 6
+    assert any("*" in f for f in formulas)                  # interaction
+    assert any("log(" in f for f in formulas)               # transform
+    assert any("I(" in f for f in formulas)                 # power term
+    assert any("offset(" in f for f in formulas)            # offset()
+    assert any("cbind(" in f for f in formulas)             # cbind response
+    assert any("weights" in c for c in FORMULA_GOLDEN.values())  # weights=
+    # factors with string levels in at least two cases
+    n_factor = sum(any(isinstance(v[0], str) for v in c["data"].values())
+                   for c in FORMULA_GOLDEN.values())
+    assert n_factor >= 2
