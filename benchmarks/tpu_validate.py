@@ -6,7 +6,11 @@ Run on real TPU hardware (axon tunnel).  Produces JSON on stdout:
   - timing table per p in {32, 128, 512, 1024} on DEVICE-RESIDENT data,
     three variants per row: "fused" (Pallas kernel), "fused_xla" (the
     kernel's XLA twin) and "einsum" (GSPMD einsum engine) — the data behind
-    engine="auto" (models/glm.py).  r02 verdict: einsum wins at every p.
+    engine="auto" (models/glm.py).  r02 verdict (kernel crippled at
+    Precision.HIGHEST): einsum won at every p.  r03: the kernel runs
+    DEFAULT (bf16-multiply) Gramian precision in the large-n regime
+    (benchmarks/HOTLOOP_r03.md) — this sweep re-decides the crossover.
+    Writes benchmarks/engine_sweep_r03.json.
 """
 from __future__ import annotations
 
@@ -156,6 +160,9 @@ def main():
         del X3, y3, w3, o3
     OUT["timing"] = timing
     print(json.dumps(OUT, indent=1))
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "engine_sweep_r03.json"), "w") as f:
+        json.dump(OUT, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -1,9 +1,12 @@
 #!/bin/bash
-# Probe the axon TPU tunnel; when it answers, run the queued TPU captures
-# in sequence (five-config harness, engine sweep, headline bench).  Safe to
-# re-run: each step skips itself if its output already exists and is fresh.
+# Probe the axon TPU tunnel; when it answers, run the queued r03 TPU
+# captures in sequence, MISSING ones first (the tunnel can wedge again at
+# any moment — never re-spend tunnel time on a capture that already
+# exists).  Safe to re-run: each step is guarded by a VALID output file
+# (partial JSON from a timeout kill is removed, not trusted).
 # IMPORTANT: run ONE tpu process at a time — concurrent clients wedge the
-# tunnel (observed twice in r2).
+# tunnel (observed in r1, r2, and again in r3 when a D2H pull was
+# SIGTERM'd mid-transfer).
 set -u
 cd "$(dirname "$0")/.."
 
@@ -14,20 +17,36 @@ assert jax.devices()[0].platform == 'tpu'
 print(float((jnp.ones((128,128))@jnp.ones((128,128)))[0,0]))" >/dev/null 2>&1
 }
 
+valid_json() {  # non-empty AND parseable
+  [ -s "$1" ] && python -c "import json,sys; json.load(open(sys.argv[1]))" "$1" >/dev/null 2>&1
+}
+
 for i in $(seq 1 "${PROBES:-8}"); do
   if probe; then
     echo "tunnel alive (probe $i)"
-    if [ ! -s benchmarks/results_r02.json ]; then
-      echo "== five-config harness"
-      timeout 560 python -u benchmarks/run.py --json benchmarks/results_r02.json 2>&1 | grep -v WARNING
+    if ! valid_json benchmarks/engine_sweep_r03.json; then
+      echo "== engine sweep (r03: DEFAULT-precision fused kernel)"
+      timeout 560 python -u benchmarks/tpu_validate.py >/tmp/sweep_out.log 2>/tmp/sweep_err.log \
+        || { echo "sweep failed"; tail -5 /tmp/sweep_err.log; }
+      valid_json benchmarks/engine_sweep_r03.json || rm -f benchmarks/engine_sweep_r03.json
     fi
-    if [ ! -s benchmarks/engine_sweep_r02.json ]; then
-      echo "== engine sweep"
-      timeout 560 python -u benchmarks/tpu_validate.py > benchmarks/engine_sweep_r02.json 2>/tmp/sweep_err.log \
-        || { echo "sweep failed"; rm -f benchmarks/engine_sweep_r02.json; tail -5 /tmp/sweep_err.log; }
+    if ! valid_json benchmarks/scoring_r03.json; then
+      echo "== 10M-row scoring bench"
+      timeout 560 python -u benchmarks/scoring_bench.py >/tmp/score_out.log 2>&1 \
+        || { echo "scoring bench failed"; tail -5 /tmp/score_out.log; }
+      valid_json benchmarks/scoring_r03.json || rm -f benchmarks/scoring_r03.json
     fi
-    echo "== headline bench"
-    timeout 560 python bench.py 2>/tmp/bench_late.log
+    if ! valid_json benchmarks/results_r03_config5.json; then
+      echo "== BASELINE config 5 at FULL 50M x 500 (several minutes)"
+      timeout 3000 python -u benchmarks/config5_full.py 2>&1 | tail -20
+      valid_json benchmarks/results_r03_config5.json || rm -f benchmarks/results_r03_config5.json
+    fi
+    # headline LAST (the driver re-runs bench.py at round end anyway);
+    # skip when this round's engine-tagged capture already exists
+    if ! grep -q '"engine"' benchmarks/bench_detail_latest.json 2>/dev/null; then
+      echo "== headline bench (fused vs einsum, reports winner)"
+      timeout 560 python bench.py 2>/tmp/bench_late.log
+    fi
     exit 0
   fi
   echo "probe $i: tunnel wedged; sleeping 45s"
