@@ -135,6 +135,12 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
         y = y[good]
         cols = {k: np.asarray(v)[good] for k, v in cols.items()}
         keep[np.flatnonzero(keep)[bad]] = False
+    # training design column means ride the Terms — R's
+    # predict(type="terms") centers each term at colMeans(model.matrix).
+    # dtype=f64 accumulates without materialising an f64 copy of X.
+    import dataclasses as _dc
+    terms = _dc.replace(
+        terms, col_means=tuple(X.mean(axis=0, dtype=np.float64)))
     return f, X, y, terms, cols, keep
 
 
@@ -247,6 +253,17 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
     chunk0 = csv_io.read_csv(path, shard_index=0, num_shards=num_chunks,
                              schema=schema, native=native)
     predictors = f.resolve_predictors(list(chunk0))
+    # BEFORE build_terms (which would fit a basis from chunk0 alone):
+    # poly() learns its orthogonal basis from the FULL column, which a
+    # streaming fit never holds
+    from .data.formula import parse_component as _pc
+    if any(_pc(c)[0] == "poly"
+           for t in predictors for c in t.split(":")):
+        raise ValueError(
+            "poly() learns its orthogonal basis from the FULL column; "
+            "from-CSV streaming fits would silently fit a basis from the "
+            "first chunk only — precompute the basis columns, or load the "
+            "data and fit resident")
     terms = build_terms(chunk0, predictors, intercept=f.intercept,
                         levels=levels, no_intercept_coding="full_k_first")
     used = _used_columns(f, predictors, named_cols.values())
@@ -756,18 +773,72 @@ def confint_profile(model, data, *, level: float = 0.95, which=None,
                     offset=off, m=m, **kw)
 
 
+class TermsPrediction:
+    """R's ``predict(type="terms")`` payload: per-TERM link-scale
+    contributions, each centered at the training design's column means,
+    plus the ``constant`` attribute (sum of the centered-away parts —
+    rowsums(matrix) + constant = the link-scale prediction)."""
+
+    def __init__(self, matrix: np.ndarray, columns: tuple, constant: float):
+        self.matrix = matrix
+        self.columns = columns
+        self.constant = constant
+
+    def __repr__(self):
+        return (f"TermsPrediction(columns={self.columns}, "
+                f"constant={self.constant:.6g}, n={self.matrix.shape[0]})")
+
+
+def _predict_terms(model, X: np.ndarray) -> TermsPrediction:
+    """R's predict.lm/glm ``type="terms"``: with an intercept, term columns
+    ii give (X[, ii] - colMeans(mm)[ii]) %*% beta[ii] and constant =
+    sum(avx*beta); a NO-intercept model is not centered and its constant
+    is 0 (R only centers when attr(terms, "intercept") > 0)."""
+    from .data.model_matrix import term_spans
+
+    if model.has_intercept:
+        avx = np.asarray(model.terms.col_means, np.float64)
+        if avx.size != model.n_params:
+            raise ValueError(
+                "model's Terms carry no training column means — from-CSV "
+                "streaming fits do not record them (and models saved "
+                "before r3 predate the field), so type='terms' is "
+                "unavailable on this model")
+    else:
+        avx = np.zeros(model.n_params)
+    beta = np.nan_to_num(np.asarray(model.coefficients, np.float64))
+    spans = term_spans(model.terms)
+    Xf = np.asarray(X, np.float64)
+    out = np.empty((Xf.shape[0], len(spans)))
+    for k, (_, lo, hi) in enumerate(spans):
+        out[:, k] = (Xf[:, lo:hi] - avx[lo:hi]) @ beta[lo:hi]
+    return TermsPrediction(out, tuple(lbl for lbl, _, _ in spans),
+                           float(avx @ beta))
+
+
 def predict(model, data, **kwargs) -> np.ndarray:
     """Score new column-data through a formula-fitted model.
 
     Equivalent of ``predict.sparkLM`` (R/pkg/R/LM.R:87-100): rebuild the
     design matrix under the training ``Terms`` (which embeds the matchCols
-    zero-filling, utils.scala:21-33) then X·beta."""
+    zero-filling, utils.scala:21-33) then X·beta.
+
+    ``type="terms"`` returns a :class:`TermsPrediction` — per-term
+    link-scale contributions centered at the training design means plus
+    the constant, exactly R's ``predict(fit, type="terms")`` (offsets are
+    excluded from the columns, as in R)."""
     if model.terms is None:
         raise ValueError(
             "model was fit from arrays, not a formula; call model.predict(X) "
             "with an aligned design matrix instead")
     cols = as_columns(data)
     X = transform(cols, model.terms)
+    if kwargs.get("type") == "terms":
+        extra = set(kwargs) - {"type"}
+        if extra:
+            raise ValueError(
+                f"type='terms' takes no other predict arguments, got {extra}")
+        return _predict_terms(model, X)
     # a fit-time by-name offset travels with the model (R's predict.glm uses
     # the stored model-frame offset); an explicit offset kwarg overrides
     off_col = getattr(model, "offset_col", None)

@@ -24,9 +24,14 @@ Extensions over the reference (standard R semantics):
     transform produces non-finite values are dropped WITH A WARNING under
     ``na_omit=True``, and error under ``na_omit=False`` (api._design).
 
-Still rejected, loudly: general expressions, nesting, ``poly()``,
-free-standing parentheses, and ``-term`` removal outside ``update()`` —
-fitting a silently different model is worse than an error.
+  * ``poly(col, k)`` — R's stats::poly ORTHOGONAL polynomial basis: the
+    recurrence coefficients (alpha, norm2) are learned from the training
+    column, stored on ``Terms``, and re-evaluated identically at scoring
+    (model_matrix.py::_poly_fit_coefs/_poly_eval).
+
+Still rejected, loudly: general expressions, nesting, free-standing
+parentheses, and ``-term`` removal outside ``update()`` — fitting a
+silently different model is worse than an error.
 """
 
 from __future__ import annotations
@@ -37,8 +42,9 @@ import re
 
 _NAME = r"[A-Za-z_.][A-Za-z0-9_.]*"
 # a term component: a column, a whitelisted transform of one (log(x),
-# sqrt(x), ...), or R's literal-power form I(x^k)
-_COMPONENT = rf"(?:{_NAME}\s*\(\s*{_NAME}\s*(?:\^\s*\d+)?\s*\)|{_NAME}|\d+)"
+# sqrt(x), ...), R's literal-power form I(x^k), or poly(x, k)
+_COMPONENT = (rf"(?:{_NAME}\s*\(\s*{_NAME}\s*(?:\^\s*\d+|,\s*\d+)?\s*\)"
+              rf"|{_NAME}|\d+)")
 # term := component ((':'|'*') component)* — shared with api.update
 TERM_RE = rf"{_COMPONENT}(?:\s*[:*]\s*{_COMPONENT})*"
 
@@ -47,13 +53,29 @@ TRANSFORMS = ("log", "log2", "log10", "sqrt", "exp", "abs")
 
 def parse_component(comp: str) -> tuple[str | None, str, int | None]:
     """'log(x)' -> ('log', 'x', None); 'I(x^2)' -> ('I', 'x', 2);
-    'x' -> (None, 'x', None).  Validates the transform whitelist."""
+    'poly(x, 3)' -> ('poly', 'x', 3); 'x' -> (None, 'x', None).
+    Validates the transform whitelist."""
     comp = comp.strip()
-    mo = re.fullmatch(rf"({_NAME})\s*\(\s*({_NAME})\s*(?:\^\s*(\d+))?\s*\)",
-                      comp)
+    mo = re.fullmatch(
+        rf"({_NAME})\s*\(\s*({_NAME})\s*(?:\^\s*(\d+)|,\s*(\d+))?\s*\)",
+        comp)
     if mo is None:
         return None, comp, None
-    func, src, power = mo.group(1), mo.group(2), mo.group(3)
+    func, src, power, arg2 = mo.groups()
+    if func == "poly":
+        # R's stats::poly — degree-k ORTHOGONAL polynomial basis (the
+        # coefficients are learned from the training column and stored on
+        # Terms so scoring evaluates the same basis)
+        if arg2 is None:
+            raise ValueError(
+                f"poly() needs a degree: poly(col, k), got {comp!r}")
+        k = int(arg2)
+        if not 1 <= k <= 9:
+            raise ValueError(f"poly(col, k) needs 1 <= k <= 9, got {comp!r}")
+        return "poly", src, k
+    if arg2 is not None:
+        raise ValueError(
+            f"{func}() takes a bare column name, got {comp!r}")
     if func == "I":
         if power is None:
             raise ValueError(
@@ -69,7 +91,7 @@ def parse_component(comp: str) -> tuple[str | None, str, int | None]:
         return func, src, None
     raise ValueError(
         f"unsupported transform {func!r} in {comp!r}; available: "
-        f"{', '.join(TRANSFORMS)}, I(col^k)")
+        f"{', '.join(TRANSFORMS)}, I(col^k), poly(col, k)")
 
 
 def canonical_component(comp: str) -> str:
@@ -78,6 +100,8 @@ def canonical_component(comp: str) -> str:
         return src
     if func == "I":
         return f"I({src}^{power})"
+    if func == "poly":
+        return f"poly({src}, {power})"
     return f"{func}({src})"
 
 

@@ -52,6 +52,13 @@ class Terms:
     intercept: bool
     xnames: tuple             # output design column names
     design: tuple = ()        # per-term component tuples, e.g. (("x",), ("x","cat"))
+    # poly(col, k) basis coefficients learned from the TRAINING column
+    # (R's stats::poly attr "coefs"): canonical component -> {alpha, norm2};
+    # scoring re-evaluates the same basis via the three-term recurrence
+    poly: dict = dataclasses.field(default_factory=dict)
+    # TRAINING design column means (R's predict(type="terms") centers each
+    # term at colMeans(model.matrix)); () until the front-end records them
+    col_means: tuple = ()
 
     def __post_init__(self):
         if not self.design:  # main-effects-only recipes (and legacy dicts)
@@ -65,6 +72,10 @@ class Terms:
             "intercept": self.intercept,
             "xnames": list(self.xnames),
             "design": [list(t) for t in self.design],
+            "poly": {k: {"alpha": list(v["alpha"]),
+                         "norm2": list(v["norm2"])}
+                     for k, v in self.poly.items()},
+            "col_means": list(self.col_means),
         }
 
     @classmethod
@@ -75,6 +86,9 @@ class Terms:
             intercept=bool(d["intercept"]),
             xnames=tuple(d["xnames"]),
             design=tuple(tuple(t) for t in d.get("design", ())),
+            poly={k: {"alpha": list(v["alpha"]), "norm2": list(v["norm2"])}
+                  for k, v in d.get("poly", {}).items()},
+            col_means=tuple(d.get("col_means", ())),
         )
 
     def signature(self) -> str:
@@ -115,7 +129,8 @@ def build_terms(data, columns=None, *, intercept: bool = False,
     build a design with different columns (use ``io.scan_csv_levels`` for
     the one global pass; ADVICE r1).
     """
-    from .formula import component_source, parse_component
+    from .formula import (canonical_component, component_source,
+                          parse_component)
 
     cols = as_columns(data)
     terms_in = list(columns) if columns is not None else list(cols)
@@ -158,6 +173,23 @@ def build_terms(data, columns=None, *, intercept: bool = False,
     lv_out = {nm: (fl if nm == fullk_col else fl[1:])
               for nm, fl in full_levels.items()}
 
+    # poly(col, k) bases are DATA statistics like factor levels: learned
+    # once from the training column, carried on Terms (multi-host fits
+    # compare Terms.signature(), which now includes them — shards would
+    # otherwise silently build different bases)
+    poly_coefs: dict[str, dict] = {}
+    for comps in design:
+        for comp in comps:
+            func, nm, deg = parse_component(comp)
+            if func != "poly":
+                continue
+            key = canonical_component(comp)
+            if key not in poly_coefs:
+                alpha, norm2 = _poly_fit_coefs(
+                    np.asarray(cols[nm], np.float64), deg)
+                poly_coefs[key] = {"alpha": alpha.tolist(),
+                                   "norm2": norm2.tolist()}
+
     present = {frozenset(comps) for comps in design}
     xnames: list[str] = [INTERCEPT_NAME] if intercept else []
     for comps in design:
@@ -194,12 +226,82 @@ def build_terms(data, columns=None, *, intercept: bool = False,
         # coded names per component; product order = first component fastest
         names = [""]
         for nm in comps:
-            part = ([f"{nm}_{lv}" for lv in lv_out[nm]] if nm in lv_out
-                    else [nm])
+            func, _, deg = parse_component(nm)
+            if nm in lv_out:
+                part = [f"{nm}_{lv}" for lv in lv_out[nm]]
+            elif func == "poly":
+                # R's naming: poly(x, 3)1, poly(x, 3)2, poly(x, 3)3
+                key = canonical_component(nm)
+                part = [f"{key}{j}" for j in range(1, deg + 1)]
+            else:
+                part = [nm]
             names = [f"{a}:{b}" if a else b for b in part for a in names]
         xnames.extend(names)
     return Terms(columns=tuple(sources), levels=lv_out, intercept=intercept,
-                 xnames=tuple(xnames), design=design)
+                 xnames=tuple(xnames), design=design, poly=poly_coefs)
+
+
+def _poly_fit_coefs(x: np.ndarray, degree: int):
+    """Learn R's ``stats::poly`` orthogonal-basis coefficients from the
+    training column: QR of the centered Vandermonde matrix gives the
+    orthogonal polynomials; ``alpha`` (recurrence shifts) and ``norm2``
+    (squared norms, padded with a leading 1 exactly as R stores them) let
+    :func:`_poly_eval` reproduce the basis on ANY data."""
+    x = np.asarray(x, np.float64)
+    if len(np.unique(x)) <= degree:
+        raise ValueError(
+            f"poly degree {degree} needs more than {degree} unique values "
+            f"(got {len(np.unique(x))}) — R's 'degree' must be less than "
+            "number of unique points")
+    xbar = float(x.mean())
+    xc = x - xbar
+    V = np.vander(xc, degree + 1, increasing=True)
+    Q, R = np.linalg.qr(V)
+    raw = Q * np.diag(R)                       # orthogonal, unnormalised
+    norm2 = np.sum(raw * raw, axis=0)
+    alpha = (np.sum(xc[:, None] * raw * raw, axis=0) / norm2 + xbar)[:degree]
+    return alpha, np.concatenate([[1.0], norm2])
+
+
+def _poly_eval(x: np.ndarray, alpha, norm2) -> np.ndarray:
+    """Evaluate the stored orthogonal basis on ``x`` via R's three-term
+    recurrence (stats:::poly with ``coefs=``): column j+1 =
+    (x - alpha[j]) p_j - (norm2[j+1]/norm2[j]) p_{j-1}, then normalise and
+    drop the constant column."""
+    x = np.asarray(x, np.float64)
+    alpha = np.asarray(alpha, np.float64)
+    norm2 = np.asarray(norm2, np.float64)
+    degree = len(alpha)
+    Z = np.ones((x.shape[0], degree + 1))
+    Z[:, 1] = x - alpha[0]
+    for i in range(2, degree + 1):
+        Z[:, i] = ((x - alpha[i - 1]) * Z[:, i - 1]
+                   - (norm2[i] / norm2[i - 1]) * Z[:, i - 2])
+    Z /= np.sqrt(norm2[1:])
+    return Z[:, 1:]
+
+
+def term_spans(terms: Terms) -> list:
+    """Map each design TERM to its xnames column span:
+    ``[(label, start, stop), ...]`` (the intercept, when present, occupies
+    column 0 and is not listed).  The widths retrace build_terms' naming
+    walk, so factor dummies / poly bases / interaction products group under
+    their term — what R's ``predict(type="terms")`` columns are."""
+    from .formula import parse_component
+    spans = []
+    j = 1 if terms.intercept else 0
+    for comps in terms.design:
+        width = 1
+        for comp in comps:
+            if comp in terms.levels:
+                width *= len(terms.levels[comp])
+            else:
+                func, _, deg = parse_component(comp)
+                if func == "poly":
+                    width *= deg
+        spans.append((":".join(comps), j, j + width))
+        j += width
+    return spans
 
 
 def _transform_fn(func: str):
@@ -215,6 +317,10 @@ def _component_values(cols, comp: str) -> np.ndarray:
     the fit's non-finite-design check rather than silently dropping rows."""
     from .formula import parse_component
     func, nm, power = parse_component(comp)
+    if func == "poly":
+        raise ValueError(
+            f"{comp!r} is a multi-column basis; evaluate it through Terms "
+            "(its coefficients live there)")
     c = np.asarray(cols[nm], np.float64)
     if func is None:
         return c
@@ -225,8 +331,9 @@ def _component_values(cols, comp: str) -> np.ndarray:
 
 
 def _coded_block(cols, comp: str, terms: Terms, dtype) -> np.ndarray:
-    """(n, k) coding of one component: k-1 dummies for a factor, else the
-    (possibly transformed) numeric column."""
+    """(n, k) coding of one component: k-1 dummies for a factor, the
+    k-column orthogonal basis for poly(col, k), else the (possibly
+    transformed) numeric column."""
     if comp in terms.levels:
         cs = np.asarray(cols[comp]).astype(str)
         kept = terms.levels[comp]
@@ -234,6 +341,12 @@ def _coded_block(cols, comp: str, terms: Terms, dtype) -> np.ndarray:
         for j, lv in enumerate(kept):
             out[:, j] = (cs == lv).astype(dtype)
         return out
+    from .formula import canonical_component, parse_component
+    func, nm, _ = parse_component(comp)
+    if func == "poly":
+        c = terms.poly[canonical_component(comp)]
+        return _poly_eval(np.asarray(cols[nm], np.float64),
+                          c["alpha"], c["norm2"]).astype(dtype)
     return _component_values(cols, comp).astype(dtype).reshape(-1, 1)
 
 
@@ -264,6 +377,7 @@ def transform(data, terms: Terms, *, dtype=np.float32) -> np.ndarray:
             coded[comp] = _coded_block(cols, comp, terms, dtype)
         return coded[comp]
 
+    from .formula import parse_component as _pc
     for comps in terms.design:
         if len(comps) == 1:
             nm = comps[0]
@@ -272,6 +386,10 @@ def transform(data, terms: Terms, *, dtype=np.float32) -> np.ndarray:
                 for lv in terms.levels[nm]:
                     out[:, j] = (cs == lv).astype(dtype)
                     j += 1
+            elif _pc(nm)[0] == "poly":
+                blk = block_of(nm)
+                out[:, j:j + blk.shape[1]] = blk
+                j += blk.shape[1]
             else:
                 out[:, j] = _component_values(cols, nm).astype(dtype)
                 j += 1

@@ -178,8 +178,11 @@ def test_glm_predict_types(mesh8, rng):
     mu = sg.predict(m, new, type="response")
     np.testing.assert_allclose(mu, 1 / (1 + np.exp(-eta)), rtol=1e-6)
     assert np.all((mu > 0) & (mu < 1))
+    tp = sg.predict(m, new, type="terms")  # supported since r3
+    np.testing.assert_allclose(tp.matrix.sum(axis=1) + tp.constant, eta,
+                               rtol=1e-5)
     with pytest.raises(ValueError, match="type"):
-        sg.predict(m, new, type="terms")
+        sg.predict(m, new, type="bogus")
 
 
 def test_glm_vcov_confint_residuals(mesh8, rng):

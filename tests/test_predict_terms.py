@@ -1,0 +1,82 @@
+"""predict(type="terms") — R's per-term link-scale decomposition."""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.config import NumericConfig
+
+F64 = NumericConfig(dtype="float64")
+
+
+def test_terms_sum_to_link_prediction(rng):
+    n = 400
+    x = rng.standard_normal(n)
+    z = rng.standard_normal(n)
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    mu = np.exp(0.2 + 0.5 * x - 0.3 * z + (g == "b") * 0.4 - (g == "c") * 0.2)
+    y = rng.poisson(mu).astype(float)
+    d = {"y": y, "x": x, "z": z, "g": g}
+    m = sg.glm("y ~ x + z + g", d, family="poisson", config=F64)
+    new = {"x": x[:50], "z": z[:50], "g": g[:50]}
+    tp = sg.predict(m, new, type="terms")
+    assert tp.columns == ("x", "z", "g")
+    eta = sg.predict(m, new, type="link")
+    np.testing.assert_allclose(tp.matrix.sum(axis=1) + tp.constant, eta,
+                               rtol=1e-6)
+    # each term column is centered at the TRAINING design means: on the
+    # training data itself every column has (near) zero mean
+    tp_train = sg.predict(m, d, type="terms")
+    np.testing.assert_allclose(tp_train.matrix.mean(axis=0), 0.0, atol=1e-6)
+
+
+def test_terms_lm_manual(rng):
+    n = 200
+    x = rng.uniform(0, 2, n)
+    y = 1.0 + 2.0 * x + 0.1 * rng.standard_normal(n)
+    m = sg.lm("y ~ x", {"y": y, "x": x}, config=F64)
+    tp = sg.predict(m, {"x": x[:5]}, type="terms")
+    # manual R semantics: (x - mean(x_train)) * beta_x; constant =
+    # beta0 + mean(x_train) * beta_x
+    want = (x[:5].astype(np.float32).astype(np.float64)
+            - np.float64(m.terms.col_means[1])) * m.coefficients[1]
+    np.testing.assert_allclose(tp.matrix[:, 0], want, rtol=1e-5)
+    assert tp.constant == pytest.approx(
+        m.coefficients[0] + m.terms.col_means[1] * m.coefficients[1],
+        rel=1e-9)
+
+
+def test_terms_with_interaction_and_poly(rng):
+    n = 300
+    x = rng.uniform(-1, 1, n)
+    g = np.array(["u", "v"])[rng.integers(0, 2, n)]
+    y = 1 + x + 0.5 * x * x + (g == "v") * (0.3 + 0.4 * x) \
+        + 0.1 * rng.standard_normal(n)
+    d = {"y": y, "x": x, "g": g}
+    m = sg.lm("y ~ poly(x, 2) + g + poly(x, 2):g", d, config=F64)
+    tp = sg.predict(m, d, type="terms")
+    assert tp.columns == ("poly(x, 2)", "g", "poly(x, 2):g")
+    np.testing.assert_allclose(tp.matrix.sum(axis=1) + tp.constant,
+                               sg.predict(m, d), rtol=1e-5)
+
+
+def test_terms_validation(rng):
+    x = rng.standard_normal(60)
+    y = x + 0.1 * rng.standard_normal(60)
+    m = sg.lm("y ~ x", {"y": y, "x": x})
+    with pytest.raises(ValueError, match="takes no other"):
+        sg.predict(m, {"x": x}, type="terms", se_fit=True)
+
+
+def test_terms_no_intercept_uncentered(rng):
+    """R centers type='terms' only when the model HAS an intercept; a
+    no-intercept fit returns raw x*beta with constant 0."""
+    x = rng.uniform(0.5, 2.0, 120)
+    y = 2.0 * x + 0.05 * rng.standard_normal(120)
+    m = sg.lm("y ~ x - 1", {"y": y, "x": x}, config=F64)
+    tp = sg.predict(m, {"x": x[:4]}, type="terms")
+    assert tp.constant == 0.0
+    np.testing.assert_allclose(
+        tp.matrix[:, 0],
+        x[:4].astype(np.float32).astype(np.float64) * m.coefficients[0],
+        rtol=1e-5)

@@ -12,8 +12,12 @@ def test_parse_transforms():
     f = parse_formula("y ~ log(x) + I(x^2)*g + sqrt(z):w")
     assert f.predictors == ("log(x)", "I(x^2)", "g", "I(x^2):g",
                             "sqrt(z):w")
-    with pytest.raises(ValueError, match="unsupported transform"):
+    # poly is SUPPORTED since r3 — but requires a degree
+    with pytest.raises(ValueError, match="needs a degree"):
         parse_formula("y ~ poly(x)")
+    assert parse_formula("y ~ poly(x, 3)").predictors == ("poly(x, 3)",)
+    with pytest.raises(ValueError, match="unsupported transform"):
+        parse_formula("y ~ sin(x)")
     with pytest.raises(ValueError, match="power form"):
         parse_formula("y ~ I(x)")
     with pytest.raises(ValueError, match="2 <= k <= 9"):
