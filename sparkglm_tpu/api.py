@@ -254,13 +254,15 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
                              schema=schema, native=native)
     predictors = f.resolve_predictors(list(chunk0))
     # BEFORE build_terms (which would fit a basis from chunk0 alone):
-    # poly() learns its orthogonal basis from the FULL column, which a
-    # streaming fit never holds
+    # poly()/bs()/ns() learn their bases from the FULL column (orthogonal
+    # coefficients / knot quantiles), which a streaming fit never holds
     from .data.formula import parse_component as _pc
-    if any(_pc(c)[0] == "poly"
-           for t in predictors for c in t.split(":")):
+    from .data.model_matrix import BASIS_FUNCS
+    basis_used = [c for t in predictors for c in t.split(":")
+                  if _pc(c)[0] in BASIS_FUNCS]
+    if basis_used:
         raise ValueError(
-            "poly() learns its orthogonal basis from the FULL column; "
+            f"{basis_used[0]!r} learns its basis from the FULL column; "
             "from-CSV streaming fits would silently fit a basis from the "
             "first chunk only — precompute the basis columns, or load the "
             "data and fit resident")

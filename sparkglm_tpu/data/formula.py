@@ -73,6 +73,19 @@ def parse_component(comp: str) -> tuple[str | None, str, int | None]:
         if not 1 <= k <= 9:
             raise ValueError(f"poly(col, k) needs 1 <= k <= 9, got {comp!r}")
         return "poly", src, k
+    if func in ("bs", "ns"):
+        # R's splines::bs/ns — df-column spline bases; knots are learned
+        # from the training column and stored on Terms
+        if arg2 is None:
+            raise ValueError(
+                f"{func}() needs degrees of freedom: {func}(col, df), "
+                f"got {comp!r}")
+        k = int(arg2)
+        lo = 3 if func == "bs" else 1
+        if not lo <= k <= 15:
+            raise ValueError(
+                f"{func}(col, df) needs {lo} <= df <= 15, got {comp!r}")
+        return func, src, k
     if arg2 is not None:
         raise ValueError(
             f"{func}() takes a bare column name, got {comp!r}")
@@ -100,8 +113,8 @@ def canonical_component(comp: str) -> str:
         return src
     if func == "I":
         return f"I({src}^{power})"
-    if func == "poly":
-        return f"poly({src}, {power})"
+    if func in ("poly", "bs", "ns"):
+        return f"{func}({src}, {power})"
     return f"{func}({src})"
 
 

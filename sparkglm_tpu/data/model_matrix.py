@@ -34,6 +34,7 @@ device once.
 from __future__ import annotations
 
 import dataclasses
+import functools as _functools
 
 import numpy as np
 
@@ -56,6 +57,9 @@ class Terms:
     # (R's stats::poly attr "coefs"): canonical component -> {alpha, norm2};
     # scoring re-evaluates the same basis via the three-term recurrence
     poly: dict = dataclasses.field(default_factory=dict)
+    # bs/ns spline knots learned from the TRAINING column (R's
+    # splines::bs/ns attrs): canonical component -> {interior, boundary, df}
+    splines: dict = dataclasses.field(default_factory=dict)
     # TRAINING design column means (R's predict(type="terms") centers each
     # term at colMeans(model.matrix)); () until the front-end records them
     col_means: tuple = ()
@@ -75,6 +79,10 @@ class Terms:
             "poly": {k: {"alpha": list(v["alpha"]),
                          "norm2": list(v["norm2"])}
                      for k, v in self.poly.items()},
+            "splines": {k: {"interior": list(v["interior"]),
+                            "boundary": list(v["boundary"]),
+                            "df": int(v["df"])}
+                        for k, v in self.splines.items()},
             "col_means": list(self.col_means),
         }
 
@@ -88,6 +96,9 @@ class Terms:
             design=tuple(tuple(t) for t in d.get("design", ())),
             poly={k: {"alpha": list(v["alpha"]), "norm2": list(v["norm2"])}
                   for k, v in d.get("poly", {}).items()},
+            splines={k: {"interior": list(v["interior"]),
+                         "boundary": list(v["boundary"]), "df": int(v["df"])}
+                     for k, v in d.get("splines", {}).items()},
             col_means=tuple(d.get("col_means", ())),
         )
 
@@ -178,17 +189,19 @@ def build_terms(data, columns=None, *, intercept: bool = False,
     # compare Terms.signature(), which now includes them — shards would
     # otherwise silently build different bases)
     poly_coefs: dict[str, dict] = {}
+    spline_coefs: dict[str, dict] = {}
     for comps in design:
         for comp in comps:
             func, nm, deg = parse_component(comp)
-            if func != "poly":
-                continue
             key = canonical_component(comp)
-            if key not in poly_coefs:
+            if func == "poly" and key not in poly_coefs:
                 alpha, norm2 = _poly_fit_coefs(
                     np.asarray(cols[nm], np.float64), deg)
                 poly_coefs[key] = {"alpha": alpha.tolist(),
                                    "norm2": norm2.tolist()}
+            elif func in ("bs", "ns") and key not in spline_coefs:
+                spline_coefs[key] = _spline_fit_knots(
+                    np.asarray(cols[nm], np.float64), deg, func)
 
     present = {frozenset(comps) for comps in design}
     xnames: list[str] = [INTERCEPT_NAME] if intercept else []
@@ -229,8 +242,8 @@ def build_terms(data, columns=None, *, intercept: bool = False,
             func, _, deg = parse_component(nm)
             if nm in lv_out:
                 part = [f"{nm}_{lv}" for lv in lv_out[nm]]
-            elif func == "poly":
-                # R's naming: poly(x, 3)1, poly(x, 3)2, poly(x, 3)3
+            elif func in BASIS_FUNCS:
+                # R's naming: poly(x, 3)1..3, bs(x, 4)1..4, ns(x, 4)1..4
                 key = canonical_component(nm)
                 part = [f"{key}{j}" for j in range(1, deg + 1)]
             else:
@@ -238,7 +251,8 @@ def build_terms(data, columns=None, *, intercept: bool = False,
             names = [f"{a}:{b}" if a else b for b in part for a in names]
         xnames.extend(names)
     return Terms(columns=tuple(sources), levels=lv_out, intercept=intercept,
-                 xnames=tuple(xnames), design=design, poly=poly_coefs)
+                 xnames=tuple(xnames), design=design, poly=poly_coefs,
+                 splines=spline_coefs)
 
 
 def _poly_fit_coefs(x: np.ndarray, degree: int):
@@ -248,6 +262,10 @@ def _poly_fit_coefs(x: np.ndarray, degree: int):
     (squared norms, padded with a leading 1 exactly as R stores them) let
     :func:`_poly_eval` reproduce the basis on ANY data."""
     x = np.asarray(x, np.float64)
+    x_fit = x[np.isfinite(x)]
+    if x_fit.size == 0:
+        raise ValueError("poly() needs finite values in its column")
+    x = x_fit
     if len(np.unique(x)) <= degree:
         raise ValueError(
             f"poly degree {degree} needs more than {degree} unique values "
@@ -297,11 +315,102 @@ def term_spans(terms: Terms) -> list:
                 width *= len(terms.levels[comp])
             else:
                 func, _, deg = parse_component(comp)
-                if func == "poly":
+                if func in BASIS_FUNCS:
                     width *= deg
         spans.append((":".join(comps), j, j + width))
         j += width
     return spans
+
+
+# multi-column basis components: their parameters are TRAINING-data
+# statistics carried on Terms, and they expand to several design columns
+BASIS_FUNCS = ("poly", "bs", "ns")
+
+
+def _spline_fit_knots(x: np.ndarray, df: int, func: str):
+    """R ``splines::bs/ns`` knot selection (intercept=FALSE): boundary
+    knots at range(x), interior knots at the quantiles of x — df-3 of
+    them for bs (cubic, degree 3), df-1 for ns (natural cubic)."""
+    x = np.asarray(x, np.float64)
+    x = x[np.isfinite(x)]  # non-finite rows are na.action's business — the
+    # knots come from the finite values, and _spline_eval yields NaN rows
+    # for non-finite x so api._design drops/errors them like any transform
+    if x.size == 0:
+        raise ValueError(f"{func}() needs finite values in its column")
+    n_interior = df - 3 if func == "bs" else df - 1
+    if n_interior < 0:
+        raise ValueError(
+            f"{func}(col, df) needs df >= {3 if func == 'bs' else 1}, "
+            f"got df={df}")
+    boundary = (float(np.min(x)), float(np.max(x)))
+    if boundary[0] == boundary[1]:
+        raise ValueError(f"{func}() needs a non-constant column")
+    if n_interior > 0:
+        probs = np.linspace(0.0, 1.0, n_interior + 2)[1:-1]
+        interior = np.quantile(x, probs)  # numpy 'linear' == R type 7
+    else:
+        interior = np.empty(0)
+    return {"interior": [float(v) for v in interior],
+            "boundary": [boundary[0], boundary[1]], "df": int(df)}
+
+
+def _spline_eval(x: np.ndarray, func: str, coefs: dict) -> np.ndarray:
+    """Evaluate the stored bs/ns basis (R ``splineDesign`` semantics,
+    intercept=FALSE).  ns applies the natural constraint — zero second
+    derivative at the boundary knots — by projecting out the two
+    constraint directions (R's ``qr.qty`` construction).  Values beyond
+    the boundary knots use the end polynomial pieces and warn (R warns
+    for bs too; its ns linearly extrapolates, so ns predictions outside
+    the training range can differ from R there)."""
+    from scipy.interpolate import BSpline
+
+    x = np.asarray(x, np.float64)
+    lo, hi = coefs["boundary"]
+    interior = tuple(float(v) for v in coefs["interior"])
+    degree = 3
+    t = np.concatenate([np.repeat(lo, degree + 1), interior,
+                        np.repeat(hi, degree + 1)])
+    finite = np.isfinite(x)
+    xf = x[finite]
+    if ((xf < lo) | (xf > hi)).any():
+        import warnings
+        warnings.warn(
+            f"{func}() evaluated beyond its boundary knots [{lo:g}, {hi:g}]"
+            " — the basis there is the end polynomial piece, and may be "
+            "ill-conditioned (R warns here too)", stacklevel=4)
+    Bf = BSpline.design_matrix(xf, t, degree, extrapolate=True).toarray()
+    if func == "bs":
+        Bf = Bf[:, 1:]
+    else:
+        Bf = Bf[:, 1:] @ _ns_projection(float(lo), float(hi), interior)
+    if finite.all():
+        return Bf
+    # NaN/Inf rows stay NaN so the front-end's na.action scan sees them
+    out = np.full((x.shape[0], Bf.shape[1]), np.nan)
+    out[finite] = Bf
+    return out
+
+
+@_functools.lru_cache(maxsize=256)
+def _ns_projection(lo: float, hi: float, interior: tuple) -> np.ndarray:
+    """Null-space basis of the natural-spline constraint (zero second
+    derivative at both boundary knots), cached per knot vector — it
+    depends only on the fitted knots, not the data (review r3)."""
+    from scipy.interpolate import BSpline
+    degree = 3
+    t = np.concatenate([np.repeat(lo, degree + 1),
+                        np.asarray(interior, np.float64),
+                        np.repeat(hi, degree + 1)])
+    k = len(t) - degree - 1
+    const = np.empty((2, k))
+    for j in range(k):
+        c = np.zeros(k)
+        c[j] = 1.0
+        d2 = BSpline(t, c, degree).derivative(2)
+        const[0, j] = d2(lo)
+        const[1, j] = d2(hi)
+    Q, _ = np.linalg.qr(const[:, 1:].T, mode="complete")
+    return Q[:, 2:]
 
 
 def _transform_fn(func: str):
@@ -317,7 +426,7 @@ def _component_values(cols, comp: str) -> np.ndarray:
     the fit's non-finite-design check rather than silently dropping rows."""
     from .formula import parse_component
     func, nm, power = parse_component(comp)
-    if func == "poly":
+    if func in BASIS_FUNCS:
         raise ValueError(
             f"{comp!r} is a multi-column basis; evaluate it through Terms "
             "(its coefficients live there)")
@@ -347,6 +456,10 @@ def _coded_block(cols, comp: str, terms: Terms, dtype) -> np.ndarray:
         c = terms.poly[canonical_component(comp)]
         return _poly_eval(np.asarray(cols[nm], np.float64),
                           c["alpha"], c["norm2"]).astype(dtype)
+    if func in ("bs", "ns"):
+        c = terms.splines[canonical_component(comp)]
+        return _spline_eval(np.asarray(cols[nm], np.float64),
+                            func, c).astype(dtype)
     return _component_values(cols, comp).astype(dtype).reshape(-1, 1)
 
 
@@ -386,7 +499,7 @@ def transform(data, terms: Terms, *, dtype=np.float32) -> np.ndarray:
                 for lv in terms.levels[nm]:
                     out[:, j] = (cs == lv).astype(dtype)
                     j += 1
-            elif _pc(nm)[0] == "poly":
+            elif _pc(nm)[0] in BASIS_FUNCS:
                 blk = block_of(nm)
                 out[:, j:j + blk.shape[1]] = blk
                 j += blk.shape[1]

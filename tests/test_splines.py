@@ -1,0 +1,118 @@
+"""bs(col, df) / ns(col, df) — R's splines::bs/ns regression bases."""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.config import NumericConfig
+from sparkglm_tpu.data.model_matrix import _spline_eval, _spline_fit_knots
+
+F64 = NumericConfig(dtype="float64")
+
+
+def test_bs_basis_shape_and_partition(rng):
+    x = rng.uniform(0, 10, 300)
+    c = _spline_fit_knots(x, 6, "bs")
+    assert len(c["interior"]) == 3 and c["df"] == 6
+    B = _spline_eval(x, "bs", c)
+    assert B.shape == (300, 6)
+    # B-splines partition unity: the full basis (incl. the dropped first
+    # column) sums to 1, so the kept columns sum to 1 - B0 in [0, 1]
+    s = B.sum(axis=1)
+    assert np.all((s > -1e-9) & (s < 1 + 1e-9))
+    # inside the range the basis is local: values in [0, 1]
+    assert B.min() > -1e-9 and B.max() <= 1 + 1e-9
+
+
+def test_ns_second_derivative_zero_at_boundaries(rng):
+    """The natural constraint: every ns basis column has zero second
+    derivative at the boundary knots (checked numerically)."""
+    x = rng.uniform(-2, 3, 400)
+    c = _spline_fit_knots(x, 4, "ns")
+    lo, hi = c["boundary"]
+    h = 1e-5 * (hi - lo)
+
+    def d2(z):
+        pts = np.array([z - h, z, z + h])
+        B = _spline_eval(pts, "ns", c)
+        return (B[0] - 2 * B[1] + B[2]) / h ** 2
+    np.testing.assert_allclose(d2(lo + 2 * h), 0.0, atol=1e-2)
+    np.testing.assert_allclose(d2(hi - 2 * h), 0.0, atol=1e-2)
+
+
+def test_spline_fit_matches_raw_cubic_span(rng):
+    """With NO interior knots, bs(x, 3) spans the cubic polynomials:
+    identical fit to y ~ x + I(x^2) + I(x^3)."""
+    n = 400
+    x = rng.uniform(0.5, 4, n)
+    y = 1 + x - 0.4 * x ** 2 + 0.05 * x ** 3 + 0.1 * rng.standard_normal(n)
+    d = {"y": y, "x": x}
+    mb = sg.lm("y ~ bs(x, 3)", d, config=F64)
+    mr = sg.lm("y ~ x + I(x^2) + I(x^3)", d, config=F64)
+    assert mb.xnames == ("intercept", "bs(x, 3)1", "bs(x, 3)2", "bs(x, 3)3")
+    assert mb.sse == pytest.approx(mr.sse, rel=1e-9)
+
+
+def test_ns_glm_fit_and_scoring_stability(rng):
+    n = 600
+    x = rng.uniform(0, 6, n)
+    mu = np.exp(0.5 + np.sin(x))
+    y = rng.poisson(mu).astype(float)
+    m = sg.glm("y ~ ns(x, 5)", {"y": y, "x": x}, family="poisson",
+               config=F64)
+    assert m.converged and m.n_params == 6
+    # the fitted spline tracks the truth inside the range
+    xs = np.linspace(0.5, 5.5, 50)
+    eta = sg.predict(m, {"x": xs}, type="link")
+    assert np.corrcoef(eta, 0.5 + np.sin(xs))[0, 1] > 0.98
+    # scoring uses the TRAINING knots: save/load scores identically
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".npz") as f:
+        m.save(f.name)
+        m2 = sg.load_model(f.name)
+    np.testing.assert_allclose(sg.predict(m2, {"x": xs}, type="link"),
+                               eta, rtol=1e-12)
+
+
+def test_spline_outside_boundary_warns(rng):
+    x = rng.uniform(0, 1, 200)
+    y = x + 0.05 * rng.standard_normal(200)
+    m = sg.lm("y ~ ns(x, 3)", {"y": y, "x": x}, config=F64)
+    with pytest.warns(UserWarning, match="boundary knots"):
+        sg.predict(m, {"x": np.array([-0.5, 0.5, 1.5])})
+
+
+def test_spline_in_drop1_and_terms(rng):
+    n = 300
+    x = rng.uniform(0, 5, n)
+    z = rng.standard_normal(n)
+    y = np.sin(x) + 0.3 * z + 0.1 * rng.standard_normal(n)
+    d = {"y": y, "x": x, "z": z}
+    m = sg.lm("y ~ ns(x, 4) + z", d, config=F64)
+    from sparkglm_tpu.models.anova import drop1
+    t = drop1(m, d)
+    assert t.row_names == ("<none>", "ns(x, 4)", "z")
+    tp = sg.predict(m, d, type="terms")
+    assert tp.columns == ("ns(x, 4)", "z")
+    np.testing.assert_allclose(tp.matrix.sum(axis=1) + tp.constant,
+                               sg.predict(m, d), rtol=1e-5, atol=1e-7)
+
+
+def test_spline_validation(rng):
+    x = rng.uniform(0, 1, 50)
+    with pytest.raises(ValueError, match="degrees of freedom"):
+        sg.lm("y ~ bs(x)", {"y": x, "x": x})
+    with pytest.raises(ValueError, match="3 <= df"):
+        sg.lm("y ~ bs(x, 2)", {"y": x, "x": x})
+    with pytest.raises(ValueError, match="non-constant"):
+        sg.lm("y ~ ns(x, 3)", {"y": x, "x": np.ones(50)})
+
+
+def test_spline_rejected_from_csv(tmp_path, rng):
+    p = tmp_path / "d.csv"
+    with open(p, "w") as fh:
+        fh.write("y,x\n")
+        for i in range(50):
+            fh.write(f"{rng.random()},{rng.random()}\n")
+    with pytest.raises(ValueError, match="basis"):
+        sg.lm_from_csv("y ~ ns(x, 3)", str(p))
