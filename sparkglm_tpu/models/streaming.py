@@ -72,9 +72,12 @@ def _check_polish(config: NumericConfig) -> None:
 
 def _resolve_dtype(Xc, config: NumericConfig) -> np.dtype:
     """Honour float64 input + x64 exactly like the resident fits
-    (models/lm.py / glm.py): f64 chunks stay f64 when x64 is on."""
+    (models/lm.py / glm.py): f64 chunks stay f64 when x64 is on.
+    Reads only the dtype attribute — never np.asarray (a device chunk
+    would round-trip the whole design through the tunnel)."""
     from ..config import x64_enabled
-    if np.asarray(Xc).dtype == np.float64 and x64_enabled():
+    dt = Xc.dtype if hasattr(Xc, "dtype") else np.asarray(Xc).dtype
+    if dt == np.float64 and x64_enabled():
         return np.dtype(np.float64)
     return np.dtype(config.dtype)
 
@@ -82,9 +85,50 @@ def _resolve_dtype(Xc, config: NumericConfig) -> np.dtype:
 def _ones_colmask(Xc) -> np.ndarray:
     """Per-column 'every value is exactly 1.0' for this chunk — AND-ed
     across chunks so streaming intercept detection sees ALL rows, matching
-    the resident full-matrix scan (lm.py::_detect_intercept)."""
+    the resident full-matrix scan (lm.py::_detect_intercept).  Device
+    chunks scan on device (pulling only the (p,) mask)."""
+    if _is_device_chunk(Xc):
+        return np.asarray(_ones_colmask_dev(Xc))
     Xc = np.asarray(Xc)
     return (Xc.min(axis=0) == 1.0) & (Xc.max(axis=0) == 1.0)
+
+
+@jax.jit
+def _ones_colmask_dev(X):
+    return (jnp.min(X, axis=0) == 1.0) & (jnp.max(X, axis=0) == 1.0)
+
+
+@jax.jit
+def _all_finite_dev(X):
+    return jnp.all(jnp.isfinite(X))
+
+
+@jax.jit
+def _matvec_hi(X, b):
+    return jnp.matmul(X, b, precision=jax.lax.Precision.HIGHEST)
+
+
+def _chunk_xbeta(Xc, beta) -> np.ndarray:
+    """X @ beta for the host-f64 stats passes: host chunks in f64; device
+    chunks on device (HIGHEST matvec) pulling only the (n,) result — the
+    design never crosses the tunnel."""
+    if _is_device_chunk(Xc):
+        return np.asarray(
+            _matvec_hi(Xc, jnp.asarray(beta, Xc.dtype)), np.float64)
+    return np.asarray(Xc, np.float64) @ beta
+
+
+def _check_finite_design_any(Xc) -> None:
+    """R's model-frame NA/NaN/Inf error, device-aware: device chunks check
+    on device (one boolean crosses back)."""
+    if _is_device_chunk(Xc):
+        if not bool(_all_finite_dev(Xc)):
+            raise ValueError(
+                "NA/NaN/Inf in the design matrix (device chunk); clean the "
+                "generator's output")
+        return
+    from .validate import check_finite_design
+    check_finite_design(np.asarray(Xc))
 
 
 # ---------------------------------------------------------------------------
@@ -154,8 +198,35 @@ def _as_source(source, chunk_rows: int) -> Callable[[], Iterator]:
     return gen
 
 
+def _is_device_chunk(Xc) -> bool:
+    return isinstance(Xc, jax.Array)
+
+
 def _put_chunk(Xc, yc, wc, oc, mesh, dtype):
-    """Shard one chunk; padding rows get weight 0 (inert in every sum)."""
+    """Shard one chunk; padding rows get weight 0 (inert in every sum).
+
+    DEVICE chunks (the design is already a jax.Array — e.g. a synthetic
+    benchmark source generating data with on-device RNG) pass through with
+    ZERO host round-trips: missing vectors are created on device, and
+    re-sharding a resident array onto the same devices copies nothing.
+    """
+    if _is_device_chunk(Xc):
+        nc = int(Xc.shape[0])
+        d = mesh.shape[meshlib.DATA_AXIS]
+        if nc % d:
+            raise ValueError(
+                f"device chunks must have rows divisible by the data axis "
+                f"({d}); got {nc} (the generator controls its chunk size)")
+        sh_m = jax.sharding.NamedSharding(mesh, meshlib.row_spec(2))
+        sh_v = jax.sharding.NamedSharding(mesh, meshlib.row_spec(1))
+
+        def putv(v, fill):
+            if v is None:
+                return jax.device_put(jnp.full((nc,), fill, dtype), sh_v)
+            return jax.device_put(jnp.asarray(v, dtype).reshape(nc), sh_v)
+
+        return (jax.device_put(jnp.asarray(Xc, dtype), sh_m),
+                putv(yc, 0.0), putv(wc, 1.0), putv(oc, 0.0))
     Xc = np.asarray(Xc, dtype=dtype)
     nc = Xc.shape[0]
     yc = np.asarray(yc, dtype=dtype).reshape(nc)
@@ -441,11 +512,11 @@ def lm_fit_streaming(
                 cm = _ones_colmask(Xc)
                 ones_mask = cm if ones_mask is None else ones_mask & cm
             n += int(Xc.shape[0])  # true rows (device padding carries w=0)
-            from .validate import check_finite_design, check_finite_vector
+            from .validate import check_finite_vector
             check_finite_vector("y", np.asarray(yc, np.float64))
             if wc is not None:
                 check_finite_vector("weights", np.asarray(wc, np.float64))
-            check_finite_design(np.asarray(Xc))
+            _check_finite_design_any(Xc)
             d = _lm_chunk_pass(*_put_chunk(Xc, yc, wc, oc, mesh, dtype)[:3])
             d = {k: np.asarray(v, np.float64) for k, v in d.items()}
             yc64, wc64, _ = _host_chunk(yc, wc, None)
@@ -500,8 +571,9 @@ def lm_fit_streaming(
     err = None
     try:
         for Xc, yc, wc, oc in _iter_chunks(chunks):
+            xb = _chunk_xbeta(Xc, beta)
             yc64, wc64, _ = _host_chunk(yc, wc, None)
-            resid = yc64 - np.asarray(Xc, np.float64) @ beta
+            resid = yc64 - xb
             sse += float(np.sum(wc64 * resid * resid))
             dmean = yc64 - ybar
             sst_centered += float(np.sum(wc64 * dmean * dmean))
@@ -637,8 +709,7 @@ def glm_fit_streaming(
                 # R's NA/NaN/Inf model-frame errors — without this the
                 # kernel sanitizer silently excludes non-finite rows
                 # (models/validate.py); first pass only
-                from .validate import (check_finite_design,
-                                       check_finite_vector,
+                from .validate import (check_finite_vector,
                                        check_response_domain)
                 check_finite_vector("y", np.asarray(yc, np.float64))
                 check_response_domain(fam.name, np.asarray(yc, np.float64))
@@ -646,12 +717,16 @@ def glm_fit_streaming(
                     check_finite_vector("weights", np.asarray(wc, np.float64))
                 if oc is not None:
                     check_finite_vector("offset", np.asarray(oc, np.float64))
-                check_finite_design(np.asarray(Xc))
+                _check_finite_design_any(Xc)
                 if oc is not None and np.any(np.asarray(oc) != 0):
                     saw_offset = True
             dchunk = _put_chunk(Xc, yc, wc, oc, mesh, dtype)
+            # device chunks skip the corner-sample fingerprint: each
+            # scalar pull is an RPC over the tunnel, and programmatic
+            # device sources are not the reorder-bug class it guards
             ccache.offer(dchunk, int(Xc.shape[0]),
-                         fingerprint=_fingerprint(Xc, yc, wc, oc))
+                         fingerprint=None if _is_device_chunk(Xc)
+                         else _fingerprint(Xc, yc, wc, oc))
             yield (*dchunk, int(Xc.shape[0]))
 
     def full_pass(beta, first):
@@ -802,8 +877,9 @@ def glm_fit_streaming(
     err = None
     try:
         for Xc, yc, wc, oc in _iter_chunks(chunks):
+            xb = _chunk_xbeta(Xc, beta)
             yc, wc, oc = _host_chunk(yc, wc, oc)
-            eta = np.asarray(Xc, np.float64) @ beta + oc
+            eta = xb + oc
             d = hoststats.glm_chunk_stats(fam.name, lnk.name, yc, eta, wc)
             stats = d if stats is None else {k: stats[k] + d[k] for k in stats}
     except Exception as e:  # noqa: BLE001 — re-raised below / by _sync_errors
@@ -827,8 +903,14 @@ def glm_fit_streaming(
     elif has_intercept and saw_offset:
         def ones_source():
             for Xc, yc, wc, oc in _iter_chunks(chunks):
-                yield (np.ones((np.asarray(yc).shape[0], 1), dtype),
-                       yc, wc, oc)
+                if _is_device_chunk(Xc):
+                    # keep the null design on device too: the intercept-only
+                    # refit then also avoids any design tunnel traffic
+                    yield (jnp.ones((int(yc.shape[0]), 1),
+                                    jnp.dtype(dtype)), yc, wc, oc)
+                else:
+                    yield (np.ones((np.asarray(yc).shape[0], 1), dtype),
+                           yc, wc, oc)
         null_dev = glm_fit_streaming(
             ones_source, family=fam, link=lnk, tol=tol, max_iter=max_iter,
             criterion=criterion, chunk_rows=chunk_rows, has_intercept=True,
