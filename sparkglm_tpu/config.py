@@ -43,8 +43,9 @@ class NumericConfig:
         ``None`` (the default) = AUTO: the polish runs exactly when the
         fit's equilibrated pivot shows the f32 normal equations losing
         digits (pivot < 0.03 ~ kappa(X) beyond ~30), with a warning —
-        on paths that can run it (resident fits with an unsharded feature
-        axis; global multi-process and streaming fits warn instead).
+        on paths that can run it (resident AND global multi-process fits
+        with an unsharded feature axis; streaming fits warn instead —
+        their chunked TSQR does not exist yet).
         ``"off"`` never polishes (r02's warn-only behaviour).
     """
 

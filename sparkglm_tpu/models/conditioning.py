@@ -33,8 +33,8 @@ def resolve_ill_conditioning(pivot: float, *, is_f32: bool, engine: str,
     * ``polish_cfg is None`` (AUTO) and the path can polish: warn and
       escalate to the CSNE polish.
     * otherwise (``polish="off"``, or a path that cannot run the polish —
-      sharded feature axis, model-axis mesh, global multi-process arrays):
-      the loud r02 warning, so the degradation never passes silently.
+      sharded feature axis, model-axis mesh, streaming fits): the loud
+      r02 warning, so the degradation never passes silently.
     """
     if not is_f32 or engine == "qr" or polish_active or pivot >= PIVOT_WARN:
         return polish_active

@@ -706,13 +706,20 @@ def _fit_global(
         raise np.linalg.LinAlgError(
             "singular weighted Gramian during IRLS (multi-process fit has "
             "no aliasing path; drop dependent columns before sharding)")
-    # the CSNE polish has no global-array implementation yet, so the AUTO
-    # policy degrades to the loud warning here (can_polish=False)
+    # the conditioning policy applies to global fits too (r3): the CSNE
+    # polish is pure jnp + shard_map, so it runs collectively on the
+    # global arrays exactly like the IRLS kernel
     from .conditioning import resolve_ill_conditioning
-    resolve_ill_conditioning(
-        float(np.asarray(out["pivot"])), is_f32=dtype != jnp.float64,
-        engine="einsum", polish_active=False, polish_cfg=config.polish,
-        can_polish=False)
+    polish_active = resolve_ill_conditioning(
+        float(np.asarray(out["pivot"])),
+        is_f32=np.dtype(dtype) != np.float64,
+        engine="einsum", polish_active=config.polish == "csne",
+        polish_cfg=config.polish, can_polish=True)
+    if polish_active:
+        beta_p, eta_p, cov_p = _csne_post(X, y, wd, od,
+                                          jnp.asarray(out["beta"]),
+                                          family=fam, link=lnk, mesh=mesh)
+        out = dict(out, beta=beta_p, eta=eta_p, cov_inv=cov_p)
 
     # host-f64 statistics from per-process partial sums
     from .validate import (check_finite_design, check_finite_vector,
@@ -865,10 +872,6 @@ def fit(
             raise ValueError("global-array fits use the einsum engine")
         if mesh is None:
             raise ValueError("pass the global mesh the arrays are sharded on")
-        if config.polish == "csne":
-            import warnings
-            warnings.warn("polish='csne' is not yet supported on "
-                          "global-array fits and is ignored", stacklevel=2)
         return _fit_global(X, y, weights, offset, fam, lnk, tol, max_iter,
                            criterion, xnames, yname, has_intercept, mesh,
                            verbose, config, beta0=beta0,
@@ -1081,7 +1084,8 @@ def fit(
     # design never pays (and then discards) the escalation TSQR pass
     from .conditioning import resolve_ill_conditioning
     polish_active = resolve_ill_conditioning(
-        float(out["pivot"]), is_f32=dtype == np.float32, engine=engine,
+        float(out["pivot"]), is_f32=np.dtype(dtype) != np.float64,
+        engine=engine,
         polish_active=polish_active, polish_cfg=config.polish,
         can_polish=not shard_features
         and mesh.shape[meshlib.MODEL_AXIS] == 1)

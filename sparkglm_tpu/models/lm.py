@@ -393,7 +393,8 @@ def fit(
     # polish cannot run — VERDICT r2 weak #4
     from .conditioning import resolve_ill_conditioning
     polish_active = resolve_ill_conditioning(
-        float(out["pivot"]), is_f32=dtype == np.float32, engine=engine,
+        float(out["pivot"]), is_f32=np.dtype(dtype) != np.float64,
+        engine=engine,
         polish_active=polish_active, polish_cfg=config.polish,
         can_polish=not shard_features
         and mesh.shape[meshlib.MODEL_AXIS] == 1)

@@ -463,7 +463,7 @@ def _warn_streaming_conditioning(pivot: float, dtype, config) -> None:
     resident fits' conditioning warning applies here too; the CSNE polish
     has no streaming implementation, hence can_polish=False (warn-only)."""
     from .conditioning import resolve_ill_conditioning
-    resolve_ill_conditioning(pivot, is_f32=np.dtype(dtype) == np.float32,
+    resolve_ill_conditioning(pivot, is_f32=np.dtype(dtype) != np.float64,
                              engine="einsum", polish_active=False,
                              polish_cfg=config.polish, can_polish=False,
                              stacklevel=4)

@@ -436,3 +436,112 @@ def test_multi_process_crash_resume(tmp_path):
     assert got["deviance"] == pytest.approx(ref.deviance, rel=1e-5)
     # resume cost: remaining iterations only (2 were done before the crash)
     assert got["iterations"] <= ref.iterations - 1
+
+
+_POLISH_WORKER = r"""
+import json, sys
+port, pid, out_path, nproc = sys.argv[1:5]
+nproc = int(nproc)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import sparkglm_tpu as sg
+from sparkglm_tpu.config import NumericConfig
+from sparkglm_tpu.parallel import distributed as dist
+
+dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                num_processes=nproc, process_id=int(pid))
+mesh = dist.global_mesh()
+
+# every process builds the same ill-conditioned design, takes its row slice
+rng = np.random.default_rng(31)
+n, p, kappa = 20_000, 10, 1e3
+Z = rng.standard_normal((n, p - 1))
+V, _ = np.linalg.qr(rng.standard_normal((p - 1, p - 1)))
+s = np.logspace(0, -np.log10(kappa), p - 1)
+X = np.column_stack([np.ones(n), (Z @ V) * s @ V.T])
+bt = rng.standard_normal(p) / np.sqrt(p)
+y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(np.float64)
+lo = int(pid) * (n // nproc); hi = n if int(pid) == nproc - 1 else lo + n // nproc
+tgt = dist.sync_max_rows(hi - lo, mesh)
+Xp, w = dist.pad_host_shard(X[lo:hi].astype(np.float32), tgt)
+yp, _ = dist.pad_host_shard(y[lo:hi].astype(np.float32), tgt)
+Xg = dist.host_shard_to_global(Xp, mesh)
+yg = dist.host_shard_to_global(yp, mesh)
+wg = dist.host_shard_to_global(w.astype(np.float32), mesh)
+
+import warnings
+with warnings.catch_warnings(record=True) as wl:
+    warnings.simplefilter("always")
+    model = sg.glm_fit(Xg, yg, weights=wg, family="binomial", mesh=mesh,
+                       has_intercept=True, criterion="relative", tol=1e-10,
+                       config=NumericConfig(dtype="float32"))
+if dist.process_index() == 0:
+    with open(out_path, "w") as f:
+        json.dump({"coefficients": model.coefficients.tolist(),
+                   "escalated": any("auto-applying the CSNE polish"
+                                    in str(w.message) for w in wl)}, f)
+print("polish worker", pid, "done", flush=True)
+"""
+
+
+def test_multi_process_auto_polish(tmp_path):
+    """The conditioning policy (default-args CSNE escalation) applies to
+    GLOBAL multi-process fits too — the polish's TSQR runs collectively."""
+    nproc = 2
+    port = _free_port()
+    out_path = tmp_path / "result.json"
+    worker_file = tmp_path / "worker.py"
+    worker_file.write_text(_POLISH_WORKER)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = "/root/repo" + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker_file), str(port), str(i),
+             str(out_path), str(nproc)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            cwd="/root/repo")
+        for i in range(nproc)
+    ]
+    logs = []
+    for pr in procs:
+        try:
+            out, _ = pr.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("polish workers timed out")
+        logs.append(out.decode())
+    for i, pr in enumerate(procs):
+        assert pr.returncode == 0, f"worker {i} failed:\n{logs[i][-3000:]}"
+    with open(out_path) as f:
+        got = json.load(f)
+    assert got["escalated"]
+
+    # single-process default-args fit of the same data is the oracle (it
+    # auto-polishes the same way)
+    import warnings
+
+    import sparkglm_tpu as sg
+    from sparkglm_tpu.config import NumericConfig
+    rng = np.random.default_rng(31)
+    n, p, kappa = 20_000, 10, 1e3
+    Z = rng.standard_normal((n, p - 1))
+    V, _ = np.linalg.qr(rng.standard_normal((p - 1, p - 1)))
+    s = np.logspace(0, -np.log10(kappa), p - 1)
+    X = np.column_stack([np.ones(n), (Z @ V) * s @ V.T])
+    bt = rng.standard_normal(p) / np.sqrt(p)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(np.float64)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ref = sg.glm_fit(X.astype(np.float32), y.astype(np.float32),
+                         family="binomial", criterion="relative", tol=1e-10,
+                         config=NumericConfig(dtype="float32"))
+    # two independently polished f32 solutions at kappa=1e3 agree to
+    # ~eps32*kappa*|beta| (coefficients here are O(10))
+    np.testing.assert_allclose(got["coefficients"], ref.coefficients,
+                               rtol=1e-3, atol=5e-4)
