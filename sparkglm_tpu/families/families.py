@@ -53,23 +53,73 @@ def _ylogyd(y, mu):
         y * jnp.log(jnp.maximum(y, _EPS) / jnp.maximum(mu, _EPS)))
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)
 class Family:
     name: str
     variance: Callable
-    dev_resids: Callable          # (y, mu, wt) -> per-row deviance
-    init_mu: Callable             # (y, wt) -> mu0 per row
+    dev_resids: Callable          # (y, mu, wt[, param]) -> per-row deviance
+    init_mu: Callable             # (y, wt[, param]) -> mu0 per row
     default_link: str
     dispersion_fixed: bool        # True: dispersion == 1 (binomial, poisson)
     # aic(dev_total, loglik_total, n_obs, n_params, wt) -> scalar; the ll
     # argument is the exact host-f64 R logLik from models/hoststats.py
     aic: Callable = None  # type: ignore[assignment]
+    # numeric family parameter (NB theta): the device callables then take
+    # it as their LAST argument, and it flows through the IRLS kernels as
+    # a TRACED operand — so glm.nb's theta search reuses ONE compiled
+    # kernel across every theta value instead of retracing per round
+    param: float | None = None
 
     def __post_init__(self):
         if self.aic is None:
             object.__setattr__(
                 self, "aic",
                 lambda dev, ll, n, p, wt_sum: -2.0 * ll + 2.0 * p)
+
+    # jit static-arg identity: the DEVICE callables + the flags that shape
+    # the compiled program — NOT the name, NOT the param VALUE (parametric
+    # families share one kernel; the param is a traced input), NOT the
+    # host-side aic.  Module-level callables make equal-math families
+    # (e.g. every negative_binomial(theta)) hash equal.
+    def _static_key(self):
+        return (self.variance, self.dev_resids, self.init_mu,
+                self.dispersion_fixed, self.param is None)
+
+    def __hash__(self):
+        return hash(self._static_key())
+
+    def __eq__(self, other):
+        return (isinstance(other, Family)
+                and self._static_key() == other._static_key())
+
+    def param_operand(self, dtype=None):
+        """The traced operand kernels thread through as ``fam_param`` —
+        None for parameterless families.  The ONE place the binding rule
+        lives (review r3)."""
+        if self.param is None:
+            return None
+        import jax.numpy as _jnp
+        return (_jnp.asarray(self.param, dtype) if dtype is not None
+                else self.param)
+
+    def with_param(self, param):
+        """Bind a TRACED param to the callables (no-op when the family has
+        none) — what the kernels call instead of touching ``param``
+        directly, so the value never enters the jaxpr as a constant."""
+        if self.param is None:
+            return self
+        if param is None:
+            # a call path forgot to thread fam_param: fail clearly at the
+            # boundary instead of a TypeError deep inside the math
+            raise ValueError(
+                f"family {self.name!r} is parametric; pass its traced "
+                "parameter (fam_param=family.param_operand(...)) to the "
+                "kernel")
+        import types
+        return types.SimpleNamespace(
+            variance=lambda mu: self.variance(mu, param),
+            dev_resids=lambda y, mu, wt: self.dev_resids(y, mu, wt, param),
+            init_mu=lambda y, wt: self.init_mu(y, wt, param))
 
 
 # ----------------------------------------------------------------------------
@@ -192,6 +242,26 @@ quasibinomial = dataclasses.replace(
 # (models/negbin.py) wraps it with the ML theta estimation loop
 # ----------------------------------------------------------------------------
 
+def _nb_variance(mu, theta):
+    return mu + mu * mu / theta
+
+
+def _nb_dev_resids(y, mu, wt, theta):
+    mu_c = jnp.maximum(mu, _EPS)
+    return 2.0 * wt * (
+        _ylogyd(y, mu_c)
+        - (y + theta) * jnp.log((y + theta) / (mu_c + theta)))
+
+
+def _nb_init_mu(y, wt, theta):
+    # MASS negative.binomial()$initialize: mustart = y + (y == 0)/6
+    return y + (y == 0) / 6.0
+
+
+def _nb_aic(dev_, ll, n, p, wt_sum):
+    return -2.0 * ll + 2.0 * (p + 1.0)
+
+
 def negative_binomial(theta: float) -> Family:
     """MASS's ``negative.binomial(theta)`` family (fixed shape ``theta``).
 
@@ -200,26 +270,24 @@ def negative_binomial(theta: float) -> Family:
     default link log; dispersion fixed at 1 (glm.nb reports "dispersion
     parameter ... taken to be 1"); AIC = -2*logLik + 2*(p+1) — glm.nb
     counts the estimated theta as a parameter.
+
+    theta rides the kernels as a TRACED param (module-level callables +
+    Family's value-free static key), so glm.nb's theta alternation
+    compiles the IRLS while_loop exactly once.
     """
     th = float(theta)
     if not np.isfinite(th) or th <= 0:
         raise ValueError(f"theta must be positive and finite, got {theta!r}")
 
-    def dev(y, mu, wt):
-        mu_c = jnp.maximum(mu, _EPS)
-        return 2.0 * wt * (
-            _ylogyd(y, mu_c)
-            - (y + th) * jnp.log((y + th) / (mu_c + th)))
-
     return Family(
         name=f"negative_binomial({th:.10g})",
-        variance=lambda mu: mu + mu * mu / th,
-        dev_resids=dev,
-        # MASS negative.binomial()$initialize: mustart = y + (y == 0)/6
-        init_mu=lambda y, wt: y + (y == 0) / 6.0,
+        variance=_nb_variance,
+        dev_resids=_nb_dev_resids,
+        init_mu=_nb_init_mu,
         default_link="log",
         dispersion_fixed=True,
-        aic=lambda dev_, ll, n, p, wt_sum: -2.0 * ll + 2.0 * (p + 1.0),
+        aic=_nb_aic,
+        param=th,
     )
 
 

@@ -76,6 +76,7 @@ def _irls_kernel(
     beta0=None,
     warm: bool = False,
     it_base=None,
+    fam_param=None,
 ):
     """Full IRLS to convergence in one compiled while_loop.
 
@@ -92,6 +93,10 @@ def _irls_kernel(
     acc = X.dtype if X.dtype == jnp.float64 else jnp.float32
     p = X.shape[1]
     valid = wt > 0
+    # parametric families (NB theta): the param is a TRACED operand — the
+    # static key excludes its value, so e.g. glm.nb's theta search shares
+    # one compiled kernel (families/families.py::Family.with_param)
+    family = family.with_param(fam_param)
 
     def dev_of(mu):
         return jnp.sum(_sanitize(family.dev_resids(y, mu, wt), valid))
@@ -255,13 +260,14 @@ def _segmented_irls(run_kernel, *, p, dtype, max_iter: int,
 
 @partial(jax.jit, static_argnames=("family", "link", "mesh", "steps"))
 def _csne_post(X, y, wt, off, beta, *, family: Family, link: Link,
-               mesh, steps: int = 2):
+               mesh, steps: int = 2, fam_param=None):
     """Post-convergence CSNE polish (ops/tsqr.py): rebuild (z, w) at the
     converged beta and tighten the final weighted LS solve — one extra,
     more accurate, Fisher step.  Returns (beta, eta, cov_inv) polished;
     the covariance comes from the TSQR factor so SEs match the polished
     coefficients' accuracy."""
     from ..ops.tsqr import csne_polish, rinv_gram
+    family = family.with_param(fam_param)
     valid = wt > 0
     eta = X @ beta + off
     mu = jnp.where(valid, link.inverse(eta), 1.0)
@@ -679,6 +685,7 @@ def _fit_global(
     dev_dtype = dtype if dtype == jnp.float64 else jnp.float32
     tol_run = effective_tol(tol, criterion, dev_dtype)
     tol_dev = jnp.asarray(tol_run, dev_dtype)
+    fam_param = fam.param_operand(dtype)
 
     def run_kernel(seg_iters, beta_arr, warm, it_base=0):
         return _irls_kernel(
@@ -690,6 +697,7 @@ def _fit_global(
             precision=config.matmul_precision,
             beta0=jnp.asarray(np.asarray(beta_arr), dtype), warm=warm,
             it_base=jnp.asarray(it_base, jnp.int32),
+            fam_param=fam_param,
         )
 
     if beta0 is not None or on_iteration is not None or checkpoint_every:
@@ -718,7 +726,8 @@ def _fit_global(
     if polish_active:
         beta_p, eta_p, cov_p = _csne_post(X, y, wd, od,
                                           jnp.asarray(out["beta"]),
-                                          family=fam, link=lnk, mesh=mesh)
+                                          family=fam, link=lnk, mesh=mesh,
+                                          fam_param=fam_param)
         out = dict(out, beta=beta_p, eta=eta_p, cov_inv=cov_p)
 
     # host-f64 statistics from per-process partial sums
@@ -752,7 +761,7 @@ def _fit_global(
             jnp.asarray(config.jitter, dtype),
             family=fam, link=lnk, criterion=criterion,
             refine_steps=config.refine_steps,
-            precision=config.matmul_precision)
+            precision=config.matmul_precision, fam_param=fam_param)
         eta0_loc = np.asarray(dist.local_rows_of(null_out["eta"]), np.float64)
         valid = wt_loc > 0
         mu0 = np.where(valid, hoststats.link_inverse(lnk.name, eta0_loc), 1.0)
@@ -956,6 +965,7 @@ def fit(
                   and config.matmul_precision is None
                   and not shard_features and mesh.shape[meshlib.MODEL_AXIS] == 1
                   and p <= 1024 and not checkpointing
+                  and fam.param is None  # Mosaic kernel takes no traced param
                   else "einsum")
     if engine not in ("einsum", "fused", "qr"):
         raise ValueError(
@@ -999,6 +1009,11 @@ def fit(
         raise ValueError(
             "beta0/on_iteration/checkpoint_every need the einsum or qr "
             "engine (the fused kernel's init pass is not warm-startable)")
+    if engine == "fused" and fam.param is not None:
+        raise ValueError(
+            "parametric families (negative binomial) need the einsum or qr "
+            "engine (the Mosaic kernel takes no traced family parameter)")
+    fam_param = fam.param_operand(dtype)
     if engine == "fused":
         out = _irls_fused_kernel(
             Xd, yd, wd, od, tol_dev,
@@ -1026,6 +1041,7 @@ def fit(
                 mesh=mesh if engine == "qr" else None,
                 beta0=jnp.asarray(beta_arr, dtype), warm=warm,
                 it_base=jnp.asarray(it_base, jnp.int32),
+                fam_param=fam_param,
             )
         if checkpointing:
             out = _segmented_irls(run_kernel, p=p, dtype=dtype,
@@ -1096,7 +1112,8 @@ def fit(
         # the TSQR factor so SEs match the polished accuracy
         beta_p, eta_p, cov_p = _csne_post(Xd, yd, wd, od,
                                           jnp.asarray(out["beta"]),
-                                          family=fam, link=lnk, mesh=mesh)
+                                          family=fam, link=lnk, mesh=mesh,
+                                          fam_param=fam_param)
         out["beta"] = np.asarray(beta_p)
         out["eta"] = np.asarray(eta_p)
         out["cov_inv"] = np.asarray(cov_p)
@@ -1126,7 +1143,7 @@ def fit(
             jnp.asarray(config.jitter, dtype),
             family=fam, link=lnk, criterion=criterion,
             refine_steps=config.refine_steps,
-            precision=config.matmul_precision)
+            precision=config.matmul_precision, fam_param=fam_param)
         null_dev = hoststats.null_deviance(
             fam.name, lnk.name, y64, wt64, off64, has_intercept,
             eta_null=np.asarray(null_out["eta"], np.float64)[:n])

@@ -244,13 +244,13 @@ def _put_chunk(Xc, yc, wc, oc, mesh, dtype):
 
 @partial(jax.jit, static_argnames=("family", "link", "first"))
 def _glm_chunk_pass(Xc, yc, wc, oc, beta, *, family: Family, link: Link,
-                    first: bool):
+                    first: bool, fam_param=None):
     # HIGHEST is pinned: streaming is H2D-bandwidth-bound, so the full-f32
     # Gramian passes are free and keep chunked accumulation at r02 accuracy
     # (the twin's None default now mirrors the fast Mosaic kernel instead)
     return fused_fisher_pass_ref(Xc, yc, wc, oc, beta,
                                  family=family, link=link, first=first,
-                                 precision="highest")
+                                 precision="highest", fam_param=fam_param)
 
 
 @jax.jit
@@ -753,7 +753,8 @@ def glm_fit_streaming(
             # blocking on chunk k's results: host IO/encode and H2D overlap
             # device compute (double buffering — ADVICE/VERDICT r1 #8)
             fut = _glm_chunk_pass(dX, dy, dw, do, b,
-                                  family=fam, link=lnk, first=first)
+                                  family=fam, link=lnk, first=first,
+                                  fam_param=fam.param_operand())
             if pending is not None:
                 drain(pending)
             pending = fut

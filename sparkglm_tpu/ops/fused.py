@@ -141,6 +141,10 @@ def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
     Returns:
       (XtWX (p,p), XtWz (p,), dev ()) — local sums; psum across data shards.
     """
+    if getattr(family, "param", None) is not None:
+        raise ValueError(
+            "the Mosaic kernel takes no traced family parameter; use the "
+            "einsum engine (or the XLA twin) for parametric families")
     n, p = X.shape
     if n % block_rows:
         raise ValueError(f"n={n} must be a multiple of block_rows={block_rows}")
@@ -181,7 +185,7 @@ def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
 
 def fused_fisher_pass_ref(X, y, wt, offset, beta, *, family, link,
                           first: bool = False, block_rows: int = 512,
-                          precision=None):
+                          precision=None, fam_param=None):
     """Plain-XLA twin of :func:`fused_fisher_pass` (identical math/signature);
     used on CPU meshes and as the correctness oracle for the kernel.  The
     Gramian precision default MIRRORS the Mosaic kernel (None -> DEFAULT for
@@ -189,6 +193,7 @@ def fused_fisher_pass_ref(X, y, wt, offset, beta, *, family, link,
     (which the kernel cannot run) always gets HIGHEST.  X'Wz stays HIGHEST
     either way — it is one matvec, and the kernel keeps it f32 on the VPU."""
     n, p = X.shape
+    family = family.with_param(fam_param)
     yc, wc, oc = (a.reshape(n, 1) for a in (y, wt, offset))
     Xw, z, _, dev = _step_math(X, yc, wc, oc, beta.reshape(1, p),
                                family=family, link=link, first=first)

@@ -92,3 +92,29 @@ def test_nb_rejects_negative_counts(mesh1, rng):
         sg.glm_fit(X, y, family=sg.negative_binomial(2.0), mesh=mesh1)
     with pytest.raises(ValueError, match="theta"):
         sg.negative_binomial(-1.0)
+
+
+def test_nb_theta_search_compiles_kernel_once(rng):
+    """theta rides the IRLS kernel as a TRACED operand (Family.with_param):
+    the whole glm.nb alternation — typically 5-25 theta values — adds at
+    most TWO kernel compilations (the poisson start + one shared NB
+    kernel), not one per theta (round-2 memory item: 'glm.nb retrace
+    cost')."""
+    import sparkglm_tpu as sg
+    from sparkglm_tpu.models.glm import _irls_kernel
+
+    n = 3000
+    x = rng.standard_normal(n)
+    mu = np.exp(0.4 + 0.5 * x)
+    y = rng.negative_binomial(2.0, 2.0 / (2.0 + mu)).astype(float)
+    base = _irls_kernel._cache_size()
+    m = sg.glm_nb("y ~ x", {"y": y, "x": x})
+    assert m.converged
+    added = _irls_kernel._cache_size() - base
+    assert added <= 2, f"theta search recompiled the kernel {added} times"
+    # and different theta values share the compiled kernel outright
+    from sparkglm_tpu.families.families import negative_binomial
+    assert negative_binomial(0.5) == negative_binomial(7.0)
+    assert hash(negative_binomial(0.5)) == hash(negative_binomial(7.0))
+    # ...while the recorded names still carry their theta
+    assert negative_binomial(0.5).name != negative_binomial(7.0).name
