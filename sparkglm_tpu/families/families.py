@@ -31,6 +31,7 @@ top-level ``glm()`` front-end converts counts+m into this form.
 from __future__ import annotations
 
 import dataclasses
+import types as _types
 from typing import Callable
 
 import jax.numpy as jnp
@@ -98,8 +99,7 @@ class Family:
         lives (review r3)."""
         if self.param is None:
             return None
-        import jax.numpy as _jnp
-        return (_jnp.asarray(self.param, dtype) if dtype is not None
+        return (jnp.asarray(self.param, dtype) if dtype is not None
                 else self.param)
 
     def with_param(self, param):
@@ -115,8 +115,7 @@ class Family:
                 f"family {self.name!r} is parametric; pass its traced "
                 "parameter (fam_param=family.param_operand(...)) to the "
                 "kernel")
-        import types
-        return types.SimpleNamespace(
+        return _types.SimpleNamespace(
             variance=lambda mu: self.variance(mu, param),
             dev_resids=lambda y, mu, wt: self.dev_resids(y, mu, wt, param),
             init_mu=lambda y, wt: self.init_mu(y, wt, param))

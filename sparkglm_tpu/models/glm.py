@@ -502,6 +502,20 @@ class GLMModel:
         from .serialize import save_model
         save_model(self, path)
 
+    def bic(self) -> float:
+        """R's ``BIC(glm)``: -2 logLik + log(nobs) * df, where df is the
+        parameter count the family's AIC used (so gaussian/Gamma/
+        inverse-gaussian count their dispersion, glm.nb its theta) and
+        nobs is R's n.ok = df_residual + rank (aliased columns carry no
+        rank); NaN for quasi families, like their AIC."""
+        if not np.isfinite(self.aic):
+            return float("nan")
+        df = (self.aic + 2.0 * self.loglik) / 2.0
+        rank = (self.n_params if self.aliased is None
+                else int(np.sum(~np.asarray(self.aliased, bool))))
+        return float(-2.0 * self.loglik
+                     + np.log(self.df_residual + rank) * df)
+
     def z_values(self) -> np.ndarray:
         with np.errstate(divide="ignore", invalid="ignore"):
             return self.coefficients / self.std_errors

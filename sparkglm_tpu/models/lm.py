@@ -152,11 +152,18 @@ class LMModel:
     has_weights: bool = False
 
     # -- scoring (LM.scala:29-61) --------------------------------------------
-    def predict(self, X, mesh=None, se_fit: bool = False):
+    def predict(self, X, mesh=None, se_fit: bool = False,
+                interval: str | None = None, level: float = 0.95,
+                pred_weights=None):
         """X·beta. Accepts an (n,p) array aligned to ``xnames``; the formula
         front-end (api.py) handles model-matrix/column matching first.
         With ``se_fit`` returns ``(fit, se)`` where se_i = sqrt(x_i' V x_i)
         (R's ``predict.lm(se.fit=TRUE)``).
+
+        ``interval="confidence"``/``"prediction"`` returns the (n, 3)
+        [fit, lwr, upr] matrix of R's ``predict.lm``: t-quantile bands on
+        the mean (confidence) or on a new observation — se widened by the
+        residual variance (prediction).
 
         ``mesh``: score over a device mesh as one row-sharded SPMD pass
         (models/scoring.py — the reference's executor-side
@@ -167,6 +174,36 @@ class LMModel:
             raise ValueError(
                 f"predict expects (n, {self.n_params}) design matrix aligned to "
                 f"xnames={list(self.xnames)}; got {X.shape}")
+        if interval is not None:
+            if interval not in ("confidence", "prediction"):
+                raise ValueError(
+                    f"interval must be 'confidence' or 'prediction', "
+                    f"got {interval!r}")
+            from scipy import stats
+            fit, se_mean = self.predict(X, mesh=mesh, se_fit=True)
+            if interval == "confidence":
+                se_band = se_mean
+            else:
+                # new-observation variance sigma^2 / w_i: pass per-row
+                # weights for a WLS fit; like R, assume constant variance
+                # (w = 1) with a warning when they are not supplied
+                if pred_weights is None:
+                    if self.has_weights:
+                        import warnings
+                        warnings.warn(
+                            "prediction intervals on a weighted fit assume "
+                            "constant variance; pass pred_weights= for "
+                            "per-row variances (R warns here too)",
+                            stacklevel=2)
+                    var_new = self.sigma ** 2
+                else:
+                    var_new = self.sigma ** 2 / np.asarray(pred_weights,
+                                                           np.float64)
+                se_band = np.sqrt(se_mean ** 2 + var_new)
+            half = stats.t.ppf(0.5 + level / 2.0, self.df_resid) * se_band
+            out = np.stack([fit, fit - half, fit + half], axis=1)
+            # R's se.fit is always the MEAN's standard error
+            return (out, se_mean) if se_fit else out
         if mesh is not None:
             from .scoring import predict_sharded
             return predict_sharded(
@@ -195,6 +232,42 @@ class LMModel:
     def save(self, path: str) -> None:
         from .serialize import save_model
         save_model(self, path)
+
+    def loglik(self, weights=None) -> float:
+        """R's ``logLik.lm``: -n/2 (log(2 pi SSE/n) + 1), over the
+        POSITIVE-weight observations (R drops w == 0 from both n and
+        sum(log w)).  Weighted fits need the fit-time weights passed back
+        in — models do not retain them."""
+        if self.has_weights and weights is None:
+            raise ValueError(
+                "logLik of a weighted lm needs the fit-time weights "
+                "(models do not retain them): model.loglik(weights=w)")
+        if weights is None:
+            n = self.n_obs
+            sum_log_w = 0.0
+        else:
+            w = np.asarray(weights, np.float64)
+            pos = w > 0
+            n = int(pos.sum())
+            sum_log_w = float(np.sum(np.log(w[pos])))
+        return float(0.5 * (sum_log_w
+                            - n * (np.log(2.0 * np.pi * self.sse / n) + 1.0)))
+
+    def loglik_weighted(self, weights) -> float:
+        return self.loglik(weights=weights)
+
+    def aic(self, weights=None) -> float:
+        """R's ``AIC(lm)``: -2 logLik + 2 (p + 1) — sigma^2 counts."""
+        return -2.0 * self.loglik(weights=weights) + 2.0 * (self.n_params + 1)
+
+    def bic(self, weights=None) -> float:
+        """R's ``BIC(lm)``: -2 logLik + log(nobs) (p + 1), nobs = the
+        positive-weight row count (R's n.ok)."""
+        rank = (self.n_params if self.aliased is None
+                else int(np.sum(~np.asarray(self.aliased, bool))))
+        n_ok = self.df_resid + rank
+        return (-2.0 * self.loglik(weights=weights)
+                + np.log(n_ok) * (self.n_params + 1))
 
     def t_values(self) -> np.ndarray:
         with np.errstate(divide="ignore", invalid="ignore"):

@@ -1,0 +1,114 @@
+"""LM logLik/AIC/BIC and predict intervals — R's stats verbs."""
+
+import numpy as np
+import pytest
+from scipy import stats
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.config import NumericConfig
+
+F64 = NumericConfig(dtype="float64")
+
+
+@pytest.fixture
+def dat(rng):
+    n = 300
+    x = rng.standard_normal(n)
+    y = 1.0 + 2.0 * x + 0.5 * rng.standard_normal(n)
+    return {"y": y, "x": x}
+
+
+def test_lm_loglik_aic_bic_match_gaussian_glm(dat):
+    """logLik.lm/AIC/BIC vs the INDEPENDENT host-f64 gaussian GLM logLik
+    (models/hoststats.py): same model, two implementations."""
+    ml = sg.lm("y ~ x", dat, config=F64)
+    mg = sg.glm("y ~ x", dat, family="gaussian", link="identity", config=F64)
+    assert ml.loglik() == pytest.approx(mg.loglik, rel=1e-9)
+    assert ml.aic() == pytest.approx(mg.aic, rel=1e-9)
+    n, k = ml.n_obs, ml.n_params + 1
+    assert ml.bic() == pytest.approx(ml.aic() - 2 * k + np.log(n) * k,
+                                     rel=1e-12)
+    assert mg.bic() == pytest.approx(ml.bic(), rel=1e-9)
+
+
+def test_weighted_lm_loglik_needs_weights(dat, rng):
+    w = rng.uniform(0.5, 2.0, len(dat["x"]))
+    d = dict(dat, w=w)
+    ml = sg.lm("y ~ x", d, weights="w", config=F64)
+    with pytest.raises(ValueError, match="weights"):
+        ml.loglik()
+    mg = sg.glm("y ~ x", d, family="gaussian", link="identity",
+                weights="w", config=F64)
+    assert ml.loglik_weighted(w) == pytest.approx(mg.loglik, rel=1e-9)
+
+
+def test_glm_bic_quasi_nan(rng):
+    x = rng.standard_normal(200)
+    y = rng.poisson(np.exp(0.3 + 0.5 * x)).astype(float)
+    m = sg.glm("y ~ x", {"y": y, "x": x}, family="quasipoisson", config=F64)
+    assert np.isnan(m.bic())
+
+
+def test_predict_intervals(dat):
+    m = sg.lm("y ~ x", dat, config=F64)
+    from sparkglm_tpu.data.model_matrix import transform
+    Xn = transform({"x": np.array([-1.0, 0.0, 2.0])}, m.terms,
+                   dtype=np.float64)
+    ci = m.predict(Xn, interval="confidence")
+    pi = m.predict(Xn, interval="prediction")
+    assert ci.shape == (3, 3) and pi.shape == (3, 3)
+    fit, se = m.predict(Xn, se_fit=True)
+    t = stats.t.ppf(0.975, m.df_resid)
+    np.testing.assert_allclose(ci[:, 0], fit, rtol=1e-12)
+    np.testing.assert_allclose(ci[:, 1], fit - t * se, rtol=1e-10)
+    np.testing.assert_allclose(pi[:, 2],
+                               fit + t * np.sqrt(se**2 + m.sigma**2),
+                               rtol=1e-10)
+    # prediction bands are strictly wider, both contain the fit
+    assert np.all(pi[:, 1] < ci[:, 1]) and np.all(pi[:, 2] > ci[:, 2])
+    # se.fit returned alongside an interval is the MEAN's se (R semantics)
+    out, se2 = m.predict(Xn, interval="prediction", se_fit=True)
+    np.testing.assert_allclose(se2, se, rtol=1e-12)
+    with pytest.raises(ValueError, match="interval"):
+        m.predict(Xn, interval="bogus")
+    # through the formula front-end
+    ci2 = sg.predict(m, {"x": np.array([-1.0, 0.0, 2.0])},
+                     interval="confidence")
+    np.testing.assert_allclose(ci2, ci, rtol=1e-6)
+
+
+def test_prediction_interval_coverage(rng):
+    """~95% of NEW observations fall inside the 95% prediction band."""
+    n = 2000
+    x = rng.standard_normal(n)
+    y = 0.5 + 1.5 * x + 0.7 * rng.standard_normal(n)
+    m = sg.lm("y ~ x", {"y": y[:1000], "x": x[:1000]}, config=F64)
+    from sparkglm_tpu.data.model_matrix import transform
+    Xn = transform({"x": x[1000:]}, m.terms, dtype=np.float64)
+    pi = m.predict(Xn, interval="prediction")
+    cover = np.mean((y[1000:] >= pi[:, 1]) & (y[1000:] <= pi[:, 2]))
+    assert 0.92 < cover < 0.98
+
+
+def test_weighted_prediction_interval_weights(dat, rng):
+    """R's predict.lm: weighted fits warn when prediction variance is
+    assumed constant; pred_weights gives per-row variance sigma^2/w."""
+    w = rng.uniform(0.5, 2.0, len(dat["x"]))
+    d = dict(dat, w=w)
+    m = sg.lm("y ~ x", d, weights="w", config=F64)
+    from sparkglm_tpu.data.model_matrix import transform
+    Xn = transform({"x": np.array([0.0, 1.0])}, m.terms, dtype=np.float64)
+    with pytest.warns(UserWarning, match="constant prediction|constant variance"):
+        m.predict(Xn, interval="prediction")
+    pw = np.array([4.0, 0.25])
+    pi = m.predict(Xn, interval="prediction", pred_weights=pw)
+    fit, se = m.predict(Xn, se_fit=True)
+    t = stats.t.ppf(0.975, m.df_resid)
+    np.testing.assert_allclose(
+        pi[:, 2], fit + t * np.sqrt(se**2 + m.sigma**2 / pw), rtol=1e-10)
+    # zero-weight rows drop out of logLik like R
+    w0 = w.copy(); w0[:10] = 0.0
+    m0 = sg.lm("y ~ x", dict(dat, w=w0), weights="w", config=F64)
+    ll = m0.loglik(weights=w0)
+    assert np.isfinite(ll)
+    assert np.isfinite(m0.aic(weights=w0)) and np.isfinite(m0.bic(weights=w0))
