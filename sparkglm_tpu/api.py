@@ -182,10 +182,14 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         offset=None, m=None, tol: float = 1e-8, max_iter: int = 100,
         criterion: str = "relative", na_omit: bool = True, mesh=None,
         engine: str = "auto", singular: str = "drop", verbose: bool = False,
+        beta0=None, on_iteration=None, checkpoint_every: int = 0,
         config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """R-style ``glm(formula, data, family, link, ...)``.
 
-    ``offset``/``m`` may be column names in ``data`` or arrays."""
+    ``offset``/``m`` may be column names in ``data`` or arrays.
+    ``beta0`` is R's ``start=`` (warm-start coefficients — e.g. a
+    checkpoint); ``on_iteration``/``checkpoint_every`` surface the
+    compiled IRLS in segments for checkpoint/resume (models/glm.py)."""
     f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
                                          dtype=np.dtype(config.dtype),
                                          extra_cols=(weights, offset, m))
@@ -212,7 +216,9 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         tol=tol,
         max_iter=max_iter, criterion=criterion, xnames=terms.xnames,
         yname=yname, has_intercept=f.intercept, mesh=mesh,
-        engine=engine, singular=singular, verbose=verbose, config=config)
+        engine=engine, singular=singular, verbose=verbose,
+        beta0=beta0, on_iteration=on_iteration,
+        checkpoint_every=checkpoint_every, config=config)
     import dataclasses
     return dataclasses.replace(
         model, formula=str(f), terms=terms,
