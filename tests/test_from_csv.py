@@ -217,3 +217,60 @@ def test_confint_profile_on_from_csv_model(tmp_path, rng):
     m_res = sg.glm("y ~ x", {"y": y, "x": x}, family="poisson")
     ci_res = sg.confint_profile(m_res, {"y": y, "x": x})
     np.testing.assert_allclose(ci_csv, ci_res, rtol=1e-5, atol=1e-7)
+
+
+def test_parse_cache_wrap_unit(tmp_path, rng):
+    """VERDICT r2 weak #7: the parsed-chunk disk tier — each chunk parses
+    ONCE, later passes memory-map; cleanup removes the tier."""
+    import os
+
+    from sparkglm_tpu.api import _parse_cache_wrap
+
+    calls = {"n": 0}
+    X0 = rng.standard_normal((40, 3))
+    y0 = rng.standard_normal(40)
+
+    def extract(i):
+        calls["n"] += 1
+        return X0 + i, y0 + i, None, None
+
+    wrapped, cleanup = _parse_cache_wrap(extract, True, 10_000)
+    for _ in range(3):          # three passes over two chunks
+        for i in range(2):
+            X, y, w, off = wrapped(i)
+            np.testing.assert_allclose(np.asarray(X), X0 + i)
+            np.testing.assert_allclose(np.asarray(y), y0 + i)
+            assert w is None and off is None
+    # first touch skips the write (may be the only extract: the HBM cache
+    # pins hot chunks), second touch parses AND persists -> 2 per chunk
+    assert calls["n"] == 4
+    # mmap-backed on the cached path
+    X, _, _, _ = wrapped(0)
+    assert isinstance(X, np.memmap)
+    assert calls["n"] == 4      # third+ touches load, never parse
+    cleanup()
+    # disabled mode is a passthrough
+    wrapped2, cleanup2 = _parse_cache_wrap(extract, False, 10_000)
+    wrapped2(0)
+    assert calls["n"] == 5
+    cleanup2()
+
+
+def test_parse_cache_fit_parity(tmp_path, rng):
+    """glm_from_csv with the disk tier on vs off: identical models (the
+    tier changes WHERE chunks come from, never their content)."""
+    import sparkglm_tpu as sg
+    n = 400
+    x = rng.standard_normal(n)
+    w = rng.uniform(0.5, 2.0, n)
+    y = rng.poisson(np.exp(0.2 + 0.5 * x)).astype(float)
+    p = tmp_path / "d.csv"
+    with open(p, "w") as fh:
+        fh.write("y,x,w\n")
+        for i in range(n):
+            fh.write(f"{y[i]},{x[i]},{w[i]}\n")
+    kw = dict(family="poisson", weights="w", chunk_bytes=2048, cache="none")
+    m_on = sg.glm_from_csv("y ~ x", str(p), parse_cache=True, **kw)
+    m_off = sg.glm_from_csv("y ~ x", str(p), parse_cache=False, **kw)
+    np.testing.assert_array_equal(m_on.coefficients, m_off.coefficients)
+    assert m_on.deviance == m_off.deviance
