@@ -14,8 +14,8 @@ Quick start::
     mu = sg.predict(model, new_data)
 """
 
-from .api import (confint_profile, glm, glm_from_csv, glm_nb, lm,
-                  lm_from_csv, predict, update)
+from .api import (TermsPrediction, confint_profile, glm,
+                  glm_from_csv, glm_nb, lm, lm_from_csv, predict, update)
 from .config import DEFAULT, NumericConfig
 from .data.formula import Formula, parse_formula
 from .data.frame import as_columns, omit_na
@@ -47,6 +47,7 @@ __all__ = [
     "lm_fit_streaming", "glm_fit_streaming",
     "LMModel", "GLMModel", "load_model", "save_model",
     "anova", "drop1", "AnovaTable", "confint_profile",
+    "TermsPrediction",
     "hatvalues", "rstandard", "cooks_distance",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
     "quasi", "negative_binomial", "glm_nb", "glm_fit_nb", "theta_of",

@@ -144,36 +144,39 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
     return f, X, y, terms, cols, keep
 
 
-def lm(formula: str, data, *, weights=None, na_omit: bool = True, mesh=None,
+def lm(formula: str, data, *, weights=None, offset=None,
+       na_omit: bool = True, mesh=None,
        singular: str = "drop", engine: str = "auto",
        config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """R-style ``lm(formula, data)`` (ref: sparkLM, R/pkg/R/LM.R:24-44).
 
     Like R, rank-deficient designs drop later aliased columns and report
-    NaN coefficients (``singular="error"`` to raise instead)."""
+    NaN coefficients (``singular="error"`` to raise instead).  ``offset``
+    (argument or ``offset()`` formula terms) follows R's ``lm`` semantics:
+    coefficients solve the y - offset regression, fitted values include
+    the offset, R^2/F use the fitted-based moments of summary.lm."""
     f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
                                          dtype=np.dtype(config.dtype),
-                                         extra_cols=(weights,))
+                                         extra_cols=(weights, offset))
     if f.response2 is not None:
         raise ValueError(
             "cbind() responses are for binomial glm(); lm() fits a single "
             "numeric response")
-    if f.offsets:
-        raise ValueError(
-            "offset() terms are not supported in lm() (linear models have "
-            "no offset; absorb it by regressing y - offset)")
     weights_arg = weights
     if isinstance(weights, str):
         weights = cols[weights]  # column name, post-NA-omit (same as glm)
     elif weights is not None:
         weights = _subset_extra(weights, keep, "weights")
+    off_arr = _assemble_offset(f, cols, keep, offset)
     model = lm_mod.fit(
-        X, y, weights=weights, xnames=terms.xnames, yname=f.response,
+        X, y, weights=weights, offset=off_arr, xnames=terms.xnames,
+        yname=f.response,
         has_intercept=f.intercept, mesh=mesh, singular=singular,
         engine=engine, config=config)
     import dataclasses
     return dataclasses.replace(
         model, formula=str(f), terms=terms,
+        offset_col=_offset_col_value(f, offset),
         weights_col=weights_arg if isinstance(weights_arg, str) else None,
         has_weights=weights_arg is not None)
 

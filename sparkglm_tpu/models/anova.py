@@ -230,7 +230,8 @@ def drop1(model, data, *, test: str | None = None, weights=None,
                                     link=model.link, weights=weights,
                                     tol=model.tol, **fit_kw)
         if is_lm:
-            return api.lm(formula, data, weights=weights, **fit_kw)
+            return api.lm(formula, data, weights=weights, offset=offset,
+                          **fit_kw)
         return api.glm(formula, data, family=model.family, link=model.link,
                        weights=weights, offset=offset, m=m, tol=model.tol,
                        **fit_kw)

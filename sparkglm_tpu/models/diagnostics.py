@@ -95,7 +95,7 @@ def rstandard(model, data, y, *, weights=None, offset=None, m=None) -> np.ndarra
         d = model.residuals(X, y, type="deviance", offset=offset,
                             weights=weights, m=m)
         return d / (np.sqrt(model.dispersion) * denom)
-    resid = np.asarray(model.residuals(X, y), np.float64)
+    resid = np.asarray(model.residuals(X, y, offset=offset), np.float64)
     n = X.shape[0]
     w = np.ones(n) if weights is None else np.asarray(weights, np.float64)
     return resid * np.sqrt(w) / (model.sigma * denom)
@@ -112,5 +112,5 @@ def cooks_distance(model, data, y, *, weights=None, offset=None,
         pe = model.residuals(X, y, type="pearson", offset=offset,
                              weights=weights, m=m)
         return (pe / om) ** 2 * h / (model.dispersion * p)
-    rs = rstandard(model, X, y, weights=weights)
+    rs = rstandard(model, X, y, weights=weights, offset=offset)
     return rs * rs * h / (om * p)

@@ -150,11 +150,15 @@ class LMModel:
     # re-evaluates the original call including weights= (ADVICE r2)
     weights_col: str | None = None
     has_weights: bool = False
+    # R's lm(offset=): recorded like the GLM fields so predict()/update()
+    # recover a by-name offset and refuse to silently drop an array one
+    has_offset: bool = False
+    offset_col: str | None = None
 
     # -- scoring (LM.scala:29-61) --------------------------------------------
     def predict(self, X, mesh=None, se_fit: bool = False,
                 interval: str | None = None, level: float = 0.95,
-                pred_weights=None):
+                pred_weights=None, offset=None):
         """X·beta. Accepts an (n,p) array aligned to ``xnames``; the formula
         front-end (api.py) handles model-matrix/column matching first.
         With ``se_fit`` returns ``(fit, se)`` where se_i = sqrt(x_i' V x_i)
@@ -180,7 +184,8 @@ class LMModel:
                     f"interval must be 'confidence' or 'prediction', "
                     f"got {interval!r}")
             from scipy import stats
-            fit, se_mean = self.predict(X, mesh=mesh, se_fit=True)
+            fit, se_mean = self.predict(X, mesh=mesh, se_fit=True,
+                                        offset=offset)
             if interval == "confidence":
                 se_band = se_mean
             else:
@@ -207,10 +212,11 @@ class LMModel:
         if mesh is not None:
             from .scoring import predict_sharded
             return predict_sharded(
-                X, self.coefficients, mesh=mesh,
+                X, self.coefficients, mesh=mesh, offset=offset,
                 vcov=self.vcov() if se_fit else None, se_fit=se_fit)
         if se_fit:
-            return self.predict(X), _row_quadform(X, self.vcov())
+            return (self.predict(X, offset=offset),
+                    _row_quadform(X, self.vcov()))
         from ..config import x64_enabled
         if not np.issubdtype(X.dtype, np.floating) or x64_enabled():
             # f64 whenever x64 allows it — the same precision contract as
@@ -221,7 +227,10 @@ class LMModel:
         Xj = jnp.asarray(X)
         # aliased (NaN) coefficients contribute nothing (R reduced basis)
         beta = jnp.asarray(np.nan_to_num(self.coefficients), dtype=Xj.dtype)
-        return np.asarray(_predict_jit(Xj, beta))
+        fit = np.asarray(_predict_jit(Xj, beta))
+        if offset is not None:
+            fit = fit + np.asarray(offset, np.float64)
+        return fit
 
     def summary(self):
         from .summary import LMSummary
@@ -297,10 +306,10 @@ class LMModel:
         return np.stack([self.coefficients - half,
                          self.coefficients + half], axis=1)
 
-    def residuals(self, X, y) -> np.ndarray:
-        """Response residuals y - X beta (models do not retain training
-        data; pass it back in)."""
-        return _squeeze_column(y) - self.predict(X)
+    def residuals(self, X, y, offset=None) -> np.ndarray:
+        """Response residuals y - fitted (models do not retain training
+        data; pass it back in, including any fit-time offset)."""
+        return _squeeze_column(y) - self.predict(X, offset=offset)
 
 
 @jax.jit
@@ -354,6 +363,7 @@ def fit(
     y,
     *,
     weights=None,
+    offset=None,
     xnames: Sequence[str] | None = None,
     yname: str = "y",
     has_intercept: bool | None = None,
@@ -376,6 +386,11 @@ def fit(
     "qr" replaces the solve with TSQR + a corrected seminormal step
     (ops/tsqr.py) — error ~eps*kappa(X) instead of ~eps*kappa^2, for
     ill-conditioned designs at float32.
+
+    ``offset``: R's ``lm(offset=)`` — a known additive component of the
+    mean.  Coefficients solve the y - offset regression; fitted values,
+    R^2 and F follow R's summary.lm fitted-based moments (mss =
+    sum w (f - wmean(f))^2 with f INCLUDING the offset).
     """
     if singular not in ("error", "drop"):
         raise ValueError(f"singular must be 'error' or 'drop', got {singular!r}")
@@ -423,9 +438,20 @@ def fit(
     w_host = np.ones((n,), dtype=dtype) if weights is None else np.asarray(weights, dtype=dtype)
     if w_host.shape != (n,):
         raise ValueError("weights must be shape (n,)")
+    off64 = None
+    y_fit = y
+    if offset is not None:
+        off64 = np.asarray(offset, np.float64).reshape(-1)
+        if off64.shape != (n,):
+            raise ValueError(f"offset must be shape ({n},), got {off64.shape}")
+        # solve the adjusted regression; every downstream residual/SSE
+        # quantity is exact for the original y with fitted = X beta + offset
+        y_fit = (np.asarray(y, np.float64) - off64).astype(y.dtype
+                 if np.issubdtype(np.asarray(y).dtype, np.floating)
+                 else np.float64)
 
     Xd = meshlib.shard_rows(X.astype(dtype, copy=False), mesh, shard_features=shard_features)
-    yd = meshlib.shard_rows(y.astype(dtype, copy=False), mesh)
+    yd = meshlib.shard_rows(np.asarray(y_fit).astype(dtype, copy=False), mesh)
     # zero weight on padding rows keeps them inert in every reduction
     wd = meshlib.shard_rows(w_host, mesh)
 
@@ -444,7 +470,7 @@ def fit(
         mask = independent_columns(out["XtWX"].astype(np.float64),
                                    tol=rank_tol)
         if not mask.all() and mask.any():
-            sub = fit(X[:, mask], y, weights=weights,
+            sub = fit(X[:, mask], y, weights=weights, offset=offset,
                       xnames=tuple(np.asarray(xnames)[mask]), yname=yname,
                       has_intercept=has_intercept, mesh=mesh,
                       shard_features=shard_features, singular="error",
@@ -485,7 +511,9 @@ def fit(
         cov_p = np.asarray(rinv_gram(R, p, R.dtype), np.float64)
         out["cov_unscaled"] = cov_p
         out["diag_inv"] = np.diag(cov_p)
-        resid = y.astype(np.float64) - X.astype(np.float64) @ beta_p
+        xb64 = X.astype(np.float64) @ beta_p
+        out["_xb64"] = xb64  # reused by the offset mss below: one matvec
+        resid = np.asarray(y_fit, np.float64) - xb64
         out["sse"] = np.float64(
             np.sum(w_host.astype(np.float64) * resid * resid))
 
@@ -494,7 +522,25 @@ def fit(
     df_model = p - (1 if has_intercept else 0)
     df_resid = n_ok - p
     sse = float(out["sse"])
-    sst = float(out["sst_centered"] if has_intercept else out["sst_raw"])
+    if off64 is not None:
+        # R's summary.lm with an offset: mss from the FITTED values
+        # f = X beta + offset (weighted mean under w); sst := mss + rss so
+        # r2 = 1 - sse/sst and F = ((sst-sse)/df_m)/sigma2 reproduce R's
+        # mss/(mss+rss) and (mss/df_m)/sigma2 exactly (the polish block's
+        # matvec is reused when it ran)
+        xb64 = out.get("_xb64")
+        if xb64 is None:
+            xb64 = X.astype(np.float64) @ out["beta"].astype(np.float64)
+        f64 = xb64 + off64
+        w64 = w_host.astype(np.float64)
+        if has_intercept:
+            fbar = float(np.sum(w64 * f64) / np.sum(w64))
+            mss = float(np.sum(w64 * (f64 - fbar) ** 2))
+        else:
+            mss = float(np.sum(w64 * f64 * f64))
+        sst = mss + sse
+    else:
+        sst = float(out["sst_centered"] if has_intercept else out["sst_raw"])
     sigma2 = sse / df_resid if df_resid > 0 else np.nan
     r2 = 1.0 - sse / sst if sst > 0 else np.nan
     adj_r2 = 1.0 - (1.0 - r2) * (n_ok - (1 if has_intercept else 0)) / df_resid if df_resid > 0 else np.nan
@@ -519,4 +565,5 @@ def fit(
         has_intercept=bool(has_intercept),
         n_shards=mesh.shape[meshlib.DATA_AXIS],
         cov_unscaled=out["cov_unscaled"].astype(np.float64),
+        has_offset=bool(off64 is not None and np.any(off64 != 0)),
     )

@@ -503,9 +503,8 @@ def lm_fit_streaming(
         for Xc, yc, wc, oc in _iter_chunks(chunks):
             if oc is not None and np.any(np.asarray(oc) != 0):
                 raise ValueError(
-                    "lm_fit_streaming does not support an offset (linear "
-                    "models have no offset; absorb it by regressing "
-                    "y - offset)")
+                    "lm_fit_streaming does not support an offset yet; use "
+                    "the resident lm(offset=) or stream y - offset")
             if dtype is None:
                 dtype = _resolve_dtype(Xc, config)
             if has_intercept is None:

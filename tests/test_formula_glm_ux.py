@@ -108,13 +108,15 @@ def test_offset_term_travels_to_predict(mesh8, rng, tmp_path):
         sg.predict(m, {"x": np.array([0.0])})
 
 
-def test_lm_rejects_cbind_and_offset(rng):
+def test_lm_rejects_cbind_and_supports_offset(rng):
     d = {"y": rng.normal(size=10), "y2": rng.normal(size=10),
          "x": rng.normal(size=10), "t": rng.normal(size=10)}
     with pytest.raises(ValueError, match="cbind"):
         sg.lm("cbind(y, y2) ~ x", d)
-    with pytest.raises(ValueError, match="offset"):
-        sg.lm("y ~ x + offset(t)", d)
+    # offset() is SUPPORTED in lm since r3 (R's lm(offset=) semantics —
+    # test_lm_inference_extras.py::test_lm_offset_r_semantics)
+    m = sg.lm("y ~ x + offset(t)", d)
+    assert m.has_offset and m.offset_col == "t"
 
 
 def test_cbind_na_omission(mesh8, rng):

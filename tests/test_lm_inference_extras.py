@@ -112,3 +112,65 @@ def test_weighted_prediction_interval_weights(dat, rng):
     ll = m0.loglik(weights=w0)
     assert np.isfinite(ll)
     assert np.isfinite(m0.aic(weights=w0)) and np.isfinite(m0.bic(weights=w0))
+
+
+def test_lm_offset_r_semantics(rng):
+    """R's lm(offset=): coefficients solve the y-offset regression; fitted
+    values include the offset; R^2/F use summary.lm's fitted-based mss."""
+    from oracle import ols_np
+
+    n = 400
+    x = rng.standard_normal(n)
+    off = rng.uniform(-1, 1, n)
+    y = 2.0 + 1.5 * x + off + 0.3 * rng.standard_normal(n)
+    d = {"y": y, "x": x, "off": off}
+    m = sg.lm("y ~ x + offset(off)", d, config=F64)
+    b64 = ols_np(np.column_stack([np.ones(n), x]), y - off)
+    np.testing.assert_allclose(m.coefficients, b64, rtol=1e-9)
+    assert m.has_offset and m.offset_col == "off"
+
+    # fitted values include the offset; residuals match
+    fit = sg.predict(m, d)
+    np.testing.assert_allclose(
+        fit, np.column_stack([np.ones(n), x]) @ b64 + off,
+        rtol=1e-6, atol=1e-6)  # scoring design materialises at f32
+    # R^2 = mss/(mss+rss) with f including the offset
+    r = y - fit
+    rss = float(np.sum(r * r))
+    mss = float(np.sum((fit - fit.mean()) ** 2))
+    assert m.r_squared == pytest.approx(mss / (mss + rss), rel=1e-5)
+    assert m.f_statistic == pytest.approx(
+        (mss / m.df_model) / (rss / m.df_resid), rel=1e-5)
+
+    # update() carries the offset() term; drop1 runs
+    m2 = sg.update(m, "~ . ", d)
+    # update refits at the DEFAULT config (f32 design) — config is a fit
+    # argument, not model state
+    np.testing.assert_allclose(m2.coefficients, m.coefficients, rtol=1e-6)
+    from sparkglm_tpu.models.anova import drop1
+    t = drop1(m, d)
+    assert t.row_names == ("<none>", "x")
+
+    # an offset= ARRAY cannot be recovered at scoring: predict refuses
+    ma = sg.lm("y ~ x", d, offset=off, config=F64)
+    with pytest.raises(ValueError, match="offset"):
+        sg.predict(ma, d)
+    np.testing.assert_allclose(ma.coefficients, m.coefficients, rtol=1e-9)
+
+
+def test_lm_offset_weighted_no_intercept(rng):
+    n = 300
+    x = rng.uniform(0.5, 2.0, n)
+    off = 0.3 * rng.standard_normal(n)
+    w = rng.uniform(0.5, 2.0, n)
+    y = 2.0 * x + off + 0.2 * rng.standard_normal(n) / np.sqrt(w)
+    d = {"y": y, "x": x, "off": off, "w": w}
+    m = sg.lm("y ~ x + offset(off) - 1", d, weights="w", config=F64)
+    # weighted closed form on the adjusted response
+    b = float(np.sum(w * x * (y - off)) / np.sum(w * x * x))
+    assert m.coefficients[0] == pytest.approx(b, rel=1e-9)
+    # no-intercept R^2: mss = sum(w f^2) (uncentered), f incl. offset
+    f = b * x + off
+    rss = float(np.sum(w * (y - f) ** 2))
+    mss = float(np.sum(w * f * f))
+    assert m.r_squared == pytest.approx(mss / (mss + rss), rel=1e-6)
