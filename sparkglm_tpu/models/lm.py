@@ -232,9 +232,11 @@ class LMModel:
             fit = fit + np.asarray(offset, np.float64)
         return fit
 
-    def summary(self):
+    def summary(self, residuals=None):
+        """R-style summary; pass ``residuals=model.residuals(X, y)`` to
+        render R's "Residuals:" quantile block (models retain no data)."""
         from .summary import LMSummary
-        return LMSummary.from_model(self)
+        return LMSummary.from_model(self, residuals=residuals)
 
     # -- persistence (absent from the reference: SURVEY.md §5 "Checkpoint /
     # resume: none") ---------------------------------------------------------

@@ -24,10 +24,23 @@ from ..utils.format import coef_table, sig_digits
 @dataclasses.dataclass(frozen=True)
 class LMSummary:
     model: object  # LMModel
+    # optional residual vector: models retain no data, so R's "Residuals:"
+    # quantile block renders only when the caller passes them back in
+    # (model.summary(residuals=model.residuals(X, y)))
+    residuals: object = None
 
     @classmethod
-    def from_model(cls, model):
-        return cls(model=model)
+    def from_model(cls, model, residuals=None):
+        return cls(model=model, residuals=residuals)
+
+    def residual_quantiles(self) -> dict | None:
+        """R's summary.lm 'Residuals:' five-number block (type-7
+        quantiles), or None when no residuals were supplied."""
+        if self.residuals is None:
+            return None
+        r = np.asarray(self.residuals, np.float64)
+        q = np.quantile(r, [0.0, 0.25, 0.5, 0.75, 1.0])
+        return dict(zip(("Min", "1Q", "Median", "3Q", "Max"), q))
 
     def coefficients(self) -> dict[str, np.ndarray]:
         m = self.model
@@ -75,8 +88,18 @@ class LMSummary:
 
     def __str__(self) -> str:  # print block, LM.scala:128-136
         arr = self.summary_array()
+        rq = self.residual_quantiles()
+        resid_block = ""
+        if rq is not None:
+            names = list(rq)
+            vals = [sig_digits(v, 5) for v in rq.values()]
+            widths = [max(len(a), len(b)) for a, b in zip(names, vals)]
+            resid_block = (
+                "Residuals:\n"
+                + " ".join(n.rjust(w) for n, w in zip(names, widths)) + "\n"
+                + " ".join(v.rjust(w) for v, w in zip(vals, widths)) + "\n\n")
         return (
-            f"Model:\n{arr[0]}\n\nCoefficients:\n{arr[1]}\n\n"
+            f"Model:\n{arr[0]}\n\n{resid_block}Coefficients:\n{arr[1]}\n\n"
             f"{arr[2]}\n{arr[3]}\n{arr[4]}\n"
         )
 

@@ -174,3 +174,24 @@ def test_lm_offset_weighted_no_intercept(rng):
     rss = float(np.sum(w * (y - f) ** 2))
     mss = float(np.sum(w * f * f))
     assert m.r_squared == pytest.approx(mss / (mss + rss), rel=1e-6)
+
+
+def test_summary_residual_quantiles():
+    """R's summary.lm 'Residuals:' five-number block (the lm.D9 example's
+    printed values: -1.0710 -0.4938 0.0685 0.2462 1.3690), rendered when
+    the residuals are passed back in."""
+    ctl = [4.17, 5.58, 5.18, 6.11, 4.50, 4.61, 5.17, 4.53, 5.33, 5.14]
+    trt = [4.81, 4.17, 4.41, 3.59, 5.87, 3.83, 6.03, 4.89, 4.32, 4.69]
+    d = {"weight": np.array(ctl + trt), "group": ["Ctl"] * 10 + ["Trt"] * 10}
+    m = sg.lm("weight ~ group", d, config=F64)
+    from sparkglm_tpu.data.model_matrix import transform
+    X = transform(d, m.terms, dtype=np.float64)
+    s = m.summary(residuals=m.residuals(X, d["weight"]))
+    q = s.residual_quantiles()
+    np.testing.assert_allclose(
+        [q["Min"], q["1Q"], q["Median"], q["3Q"], q["Max"]],
+        [-1.0710, -0.49375, 0.0685, 0.24625, 1.3690], atol=1e-4)
+    text = str(s)
+    assert "Residuals:" in text and "-1.0710" in text and "1.3690" in text
+    # without residuals the block is absent (models retain no data)
+    assert "Residuals:" not in str(m.summary())
