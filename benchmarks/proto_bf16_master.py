@@ -1,0 +1,201 @@
+"""Prototype: bf16 master copy of X for the single-pass Fisher kernel.
+
+VERDICT r3 weak #3 / next-round #2: HOTLOOP_r03.md's roofline puts the
+floor at ~6-8 ms/iter at 2M x 512 vs the shipped fused kernel's ~16 ms,
+and names one untried lever — storing X in bfloat16 so the dominant HBM
+read halves (n*p*4 -> n*p*2 bytes) — before calling 14-16 ms structural.
+This measures that lever with the accuracy contract attached:
+
+  * f32_default        — the shipped r3 kernel (baseline, ~16 ms)
+  * bf16_upcast        — X stored bf16, upcast to f32 in VMEM; identical
+                         arithmetic to the shipped kernel thereafter (the
+                         MXU sees the same bf16 multiplicands DEFAULT
+                         precision would produce; only input storage
+                         rounding is added)
+  * bf16_native        — X stored bf16, VPU elementwise kept in bf16
+                         where legal (Xw product), MXU fed bf16 directly;
+                         tests whether bf16 VPU lanes shave the ~8 ms of
+                         vector work that cannot overlap the MXU
+
+Accuracy is reported as (a) max relerr of the Gramian vs an f32 HIGHEST
+reference, and (b) the end-to-end contract that matters: relerr of the
+solved Newton step beta = G^{-1} b vs the reference step.
+
+Writes benchmarks/proto_bf16_r04.json.  Run ONE process at a time on the
+tunnel (see tpu_when_alive.sh).
+"""
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+
+def _fetch(out):
+    return float(jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+
+
+def timeit(fn, *args, reps=12):
+    out = fn(*args)
+    _fetch(out)
+
+    def run(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = fn(*args)
+        _fetch(out)
+        return time.perf_counter() - t0
+
+    t1 = min(run(2), run(2))
+    t2 = min(run(2 + reps), run(2 + reps))
+    return max((t2 - t1) / reps, 0.0)
+
+
+def make_kernel(mode, block_rows, p, precision=jax.lax.Precision.DEFAULT):
+    """mode: f32 | bf16_upcast | bf16_native.  Logistic Fisher pass."""
+    x_dtype = jnp.float32 if mode == "f32" else jnp.bfloat16
+
+    def kern(x_ref, y_ref, wt_ref, off_ref, beta_ref,
+             xtwx_ref, xtwz_ref, dev_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            xtwx_ref[:] = jnp.zeros_like(xtwx_ref)
+            xtwz_ref[:] = jnp.zeros_like(xtwz_ref)
+            dev_ref[:] = jnp.zeros_like(dev_ref)
+
+        Xs = x_ref[:]                      # stored dtype (f32 or bf16)
+        X = Xs.astype(jnp.float32)
+        y = y_ref[:]
+        wt = wt_ref[:]
+        off = off_ref[:]
+        beta_row = beta_ref[:]
+        valid = wt > 0.0
+        eta = jnp.sum(X * beta_row, axis=1, keepdims=True) + off
+        mu = jnp.where(valid, jax.nn.sigmoid(eta), 0.5)
+        v = jnp.maximum(mu * (1.0 - mu), 1e-30)
+        g = 1.0 / v
+        w = jnp.where(valid, wt * v, 0.0)
+        z = jnp.where(valid, eta - off + (y - mu) * g, 0.0)
+        ylog = jnp.where(y > 0, y * jnp.log(jnp.maximum(y / mu, 1e-30)), 0.0)
+        y1 = jnp.where(y < 1, (1 - y) * jnp.log(
+            jnp.maximum((1 - y) / (1 - mu), 1e-30)), 0.0)
+        dev = jnp.sum(jnp.where(valid, 2.0 * wt * (ylog + y1), 0.0)).reshape(1, 1)
+        if mode == "bf16_native":
+            # keep the rank-2 elementwise product on bf16 VPU lanes; the
+            # MXU consumes bf16 directly either way under DEFAULT
+            Xw = Xs * w.astype(jnp.bfloat16)
+            xtwx_ref[:] += jax.lax.dot_general(
+                Xw, Xs, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=precision)
+            xtwz_ref[:] += jax.lax.dot_general(
+                z.reshape(1, -1).astype(jnp.bfloat16), Xw,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=precision)
+        else:
+            Xw = X * w
+            xtwx_ref[:] += jax.lax.dot_general(
+                Xw, X, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=precision)
+            xtwz_ref[:] += jnp.sum(Xw * z, axis=0, keepdims=True)
+        dev_ref[:] += dev
+
+    itemsize = 4 if mode == "f32" else 2
+
+    @jax.jit
+    def run(X, y, wt, off, beta):
+        n = X.shape[0]
+        yc, wc, oc = (a.reshape(n, 1) for a in (y, wt, off))
+        vec = lambda: pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            kern,
+            grid=(n // block_rows,),
+            in_specs=[
+                pl.BlockSpec((block_rows, p), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                vec(), vec(), vec(),
+                pl.BlockSpec((1, p), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((p, p), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, p), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((p, p), jnp.float32),
+                jax.ShapeDtypeStruct((1, p), jnp.float32),
+                jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            ],
+            cost_estimate=pl.CostEstimate(
+                flops=2 * n * p * (p + 2),
+                bytes_accessed=itemsize * n * p + 4 * (4 * n + p * p + 2 * p),
+                transcendentals=4 * n,
+            ),
+            interpret=os.environ.get("PALLAS_INTERPRET") == "1",
+        )(X, yc, wc, oc, beta.reshape(1, p))
+
+    return run
+
+
+def main():
+    n, p = 2_097_152, 512
+    kx, kb = jax.random.split(jax.random.PRNGKey(0))
+    X = jax.random.normal(kx, (n, p), jnp.float32).at[:, 0].set(1.0)
+    beta_t = jax.random.normal(kb, (p,), jnp.float32) * 0.1
+    eta = X @ beta_t
+    mu = jax.nn.sigmoid(eta)
+    y = (jax.random.uniform(jax.random.PRNGKey(1), (n,)) < mu).astype(jnp.float32)
+    wt = jnp.ones((n,), jnp.float32)
+    off = jnp.zeros((n,), jnp.float32)
+    Xb = X.astype(jnp.bfloat16)
+    res = {"n": n, "p": p}
+
+    ref = make_kernel("f32", 512, p, jax.lax.Precision.HIGHEST)
+    Gr, br, dr = ref(X, y, wt, off, beta_t)
+    lam = 1e-6 * jnp.trace(Gr) / p
+    step_ref = jax.scipy.linalg.cho_solve(
+        jax.scipy.linalg.cho_factor(Gr + lam * jnp.eye(p)), br.ravel())
+
+    def record(tag, k, Xin):
+        try:
+            t = timeit(k, Xin, y, wt, off, beta_t)
+            G, b, d = k(Xin, y, wt, off, beta_t)
+            step = jax.scipy.linalg.cho_solve(
+                jax.scipy.linalg.cho_factor(G + lam * jnp.eye(p)), b.ravel())
+            res[f"{tag}_ms"] = t * 1e3
+            res[f"{tag}_gram_relerr"] = float(
+                jnp.max(jnp.abs(G - Gr)) / jnp.max(jnp.abs(Gr)))
+            res[f"{tag}_step_relerr"] = float(
+                jnp.linalg.norm(step - step_ref) / jnp.linalg.norm(step_ref))
+        except Exception as e:
+            res[f"{tag}_error"] = str(e).split("\n")[0][:160]
+        print(tag, res.get(f"{tag}_ms", res.get(f"{tag}_error")),
+              res.get(f"{tag}_step_relerr", ""), flush=True)
+        # dump incrementally: a tunnel wedge / timeout kill mid-sweep keeps
+        # every completed measurement (tunnel time is never re-spent)
+        with open("/root/repo/benchmarks/proto_bf16_r04.json", "w") as f:
+            json.dump(res, f, indent=1)
+
+    for br_rows in (256, 512, 1024):
+        record(f"f32_default_b{br_rows}",
+               make_kernel("f32", br_rows, p), X)
+        record(f"bf16_upcast_b{br_rows}",
+               make_kernel("bf16_upcast", br_rows, p), Xb)
+        record(f"bf16_native_b{br_rows}",
+               make_kernel("bf16_native", br_rows, p), Xb)
+
+    print(json.dumps(res, indent=1))
+    with open("/root/repo/benchmarks/proto_bf16_r04.json", "w") as f:
+        json.dump(res, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

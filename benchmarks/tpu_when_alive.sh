@@ -1,12 +1,22 @@
 #!/bin/bash
-# Probe the axon TPU tunnel; when it answers, run the queued r03 TPU
-# captures in sequence, MISSING ones first (the tunnel can wedge again at
-# any moment — never re-spend tunnel time on a capture that already
-# exists).  Safe to re-run: each step is guarded by a VALID output file
-# (partial JSON from a timeout kill is removed, not trusted).
+# Probe the axon TPU tunnel; when it answers, run the queued TPU captures
+# in sequence, highest-value-first (the tunnel can wedge again at any
+# moment — never re-spend tunnel time on a capture that already exists).
+# Safe to re-run: each step is guarded by a VALID output file (partial
+# JSON from a timeout kill is removed, not trusted).
 # IMPORTANT: run ONE tpu process at a time — concurrent clients wedge the
 # tunnel (observed in r1, r2, and again in r3 when a D2H pull was
 # SIGTERM'd mid-transfer).
+#
+# r04 queue order (VERDICT r3 "next round" #1 and #2):
+#   1. engine sweep      — hardware re-cert of the fused-vs-einsum
+#                          crossover + shipped-kernel timing table
+#   2. headline bench.py — the engine-tagged number of record
+#                          (bench_detail_latest.json)
+#   3. bf16 master proto — the one untried roofline lever (proto_bf16_r04)
+#   4. scoring bench     — 10M-row sharded predict
+#   5. five-config refresh (results_r04.json, configs 1-5 at scale 1)
+#   6. config 5 at FULL 50M x 500 (longest; last so a wedge costs least)
 set -u
 cd "$(dirname "$0")/.."
 
@@ -25,10 +35,24 @@ for i in $(seq 1 "${PROBES:-8}"); do
   if probe; then
     echo "tunnel alive (probe $i)"
     if ! valid_json benchmarks/engine_sweep_r03.json; then
-      echo "== engine sweep (r03: DEFAULT-precision fused kernel)"
+      echo "== engine sweep (hardware re-cert, DEFAULT-precision fused kernel)"
       timeout 560 python -u benchmarks/tpu_validate.py >/tmp/sweep_out.log 2>/tmp/sweep_err.log \
         || { echo "sweep failed"; tail -5 /tmp/sweep_err.log; }
       valid_json benchmarks/engine_sweep_r03.json || rm -f benchmarks/engine_sweep_r03.json
+    fi
+    if ! { valid_json benchmarks/bench_detail_latest.json \
+           && grep -q '"engine"' benchmarks/bench_detail_latest.json; }; then
+      echo "== headline bench (fused vs einsum, engine-tagged number of record)"
+      timeout 560 python bench.py 2>/tmp/bench_late.log \
+        || { echo "headline failed"; tail -5 /tmp/bench_late.log; }
+      valid_json benchmarks/bench_detail_latest.json \
+        || rm -f benchmarks/bench_detail_latest.json
+    fi
+    if ! valid_json benchmarks/proto_bf16_r04.json; then
+      echo "== bf16 master-copy prototype (roofline lever, VERDICT r3 #2)"
+      timeout 560 python -u benchmarks/proto_bf16_master.py >/tmp/bf16_out.log 2>&1 \
+        || { echo "bf16 proto failed"; tail -5 /tmp/bf16_out.log; }
+      valid_json benchmarks/proto_bf16_r04.json || rm -f benchmarks/proto_bf16_r04.json
     fi
     if ! valid_json benchmarks/scoring_r03.json; then
       echo "== 10M-row scoring bench"
@@ -36,16 +60,17 @@ for i in $(seq 1 "${PROBES:-8}"); do
         || { echo "scoring bench failed"; tail -5 /tmp/score_out.log; }
       valid_json benchmarks/scoring_r03.json || rm -f benchmarks/scoring_r03.json
     fi
+    if ! valid_json benchmarks/results_r04.json; then
+      echo "== five-config refresh (results_r04.json)"
+      timeout 1500 python -u benchmarks/run.py --json benchmarks/results_r04.json \
+        >/tmp/run_r04.log 2>&1 \
+        || { echo "five-config failed"; tail -5 /tmp/run_r04.log; }
+      valid_json benchmarks/results_r04.json || rm -f benchmarks/results_r04.json
+    fi
     if ! valid_json benchmarks/results_r03_config5.json; then
       echo "== BASELINE config 5 at FULL 50M x 500 (several minutes)"
       timeout 3000 python -u benchmarks/config5_full.py 2>&1 | tail -20
       valid_json benchmarks/results_r03_config5.json || rm -f benchmarks/results_r03_config5.json
-    fi
-    # headline LAST (the driver re-runs bench.py at round end anyway);
-    # skip when this round's engine-tagged capture already exists
-    if ! grep -q '"engine"' benchmarks/bench_detail_latest.json 2>/dev/null; then
-      echo "== headline bench (fused vs einsum, reports winner)"
-      timeout 560 python bench.py 2>/tmp/bench_late.log
     fi
     exit 0
   fi

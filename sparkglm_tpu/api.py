@@ -411,13 +411,18 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
         weights_col=weights, has_weights=weights is not None)
 
 
-def lm_from_csv(formula: str, path: str, *, weights=None,
+def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
                 na_omit: bool = True, chunk_bytes: int = 256 << 20,
                 mesh=None, native: bool | None = None, parse_cache="auto",
                 config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """OLS/WLS by formula straight from a CSV too big to load (two
     streaming passes: Gramian accumulation, then the exact host-f64
-    residual pass; see :func:`glm_from_csv`)."""
+    residual pass; see :func:`glm_from_csv`).
+
+    ``weights``/``offset`` must be column names; ``offset()`` formula
+    terms follow R's ``lm`` semantics like the resident :func:`lm`
+    (VERDICT r3 #6 — streaming was the one place lm offset parity ended).
+    """
     from .models import streaming
 
     pre = parse_formula(formula)  # reject before any file IO
@@ -425,25 +430,21 @@ def lm_from_csv(formula: str, path: str, *, weights=None,
         raise ValueError(
             "cbind() responses are for binomial glm(); lm() fits a single "
             "numeric response")
-    if pre.offsets:
-        raise ValueError(
-            "offset() terms are not supported in lm() (linear models have "
-            "no offset; absorb it by regressing y - offset)")
     import os as _os
 
     f, terms, num_chunks, extract = _csv_stream_design(
-        formula, path, named_cols={"weights": weights},
+        formula, path, named_cols={"weights": weights, "offset": offset},
         na_omit=na_omit, dtype=np.dtype(config.dtype),
         chunk_bytes=chunk_bytes, native=native)
-    # lm streams twice (Gramian pass + exact residual pass): the second
-    # pass loads memory-mapped parsed chunks instead of re-parsing
+    # lm streams twice (Gramian pass + exact residual pass; three with an
+    # offset + intercept): later passes load memory-mapped parsed chunks
+    # instead of re-parsing
     extract, parse_cleanup = _parse_cache_wrap(
         extract, parse_cache, _os.path.getsize(path))
 
     def source():
         for i in range(num_chunks):
-            X, y, w, _ = extract(i)
-            yield X, y, w, None
+            yield lambda i=i: extract(i)
 
     try:
         model = streaming.lm_fit_streaming(
@@ -454,6 +455,7 @@ def lm_from_csv(formula: str, path: str, *, weights=None,
     import dataclasses
     return dataclasses.replace(model, formula=str(f), terms=terms,
                                weights_col=weights,
+                               offset_col=_offset_col_value(f, offset),
                                has_weights=weights is not None)
 
 

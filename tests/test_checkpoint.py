@@ -70,8 +70,68 @@ def test_interrupt_and_resume(prob, mesh8):
     assert resumed.iterations <= plain.iterations - ckpt["iters"] + 1
 
 
-def test_checkpoint_rejected_on_fused_engine(prob, mesh8):
+def test_segmented_equals_plain_fused(prob, mesh8):
+    """r4: the fused engine is warm-startable — a checkpoint_every=1 fused
+    fit reproduces the unsegmented fused trajectory exactly (the segment
+    driver threads the half-step-lagged deviance baseline across
+    boundaries), so long fits no longer demote to einsum."""
     X, y = prob
-    with pytest.raises(ValueError, match="einsum or qr"):
-        sg.glm_fit(X, y, family="binomial", mesh=mesh8, engine="fused",
-                   checkpoint_every=1, on_iteration=lambda *a: None)
+    kw = dict(family="binomial", tol=1e-10, criterion="relative", mesh=mesh8,
+              engine="fused")
+    plain = sg.glm_fit(X, y, **kw)
+    trace = []
+    seg = sg.glm_fit(X, y, checkpoint_every=1,
+                     on_iteration=lambda i, b, d: trace.append((i, b, d)),
+                     **kw)
+    assert seg.iterations == plain.iterations
+    assert len(trace) == seg.iterations
+    np.testing.assert_allclose(seg.coefficients, plain.coefficients,
+                               rtol=0, atol=1e-12)
+    assert seg.deviance == pytest.approx(plain.deviance, rel=1e-12)
+    assert [t[0] for t in trace] == list(range(1, seg.iterations + 1))
+
+
+def test_interrupt_and_resume_fused(prob, mesh8):
+    """Crash a fused fit after 2 iterations; beta0 resume on the fused
+    engine reaches the einsum solution with only the remaining work."""
+    X, y = prob
+    kw = dict(family="binomial", tol=1e-10, criterion="relative", mesh=mesh8)
+    plain = sg.glm_fit(X, y, **kw)  # einsum reference solution
+
+    ckpt = {}
+
+    class Crash(Exception):
+        pass
+
+    def hook(i, b, d):
+        ckpt["beta"], ckpt["iters"] = b, i
+        if i == 2:
+            raise Crash
+
+    with pytest.raises(Crash):
+        sg.glm_fit(X, y, engine="fused", checkpoint_every=1,
+                   on_iteration=hook, **kw)
+    assert ckpt["iters"] == 2
+
+    with np.testing.suppress_warnings() as sup:
+        sup.filter(UserWarning)
+        resumed = sg.glm_fit(X, y, engine="fused", beta0=ckpt["beta"], **kw)
+    np.testing.assert_allclose(resumed.coefficients, plain.coefficients,
+                               rtol=0, atol=5e-10)
+    assert resumed.deviance == pytest.approx(plain.deviance, rel=1e-10)
+    assert resumed.converged
+
+
+def test_fused_checkpoint_segments_cost_no_extra_passes(prob, mesh8):
+    """checkpoint_every=2 on fused: segment boundaries add no coefficient
+    updates — the trajectory matches checkpoint_every=1 and plain."""
+    X, y = prob
+    kw = dict(family="binomial", tol=1e-10, criterion="relative", mesh=mesh8,
+              engine="fused")
+    seg1 = sg.glm_fit(X, y, checkpoint_every=1,
+                      on_iteration=lambda *a: None, **kw)
+    seg2 = sg.glm_fit(X, y, checkpoint_every=2,
+                      on_iteration=lambda *a: None, **kw)
+    assert seg1.iterations == seg2.iterations
+    np.testing.assert_allclose(seg1.coefficients, seg2.coefficients,
+                               rtol=0, atol=1e-12)
