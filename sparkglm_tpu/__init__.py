@@ -15,7 +15,11 @@ Quick start::
 """
 
 from .api import (TermsPrediction, confint_profile, glm,
-                  glm_from_csv, glm_nb, lm, lm_from_csv, predict, update)
+                  glm_from_csv, glm_from_json, glm_from_parquet, glm_nb, lm,
+                  lm_from_csv, lm_from_json, lm_from_parquet, predict, update)
+from .data.json import read_json, scan_json_levels, scan_json_schema
+from .data.parquet import (read_parquet, scan_parquet_levels,
+                           scan_parquet_schema)
 from .config import DEFAULT, NumericConfig
 from .data.formula import Formula, parse_formula
 from .data.frame import as_columns, omit_na
@@ -44,6 +48,10 @@ __version__ = "0.1.0"
 __all__ = [
     "lm", "glm", "predict", "update", "lm_fit", "glm_fit",
     "lm_from_csv", "glm_from_csv",
+    "lm_from_parquet", "glm_from_parquet",
+    "lm_from_json", "glm_from_json",
+    "read_parquet", "scan_parquet_schema", "scan_parquet_levels",
+    "read_json", "scan_json_schema", "scan_json_levels",
     "lm_fit_streaming", "glm_fit_streaming",
     "LMModel", "GLMModel", "load_model", "save_model",
     "anova", "drop1", "AnovaTable", "confint_profile",

@@ -233,34 +233,78 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         has_m=m_arg is not None)
 
 
-def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
-                       chunk_bytes, native):
-    """Shared plan for the from-CSV streaming fits: global schema + factor
-    levels in one pass each (native C++ loader when available), a newline-
-    aligned byte-range chunking of the file, and fitted ``Terms`` every
-    chunk transforms through.  Returns ``(f, terms, num_chunks, extract)``
-    where ``extract(chunk_index)`` yields the per-chunk model-frame pieces.
-    """
+def _stream_io(path, *, chunk_bytes, native, backend: str = "auto"):
+    """Resolve the file-streaming backend: global scans, chunk count, and a
+    per-chunk reader sharing one contract (``read(i) -> columns dict``).
+    ``backend="auto"`` dispatches on extension — .parquet/.pq stream
+    row-group bands (data/parquet.py), .json/.jsonl/.ndjson stream
+    newline-aligned NDJSON byte ranges (data/json.py — the reference's own
+    fixture format, testData.scala:10-15), everything else newline-aligned
+    CSV byte ranges (data/io.py)."""
     import os
 
-    from .data import io as csv_io
+    if backend == "auto":
+        low = str(path).lower()
+        backend = ("parquet" if low.endswith((".parquet", ".pq"))
+                   else "json" if low.endswith((".json", ".jsonl", ".ndjson"))
+                   else "csv")
+    if backend == "json":
+        from .data import json as json_io
+        schema = json_io.scan_json_schema(path, chunk_bytes=chunk_bytes)
+        levels = json_io.scan_json_levels(path, chunk_bytes=chunk_bytes,
+                                          schema=schema)
+        num_chunks = max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
 
+        def read(i):
+            return json_io.read_json(path, shard_index=i,
+                                     num_shards=num_chunks, schema=schema)
+        return levels, num_chunks, read
+    if backend == "parquet":
+        from .data import parquet as pq_io
+        schema = pq_io.scan_parquet_schema(path)
+        levels = pq_io.scan_parquet_levels(path, schema=schema)
+        num_chunks = pq_io.row_group_bands(path, chunk_bytes)
+
+        def read(i):
+            return pq_io.read_parquet(path, shard_index=i,
+                                      num_shards=num_chunks, schema=schema)
+    else:
+        from .data import io as csv_io
+        # both global scans are memory-bounded (chunked merge) — the whole
+        # point of this path is files that do not fit
+        schema = csv_io.scan_csv_schema(path, native=native,
+                                        chunk_bytes=chunk_bytes)
+        levels = csv_io.scan_csv_levels(path, native=native,
+                                        chunk_bytes=chunk_bytes)
+        num_chunks = max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
+
+        def read(i):
+            return csv_io.read_csv(path, shard_index=i,
+                                   num_shards=num_chunks,
+                                   schema=schema, native=native)
+    return levels, num_chunks, read
+
+
+def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
+                       chunk_bytes, native, backend: str = "auto"):
+    """Shared plan for the from-file streaming fits: global schema + factor
+    levels in one pass each (native C++ loader for CSV; pyarrow row-group
+    pruned scans for Parquet), a chunking of the file aligned to its IO
+    unit (newline byte ranges / row-group bands), and fitted ``Terms``
+    every chunk transforms through.  Returns ``(f, terms, num_chunks,
+    extract)`` where ``extract(chunk_index)`` yields the per-chunk
+    model-frame pieces.
+    """
     f = parse_formula(formula)
     for what, v in named_cols.items():
         if v is not None and not isinstance(v, str):
             raise ValueError(
                 f"{what} must be a column NAME for from-CSV streaming fits "
                 "(arrays cannot align with file chunks)")
-    # both global scans are memory-bounded (chunked merge) — the whole point
-    # of this path is files that do not fit
-    schema = csv_io.scan_csv_schema(path, native=native,
-                                    chunk_bytes=chunk_bytes)
-    levels = csv_io.scan_csv_levels(path, native=native,
-                                    chunk_bytes=chunk_bytes)
-    num_chunks = max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
+    levels, num_chunks, _read_chunk = _stream_io(
+        path, chunk_bytes=chunk_bytes, native=native, backend=backend)
 
-    chunk0 = csv_io.read_csv(path, shard_index=0, num_shards=num_chunks,
-                             schema=schema, native=native)
+    chunk0 = _read_chunk(0)
     predictors = f.resolve_predictors(list(chunk0))
     # BEFORE build_terms (which would fit a basis from chunk0 alone):
     # poly()/bs()/ns() learn their bases from the FULL column (orthogonal
@@ -300,8 +344,7 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
     warned_transform: list = []
 
     def extract(i: int):
-        cols = csv_io.read_csv(path, shard_index=i, num_shards=num_chunks,
-                               schema=schema, native=native)
+        cols = _read_chunk(i)
         if na_omit:
             cols, _ = omit_na(cols, used)
         yraw = cols[f.response]
@@ -357,6 +400,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
                  mesh=None, cache: str = "auto", parse_cache="auto",
                  verbose: bool = False,
                  beta0=None, on_iteration=None, native: bool | None = None,
+                 backend: str = "auto",
                  config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """Fit a GLM by formula straight from a CSV too big to load.
 
@@ -380,7 +424,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
     f, terms, num_chunks, extract = _csv_stream_design(
         formula, path, named_cols={"weights": weights, "offset": offset},
         na_omit=na_omit, dtype=np.dtype(config.dtype),
-        chunk_bytes=chunk_bytes, native=native)
+        chunk_bytes=chunk_bytes, native=native, backend=backend)
     # chunks past the HBM budget re-stream every IRLS pass: the parsed-chunk
     # disk tier turns those re-parses into memory-mapped loads
     extract, parse_cleanup = _parse_cache_wrap(
@@ -414,6 +458,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
 def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
                 na_omit: bool = True, chunk_bytes: int = 256 << 20,
                 mesh=None, native: bool | None = None, parse_cache="auto",
+                backend: str = "auto",
                 config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """OLS/WLS by formula straight from a CSV too big to load (two
     streaming passes: Gramian accumulation, then the exact host-f64
@@ -435,7 +480,7 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
     f, terms, num_chunks, extract = _csv_stream_design(
         formula, path, named_cols={"weights": weights, "offset": offset},
         na_omit=na_omit, dtype=np.dtype(config.dtype),
-        chunk_bytes=chunk_bytes, native=native)
+        chunk_bytes=chunk_bytes, native=native, backend=backend)
     # lm streams twice (Gramian pass + exact residual pass; three with an
     # offset + intercept): later passes load memory-mapped parsed chunks
     # instead of re-parsing
@@ -457,6 +502,46 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
                                weights_col=weights,
                                offset_col=_offset_col_value(f, offset),
                                has_weights=weights is not None)
+
+
+def glm_from_parquet(formula: str, path: str, **kwargs) -> glm_mod.GLMModel:
+    """Fit a GLM by formula straight from a Parquet file too big to load.
+
+    The columnar twin of :func:`glm_from_csv` (SURVEY §2.3's Spark-reader
+    role: the reference's DataFrames arrive from any source — testData
+    fixtures are JSON, testData.scala:10-15): the same streaming IRLS
+    engine, with chunks as row-group BANDS and the schema read from the
+    typed footer instead of a data pass (``data/parquet.py``).  Same
+    keywords as :func:`glm_from_csv` except ``native`` (the C++ CSV
+    loader does not apply); multi-host fits shard by row-group band via
+    ``read_parquet(shard_index=process_index(), num_shards=...)``.
+    """
+    kwargs.pop("native", None)
+    return glm_from_csv(formula, path, backend="parquet", **kwargs)
+
+
+def lm_from_parquet(formula: str, path: str, **kwargs) -> lm_mod.LMModel:
+    """OLS/WLS by formula straight from a Parquet file too big to load —
+    the columnar twin of :func:`lm_from_csv`; see :func:`glm_from_parquet`."""
+    kwargs.pop("native", None)
+    return lm_from_csv(formula, path, backend="parquet", **kwargs)
+
+
+def glm_from_json(formula: str, path: str, **kwargs) -> glm_mod.GLMModel:
+    """Fit a GLM by formula straight from a newline-delimited JSON file —
+    the reference's own fixture format (Spark ``jsonFile``,
+    testData.scala:10-15).  Same streaming engine as
+    :func:`glm_from_csv`; records are one JSON object per line, columns
+    are the union of keys (``data/json.py``)."""
+    kwargs.pop("native", None)
+    return glm_from_csv(formula, path, backend="json", **kwargs)
+
+
+def lm_from_json(formula: str, path: str, **kwargs) -> lm_mod.LMModel:
+    """OLS/WLS by formula straight from a newline-delimited JSON file;
+    see :func:`glm_from_json`."""
+    kwargs.pop("native", None)
+    return lm_from_csv(formula, path, backend="json", **kwargs)
 
 
 def _parse_cache_wrap(extract, mode, csv_bytes: int):
@@ -927,12 +1012,100 @@ def _predict_terms(model, X: np.ndarray) -> TermsPrediction:
                            float(avx @ beta))
 
 
+def _predict_from_path(model, path, *, chunk_bytes: int = 256 << 20,
+                       native: bool | None = None, out_path: str | None = None,
+                       **kwargs):
+    """Out-of-core scoring: stream a CSV too big to load through the
+    training ``Terms`` + the model's scorer, chunk by chunk (VERDICT r3
+    #5 — the reference predicts executor-side on distributed data,
+    LM.scala:52-61; this is that role for file-resident data).
+
+    Each byte-range chunk goes through the EXACT resident predict path
+    (``predict(model, chunk_cols, **kwargs)``), so results are
+    bit-identical to loading the file whole: the transform and the
+    X·beta / quadform scorers are row-local, and chunk boundaries cannot
+    change any per-row reduction.
+
+    ``offset`` must be a column NAME here (arrays cannot align with file
+    chunks); a fit-time by-name offset travels with the model as usual.
+    ``out_path`` streams results to a CSV (``fit`` or ``fit,se_fit``
+    columns) instead of accumulating them — for scoring runs whose
+    OUTPUT is also too big to hold; returns ``out_path``.
+
+    ``.parquet``/``.pq`` paths stream row-group bands through the same
+    flow (``_stream_io`` dispatch)."""
+    off_kw = kwargs.get("offset")
+    if off_kw is not None and not isinstance(off_kw, str):
+        raise ValueError(
+            "offset must be a column NAME when scoring from a file path "
+            "(arrays cannot align with file chunks)")
+    if out_path is not None and kwargs.get("type") == "terms":
+        raise ValueError("out_path supports fit/se scoring, not type='terms'")
+    _, num_chunks, read_chunk = _stream_io(path, chunk_bytes=chunk_bytes,
+                                           native=native)
+    parts = []
+    out_fh = open(out_path, "w") if out_path is not None else None
+    wrote_header = False
+    try:
+        for i in range(num_chunks):
+            cols = read_chunk(i)
+            ncols = len(next(iter(cols.values()))) if cols else 0
+            if ncols == 0:
+                continue
+            kw = dict(kwargs)
+            if isinstance(off_kw, str):
+                if off_kw not in cols:
+                    raise KeyError(
+                        f"offset column {off_kw!r} not found in file columns "
+                        f"{list(cols)}")
+                kw["offset"] = np.asarray(cols[off_kw], np.float64)
+            res = predict(model, cols, **kw)
+            if out_fh is not None:
+                if isinstance(res, tuple):
+                    if not wrote_header:
+                        out_fh.write("fit,se_fit\n")
+                        wrote_header = True
+                    np.savetxt(out_fh, np.column_stack(res), fmt="%.17g",
+                               delimiter=",")
+                else:
+                    if not wrote_header:
+                        out_fh.write("fit\n")
+                        wrote_header = True
+                    np.savetxt(out_fh, np.asarray(res), fmt="%.17g")
+            else:
+                parts.append(res)
+    finally:
+        if out_fh is not None:
+            out_fh.close()
+    if out_path is not None:
+        if not wrote_header:
+            raise ValueError(f"{path!r} contained no data rows")
+        return out_path
+    if not parts:
+        raise ValueError(f"{path!r} contained no data rows")
+    first = parts[0]
+    if isinstance(first, tuple):  # se_fit: (fit, se)
+        return tuple(np.concatenate([p[j] for p in parts])
+                     for j in range(len(first)))
+    if isinstance(first, TermsPrediction):
+        return TermsPrediction(
+            np.concatenate([p.matrix for p in parts], axis=0),
+            first.columns, first.constant)
+    return np.concatenate(parts)
+
+
 def predict(model, data, **kwargs) -> np.ndarray:
     """Score new column-data through a formula-fitted model.
 
     Equivalent of ``predict.sparkLM`` (R/pkg/R/LM.R:87-100): rebuild the
     design matrix under the training ``Terms`` (which embeds the matchCols
     zero-filling, utils.scala:21-33) then X·beta.
+
+    ``data`` may also be a CSV file PATH: scoring then streams the file
+    in byte-range chunks through the identical per-chunk path
+    (bit-parity with loading it whole); see :func:`_predict_from_path`
+    for the path-only keywords (``chunk_bytes``, ``native``,
+    ``out_path``).
 
     ``type="terms"`` returns a :class:`TermsPrediction` — per-term
     link-scale contributions centered at the training design means plus
@@ -942,6 +1115,8 @@ def predict(model, data, **kwargs) -> np.ndarray:
         raise ValueError(
             "model was fit from arrays, not a formula; call model.predict(X) "
             "with an aligned design matrix instead")
+    if _is_path(data):
+        return _predict_from_path(model, str(data), **kwargs)
     cols = as_columns(data)
     X = transform(cols, model.terms)
     if kwargs.get("type") == "terms":

@@ -1,0 +1,146 @@
+"""Newline-delimited JSON (NDJSON) ingestion — the reference's own fixture
+format.
+
+The reference loads its test data with Spark's JSON reader
+(/root/reference/src/test/scala/com/Alteryx/testUtils/data/
+testData.scala:10-15, ``sqlContext.jsonFile``), which reads one JSON object
+per line.  This tier gives that format the same contracts as the CSV and
+Parquet readers (``data/io.py``, ``data/parquet.py``): a global schema
+scan, a global level scan, and newline-aligned byte-range shard reads —
+so the streaming fits, multi-host sharding, and out-of-core predict all
+compose unchanged (``api._stream_io`` dispatches on the .json/.jsonl/
+.ndjson extension).
+
+Column semantics mirror Spark's JSON relation: the schema is the UNION of
+keys across records; a record missing a key contributes NaN (numeric) /
+None (categorical); a key that is ever a string anywhere is categorical
+everywhere (the CSV scan's categorical-anywhere-wins verdict); booleans
+read as numeric 0/1 (Spark would type them boolean — a regression design
+wants the indicator).  Nested objects/arrays are rejected: model frames
+are flat.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import os
+
+import numpy as np
+
+from .io import CATEGORICAL, NUMERIC
+
+
+def _align_ranges(path: str, shard_index: int, num_shards: int):
+    """Newline-aligned byte range of the shard — identical carve-up to
+    ``_read_csv_py`` minus the header line (NDJSON has none)."""
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        fsize = f.tell()
+
+        def align(pos):
+            if pos <= 0:
+                return 0
+            if pos >= fsize:
+                return fsize
+            f.seek(pos - 1)
+            f.readline()
+            return f.tell()
+
+        begin = align(fsize * shard_index // num_shards)
+        end = align(fsize * (shard_index + 1) // num_shards)
+        f.seek(begin)
+        return f.read(end - begin).decode()
+
+
+def _records(blob: str, path: str):
+    for ln in blob.split("\n"):
+        ln = ln.strip()
+        if not ln:
+            continue
+        rec = _json.loads(ln)
+        if not isinstance(rec, dict):
+            raise ValueError(
+                f"{path!r}: NDJSON lines must be objects, got "
+                f"{type(rec).__name__}")
+        yield rec
+
+
+def _kind_of(v) -> int:
+    if isinstance(v, str):
+        return CATEGORICAL
+    if isinstance(v, (bool, int, float)) or v is None:
+        return NUMERIC
+    raise ValueError(
+        f"nested JSON value {v!r} is not a flat model-frame column")
+
+
+def scan_json_schema(path: str, *, chunk_bytes: int | None = None
+                     ) -> dict[str, int]:
+    """Column name -> NUMERIC | CATEGORICAL over the UNION of keys.
+    ``chunk_bytes`` bounds peak memory (slices scanned independently,
+    kinds merged — categorical anywhere wins, like ``scan_csv_schema``)."""
+    num = (max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
+           if chunk_bytes else 1)
+    merged: dict[str, int] = {}
+    for i in range(num):
+        for rec in _records(_align_ranges(path, i, num), path):
+            for k, v in rec.items():
+                merged[k] = max(merged.get(k, NUMERIC), _kind_of(v))
+    return merged
+
+
+def scan_json_levels(path: str, *, chunk_bytes: int | None = None,
+                     schema: dict[str, int] | None = None
+                     ) -> dict[str, list[str]]:
+    """Global sorted level lists of every categorical column (the
+    ``scan_csv_levels`` contract for multi-host level agreement)."""
+    if schema is None:
+        schema = scan_json_schema(path, chunk_bytes=chunk_bytes)
+    cat = {k for k, v in schema.items() if v == CATEGORICAL}
+    if not cat:
+        return {}  # skip a full re-parse of an all-numeric file
+    sets: dict[str, set] = {k: set() for k in cat}
+    num = (max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
+           if chunk_bytes else 1)
+    for i in range(num):
+        for rec in _records(_align_ranges(path, i, num), path):
+            for k in cat:
+                v = rec.get(k)
+                if v is not None:
+                    sets[k].add(str(v))
+    return {k: sorted(v) for k, v in sets.items()}
+
+
+def read_json(path: str, *, shard_index: int = 0, num_shards: int = 1,
+              schema: dict[str, int] | None = None) -> dict[str, np.ndarray]:
+    """Read a newline-aligned byte-range shard of an NDJSON file into
+    name -> column arrays (float64 / object-of-str with None) — the
+    ``read_csv(shard_index=)`` per-host contract.  Pass a global
+    ``scan_json_schema`` result so every shard types (and includes)
+    identical columns even when its own records miss some keys."""
+    if num_shards < 1 or not (0 <= shard_index < num_shards):
+        raise ValueError(
+            f"need 0 <= shard_index < num_shards, got {shard_index}/{num_shards}")
+    recs = list(_records(_align_ranges(path, shard_index, num_shards), path))
+    if schema is None:
+        local: dict[str, int] = {}
+        for rec in recs:
+            for k, v in rec.items():
+                local[k] = max(local.get(k, NUMERIC), _kind_of(v))
+        schema = local
+    n = len(recs)
+    out: dict[str, np.ndarray] = {}
+    for name, kind in schema.items():
+        if kind == CATEGORICAL:
+            col = np.empty((n,), dtype=object)
+            for i, rec in enumerate(recs):
+                v = rec.get(name)
+                col[i] = None if v is None else str(v)
+        else:
+            col = np.full((n,), np.nan)
+            for i, rec in enumerate(recs):
+                v = rec.get(name)
+                if v is not None:
+                    col[i] = float(v)
+        out[name] = col
+    return out

@@ -1,0 +1,165 @@
+"""Columnar (Parquet) ingestion tier — the reference's Spark-reader role.
+
+The reference inherits Spark's reader breadth (its DataFrames arrive from
+any source; its own test fixtures are JSON —
+/root/reference/src/test/scala/com/Alteryx/testUtils/data/testData.scala:10-15).
+SURVEY.md §2.3 maps that role to an "Arrow/Parquet reader feeding per-host
+shards".  This module is the Parquet counterpart of ``data/io.py``'s CSV
+trio with the SAME contracts, so everything downstream (``build_terms``,
+the streaming fits, multi-host sharding) composes unchanged:
+
+  * ``scan_parquet_schema`` — column -> NUMERIC | CATEGORICAL.  Unlike the
+    CSV scan this costs one footer read: Parquet files are typed.
+  * ``scan_parquet_levels`` — global sorted level lists for categorical
+    columns (column-pruned batch scan: only the string columns stream).
+  * ``read_parquet(shard_index=, num_shards=)`` — name -> column arrays
+    (float64 / object-of-str with None for nulls) for a CONTIGUOUS band of
+    row groups.  Row-group banding is the columnar analogue of the CSV
+    reader's newline-aligned byte ranges: the same per-host shard contract,
+    aligned to the file's natural IO unit.
+
+pyarrow is the host-side decoder (baked into the image); everything is
+gated so importing sparkglm_tpu never requires it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .io import CATEGORICAL, NUMERIC
+
+
+def _pq():
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+    except ImportError as e:  # pragma: no cover - pyarrow is in the image
+        raise ImportError(
+            "Parquet ingestion needs pyarrow (CSV ingestion has no such "
+            "dependency: data/io.py)") from e
+    return pa, pq
+
+
+def _is_categorical_type(pa, t) -> bool:
+    if pa.types.is_dictionary(t):
+        t = t.value_type
+    return (pa.types.is_string(t) or pa.types.is_large_string(t)
+            or pa.types.is_binary(t) or pa.types.is_large_binary(t))
+
+
+def scan_parquet_schema(path: str) -> dict[str, int]:
+    """Column name -> NUMERIC (0) | CATEGORICAL (1) from the file footer
+    (no data pass — the typed-format advantage over ``scan_csv_schema``)."""
+    pa, pq = _pq()
+    out = {}
+    for field in pq.read_schema(path):
+        out[field.name] = (CATEGORICAL
+                          if _is_categorical_type(pa, field.type) else NUMERIC)
+    return out
+
+
+def scan_parquet_levels(path: str, *, batch_rows: int = 1 << 16,
+                        schema: dict[str, int] | None = None
+                        ) -> dict[str, list[str]]:
+    """One global, COLUMN-PRUNED pass returning the full sorted level list
+    of every categorical column (``scan_csv_levels`` contract: multi-host
+    fits pass this to ``build_terms(levels=...)`` so every host codes the
+    same design).  Only the categorical columns are decoded; numeric data
+    never leaves the file.  Missing values do not become levels."""
+    _, pq = _pq()
+    if schema is None:
+        schema = scan_parquet_schema(path)
+    cat_cols = [k for k, v in schema.items() if v == CATEGORICAL]
+    if not cat_cols:
+        return {}
+    sets: dict[str, set] = {k: set() for k in cat_cols}
+    pf = pq.ParquetFile(path)
+    for batch in pf.iter_batches(columns=cat_cols, batch_size=batch_rows):
+        for k in cat_cols:
+            col = batch.column(batch.schema.get_field_index(k))
+            sets[k].update(str(v) for v in col.to_pylist() if v is not None)
+    return {k: sorted(v) for k, v in sets.items()}
+
+
+def _group_band(n_groups: int, shard_index: int, num_shards: int):
+    """Contiguous, nearly-even split of row-group indices — the same
+    carve-up ``read_csv`` applies to byte ranges (a shard may be empty
+    when num_shards > n_groups, exactly like an empty byte range)."""
+    lo = (n_groups * shard_index) // num_shards
+    hi = (n_groups * (shard_index + 1)) // num_shards
+    return list(range(lo, hi))
+
+
+def _column_out(pa, col, kind: int) -> np.ndarray:
+    """Arrow column -> the data/io.py column contract (float64, or
+    object-of-str with None for nulls).  ``schema=`` overrides follow the
+    CSV reader's forced-kind semantics: a numeric-typed column forced
+    CATEGORICAL stringifies; a string column forced NUMERIC parses."""
+    if kind == NUMERIC:
+        if _is_categorical_type(pa, col.type):
+            vals = col.to_pylist()
+            return np.array([np.nan if v is None else float(v)
+                             for v in vals], np.float64)
+        return np.asarray(
+            col.cast(pa.float64()).to_numpy(zero_copy_only=False), np.float64)
+    vals = col.to_pylist()
+    out = np.empty((len(vals),), dtype=object)
+    for i, v in enumerate(vals):
+        out[i] = None if v is None else str(v)
+    return out
+
+
+def read_parquet(path: str, *, shard_index: int = 0, num_shards: int = 1,
+                 schema: dict[str, int] | None = None,
+                 columns: list[str] | None = None) -> dict[str, np.ndarray]:
+    """Read a contiguous row-group band into name -> column arrays.
+
+    The per-host loading pattern for multi-host meshes, mirroring
+    ``read_csv(shard_index=, num_shards=)``: every process reads its own
+    band, builds its design from the GLOBAL ``scan_parquet_levels``, and
+    streams through its local devices (tests/test_multiprocess.py flow).
+    ``columns`` prunes the read to the named columns (Parquet reads are
+    columnar — the pruning actually skips IO, unlike CSV).
+    """
+    if num_shards < 1 or not (0 <= shard_index < num_shards):
+        raise ValueError(
+            f"need 0 <= shard_index < num_shards, got {shard_index}/{num_shards}")
+    pa, pq = _pq()
+    pf = pq.ParquetFile(path)
+    if schema is None:
+        schema = scan_parquet_schema(path)
+    band = _group_band(pf.metadata.num_row_groups, shard_index, num_shards)
+    names = [f.name for f in pf.schema_arrow]
+    if columns is not None:
+        missing = [c for c in columns if c not in names]
+        if missing:
+            raise KeyError(
+                f"column {missing[0]!r} not found in {path!r} "
+                f"(has {names})")
+        names = [n for n in names if n in set(columns)]
+    if not band:
+        return {n: (np.empty(0, np.float64)
+                    if schema.get(n, NUMERIC) == NUMERIC
+                    else np.empty(0, object)) for n in names}
+    table = pf.read_row_groups(band, columns=names)
+    out: dict[str, np.ndarray] = {}
+    for name in names:
+        col = table.column(name)
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks()
+        if pa.types.is_dictionary(col.type):
+            col = col.cast(col.type.value_type)
+        out[name] = _column_out(pa, col, schema.get(name, NUMERIC))
+    return out
+
+
+def row_group_bands(path: str, chunk_bytes: int) -> int:
+    """How many ~``chunk_bytes`` chunks the file's row groups make — the
+    streaming verbs' analogue of ``ceil(file_size / chunk_bytes)``, kept
+    row-group-aligned so every chunk read is whole row groups."""
+    _, pq = _pq()
+    md = pq.ParquetFile(path).metadata
+    total = sum(md.row_group(i).total_byte_size
+                for i in range(md.num_row_groups))
+    want = max(1, -(-total // int(chunk_bytes)))
+    return min(max(1, md.num_row_groups), want)

@@ -33,6 +33,15 @@ generated on the fly without materializing it.  The iterator may also yield
 zero-arg THUNKS producing those tuples (``_materialize``): chunks held by
 the device cache are then skipped without paying their production cost
 (api.glm_from_csv yields one thunk per CSV byte range).
+
+Streaming models carry only the covariance DIAGONAL (std errors and the
+t/z inference derived from them) — accumulating the full p x p unscaled
+covariance per chunk would double the host accumulator traffic for a
+matrix most summaries never read, so ``vcov()``/``correlation()`` raise
+on streaming models with a message naming the resident refit as the
+remedy.  Everything else a resident summary prints is here, including
+R's summary.lm "Residuals:" quantile block (streamed in the lm residual
+pass; single-process fits).
 """
 
 from __future__ import annotations
@@ -594,6 +603,14 @@ def lm_fit_streaming(
     sst_raw = 0.0
     swf = 0.0       # offset mode: sum w * (X beta + offset), for wmean(f)
     mss_raw = 0.0   # offset mode, no intercept: sum w * f^2
+    # R's summary.lm "Residuals:" five numbers, streamed in this pass
+    # (VERDICT r3 #7): sqrt(w)*r like summary.lm's weighted residuals
+    # (= r unweighted).  Single-process only (global order statistics);
+    # f32 keeps 50M rows at 200 MB, capped at ~2 GB beyond which the
+    # block reverts to the opt-in summary(residuals=) path.
+    rq_parts: list | None = [] if nproc == 1 else None
+    rq_bytes = 0
+    saw_weights = False
     err = None
     try:
         for Xc, yc, wc, oc in _iter_chunks(chunks):
@@ -602,6 +619,13 @@ def lm_fit_streaming(
             f = xb + oc64
             resid = yc64 - f
             sse += float(np.sum(wc64 * resid * resid))
+            if wc is not None and np.any(wc64 != 1.0):
+                saw_weights = True
+            if rq_parts is not None:
+                rq_parts.append((np.sqrt(wc64) * resid).astype(np.float32))
+                rq_bytes += rq_parts[-1].nbytes
+                if rq_bytes > (1 << 31):
+                    rq_parts = None
             if saw_offset:
                 swf += float(np.sum(wc64 * f))
                 mss_raw += float(np.sum(wc64 * f * f))
@@ -616,9 +640,11 @@ def lm_fit_streaming(
     if nproc > 1:
         _sync_errors(err)
         from ..parallel import distributed as dist
-        sse, sst_centered, sst_raw, swf, mss_raw = (
+        sse, sst_centered, sst_raw, swf, mss_raw, sw_flag = (
             float(v) for v in dist.allsum_f64(
-                [sse, sst_centered, sst_raw, swf, mss_raw]))
+                [sse, sst_centered, sst_raw, swf, mss_raw,
+                 float(saw_weights)]))
+        saw_weights = sw_flag > 0  # any process saw non-unit weights
     if saw_offset:
         # R's summary.lm with an offset: mss from the FITTED values
         # f = X beta + offset; sst := mss + rss (models/lm.py).  The
@@ -654,6 +680,13 @@ def lm_fit_streaming(
         sst = mss + sse
     else:
         sst = float(sst_centered if has_intercept else sst_raw)
+    resid_q = None
+    if rq_parts:
+        allr = np.concatenate(rq_parts).astype(np.float64)
+        # np.quantile's default interpolation IS R's type 7
+        resid_q = tuple(
+            float(v) for v in np.quantile(allr, [0.0, 0.25, 0.5, 0.75, 1.0]))
+        del allr, rq_parts
     df_model = p - (1 if has_intercept else 0)
     df_resid = int(acc["n_ok"]) - p  # R's n.ok: weights>0 rows only
     n_ok = int(acc["n_ok"])
@@ -672,7 +705,8 @@ def lm_fit_streaming(
         sigma=float(np.sqrt(sigma2)), f_statistic=float(f_stat),
         has_intercept=bool(has_intercept),
         n_shards=mesh.shape[meshlib.DATA_AXIS], cov_unscaled=None,
-        has_offset=bool(saw_offset))
+        has_offset=bool(saw_offset), has_weights=bool(saw_weights),
+        resid_quantiles=resid_q)
 
 
 def glm_fit_streaming(

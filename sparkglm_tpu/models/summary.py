@@ -35,11 +35,16 @@ class LMSummary:
 
     def residual_quantiles(self) -> dict | None:
         """R's summary.lm 'Residuals:' five-number block (type-7
-        quantiles), or None when no residuals were supplied."""
-        if self.residuals is None:
+        quantiles).  Caller-supplied residuals win; otherwise the model's
+        streamed quantiles (out-of-core fits store them at fit time —
+        models retain no data) render by default; None when neither."""
+        if self.residuals is not None:
+            r = np.asarray(self.residuals, np.float64)
+            q = np.quantile(r, [0.0, 0.25, 0.5, 0.75, 1.0])
+        elif getattr(self.model, "resid_quantiles", None) is not None:
+            q = [float(v) for v in self.model.resid_quantiles]
+        else:
             return None
-        r = np.asarray(self.residuals, np.float64)
-        q = np.quantile(r, [0.0, 0.25, 0.5, 0.75, 1.0])
         return dict(zip(("Min", "1Q", "Median", "3Q", "Max"), q))
 
     def coefficients(self) -> dict[str, np.ndarray]:
@@ -94,8 +99,16 @@ class LMSummary:
             names = list(rq)
             vals = [sig_digits(v, 5) for v in rq.values()]
             widths = [max(len(a), len(b)) for a, b in zip(names, vals)]
+            # R's print.summary.lm header: weighted fits show sqrt(w)*r.
+            # Only the model's STREAMED quantiles are sqrt(w)-weighted;
+            # caller-supplied residuals are raw, so they keep the plain
+            # header whatever the fit's weights were.
+            hdr = ("Weighted Residuals:"
+                   if self.residuals is None
+                   and getattr(self.model, "has_weights", False)
+                   else "Residuals:")
             resid_block = (
-                "Residuals:\n"
+                hdr + "\n"
                 + " ".join(n.rjust(w) for n, w in zip(names, widths)) + "\n"
                 + " ".join(v.rjust(w) for v, w in zip(vals, widths)) + "\n\n")
         return (

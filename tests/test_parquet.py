@@ -1,0 +1,243 @@
+"""Parquet ingestion tier (VERDICT r3 #4) — the reference's Spark-reader
+role (SURVEY §2.3: "Arrow/Parquet reader feeding per-host shards"; the
+reference's own fixtures are JSON, testData.scala:10-15, and its DataFrames
+arrive from any Spark source).  Contracts mirror the CSV trio exactly:
+schema scan, global level scan, shard-contract reads (row-group bands in
+place of newline byte ranges), the same streaming fits on top — plus a
+REAL 2-process fit sharded by row-group band."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+
+def _write_parquet(path, cols, row_group_size=256):
+    table = pa.table({k: list(v) for k, v in cols.items()})
+    pq.write_table(table, str(path), row_group_size=row_group_size)
+
+
+@pytest.fixture()
+def pq_data(tmp_path, rng):
+    n = 2000
+    x = np.round(rng.normal(size=n), 6)
+    grp = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    lt = np.round(rng.uniform(0.2, 0.8, n), 6)
+    lam = np.exp(0.3 + 0.5 * x - 0.4 * (grp == "b") + lt)
+    y = rng.poisson(lam).astype(float)
+    w = np.round(rng.uniform(0.5, 2.0, n), 6)
+    cols = {"y": y, "x": x, "grp": grp, "lt": lt, "w": w}
+    p = tmp_path / "d.parquet"
+    _write_parquet(p, cols)
+    return str(p), cols
+
+
+def test_schema_and_levels(pq_data):
+    path, cols = pq_data
+    schema = sg.scan_parquet_schema(path)
+    assert schema == {"y": 0, "x": 0, "grp": 1, "lt": 0, "w": 0}
+    levels = sg.scan_parquet_levels(path)
+    assert levels == {"grp": sorted(set(cols["grp"]))}
+
+
+def test_read_parquet_shards_cover_exactly(pq_data):
+    """Row-group bands partition the file: every row exactly once, in
+    order — the read_csv(shard_index=) contract."""
+    path, cols = pq_data
+    for num_shards in (1, 3, 4, 16):
+        got = [sg.read_parquet(path, shard_index=i, num_shards=num_shards)
+               for i in range(num_shards)]
+        y = np.concatenate([g["y"] for g in got])
+        np.testing.assert_array_equal(y, cols["y"])
+        grp = np.concatenate([g["grp"] for g in got])
+        assert list(grp) == list(cols["grp"])
+    # more shards than row groups: trailing shards are empty, total intact
+    n_groups = pq.ParquetFile(path).metadata.num_row_groups
+    many = n_groups + 3
+    got = [sg.read_parquet(path, shard_index=i, num_shards=many)
+           for i in range(many)]
+    assert sum(len(g["y"]) for g in got) == len(cols["y"])
+
+
+def test_read_parquet_nulls_and_dictionary(tmp_path):
+    """Nulls follow the io.py contract (NaN numeric, None categorical);
+    dictionary-encoded strings decode to plain str."""
+    t = pa.table({
+        "v": pa.array([1.5, None, 3.0], pa.float64()),
+        "g": pa.array(["u", None, "v"]).dictionary_encode(),
+    })
+    p = tmp_path / "nulls.parquet"
+    pq.write_table(t, str(p))
+    cols = sg.read_parquet(str(p))
+    assert np.isnan(cols["v"][1]) and cols["v"][2] == 3.0
+    assert list(cols["g"]) == ["u", None, "v"]
+    assert sg.scan_parquet_schema(str(p)) == {"v": 0, "g": 1}
+    assert sg.scan_parquet_levels(str(p)) == {"g": ["u", "v"]}
+
+
+def test_glm_from_parquet_matches_in_memory(pq_data, mesh8):
+    path, cols = pq_data
+    m_pq = sg.glm_from_parquet("y ~ x + grp + offset(lt)", path,
+                               weights="w", family="poisson",
+                               chunk_bytes=16 << 10, tol=1e-10,
+                               criterion="relative", mesh=mesh8)
+    m_mem = sg.glm("y ~ x + grp", cols, family="poisson", weights="w",
+                   offset="lt", tol=1e-10, criterion="relative", mesh=mesh8)
+    np.testing.assert_allclose(m_pq.coefficients, m_mem.coefficients,
+                               rtol=1e-5, atol=1e-8)
+    np.testing.assert_allclose(m_pq.deviance, m_mem.deviance, rtol=1e-6)
+    np.testing.assert_allclose(m_pq.std_errors, m_mem.std_errors, rtol=1e-5)
+    assert m_pq.xnames == m_mem.xnames
+
+
+def test_lm_from_parquet_offset_and_quantiles(pq_data, mesh8):
+    path, cols = pq_data
+    m_pq = sg.lm_from_parquet("y ~ x + grp", path, weights="w", offset="lt",
+                              chunk_bytes=16 << 10, mesh=mesh8)
+    m_mem = sg.lm("y ~ x + grp", cols, weights="w", offset="lt", mesh=mesh8)
+    # streaming f32 chunk Gramians vs the resident single reduction
+    np.testing.assert_allclose(m_pq.coefficients, m_mem.coefficients,
+                               rtol=1e-5, atol=1e-8)
+    np.testing.assert_allclose(m_pq.r_squared, m_mem.r_squared, rtol=1e-6)
+    # residual quantile block streams on the parquet tier too
+    assert m_pq.resid_quantiles is not None
+    assert "Weighted Residuals:" in str(m_pq.summary())
+
+
+def test_glm_from_parquet_equals_from_csv(pq_data, tmp_path, mesh8):
+    """Same data through both ingestion tiers -> the same model."""
+    import csv as csv_mod
+    path, cols = pq_data
+    cp = tmp_path / "d.csv"
+    with open(cp, "w", newline="") as fh:
+        w = csv_mod.writer(fh)
+        w.writerow(list(cols))
+        for i in range(len(cols["y"])):
+            w.writerow([cols[k][i] for k in cols])
+    kw = dict(weights="w", family="poisson", chunk_bytes=16 << 10,
+              tol=1e-10, criterion="relative", mesh=mesh8)
+    m_pq = sg.glm_from_parquet("y ~ x + grp", path, **kw)
+    m_csv = sg.glm_from_csv("y ~ x + grp", str(cp), **kw)
+    # same values, different chunk BOUNDARIES (row-group bands vs newline
+    # byte ranges) -> f32 accumulation order differs at ~1e-7
+    np.testing.assert_allclose(m_pq.coefficients, m_csv.coefficients,
+                               rtol=1e-5, atol=1e-8)
+    assert m_pq.n_obs == m_csv.n_obs
+
+
+def test_predict_from_parquet_path(pq_data, mesh8):
+    """predict(model, 'x.parquet') streams row-group bands, bit-identical
+    to scoring the loaded columns."""
+    path, cols = pq_data
+    m = sg.glm("y ~ x + grp + offset(lt)", cols, family="poisson")
+    whole = sg.predict(m, cols)
+    chunked = sg.predict(m, path, chunk_bytes=16 << 10)
+    np.testing.assert_array_equal(np.asarray(chunked), np.asarray(whole))
+
+
+_PQ_WORKER = r"""
+import json, sys
+port, pid, pq_path, out_path, nproc = sys.argv[1:6]
+nproc = int(nproc)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import sparkglm_tpu as sg
+from sparkglm_tpu.parallel import distributed as dist
+
+dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                num_processes=nproc, process_id=int(pid))
+mesh = dist.global_mesh()
+# each process reads its OWN row-group band — the per-host shard contract
+cols = sg.read_parquet(pq_path, shard_index=dist.process_index(),
+                       num_shards=nproc)
+# global level discovery: level "c" lives only in shard 0's row groups
+levels = sg.scan_parquet_levels(pq_path)
+assert levels == {"grp": ["a", "b", "c"]}, levels
+terms = sg.build_terms(cols, ["x1", "x2", "grp"], intercept=True,
+                       levels=levels)
+X = sg.transform(cols, terms).astype(np.float64)
+y = np.asarray(cols["y"], np.float64)
+tgt = dist.sync_max_rows(X.shape[0], mesh)
+Xp, w = dist.pad_host_shard(X.astype(np.float32), tgt)
+yp, _ = dist.pad_host_shard(y.astype(np.float32), tgt)
+Xg = dist.host_shard_to_global(Xp, mesh)
+yg = dist.host_shard_to_global(yp, mesh)
+wg = dist.host_shard_to_global(w.astype(np.float32), mesh)
+model = sg.glm_fit(Xg, yg, weights=wg, family="poisson", mesh=mesh,
+                   has_intercept=True, xnames=terms.xnames,
+                   criterion="relative", tol=1e-10)
+if dist.process_index() == 0:
+    with open(out_path, "w") as f:
+        json.dump({"coefficients": model.coefficients.tolist(),
+                   "deviance": model.deviance,
+                   "n_obs": model.n_obs,
+                   "converged": model.converged}, f)
+print("pq worker", pid, "done", flush=True)
+"""
+
+
+def test_multi_process_parquet_fit(tmp_path):
+    """VERDICT r3 #4 done-criterion: a REAL 2-process fit sharded by
+    row-group band, mirroring test_multiprocess.py's CSV flow."""
+    from tests.test_multiprocess import _free_port
+
+    rng = np.random.default_rng(23)
+    n = 3001
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    grp = np.where(np.arange(n) < 150, "c",
+                   np.where(rng.random(n) < 0.5, "a", "b"))
+    eff = {"a": 0.0, "b": 0.2, "c": -0.4}
+    y = rng.poisson(np.exp(0.4 + 0.5 * x1 - 0.3 * x2
+                           + np.vectorize(eff.get)(grp))).astype(np.float64)
+    path = tmp_path / "mp.parquet"
+    _write_parquet(path, {"y": y, "x1": x1, "x2": x2, "grp": grp},
+                   row_group_size=500)
+    worker = tmp_path / "worker.py"
+    worker.write_text(_PQ_WORKER)
+    out_path = tmp_path / "out.json"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = "/root/repo" + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(port), str(i), str(path),
+         str(out_path), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd="/root/repo") for i in range(2)]
+    outs = []
+    for pr in procs:
+        try:
+            out, _ = pr.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("parquet workers timed out")
+        outs.append(out.decode())
+    for i, pr in enumerate(procs):
+        assert pr.returncode == 0, f"worker {i}:\n{outs[i][-3000:]}"
+    with open(out_path) as f:
+        got = json.load(f)
+
+    cols = sg.read_parquet(str(path))
+    terms = sg.build_terms(cols, ["x1", "x2", "grp"], intercept=True,
+                           levels=sg.scan_parquet_levels(str(path)))
+    X = sg.transform(cols, terms).astype(np.float32)
+    ref = sg.glm_fit(X, np.asarray(cols["y"], np.float32), family="poisson",
+                     criterion="relative", tol=1e-10, xnames=terms.xnames)
+    assert got["converged"] and got["n_obs"] == n
+    np.testing.assert_allclose(got["coefficients"], ref.coefficients,
+                               rtol=0, atol=5e-6)
+    assert got["deviance"] == pytest.approx(ref.deviance, rel=1e-5)

@@ -83,3 +83,78 @@ def test_api_predict_through_mesh(mesh8, rng):
     host = sg.predict(m, new)
     np.testing.assert_allclose(sg.predict(m, new, mesh=mesh8), host,
                                rtol=1e-12, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# out-of-core predict: predict(model, "path.csv") — VERDICT r3 #5
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def score_csv(tmp_path, rng):
+    import csv as csv_mod
+    n = 3000
+    x = np.round(rng.standard_normal(n), 6)
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    lt = np.round(rng.uniform(0.1, 0.9, n), 6)
+    lam = np.exp(0.4 + 0.5 * x + 0.6 * (g == "b") + lt)
+    y = rng.poisson(lam).astype(float)
+    cols = {"y": y, "x": x, "g": g, "lt": lt}
+    p = tmp_path / "score.csv"
+    with open(p, "w", newline="") as fh:
+        w = csv_mod.writer(fh)
+        w.writerow(list(cols))
+        for i in range(n):
+            w.writerow([cols[nm][i] for nm in cols])
+    return str(p), sg.read_csv(str(p))
+
+
+def test_predict_from_csv_bit_parity(score_csv):
+    """Chunked file scoring is BIT-identical to loading the file whole:
+    every chunk runs the same resident per-row path."""
+    path, data = score_csv
+    m = sg.glm("y ~ x + g + offset(lt)", data, family="poisson")
+    whole = sg.predict(m, data)
+    chunked = sg.predict(m, path, chunk_bytes=1 << 12)  # many small chunks
+    np.testing.assert_array_equal(np.asarray(chunked), np.asarray(whole))
+
+
+def test_predict_from_csv_se_fit_and_link(score_csv):
+    path, data = score_csv
+    m = sg.glm("y ~ x + g + offset(lt)", data, family="poisson")
+    fit_w, se_w = sg.predict(m, data, se_fit=True)
+    fit_c, se_c = sg.predict(m, path, se_fit=True, chunk_bytes=1 << 12)
+    np.testing.assert_array_equal(fit_c, fit_w)
+    np.testing.assert_array_equal(se_c, se_w)
+    np.testing.assert_array_equal(
+        sg.predict(m, path, type="link", chunk_bytes=1 << 12),
+        sg.predict(m, data, type="link"))
+
+
+def test_predict_from_csv_lm_terms_and_offset_override(score_csv):
+    path, data = score_csv
+    m = sg.lm("y ~ x + g", data)
+    tp_w = sg.predict(m, data, type="terms")
+    tp_c = sg.predict(m, path, type="terms", chunk_bytes=1 << 12)
+    np.testing.assert_array_equal(tp_c.matrix, tp_w.matrix)
+    assert tp_c.columns == tp_w.columns and tp_c.constant == tp_w.constant
+    # explicit by-name offset override on the path flow
+    m2 = sg.lm("y ~ x + g", data, offset="lt")
+    np.testing.assert_array_equal(
+        sg.predict(m2, path, chunk_bytes=1 << 12),
+        sg.predict(m2, data))
+    with pytest.raises(ValueError, match="column NAME"):
+        sg.predict(m2, path, offset=np.zeros(3000))
+
+
+def test_predict_from_csv_out_path(score_csv, tmp_path):
+    """out_path streams fit/se to disk for scoring runs whose output is
+    also too big to hold; written values round-trip exactly (%.17g)."""
+    path, data = score_csv
+    m = sg.glm("y ~ x + g + offset(lt)", data, family="poisson")
+    out = str(tmp_path / "scored.csv")
+    ret = sg.predict(m, path, se_fit=True, chunk_bytes=1 << 12, out_path=out)
+    assert ret == out
+    got = sg.read_csv(out)
+    fit_w, se_w = sg.predict(m, data, se_fit=True)
+    np.testing.assert_array_equal(np.asarray(got["fit"]), fit_w)
+    np.testing.assert_array_equal(np.asarray(got["se_fit"]), se_w)
