@@ -1,0 +1,75 @@
+"""Time the SHIPPED bf16-warmup schedule end-to-end on TPU.
+
+proto_bf16_master.py measures the raw pass; this measures what users get:
+``glm_fit(engine="fused")`` vs ``glm_fit(engine="fused",
+config=NumericConfig(bf16_warmup=True))`` on the 2M x 512 logistic
+headline shape, device-resident data, full fits to tol=1e-8 — plus the
+coefficient agreement between the two (the accuracy contract).
+
+Writes benchmarks/bf16_sched_r04.json incrementally.  ONE tunnel client
+at a time (tpu_when_alive.sh).
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np  # noqa: E402
+
+import sparkglm_tpu as sg  # noqa: E402
+from sparkglm_tpu.config import NumericConfig  # noqa: E402
+
+OUT = "/root/repo/benchmarks/bf16_sched_r04.json"
+
+
+def main():
+    res = {"device": str(jax.devices()[0])}
+    n, p = 2_097_152, 512
+    kx, kb = jax.random.split(jax.random.PRNGKey(0))
+
+    @jax.jit
+    def gen():
+        X = jax.random.normal(kx, (n, p), jnp.float32).at[:, 0].set(1.0)
+        bt = jax.random.normal(kb, (p,), jnp.float32) / (2.0 * p ** 0.5)
+        y = (jax.random.uniform(jax.random.PRNGKey(1), (n,))
+             < jax.nn.sigmoid(X @ bt)).astype(jnp.float32)
+        return X, y
+
+    X, y = gen()
+    jax.block_until_ready(y)
+    mesh = sg.make_mesh()
+    kw = dict(family="binomial", tol=1e-8, criterion="relative",
+              engine="fused", mesh=mesh)
+
+    def fit_time(tag, **extra):
+        t = []
+        m = None
+        for rep in range(3):
+            t0 = time.perf_counter()
+            m = sg.glm_fit(X, y, **kw, **extra)
+            t.append(time.perf_counter() - t0)
+        res[f"{tag}_fit_s"] = min(t[1:])  # rep 0 pays compile
+        res[f"{tag}_compile_s"] = t[0]
+        res[f"{tag}_iters"] = int(m.iterations)
+        res[f"{tag}_ms_per_iter"] = 1e3 * min(t[1:]) / max(1, m.iterations)
+        with open(OUT, "w") as f:
+            json.dump(res, f, indent=1)
+        print(tag, res[f"{tag}_fit_s"], "s,", m.iterations, "iters", flush=True)
+        return m
+
+    m32 = fit_time("fused_f32")
+    mbf = fit_time("fused_bf16_warmup", config=NumericConfig(bf16_warmup=True))
+    res["coef_maxdiff"] = float(np.max(np.abs(
+        m32.coefficients - mbf.coefficients)))
+    res["speedup"] = res["fused_f32_fit_s"] / res["fused_bf16_warmup_fit_s"]
+    with open(OUT, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()

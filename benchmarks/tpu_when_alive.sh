@@ -54,6 +54,12 @@ for i in $(seq 1 "${PROBES:-8}"); do
         || { echo "bf16 proto failed"; tail -5 /tmp/bf16_out.log; }
       valid_json benchmarks/proto_bf16_r04.json || rm -f benchmarks/proto_bf16_r04.json
     fi
+    if ! valid_json benchmarks/bf16_sched_r04.json; then
+      echo "== SHIPPED bf16-warmup schedule end-to-end (fused vs fused+warmup)"
+      timeout 900 python -u benchmarks/bf16_sched_bench.py >/tmp/bf16_sched.log 2>&1 \
+        || { echo "bf16 sched bench failed"; tail -5 /tmp/bf16_sched.log; }
+      valid_json benchmarks/bf16_sched_r04.json || rm -f benchmarks/bf16_sched_r04.json
+    fi
     if ! valid_json benchmarks/scoring_r03.json; then
       echo "== 10M-row scoring bench"
       timeout 560 python -u benchmarks/scoring_bench.py >/tmp/score_out.log 2>&1 \

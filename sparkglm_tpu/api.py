@@ -243,11 +243,19 @@ def _stream_io(path, *, chunk_bytes, native, backend: str = "auto"):
     CSV byte ranges (data/io.py)."""
     import os
 
+    if backend not in ("auto", "csv", "json", "parquet"):
+        raise ValueError(
+            f"backend must be 'auto', 'csv', 'json' or 'parquet', "
+            f"got {backend!r}")
     if backend == "auto":
         low = str(path).lower()
         backend = ("parquet" if low.endswith((".parquet", ".pq"))
                    else "json" if low.endswith((".json", ".jsonl", ".ndjson"))
                    else "csv")
+    # every reader takes (i, columns=None); ``columns`` prunes the read to
+    # the named subset where the format can exploit it (Parquet skips the
+    # IO entirely; NDJSON skips column building; CSV must parse the line
+    # anyway and ignores it)
     if backend == "json":
         from .data import json as json_io
         schema = json_io.scan_json_schema(path, chunk_bytes=chunk_bytes)
@@ -255,9 +263,11 @@ def _stream_io(path, *, chunk_bytes, native, backend: str = "auto"):
                                           schema=schema)
         num_chunks = max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
 
-        def read(i):
+        def read(i, columns=None):
+            sub = (schema if columns is None
+                   else {k: v for k, v in schema.items() if k in set(columns)})
             return json_io.read_json(path, shard_index=i,
-                                     num_shards=num_chunks, schema=schema)
+                                     num_shards=num_chunks, schema=sub)
         return levels, num_chunks, read
     if backend == "parquet":
         from .data import parquet as pq_io
@@ -265,9 +275,10 @@ def _stream_io(path, *, chunk_bytes, native, backend: str = "auto"):
         levels = pq_io.scan_parquet_levels(path, schema=schema)
         num_chunks = pq_io.row_group_bands(path, chunk_bytes)
 
-        def read(i):
+        def read(i, columns=None):
             return pq_io.read_parquet(path, shard_index=i,
-                                      num_shards=num_chunks, schema=schema)
+                                      num_shards=num_chunks, schema=schema,
+                                      columns=columns)
     else:
         from .data import io as csv_io
         # both global scans are memory-bounded (chunked merge) — the whole
@@ -278,7 +289,7 @@ def _stream_io(path, *, chunk_bytes, native, backend: str = "auto"):
                                         chunk_bytes=chunk_bytes)
         num_chunks = max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
 
-        def read(i):
+        def read(i, columns=None):
             return csv_io.read_csv(path, shard_index=i,
                                    num_shards=num_chunks,
                                    schema=schema, native=native)
@@ -344,7 +355,9 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
     warned_transform: list = []
 
     def extract(i: int):
-        cols = _read_chunk(i)
+        # prune the read to the columns the model frame touches (Parquet
+        # skips the IO for the rest — the columnar tier's advantage)
+        cols = _read_chunk(i, used)
         if na_omit:
             cols, _ = omit_na(cols, used)
         yraw = cols[f.response]

@@ -47,6 +47,19 @@ class NumericConfig:
         with an unsharded feature axis; streaming fits warn instead —
         their chunked TSQR does not exist yet).
         ``"off"`` never polishes (r02's warn-only behaviour).
+      bf16_warmup: mixed-precision IRLS schedule for the fused engine.
+        Early iterations only steer beta toward the fixed point — their
+        Gramians need no more accuracy than the step they produce — so the
+        warm-up phase streams a BFLOAT16 master copy of X (half the HBM
+        read per pass, the dominant cost at large n) until the relative
+        deviance change flattens below ``bf16_switch_tol``, then
+        warm-starts float32 passes to the exact fixed point.  The FINAL
+        iterations (and everything reported) are full f32: coefficients
+        match the plain fused engine at its normal tolerance.  Costs one
+        extra bf16 copy of X in HBM (1.5x design memory).  Off by default
+        pending the v5e timing capture (benchmarks/proto_bf16_master.py).
+      bf16_switch_tol: relative |ddev| at which the warm-up hands over
+        (default 1e-4 ~ the bf16 storage-rounding deviance floor).
     """
 
     dtype: jnp.dtype = jnp.float32
@@ -55,6 +68,8 @@ class NumericConfig:
     refine_steps: int = 1
     matmul_precision: str | None = None
     polish: str | None = None
+    bf16_warmup: bool = False
+    bf16_switch_tol: float = 1e-4
 
 
 DEFAULT = NumericConfig()

@@ -319,12 +319,13 @@ def _split_line(line: str, ncol: int | None = None) -> list[str]:
     return fields
 
 
-def _read_csv_py(path: str, shard_index: int, num_shards: int,
-                 schema: dict[str, int] | None) -> dict[str, np.ndarray]:
-    """Pure-Python fallback with identical semantics (incl. byte sharding)."""
+def read_aligned_slice(path: str, shard_index: int, num_shards: int,
+                       data_start: int = 0) -> str:
+    """Decode shard ``shard_index`` of the newline-aligned byte-range
+    carve-up of ``[data_start, EOF)`` — the per-host shard contract shared
+    by the CSV fallback reader (data_start = end of header line) and the
+    NDJSON reader (data_start = 0, no header)."""
     with open(path, "rb") as f:
-        header = f.readline().decode()
-        data_start = f.tell()
         f.seek(0, os.SEEK_END)
         fsize = f.tell()
         span = fsize - data_start
@@ -341,7 +342,16 @@ def _read_csv_py(path: str, shard_index: int, num_shards: int,
         begin = align(data_start + span * shard_index // num_shards)
         end = align(data_start + span * (shard_index + 1) // num_shards)
         f.seek(begin)
-        blob = f.read(end - begin).decode()
+        return f.read(end - begin).decode()
+
+
+def _read_csv_py(path: str, shard_index: int, num_shards: int,
+                 schema: dict[str, int] | None) -> dict[str, np.ndarray]:
+    """Pure-Python fallback with identical semantics (incl. byte sharding)."""
+    with open(path, "rb") as f:
+        header = f.readline().decode()
+        data_start = f.tell()
+    blob = read_aligned_slice(path, shard_index, num_shards, data_start)
 
     names = _split_line(header.rstrip("\n"))
     # drop only truly blank lines; a ',,' line is a row of missing values,

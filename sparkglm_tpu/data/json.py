@@ -27,29 +27,13 @@ import os
 
 import numpy as np
 
-from .io import CATEGORICAL, NUMERIC
+from .io import CATEGORICAL, NUMERIC, read_aligned_slice
 
 
 def _align_ranges(path: str, shard_index: int, num_shards: int):
-    """Newline-aligned byte range of the shard — identical carve-up to
-    ``_read_csv_py`` minus the header line (NDJSON has none)."""
-    with open(path, "rb") as f:
-        f.seek(0, os.SEEK_END)
-        fsize = f.tell()
-
-        def align(pos):
-            if pos <= 0:
-                return 0
-            if pos >= fsize:
-                return fsize
-            f.seek(pos - 1)
-            f.readline()
-            return f.tell()
-
-        begin = align(fsize * shard_index // num_shards)
-        end = align(fsize * (shard_index + 1) // num_shards)
-        f.seek(begin)
-        return f.read(end - begin).decode()
+    """Newline-aligned byte range of the shard — the shared carve-up
+    (``data/io.py::read_aligned_slice``) with no header line to skip."""
+    return read_aligned_slice(path, shard_index, num_shards, data_start=0)
 
 
 def _records(blob: str, path: str):

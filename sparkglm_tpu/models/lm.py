@@ -160,6 +160,10 @@ class LMModel:
     # model retains no data (VERDICT r3 #7).  None for resident fits
     # (pass residuals= to summary()) and multi-process streams.
     resid_quantiles: tuple | None = None
+    # R's print.summary.lm header rule: "Weighted Residuals:" only when the
+    # weights VARY (diff(range(w)) != 0) — distinct from has_weights, which
+    # records that the CALL had weights (update()/logLik plumbing)
+    weights_vary: bool = False
 
     # -- scoring (LM.scala:29-61) --------------------------------------------
     def predict(self, X, mesh=None, se_fit: bool = False,

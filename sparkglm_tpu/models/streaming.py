@@ -610,7 +610,9 @@ def lm_fit_streaming(
     # block reverts to the opt-in summary(residuals=) path.
     rq_parts: list | None = [] if nproc == 1 else None
     rq_bytes = 0
-    saw_weights = False
+    # R's "Weighted Residuals:" header needs diff(range(w)) != 0, so track
+    # the global weight range, not just presence
+    w_lo, w_hi = np.inf, -np.inf
     err = None
     try:
         for Xc, yc, wc, oc in _iter_chunks(chunks):
@@ -619,8 +621,9 @@ def lm_fit_streaming(
             f = xb + oc64
             resid = yc64 - f
             sse += float(np.sum(wc64 * resid * resid))
-            if wc is not None and np.any(wc64 != 1.0):
-                saw_weights = True
+            if wc64.size:
+                w_lo = min(w_lo, float(wc64.min()))
+                w_hi = max(w_hi, float(wc64.max()))
             if rq_parts is not None:
                 rq_parts.append((np.sqrt(wc64) * resid).astype(np.float32))
                 rq_bytes += rq_parts[-1].nbytes
@@ -639,12 +642,18 @@ def lm_fit_streaming(
         err = e
     if nproc > 1:
         _sync_errors(err)
+        from jax.experimental import multihost_utils as mh
+
         from ..parallel import distributed as dist
-        sse, sst_centered, sst_raw, swf, mss_raw, sw_flag = (
+        sse, sst_centered, sst_raw, swf, mss_raw = (
             float(v) for v in dist.allsum_f64(
-                [sse, sst_centered, sst_raw, swf, mss_raw,
-                 float(saw_weights)]))
-        saw_weights = sw_flag > 0  # any process saw non-unit weights
+                [sse, sst_centered, sst_raw, swf, mss_raw]))
+        # global weight RANGE (min/max don't compose under allsum)
+        rng_all = np.asarray(
+            mh.process_allgather(np.asarray([w_lo, w_hi], np.float64)))
+        w_lo = float(np.min(rng_all[..., 0]))
+        w_hi = float(np.max(rng_all[..., 1]))
+    weights_vary = np.isfinite(w_lo) and w_hi > w_lo
     if saw_offset:
         # R's summary.lm with an offset: mss from the FITTED values
         # f = X beta + offset; sst := mss + rss (models/lm.py).  The
@@ -705,7 +714,9 @@ def lm_fit_streaming(
         sigma=float(np.sqrt(sigma2)), f_statistic=float(f_stat),
         has_intercept=bool(has_intercept),
         n_shards=mesh.shape[meshlib.DATA_AXIS], cov_unscaled=None,
-        has_offset=bool(saw_offset), has_weights=bool(saw_weights),
+        has_offset=bool(saw_offset),
+        has_weights=bool(np.isfinite(w_lo) and (w_lo != 1.0 or w_hi != 1.0)),
+        weights_vary=bool(weights_vary),
         resid_quantiles=resid_q)
 
 

@@ -99,13 +99,14 @@ class LMSummary:
             names = list(rq)
             vals = [sig_digits(v, 5) for v in rq.values()]
             widths = [max(len(a), len(b)) for a, b in zip(names, vals)]
-            # R's print.summary.lm header: weighted fits show sqrt(w)*r.
-            # Only the model's STREAMED quantiles are sqrt(w)-weighted;
-            # caller-supplied residuals are raw, so they keep the plain
-            # header whatever the fit's weights were.
+            # R's print.summary.lm header: "Weighted Residuals:" only when
+            # the weights VARY (diff(range(w)) != 0).  Only the model's
+            # STREAMED quantiles are sqrt(w)-weighted; caller-supplied
+            # residuals are raw, so they keep the plain header whatever
+            # the fit's weights were.
             hdr = ("Weighted Residuals:"
                    if self.residuals is None
-                   and getattr(self.model, "has_weights", False)
+                   and getattr(self.model, "weights_vary", False)
                    else "Residuals:")
             resid_block = (
                 hdr + "\n"

@@ -83,7 +83,14 @@ def _step_math(X, y, wt, off, beta_row, *, family, link, first):
     f32 matmul operands towards bf16, and z = eta + (y-mu)*g amplifies that
     into ~1e-3 relative error in X'Wz (measured); the elementwise form stays
     at f32 accuracy.
+
+    A bfloat16 X (the warm-up phase of the mixed-precision IRLS schedule:
+    half the HBM read per pass) is upcast to f32 here — all elementwise
+    math and accumulation stay f32; only the input storage rounding
+    (~2^-9 per entry) is added.
     """
+    if X.dtype == jnp.bfloat16:
+        X = X.astype(jnp.float32)
     valid = wt > 0.0
     if first:
         mu = jnp.where(valid, family.init_mu(y, jnp.maximum(wt, _TINY)), 1.0)
@@ -119,6 +126,10 @@ def _fisher_kernel(x_ref, y_ref, wt_ref, off_ref, beta_ref,
         x_ref[:], y_ref[:], wt_ref[:], off_ref[:], beta_ref[:],
         family=family, link=link, first=first)
     X = x_ref[:]
+    if X.dtype == jnp.bfloat16:
+        # MXU consumes bf16 directly under DEFAULT; f32 Xw x bf16 X needs
+        # matching dtypes for dot_general, and accumulation stays f32
+        X = X.astype(jnp.float32)
     xtwx_ref[:] += jax.lax.dot_general(
         Xw, X, (((0,), (0,)), ((), ())), preferred_element_type=X.dtype,
         precision=precision)
@@ -148,6 +159,9 @@ def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
     n, p = X.shape
     if n % block_rows:
         raise ValueError(f"n={n} must be a multiple of block_rows={block_rows}")
+    # bf16 X (mixed-precision warm-up): accumulators stay f32
+    acc = jnp.float32 if X.dtype == jnp.bfloat16 else X.dtype
+    itemsize = X.dtype.itemsize
     yc, wc, oc = (a.reshape(n, 1) for a in (y, wt, offset))
     bc = beta.reshape(1, p)
     kern = partial(_fisher_kernel, family=family, link=link, first=first,
@@ -169,13 +183,13 @@ def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((p, p), X.dtype),
-            jax.ShapeDtypeStruct((1, p), X.dtype),
-            jax.ShapeDtypeStruct((1, 1), X.dtype),
+            jax.ShapeDtypeStruct((p, p), acc),
+            jax.ShapeDtypeStruct((1, p), acc),
+            jax.ShapeDtypeStruct((1, 1), acc),
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * n * p * (p + 2),
-            bytes_accessed=4 * (n * p + 4 * n + p * p + 2 * p),
+            bytes_accessed=itemsize * n * p + 4 * (4 * n + p * p + 2 * p),
             transcendentals=4 * n,
         ),
         interpret=interpret,
@@ -197,6 +211,8 @@ def fused_fisher_pass_ref(X, y, wt, offset, beta, *, family, link,
     yc, wc, oc = (a.reshape(n, 1) for a in (y, wt, offset))
     Xw, z, _, dev = _step_math(X, yc, wc, oc, beta.reshape(1, p),
                                family=family, link=link, first=first)
+    if X.dtype == jnp.bfloat16:  # mirror the kernel: f32 math/accumulation
+        X = X.astype(jnp.float32)
     gram_prec = (jax.lax.Precision.HIGHEST if X.dtype == jnp.float64
                  else resolve_kernel_precision(precision))
     XtWX = jax.lax.dot_general(Xw, X, (((0,), (0,)), ((), ())),

@@ -244,6 +244,14 @@ def test_lm_streaming_weighted_residual_quantiles(rng, mesh8):
         rtol=1e-6, atol=1e-9)
     assert "Weighted Residuals:" in str(m.summary())
 
+    # R's header rule needs weights that VARY: constant weights (even != 1)
+    # keep the plain header, though the quantiles are still sqrt(w)*r
+    mc = sg.lm_fit_streaming((X, y, np.full(n, 2.0), None), chunk_rows=200,
+                             mesh=mesh8)
+    sc = str(mc.summary())
+    assert "Weighted Residuals:" not in sc and "Residuals:" in sc
+    assert mc.has_weights and not mc.weights_vary
+
 
 def test_from_csv_rejects_array_args(csv_data):
     path, _ = csv_data

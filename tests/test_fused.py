@@ -139,3 +139,77 @@ def test_engine_validated(mesh1, rng):
     X, y = _logistic_data(rng, n=200)
     with pytest.raises(ValueError, match="engine"):
         sg.glm_fit(X, y, engine="warp", mesh=mesh1)
+
+
+def test_bf16_warmup_schedule_matches_plain(rng, mesh8):
+    """Mixed-precision schedule (config.bf16_warmup): bf16 warm-up passes
+    hand over to f32 at bf16_switch_tol, so the FINAL coefficients match
+    the plain fused engine at its normal tolerance — the accuracy
+    contract that makes the half-HBM warm-up shippable."""
+    from sparkglm_tpu.config import NumericConfig
+
+    n, p = 40_000, 12
+    X = np.column_stack([np.ones(n),
+                         rng.standard_normal((n, p - 1))]).astype(np.float32)
+    bt = (rng.standard_normal(p) / np.sqrt(p)).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(np.float32)
+
+    kw = dict(family="binomial", tol=1e-8, criterion="relative", mesh=mesh8,
+              engine="fused")
+    plain = sg.glm_fit(X, y, **kw)
+    mixed = sg.glm_fit(X, y, config=NumericConfig(bf16_warmup=True), **kw)
+    assert mixed.converged
+    np.testing.assert_allclose(mixed.coefficients, plain.coefficients,
+                               rtol=0, atol=5e-6)
+    np.testing.assert_allclose(mixed.std_errors, plain.std_errors,
+                               rtol=1e-4)
+    assert mixed.deviance == pytest.approx(plain.deviance, rel=1e-6)
+    # the schedule runs real warm-up iterations plus >=1 f32 iteration,
+    # and reports the total
+    assert mixed.iterations >= plain.iterations
+
+
+def test_bf16_fused_pass_parity(rng):
+    """ops-level: the fused pass accepts bf16 X; results match the f32
+    pass at bf16 input-rounding tolerance, accumulators are f32."""
+    from sparkglm_tpu.families.families import resolve
+    from sparkglm_tpu.ops.fused import fused_fisher_pass_ref
+
+    fam, lnk = resolve("binomial", "logit")
+    n, p = 4096, 16
+    X = rng.standard_normal((n, p)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    wt = np.ones(n, np.float32)
+    off = np.zeros(n, np.float32)
+    beta = (rng.standard_normal(p) * 0.1).astype(np.float32)
+    import jax.numpy as jnp
+    G32, b32, d32 = fused_fisher_pass_ref(
+        jnp.asarray(X), jnp.asarray(y), jnp.asarray(wt), jnp.asarray(off),
+        jnp.asarray(beta), family=fam, link=lnk)
+    Gb, bb, db = fused_fisher_pass_ref(
+        jnp.asarray(X).astype(jnp.bfloat16), jnp.asarray(y),
+        jnp.asarray(wt), jnp.asarray(off), jnp.asarray(beta),
+        family=fam, link=lnk)
+    assert Gb.dtype == jnp.float32 and bb.dtype == jnp.float32
+    assert float(jnp.max(jnp.abs(Gb - G32)) / jnp.max(jnp.abs(G32))) < 5e-3
+    assert float(abs(db - d32) / abs(d32)) < 1e-3
+
+
+def test_bf16_warmup_honours_max_iter(rng, mesh8):
+    """A warm-up that spends the whole budget must not run unbudgeted f32
+    passes: iterations <= max_iter, converged=False at the user tol."""
+    from sparkglm_tpu.config import NumericConfig
+
+    n, p = 20_000, 8
+    X = np.column_stack([np.ones(n),
+                         rng.standard_normal((n, p - 1))]).astype(np.float32)
+    bt = (rng.standard_normal(p) / np.sqrt(p)).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(np.float32)
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = sg.glm_fit(X, y, family="binomial", engine="fused", max_iter=2,
+                       tol=1e-12, criterion="relative", mesh=mesh8,
+                       config=NumericConfig(bf16_warmup=True))
+    assert m.iterations <= 2
+    assert not m.converged
