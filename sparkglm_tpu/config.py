@@ -43,9 +43,9 @@ class NumericConfig:
         ``None`` (the default) = AUTO: the polish runs exactly when the
         fit's equilibrated pivot shows the f32 normal equations losing
         digits (pivot < 0.03 ~ kappa(X) beyond ~30), with a warning —
-        on paths that can run it (resident AND global multi-process fits
-        with an unsharded feature axis; streaming fits warn instead —
-        their chunked TSQR does not exist yet).
+        on every path: resident and global multi-process fits with an
+        unsharded feature axis (ops/tsqr.py), and streaming/out-of-core
+        fits via the chunked TSQR (models/streaming.py::_streaming_csne).
         ``"off"`` never polishes (r02's warn-only behaviour).
       bf16_warmup: mixed-precision IRLS schedule for the fused engine.
         Early iterations only steer beta toward the fixed point — their

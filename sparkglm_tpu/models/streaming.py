@@ -67,16 +67,14 @@ DEFAULT_CHUNK_ROWS = 262_144
 
 
 def _check_polish(config: NumericConfig) -> None:
-    """Streaming solves run on host float64 already — the csne polish is
-    neither needed nor applicable; invalid values still raise like the
-    resident fits."""
+    """Validate the polish config like the resident fits.  The streaming
+    ACCUMULATION is host f64, but the per-chunk Gramian products are
+    device f32 (~eps32*kappa^2 coefficient error on ill-conditioned
+    designs), so since r4 polish='csne' (and the AUTO escalation) runs
+    the chunked TSQR polish (:func:`_streaming_csne`)."""
     if config.polish not in (None, "csne", "off"):
         raise ValueError(
             f"polish must be None (auto), 'csne' or 'off', got {config.polish!r}")
-    if config.polish == "csne":
-        import warnings
-        warnings.warn("streaming fits solve on host float64; polish='csne' "
-                      "is not applicable and is ignored", stacklevel=3)
 
 
 def _resolve_dtype(Xc, config: NumericConfig) -> np.dtype:
@@ -471,16 +469,136 @@ def _diag_inv64(factor) -> np.ndarray:
     return np.diag(scipy.linalg.cho_solve(cho, np.eye(cho[0].shape[0]))) * dinv * dinv
 
 
-def _warn_streaming_conditioning(pivot: float, dtype, config) -> None:
+def _resolve_streaming_polish(pivot: float, dtype, config) -> bool:
     """Chunk Gramians are computed in f32 on device (accumulation is host
     f64, but the per-chunk products already carry ~eps32 noise), so the
-    resident fits' conditioning warning applies here too; the CSNE polish
-    has no streaming implementation, hence can_polish=False (warn-only)."""
+    resident fits' conditioning policy applies here too — and since r4 the
+    CHUNKED TSQR polish (:func:`_streaming_csne`) can actually run, so the
+    policy escalates instead of warning (can_polish=True)."""
     from .conditioning import resolve_ill_conditioning
-    resolve_ill_conditioning(pivot, is_f32=np.dtype(dtype) != np.float64,
-                             engine="einsum", polish_active=False,
-                             polish_cfg=config.polish, can_polish=False,
-                             stacklevel=4)
+    return resolve_ill_conditioning(
+        pivot, is_f32=np.dtype(dtype) != np.float64,
+        engine="einsum", polish_active=config.polish == "csne",
+        polish_cfg=config.polish, can_polish=True, stacklevel=4)
+
+
+@jax.jit
+def _xtv_hi(X, v):
+    return jnp.matmul(X.T, v, precision=jax.lax.Precision.HIGHEST)
+
+
+@partial(jax.jit, static_argnames=("m",))
+def _chunk_tsqr_r(Xd, wd, *, m):
+    """Per-chunk sqrt(w)-scaled TSQR factor (module-level jit: the XLA
+    compile caches across fits)."""
+    from ..ops.tsqr import tsqr_r
+    Xw = Xd * jnp.sqrt(jnp.maximum(wd, 0.0))[:, None]
+    return tsqr_r(Xw, m)
+
+
+def _sync_polish_decision(want: bool, nproc: int) -> bool:
+    """A per-process polish decision (it depends on the locally-resolved
+    dtype/pivot) entering collective passes on SOME processes only would
+    deadlock the job — make it collective: any process that wants the
+    polish enlists all of them."""
+    if nproc <= 1:
+        return want
+    from ..parallel import distributed as dist
+    return bool(dist.allsum_f64([float(want)])[0] > 0)
+
+
+def _chunk_zw(fam_name, lnk_name, yc, wc, oc, xb):
+    """Host-f64 IRLS working response/weights at beta (models/hoststats.py
+    numpy family math).  fam_name None = lm: z = y - offset, w = wt."""
+    from . import hoststats
+    if fam_name is None:
+        return yc - oc, wc
+    eta = xb + oc
+    mu = hoststats.link_inverse(lnk_name, eta)
+    g = hoststats.link_deriv(lnk_name, mu)
+    var = hoststats.variance(fam_name, mu)
+    valid = wc > 0
+    w = np.where(valid, wc / np.maximum(var * g * g, 1e-300), 0.0)
+    z = np.where(valid,
+                 np.nan_to_num(eta - oc + (yc - mu) * g,
+                               nan=0.0, posinf=0.0, neginf=0.0), 0.0)
+    return z, w
+
+
+def _streaming_csne(chunks, beta, *, fam_name, lnk_name, dtype, mesh,
+                    nproc, steps: int = 2):
+    """Chunked TSQR + corrected seminormal polish — the streaming analogue
+    of ``ops/tsqr.py::csne_polish`` (error ~eps32*kappa instead of the
+    chunked f32 Gramians' ~eps32*kappa^2).
+
+    One pass QR-factors each chunk's sqrt(w)-scaled design ON DEVICE
+    (f32 — that is where the eps32*kappa backward error comes from) and
+    combines the (p, p) R factors sequentially on host in f64; each
+    correction step is one more streaming pass accumulating the exact
+    host-f64 gradient X'W(z - X beta), solved against R'R and accepted
+    only when the gradient norm drops.  Multi-process: local R factors
+    allgather+stack, gradients allsum — every process returns the same
+    polished beta.  Returns ``(beta, diag_inv)`` (diag of (X'WX)^{-1}
+    from R, so SEs carry the polished accuracy) or ``None`` when R is
+    numerically rank-deficient (caller keeps the unpolished solution).
+    """
+    p = beta.shape[0]
+    put_dtype = np.float32 if np.dtype(dtype) != np.float64 else np.float64
+
+    def passes(b, want_r: bool):
+        """One streaming pass: gradient at b (always) + R factor (opt)."""
+        R = None
+        g = np.zeros(p)
+        for Xc, yc, wc, oc in _iter_chunks(chunks):
+            xb = _chunk_xbeta(Xc, b)
+            yc64, wc64, oc64 = _host_chunk(yc, wc, oc)
+            z, w = _chunk_zw(fam_name, lnk_name, yc64, wc64, oc64, xb)
+            r = w * (z - xb)
+            if _is_device_chunk(Xc):
+                # the residual stays >= f32 even for bf16 device sources —
+                # a bf16 gradient would defeat the polish
+                g += np.asarray(_xtv_hi(Xc, jnp.asarray(r, put_dtype)),
+                                np.float64)
+            else:
+                g += np.asarray(Xc, np.float64).T @ r
+            if want_r:
+                Xd, _, wd, _ = _put_chunk(Xc, yc, w, None, mesh, put_dtype)
+                Rc = np.asarray(_chunk_tsqr_r(Xd, wd, m=mesh), np.float64)
+                R = Rc if R is None else np.linalg.qr(
+                    np.vstack([R, Rc]), mode="r")
+        if nproc > 1:
+            from jax.experimental import multihost_utils as mh
+
+            from ..parallel import distributed as dist
+            g = dist.allsum_f64(g)
+            if want_r:
+                all_r = np.asarray(mh.process_allgather(
+                    np.asarray(R if R is not None else np.zeros((p, p)),
+                               np.float64)))
+                R = np.linalg.qr(all_r.reshape(-1, p), mode="r")
+        return g, R
+
+    g, R = passes(beta, True)
+    # scale-free rank probe, as ops/tsqr.py::r_pivot
+    col = np.sqrt(np.clip(np.sum(R * R, axis=0), 1e-30, None))
+    if float(np.min(np.abs(np.diag(R)) / col)) < 1e-6:
+        return None
+
+    def solve_rr(v):
+        y1 = scipy.linalg.solve_triangular(R.T, v, lower=True)
+        return scipy.linalg.solve_triangular(R, y1, lower=False)
+
+    gn = float(g @ g)
+    b = np.asarray(beta, np.float64).copy()
+    for _ in range(steps):
+        cand = b + solve_rr(g)
+        g_c, _ = passes(cand, False)
+        gn_c = float(g_c @ g_c)
+        if not (gn_c < gn):
+            break
+        b, g, gn = cand, g_c, gn_c
+    diag_inv = np.diag(solve_rr(np.eye(p)))
+    return b, diag_inv
 
 
 # ---------------------------------------------------------------------------
@@ -591,8 +709,19 @@ def lm_fit_streaming(
             or bool(ones_mask.any()))
 
     beta, cho, pivot = _solve64(acc["XtWX"], acc["XtWy"], config.jitter)
-    _warn_streaming_conditioning(pivot, dtype, config)
     diag_inv = _diag_inv64(cho)
+    if _sync_polish_decision(
+            _resolve_streaming_polish(pivot, dtype, config), nproc):
+        pol = _streaming_csne(chunks, beta, fam_name=None, lnk_name=None,
+                              dtype=dtype, mesh=mesh, nproc=nproc)
+        if pol is not None:
+            beta, diag_inv = pol
+        else:
+            import warnings
+            warnings.warn(
+                "CSNE polish skipped: the TSQR rank probe found the design "
+                "numerically rank-deficient — returning the unpolished "
+                "solution; coefficients may lose digits", stacklevel=2)
     # residual statistics in a second HOST float64 pass at the solved beta —
     # the one-pass y'Wy - beta'X'Wy identity loses every significant digit
     # for near-exact fits once the Gramian carries f32 chunk rounding
@@ -960,8 +1089,6 @@ def glm_fit_streaming(
         if crit <= tol_eff:
             converged = True
             break
-    if not _null_model:
-        _warn_streaming_conditioning(pivot, dtype, config)
     diag_inv = _diag_inv64(cho)  # once, from the final factorization
     # the IRLS loop is the cache's only reader; release the pinned device
     # chunks NOW so the host-side stats passes and the recursive null-model
@@ -971,6 +1098,21 @@ def glm_fit_streaming(
     ccache.fingerprints.clear()
     ccache.bytes = 0
     ccache.open = False
+    if not _null_model and _sync_polish_decision(
+            _resolve_streaming_polish(pivot, dtype, config), nproc):
+        # chunked TSQR + CSNE at the converged beta — the streaming
+        # analogue of the resident auto-escalation (models/conditioning.py)
+        pol = _streaming_csne(chunks, beta, fam_name=fam.name,
+                              lnk_name=lnk.name, dtype=dtype, mesh=mesh,
+                              nproc=nproc)
+        if pol is not None:
+            beta, diag_inv = pol
+        else:
+            import warnings
+            warnings.warn(
+                "CSNE polish skipped: the TSQR rank probe found the design "
+                "numerically rank-deficient — returning the unpolished "
+                "solution; coefficients may lose digits", stacklevel=2)
     if not converged and not _null_model:
         import warnings
         clamp_note = (f" (effective threshold {tol_eff:g} — the requested "

@@ -229,9 +229,15 @@ def test_streaming_rejects_bad_polish(rng):
     with pytest.raises(ValueError, match="polish"):
         glm_fit_streaming((X, y), family="gamma", link="log",
                           config=NumericConfig(polish="bogus"))
-    with pytest.warns(UserWarning, match="not applicable"):
-        glm_fit_streaming((X, y), family="gamma", link="log",
-                          config=NumericConfig(polish="csne"))
+    # explicit polish='csne' runs the chunked TSQR polish (r4) — no
+    # "not applicable" warning, and the fit still matches the unpolished
+    # one on this well-conditioned design
+    m_p = glm_fit_streaming((X, y), family="gamma", link="log",
+                            config=NumericConfig(polish="csne"))
+    m_0 = glm_fit_streaming((X, y), family="gamma", link="log",
+                            config=NumericConfig(polish="off"))
+    np.testing.assert_allclose(m_p.coefficients, m_0.coefficients,
+                               rtol=1e-5, atol=1e-7)
 
 
 def test_polish_validated():
@@ -241,21 +247,57 @@ def test_polish_validated():
         sg.lm_fit(X, y, config=NumericConfig(polish="nope"))
 
 
-def test_streaming_warns_on_ill_conditioning(rng):
-    """Streaming fits have no CSNE polish, so the AUTO policy degrades to
-    the loud warning (config.py polish docstring contract); chunk Gramians
-    are f32 on device even though accumulation is host f64."""
-    from sparkglm_tpu.models.streaming import glm_fit_streaming, lm_fit_streaming
+def test_streaming_auto_polish_recovers_digits(rng):
+    """r4: the AUTO conditioning policy ESCALATES streaming fits to the
+    chunked TSQR + CSNE polish (previously warn-only — the one place the
+    resident accuracy contract ended).  The chunk Gramians are f32 on
+    device (~eps32*kappa^2 error); the chunked f32 QR + host-f64
+    seminormal correction recovers ~eps32*kappa."""
+    from sparkglm_tpu.models.streaming import lm_fit_streaming
     n, p, kappa = 20_000, 10, 1e3
     X = _conditioned(rng, n, p, kappa).astype(np.float32)
-    yl = (X @ rng.standard_normal(p) + 0.1 * rng.standard_normal(n)).astype(np.float32)
+    yl = (X @ rng.standard_normal(p)
+          + 0.1 * rng.standard_normal(n)).astype(np.float32)
+    truth = np.linalg.lstsq(X.astype(np.float64),
+                            np.asarray(yl, np.float64), rcond=None)[0]
+
+    with pytest.warns(UserWarning, match="auto-applying"):
+        m_auto = lm_fit_streaming((X, yl), chunk_rows=4096,
+                                  config=NumericConfig(dtype="float32"))
     with pytest.warns(UserWarning, match="may lose digits"):
-        lm_fit_streaming((X, yl), config=NumericConfig(dtype="float32"))
-    yg = (rng.random(n) < 1 / (1 + np.exp(-np.clip(X @ rng.standard_normal(p), -8, 8)))
-          ).astype(np.float32)
+        m_off = lm_fit_streaming((X, yl), chunk_rows=4096,
+                                 config=NumericConfig(dtype="float32",
+                                                      polish="off"))
+    err_auto = np.max(np.abs(m_auto.coefficients - truth))
+    err_off = np.max(np.abs(m_off.coefficients - truth))
+    assert err_auto < err_off / 5, (err_auto, err_off)
+    assert err_auto < 1e-3
+
+
+def test_streaming_glm_auto_polish(rng):
+    """The GLM streaming path escalates too — z/w rebuilt at the
+    converged beta from the host-f64 family math."""
+    from sparkglm_tpu.models.streaming import glm_fit_streaming
+    n, p, kappa = 20_000, 10, 1e3
+    X = _conditioned(rng, n, p, kappa).astype(np.float32)
+    yg = (rng.random(n) < 1 / (1 + np.exp(
+        -np.clip(X @ rng.standard_normal(p), -8, 8)))).astype(np.float32)
+    with pytest.warns(UserWarning, match="auto-applying"):
+        m_auto = glm_fit_streaming((X, yg), family="binomial",
+                                   chunk_rows=4096,
+                                   config=NumericConfig(dtype="float32"))
+    # f64 oracle on the identical data (module-level import)
+    truth = irls_np(X.astype(np.float64), np.asarray(yg, np.float64),
+                    "binomial", "logit")[0]
     with pytest.warns(UserWarning, match="may lose digits"):
-        glm_fit_streaming((X, yg), family="binomial",
-                          config=NumericConfig(dtype="float32"))
+        m_off = glm_fit_streaming((X, yg), family="binomial",
+                                  chunk_rows=4096,
+                                  config=NumericConfig(dtype="float32",
+                                                       polish="off"))
+    err_auto = np.max(np.abs(m_auto.coefficients - truth))
+    err_off = np.max(np.abs(m_off.coefficients - truth))
+    assert err_auto <= err_off, (err_auto, err_off)
+    assert err_auto < 5e-3
 
 
 def test_default_args_auto_polish_at_kappa_1e3(mesh8, rng):
