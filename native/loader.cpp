@@ -178,6 +178,278 @@ bool for_each_field(const char* lb, size_t llen, size_t ncol, F&& on_field) {
 
 }  // namespace
 
+// ---------------------------------------------------------------------------
+// Flat NDJSON (one JSON object per line) — the reference's own fixture
+// format (testData.scala:10-15 loads test data with Spark's JSON reader).
+// Spark-JSON semantics shared with the Python twin (data/json.py): columns
+// are the UNION of keys, a record missing a key contributes a missing
+// value, a key that is ever a string is categorical everywhere, booleans
+// read as 0/1 indicators, nested objects/arrays are rejected.
+// ---------------------------------------------------------------------------
+
+#include <charconv>
+#include <cmath>
+
+namespace {
+
+enum class JKind { Str, Num, Bool, Null, Err };
+
+struct JValue {
+  JKind kind = JKind::Null;
+  double num = 0.0;
+  bool is_int = false;
+  std::string str;
+};
+
+struct JLine {
+  const char* p;
+  const char* end;
+  std::string err;
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  }
+
+  bool fail(const char* msg) {
+    if (err.empty()) err = msg;
+    return false;
+  }
+
+  static void utf8_append(std::string& out, uint32_t cp) {
+    if (cp < 0x80) {
+      out.push_back(static_cast<char>(cp));
+    } else if (cp < 0x800) {
+      out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+      out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  bool hex4(uint32_t* out) {
+    if (end - p < 4) return fail("bad \\u escape");
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = p[i];
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= static_cast<uint32_t>(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= static_cast<uint32_t>(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= static_cast<uint32_t>(c - 'A' + 10);
+      else return fail("bad \\u escape");
+    }
+    p += 4;
+    *out = v;
+    return true;
+  }
+
+  bool parse_string(std::string& out) {
+    out.clear();
+    if (p >= end || *p != '"') return fail("expected string");
+    ++p;
+    while (p < end && *p != '"') {
+      char c = *p++;
+      if (c != '\\') {
+        out.push_back(c);
+        continue;
+      }
+      if (p >= end) return fail("bad escape");
+      char e = *p++;
+      switch (e) {
+        case '"': out.push_back('"'); break;
+        case '\\': out.push_back('\\'); break;
+        case '/': out.push_back('/'); break;
+        case 'b': out.push_back('\b'); break;
+        case 'f': out.push_back('\f'); break;
+        case 'n': out.push_back('\n'); break;
+        case 'r': out.push_back('\r'); break;
+        case 't': out.push_back('\t'); break;
+        case 'u': {
+          uint32_t cp;
+          if (!hex4(&cp)) return false;
+          if (cp >= 0xD800 && cp <= 0xDBFF) {
+            // high surrogate MUST pair with a following low surrogate;
+            // python json.loads tolerates lone surrogates, but their
+            // CESU-8 bytes would crash the ctypes .decode() later — fail
+            // loudly here instead of corrupting level strings
+            if (end - p < 6 || p[0] != '\\' || p[1] != 'u') {
+              return fail("unpaired surrogate escape");
+            }
+            p += 2;
+            uint32_t lo;
+            if (!hex4(&lo)) return false;
+            if (lo < 0xDC00 || lo > 0xDFFF) {
+              return fail("unpaired surrogate escape");
+            }
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+          } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+            return fail("unpaired surrogate escape");
+          }
+          utf8_append(out, cp);
+          break;
+        }
+        default: return fail("bad escape");
+      }
+    }
+    if (p >= end) return fail("unterminated string");
+    ++p;  // closing quote
+    return true;
+  }
+
+  bool parse_value(JValue& v) {
+    skip_ws();
+    if (p >= end) return fail("truncated value");
+    char c = *p;
+    if (c == '"') {
+      v.kind = JKind::Str;
+      return parse_string(v.str);
+    }
+    if (c == '{' || c == '[') {
+      return fail("nested JSON value is not a flat model-frame column");
+    }
+    if (c == 't' && end - p >= 4 && std::memcmp(p, "true", 4) == 0) {
+      p += 4;
+      v.kind = JKind::Bool;
+      v.num = 1.0;
+      return true;
+    }
+    if (c == 'f' && end - p >= 5 && std::memcmp(p, "false", 5) == 0) {
+      p += 5;
+      v.kind = JKind::Bool;
+      v.num = 0.0;
+      return true;
+    }
+    if (c == 'n' && end - p >= 4 && std::memcmp(p, "null", 4) == 0) {
+      p += 4;
+      v.kind = JKind::Null;
+      return true;
+    }
+    // python json.loads accepts these non-standard literals by default
+    if (c == 'N' && end - p >= 3 && std::memcmp(p, "NaN", 3) == 0) {
+      p += 3;
+      v.kind = JKind::Num;
+      v.num = std::numeric_limits<double>::quiet_NaN();
+      v.is_int = false;
+      return true;
+    }
+    if (c == 'I' && end - p >= 8 && std::memcmp(p, "Infinity", 8) == 0) {
+      p += 8;
+      v.kind = JKind::Num;
+      v.num = std::numeric_limits<double>::infinity();
+      v.is_int = false;
+      return true;
+    }
+    if (c == '-' && end - p >= 9 && std::memcmp(p, "-Infinity", 9) == 0) {
+      p += 9;
+      v.kind = JKind::Num;
+      v.num = -std::numeric_limits<double>::infinity();
+      v.is_int = false;
+      return true;
+    }
+    const char* q = p;
+    bool integral = true;
+    while (q < end && (std::strchr("+-0123456789.eE", *q) != nullptr)) {
+      if (*q == '.' || *q == 'e' || *q == 'E') integral = false;
+      ++q;
+    }
+    double d;
+    if (q > p && parse_double(p, static_cast<size_t>(q - p), &d)) {
+      p = q;
+      v.kind = JKind::Num;
+      v.num = d;
+      // python json.loads types a '.'-/'e'-free token as int; str() of an
+      // int has no ".0" — record it so categorical interning matches
+      v.is_int = integral && std::abs(d) < 9007199254740992.0;  // 2^53
+      return true;
+    }
+    return fail("bad JSON value");
+  }
+};
+
+// Python str(float) formatting, so a numeric value landing in a
+// CATEGORICAL column interns the same level string as the Python twin's
+// str(v): shortest round-trip digits (to_chars scientific), then CPython
+// repr's fixed/scientific choice — fixed iff -4 <= exp10 < 16, with ".0"
+// appended to integral magnitudes; otherwise "d[.ddd]e±XX".
+std::string py_float_str(double v) {
+  if (std::isnan(v)) return "nan";
+  if (std::isinf(v)) return v < 0 ? "-inf" : "inf";
+  char buf[40];
+  auto [ptr, ec] = std::to_chars(buf, buf + sizeof(buf), v,
+                                 std::chars_format::scientific);
+  std::string s(buf, ptr);
+  size_t epos = s.find('e');
+  std::string mant = s.substr(0, epos);
+  int exp = std::atoi(s.c_str() + epos + 1);
+  bool neg = !mant.empty() && mant[0] == '-';
+  if (neg) mant.erase(0, 1);
+  std::string digits;
+  for (char c : mant) {
+    if (c != '.') digits.push_back(c);
+  }
+  while (digits.size() > 1 && digits.back() == '0') digits.pop_back();
+  std::string out;
+  if (exp >= -4 && exp < 16) {
+    if (exp >= 0) {
+      if (static_cast<size_t>(exp) + 1 >= digits.size()) {
+        out = digits + std::string(exp + 1 - digits.size(), '0') + ".0";
+      } else {
+        out = digits.substr(0, exp + 1) + "." + digits.substr(exp + 1);
+      }
+    } else {
+      out = "0." + std::string(-exp - 1, '0') + digits;
+    }
+  } else {
+    out = digits.substr(0, 1);
+    if (digits.size() > 1) out += "." + digits.substr(1);
+    char eb[8];
+    std::snprintf(eb, sizeof(eb), "e%+03d", exp);
+    out += eb;
+  }
+  return neg ? "-" + out : out;
+}
+
+// Parse one NDJSON object line into (key, value) callbacks; returns false
+// (with err set) on malformed lines.
+template <typename F>
+bool parse_json_object(const char* lb, size_t llen, std::string* err,
+                       F&& on_pair) {
+  JLine jl{lb, lb + llen, {}};
+  jl.skip_ws();
+  if (jl.p >= jl.end) return false;  // blank line: skip silently
+  if (*jl.p != '{') {
+    *err = "NDJSON lines must be objects";
+    return false;
+  }
+  ++jl.p;
+  jl.skip_ws();
+  if (jl.p < jl.end && *jl.p == '}') return true;  // empty object: a row
+  std::string key;
+  JValue val;
+  while (true) {
+    jl.skip_ws();
+    if (!jl.parse_string(key)) { *err = jl.err; return false; }
+    jl.skip_ws();
+    if (jl.p >= jl.end || *jl.p != ':') { *err = "expected ':'"; return false; }
+    ++jl.p;
+    if (!jl.parse_value(val)) { *err = jl.err; return false; }
+    on_pair(key, val);
+    jl.skip_ws();
+    if (jl.p < jl.end && *jl.p == ',') { ++jl.p; continue; }
+    if (jl.p < jl.end && *jl.p == '}') return true;
+    *err = "expected ',' or '}'";
+    return false;
+  }
+}
+
+}  // namespace
+
 extern "C" {
 
 struct SgioTable;  // opaque
@@ -349,6 +621,174 @@ int64_t sgio_col_n_levels(SgioTable* h, int64_t i) {
 
 const char* sgio_col_level(SgioTable* h, int64_t i, int64_t j) {
   return reinterpret_cast<Table*>(h)->cols[i].levels[j].c_str();
+}
+
+// Flat NDJSON reader sharing the Table ABI.  ``kind_names``/``kinds`` fix
+// column kinds BY NAME (JSON has no column order; a shard's local key order
+// cannot index a global schema positionally): with n_kinds > 0 the output
+// columns are exactly the named set in that order — keys outside it are
+// ignored, absent keys yield all-missing columns — so every host of a
+// sharded read types and aligns identically.  schema_only skips the fill.
+SgioTable* sgio_read_json(const char* path, int64_t shard_index,
+                          int64_t num_shards,
+                          const char* const* kind_names,
+                          const int32_t* kinds, int64_t n_kinds,
+                          int32_t schema_only) {
+  auto* t = new Table();
+  FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    t->error = std::string("cannot open ") + path;
+    return reinterpret_cast<SgioTable*>(t);
+  }
+  std::fseek(f, 0, SEEK_END);
+  const int64_t fsize = std::ftell(f);
+  if (num_shards < 1) num_shards = 1;
+  if (shard_index < 0 || shard_index >= num_shards) {
+    t->error = "shard_index out of range";
+    std::fclose(f);
+    return reinterpret_cast<SgioTable*>(t);
+  }
+  auto align_forward = [&](int64_t pos) -> int64_t {
+    if (pos <= 0) return 0;
+    if (pos >= fsize) return fsize;
+    std::fseek(f, pos - 1, SEEK_SET);
+    int ch;
+    while ((ch = std::fgetc(f)) != EOF && ch != '\n') {}
+    return std::ftell(f);
+  };
+  const int64_t begin = align_forward(fsize * shard_index / num_shards);
+  const int64_t end_pos = align_forward(fsize * (shard_index + 1) / num_shards);
+
+  std::unordered_map<std::string, size_t> index;
+  const bool fixed = n_kinds > 0;
+  for (int64_t i = 0; i < n_kinds; ++i) {
+    Column c;
+    c.name = kind_names[i];
+    c.is_categorical = kinds[i] != 0;
+    index.emplace(c.name, t->cols.size());
+    t->cols.push_back(std::move(c));
+  }
+
+  auto col_size = [](const Column& c) -> int64_t {
+    return static_cast<int64_t>(c.is_categorical ? c.codes.size()
+                                                 : c.nums.size());
+  };
+  auto push_missing = [](Column& c) {
+    if (c.is_categorical) c.codes.push_back(-1);
+    else c.nums.push_back(std::numeric_limits<double>::quiet_NaN());
+  };
+
+  if (!fixed || schema_only) {
+    // discovery pass: union of keys, categorical iff a STRING appears
+    // anywhere (data/json.py::scan_json_schema semantics), row count.
+    // Duplicate keys within a record: last wins BEFORE kind merging, as
+    // python's json.loads dict would present them
+    int64_t rows = 0;
+    std::vector<std::pair<std::string, JKind>> line_pairs;
+    for_each_line(f, begin, end_pos, [&](const char* lb, size_t llen) {
+      if (!t->error.empty()) return;
+      std::string perr;
+      line_pairs.clear();
+      bool ok = parse_json_object(lb, llen, &perr,
+          [&](const std::string& key, const JValue& v) {
+            for (auto& kv : line_pairs) {
+              if (kv.first == key) {
+                kv.second = v.kind;
+                return;
+              }
+            }
+            line_pairs.emplace_back(key, v.kind);
+          });
+      if (!perr.empty()) {
+        t->error = perr;
+        return;
+      }
+      if (!ok) return;
+      ++rows;
+      for (const auto& kv : line_pairs) {
+        auto it = index.find(kv.first);
+        size_t idx;
+        if (it == index.end()) {
+          if (fixed) continue;  // schema_only with fixed kinds: count only
+          Column c;
+          c.name = kv.first;
+          idx = t->cols.size();
+          index.emplace(kv.first, idx);
+          t->cols.push_back(std::move(c));
+        } else {
+          idx = it->second;
+        }
+        if (!fixed && kv.second == JKind::Str) {
+          t->cols[idx].is_categorical = true;
+        }
+      }
+    });
+    if (!t->error.empty() || schema_only) {
+      t->n_rows = rows;
+      std::fclose(f);
+      return reinterpret_cast<SgioTable*>(t);
+    }
+  }
+
+  // fill pass (single pass when kinds came fixed from the global scan)
+  int64_t row = 0;
+  for_each_line(f, begin, end_pos, [&](const char* lb, size_t llen) {
+    if (!t->error.empty()) return;
+    std::string perr;
+    bool ok = parse_json_object(lb, llen, &perr,
+        [&](const std::string& key, const JValue& v) {
+          auto it = index.find(key);
+          if (it == index.end()) return;  // key outside the fixed schema
+          Column& c = t->cols[it->second];
+          while (col_size(c) < row) push_missing(c);
+          if (col_size(c) > row) {  // duplicate key: python dict keeps last
+            if (c.is_categorical) c.codes.pop_back();
+            else c.nums.pop_back();
+          }
+          switch (v.kind) {
+            case JKind::Null:
+              push_missing(c);
+              break;
+            case JKind::Num:
+            case JKind::Bool:
+              if (c.is_categorical) {
+                // match the Python twin's str(v) of the json-typed value
+                std::string s =
+                    v.kind == JKind::Bool ? (v.num != 0.0 ? "True" : "False")
+                    : v.is_int ? std::to_string(static_cast<long long>(v.num))
+                               : py_float_str(v.num);
+                c.codes.push_back(c.intern(s.data(), s.size()));
+              } else {
+                c.nums.push_back(v.num);
+              }
+              break;
+            case JKind::Str: {
+              if (c.is_categorical) {
+                c.codes.push_back(c.intern(v.str.data(), v.str.size()));
+              } else {
+                double d;
+                if (parse_double(v.str.data(), v.str.size(), &d)) {
+                  c.nums.push_back(d);
+                } else {
+                  t->error = "could not convert string to float: '" + v.str +
+                             "' in numeric column '" + c.name + "'";
+                }
+              }
+              break;
+            }
+            default:
+              break;
+          }
+        });
+    if (!perr.empty()) t->error = perr;
+    else if (ok) ++row;
+  });
+  for (auto& c : t->cols) {
+    while (col_size(c) < row) push_missing(c);
+  }
+  t->n_rows = row;
+  std::fclose(f);
+  return reinterpret_cast<SgioTable*>(t);
 }
 
 void sgio_free(SgioTable* h) { delete reinterpret_cast<Table*>(h); }

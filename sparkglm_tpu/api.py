@@ -258,16 +258,18 @@ def _stream_io(path, *, chunk_bytes, native, backend: str = "auto"):
     # anyway and ignores it)
     if backend == "json":
         from .data import json as json_io
-        schema = json_io.scan_json_schema(path, chunk_bytes=chunk_bytes)
+        schema = json_io.scan_json_schema(path, chunk_bytes=chunk_bytes,
+                                          native=native)
         levels = json_io.scan_json_levels(path, chunk_bytes=chunk_bytes,
-                                          schema=schema)
+                                          schema=schema, native=native)
         num_chunks = max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
 
         def read(i, columns=None):
             sub = (schema if columns is None
                    else {k: v for k, v in schema.items() if k in set(columns)})
             return json_io.read_json(path, shard_index=i,
-                                     num_shards=num_chunks, schema=sub)
+                                     num_shards=num_chunks, schema=sub,
+                                     native=native)
         return levels, num_chunks, read
     if backend == "parquet":
         from .data import parquet as pq_io
@@ -545,15 +547,14 @@ def glm_from_json(formula: str, path: str, **kwargs) -> glm_mod.GLMModel:
     the reference's own fixture format (Spark ``jsonFile``,
     testData.scala:10-15).  Same streaming engine as
     :func:`glm_from_csv`; records are one JSON object per line, columns
-    are the union of keys (``data/json.py``)."""
-    kwargs.pop("native", None)
+    are the union of keys, parsed by the native C++ loader when built
+    (``data/json.py``, native/loader.cpp::sgio_read_json)."""
     return glm_from_csv(formula, path, backend="json", **kwargs)
 
 
 def lm_from_json(formula: str, path: str, **kwargs) -> lm_mod.LMModel:
     """OLS/WLS by formula straight from a newline-delimited JSON file;
     see :func:`glm_from_json`."""
-    kwargs.pop("native", None)
     return lm_from_csv(formula, path, backend="json", **kwargs)
 
 

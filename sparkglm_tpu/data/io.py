@@ -244,32 +244,38 @@ def read_csv(path: str, *, shard_index: int = 0, num_shards: int = 1,
         err = lib.sgio_error(h)
         if err:
             raise OSError(err.decode())
-        n = lib.sgio_n_rows(h)
-        out: dict[str, np.ndarray] = {}
-        for i in range(lib.sgio_n_cols(h)):
-            name = lib.sgio_col_name(h, i).decode()
-            if lib.sgio_col_kind(h, i) == NUMERIC:
-                buf = (np.ctypeslib.as_array(lib.sgio_col_data(h, i),
-                                             shape=(n,)) if n
-                       else np.empty(0))
-                out[name] = np.array(buf, dtype=np.float64)  # owned copy
-            else:
-                codes = (np.ctypeslib.as_array(lib.sgio_col_codes(h, i),
-                                               shape=(n,)) if n
-                         else np.empty(0, np.int32))
-                levels = np.array(
-                    [lib.sgio_col_level(h, i, j).decode()
-                     for j in range(lib.sgio_col_n_levels(h, i))],
-                    dtype=object)
-                col = np.empty((n,), dtype=object)
-                missing = codes < 0
-                if len(levels):
-                    col[~missing] = levels[codes[~missing]]
-                col[missing] = None
-                out[name] = col
-        return out
+        return native_table_columns(lib, h)
     finally:
         lib.sgio_free(h)
+
+
+def native_table_columns(lib, h) -> dict[str, np.ndarray]:
+    """Decode a native SgioTable into the columns contract (float64 /
+    object-of-str with None); shared by the CSV and NDJSON readers."""
+    n = lib.sgio_n_rows(h)
+    out: dict[str, np.ndarray] = {}
+    for i in range(lib.sgio_n_cols(h)):
+        name = lib.sgio_col_name(h, i).decode()
+        if lib.sgio_col_kind(h, i) == NUMERIC:
+            buf = (np.ctypeslib.as_array(lib.sgio_col_data(h, i),
+                                         shape=(n,)) if n
+                   else np.empty(0))
+            out[name] = np.array(buf, dtype=np.float64)  # owned copy
+        else:
+            codes = (np.ctypeslib.as_array(lib.sgio_col_codes(h, i),
+                                           shape=(n,)) if n
+                     else np.empty(0, np.int32))
+            levels = np.array(
+                [lib.sgio_col_level(h, i, j).decode()
+                 for j in range(lib.sgio_col_n_levels(h, i))],
+                dtype=object)
+            col = np.empty((n,), dtype=object)
+            missing = codes < 0
+            if len(levels):
+                col[~missing] = levels[codes[~missing]]
+            col[missing] = None
+            out[name] = col
+    return out
 
 
 _MISSING = {"", "NA", "NaN", "nan", "null", "NULL"}

@@ -22,12 +22,64 @@ are flat.
 
 from __future__ import annotations
 
+import ctypes
 import json as _json
 import os
 
 import numpy as np
 
 from .io import CATEGORICAL, NUMERIC, read_aligned_slice
+from .io import _load as _load_io_lib
+
+_json_sig_ready = False
+
+
+def _native_lib(native):
+    """The shared native loader (data/io.py builds/loads it), when it has
+    the NDJSON entry point — a stale prebuilt .so without it falls back to
+    the Python twin rather than failing."""
+    global _json_sig_ready
+    if native is False:
+        return None
+    lib = _load_io_lib()
+    if lib is None or not hasattr(lib, "sgio_read_json"):
+        if native is True:
+            raise RuntimeError("native NDJSON loader unavailable")
+        return None
+    if not _json_sig_ready:
+        lib.sgio_read_json.restype = ctypes.c_void_p
+        lib.sgio_read_json.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32]
+        _json_sig_ready = True
+    return lib
+
+
+def _schema_operands(schema: dict[str, int] | None):
+    if not schema:
+        return None, None, 0
+    names = (ctypes.c_char_p * len(schema))(
+        *[k.encode() for k in schema])
+    kinds = (ctypes.c_int32 * len(schema))(*[int(v) for v in schema.values()])
+    return names, kinds, len(schema)
+
+
+def _native_call(lib, path, shard_index, num_shards, schema, schema_only):
+    names, kinds, nk = _schema_operands(schema)
+    h = lib.sgio_read_json(str(path).encode(), shard_index, num_shards,
+                           names, kinds, nk, 1 if schema_only else 0)
+    err = lib.sgio_error(h)
+    if err:
+        msg = err.decode()
+        lib.sgio_free(h)
+        # file-level problems are OSError; EVERY parse problem is
+        # ValueError, matching the Python twin's json.JSONDecodeError
+        # (a ValueError subclass) contract
+        if msg.startswith("cannot open") or "shard_index" in msg:
+            raise OSError(msg)
+        raise ValueError(f"{path!r}: {msg}")
+    return h
 
 
 def _align_ranges(path: str, shard_index: int, num_shards: int):
@@ -58,11 +110,22 @@ def _kind_of(v) -> int:
         f"nested JSON value {v!r} is not a flat model-frame column")
 
 
-def scan_json_schema(path: str, *, chunk_bytes: int | None = None
-                     ) -> dict[str, int]:
+def scan_json_schema(path: str, *, chunk_bytes: int | None = None,
+                     native: bool | None = None) -> dict[str, int]:
     """Column name -> NUMERIC | CATEGORICAL over the UNION of keys.
-    ``chunk_bytes`` bounds peak memory (slices scanned independently,
-    kinds merged — categorical anywhere wins, like ``scan_csv_schema``)."""
+    The native scan streams the whole file holding only column metadata;
+    for the Python fallback ``chunk_bytes`` bounds peak memory (slices
+    scanned independently, kinds merged — categorical anywhere wins, like
+    ``scan_csv_schema``)."""
+    lib = _native_lib(native)
+    if lib is not None:
+        h = _native_call(lib, path, 0, 1, None, schema_only=True)
+        try:
+            return {lib.sgio_col_name(h, i).decode():
+                    int(lib.sgio_col_kind(h, i))
+                    for i in range(lib.sgio_n_cols(h))}
+        finally:
+            lib.sgio_free(h)
     num = (max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
            if chunk_bytes else 1)
     merged: dict[str, int] = {}
@@ -74,37 +137,58 @@ def scan_json_schema(path: str, *, chunk_bytes: int | None = None
 
 
 def scan_json_levels(path: str, *, chunk_bytes: int | None = None,
-                     schema: dict[str, int] | None = None
-                     ) -> dict[str, list[str]]:
+                     schema: dict[str, int] | None = None,
+                     native: bool | None = None) -> dict[str, list[str]]:
     """Global sorted level lists of every categorical column (the
-    ``scan_csv_levels`` contract for multi-host level agreement)."""
+    ``scan_csv_levels`` contract for multi-host level agreement).
+    ``chunk_bytes`` bounds peak memory; shards read through
+    :func:`read_json` (native C++ parser when built), pruned to the
+    categorical columns."""
     if schema is None:
-        schema = scan_json_schema(path, chunk_bytes=chunk_bytes)
+        schema = scan_json_schema(path, chunk_bytes=chunk_bytes,
+                                  native=native)
     cat = {k for k, v in schema.items() if v == CATEGORICAL}
     if not cat:
         return {}  # skip a full re-parse of an all-numeric file
     sets: dict[str, set] = {k: set() for k in cat}
     num = (max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
            if chunk_bytes else 1)
+    sub = {k: CATEGORICAL for k in schema if k in cat}
     for i in range(num):
-        for rec in _records(_align_ranges(path, i, num), path):
-            for k in cat:
-                v = rec.get(k)
-                if v is not None:
-                    sets[k].add(str(v))
+        cols = read_json(path, shard_index=i, num_shards=num, schema=sub,
+                         native=native)
+        for k in cat:
+            sets[k].update(v for v in cols[k] if v is not None)
     return {k: sorted(v) for k, v in sets.items()}
 
 
 def read_json(path: str, *, shard_index: int = 0, num_shards: int = 1,
-              schema: dict[str, int] | None = None) -> dict[str, np.ndarray]:
+              schema: dict[str, int] | None = None,
+              native: bool | None = None) -> dict[str, np.ndarray]:
     """Read a newline-aligned byte-range shard of an NDJSON file into
     name -> column arrays (float64 / object-of-str with None) — the
     ``read_csv(shard_index=)`` per-host contract.  Pass a global
     ``scan_json_schema`` result so every shard types (and includes)
-    identical columns even when its own records miss some keys."""
+    identical columns even when its own records miss some keys.
+    ``native=None`` auto-selects the C++ parser (native/loader.cpp
+    ``sgio_read_json``) when it builds/loads."""
     if num_shards < 1 or not (0 <= shard_index < num_shards):
         raise ValueError(
             f"need 0 <= shard_index < num_shards, got {shard_index}/{num_shards}")
+    from .io import native_table_columns
+    lib = _native_lib(native)
+    if lib is not None:
+        h = _native_call(lib, path, shard_index, num_shards, schema,
+                         schema_only=False)
+        try:
+            out = native_table_columns(lib, h)
+        finally:
+            lib.sgio_free(h)
+        if schema is not None:
+            # the native reader outputs the schema's columns in order
+            # already; keep the dict-order contract explicit
+            out = {k: out[k] for k in schema}
+        return out
     recs = list(_records(_align_ranges(path, shard_index, num_shards), path))
     if schema is None:
         local: dict[str, int] = {}

@@ -241,3 +241,17 @@ def test_multi_process_parquet_fit(tmp_path):
     np.testing.assert_allclose(got["coefficients"], ref.coefficients,
                                rtol=0, atol=5e-6)
     assert got["deviance"] == pytest.approx(ref.deviance, rel=1e-5)
+
+
+def test_r_verbs_on_parquet_path(pq_data, mesh8):
+    """update()/drop1() accept the training PARQUET path — the from-file
+    verbs dispatch by extension through the shared _stream_io backend."""
+    path, cols = pq_data
+    m = sg.glm_from_parquet("y ~ x + grp", path, family="poisson",
+                            chunk_bytes=16 << 10, mesh=mesh8)
+    m2 = sg.update(m, "~ . - grp", data=path)
+    ref = sg.glm("y ~ x", cols, family="poisson", mesh=mesh8)
+    np.testing.assert_allclose(m2.coefficients, ref.coefficients,
+                               rtol=1e-5, atol=5e-6)
+    tbl = sg.drop1(m, data=path)
+    assert {"x", "grp"} <= set(tbl.row_names)
