@@ -70,19 +70,27 @@ bool is_missing(const char* b, size_t len) {
 }
 
 bool parse_double(const char* b, size_t len, double* out) {
-  char buf[64];  // strtod needs NUL termination; CSV fields are tiny
-  if (len == 0 || len >= sizeof(buf)) return false;
+  char buf[64];  // strtod needs NUL termination; fields are usually tiny
+  std::string big;  // high-precision serializers emit 60+ char literals
+  if (len == 0) return false;
   // strtod accepts hex floats ("0x1A"); Python float() does not — reject so
   // both loaders type such columns identically (categorical)
   for (size_t i = 0; i + 1 < len; ++i) {
     if (b[i] == '0' && (b[i + 1] == 'x' || b[i + 1] == 'X')) return false;
   }
-  std::memcpy(buf, b, len);
-  buf[len] = '\0';
+  const char* src;
+  if (len < sizeof(buf)) {
+    std::memcpy(buf, b, len);
+    buf[len] = '\0';
+    src = buf;
+  } else {
+    big.assign(b, len);
+    src = big.c_str();
+  }
   char* end = nullptr;
   errno = 0;
-  double v = std::strtod(buf, &end);
-  if (end != buf + len || errno == ERANGE) return false;
+  double v = std::strtod(src, &end);
+  if (end != src + len || errno == ERANGE) return false;
   *out = v;
   return true;
 }
@@ -199,7 +207,36 @@ struct JValue {
   double num = 0.0;
   bool is_int = false;
   std::string str;
+  std::string raw;  // the Num token verbatim (exact str(int) interning)
 };
+
+// Strict JSON number grammar: '-'? ('0'|[1-9][0-9]*) ('.'[0-9]+)?
+// ([eE][+-]?[0-9]+)? — strtod alone would also accept ".5", "+5", "01",
+// which python's json.loads rejects; file validity must not depend on
+// whether the .so built.
+bool valid_json_number(const char* b, size_t len) {
+  size_t i = 0;
+  auto digit = [&](size_t k) { return k < len && b[k] >= '0' && b[k] <= '9'; };
+  if (i < len && b[i] == '-') ++i;
+  if (!digit(i)) return false;
+  if (b[i] == '0') {
+    ++i;
+  } else {
+    while (digit(i)) ++i;
+  }
+  if (i < len && b[i] == '.') {
+    ++i;
+    if (!digit(i)) return false;
+    while (digit(i)) ++i;
+  }
+  if (i < len && (b[i] == 'e' || b[i] == 'E')) {
+    ++i;
+    if (i < len && (b[i] == '+' || b[i] == '-')) ++i;
+    if (!digit(i)) return false;
+    while (digit(i)) ++i;
+  }
+  return i == len;
+}
 
 struct JLine {
   const char* p;
@@ -359,13 +396,16 @@ struct JLine {
       ++q;
     }
     double d;
-    if (q > p && parse_double(p, static_cast<size_t>(q - p), &d)) {
-      p = q;
+    const size_t tlen = static_cast<size_t>(q - p);
+    if (tlen > 0 && valid_json_number(p, tlen) && parse_double(p, tlen, &d)) {
       v.kind = JKind::Num;
       v.num = d;
-      // python json.loads types a '.'-/'e'-free token as int; str() of an
-      // int has no ".0" — record it so categorical interning matches
-      v.is_int = integral && std::abs(d) < 9007199254740992.0;  // 2^53
+      // python json.loads types a '.'-/'e'-free token as int; str(int)
+      // is the token VERBATIM (arbitrary precision — no 2^53 cap), so
+      // categorical interning keeps the raw token for integral literals
+      v.is_int = integral;
+      v.raw.assign(p, tlen);
+      p = q;
       return true;
     }
     return fail("bad JSON value");
@@ -421,6 +461,17 @@ template <typename F>
 bool parse_json_object(const char* lb, size_t llen, std::string* err,
                        F&& on_pair) {
   JLine jl{lb, lb + llen, {}};
+  // a record must be ONE object per line — trailing content after '}' is
+  // python's JSONDecodeError "Extra data", never silently dropped
+  auto finish = [&]() {
+    ++jl.p;  // consume '}'
+    jl.skip_ws();
+    if (jl.p < jl.end) {
+      *err = "Extra data after JSON object";
+      return false;
+    }
+    return true;
+  };
   jl.skip_ws();
   if (jl.p >= jl.end) return false;  // blank line: skip silently
   if (*jl.p != '{') {
@@ -429,7 +480,7 @@ bool parse_json_object(const char* lb, size_t llen, std::string* err,
   }
   ++jl.p;
   jl.skip_ws();
-  if (jl.p < jl.end && *jl.p == '}') return true;  // empty object: a row
+  if (jl.p < jl.end && *jl.p == '}') return finish();  // empty object: a row
   std::string key;
   JValue val;
   while (true) {
@@ -442,7 +493,7 @@ bool parse_json_object(const char* lb, size_t llen, std::string* err,
     on_pair(key, val);
     jl.skip_ws();
     if (jl.p < jl.end && *jl.p == ',') { ++jl.p; continue; }
-    if (jl.p < jl.end && *jl.p == '}') return true;
+    if (jl.p < jl.end && *jl.p == '}') return finish();
     *err = "expected ',' or '}'";
     return false;
   }
@@ -752,10 +803,11 @@ SgioTable* sgio_read_json(const char* path, int64_t shard_index,
             case JKind::Num:
             case JKind::Bool:
               if (c.is_categorical) {
-                // match the Python twin's str(v) of the json-typed value
+                // match the Python twin's str(v) of the json-typed value:
+                // ints keep their token verbatim (arbitrary precision)
                 std::string s =
                     v.kind == JKind::Bool ? (v.num != 0.0 ? "True" : "False")
-                    : v.is_int ? std::to_string(static_cast<long long>(v.num))
+                    : v.is_int ? v.raw
                                : py_float_str(v.num);
                 c.codes.push_back(c.intern(s.data(), s.size()));
               } else {

@@ -154,9 +154,24 @@ def scan_json_levels(path: str, *, chunk_bytes: int | None = None,
     num = (max(1, -(-os.path.getsize(path) // int(chunk_bytes)))
            if chunk_bytes else 1)
     sub = {k: CATEGORICAL for k in schema if k in cat}
+    lib = _native_lib(native)
     for i in range(num):
+        if lib is not None:
+            # the native table already holds each shard's DEDUPLICATED
+            # level list — union those directly instead of expanding the
+            # codes back into n-row object arrays
+            h = _native_call(lib, path, i, num, sub, schema_only=False)
+            try:
+                for j in range(lib.sgio_n_cols(h)):
+                    name = lib.sgio_col_name(h, j).decode()
+                    sets[name].update(
+                        lib.sgio_col_level(h, j, k).decode()
+                        for k in range(lib.sgio_col_n_levels(h, j)))
+            finally:
+                lib.sgio_free(h)
+            continue
         cols = read_json(path, shard_index=i, num_shards=num, schema=sub,
-                         native=native)
+                         native=False)
         for k in cat:
             sets[k].update(v for v in cols[k] if v is not None)
     return {k: sorted(v) for k, v in sets.items()}

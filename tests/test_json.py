@@ -164,6 +164,34 @@ def test_native_json_parity(json_data, tmp_path):
     assert sg.scan_json_schema(str(dup), native=True) == \
         sg.scan_json_schema(str(dup), native=False) == {"a": 0}
 
+    # big ints in categorical columns intern the VERBATIM token (python's
+    # arbitrary-precision str(int)); long literals parse; strict JSON
+    # number grammar (.5 / +5 / 01 rejected both ways, like json.loads)
+    big = tmp_path / "big.jsonl"
+    big.write_text('{"s": "lvl", "x": 1.%s1}\n{"s": 10000000000000000}\n'
+                   % ("3" * 70))
+    sch2 = sg.scan_json_schema(str(big), native=False)
+    nn2 = sg.read_json(str(big), schema=sch2, native=True)
+    pp2 = sg.read_json(str(big), schema=sch2, native=False)
+    assert list(nn2["s"]) == list(pp2["s"]) == ["lvl", "10000000000000000"]
+    np.testing.assert_array_equal(nn2["x"], pp2["x"])
+    for bad_lit in (".5", "+5", "01"):
+        fp = tmp_path / "badnum.jsonl"
+        fp.write_text('{"a": %s}\n' % bad_lit)
+        with pytest.raises(ValueError):
+            sg.read_json(str(fp), native=True)
+        with pytest.raises(ValueError):
+            sg.read_json(str(fp), native=False)
+
+    # trailing content after the object is python's "Extra data" error,
+    # never silent data loss
+    tr = tmp_path / "trail.jsonl"
+    tr.write_text('{"a": 1}{"a": 2}\n')
+    with pytest.raises(ValueError):
+        sg.read_json(str(tr), native=True)
+    with pytest.raises(ValueError):
+        sg.read_json(str(tr), native=False)
+
     # error parity: nested values refused by both; ALL native parse errors
     # are ValueError (the json.JSONDecodeError contract)
     bad = tmp_path / "bad.jsonl"
