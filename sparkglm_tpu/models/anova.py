@@ -155,6 +155,12 @@ def anova(*models, test: str | None = None) -> AnovaTable:
                       tuple(cols), names, tuple(rows))
 
 
+def _aic_lm(n: int, m) -> float:
+    """R's stats:::extractAIC.lm scale: n*log(RSS/n) + 2*edf (constants
+    dropped — only differences matter in drop1/add1 tables)."""
+    return float(n * np.log(m.sse / n) + 2 * (n - m.df_resid))
+
+
 def _droppable_terms(design) -> list:
     """Terms not marginal to any other term (R's drop1 scope): T is
     droppable iff no other term's component set strictly contains T's."""
@@ -244,19 +250,14 @@ def drop1(model, data, *, test: str | None = None, weights=None,
 
     if is_lm:
         cols = ["Df", "Sum of Sq", "RSS", "AIC"]
-        # R's stats:::drop1.lm AIC: n*log(RSS/n) + 2*edf (+ constants
-        # dropped — differences are what matter)
         n = model.n_obs
-
-        def aic_lm(m):
-            return n * np.log(m.sse / n) + 2 * (n - m.df_resid)
-        rows = [(None, None, float(model.sse), float(aic_lm(model)))]
+        rows = [(None, None, float(model.sse), _aic_lm(n, model))]
         row_names = ["<none>"]
         for nm in dropped_names:
             sub = refit([t for t in all_terms if t != nm])
             rows.append((int(sub.df_resid - model.df_resid),
                          float(sub.sse - model.sse),
-                         float(sub.sse), float(aic_lm(sub))))
+                         float(sub.sse), _aic_lm(n, sub)))
             row_names.append(nm)
         return AnovaTable("Single term deletions", f"Model: {model.formula}",
                           tuple(cols), tuple(row_names), tuple(rows))
@@ -279,4 +280,110 @@ def drop1(model, data, *, test: str | None = None, weights=None,
         rows.append(tuple(row))
         row_names.append(nm)
     return AnovaTable("Single term deletions", f"Model: {model.formula}",
+                      tuple(cols), tuple(row_names), tuple(rows))
+
+
+def add1(model, scope, data, *, test: str | None = None,
+         **fit_kw) -> AnovaTable:
+    """R's ``add1``: refit with each scope term ADDED — the companion of
+    :func:`drop1` (the reference has neither; R users expect both).
+
+    ``scope`` is a one-sided formula of candidate terms (``"~ x2 + x1:x3"``
+    or ``". + x2"`` forms both work); terms already in the model are
+    skipped.  Each refit goes through :func:`api.update`, so family/link,
+    by-name weights/offset/m, glm.nb theta re-estimation, and PATH data
+    (out-of-core streaming refits) all behave exactly as ``update`` does.
+    ``test="Chisq"`` adds the dispersion-scaled LRT at the ORIGINAL
+    model's dispersion, as ``add1.glm`` does.
+    """
+    import re as _re
+
+    from .. import api
+    from ..data.formula import TERM_RE, _expand_term, canonical_component
+
+    if model.terms is None:
+        raise ValueError(
+            "add1 needs a formula-fitted model (model.terms is None)")
+    if test not in (None, "Chisq"):
+        raise ValueError(f"test must be None or 'Chisq', got {test!r}")
+    is_lm = _is_lm(model)
+
+    rhs = scope.split("~", 1)[-1]
+    leftover = _re.sub(rf"([+-]?)\s*({TERM_RE})", "", rhs)
+    if _re.sub(r"[\s+]", "", leftover):
+        raise ValueError(f"unsupported scope syntax in {scope!r}")
+    existing = {frozenset(canonical_component(c) for c in t)
+                for t in model.terms.design}
+    candidates: list = []
+    seen_keys: set = set()
+    for sign, chunk in _re.findall(rf"([+-]?)\s*({TERM_RE})", rhs):
+        if chunk == "." or _re.fullmatch(r"\d+", chunk) or sign == "-":
+            continue
+        for term, _ in _expand_term(sign, chunk, scope):
+            # dedup by CANONICAL component set (a:b == b:a), against both
+            # the model's terms and earlier candidates
+            key = frozenset(canonical_component(c) for c in term.split(":"))
+            if key not in existing and key not in seen_keys:
+                seen_keys.add(key)
+                candidates.append(term)
+    if not candidates:
+        raise ValueError(f"scope {scope!r} adds no terms beyond the model")
+
+    def refit(term):
+        try:
+            sub = api.update(model, f"~ . + {term}", data, **fit_kw)
+        except ValueError as e:
+            if "margin" in str(e) or "missing the term" in str(e):
+                # the framework refuses non-marginal designs (R silently
+                # changes contrast coding instead); surface WHICH candidate
+                raise ValueError(
+                    f"add1 candidate {term!r} needs its marginal terms in "
+                    f"the model first ({e}); add the margins to the model "
+                    "or drop the interaction from the scope") from None
+            raise
+        # R's add1/drop1 refuse comparisons across different row sets (a
+        # candidate column's NAs would shrink the refit sample, mixing the
+        # term effect with row removal in every statistic)
+        if sub.n_obs != model.n_obs:
+            raise ValueError(
+                f"number of rows in use changed adding {term!r} "
+                f"({model.n_obs} -> {sub.n_obs}): remove missing values "
+                "before add1")
+        return sub
+
+    if is_lm:
+        cols = ["Df", "Sum of Sq", "RSS", "AIC"]
+        n = model.n_obs
+        rows = [(None, None, float(model.sse), _aic_lm(n, model))]
+        row_names = ["<none>"]
+        for nm in candidates:
+            sub = refit(nm)
+            rows.append((int(model.df_resid - sub.df_resid),
+                         float(model.sse - sub.sse),
+                         float(sub.sse), _aic_lm(n, sub)))
+            row_names.append(nm)
+        return AnovaTable("Single term additions", f"Model: {model.formula}",
+                          tuple(cols), tuple(row_names), tuple(rows))
+
+    disp = float(model.dispersion)
+    cols = ["Df", "Deviance", "AIC"]
+    if test == "Chisq":
+        cols += ["LRT", "Pr(>Chi)"]
+    rows = [(None, float(model.deviance), float(model.aic))
+            + ((None, None) if test == "Chisq" else ())]
+    row_names = ["<none>"]
+    for nm in candidates:
+        sub = refit(nm)
+        row = [int(model.df_residual - sub.df_residual),
+               float(sub.deviance), float(sub.aic)]
+        if test == "Chisq":
+            if row[0] > 0:
+                lrt = max(model.deviance - sub.deviance, 0.0) / disp
+                row += [float(lrt), float(scipy.stats.chi2.sf(lrt, row[0]))]
+            else:
+                # fully aliased addition: R prints NA, not a made-up test
+                row += [None, None]
+        rows.append(tuple(row))
+        row_names.append(nm)
+    return AnovaTable("Single term additions", f"Model: {model.formula}",
                       tuple(cols), tuple(row_names), tuple(rows))

@@ -190,3 +190,81 @@ def test_drop1_lm_and_offset(rng):
     sub = sg.glm("y ~ x + offset(lt)", dp, family="poisson")
     z_row = tp.rows[tp.row_names.index("z")]
     np.testing.assert_allclose(z_row[1], sub.deviance, rtol=1e-9)
+
+
+def test_add1_glm_matches_explicit_refits(rng, mesh8):
+    """R's add1: each scope term refit ADDED; Df/Deviance/AIC/LRT match
+    explicit update() refits, and terms already in the model are skipped."""
+    n = 3000
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    y = rng.poisson(np.exp(0.4 + 0.5 * x1 + 0.3 * x2
+                           - 0.4 * (g == "b"))).astype(float)
+    data = {"y": y, "x1": x1, "x2": x2, "g": g}
+    m = sg.glm("y ~ x1", data, family="poisson", mesh=mesh8)
+    tbl = sg.add1(m, "~ x1 + x2 + g", data, test="Chisq")
+    assert tbl.row_names == ("<none>", "x2", "g")
+    m_x2 = sg.update(m, "~ . + x2", data)
+    m_g = sg.update(m, "~ . + g", data)
+    rows = dict(zip(tbl.row_names, tbl.rows))
+    assert rows["x2"][0] == 1 and rows["g"][0] == 2
+    assert rows["x2"][1] == pytest.approx(m_x2.deviance, rel=1e-10)
+    assert rows["g"][2] == pytest.approx(m_g.aic, rel=1e-10)
+    # LRT at the original model's dispersion (Poisson: 1)
+    assert rows["x2"][3] == pytest.approx(m.deviance - m_x2.deviance,
+                                          rel=1e-10)
+    assert 0 <= rows["x2"][4] <= 1
+    text = str(tbl)
+    assert "Single term additions" in text and "<none>" in text
+
+    with pytest.raises(ValueError, match="adds no terms"):
+        sg.add1(m, "~ x1", data)
+
+
+def test_add1_lm_and_from_csv_path(tmp_path, rng, mesh8):
+    """add1 on lm uses R's drop1/add1 AIC scale; path data streams the
+    refits out-of-core through update()."""
+    import csv as csv_mod
+    n = 2000
+    x1 = np.round(rng.standard_normal(n), 6)
+    x2 = np.round(rng.standard_normal(n), 6)
+    y = np.round(1.0 + 0.8 * x1 + 0.5 * x2 + 0.3 * rng.standard_normal(n), 6)
+    data = {"y": y, "x1": x1, "x2": x2}
+    m = sg.lm("y ~ x1", data, mesh=mesh8)
+    tbl = sg.add1(m, "~ . + x2", data)
+    rows = dict(zip(tbl.row_names, tbl.rows))
+    m_full = sg.update(m, "~ . + x2", data)
+    assert rows["x2"][0] == 1
+    assert rows["x2"][1] == pytest.approx(m.sse - m_full.sse, rel=1e-9)
+    assert rows["x2"][2] == pytest.approx(m_full.sse, rel=1e-9)
+
+    p = tmp_path / "d.csv"
+    with open(p, "w", newline="") as fh:
+        w = csv_mod.writer(fh)
+        w.writerow(["y", "x1", "x2"])
+        for i in range(n):
+            w.writerow([y[i], x1[i], x2[i]])
+    m_csv = sg.lm_from_csv("y ~ x1", str(p), chunk_bytes=16 << 10)
+    tbl_csv = sg.add1(m_csv, "~ . + x2", str(p))
+    rows_csv = dict(zip(tbl_csv.row_names, tbl_csv.rows))
+    np.testing.assert_allclose(rows_csv["x2"][2], rows["x2"][2], rtol=1e-6)
+
+
+def test_add1_guards(rng, mesh8):
+    """Scope syntax is validated (no silent misparse), a:b == b:a dedups,
+    and a candidate with NAs that shrinks the sample is refused."""
+    n = 500
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    x3 = rng.standard_normal(n)
+    y = rng.poisson(np.exp(0.3 + 0.5 * x1)).astype(float)
+    data = {"y": y, "x1": x1, "x2": x2, "x3": x3}
+    m = sg.glm("y ~ x1", data, family="poisson", mesh=mesh8)
+    with pytest.raises(ValueError, match="unsupported scope"):
+        sg.add1(m, "~ . + x2^2", data)
+    tbl = sg.add1(m, "~ x2:x3 + x3:x2", data)
+    assert tbl.row_names == ("<none>", "x2:x3")  # canonical dedup
+    bad = dict(data, x2=np.where(np.arange(n) < 10, np.nan, x2))
+    with pytest.raises(ValueError, match="rows in use changed"):
+        sg.add1(m, "~ . + x2", bad)
