@@ -29,7 +29,7 @@ from .data.model_matrix import Terms, build_terms, model_matrix, transform
 from .families.families import (FAMILIES, Family, get_family,
                                 negative_binomial, quasi)
 from .families.links import LINKS, Link, get_link
-from .models.anova import AnovaTable, add1, anova, drop1
+from .models.anova import AnovaTable, add1, anova, drop1, step
 from .models.diagnostics import cooks_distance, hatvalues, rstandard
 from .models.glm import GLMModel
 from .models.glm import fit as glm_fit
@@ -54,7 +54,7 @@ __all__ = [
     "read_json", "scan_json_schema", "scan_json_levels",
     "lm_fit_streaming", "glm_fit_streaming",
     "LMModel", "GLMModel", "load_model", "save_model",
-    "anova", "add1", "drop1", "AnovaTable", "confint_profile",
+    "anova", "add1", "drop1", "step", "AnovaTable", "confint_profile",
     "TermsPrediction",
     "hatvalues", "rstandard", "cooks_distance",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",

@@ -1,5 +1,6 @@
-"""Model comparison: ``anova()`` (analysis of deviance / variance) and
-``drop1()`` (single-term deletions).
+"""Model comparison and selection: ``anova()`` (analysis of deviance /
+variance), ``drop1()``/``add1()`` (single-term deletions/additions) and
+``step()`` (AIC-guided stepwise selection).
 
 Extensions over the reference (which has no model-comparison tooling at
 all — its full inference surface is the summary printer,
@@ -155,10 +156,10 @@ def anova(*models, test: str | None = None) -> AnovaTable:
                       tuple(cols), names, tuple(rows))
 
 
-def _aic_lm(n: int, m) -> float:
-    """R's stats:::extractAIC.lm scale: n*log(RSS/n) + 2*edf (constants
-    dropped — only differences matter in drop1/add1 tables)."""
-    return float(n * np.log(m.sse / n) + 2 * (n - m.df_resid))
+def _aic_lm(n: int, m, k: float = 2.0) -> float:
+    """R's stats:::extractAIC.lm scale: n*log(RSS/n) + k*edf (constants
+    dropped — only differences matter in drop1/add1/step tables)."""
+    return float(n * np.log(m.sse / n) + k * (n - m.df_resid))
 
 
 def _droppable_terms(design) -> list:
@@ -387,3 +388,132 @@ def add1(model, scope, data, *, test: str | None = None,
         row_names.append(nm)
     return AnovaTable("Single term additions", f"Model: {model.formula}",
                       tuple(cols), tuple(row_names), tuple(rows))
+
+
+def _proper_subsets(key: frozenset):
+    """All nonempty proper subsets of a term's component set (the
+    lower-order relatives R's hierarchy rule requires before an
+    interaction may enter)."""
+    from itertools import combinations
+    items = sorted(key)
+    for r in range(1, len(items)):
+        for sub in combinations(items, r):
+            yield sub
+
+
+def _step_aic(m, k: float) -> float:
+    """R's ``extractAIC`` at penalty ``k``: lm on the n*log(RSS/n) + k*edf
+    scale; glm as aic + (k-2)*edf.  k=2 is AIC; k=log(n) is BIC."""
+    if _is_lm(m):
+        return _aic_lm(m.n_obs, m, k)
+    if not np.isfinite(m.aic):
+        raise ValueError(
+            f"AIC is not defined for the {m.family} family (R's step "
+            "refuses quasi fits too); fit a parametric family or select "
+            "manually with anova()")
+    n_ok = m.df_null + (1 if m.has_intercept else 0)
+    edf = n_ok - m.df_residual
+    return float(m.aic + (k - 2.0) * edf)
+
+
+def step(model, data, *, scope: str | None = None, direction: str = "both",
+         k: float = 2.0, steps: int = 1000, trace: bool = False, **fit_kw):
+    """R's ``step``: AIC-guided stepwise selection built on
+    :func:`add1`/:func:`drop1` moves (the reference has no selection verbs
+    at all; R users expect the triple).
+
+    ``scope`` is the upper one-sided formula of candidate terms for
+    forward moves (required for ``direction="forward"``/``"both"`` unless
+    the model already contains every candidate); ``k=2`` is AIC,
+    ``k=log(n)`` BIC.  Every refit goes through :func:`api.update`, so
+    family/link, by-name weights/offset/m, and PATH data (out-of-core
+    streaming refits) all work.  A forward candidate whose marginal terms
+    are not yet in the model is skipped until its margins enter.  Returns
+    the final fitted model; ``trace=True`` prints R's per-step lines.
+    """
+    import re as _re
+
+    from .. import api
+    from ..data.formula import TERM_RE, _expand_term, canonical_component
+
+    if model.terms is None:
+        raise ValueError(
+            "step needs a formula-fitted model (model.terms is None)")
+    if direction not in ("both", "backward", "forward"):
+        raise ValueError(
+            f"direction must be 'both', 'backward' or 'forward', "
+            f"got {direction!r}")
+    def term_key(term: str) -> frozenset:
+        return frozenset(canonical_component(c) for c in term.split(":"))
+
+    scope_keys: dict = {}
+    if scope is not None:
+        rhs = scope.split("~", 1)[-1]
+        leftover = _re.sub(rf"([+-]?)\s*({TERM_RE})", "", rhs)
+        if _re.sub(r"[\s+]", "", leftover):
+            raise ValueError(f"unsupported scope syntax in {scope!r}")
+        for sign, chunk in _re.findall(rf"([+-]?)\s*({TERM_RE})", rhs):
+            if sign == "-":
+                raise ValueError(
+                    f"'-' terms are not supported in a step scope "
+                    f"({scope!r}); drop them from the scope instead — "
+                    "fitting under silently different constraints is "
+                    "worse than an error")
+            if chunk == ".":
+                # R's update.formula semantics: '.' is the ORIGINAL
+                # model's terms — they stay in scope, so a term dropped
+                # early can re-enter later under direction='both'
+                for t in model.terms.design:
+                    scope_keys.setdefault(frozenset(
+                        canonical_component(c) for c in t), ":".join(t))
+                continue
+            if _re.fullmatch(r"\d+", chunk):
+                continue
+            for term, _ in _expand_term(sign, chunk, scope):
+                scope_keys.setdefault(term_key(term), term)
+    if direction == "forward" and not scope_keys:
+        raise ValueError("direction='forward' needs a scope of candidates")
+
+    current = model
+    cur_aic = _step_aic(current, k)
+    for _ in range(int(steps)):
+        if trace:
+            print(f"Step:  AIC={cur_aic:.2f}\n{current.formula}")
+        term_keys = {frozenset(canonical_component(c) for c in t)
+                     for t in current.terms.design}
+        moves: list = []  # ("+"/"-" , term)
+        if direction in ("both", "backward"):
+            can_drop = _droppable_terms(current.terms.design)
+            # a no-intercept model must keep >= 1 term (update refuses)
+            if not (len(current.terms.design) == 1
+                    and not current.has_intercept):
+                moves.extend(("-", ":".join(t)) for t in can_drop)
+        if direction in ("both", "forward"):
+            for key, term in scope_keys.items():
+                if key in term_keys:
+                    continue
+                # R's factor.scope hierarchy: an interaction enters only
+                # once every lower-order relative is in the model (local
+                # check — never inferred from error-message text)
+                if len(key) > 1 and any(
+                        frozenset(sub) not in term_keys
+                        for sub in _proper_subsets(key)):
+                    continue
+                moves.append(("+", term))
+        best = None
+        for sign, term in moves:
+            cand = api.update(current, f"~ . {sign} {term}", data, **fit_kw)
+            if cand.n_obs != current.n_obs:
+                raise ValueError(
+                    f"number of rows in use changed at '{sign} {term}' "
+                    f"({current.n_obs} -> {cand.n_obs}): remove missing "
+                    "values before step")
+            a = _step_aic(cand, k)
+            if trace:
+                print(f"  {sign} {term:<24} AIC={a:.2f}")
+            if best is None or a < best[0]:
+                best = (a, sign, term, cand)
+        if best is None or best[0] >= cur_aic - 1e-10:
+            break
+        cur_aic, _, _, current = best
+    return current

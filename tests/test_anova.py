@@ -268,3 +268,77 @@ def test_add1_guards(rng, mesh8):
     bad = dict(data, x2=np.where(np.arange(n) < 10, np.nan, x2))
     with pytest.raises(ValueError, match="rows in use changed"):
         sg.add1(m, "~ . + x2", bad)
+
+
+def test_step_both_directions_recovers_truth(rng, mesh8):
+    """R's step(): AIC-guided stepwise selection.  With two real effects,
+    two noise columns, and an interaction candidate whose margins gate
+    it, 'both' lands on the true model from an overfit start."""
+    n = 4000
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    z1 = rng.standard_normal(n)
+    z2 = rng.standard_normal(n)
+    y = rng.poisson(np.exp(0.4 + 0.6 * x1 - 0.5 * x2)).astype(float)
+    data = {"y": y, "x1": x1, "x2": x2, "z1": z1, "z2": z2}
+
+    # backward from the full model
+    full = sg.glm("y ~ x1 + x2 + z1 + z2", data, family="poisson", mesh=mesh8)
+    back = sg.step(full, data, direction="backward")
+    assert set(back.xnames) == {"intercept", "x1", "x2"}
+
+    # forward from the null model over a scope incl. a gated interaction
+    null = sg.glm("y ~ 1", data, family="poisson", mesh=mesh8)
+    fwd = sg.step(null, data, scope="~ x1 + x2 + z1 + z2 + x1:x2",
+                  direction="forward")
+    assert {"x1", "x2"} <= set(fwd.xnames)
+    assert not ({"z1", "z2"} & set(fwd.xnames))
+
+    # both: same destination from a wrong start
+    start = sg.glm("y ~ z1 + z2", data, family="poisson", mesh=mesh8)
+    both = sg.step(start, data, scope="~ x1 + x2 + z1 + z2")
+    assert set(both.xnames) == {"intercept", "x1", "x2"}
+    # the returned object is a normal fitted model
+    assert both.converged and "Pr(>|z|)" in str(both.summary())
+
+
+def test_step_lm_bic_and_guards(rng, mesh8):
+    n = 2000
+    x1 = rng.standard_normal(n)
+    z = rng.standard_normal(n)
+    y = 1.0 + 0.8 * x1 + 0.3 * rng.standard_normal(n)
+    data = {"y": y, "x1": x1, "z": z}
+    full = sg.lm("y ~ x1 + z", data, mesh=mesh8)
+    chosen = sg.step(full, data, k=float(np.log(n)))  # BIC drops z
+    assert set(chosen.xnames) == {"intercept", "x1"}
+    with pytest.raises(ValueError, match="direction"):
+        sg.step(full, data, direction="sideways")
+    with pytest.raises(ValueError, match="scope"):
+        sg.step(full, data, direction="forward")
+    # quasi families have no AIC — refuse like R
+    yq = rng.poisson(np.exp(0.3 + 0.5 * x1)).astype(float)
+    mq = sg.glm("y ~ x1", {"y": yq, "x1": x1}, family="quasipoisson",
+                mesh=mesh8)
+    with pytest.raises(ValueError, match="AIC is not defined"):
+        sg.step(mq, {"y": yq, "x1": x1})
+
+
+def test_step_scope_dot_allows_reentry_and_minus_rejected(rng, mesh8):
+    """'.' in scope keeps the ORIGINAL terms addable (a dropped term can
+    re-enter under direction='both'); '-' scope terms are an error, not a
+    silent constraint change."""
+    n = 3000
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    y = rng.poisson(np.exp(0.4 + 0.7 * x1)).astype(float)
+    data = {"y": y, "x1": x1, "x2": x2}
+    m = sg.glm("y ~ x1 + x2", data, family="poisson", mesh=mesh8)
+    with pytest.raises(ValueError, match="'-' terms"):
+        sg.step(m, data, scope="~ . - x2")
+    # scope "~ ." alone: both-direction selection over the original terms
+    sel = sg.step(m, data, scope="~ .")
+    assert set(sel.xnames) == {"intercept", "x1"}
+    # hierarchy gate: x1:x2 never enters while x2 is out
+    sel2 = sg.step(sg.glm("y ~ x1", data, family="poisson", mesh=mesh8),
+                   data, scope="~ . + x2 + x1:x2")
+    assert "x1:x2" not in sel2.xnames or "x2" in sel2.xnames
