@@ -30,7 +30,8 @@ from .families.families import (FAMILIES, Family, get_family,
                                 negative_binomial, quasi)
 from .families.links import LINKS, Link, get_link
 from .models.anova import AnovaTable, add1, anova, drop1, step
-from .models.diagnostics import cooks_distance, hatvalues, rstandard
+from .models.diagnostics import (cooks_distance, dfbeta, dfbetas,
+                                 dffits, hatvalues, rstandard)
 from .models.glm import GLMModel
 from .models.glm import fit as glm_fit
 from .models.negbin import fit_nb as glm_fit_nb
@@ -57,6 +58,7 @@ __all__ = [
     "anova", "add1", "drop1", "step", "AnovaTable", "confint_profile",
     "TermsPrediction",
     "hatvalues", "rstandard", "cooks_distance",
+    "dfbeta", "dfbetas", "dffits",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
     "quasi", "negative_binomial", "glm_nb", "glm_fit_nb", "theta_of",
     "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",

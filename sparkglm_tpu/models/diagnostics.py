@@ -114,3 +114,82 @@ def cooks_distance(model, data, y, *, weights=None, offset=None,
         return (pe / om) ** 2 * h / (model.dispersion * p)
     rs = rstandard(model, X, y, weights=weights, offset=offset)
     return rs * rs * h / (om * p)
+
+
+def _deletion_pieces(model, X, y, *, weights, offset, m):
+    """Shared ingredients of the case-deletion diagnostics: the dfbeta
+    matrix (rank-one downdate), hat diagonal h, and R's leave-one-out
+    scale sigma_(i) from lm.influence's identity
+
+        sigma_(i)^2 = (sum w e^2 - w_i e_i^2 / (1 - h_i)) / (n - p - 1)
+
+    — EXACT for an LM.  For a GLM, e and w are the CONVERGED WORKING
+    model's residuals/weights (the one-step influence approximation);
+    note R's dffits()/dfbetas() scale by deviance-based weighted
+    residuals instead, so GLM values are the working-model analogues,
+    not digit-for-digit R.  When n - p - 1 <= 0 the scale is undefined
+    and sigma_(i) is NaN, as in R.  The working weights and the hat
+    quadform are each computed ONCE here."""
+    from .lm import _row_quadform
+
+    X = np.asarray(_design_of(model, X), np.float64)
+    if model.cov_unscaled is None:
+        raise ValueError("model was fit without the unscaled covariance "
+                         "(streaming fits keep only its diagonal)")
+    C = np.nan_to_num(np.asarray(model.cov_unscaled, np.float64))
+    w = _working_weights(model, X, weights, m, offset)
+    q = np.asarray(_row_quadform(X, C), np.float64) ** 2
+    h = np.clip(w * q, 0.0, 1.0)
+    if hasattr(model, "family"):
+        e = np.asarray(model.residuals(X, y, type="working", offset=offset,
+                                       weights=weights, m=m), np.float64)
+        df_resid = model.df_residual
+    else:
+        e = np.asarray(model.residuals(X, y, offset=offset), np.float64)
+        df_resid = model.df_resid
+    om = np.maximum(1.0 - h, 1e-12)
+    dfb = (X @ C) * (w * e / om)[:, None]
+    rss_w = float(np.sum(w * e * e))
+    if df_resid - 1 <= 0:
+        s_i = np.full(X.shape[0], np.nan)  # undefined, as R reports
+    else:
+        s_i = np.sqrt(np.maximum(
+            (rss_w - w * e * e / om) / (df_resid - 1), 1e-300))
+    return dfb, C, e, w, h, om, s_i
+
+
+def dfbeta(model, data, y, *, weights=None, offset=None, m=None) -> np.ndarray:
+    """R's ``dfbeta``: the (n, p) change in coefficients when each row is
+    deleted — EXACT for an LM (the rank-one downdate identity
+
+        beta - beta_(i) = (X'WX)^-1 x_i w_i e_i / (1 - h_i)
+
+    is algebraic, not approximate); the one-step working-model
+    approximation for a GLM (R's influence.glm coefficients)."""
+    dfb, *_ = _deletion_pieces(model, data, y, weights=weights,
+                               offset=offset, m=m)
+    return dfb
+
+
+def dfbetas(model, data, y, *, weights=None, offset=None,
+            m=None) -> np.ndarray:
+    """``dfbeta`` scaled by sigma_(i) * se_j — exact for an LM; for a GLM
+    the working-model analogue (see :func:`_deletion_pieces`)."""
+    dfb, C, _, _, _, _, s_i = _deletion_pieces(model, data, y,
+                                               weights=weights,
+                                               offset=offset, m=m)
+    se = np.sqrt(np.maximum(np.diag(C), 1e-300))
+    return dfb / (s_i[:, None] * se[None, :])
+
+
+def dffits(model, data, y, *, weights=None, offset=None, m=None) -> np.ndarray:
+    """The scaled change in the i-th fitted value under deletion of row i,
+
+        dffits_i = e_i sqrt(w_i h_i) / (sigma_(i) (1 - h_i))
+
+    — exact for an LM; for a GLM the working-model analogue (R's dffits
+    scales deviance-based weighted residuals instead)."""
+    _, _, e, w, h, om, s_i = _deletion_pieces(model, data, y,
+                                              weights=weights,
+                                              offset=offset, m=m)
+    return e * np.sqrt(w * h) / (s_i * om)

@@ -82,3 +82,74 @@ def test_diagnostics_formula_data_and_aliased(rng):
     np.testing.assert_allclose(ha.sum(), 2, rtol=1e-3)  # rank 2, not 3
     cd = sg.cooks_distance(ma, X, y)
     assert np.all(np.isfinite(cd))
+
+
+def test_dfbeta_dffits_lm_exact_vs_deletion(rng, mesh8):
+    """The LM rank-one downdate identities are algebraic: dfbeta and
+    dffits must match BRUTE-FORCE row deletion to f64 precision."""
+    from sparkglm_tpu.config import NumericConfig
+    n, p = 300, 4
+    X = np.column_stack([np.ones(n), rng.standard_normal((n, p - 1))])
+    w = rng.uniform(0.5, 2.0, n)
+    y = X @ rng.standard_normal(p) + 0.4 * rng.standard_normal(n)
+    cfg = NumericConfig(dtype="float64")
+    full = sg.lm_fit(X, y, weights=w, config=cfg)
+    dfb = sg.dfbeta(full, X, y, weights=w)
+    dft = sg.dffits(full, X, y, weights=w)
+    dfbs = sg.dfbetas(full, X, y, weights=w)
+    h = sg.hatvalues(full, X, weights=w)
+    for i in (0, 17, 123, n - 1):
+        keep = np.arange(n) != i
+        sub = sg.lm_fit(X[keep], y[keep], weights=w[keep], config=cfg)
+        np.testing.assert_allclose(dfb[i], full.coefficients - sub.coefficients,
+                                   rtol=1e-7, atol=1e-10)
+        # dffits_i = (yhat_i - yhat_(i)) / (sigma_(i) sqrt(h_i / w_i))
+        yhat_full = float(X[i] @ full.coefficients)
+        yhat_del = float(X[i] @ sub.coefficients)
+        want = (yhat_full - yhat_del) / (sub.sigma * np.sqrt(h[i] / w[i]))
+        np.testing.assert_allclose(dft[i], want, rtol=1e-7)
+        # dfbetas scaling: dfbeta / (sigma_(i) * sqrt(cov_jj))
+        np.testing.assert_allclose(
+            dfbs[i], dfb[i] / (sub.sigma * np.sqrt(np.diag(full.cov_unscaled))),
+            rtol=1e-7)
+
+
+def test_dfbeta_glm_one_step_tracks_deletion(rng, mesh8):
+    """The GLM one-step approximations (R's influence.glm) must track the
+    actual deletion refits: high rank correlation and the same most
+    influential row."""
+    from sparkglm_tpu.config import NumericConfig
+    n, p = 250, 3
+    X = np.column_stack([np.ones(n), rng.standard_normal((n, p - 1))])
+    eta = X @ np.array([0.3, 0.6, -0.4])
+    y = rng.poisson(np.exp(eta)).astype(float)
+    y[7] += 25  # plant an outlier
+    cfg = NumericConfig(dtype="float64")
+    full = sg.glm_fit(X, y, family="poisson", tol=1e-12, config=cfg)
+    dfb = sg.dfbeta(full, X, y)
+    actual = np.empty_like(dfb)
+    for i in range(n):
+        keep = np.arange(n) != i
+        sub = sg.glm_fit(X[keep], y[keep], family="poisson", tol=1e-12,
+                         config=cfg)
+        actual[i] = full.coefficients - sub.coefficients
+    for j in range(p):
+        r = np.corrcoef(dfb[:, j], actual[:, j])[0, 1]
+        assert r > 0.95, (j, r)
+    # the planted outlier dominates both the approximation and the truth
+    assert np.argmax(np.abs(sg.dffits(full, X, y))) == 7
+    assert np.argmax(np.linalg.norm(actual, axis=1)) == 7
+
+
+def test_dfbetas_nan_when_scale_undefined(rng):
+    """n - p - 1 == 0: sigma_(i) is undefined; dfbetas/dffits report NaN
+    (R's behavior), never plausible finite numbers at an arbitrary scale."""
+    from sparkglm_tpu.config import NumericConfig
+    n, p = 4, 3
+    X = np.column_stack([np.ones(n), rng.standard_normal((n, p - 1))])
+    y = rng.standard_normal(n)
+    m = sg.lm_fit(X, y, config=NumericConfig(dtype="float64"))
+    assert np.isnan(sg.dfbetas(m, X, y)).all()
+    assert np.isnan(sg.dffits(m, X, y)).all()
+    # dfbeta itself (unscaled) stays exact and finite
+    assert np.isfinite(sg.dfbeta(m, X, y)).all()
