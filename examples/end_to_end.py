@@ -73,6 +73,20 @@ print("AIC", round(m.aic, 2), " BIC", round(m.bic(), 2))
 tp = sg.predict(m, data, type="terms")
 print("terms:", tp.columns, " constant:", round(tp.constant, 4))
 
+# single-term additions and AIC-stepwise selection (R's add1/step; the
+# hierarchy gate admits an interaction only once its margins are in)
+print(sg.add1(m, "~ . + age:veh", data, test="Chisq"))
+sel = sg.step(sg.glm("claims ~ offset(log_expo)", data, family="poisson",
+                     weights="w"),
+              data, scope="~ age + log(dens) + veh")
+print("step selected:", sel.formula)
+
+# case-deletion influence (exact rank-one downdate for lm; one-step for
+# glm) — the fit-time offset() column travels with the model and is
+# recovered from the data automatically, as in predict()
+infl = sg.dffits(m, data, data["claims"], weights=data["w"])
+print("max |dffits| row:", int(np.argmax(np.abs(infl))))
+
 # ---------------------------------------------------------------------------
 # 4. Scoring — host, and sharded over the mesh (the reference's
 #    executor-side predictMultiple, as one SPMD pass)

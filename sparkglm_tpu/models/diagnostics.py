@@ -47,6 +47,51 @@ def _design_of(model, data):
     return transform(as_columns(data), model.terms, dtype=np.float64)
 
 
+def _recover_offset(model, data, offset):
+    """Diagnostics follow predict()'s offset contract: a fit-time by-name
+    offset travels with the model and is recovered from COLUMN data
+    automatically; an array offset cannot be, so omitting it on an
+    offset model is an error — silent offset-free diagnostics are
+    plausible wrong numbers (review r4)."""
+    if offset is not None:
+        return offset
+    off_col = getattr(model, "offset_col", None)
+    is_cols = not (isinstance(data, np.ndarray) and data.ndim == 2)
+    if off_col is not None and is_cols:
+        from ..data.frame import as_columns
+        cols = as_columns(data)
+        names = [off_col] if isinstance(off_col, str) else list(off_col)
+        missing = [nm for nm in names if nm not in cols]
+        if missing:
+            raise ValueError(
+                f"model was fit with offset column {missing[0]!r}, which "
+                "is missing from the data; pass offset= explicitly")
+        return sum(np.asarray(cols[nm], np.float64) for nm in names)
+    if getattr(model, "has_offset", False):
+        raise ValueError(
+            "model was fit with an offset that cannot be recovered from "
+            "this data; pass offset= (or fit with the offset as a named "
+            "column so it travels with the model)")
+    return None
+
+
+def _hat_pieces(model, data, *, weights, offset, m):
+    """Design, unscaled covariance, working weights, and the hat diagonal
+    — computed once and shared by every diagnostic."""
+    from .lm import _row_quadform
+
+    offset = _recover_offset(model, data, offset)
+    X = np.asarray(_design_of(model, data), np.float64)
+    if model.cov_unscaled is None:
+        raise ValueError("model was fit without the unscaled covariance "
+                         "(streaming fits keep only its diagonal)")
+    C = np.nan_to_num(np.asarray(model.cov_unscaled, np.float64))
+    w = _working_weights(model, X, weights, m, offset)
+    # _row_quadform returns sqrt(x_i' V x_i) (the SE helper) — square it
+    q = np.asarray(_row_quadform(X, C), np.float64) ** 2
+    return X, C, w, np.clip(w * q, 0.0, 1.0), offset
+
+
 def _rank(model) -> int:
     aliased = getattr(model, "aliased", None)
     if aliased is None:
@@ -74,20 +119,12 @@ def _working_weights(model, X, wt, m, offset):
 
 def hatvalues(model, data, *, weights=None, offset=None, m=None) -> np.ndarray:
     """Leverage h_i of each observation (R ``hatvalues``)."""
-    from .lm import _row_quadform
-
-    X = np.asarray(_design_of(model, data), np.float64)
-    if model.cov_unscaled is None:
-        raise ValueError("model was fit without the unscaled covariance "
-                         "(streaming fits keep only its diagonal)")
-    w = _working_weights(model, X, weights, m, offset)
-    # _row_quadform returns sqrt(x_i' V x_i) (the SE helper) — square it
-    q = np.asarray(_row_quadform(X, model.cov_unscaled), np.float64) ** 2
-    return np.clip(w * q, 0.0, 1.0)
+    return _hat_pieces(model, data, weights=weights, offset=offset, m=m)[3]
 
 
 def rstandard(model, data, y, *, weights=None, offset=None, m=None) -> np.ndarray:
     """Standardized residuals (R ``rstandard``: deviance-based for GLMs)."""
+    offset = _recover_offset(model, data, offset)
     X = _design_of(model, data)
     h = hatvalues(model, X, weights=weights, offset=offset, m=m)
     denom = np.sqrt(np.maximum(1.0 - h, 1e-12))
@@ -104,6 +141,7 @@ def rstandard(model, data, y, *, weights=None, offset=None, m=None) -> np.ndarra
 def cooks_distance(model, data, y, *, weights=None, offset=None,
                    m=None) -> np.ndarray:
     """Cook's distance (R ``cooks.distance``)."""
+    offset = _recover_offset(model, data, offset)
     X = _design_of(model, data)
     h = hatvalues(model, X, weights=weights, offset=offset, m=m)
     p = max(_rank(model), 1)
@@ -127,19 +165,11 @@ def _deletion_pieces(model, X, y, *, weights, offset, m):
     model's residuals/weights (the one-step influence approximation);
     note R's dffits()/dfbetas() scale by deviance-based weighted
     residuals instead, so GLM values are the working-model analogues,
-    not digit-for-digit R.  When n - p - 1 <= 0 the scale is undefined
-    and sigma_(i) is NaN, as in R.  The working weights and the hat
-    quadform are each computed ONCE here."""
-    from .lm import _row_quadform
-
-    X = np.asarray(_design_of(model, X), np.float64)
-    if model.cov_unscaled is None:
-        raise ValueError("model was fit without the unscaled covariance "
-                         "(streaming fits keep only its diagonal)")
-    C = np.nan_to_num(np.asarray(model.cov_unscaled, np.float64))
-    w = _working_weights(model, X, weights, m, offset)
-    q = np.asarray(_row_quadform(X, C), np.float64) ** 2
-    h = np.clip(w * q, 0.0, 1.0)
+    not digit-for-digit R.  sigma_(i) is NaN where undefined (n-p-1 <= 0,
+    or a float-rounded NEGATIVE downdated RSS near h_i -> 1), as R
+    reports — never a clamped finite stand-in."""
+    X, C, w, h, offset = _hat_pieces(model, X, weights=weights,
+                                     offset=offset, m=m)
     if hasattr(model, "family"):
         e = np.asarray(model.residuals(X, y, type="working", offset=offset,
                                        weights=weights, m=m), np.float64)
@@ -153,8 +183,8 @@ def _deletion_pieces(model, X, y, *, weights, offset, m):
     if df_resid - 1 <= 0:
         s_i = np.full(X.shape[0], np.nan)  # undefined, as R reports
     else:
-        s_i = np.sqrt(np.maximum(
-            (rss_w - w * e * e / om) / (df_resid - 1), 1e-300))
+        s2_i = (rss_w - w * e * e / om) / (df_resid - 1)
+        s_i = np.sqrt(np.where(s2_i > 0, s2_i, np.nan))
     return dfb, C, e, w, h, om, s_i
 
 

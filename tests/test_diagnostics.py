@@ -153,3 +153,30 @@ def test_dfbetas_nan_when_scale_undefined(rng):
     assert np.isnan(sg.dffits(m, X, y)).all()
     # dfbeta itself (unscaled) stays exact and finite
     assert np.isfinite(sg.dfbeta(m, X, y)).all()
+
+
+def test_diagnostics_recover_formula_offset(rng):
+    """A fit-time offset() column travels with the model: diagnostics on
+    COLUMN data recover it automatically (same contract as predict), and
+    an unrecoverable array offset is refused, never silently dropped."""
+    n = 500
+    x = rng.standard_normal(n)
+    off = rng.uniform(0.0, 1.0, n)
+    y = rng.poisson(np.exp(0.3 + 0.5 * x + off)).astype(float)
+    data = {"y": y, "x": x, "lo": off}
+    m = sg.glm("y ~ x + offset(lo)", data, family="poisson")
+    X = np.column_stack([np.ones(n), x])
+    auto = sg.dffits(m, data, y)
+    explicit = sg.dffits(m, X, y, offset=off)
+    np.testing.assert_allclose(auto, explicit, rtol=1e-10)
+    # and they genuinely differ from the (wrong) offset-free values
+    m0 = sg.glm("y ~ x", data, family="poisson")
+    assert not np.allclose(auto, sg.dffits(m0, data, y))
+    # array-offset fits refuse silent offset-free diagnostics
+    ma = sg.glm_fit(X, y, family="poisson", offset=off)
+    with pytest.raises(ValueError, match="offset"):
+        sg.hatvalues(ma, X)
+    # two SEPARATE f32 fits (formula vs array path): same hat values up
+    # to the fits' own f32 coefficient noise
+    np.testing.assert_allclose(sg.hatvalues(ma, X, offset=off),
+                               sg.hatvalues(m, data), rtol=5e-3)
