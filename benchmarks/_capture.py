@@ -1,0 +1,28 @@
+"""Shared plumbing for the TPU capture harnesses.
+
+One source of truth for (a) the round-tagged output path — the round
+number comes from the ROUND env var that benchmarks/tpu_when_alive.sh
+exports, so bumping it there retargets every writer at once — and
+(b) atomic JSON dumps: the watchdog's `timeout` can SIGTERM a writer at
+any instant, and a truncate-then-write that dies mid-dump would leave
+unparseable JSON whose cleanup discards every accumulated measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+ROUND = os.environ.get("ROUND", "5").zfill(2)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def out_path(stem: str) -> str:
+    return os.path.join(_HERE, f"{stem}_r{ROUND}.json")
+
+
+def dump_atomic(obj, path: str) -> None:
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)

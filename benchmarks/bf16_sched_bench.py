@@ -6,7 +6,7 @@ config=NumericConfig(bf16_warmup=True))`` on the 2M x 512 logistic
 headline shape, device-resident data, full fits to tol=1e-8 — plus the
 coefficient agreement between the two (the accuracy contract).
 
-Writes benchmarks/bf16_sched_r04.json incrementally.  ONE tunnel client
+Writes benchmarks/bf16_sched_r05.json incrementally.  ONE tunnel client
 at a time (tpu_when_alive.sh).
 """
 import json
@@ -23,7 +23,9 @@ import numpy as np  # noqa: E402
 import sparkglm_tpu as sg  # noqa: E402
 from sparkglm_tpu.config import NumericConfig  # noqa: E402
 
-OUT = "/root/repo/benchmarks/bf16_sched_r04.json"
+from _capture import dump_atomic, out_path  # noqa: E402
+
+OUT = out_path("bf16_sched")
 
 
 def main():
@@ -56,8 +58,7 @@ def main():
         res[f"{tag}_compile_s"] = t[0]
         res[f"{tag}_iters"] = int(m.iterations)
         res[f"{tag}_ms_per_iter"] = 1e3 * min(t[1:]) / max(1, m.iterations)
-        with open(OUT, "w") as f:
-            json.dump(res, f, indent=1)
+        dump_atomic(res, OUT)
         print(tag, res[f"{tag}_fit_s"], "s,", m.iterations, "iters", flush=True)
         return m
 
@@ -66,8 +67,8 @@ def main():
     res["coef_maxdiff"] = float(np.max(np.abs(
         m32.coefficients - mbf.coefficients)))
     res["speedup"] = res["fused_f32_fit_s"] / res["fused_bf16_warmup_fit_s"]
-    with open(OUT, "w") as f:
-        json.dump(res, f, indent=1)
+    res["complete"] = True  # watchdog guard: partial dumps lack this
+    dump_atomic(res, OUT)
     print(json.dumps(res, indent=1))
 
 

@@ -11,7 +11,7 @@ Fisher pass with host-float64 accumulation.  The reported statistics are
 the engine's own (host-f64 from on-device X@beta pulls of (n,) vectors).
 
 Writes measured iterations, s/iteration, convergence, and the implied
-HBM sweep bandwidth to benchmarks/results_r03_config5.json.  Chunks are
+HBM sweep bandwidth to benchmarks/config5_r05.json.  Chunks are
 regenerated per pass (100 GB does not fit in 16 GB HBM): generation is a
 cheap RNG kernel per chunk, so cache="none" keeps the measurement clean.
 
@@ -30,6 +30,8 @@ import numpy as np
 HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(HERE))
 from sparkglm_tpu.models.streaming import glm_fit_streaming
+
+from _capture import dump_atomic, out_path  # noqa: E402
 
 N_TOTAL = 50_000_000
 P = 500
@@ -107,8 +109,7 @@ def main():
         "max_abs_beta_err": float(np.max(np.abs(model.coefficients - bt))),
     }
     print(json.dumps(res, indent=1))
-    with open(os.path.join(HERE, "results_r03_config5.json"), "w") as f:
-        json.dump(res, f, indent=1)
+    dump_atomic(res, out_path("config5"))
 
 
 if __name__ == "__main__":

@@ -21,7 +21,7 @@ Accuracy is reported as (a) max relerr of the Gramian vs an f32 HIGHEST
 reference, and (b) the end-to-end contract that matters: relerr of the
 solved Newton step beta = G^{-1} b vs the reference step.
 
-Writes benchmarks/proto_bf16_r04.json.  Run ONE process at a time on the
+Writes benchmarks/proto_bf16_r05.json.  Run ONE process at a time on the
 tunnel (see tpu_when_alive.sh).
 """
 import json
@@ -35,6 +35,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 sys.path.insert(0, "/root/repo")
+
+from _capture import dump_atomic, out_path  # noqa: E402
 
 
 def _fetch(out):
@@ -181,8 +183,7 @@ def main():
               res.get(f"{tag}_step_relerr", ""), flush=True)
         # dump incrementally: a tunnel wedge / timeout kill mid-sweep keeps
         # every completed measurement (tunnel time is never re-spent)
-        with open("/root/repo/benchmarks/proto_bf16_r04.json", "w") as f:
-            json.dump(res, f, indent=1)
+        dump_atomic(res, out_path("proto_bf16"))
 
     for br_rows in (256, 512, 1024):
         record(f"f32_default_b{br_rows}",
@@ -192,9 +193,9 @@ def main():
         record(f"bf16_native_b{br_rows}",
                make_kernel("bf16_native", br_rows, p), Xb)
 
+    res["complete"] = True  # watchdog guard: partial dumps lack this
     print(json.dumps(res, indent=1))
-    with open("/root/repo/benchmarks/proto_bf16_r04.json", "w") as f:
-        json.dump(res, f, indent=1)
+    dump_atomic(res, out_path("proto_bf16"))
 
 
 if __name__ == "__main__":

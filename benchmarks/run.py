@@ -28,6 +28,9 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _capture import dump_atomic  # noqa: E402
 
 
 def main() -> None:
@@ -68,6 +71,9 @@ def main() -> None:
     if args.merge and args.json and os.path.exists(args.json):
         with open(args.json) as f:
             results = json.load(f)
+        # a prior run's completion sentinel must not survive into this
+        # run's incremental dumps — finish() re-stamps it only if earned
+        results = [r for r in results if r.get("config") != "_complete"]
 
     def emit(rec):
         base = lambda name: re.sub(r"_\d+x\d+$", "", name)
@@ -75,10 +81,10 @@ def main() -> None:
                       if base(r["config"]) != base(rec["config"])]
         results.append(rec)
         print(json.dumps(rec), flush=True)
-        # write incrementally so a timeout mid-harness keeps earlier configs
+        # write incrementally (and atomically: a SIGTERM mid-dump must not
+        # truncate the file) so a timeout mid-harness keeps earlier configs
         if args.json:
-            with open(args.json, "w") as f:
-                json.dump(results, f, indent=1)
+            dump_atomic(results, args.json)
 
     def rows(base: int) -> int:
         return max(4096, int(base * args.scale))
@@ -162,7 +168,7 @@ def main() -> None:
     # so the measurement is the streaming pipeline (H2D + device compute +
     # host-f64 stats), not numpy's RNG throughput.
     if 5 not in only:
-        return finish(args, results, jax)
+        return finish(args, results, jax, only)
     p5 = 500
     chunk = 1_048_576 // 4
     n5 = rows(2_000_000)
@@ -206,11 +212,22 @@ def main() -> None:
           "note": "wall-clock includes one-time H2D over the axon tunnel "
                   "(throttles to ~100-200 MB/s sustained) + R-semantics "
                   "null-model IRLS; chunk cache makes iterations HBM-bound"})
-    finish(args, results, jax)
+    finish(args, results, jax, only)
 
 
-def finish(args, results, jax) -> None:
-    # emit() already persists incrementally after every record
+def finish(args, results, jax, only) -> None:
+    # emit() already persists incrementally after every record; stamp a
+    # sentinel record so a timeout-killed partial file is distinguishable
+    # from a finished harness (the tpu_when_alive.sh guard greps for it).
+    # Only a FULL five-config run on real TPU earns the sentinel — a
+    # --only smoke or a CPU run must never satisfy the round's capture
+    # guard (it would permanently skip the real refresh).
+    full_tpu = (only == {1, 2, 3, 4, 5}
+                and jax.default_backend() == "tpu")
+    if args.json and full_tpu:
+        results[:] = [r for r in results if r.get("config") != "_complete"]
+        results.append({"config": "_complete", "complete": True})
+        dump_atomic(results, args.json)
     print(f"platform={jax.default_backend()} devices={len(jax.devices())}",
           file=sys.stderr)
 

@@ -20,6 +20,8 @@ from sparkglm_tpu.models.scoring import _score_kernel
 from sparkglm_tpu.families.links import get_link
 from sparkglm_tpu.parallel import mesh as meshlib
 
+from _capture import dump_atomic, out_path  # noqa: E402
+
 
 def _fetch(out):
     return float(jnp.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
@@ -73,8 +75,7 @@ def main():
     res["predict_2Mx512_response"] = bench(2_097_152, 512, False, True)
     res["predict_2Mx512_se_fit"] = bench(2_097_152, 512, True, True)
     print(json.dumps(res, indent=1))
-    with open("/root/repo/benchmarks/scoring_r03.json", "w") as f:
-        json.dump(res, f, indent=1)
+    dump_atomic(res, out_path("scoring"))
 
 
 if __name__ == "__main__":

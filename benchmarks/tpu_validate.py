@@ -10,7 +10,7 @@ Run on real TPU hardware (axon tunnel).  Produces JSON on stdout:
     Precision.HIGHEST): einsum won at every p.  r03: the kernel runs
     DEFAULT (bf16-multiply) Gramian precision in the large-n regime
     (benchmarks/HOTLOOP_r03.md) — this sweep re-decides the crossover.
-    Writes benchmarks/engine_sweep_r03.json.
+    Writes benchmarks/engine_sweep_r05.json.
 """
 from __future__ import annotations
 
@@ -29,6 +29,8 @@ import sparkglm_tpu as sg
 from sparkglm_tpu.families.families import resolve
 from sparkglm_tpu.models import glm as glm_mod
 from sparkglm_tpu.ops.fused import fused_fisher_pass, fused_fisher_pass_ref
+
+from _capture import dump_atomic, out_path  # noqa: E402
 
 OUT = {}
 
@@ -160,9 +162,7 @@ def main():
         del X3, y3, w3, o3
     OUT["timing"] = timing
     print(json.dumps(OUT, indent=1))
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "engine_sweep_r03.json"), "w") as f:
-        json.dump(OUT, f, indent=1)
+    dump_atomic(OUT, out_path("engine_sweep"))
 
 
 if __name__ == "__main__":

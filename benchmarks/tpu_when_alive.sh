@@ -3,22 +3,36 @@
 # in sequence, highest-value-first (the tunnel can wedge again at any
 # moment — never re-spend tunnel time on a capture that already exists).
 # Safe to re-run: each step is guarded by a VALID output file (partial
-# JSON from a timeout kill is removed, not trusted).
+# JSON from a timeout kill is removed, not trusted; the three incremental
+# writers additionally stamp "complete": true on their final dump, so a
+# partial file is kept but never satisfies the guard).
 # IMPORTANT: run ONE tpu process at a time — concurrent clients wedge the
 # tunnel (observed in r1, r2, and again in r3 when a D2H pull was
 # SIGTERM'd mid-transfer).
 #
-# r04 queue order (VERDICT r3 "next round" #1 and #2):
+# Queue order (VERDICT r4 "next round" #1 and #2), highest value first:
 #   1. engine sweep      — hardware re-cert of the fused-vs-einsum
 #                          crossover + shipped-kernel timing table
 #   2. headline bench.py — the engine-tagged number of record
 #                          (bench_detail_latest.json)
-#   3. bf16 master proto — the one untried roofline lever (proto_bf16_r04)
-#   4. scoring bench     — 10M-row sharded predict
-#   5. five-config refresh (results_r04.json, configs 1-5 at scale 1)
-#   6. config 5 at FULL 50M x 500 (longest; last so a wedge costs least)
+#   3. bf16 sched bench  — the SHIPPED bf16-warmup schedule end-to-end
+#                          (executes BF16_SCHEDULE_r04.md's decision rule)
+#   4. bf16 master proto — the roofline lever prototype
+#   5. scoring bench     — 10M-row sharded predict
+#   6. five-config refresh (results_r05.json, configs 1-5 at scale 1)
+#   7. config 5 at FULL 50M x 500 -> config5_rNN.json (longest; last
+#      so a wedge costs least)
+#
+# DEADLINE: checked before EVERY step, not just per probe pass — a queue
+# entered seconds before the deadline must not run hours past it into the
+# driver's end-of-round bench.py (r3's stale watchdog caused exactly that
+# collision — R4_RESPONSE.md).
 set -u
 cd "$(dirname "$0")/.."
+
+export ROUND=5   # bench.py + benchmarks/_capture.py read this — one source
+R2=$(printf "%02d" "$ROUND")   # matches _capture.py's ROUND.zfill(2)
+DEADLINE_EPOCH="${DEADLINE_EPOCH:-$(( $(date +%s) + 34200 ))}"   # default 9.5h
 
 probe() {
   timeout 75 python -c "
@@ -27,61 +41,82 @@ assert jax.devices()[0].platform == 'tpu'
 print(float((jnp.ones((128,128))@jnp.ones((128,128)))[0,0]))" >/dev/null 2>&1
 }
 
-valid_json() {  # non-empty AND parseable
-  [ -s "$1" ] && python -c "import json,sys; json.load(open(sys.argv[1]))" "$1" >/dev/null 2>&1
+before_deadline() { [ "$(date +%s)" -lt "$DEADLINE_EPOCH" ]; }
+
+# STEPS: "output-file|required-marker|timeout|command"
+# required-marker: grep pattern the file must contain beyond parsing
+# (empty = parseable is enough).  bench_detail_latest must be THIS round's
+# capture; the incremental writers must have reached their final dump.
+STEPS=(
+  "benchmarks/engine_sweep_r${R2}.json||560|python -u benchmarks/tpu_validate.py"
+  "benchmarks/bench_detail_latest.json|\"round\": ${ROUND}|560|python bench.py"
+  "benchmarks/bf16_sched_r${R2}.json|\"complete\": true|900|python -u benchmarks/bf16_sched_bench.py"
+  "benchmarks/proto_bf16_r${R2}.json|\"complete\": true|560|python -u benchmarks/proto_bf16_master.py"
+  "benchmarks/scoring_r${R2}.json||560|python -u benchmarks/scoring_bench.py"
+  "benchmarks/results_r${R2}.json|\"complete\": true|1500|python -u benchmarks/run.py --merge --json benchmarks/results_r${R2}.json"
+  "benchmarks/config5_r${R2}.json||3000|python -u benchmarks/config5_full.py"
+)
+
+capture_ok() {  # $1=file $2=marker: non-empty, parseable, marker present
+  [ -s "$1" ] || return 1
+  python -c "import json,sys; json.load(open(sys.argv[1]))" "$1" >/dev/null 2>&1 || return 1
+  [ -z "$2" ] || grep -q "$2" "$1"
 }
 
-for i in $(seq 1 "${PROBES:-8}"); do
+run_queue() {
+  local spec file marker tmo cmd log left
+  for spec in "${STEPS[@]}"; do
+    IFS='|' read -r file marker tmo cmd <<<"$spec"
+    capture_ok "$file" "$marker" && continue
+    # clamp the step budget to the remaining deadline window: a step
+    # entered seconds before the deadline must not hold the tunnel for
+    # its full timeout into the driver's end-of-round bench.py
+    left=$(( DEADLINE_EPOCH - $(date +%s) ))
+    if [ "$left" -lt 120 ]; then
+      echo "[$(date +%H:%M:%S)] <120s to deadline; skipping $file"
+      return 1
+    fi
+    [ "$tmo" -gt "$left" ] && tmo=$left
+    log="/tmp/$(basename "$file" .json).log"
+    echo "== $cmd  (-> $file, timeout ${tmo}s)"
+    timeout "$tmo" $cmd >"$log" 2>&1 \
+      || { echo "   step failed (rc=$?)"; tail -5 "$log"; }
+    # a partial/invalid capture must not satisfy the guard next pass —
+    # EXCEPT incremental writers, whose partial dumps (parseable, no
+    # "complete" marker) are kept for inspection; the step still re-runs
+    # from scratch next pass (the writers have no resume logic)
+    if ! capture_ok "$file" "$marker"; then
+      python -c "import json,sys; json.load(open(sys.argv[1]))" "$file" >/dev/null 2>&1 \
+        || rm -f "$file"
+    fi
+  done
+}
+
+all_done() {
+  local spec file marker _
+  for spec in "${STEPS[@]}"; do
+    IFS='|' read -r file marker _ <<<"$spec"
+    capture_ok "$file" "$marker" || return 1
+  done
+}
+
+i=0
+while before_deadline; do
+  i=$((i+1))
   if probe; then
-    echo "tunnel alive (probe $i)"
-    if ! valid_json benchmarks/engine_sweep_r03.json; then
-      echo "== engine sweep (hardware re-cert, DEFAULT-precision fused kernel)"
-      timeout 560 python -u benchmarks/tpu_validate.py >/tmp/sweep_out.log 2>/tmp/sweep_err.log \
-        || { echo "sweep failed"; tail -5 /tmp/sweep_err.log; }
-      valid_json benchmarks/engine_sweep_r03.json || rm -f benchmarks/engine_sweep_r03.json
+    echo "[$(date +%H:%M:%S)] tunnel alive (probe $i) — running queue"
+    run_queue; queue_rc=$?
+    if all_done; then
+      echo "[$(date +%H:%M:%S)] ALL CAPTURES COMPLETE"
+      exit 0
     fi
-    if ! { valid_json benchmarks/bench_detail_latest.json \
-           && grep -q '"engine"' benchmarks/bench_detail_latest.json; }; then
-      echo "== headline bench (fused vs einsum, engine-tagged number of record)"
-      timeout 560 python bench.py 2>/tmp/bench_late.log \
-        || { echo "headline failed"; tail -5 /tmp/bench_late.log; }
-      valid_json benchmarks/bench_detail_latest.json \
-        || rm -f benchmarks/bench_detail_latest.json
-    fi
-    if ! valid_json benchmarks/proto_bf16_r04.json; then
-      echo "== bf16 master-copy prototype (roofline lever, VERDICT r3 #2)"
-      timeout 560 python -u benchmarks/proto_bf16_master.py >/tmp/bf16_out.log 2>&1 \
-        || { echo "bf16 proto failed"; tail -5 /tmp/bf16_out.log; }
-      valid_json benchmarks/proto_bf16_r04.json || rm -f benchmarks/proto_bf16_r04.json
-    fi
-    if ! valid_json benchmarks/bf16_sched_r04.json; then
-      echo "== SHIPPED bf16-warmup schedule end-to-end (fused vs fused+warmup)"
-      timeout 900 python -u benchmarks/bf16_sched_bench.py >/tmp/bf16_sched.log 2>&1 \
-        || { echo "bf16 sched bench failed"; tail -5 /tmp/bf16_sched.log; }
-      valid_json benchmarks/bf16_sched_r04.json || rm -f benchmarks/bf16_sched_r04.json
-    fi
-    if ! valid_json benchmarks/scoring_r03.json; then
-      echo "== 10M-row scoring bench"
-      timeout 560 python -u benchmarks/scoring_bench.py >/tmp/score_out.log 2>&1 \
-        || { echo "scoring bench failed"; tail -5 /tmp/score_out.log; }
-      valid_json benchmarks/scoring_r03.json || rm -f benchmarks/scoring_r03.json
-    fi
-    if ! valid_json benchmarks/results_r04.json; then
-      echo "== five-config refresh (results_r04.json)"
-      timeout 1500 python -u benchmarks/run.py --json benchmarks/results_r04.json \
-        >/tmp/run_r04.log 2>&1 \
-        || { echo "five-config failed"; tail -5 /tmp/run_r04.log; }
-      valid_json benchmarks/results_r04.json || rm -f benchmarks/results_r04.json
-    fi
-    if ! valid_json benchmarks/results_r03_config5.json; then
-      echo "== BASELINE config 5 at FULL 50M x 500 (several minutes)"
-      timeout 3000 python -u benchmarks/config5_full.py 2>&1 | tail -20
-      valid_json benchmarks/results_r03_config5.json || rm -f benchmarks/results_r03_config5.json
-    fi
-    exit 0
+    [ "$queue_rc" -ne 0 ] && break   # deadline hit mid-queue: exit now
+    echo "[$(date +%H:%M:%S)] queue pass ended (captures missing); re-probing in 120s"
+    sleep 120
+  else
+    echo "[$(date +%H:%M:%S)] probe $i: tunnel wedged; sleeping 240s"
+    sleep 240
   fi
-  echo "probe $i: tunnel wedged; sleeping 45s"
-  sleep 45
 done
-echo "tunnel never answered"
-exit 1
+echo "[$(date +%H:%M:%S)] deadline reached; exiting so the driver's bench.py has the tunnel to itself"
+all_done && exit 0 || exit 1
