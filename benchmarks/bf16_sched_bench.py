@@ -2,9 +2,19 @@
 
 proto_bf16_master.py measures the raw pass; this measures what users get:
 ``glm_fit(engine="fused")`` vs ``glm_fit(engine="fused",
-config=NumericConfig(bf16_warmup=True))`` on the 2M x 512 logistic
-headline shape, device-resident data, full fits to tol=1e-8 — plus the
-coefficient agreement between the two (the accuracy contract).
+config=NumericConfig(bf16_warmup=True))`` — the full user entry point
+including H2D upload and host-f64 statistics — on a 1M x 512 logistic
+slice of the headline shape, full fits to tol=1e-8, plus the coefficient
+agreement between the two (the accuracy contract).
+
+Data lives in HOST numpy from the start: generating on device and letting
+glm_fit's ``np.asarray`` pull 4.3 GB back D2H is exactly the tunnel
+operation that wedged round 3 (R4_RESPONSE.md) and hung this bench's first
+r5 window for its whole 900 s timeout.  1M x 512 (2.1 GB) keeps each
+per-fit H2D upload ~20 s over the tunnel; on a real TPU VM this script is
+IO-trivial.  The *kernel-level* schedule timing at the full 2M x 512 rides
+bench.py's ``headline_fused_bf16`` record — the two together execute
+BF16_SCHEDULE_r04.md's decision rule.
 
 Writes benchmarks/bf16_sched_r05.json incrementally.  ONE tunnel client
 at a time (tpu_when_alive.sh).
@@ -14,7 +24,6 @@ import sys
 import time
 
 import jax
-import jax.numpy as jnp
 
 sys.path.insert(0, "/root/repo")
 
@@ -26,47 +35,62 @@ from sparkglm_tpu.config import NumericConfig  # noqa: E402
 from _capture import dump_atomic, out_path  # noqa: E402
 
 OUT = out_path("bf16_sched")
+SOFT_DEADLINE_S = 780.0  # dump what we have before the watchdog's 900 s
 
 
 def main():
+    t_start = time.perf_counter()
     res = {"device": str(jax.devices()[0])}
-    n, p = 2_097_152, 512
-    kx, kb = jax.random.split(jax.random.PRNGKey(0))
-
-    @jax.jit
-    def gen():
-        X = jax.random.normal(kx, (n, p), jnp.float32).at[:, 0].set(1.0)
-        bt = jax.random.normal(kb, (p,), jnp.float32) / (2.0 * p ** 0.5)
-        y = (jax.random.uniform(jax.random.PRNGKey(1), (n,))
-             < jax.nn.sigmoid(X @ bt)).astype(jnp.float32)
-        return X, y
-
-    X, y = gen()
-    jax.block_until_ready(y)
+    n, p = 1_048_576, 512
+    res["n"], res["p"] = n, p
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((n, p)).astype(np.float32)
+    X[:, 0] = 1.0
+    bt = (rng.standard_normal(p) / (2.0 * p ** 0.5)).astype(np.float32)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-(X @ bt)))).astype(np.float32)
+    print(f"host data ready at {time.perf_counter() - t_start:.1f}s",
+          flush=True)
     mesh = sg.make_mesh()
     kw = dict(family="binomial", tol=1e-8, criterion="relative",
               engine="fused", mesh=mesh)
 
-    def fit_time(tag, **extra):
+    def fit_time(tag, reps=3, **extra):
         t = []
         m = None
-        for rep in range(3):
+        for rep in range(reps):
+            if time.perf_counter() - t_start > SOFT_DEADLINE_S and m is not None:
+                print(f"{tag}: soft deadline, stopping at rep {rep}",
+                      flush=True)
+                break
             t0 = time.perf_counter()
             m = sg.glm_fit(X, y, **kw, **extra)
             t.append(time.perf_counter() - t0)
-        res[f"{tag}_fit_s"] = min(t[1:])  # rep 0 pays compile
+            print(f"{tag} rep{rep}: {t[-1]:.2f}s ({m.iterations} iters)",
+                  flush=True)
+        best = min(t[1:]) if len(t) > 1 else t[0]
+        res[f"{tag}_fit_s"] = best
+        if len(t) == 1:
+            # deadline-truncated: the single rep paid JIT compile, so this
+            # fit_s is NOT comparable to a warm one — flag it in the record
+            res[f"{tag}_truncated_compile_inclusive"] = True
         res[f"{tag}_compile_s"] = t[0]
         res[f"{tag}_iters"] = int(m.iterations)
-        res[f"{tag}_ms_per_iter"] = 1e3 * min(t[1:]) / max(1, m.iterations)
+        res[f"{tag}_ms_per_iter"] = 1e3 * best / max(1, m.iterations)
         dump_atomic(res, OUT)
-        print(tag, res[f"{tag}_fit_s"], "s,", m.iterations, "iters", flush=True)
         return m
 
-    m32 = fit_time("fused_f32")
-    mbf = fit_time("fused_bf16_warmup", config=NumericConfig(bf16_warmup=True))
+    m32 = fit_time("fused_f32", reps=2)
+    mbf = fit_time("fused_bf16_warmup", reps=2,
+                   config=NumericConfig(bf16_warmup=True))
     res["coef_maxdiff"] = float(np.max(np.abs(
         m32.coefficients - mbf.coefficients)))
-    res["speedup"] = res["fused_f32_fit_s"] / res["fused_bf16_warmup_fit_s"]
+    res["speedup_end_to_end"] = (res["fused_f32_fit_s"]
+                                 / res["fused_bf16_warmup_fit_s"])
+    res["note"] = ("certifies the SHIPPED entry point runs the schedule on "
+                   "TPU and the coefficient contract; end-to-end times are "
+                   "tunnel-upload-dominated here, so the schedule SPEEDUP of "
+                   "record is bench_detail_latest.json headline_fused vs "
+                   "headline_fused_bf16 (device-resident kernel)")
     res["complete"] = True  # watchdog guard: partial dumps lack this
     dump_atomic(res, OUT)
     print(json.dumps(res, indent=1))

@@ -89,7 +89,12 @@ def _hat_pieces(model, data, *, weights, offset, m):
     w = _working_weights(model, X, weights, m, offset)
     # _row_quadform returns sqrt(x_i' V x_i) (the SE helper) — square it
     q = np.asarray(_row_quadform(X, C), np.float64) ** 2
-    return X, C, w, np.clip(w * q, 0.0, 1.0), offset
+    h = np.clip(w * q, 0.0, 1.0)
+    # R's lminfl snaps hat >= 1 - tol to exactly 1 so the (snapped-to-zero)
+    # residual of a leverage-one row propagates 0/0 = NaN, not a huge
+    # finite value off float noise one ulp below 1
+    h[h > 1.0 - 1e-12] = 1.0
+    return X, C, w, h, offset
 
 
 def _rank(model) -> int:
@@ -127,15 +132,16 @@ def rstandard(model, data, y, *, weights=None, offset=None, m=None) -> np.ndarra
     offset = _recover_offset(model, data, offset)
     X = _design_of(model, data)
     h = hatvalues(model, X, weights=weights, offset=offset, m=m)
-    denom = np.sqrt(np.maximum(1.0 - h, 1e-12))
-    if hasattr(model, "family"):
-        d = model.residuals(X, y, type="deviance", offset=offset,
-                            weights=weights, m=m)
-        return d / (np.sqrt(model.dispersion) * denom)
-    resid = np.asarray(model.residuals(X, y, offset=offset), np.float64)
-    n = X.shape[0]
-    w = np.ones(n) if weights is None else np.asarray(weights, np.float64)
-    return resid * np.sqrt(w) / (model.sigma * denom)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        denom = np.sqrt(1.0 - h)
+        if hasattr(model, "family"):
+            d = model.residuals(X, y, type="deviance", offset=offset,
+                                weights=weights, m=m)
+            return _inf_to_nan(d / (np.sqrt(model.dispersion) * denom))
+        resid = np.asarray(model.residuals(X, y, offset=offset), np.float64)
+        n = X.shape[0]
+        w = np.ones(n) if weights is None else np.asarray(weights, np.float64)
+        return _inf_to_nan(resid * np.sqrt(w) / (model.sigma * denom))
 
 
 def cooks_distance(model, data, y, *, weights=None, offset=None,
@@ -145,47 +151,87 @@ def cooks_distance(model, data, y, *, weights=None, offset=None,
     X = _design_of(model, data)
     h = hatvalues(model, X, weights=weights, offset=offset, m=m)
     p = max(_rank(model), 1)
-    om = np.maximum(1.0 - h, 1e-12)
-    if hasattr(model, "family"):
-        pe = model.residuals(X, y, type="pearson", offset=offset,
-                             weights=weights, m=m)
-        return (pe / om) ** 2 * h / (model.dispersion * p)
-    rs = rstandard(model, X, y, weights=weights, offset=offset)
-    return rs * rs * h / (om * p)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        om = 1.0 - h
+        if hasattr(model, "family"):
+            pe = model.residuals(X, y, type="pearson", offset=offset,
+                                 weights=weights, m=m)
+            return _inf_to_nan((pe / om) ** 2 * h / (model.dispersion * p))
+        rs = rstandard(model, X, y, weights=weights, offset=offset)
+        return _inf_to_nan(rs * rs * h / (om * p))
 
 
 def _deletion_pieces(model, X, y, *, weights, offset, m):
-    """Shared ingredients of the case-deletion diagnostics: the dfbeta
-    matrix (rank-one downdate), hat diagonal h, and R's leave-one-out
-    scale sigma_(i) from lm.influence's identity
+    """Shared ingredients of the case-deletion diagnostics, exactly R's
+    ``lm.influence`` / ``influence.glm`` algorithm (stats/R/lm.influence.R
+    + src/lminfl.f): every quantity derives from the QR of the WEIGHTED
+    model matrix sqrt(W) X (W the converged IRLS working weights; prior
+    weights for an LM) and the WEIGHTED residual vector
 
-        sigma_(i)^2 = (sum w e^2 - w_i e_i^2 / (1 - h_i)) / (n - p - 1)
+        ew_i = sqrt(w_i) e_i          (LM:  R's weighted.residuals)
+        ew_i = deviance residual_i    (GLM: R's weighted.residuals ==
+                                       residuals(fit, "deviance"))
 
-    — EXACT for an LM.  For a GLM, e and w are the CONVERGED WORKING
-    model's residuals/weights (the one-step influence approximation);
-    note R's dffits()/dfbetas() scale by deviance-based weighted
-    residuals instead, so GLM values are the working-model analogues,
-    not digit-for-digit R.  sigma_(i) is NaN where undefined (n-p-1 <= 0,
-    or a float-rounded NEGATIVE downdated RSS near h_i -> 1), as R
-    reports — never a clamped finite stand-in."""
+    — R feeds the *deviance* residuals of a GLM through the same LINPACK
+    downdate it uses for an LM, so the GLM numbers are R's one-step
+    working-model approximations, digit-for-digit (NOT the textbook
+    one-step that would use working residuals).  The identities:
+
+        dfbeta_i   = (X'WX)^-1 x_i sqrt(w_i) ew_i / (1 - h_i)
+        sigma_(i)^2 = (sum ew^2 - ew_i^2 / (1 - h_i)) / (n - p - 1)
+
+    (sum ew^2 is the weighted RSS for an LM, the DEVIANCE for a GLM).
+    sigma_(i) is NaN where undefined (n-p-1 <= 0, or a float-rounded
+    NEGATIVE downdated RSS near h_i -> 1), as R reports — never a clamped
+    finite stand-in.  Tiny residuals are snapped to exact zero first
+    (|ew| < 100 eps median|ew|), R's guard against Inf at h_i = 1."""
     X, C, w, h, offset = _hat_pieces(model, X, weights=weights,
                                      offset=offset, m=m)
+    ew, df_resid = _weighted_residuals(model, X, y, weights=weights,
+                                       offset=offset, m=m)
+    med = float(np.median(np.abs(ew)))
+    ew = np.where(np.abs(ew) < 100.0 * np.finfo(np.float64).eps * med,
+                  0.0, ew)
+    # R leaves 1-h UNCLAMPED: at h_i = 1 the snapped-to-zero residual gives
+    # 0/0 = NaN through every downdate, and each public diagnostic converts
+    # any Inf to NaN (`res[is.infinite(res)] <- NaN`) — a leverage-one row
+    # reports undefined, never a clamp-scaled finite stand-in
+    om = 1.0 - h
+    with np.errstate(divide="ignore", invalid="ignore"):
+        dfb = (X @ C) * (np.sqrt(w) * ew / om)[:, None]
+        rss_w = float(np.sum(ew * ew))
+        if df_resid - 1 <= 0:
+            s_i = np.full(X.shape[0], np.nan)  # undefined, as R reports
+        else:
+            s2_i = (rss_w - ew * ew / om) / (df_resid - 1)
+            s_i = np.sqrt(np.where(s2_i > 0, s2_i, np.nan))
+        # the full-sample scale s^2 = sum(ew^2)/df_resid (weighted RSS for
+        # an LM, deviance for a GLM) — computed ONCE here so covratio /
+        # influence_measures / cooks share one definition; NaN when
+        # df_resid == 0 (saturated), as R reports
+        s = float(np.sqrt(rss_w / df_resid)) if df_resid > 0 else float("nan")
+    return dfb, C, ew, w, h, om, s_i, s
+
+
+def _inf_to_nan(a):
+    a = np.asarray(a)
+    a[np.isinf(a)] = np.nan
+    return a
+
+
+def _weighted_residuals(model, X, y, *, weights, offset, m):
+    """R's ``weighted.residuals``: sqrt(prior weight) * residual for an LM,
+    deviance residuals for a GLM — the vector every deletion diagnostic is
+    built from.  Returns (ew, df_residual)."""
     if hasattr(model, "family"):
-        e = np.asarray(model.residuals(X, y, type="working", offset=offset,
-                                       weights=weights, m=m), np.float64)
-        df_resid = model.df_residual
-    else:
-        e = np.asarray(model.residuals(X, y, offset=offset), np.float64)
-        df_resid = model.df_resid
-    om = np.maximum(1.0 - h, 1e-12)
-    dfb = (X @ C) * (w * e / om)[:, None]
-    rss_w = float(np.sum(w * e * e))
-    if df_resid - 1 <= 0:
-        s_i = np.full(X.shape[0], np.nan)  # undefined, as R reports
-    else:
-        s2_i = (rss_w - w * e * e / om) / (df_resid - 1)
-        s_i = np.sqrt(np.where(s2_i > 0, s2_i, np.nan))
-    return dfb, C, e, w, h, om, s_i
+        ew = np.asarray(model.residuals(X, y, type="deviance", offset=offset,
+                                        weights=weights, m=m), np.float64)
+        return ew, model.df_residual
+    n = X.shape[0]
+    wt = (np.ones(n) if weights is None
+          else np.asarray(weights, np.float64).reshape(n))
+    e = np.asarray(model.residuals(X, y, offset=offset), np.float64)
+    return np.sqrt(wt) * e, model.df_resid
 
 
 def dfbeta(model, data, y, *, weights=None, offset=None, m=None) -> np.ndarray:
@@ -194,8 +240,9 @@ def dfbeta(model, data, y, *, weights=None, offset=None, m=None) -> np.ndarray:
 
         beta - beta_(i) = (X'WX)^-1 x_i w_i e_i / (1 - h_i)
 
-    is algebraic, not approximate); the one-step working-model
-    approximation for a GLM (R's influence.glm coefficients)."""
+    is algebraic, not approximate); for a GLM, digit-for-digit R's
+    ``influence.glm`` coefficients (deviance residuals through the same
+    downdate — see :func:`_deletion_pieces`)."""
     dfb, *_ = _deletion_pieces(model, data, y, weights=weights,
                                offset=offset, m=m)
     return dfb
@@ -203,23 +250,146 @@ def dfbeta(model, data, y, *, weights=None, offset=None, m=None) -> np.ndarray:
 
 def dfbetas(model, data, y, *, weights=None, offset=None,
             m=None) -> np.ndarray:
-    """``dfbeta`` scaled by sigma_(i) * se_j — exact for an LM; for a GLM
-    the working-model analogue (see :func:`_deletion_pieces`)."""
-    dfb, C, _, _, _, _, s_i = _deletion_pieces(model, data, y,
-                                               weights=weights,
-                                               offset=offset, m=m)
+    """``dfbeta`` scaled by sigma_(i) * se_j (R ``dfbetas``:
+    ``infl$coefficients / outer(infl$sigma, sqrt(diag(chol2inv(qr))))``)."""
+    dfb, C, _, _, _, _, s_i, _ = _deletion_pieces(model, data, y,
+                                                  weights=weights,
+                                                  offset=offset, m=m)
     se = np.sqrt(np.maximum(np.diag(C), 1e-300))
-    return dfb / (s_i[:, None] * se[None, :])
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return _inf_to_nan(dfb / (s_i[:, None] * se[None, :]))
 
 
 def dffits(model, data, y, *, weights=None, offset=None, m=None) -> np.ndarray:
-    """The scaled change in the i-th fitted value under deletion of row i,
+    """R ``dffits``: the scaled change in the i-th fitted value under
+    deletion of row i,
 
-        dffits_i = e_i sqrt(w_i h_i) / (sigma_(i) (1 - h_i))
+        dffits_i = ew_i sqrt(h_i) / (sigma_(i) (1 - h_i)),
 
-    — exact for an LM; for a GLM the working-model analogue (R's dffits
-    scales deviance-based weighted residuals instead)."""
-    _, _, e, w, h, om, s_i = _deletion_pieces(model, data, y,
-                                              weights=weights,
-                                              offset=offset, m=m)
-    return e * np.sqrt(w * h) / (s_i * om)
+    ew the weighted (LM) / deviance (GLM) residual — digit-for-digit R on
+    both model classes."""
+    _, _, ew, _, h, om, s_i, _ = _deletion_pieces(model, data, y,
+                                                  weights=weights,
+                                                  offset=offset, m=m)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return _inf_to_nan(ew * np.sqrt(h) / (s_i * om))
+
+
+def rstudent(model, data, y, *, weights=None, offset=None,
+             m=None) -> np.ndarray:
+    """Externally studentized residuals (R ``rstudent``).
+
+    LM: ew_i / (sigma_(i) sqrt(1 - h_i)).  GLM (R's rstudent.glm):
+
+        sign(dev_i) sqrt(dev_i^2 + h_i pear_i^2 / (1 - h_i))
+
+    divided by sigma_(i) unless the family is binomial or poisson (the
+    fixed-dispersion pair R special-cases by NAME — quasi twins divide)."""
+    offset = _recover_offset(model, data, offset)
+    X = _design_of(model, data)
+    _, _, ew, _, h, om, s_i, _ = _deletion_pieces(model, X, y,
+                                                  weights=weights,
+                                                  offset=offset, m=m)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        if not hasattr(model, "family"):
+            return _inf_to_nan(ew / (s_i * np.sqrt(om)))
+        pe = np.asarray(model.residuals(X, y, type="pearson", offset=offset,
+                                        weights=weights, m=m), np.float64)
+        r = np.sign(ew) * np.sqrt(ew * ew + h * pe * pe / om)
+        if model.family in ("binomial", "poisson"):
+            return _inf_to_nan(r)
+        return _inf_to_nan(r / s_i)
+
+
+def covratio(model, data, y, *, weights=None, offset=None,
+             m=None) -> np.ndarray:
+    """R ``covratio``: the change in the determinant of the coefficient
+    covariance under deletion of row i,
+
+        covratio_i = (sigma_(i) / s)^(2 p) / (1 - h_i),
+
+    with s^2 = sum(ew^2) / df_residual (the weighted RSS scale for an LM,
+    deviance / df for a GLM — R uses the deviance scale here even for
+    fixed-dispersion families) and p the model rank."""
+    _, _, ew, _, _, om, s_i, s = _deletion_pieces(model, data, y,
+                                                  weights=weights,
+                                                  offset=offset, m=m)
+    p = max(_rank(model), 1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return _inf_to_nan((s_i / s) ** (2 * p) / om)
+
+
+class InfluenceMeasures:
+    """R's ``influence.measures`` table: one row per observation, columns
+    ``dfb.<name>`` (per non-aliased coefficient), ``dffit``, ``cov.r``,
+    ``cook.d``, ``hat``, plus R's is-influential flag matrix (same shape):
+
+      |dfbetas| > 1;  |dffit| > 3 sqrt(k/(n-k));  |1 - cov.r| > 3k/(n-k);
+      pf(cook.d, k, n-k) > 0.5;  hat > 3k/n
+
+    with k the model rank and n the number of cases with h_i > 0."""
+
+    def __init__(self, columns, infmat, is_inf):
+        self.columns = columns
+        self.infmat = infmat
+        self.is_inf = is_inf
+
+    def __repr__(self):
+        head = "obs  " + "  ".join(f"{c:>10s}" for c in self.columns) + "  inf"
+        lines = [head]
+        for i in range(self.infmat.shape[0]):
+            cells = "  ".join(f"{v:10.4g}" for v in self.infmat[i])
+            mark = " *" if self.is_inf[i].any() else ""
+            lines.append(f"{i:<4d} {cells} {mark}")
+        return "\n".join(lines)
+
+
+def influence_measures(model, data, y, *, weights=None, offset=None,
+                       m=None) -> InfluenceMeasures:
+    """R ``influence.measures``: dfbetas / dffits / covratio / Cook's
+    distance / hat in one table with R's flagging rules."""
+    import scipy.stats
+
+    offset = _recover_offset(model, data, offset)
+    X = _design_of(model, data)
+    dfb, C, ew, _, h, om, s_i, s = _deletion_pieces(model, X, y,
+                                                    weights=weights,
+                                                    offset=offset, m=m)
+    p = max(_rank(model), 1)
+    aliased = getattr(model, "aliased", None)
+    keep = (np.ones(dfb.shape[1], bool) if aliased is None
+            else ~np.asarray(aliased, bool))
+    se = np.sqrt(np.maximum(np.diag(C), 1e-300))
+    names = getattr(model, "xnames", None)
+    if names is None:
+        names = [f"b{j}" for j in range(dfb.shape[1])]
+    cols = ([f"dfb.{nm}" for nm, k in zip(names, keep) if k]
+            + ["dffit", "cov.r", "cook.d", "hat"])
+    with np.errstate(divide="ignore", invalid="ignore"):
+        dfbs = (dfb / (s_i[:, None] * se[None, :]))[:, keep]
+        dft = ew * np.sqrt(h) / (s_i * om)
+        cov_r = (s_i / s) ** (2 * p) / om
+        # Cook from the pieces already in hand — no second hat pass
+        if hasattr(model, "family"):
+            pe = np.asarray(model.residuals(X, y, type="pearson",
+                                            offset=offset, weights=weights,
+                                            m=m), np.float64)
+            cook = (pe / om) ** 2 * h / (model.dispersion * p)
+        else:
+            cook = (ew / (s * om)) ** 2 * h / p
+    infmat = np.column_stack([dfbs, dft, cov_r, cook, h])
+    infmat[np.isinf(infmat)] = np.nan
+    n_used = int(np.sum(h > 0))
+    k = p
+    if n_used <= k:
+        raise ValueError("too few cases with h_ii > 0: n <= rank")
+    nk = n_used - k
+    with np.errstate(invalid="ignore"):
+        is_inf = np.column_stack([
+            np.abs(dfbs) > 1.0,
+            np.abs(dft) > 3.0 * np.sqrt(k / nk),
+            np.abs(1.0 - cov_r) > (3.0 * k) / nk,
+            scipy.stats.f.cdf(cook, k, nk) > 0.5,
+            h > (3.0 * k) / n_used,
+        ])
+    return InfluenceMeasures(cols, infmat, is_inf)

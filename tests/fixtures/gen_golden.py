@@ -146,6 +146,115 @@ def r_fit(X, y, family, link, wt=None, offset=None, m=None,
         df_null=int(n - (1 if has_intercept else 0)))
 
 
+def _pearson_resid(family, y, mu, wt):
+    return (y - mu) * np.sqrt(wt) / np.sqrt(_variance(family, mu))
+
+
+def r_influence(X, y, family=None, link=None, wt=None, offset=None, m=None,
+                quasi=False):
+    """R's lm.influence / influence.glm / influence.measures, re-derived
+    independently of sparkglm_tpu via the QR route R itself uses
+    (stats/R/lm.influence.R, src/library/stats/src/lminfl.f):
+
+      * QR of sqrt(W) X, W the converged IRLS working weights (prior
+        weights for gaussian/identity == an LM);
+      * e = weighted.residuals: sqrt(w) resid (LM), deviance resid (GLM);
+      * hat_i = ||Q_i||^2;  dfbeta = (Q R^-T) * e/(1-h);
+      * sigma_(i)^2 = (sum e^2 - e_i^2/(1-h_i)) / (n - p - 1);
+      * dfbetas, dffits, covratio, rstudent, rstandard, cooks.distance and
+        the influence.measures flag matrix per the R source formulas.
+    """
+    from scipy.stats import f as fdist
+
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.float64)
+    n, p = X.shape
+    wt = np.ones(n) if wt is None else np.asarray(wt, np.float64)
+    if m is not None:
+        m = np.asarray(m, np.float64)
+        y = y / m
+        wt = wt * m
+    off = np.zeros(n) if offset is None else np.asarray(offset, np.float64)
+    is_lm = family in (None, "lm")
+    if is_lm:
+        sw = np.sqrt(wt)
+        beta, *_ = np.linalg.lstsq(sw[:, None] * X, sw * (y - off),
+                                   rcond=None)
+        w_work = wt
+        ew = sw * (y - X @ beta - off)
+        dispersion = None
+    else:
+        beta, _, _, _ = irls_np(X, y, family, link, wt=wt, offset=off,
+                                tol=1e-13, max_iter=200)
+        eta = X @ beta + off
+        mu = _linkinv(link, eta)
+        gp = {  # d eta / d mu
+            "identity": lambda m_: np.ones_like(m_),
+            "log": lambda m_: 1.0 / m_,
+            "logit": lambda m_: 1.0 / (m_ * (1 - m_)),
+            "probit": lambda m_: 1.0 / np.maximum(
+                np.exp(-0.5 * sp.ndtri(m_) ** 2) / np.sqrt(2 * np.pi), 1e-300),
+            "cloglog": lambda m_: 1.0 / np.maximum(-(1 - m_) * np.log(1 - m_),
+                                                   1e-300),
+            "inverse": lambda m_: -1.0 / m_ ** 2,
+            "sqrt": lambda m_: 0.5 / np.sqrt(m_),
+            "inverse_squared": lambda m_: -2.0 / m_ ** 3,
+        }[link](mu)
+        w_work = wt / (_variance(family, mu) * gp * gp)
+        dev_i = _dev_resids(family, y, mu, wt)
+        ew = np.sign(y - mu) * np.sqrt(np.maximum(dev_i, 0.0))
+        pear = _pearson_resid(family, y, mu, wt)
+        fixed_disp = family in ("binomial", "poisson") and not quasi
+        dispersion = (1.0 if fixed_disp
+                      else float(np.sum(pear ** 2) / (n - p)))
+    # R: e[abs(e) < 100 eps median|e|] <- 0 before the downdate
+    med = float(np.median(np.abs(ew)))
+    ew = np.where(np.abs(ew) < 100 * np.finfo(float).eps * med, 0.0, ew)
+    Q, R = np.linalg.qr(np.sqrt(w_work)[:, None] * X)
+    h = np.sum(Q * Q, axis=1)
+    om = 1.0 - h
+    Rinv = np.linalg.inv(R)
+    xxi = Rinv @ Rinv.T            # chol2inv(qr): (X'WX)^-1
+    dfbeta = (Q @ Rinv.T) * (ew / om)[:, None]
+    df_resid = n - p
+    rss = float(np.sum(ew * ew))
+    s2_i = (rss - ew * ew / om) / (df_resid - 1)
+    sigma_i = np.sqrt(np.where(s2_i > 0, s2_i, np.nan))
+    s = np.sqrt(rss / df_resid)
+    dfbetas = dfbeta / np.outer(sigma_i, np.sqrt(np.diag(xxi)))
+    dffits_v = ew * np.sqrt(h) / (sigma_i * om)
+    cov_r = (sigma_i / s) ** (2 * p) / om
+    if is_lm:
+        rstud = ew / (sigma_i * np.sqrt(om))
+        rstand = ew / (s * np.sqrt(om))
+        cooks = (ew / (s * om)) ** 2 * h / p
+    else:
+        rstud = np.sign(ew) * np.sqrt(ew ** 2 + h * pear ** 2 / om)
+        if not (family in ("binomial", "poisson") and not quasi):
+            rstud = rstud / sigma_i
+        rstand = ew / np.sqrt(dispersion * om)
+        cooks = (pear / om) ** 2 * h / (dispersion * p)
+    infmat = np.column_stack([dfbetas, dffits_v, cov_r, cooks, h])
+    infmat[np.isinf(infmat)] = np.nan
+    n_used, k = int(np.sum(h > 0)), p
+    is_inf = np.column_stack([
+        np.abs(dfbetas) > 1.0,
+        np.abs(dffits_v) > 3.0 * np.sqrt(k / (n_used - k)),
+        np.abs(1.0 - cov_r) > 3.0 * k / (n_used - k),
+        fdist.cdf(cooks, k, n_used - k) > 0.5,
+        h > 3.0 * k / n_used,
+    ])
+    out = dict(hat=h.tolist(), sigma=sigma_i.tolist(),
+               dfbeta=dfbeta.tolist(), dfbetas=dfbetas.tolist(),
+               dffits=dffits_v.tolist(), covratio=cov_r.tolist(),
+               rstudent=rstud.tolist(), rstandard=rstand.tolist(),
+               cooks_distance=cooks.tolist(),
+               is_inf=is_inf.astype(int).tolist())
+    if dispersion is not None:
+        out["dispersion"] = dispersion
+    return out
+
+
 # ---------------------------------------------------------------------------
 # cases
 # ---------------------------------------------------------------------------
@@ -171,6 +280,7 @@ def main():
         family="poisson", link="log",
         fit=dobson_fit,
         r_doc=dobson_r_doc,
+        influence=r_influence(X, counts, "poisson", "log"),
         provenance="R ?glm 'Dobson (1990) Page 93: Randomized Controlled Trial'")
 
     # -- 2. clotting gamma — R ?glm example ---------------------------------
@@ -186,6 +296,7 @@ def main():
         family="gamma", link="inverse",
         fit=clotting_fit,
         r_doc=clotting_r_doc,
+        influence=r_influence(Xc, lot1, "gamma", "inverse"),
         provenance="R ?glm 'McCullagh & Nelder (1989, pp. 300-2)' summary(glm(lot1 ~ log(u), family = Gamma))")
     cases["clotting_gamma_lot2"] = dict(
         data=dict(u=u.tolist(), lot2=lot2),
@@ -205,6 +316,7 @@ def main():
         data=dict(x1=x1.tolist(), m=m_sz.tolist(), successes=succ.tolist()),
         family="binomial", link="logit",
         fit=r_fit(Xb, succ, "binomial", "logit", m=m_sz),
+        influence=r_influence(Xb, succ, "binomial", "logit", m=m_sz),
         provenance="synthetic; R: glm(cbind(s, m-s) ~ x1, binomial)")
 
     # -- 4. poisson with offset ---------------------------------------------
@@ -231,6 +343,7 @@ def main():
         data=dict(x1=x1.tolist(), w=wts.tolist(), y=yg.tolist()),
         family="gaussian", link="identity",
         fit=r_fit(Xb, yg, "gaussian", "identity", wt=wts),
+        influence=r_influence(Xb, yg, "gaussian", "identity", wt=wts),
         provenance="synthetic; R: glm(y ~ x1, gaussian, weights = w)")
 
     # -- 7. inverse gaussian ------------------------------------------------
@@ -363,6 +476,7 @@ def main():
         r_doc=dict(coefficients=[5.032, -0.371], sigma=0.6964,
                    r_squared=0.07308, adj_r_squared=0.02158,
                    f_statistic=1.419),
+        influence=r_influence(X9, w9, "lm"),
         summary_contains=["5.032", "0.2202", "22.85", "-0.3710", "0.3114",
                           "-1.191", "0.6964", "0.07308", "0.02158", "1.419"],
         provenance="R ?lm 'Annette Dobson ... Plant Weight Data' lm.D9")

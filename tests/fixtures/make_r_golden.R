@@ -109,3 +109,47 @@ show("cbind_binomial",
 d <- fc$gaussian_transforms$data
 show("gaussian_transforms",
      glm(d$y ~ log(d$u) + I(d$u^2), family = gaussian()))
+
+# ---------------------------------------------------------------------------
+# influence goldens (round 5): verify the case-deletion / influence tier —
+# compare against r_golden.json$<case>$influence (hat, sigma, dfbeta(s),
+# dffits, covratio, rstudent, rstandard, cooks_distance, is_inf).
+# ---------------------------------------------------------------------------
+
+show_influence <- function(name, fit) {
+  infl <- influence(fit)
+  im <- influence.measures(fit)
+  cat("== influence ", name, "\n")
+  cat("hat:       ", format(unname(infl$hat), digits = 10), "\n")
+  cat("sigma:     ", format(unname(infl$sigma), digits = 10), "\n")
+  cat("dfbeta:    ", format(unname(infl$coefficients), digits = 10), "\n")
+  cat("dfbetas:   ", format(unname(dfbetas(fit)), digits = 10), "\n")
+  cat("dffits:    ", format(unname(dffits(fit)), digits = 10), "\n")
+  cat("covratio:  ", format(unname(covratio(fit)), digits = 10), "\n")
+  cat("rstudent:  ", format(unname(rstudent(fit)), digits = 10), "\n")
+  cat("rstandard: ", format(unname(rstandard(fit)), digits = 10), "\n")
+  cat("cooks:     ", format(unname(cooks.distance(fit)), digits = 10), "\n")
+  cat("is.inf:    ", as.integer(im$is.inf), "\n\n")
+}
+
+counts <- c(18, 17, 15, 20, 10, 20, 25, 13, 12)
+outcome <- gl(3, 1, 9); treatment <- gl(3, 3)
+show_influence("dobson_poisson",
+               glm(counts ~ outcome + treatment, family = poisson()))
+
+clotting <- data.frame(u = c(5, 10, 15, 20, 30, 40, 60, 80, 100),
+                       lot1 = c(118, 58, 42, 35, 27, 25, 21, 19, 18))
+show_influence("clotting_gamma_lot1",
+               glm(lot1 ~ log(u), data = clotting, family = Gamma))
+
+d <- j$grouped_binomial_logit$data
+show_influence("grouped_binomial_logit",
+               glm(cbind(d$successes, d$m - d$successes) ~ d$x1,
+                   family = binomial()))
+
+d <- j$gaussian_weighted$data
+show_influence("gaussian_weighted",
+               glm(d$y ~ d$x1, family = gaussian(), weights = d$w))
+
+d <- fc$lm_D9_factor$data
+show_influence("lm_D9_factor", lm(d$weight ~ factor(d$group)))

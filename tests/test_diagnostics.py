@@ -133,9 +133,12 @@ def test_dfbeta_glm_one_step_tracks_deletion(rng, mesh8):
         sub = sg.glm_fit(X[keep], y[keep], family="poisson", tol=1e-12,
                          config=cfg)
         actual[i] = full.coefficients - sub.coefficients
+    # R's deviance-residual one-step (digit-for-digit influence.glm) is a
+    # hair looser against true deletion than the textbook working-residual
+    # one-step; 0.94 still certifies it tracks the refits
     for j in range(p):
         r = np.corrcoef(dfb[:, j], actual[:, j])[0, 1]
-        assert r > 0.95, (j, r)
+        assert r > 0.94, (j, r)
     # the planted outlier dominates both the approximation and the truth
     assert np.argmax(np.abs(sg.dffits(full, X, y))) == 7
     assert np.argmax(np.linalg.norm(actual, axis=1)) == 7
@@ -153,6 +156,126 @@ def test_dfbetas_nan_when_scale_undefined(rng):
     assert np.isnan(sg.dffits(m, X, y)).all()
     # dfbeta itself (unscaled) stays exact and finite
     assert np.isfinite(sg.dfbeta(m, X, y)).all()
+
+
+def _golden():
+    import json
+    import os
+    with open(os.path.join(os.path.dirname(__file__), "fixtures",
+                           "r_golden.json")) as f:
+        return json.load(f)
+
+
+def _influence_all(model, X, y, **kw):
+    return dict(
+        hat=sg.hatvalues(model, X, **kw),
+        dfbeta=sg.dfbeta(model, X, y, **kw),
+        dfbetas=sg.dfbetas(model, X, y, **kw),
+        dffits=sg.dffits(model, X, y, **kw),
+        covratio=sg.covratio(model, X, y, **kw),
+        rstudent=sg.rstudent(model, X, y, **kw),
+        rstandard=sg.rstandard(model, X, y, **kw),
+        cooks_distance=sg.cooks_distance(model, X, y, **kw),
+    )
+
+
+@pytest.mark.parametrize("case", ["dobson_poisson", "clotting_gamma_lot1",
+                                  "grouped_binomial_logit",
+                                  "gaussian_weighted"])
+def test_glm_influence_golden(mesh1, case):
+    """Digit-for-digit R: every influence quantity against the committed
+    R-semantics goldens (QR-route independent implementation, verifiable
+    with real R via make_r_golden.R)."""
+    from sparkglm_tpu.config import NumericConfig
+    j = _golden()[case]
+    d, g = j["data"], j["influence"]
+    kw = {}
+    if case == "dobson_poisson":
+        o = np.tile([(0, 0), (1, 0), (0, 1)], (3, 1))
+        t = np.repeat([(0, 0), (1, 0), (0, 1)], 3, axis=0)
+        X = np.column_stack([np.ones(9), o, t])
+        y = np.asarray(d["counts"], float)
+    elif case == "clotting_gamma_lot1":
+        u = np.asarray(d["u"], float)
+        X = np.column_stack([np.ones(len(u)), np.log(u)])
+        y = np.asarray(d["lot1"], float)
+    elif case == "grouped_binomial_logit":
+        x1 = np.asarray(d["x1"], float)
+        X = np.column_stack([np.ones(len(x1)), x1])
+        y = np.asarray(d["successes"], float)
+        kw["m"] = np.asarray(d["m"], float)
+    else:
+        x1 = np.asarray(d["x1"], float)
+        X = np.column_stack([np.ones(len(x1)), x1])
+        y = np.asarray(d["y"], float)
+        kw["weights"] = np.asarray(d["w"], float)
+    model = sg.glm_fit(X, y, family=j["family"], link=j["link"], tol=1e-12,
+                       config=NumericConfig(dtype="float64"), mesh=mesh1, **kw)
+    got = _influence_all(model, X, y, **kw)
+    # sigma_(i) rides inside dfbetas/dffits; compare the direct outputs
+    for key, want in got.items():
+        np.testing.assert_allclose(
+            want, np.asarray(g[key], float), rtol=5e-6, atol=1e-9,
+            err_msg=f"{case}:{key}")
+    im = sg.influence_measures(model, X, y, **kw)
+    k = X.shape[1]
+    np.testing.assert_allclose(
+        im.infmat,
+        np.column_stack([np.asarray(g["dfbetas"], float),
+                         np.asarray(g["dffits"], float),
+                         np.asarray(g["covratio"], float),
+                         np.asarray(g["cooks_distance"], float),
+                         np.asarray(g["hat"], float)]),
+        rtol=5e-6, atol=1e-9)
+    assert im.infmat.shape[1] == k + 4
+    np.testing.assert_array_equal(im.is_inf.astype(int),
+                                  np.asarray(g["is_inf"], int))
+
+
+def test_lm_influence_golden(mesh1):
+    """R's ?lm plant-weight fixture through the FORMULA path: the stored
+    Terms rebuild the design, and every influence quantity matches the
+    R-semantics goldens."""
+    j = _golden()["formula_cases"]["lm_D9_factor"]
+    d, g = j["data"], j["influence"]
+    from sparkglm_tpu.config import NumericConfig
+    data = {"weight": np.asarray(d["weight"], float),
+            "group": list(d["group"])}
+    model = sg.lm(j["formula"], data, config=NumericConfig(dtype="float64"))
+    y = data["weight"]
+    got = _influence_all(model, data, y)
+    for key, want in got.items():
+        np.testing.assert_allclose(
+            got[key], np.asarray(g[key], float), rtol=5e-6, atol=1e-9,
+            err_msg=f"lm_D9:{key}")
+    im = sg.influence_measures(model, data, y)
+    assert im.columns[-4:] == ["dffit", "cov.r", "cook.d", "hat"]
+    assert im.columns[0].startswith("dfb.")
+    np.testing.assert_array_equal(im.is_inf.astype(int),
+                                  np.asarray(g["is_inf"], int))
+
+
+def test_leverage_one_row_reports_nan(rng):
+    """A factor level observed in exactly one row has h_i = 1: R reports
+    NaN for every sigma_(i)-scaled diagnostic there (0/0 through the
+    downdate), never a clamp-scaled finite stand-in."""
+    from sparkglm_tpu.config import NumericConfig
+    n = 40
+    x = rng.standard_normal(n)
+    d = {"y": 1.0 + 0.5 * x + 0.1 * rng.standard_normal(n),
+         "x": x, "g": ["a"] * (n - 1) + ["solo"]}
+    m = sg.lm("y ~ x + g", d, config=NumericConfig(dtype="float64"))
+    y = d["y"]
+    assert sg.hatvalues(m, d)[-1] == 1.0
+    assert np.isnan(sg.dffits(m, d, y)[-1])
+    assert np.isnan(sg.covratio(m, d, y)[-1])
+    assert np.isnan(sg.rstudent(m, d, y)[-1])
+    assert np.isnan(sg.dfbetas(m, d, y)[-1]).all()
+    # the other rows stay fully defined
+    assert np.isfinite(sg.dffits(m, d, y)[:-1]).all()
+    im = sg.influence_measures(m, d, y)
+    assert np.isnan(im.infmat[-1, -4])  # dffit column
+    assert np.isfinite(im.infmat[:-1, -4]).all()
 
 
 def test_diagnostics_recover_formula_offset(rng):
