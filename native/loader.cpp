@@ -95,6 +95,42 @@ bool parse_double(const char* b, size_t len, double* out) {
   return true;
 }
 
+// Python float(str) lexical parity for STRINGS coerced into numeric
+// columns: leading/trailing whitespace is stripped and single underscores
+// BETWEEN digits are removed (PEP 515) before the strict parse — Python's
+// float("1_0") is 10.0 and float(" 1.5 ") is 1.5 where bare strtod fails.
+// Divergence would break the multi-host identical-design contract between
+// a host with the .so and one on the Python fallback (review r4).
+bool py_float_parse(const char* b, size_t len, double* out) {
+  auto sp = [](char c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+           c == '\v';
+  };
+  while (len > 0 && sp(b[0])) { ++b; --len; }
+  while (len > 0 && sp(b[len - 1])) --len;
+  if (len == 0) return false;
+  bool has_us = false;
+  for (size_t i = 0; i < len; ++i) {
+    if (b[i] == '_') { has_us = true; break; }
+  }
+  if (!has_us) return parse_double(b, len, out);
+  std::string clean;
+  clean.reserve(len);
+  for (size_t i = 0; i < len; ++i) {
+    if (b[i] == '_') {
+      // PEP 515: an underscore is valid only between two digits
+      if (i == 0 || i + 1 >= len ||
+          !std::isdigit(static_cast<unsigned char>(b[i - 1])) ||
+          !std::isdigit(static_cast<unsigned char>(b[i + 1]))) {
+        return false;
+      }
+      continue;
+    }
+    clean.push_back(b[i]);
+  }
+  return parse_double(clean.data(), clean.size(), out);
+}
+
 // Trim -> unquote -> collapse RFC-4180 escaped quotes ("" -> ").  The
 // Python fallback's _clean_field mirrors these steps exactly; a quoted CSV
 // must parse identically whether or not the .so builds.  scratch backs the
@@ -405,6 +441,10 @@ struct JLine {
       // categorical interning keeps the raw token for integral literals
       v.is_int = integral;
       v.raw.assign(p, tlen);
+      // python str(json.loads("-0")) is "0" (int parse), not the raw
+      // token — "-0" is the only integral JSON literal whose str differs
+      // from its spelling (leading zeros are invalid JSON)
+      if (integral && v.raw == "-0") v.raw = "0";
       p = q;
       return true;
     }
@@ -819,7 +859,8 @@ SgioTable* sgio_read_json(const char* path, int64_t shard_index,
                 c.codes.push_back(c.intern(v.str.data(), v.str.size()));
               } else {
                 double d;
-                if (parse_double(v.str.data(), v.str.size(), &d)) {
+                // python-float lexing: the twin coerces with float(str)
+                if (py_float_parse(v.str.data(), v.str.size(), &d)) {
                   c.nums.push_back(d);
                 } else {
                   t->error = "could not convert string to float: '" + v.str +

@@ -54,10 +54,13 @@ class NumericConfig:
         read per pass, the dominant cost at large n) until the relative
         deviance change flattens below ``bf16_switch_tol``, then
         warm-starts float32 passes to the exact fixed point.  The FINAL
-        iterations (and everything reported) are full f32: coefficients
-        match the plain fused engine at its normal tolerance.  Costs one
-        extra bf16 copy of X in HBM (1.5x design memory).  Off by default
-        pending the v5e timing capture (benchmarks/proto_bf16_master.py).
+        iterations (and everything reported) are full f32.  MEASURED on a
+        real v5e-class chip (benchmarks/BF16_DECISION_r05.md): the fused
+        pass is VPU/MXU-bound, not HBM-bound, so the schedule buys NO
+        speed there (0.90x end-to-end; coefficients ~8e-6 off the plain
+        engine at 2M x 512) — it stays opt-in as a MEMORY lever (a bf16
+        master copy halves the bytes a resident warm-up phase reads and
+        can hold), not a speed lever.
       bf16_switch_tol: relative |ddev| at which the warm-up hands over
         (default 1e-4 ~ the bf16 storage-rounding deviance floor).
     """

@@ -117,6 +117,8 @@ def scan_json_schema(path: str, *, chunk_bytes: int | None = None,
     for the Python fallback ``chunk_bytes`` bounds peak memory (slices
     scanned independently, kinds merged — categorical anywhere wins, like
     ``scan_csv_schema``)."""
+    from .io import resolve_gz
+    path = resolve_gz(path, 0, 1, "scan_json_schema")
     lib = _native_lib(native)
     if lib is not None:
         h = _native_call(lib, path, 0, 1, None, schema_only=True)
@@ -144,6 +146,8 @@ def scan_json_levels(path: str, *, chunk_bytes: int | None = None,
     ``chunk_bytes`` bounds peak memory; shards read through
     :func:`read_json` (native C++ parser when built), pruned to the
     categorical columns."""
+    from .io import resolve_gz
+    path = resolve_gz(path, 0, 1, "scan_json_levels")
     if schema is None:
         schema = scan_json_schema(path, chunk_bytes=chunk_bytes,
                                   native=native)
@@ -190,7 +194,8 @@ def read_json(path: str, *, shard_index: int = 0, num_shards: int = 1,
     if num_shards < 1 or not (0 <= shard_index < num_shards):
         raise ValueError(
             f"need 0 <= shard_index < num_shards, got {shard_index}/{num_shards}")
-    from .io import native_table_columns
+    from .io import native_table_columns, resolve_gz
+    path = resolve_gz(path, shard_index, num_shards, "read_json")
     lib = _native_lib(native)
     if lib is not None:
         h = _native_call(lib, path, shard_index, num_shards, schema,

@@ -43,6 +43,13 @@ from .frame import as_columns, is_categorical
 INTERCEPT_NAME = "intercept"
 
 
+class MarginalityError(ValueError):
+    """A factor interaction's lower-order margin is missing from the model
+    (R would silently switch the factor's contrast coding; this framework
+    demands the margin instead).  A dedicated type so callers like add1 can
+    recognize the condition STRUCTURALLY, never by error-message text."""
+
+
 @dataclasses.dataclass(frozen=True)
 class Terms:
     """Fitted design-matrix recipe (the reference's xnames + the level maps
@@ -230,7 +237,7 @@ def build_terms(data, columns=None, *, intercept: bool = False,
                 rest = [c for c in comps if c != f]
                 for req in ([":".join(rest)] if rest else []) + [f]:
                     if frozenset(req.split(":")) not in present:
-                        raise ValueError(
+                        raise MarginalityError(
                             f"interaction {':'.join(comps)} involves factor "
                             f"{f!r} but the model is missing the term "
                             f"{req!r}; add it (R changes the factor's "

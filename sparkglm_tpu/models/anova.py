@@ -63,17 +63,47 @@ def _is_lm(m) -> bool:
     return hasattr(m, "sse")
 
 
-def anova(*models, test: str | None = None) -> AnovaTable:
-    """R's ``anova(m1, m2, ...)`` for fitted models on the same data.
+def _is_fitted_model(obj) -> bool:
+    from .glm import GLMModel
+    from .lm import LMModel
+    return isinstance(obj, (LMModel, GLMModel))
 
+
+def anova(*models, test: str | None = None, data=None, weights=None,
+          offset=None, m=None, **fit_kw) -> AnovaTable:
+    """R's ``anova``: multi-model comparison, or the single-model
+    sequential (Type-I) table.
+
+    ``anova(m1, m2, ...)`` compares fitted models on the same data.
     ``test``: None (no p-values), ``"Chisq"`` (deviance chi-square; the
     difference is scaled by the largest model's dispersion for families
     with estimated dispersion) or ``"F"``.
+
+    ``anova(model, data)`` (R's ``anova(fit)`` — models here do not retain
+    their data) builds R's analysis-of-variance / analysis-of-deviance
+    table with terms added sequentially in formula order, riding the same
+    refit machinery as :func:`drop1`; ``weights``/``offset``/``m`` follow
+    drop1's carry rules.
     """
-    if len(models) < 2:
+    if not models:
+        raise ValueError("anova needs a fitted model")
+    if len(models) == 2 and not _is_fitted_model(models[1]):
+        # anova(model, data) positional form (a POSITIVE model test —
+        # attribute sniffing would misfire on a DataFrame whose columns
+        # happen to be named like model fields)
+        models, data = models[:1], models[1]
+    if len(models) == 1:
+        if data is None:
+            raise ValueError(
+                "models do not retain training data: single-model "
+                "sequential anova needs it — anova(model, data)")
+        return _anova_sequential(models[0], data, test=test, weights=weights,
+                                 offset=offset, m=m, fit_kw=fit_kw)
+    if data is not None or weights is not None or offset is not None \
+            or m is not None or fit_kw:
         raise ValueError(
-            "anova needs at least two fitted models (single-model "
-            "sequential tables require the data; use drop1(model, data))")
+            "data/weights/offset/m only apply to the single-model "
+            "sequential form anova(model, data)")
     if test not in (None, "Chisq", "F"):
         raise ValueError(f"test must be None, 'Chisq' or 'F', got {test!r}")
     kinds = {_is_lm(m) for m in models}
@@ -156,6 +186,118 @@ def anova(*models, test: str | None = None) -> AnovaTable:
                       tuple(cols), names, tuple(rows))
 
 
+def _anova_sequential(model, data, *, test, weights, offset, m,
+                      fit_kw) -> AnovaTable:
+    """R's single-model ``anova(fit)``: terms added sequentially (first to
+    last).  LMs get anova.lm's Df / Sum Sq / Mean Sq / F value / Pr(>F)
+    table (F against the FULL model's scale, always present, as in R);
+    GLMs get anova.glm's NULL-first analysis-of-deviance table with
+    optional ``test="Chisq"``/``"F"`` columns (dispersion of the full
+    model).  Sequential sub-fits ride the drop1 refit machinery; the full
+    row is the model itself (no refit)."""
+    if model.terms is None:
+        raise ValueError(
+            "anova(model, data) needs a formula-fitted model "
+            "(model.terms is None)")
+    if test not in (None, "Chisq", "F"):
+        raise ValueError(f"test must be None, 'Chisq' or 'F', got {test!r}")
+    is_lm = _is_lm(model)
+    refit = _make_refitter(model, data, weights=weights, offset=offset, m=m,
+                           caller="anova", fit_kw=fit_kw)
+    all_terms = [":".join(t) for t in model.terms.design]
+    if not all_terms:
+        raise ValueError("the model has no terms beyond the intercept")
+    # prefix fits 1..T-1 (the 0-prefix comes from the model's own null
+    # stats; the T-prefix IS the model)
+    prefix = [refit(all_terms[:k]) for k in range(1, len(all_terms))]
+    prefix.append(model)
+
+    def _check_rows(sub):
+        # a sub-fit dropping fewer NA rows than the full model (its formula
+        # omits the NA-carrying covariates) would silently corrupt every
+        # sequential difference — the null baseline included (review r5)
+        if sub.n_obs != model.n_obs:
+            raise ValueError(
+                f"number of rows in use changed in a sequential sub-fit "
+                f"({model.n_obs} -> {sub.n_obs}): remove missing values "
+                "before anova")
+
+    for sub in prefix[:-1]:
+        _check_rows(sub)
+
+    if is_lm:
+        # anova.lm: no NULL row; Residuals last; F always reported.  The
+        # 0-prefix baseline comes from an explicit null refit when there is
+        # an intercept (exact under offsets too); the no-intercept baseline
+        # is the raw sum of squares the model already carries
+        if model.has_intercept:
+            null_fit = refit([])
+            _check_rows(null_fit)
+            df0, sse0 = null_fit.df_resid, float(null_fit.sse)
+        else:
+            df0, sse0 = model.n_obs, float(model.sst)
+        s2 = model.sse / model.df_resid
+        cols = ["Df", "Sum Sq", "Mean Sq", "F value", "Pr(>F)"]
+        rows = []
+        prev_df, prev_sse = df0, sse0
+        for sub in prefix:
+            ddf = prev_df - sub.df_resid
+            dss = prev_sse - sub.sse
+            if ddf > 0:
+                fstat = (dss / ddf) / s2
+                rows.append((int(ddf), float(dss), float(dss / ddf),
+                             float(fstat),
+                             float(scipy.stats.f.sf(fstat, ddf,
+                                                    model.df_resid))))
+            else:  # fully aliased term: R drops the row; keep a 0-df stub
+                rows.append((0, float(dss), None, None, None))
+            prev_df, prev_sse = sub.df_resid, sub.sse
+        rows.append((int(model.df_resid), float(model.sse), float(s2),
+                     None, None))
+        return AnovaTable(
+            "Analysis of Variance Table",
+            f"Response: {model.yname}",
+            tuple(cols), tuple(all_terms) + ("Residuals",), tuple(rows))
+
+    disp = float(model.dispersion)
+    cols = ["Df", "Deviance", "Resid. Df", "Resid. Dev"]
+    if test == "Chisq":
+        cols.append("Pr(>Chi)")
+    elif test == "F":
+        cols += ["F", "Pr(>F)"]
+    pad = (len(cols) - 4) * (None,)
+    rows = [(None, None, int(model.df_null), float(model.null_deviance))
+            + pad]
+    row_names = ["NULL"]
+    prev_df, prev_dev = model.df_null, float(model.null_deviance)
+    for nm, sub in zip(all_terms, prefix):
+        ddf = prev_df - sub.df_residual
+        ddev = prev_dev - sub.deviance
+        row = [int(ddf), float(ddev), int(sub.df_residual),
+               float(sub.deviance)]
+        if ddf > 0:
+            if test == "Chisq":
+                row.append(float(scipy.stats.chi2.sf(
+                    max(ddev, 0.0) / disp, ddf)))
+            elif test == "F" and disp > 0 and model.df_residual > 0:
+                fstat = (ddev / ddf) / disp
+                row += [float(fstat),
+                        float(scipy.stats.f.sf(fstat, ddf,
+                                               model.df_residual))]
+            else:
+                row += list(pad)
+        else:
+            row += list(pad)
+        rows.append(tuple(row))
+        row_names.append(nm)
+        prev_df, prev_dev = sub.df_residual, float(sub.deviance)
+    heading = (f"Model: {model.family}, link: {model.link}\n\n"
+               f"Response: {model.yname}\n\n"
+               "Terms added sequentially (first to last)")
+    return AnovaTable("Analysis of Deviance Table", heading,
+                      tuple(cols), tuple(row_names), tuple(rows))
+
+
 def _aic_lm(n: int, m, k: float = 2.0) -> float:
     """R's stats:::extractAIC.lm scale: n*log(RSS/n) + k*edf (constants
     dropped — only differences matter in drop1/add1/step tables)."""
@@ -170,33 +312,21 @@ def _droppable_terms(design) -> list:
             if not any(s < s2 for s2 in sets)]
 
 
-def drop1(model, data, *, test: str | None = None, weights=None,
-          offset=None, m=None, **fit_kw) -> AnovaTable:
-    """R's ``drop1``: refit without each droppable term.
-
-    Needs the training ``data`` (models do not retain it).  Reports the
-    reduced fits' Deviance and AIC; ``test="Chisq"`` adds the
-    dispersion-scaled LRT and its p-value.  ``weights``/``offset``/``m``
-    and extra fit kwargs are forwarded to the refits; by-name fit-time
-    offset/weights/m columns stored on the model are applied
-    automatically, and array-valued ones must be re-passed (refusing
-    beats silently deflating every LRT).
-    """
+def _make_refitter(model, data, *, weights, offset, m, caller, fit_kw):
+    """The shared refit closure of :func:`drop1` and single-model
+    :func:`anova`: carries by-name fit-time weights/offset/m, refuses
+    unrecoverable array offsets, and streams PATH data per refit.
+    Returns ``refit(term_strings) -> fitted model``."""
     from .. import api
     from ..data.frame import as_columns
 
-    if model.terms is None:
-        raise ValueError(
-            "drop1 needs a formula-fitted model (model.terms is None)")
-    if test not in (None, "Chisq"):
-        raise ValueError(f"test must be None or 'Chisq', got {test!r}")
     is_lm = _is_lm(model)
     data_is_path = api._is_path(data)
-    weights = api._carry_fit_arg(model, "weights", weights, "drop1")
-    m = api._carry_fit_arg(model, "m", m, "drop1")
+    weights = api._carry_fit_arg(model, "weights", weights, caller)
+    m = api._carry_fit_arg(model, "m", m, caller)
     if data_is_path and m is not None:
         raise ValueError(
-            "from-CSV drop1 expresses group sizes with a "
+            f"from-CSV {caller} expresses group sizes with a "
             "cbind(successes, failures) response, not m=")
     if offset is None:
         offset = getattr(model, "offset_col", None)
@@ -208,9 +338,9 @@ def drop1(model, data, *, test: str | None = None, weights=None,
             # recovered from the data, and refitting without it would
             # silently inflate every LRT
             raise ValueError(
-                "model was fit with an array offset; pass offset= to drop1 "
-                "(or fit with the offset as a named column so it travels "
-                "with the model)")
+                f"model was fit with an array offset; pass offset= to "
+                f"{caller} (or fit with the offset as a named column so it "
+                "travels with the model)")
 
     # path data: every refit streams the file (VERDICT r2 missing #4);
     # offsets ride the refit formula as offset() terms, since only named
@@ -219,7 +349,7 @@ def drop1(model, data, *, test: str | None = None, weights=None,
     if data_is_path:
         if offset is not None and not isinstance(offset, (str, tuple, list)):
             raise ValueError(
-                "from-CSV drop1 needs offset as a column name (arrays "
+                f"from-CSV {caller} needs offset as a column name (arrays "
                 "cannot align with file chunks)")
         off_names = ([offset] if isinstance(offset, str)
                      else list(offset) if offset is not None else [])
@@ -242,6 +372,30 @@ def drop1(model, data, *, test: str | None = None, weights=None,
         return api.glm(formula, data, family=model.family, link=model.link,
                        weights=weights, offset=offset, m=m, tol=model.tol,
                        **fit_kw)
+
+    return refit
+
+
+def drop1(model, data, *, test: str | None = None, weights=None,
+          offset=None, m=None, **fit_kw) -> AnovaTable:
+    """R's ``drop1``: refit without each droppable term.
+
+    Needs the training ``data`` (models do not retain it).  Reports the
+    reduced fits' Deviance and AIC; ``test="Chisq"`` adds the
+    dispersion-scaled LRT and its p-value.  ``weights``/``offset``/``m``
+    and extra fit kwargs are forwarded to the refits; by-name fit-time
+    offset/weights/m columns stored on the model are applied
+    automatically, and array-valued ones must be re-passed (refusing
+    beats silently deflating every LRT).
+    """
+    if model.terms is None:
+        raise ValueError(
+            "drop1 needs a formula-fitted model (model.terms is None)")
+    if test not in (None, "Chisq"):
+        raise ValueError(f"test must be None or 'Chisq', got {test!r}")
+    is_lm = _is_lm(model)
+    refit = _make_refitter(model, data, weights=weights, offset=offset, m=m,
+                           caller="drop1", fit_kw=fit_kw)
 
     all_terms = [":".join(t) for t in model.terms.design]
     dropped_names = [":".join(t) for t in _droppable_terms(model.terms.design)]
@@ -331,17 +485,17 @@ def add1(model, scope, data, *, test: str | None = None,
         raise ValueError(f"scope {scope!r} adds no terms beyond the model")
 
     def refit(term):
+        from ..data.model_matrix import MarginalityError
         try:
             sub = api.update(model, f"~ . + {term}", data, **fit_kw)
-        except ValueError as e:
-            if "margin" in str(e) or "missing the term" in str(e):
-                # the framework refuses non-marginal designs (R silently
-                # changes contrast coding instead); surface WHICH candidate
-                raise ValueError(
-                    f"add1 candidate {term!r} needs its marginal terms in "
-                    f"the model first ({e}); add the margins to the model "
-                    "or drop the interaction from the scope") from None
-            raise
+        except MarginalityError as e:
+            # the dedicated type (never message text — an unrelated error
+            # must keep its own traceback): surface WHICH candidate, and
+            # note only FACTOR interactions need margins present
+            raise ValueError(
+                f"add1 candidate {term!r} needs its marginal terms in "
+                f"the model first ({e}); add the margins to the model "
+                "or drop the interaction from the scope") from e
         # R's add1/drop1 refuse comparisons across different row sets (a
         # candidate column's NAs would shrink the refit sample, mixing the
         # term effect with row removal in every statistic)
@@ -474,11 +628,42 @@ def step(model, data, *, scope: str | None = None, direction: str = "both",
     if direction == "forward" and not scope_keys:
         raise ValueError("direction='forward' needs a scope of candidates")
 
+    is_lm = _is_lm(model)
+
+    def _move_table(evals, cur_aic):
+        """R's per-step move table: one row per candidate plus <none>,
+        sorted by AIC ascending (R's print of the drop1/add1 frame) —
+        lm on the Df / Sum of Sq / RSS / AIC scale, glm on
+        Df / Deviance / AIC."""
+        rows = []
+        if is_lm:
+            cols = ("Df", "Sum of Sq", "RSS", "AIC")
+            rows.append(("<none>", (None, None, float(current.sse),
+                                    cur_aic)))
+            for sign, term, cand, a in evals:
+                rows.append((f"{sign} {term}",
+                             (int(abs(current.df_resid - cand.df_resid)),
+                              float(abs(current.sse - cand.sse)),
+                              float(cand.sse), a)))
+        else:
+            cols = ("Df", "Deviance", "AIC")
+            rows.append(("<none>", (None, float(current.deviance), cur_aic)))
+            for sign, term, cand, a in evals:
+                rows.append((f"{sign} {term}",
+                             (int(abs(current.df_residual
+                                      - cand.df_residual)),
+                              float(cand.deviance), a)))
+        rows.sort(key=lambda r: r[1][-1])
+        return AnovaTable("", "", cols,
+                          tuple(nm for nm, _ in rows),
+                          tuple(r for _, r in rows))
+
     current = model
     cur_aic = _step_aic(current, k)
+    if trace:
+        print(f"Start:  AIC={cur_aic:.2f}")
+        print(f"{current.formula}\n")
     for _ in range(int(steps)):
-        if trace:
-            print(f"Step:  AIC={cur_aic:.2f}\n{current.formula}")
         term_keys = {frozenset(canonical_component(c) for c in t)
                      for t in current.terms.design}
         moves: list = []  # ("+"/"-" , term)
@@ -501,6 +686,7 @@ def step(model, data, *, scope: str | None = None, direction: str = "both",
                     continue
                 moves.append(("+", term))
         best = None
+        evals = []
         for sign, term in moves:
             cand = api.update(current, f"~ . {sign} {term}", data, **fit_kw)
             if cand.n_obs != current.n_obs:
@@ -509,11 +695,16 @@ def step(model, data, *, scope: str | None = None, direction: str = "both",
                     f"({current.n_obs} -> {cand.n_obs}): remove missing "
                     "values before step")
             a = _step_aic(cand, k)
-            if trace:
-                print(f"  {sign} {term:<24} AIC={a:.2f}")
+            evals.append((sign, term, cand, a))
             if best is None or a < best[0]:
                 best = (a, sign, term, cand)
+        if trace and evals:
+            # the table body without the empty title/heading/spacer lines
+            print("\n".join(str(_move_table(evals, cur_aic)).split("\n")[3:]))
         if best is None or best[0] >= cur_aic - 1e-10:
             break
         cur_aic, _, _, current = best
+        if trace:
+            print(f"\nStep:  AIC={cur_aic:.2f}")
+            print(f"{current.formula}\n")
     return current

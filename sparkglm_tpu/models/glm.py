@@ -308,6 +308,7 @@ def _irls_fused_kernel(
     warm: bool = False,
     it_base=None,
     dev_prev=None,
+    fam_param=None,
 ):
     """IRLS where each iteration's data touch is ONE fused pass over X
     (ops/fused.py): eta, mu, z, w, Gramian and deviance per row block, then a
@@ -338,20 +339,31 @@ def _irls_fused_kernel(
     valid = wt > 0
     pass_fn = fused_fisher_pass if use_pallas else fused_fisher_pass_ref
 
+    # the traced family scalar (negbin theta) enters the shard_map as an
+    # explicit replicated operand — closures over traced values are not
+    # part of shard_map's contract.  Parameterless families pass a dummy
+    # zero that neither twin reads (has_param=False below).
+    has_param = fam_param is not None
+    fp_arr = (jnp.asarray(fam_param, bdt) if has_param
+              else jnp.zeros((), bdt))
+
     def spmd_pass(first):
-        def f(Xs, ys, ws, os_, beta):
+        def f(Xs, ys, ws, os_, beta, fp):
             XtWX, XtWz, dev = pass_fn(Xs, ys, ws, os_, beta, family=family,
                                       link=link, first=first,
                                       block_rows=block_rows,
-                                      precision=precision)
+                                      precision=precision,
+                                      fam_param=fp if has_param else None)
             return (jax.lax.psum(XtWX, meshlib.DATA_AXIS),
                     jax.lax.psum(XtWz, meshlib.DATA_AXIS),
                     jax.lax.psum(dev, meshlib.DATA_AXIS))
         d = meshlib.DATA_AXIS
-        return jax.shard_map(
+        fn = jax.shard_map(
             f, mesh=mesh,
-            in_specs=(P(d, None), P(d), P(d), P(d), P()),
+            in_specs=(P(d, None), P(d), P(d), P(d), P(), P()),
             out_specs=(P(), P(), P()), check_vma=False)
+        return lambda Xs, ys, ws, os_, beta: fn(Xs, ys, ws, os_, beta,
+                                                fp_arr)
 
     def solve(XtWX, XtWz, beta_prev, fac_prev):
         beta, cho = solve_normal(XtWX, XtWz, jitter=jitter,
@@ -768,12 +780,7 @@ def _fit_global(
         big = n_global * p * p > (1 << 31)
         engine = ("fused" if on_tpu and big and dtype == jnp.float32
                   and config.matmul_precision is None and p <= 1024
-                  and not model_par
-                  and fam.param is None else "einsum")
-    if engine == "fused" and fam.param is not None:
-        raise ValueError(
-            "parametric families (negative binomial) need the einsum "
-            "engine (the Mosaic kernel takes no traced family parameter)")
+                  and not model_par else "einsum")
     if engine == "fused" and model_par:
         raise ValueError(
             "engine='fused' does not support a sharded feature axis")
@@ -805,6 +812,7 @@ def _fit_global(
                 beta0=jnp.asarray(np.asarray(beta_arr), dtype), warm=warm,
                 it_base=jnp.asarray(it_base, jnp.int32),
                 dev_prev=None if dev_prev is None else jnp.asarray(dev_prev),
+                fam_param=fam_param,
             )
     else:
         def run_kernel(seg_iters, beta_arr, warm, it_base=0, dev_prev=None):
@@ -1002,6 +1010,12 @@ def fit(
                 "global-array fits use the einsum or fused engine")
         if mesh is None:
             raise ValueError("pass the global mesh the arrays are sharded on")
+        if config.bf16_warmup:
+            import warnings
+            warnings.warn(
+                "bf16_warmup is not implemented on the global-array "
+                "multi-process path; running full-precision passes",
+                stacklevel=2)
         return _fit_global(X, y, weights, offset, fam, lnk, tol, max_iter,
                            criterion, xnames, yname, has_intercept, mesh,
                            verbose, config, beta0=beta0,
@@ -1088,7 +1102,6 @@ def fit(
                   and config.matmul_precision is None
                   and not shard_features and mesh.shape[meshlib.MODEL_AXIS] == 1
                   and p <= 1024
-                  and fam.param is None  # Mosaic kernel takes no traced param
                   else "einsum")
     if engine not in ("einsum", "fused", "qr"):
         raise ValueError(
@@ -1097,6 +1110,22 @@ def fit(
                                       or mesh.shape[meshlib.MODEL_AXIS] != 1):
         raise ValueError(
             f"engine={engine!r} does not support a sharded feature axis")
+    if config.bf16_warmup and not (
+            engine == "fused" and dtype == np.float32
+            and criterion == "relative" and not checkpointing):
+        # the schedule exists only on the resident fused f32 relative-
+        # criterion path; anywhere else it would be a SILENT no-op — the
+        # multi-hour checkpointed fits it targets most would quietly lose
+        # it (review r4)
+        import warnings
+        warnings.warn(
+            "bf16_warmup is set but this fit cannot honour it "
+            f"(engine={engine!r}, dtype={np.dtype(dtype).name}, "
+            f"criterion={criterion!r}"
+            + (", checkpointing" if checkpointing else "") +
+            "); running full-precision passes — the schedule needs the "
+            "fused float32 engine with criterion='relative' and no "
+            "checkpointing", stacklevel=2)
     # the qr engine's corrected-seminormal solve already delivers the
     # polish's ~eps*kappa accuracy every iteration — skip the redundant TSQR
     polish_active = config.polish == "csne" and engine != "qr"
@@ -1128,10 +1157,6 @@ def fit(
     dev_dtype = jnp.float32 if not use_f64 else jnp.float64
     tol_run = effective_tol(tol, criterion, dev_dtype)
     tol_dev = jnp.asarray(tol_run, dev_dtype)
-    if engine == "fused" and fam.param is not None:
-        raise ValueError(
-            "parametric families (negative binomial) need the einsum or qr "
-            "engine (the Mosaic kernel takes no traced family parameter)")
     fam_param = fam.param_operand(dtype)
     if engine == "fused":
         def run_kernel(seg_iters, beta_arr, warm, it_base=0, dev_prev=None):
@@ -1149,6 +1174,7 @@ def fit(
                 beta0=jnp.asarray(beta_arr, dtype), warm=warm,
                 it_base=jnp.asarray(it_base, jnp.int32),
                 dev_prev=None if dev_prev is None else jnp.asarray(dev_prev),
+                fam_param=fam_param,
             )
         if checkpointing:
             out = _segmented_irls(run_kernel, p=p, dtype=dtype,
@@ -1175,7 +1201,8 @@ def fit(
                 refine_steps=config.refine_steps,
                 mesh=mesh, block_rows=block_rows,
                 use_pallas=on_tpu and p <= 1024,
-                trace=verbose, precision=config.matmul_precision)
+                trace=verbose, precision=config.matmul_precision,
+                fam_param=fam_param)
             it1 = int(np.asarray(warm_out["iters"]))
             if it1 >= int(max_iter):
                 # warm-up spent the whole budget: honour max_iter (no

@@ -636,6 +636,7 @@ def lm_fit_streaming(
     dtype = None
     ones_mask = None
     saw_offset = False
+    saw_weights = False
     n = 0
     err = None
     try:
@@ -649,6 +650,10 @@ def lm_fit_streaming(
             from .validate import check_finite_vector
             check_finite_vector("y", np.asarray(yc, np.float64))
             if wc is not None:
+                # has_weights records that the CALL supplied weights (the
+                # lm.py contract update()/logLik rely on), NOT whether the
+                # values happen to differ from 1 (review r4)
+                saw_weights = True
                 check_finite_vector("weights", np.asarray(wc, np.float64))
             if oc is not None:
                 check_finite_vector("offset", np.asarray(oc, np.float64))
@@ -689,7 +694,7 @@ def lm_fit_streaming(
         flat = np.concatenate(
             [np.ravel(acc["XtWX"]), np.ravel(acc["XtWy"]),
              [acc["sw"], acc["swy"], acc["n_ok"], float(n),
-              float(saw_offset)],
+              float(saw_offset), float(saw_weights)],
              (np.ones(p) if ones_mask is None else ones_mask.astype(np.float64))])
         tot = dist.allsum_f64(flat)
         acc["XtWX"] = tot[:p * p].reshape(p, p)
@@ -698,8 +703,9 @@ def lm_fit_streaming(
         acc["sw"], acc["swy"], acc["n_ok"] = tot[base], tot[base + 1], tot[base + 2]
         n = int(tot[base + 3])
         saw_offset = bool(tot[base + 4] > 0)  # any process saw an offset
+        saw_weights = bool(tot[base + 5] > 0)  # any process got weights
         if ones_mask is not None:
-            ones_mask = tot[base + 5:] == nproc
+            ones_mask = tot[base + 6:] == nproc
     if xnames is None:
         xnames = tuple(f"x{i}" for i in range(p))
     xnames = tuple(xnames)
@@ -844,7 +850,7 @@ def lm_fit_streaming(
         has_intercept=bool(has_intercept),
         n_shards=mesh.shape[meshlib.DATA_AXIS], cov_unscaled=None,
         has_offset=bool(saw_offset),
-        has_weights=bool(np.isfinite(w_lo) and (w_lo != 1.0 or w_hi != 1.0)),
+        has_weights=bool(saw_weights),
         weights_vary=bool(weights_vary),
         resid_quantiles=resid_q)
 

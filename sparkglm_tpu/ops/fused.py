@@ -111,9 +111,16 @@ def _step_math(X, y, wt, off, beta_row, *, family, link, first):
     return X * w, z, w, dev
 
 
-def _fisher_kernel(x_ref, y_ref, wt_ref, off_ref, beta_ref,
-                   xtwx_ref, xtwz_ref, dev_ref, *, family, link, first,
-                   precision):
+def _fisher_kernel(x_ref, y_ref, wt_ref, off_ref, beta_ref, *rest,
+                   family, link, first, precision, has_param):
+    if has_param:
+        # parametric family (negbin theta): the scalar rides in SMEM as a
+        # TRACED operand, so one compiled kernel serves the whole theta
+        # search (families hash equal across param values)
+        param_ref, xtwx_ref, xtwz_ref, dev_ref = rest
+        family = family.with_param(param_ref[0, 0])
+    else:
+        xtwx_ref, xtwz_ref, dev_ref = rest
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -142,20 +149,24 @@ def _fisher_kernel(x_ref, y_ref, wt_ref, off_ref, beta_ref,
                                    "interpret", "precision"))
 def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
                       first: bool = False, block_rows: int = 512,
-                      interpret: bool = False, precision=None):
+                      interpret: bool = False, precision=None,
+                      fam_param=None):
     """One fused IRLS data pass over a *local* (unsharded) row block.
 
     Args:
       X: (n, p) float32, n divisible by ``block_rows`` (pad with wt=0 rows).
       y/wt/offset: (n,) per-row vectors; padding rows must have wt == 0.
       beta: (p,) current coefficients (ignored when ``first``).
+      fam_param: TRACED scalar family parameter (negbin theta) — rides the
+        kernel as a (1, 1) SMEM operand, so glm.nb's whole theta search
+        reuses ONE compiled kernel (the family hash excludes the value).
     Returns:
       (XtWX (p,p), XtWz (p,), dev ()) — local sums; psum across data shards.
     """
-    if getattr(family, "param", None) is not None:
+    if getattr(family, "param", None) is not None and fam_param is None:
         raise ValueError(
-            "the Mosaic kernel takes no traced family parameter; use the "
-            "einsum engine (or the XLA twin) for parametric families")
+            f"family {family.name!r} is parametric; pass its traced "
+            "parameter (fam_param=family.param_operand(...)) to the kernel")
     n, p = X.shape
     if n % block_rows:
         raise ValueError(f"n={n} must be a multiple of block_rows={block_rows}")
@@ -164,19 +175,27 @@ def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
     itemsize = X.dtype.itemsize
     yc, wc, oc = (a.reshape(n, 1) for a in (y, wt, offset))
     bc = beta.reshape(1, p)
+    has_param = fam_param is not None
     kern = partial(_fisher_kernel, family=family, link=link, first=first,
-                   precision=resolve_kernel_precision(precision))
+                   precision=resolve_kernel_precision(precision),
+                   has_param=has_param)
     vec = lambda: pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((block_rows, p), lambda i: (i, 0),
+                     memory_space=pltpu.VMEM),
+        vec(), vec(), vec(),
+        pl.BlockSpec((1, p), lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    operands = [X, yc, wc, oc, bc]
+    if has_param:
+        in_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0),
+                                     memory_space=pltpu.SMEM))
+        operands.append(jnp.reshape(jnp.asarray(fam_param, acc), (1, 1)))
     XtWX, XtWz, dev = pl.pallas_call(
         kern,
         grid=(n // block_rows,),
-        in_specs=[
-            pl.BlockSpec((block_rows, p), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            vec(), vec(), vec(),
-            pl.BlockSpec((1, p), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((p, p), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, p), lambda i: (0, 0), memory_space=pltpu.VMEM),
@@ -193,7 +212,7 @@ def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
             transcendentals=4 * n,
         ),
         interpret=interpret,
-    )(X, yc, wc, oc, bc)
+    )(*operands)
     return XtWX, XtWz[0, :], dev[0, 0]
 
 

@@ -153,3 +153,15 @@ show_influence("gaussian_weighted",
 
 d <- fc$lm_D9_factor$data
 show_influence("lm_D9_factor", lm(d$weight ~ factor(d$group)))
+
+# ---------------------------------------------------------------------------
+# single-model sequential anova (round 5): verify against the framework's
+# anova(model, data) tables for the two documentation fixtures.
+# ---------------------------------------------------------------------------
+
+cat("== anova dobson_poisson\n")
+print(anova(glm(counts ~ outcome + treatment, family = poisson()),
+            test = "Chisq"))
+d <- fc$lm_D9_factor$data
+cat("== anova lm_D9\n")
+print(anova(lm(d$weight ~ factor(d$group))))

@@ -75,8 +75,8 @@ def test_anova_lm(rng):
 
 def test_anova_validation(pois_data, rng):
     m1 = sg.glm("y ~ x", pois_data, family="poisson")
-    with pytest.raises(ValueError, match="at least two"):
-        sg.anova(m1)
+    with pytest.raises(ValueError, match="sequential anova needs it"):
+        sg.anova(m1)  # single-model form without the data
     d2 = {"x": rng.normal(size=100), "y": np.ones(100)}
     m_other = sg.lm("y ~ x", d2)
     with pytest.raises(TypeError, match="mix"):
@@ -342,3 +342,206 @@ def test_step_scope_dot_allows_reentry_and_minus_rejected(rng, mesh8):
     sel2 = sg.step(sg.glm("y ~ x1", data, family="poisson", mesh=mesh8),
                    data, scope="~ . + x2 + x1:x2")
     assert "x1:x2" not in sel2.xnames or "x2" in sel2.xnames
+
+
+# ---------------------------------------------------------------------------
+# single-model sequential anova (R's anova(fit)) — round 5
+# ---------------------------------------------------------------------------
+
+def _dobson_data():
+    counts = [18.0, 17, 15, 20, 10, 20, 25, 13, 12]
+    return {"counts": np.array(counts),
+            "outcome": [str(1 + i % 3) for i in range(9)],
+            "treatment": [str(1 + i // 3) for i in range(9)]}
+
+
+def test_anova_single_glm_dobson_golden():
+    """R's own ?glm example prints anova(glm.D93): the NULL / outcome /
+    treatment rows with deviances 10.5814 -> 5.1291.  Sequential values are
+    cross-checked against the independent oracle IRLS."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
+    from oracle import irls_np
+    from sparkglm_tpu.config import NumericConfig
+
+    d = _dobson_data()
+    m = sg.glm("counts ~ outcome + treatment", d, family="poisson",
+               config=NumericConfig(dtype="float64"), tol=1e-12)
+    t = sg.anova(m, d, test="Chisq")
+    assert t.columns == ("Df", "Deviance", "Resid. Df", "Resid. Dev",
+                         "Pr(>Chi)")
+    assert t.row_names == ("NULL", "outcome", "treatment")
+    # R's printed table: NULL 8 10.5814; outcome 2 ... 6 5.1291 (treatment
+    # adds nothing); treatment 2 ... 4 5.1291
+    assert t.rows[0][2] == 8
+    np.testing.assert_allclose(t.rows[0][3], 10.5814, atol=5e-5)
+    assert t.rows[1][0] == 2 and t.rows[1][2] == 6
+    assert t.rows[2][0] == 2 and t.rows[2][2] == 4
+    np.testing.assert_allclose(t.rows[2][3], 5.1291, atol=5e-5)
+    # oracle cross-check of the outcome-only sub-fit deviance
+    y = d["counts"]
+    o = np.tile([(0, 0), (1, 0), (0, 1)], (3, 1))
+    Xo = np.column_stack([np.ones(9), o])
+    from oracle import irls_np as _ir
+    import numpy as _np
+    beta, dev, *_ = _ir(Xo, y, "poisson", "log", wt=_np.ones(9),
+                        offset=_np.zeros(9), tol=1e-13, max_iter=200)
+    np.testing.assert_allclose(t.rows[1][3], dev, rtol=1e-7)
+    np.testing.assert_allclose(t.rows[1][1], 10.581446 - dev, atol=5e-5)
+    s = str(t)
+    assert "Terms added sequentially (first to last)" in s
+    assert "Model: poisson, link: log" in s and "Response: counts" in s
+
+
+def test_anova_single_lm_D9_golden():
+    """R's ?lm plant-weight example: anova(lm.D9) has group F = 1.4191,
+    p = 0.249 (the same F the documented summary prints)."""
+    from sparkglm_tpu.config import NumericConfig
+    ctl = [4.17, 5.58, 5.18, 6.11, 4.50, 4.61, 5.17, 4.53, 5.33, 5.14]
+    trt = [4.81, 4.17, 4.41, 3.59, 5.87, 3.83, 6.03, 4.89, 4.32, 4.69]
+    d = {"weight": np.array(ctl + trt),
+         "group": ["Ctl"] * 10 + ["Trt"] * 10}
+    m = sg.lm("weight ~ group", d, config=NumericConfig(dtype="float64"))
+    t = sg.anova(m, d)
+    assert t.columns == ("Df", "Sum Sq", "Mean Sq", "F value", "Pr(>F)")
+    assert t.row_names == ("group", "Residuals")
+    assert t.rows[0][0] == 1 and t.rows[1][0] == 18
+    np.testing.assert_allclose(t.rows[0][1], 0.6882, atol=5e-5)   # Sum Sq
+    np.testing.assert_allclose(t.rows[0][3], 1.4191, atol=5e-4)   # F
+    np.testing.assert_allclose(t.rows[0][4], 0.249, atol=5e-4)    # Pr(>F)
+    np.testing.assert_allclose(t.rows[1][1], 8.7293, atol=5e-4)   # RSS
+    assert t.rows[1][3] is None and t.rows[1][4] is None
+    assert "Analysis of Variance Table" in str(t)
+
+
+def test_anova_single_sequential_order_matters(pois_data):
+    """Type-I tables attribute shared deviance to the FIRST term: the same
+    model with reordered formula gives different per-term deviances but the
+    same final residual row."""
+    m1 = sg.glm("y ~ x + grp", pois_data, family="poisson")
+    m2 = sg.glm("y ~ grp + x", pois_data, family="poisson")
+    t1 = sg.anova(m1, pois_data)
+    t2 = sg.anova(m2, pois_data)
+    np.testing.assert_allclose(t1.rows[-1][3], t2.rows[-1][3], rtol=1e-9)
+    assert t1.row_names[1] == "x" and t2.row_names[1] == "grp"
+    # deviance rows sum to the same total drop
+    np.testing.assert_allclose(
+        sum(r[1] for r in t1.rows[1:]), sum(r[1] for r in t2.rows[1:]),
+        rtol=1e-8)
+
+
+def test_anova_single_f_test_and_offset_carry(rng):
+    """test='F' on an estimated-dispersion family, with a by-name offset
+    carried through every sequential sub-fit automatically."""
+    n = 400
+    x = rng.standard_normal(n)
+    z = rng.standard_normal(n)
+    off = rng.uniform(0.0, 1.0, n)
+    mu = np.exp(0.4 + 0.6 * x + off)
+    d = {"y": rng.gamma(4.0, mu / 4.0), "x": x, "z": z, "lo": off}
+    m = sg.glm("y ~ x + z + offset(lo)", d, family="gamma", link="log")
+    t = sg.anova(m, d, test="F")
+    assert t.columns[-2:] == ("F", "Pr(>F)")
+    assert t.rows[1][-1] < 1e-6    # x is real
+    assert t.rows[2][-1] > 0.001   # z is null
+    # the offset genuinely matters: dropping it shifts the NULL deviance
+    m0 = sg.glm("y ~ x + z", d, family="gamma", link="log")
+    t0 = sg.anova(m0, d, test="F")
+    assert abs(t.rows[0][3] - t0.rows[0][3]) > 1e-3
+
+
+def test_anova_single_guards(pois_data, rng):
+    m = sg.glm("y ~ x", pois_data, family="poisson")
+    with pytest.raises(ValueError, match="needs it"):
+        sg.anova(m)
+    X = np.c_[np.ones(50), rng.standard_normal(50)]
+    yv = rng.poisson(np.exp(0.2 + 0.3 * X[:, 1])).astype(float)
+    ma = sg.glm_fit(X, yv, family="poisson")
+    with pytest.raises(ValueError, match="formula-fitted"):
+        sg.anova(ma, {"y": yv})
+    m2 = sg.glm("y ~ x + grp", pois_data, family="poisson")
+    with pytest.raises(ValueError, match="single-model"):
+        sg.anova(m, m2, data=pois_data)
+
+
+def test_step_trace_r_format(rng, mesh8, capsys):
+    """R's printed step trace: 'Start:  AIC=' block, then a per-step move
+    table SORTED by AIC ascending with a '<none>' row, then 'Step:  AIC='
+    after each accepted move — golden-string structure on a deterministic
+    scope."""
+    n = 500
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    z = rng.standard_normal(n)
+    d = {"y": (3.0 + 2.0 * x1 + 1.0 * x2
+               + 0.5 * rng.standard_normal(n)),
+         "x1": x1, "x2": x2, "z": z}
+    m = sg.lm("y ~ x1 + x2 + z", d)
+    out = sg.step(m, d, direction="backward", trace=True)
+    s = capsys.readouterr().out
+    lines = s.splitlines()
+    assert lines[0].startswith("Start:  AIC=")
+    assert lines[1] == "y ~ x1 + x2 + z" and lines[2] == ""
+    # header of the move table
+    assert lines[3].split() == ["Df", "Sum", "of", "Sq", "RSS", "AIC"]
+    # first step: dropping the null term z is the best (lowest-AIC) move,
+    # so it prints FIRST; <none> next; the real effects last
+    assert lines[4].startswith("- z")
+    assert lines[5].startswith("<none>")
+    # rows are sorted by the AIC column (last number on each line)
+    aics = [float(ln.split()[-1]) for ln in lines[4:8]]
+    assert aics == sorted(aics)
+    # the accepted move prints R's Step block with the new formula
+    step_idx = next(i for i, ln in enumerate(lines)
+                    if ln.startswith("Step:  AIC="))
+    assert lines[step_idx + 1] == "y ~ x1 + x2"
+    # final model kept the true effects
+    assert set(out.terms.design) == {("x1",), ("x2",)}
+
+
+def test_step_trace_glm_deviance_columns(pois_data, capsys):
+    m = sg.glm("y ~ x + z + grp", pois_data, family="poisson")
+    sg.step(m, pois_data, direction="backward", trace=True)
+    s = capsys.readouterr().out
+    lines = s.splitlines()
+    assert lines[3].split() == ["Df", "Deviance", "AIC"]
+    assert any(ln.startswith("<none>") for ln in lines)
+    assert "- z" in s and "Step:  AIC=" in s
+
+
+def test_anova_single_refuses_na_shrunk_subfits(rng):
+    """Covariate NAs shrink a sub-fit's sample (the null baseline
+    included): the sequential table must refuse, never silently mix row
+    removal into the differences."""
+    n = 40
+    x = rng.standard_normal(n)
+    x[:5] = np.nan
+    d = {"y": 1.0 + 0.5 * np.nan_to_num(x) + 0.1 * rng.standard_normal(n),
+         "x": x}
+    m = sg.lm("y ~ x", d)           # fits 35 rows (NA-omitted)
+    with pytest.raises(ValueError, match="rows in use changed"):
+        sg.anova(m, d)
+    # GLM: the 'y ~ z' prefix omits the NA column and would fit all 40
+    d["z"] = rng.standard_normal(n)
+    mp = sg.glm("y ~ z + x", d, family="gaussian", link="identity")
+    with pytest.raises(ValueError, match="rows in use changed"):
+        sg.anova(mp, d, test="F")
+
+
+def test_anova_empty_and_df_like_dispatch(pois_data):
+    with pytest.raises(ValueError, match="needs a fitted model"):
+        sg.anova()
+
+    class FakeFrame(dict):  # attribute-forwarding container, like pandas
+        def __getattr__(self, k):
+            try:
+                return self[k]
+            except KeyError:
+                raise AttributeError(k)
+
+    m = sg.glm("y ~ x", pois_data, family="poisson")
+    df = FakeFrame({k: v for k, v in pois_data.items()})
+    df["coefficients"] = np.zeros(len(pois_data["y"]))  # trap column
+    t = sg.anova(m, df)  # must dispatch as (model, data), not two models
+    assert t.row_names[0] == "NULL"

@@ -213,3 +213,40 @@ def test_bf16_warmup_honours_max_iter(rng, mesh8):
                        config=NumericConfig(bf16_warmup=True))
     assert m.iterations <= 2
     assert not m.converged
+
+
+def test_pallas_kernel_traced_theta_interpret(rng):
+    """Negbin theta rides the Mosaic kernel as a TRACED (1,1) SMEM operand
+    (VERDICT r4 #5): the Pallas code path (interpreter) matches the XLA
+    twin at two theta values WITHOUT retracing — one jitted kernel serves
+    the whole theta search."""
+    import jax.numpy as jnp
+    from sparkglm_tpu.families.families import resolve
+    from sparkglm_tpu.ops.fused import fused_fisher_pass, fused_fisher_pass_ref
+
+    fam, lnk = resolve("negative_binomial(2.0)", "log")
+    n, p = 1024, 8
+    X = rng.normal(size=(n, p)).astype(np.float32)
+    X[:, 0] = 1.0
+    mu = np.exp(np.abs(X @ np.full(p, 0.05)))
+    y = rng.negative_binomial(2.0, 2.0 / (2.0 + mu)).astype(np.float32)
+    wt = rng.uniform(0.0, 2.0, n).astype(np.float32)
+    off = (0.05 * rng.normal(size=n)).astype(np.float32)
+    beta = (rng.normal(size=p) / 10).astype(np.float32)
+    args = (jnp.asarray(X), jnp.asarray(y), jnp.asarray(wt), jnp.asarray(off),
+            jnp.asarray(beta))
+    for theta in (0.7, 3.5):
+        fp = jnp.asarray(theta, jnp.float32)
+        got = fused_fisher_pass(*args, family=fam, link=lnk, first=False,
+                                block_rows=256, interpret=True, fam_param=fp)
+        ref = fused_fisher_pass_ref(*args, family=fam, link=lnk, first=False,
+                                    block_rows=256, fam_param=fp)
+        for g, r in zip(got, ref):
+            scale = max(float(jnp.max(jnp.abs(r))), 1.0)
+            np.testing.assert_allclose(np.asarray(g, np.float64),
+                                       np.asarray(r, np.float64),
+                                       atol=2e-5 * scale, rtol=0)
+    # forgetting the param fails loudly at the boundary
+    with pytest.raises(ValueError, match="parametric"):
+        fused_fisher_pass(*args, family=fam, link=lnk, first=False,
+                          block_rows=256, interpret=True)

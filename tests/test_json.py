@@ -272,3 +272,70 @@ def test_native_json_fuzz_parity(tmp_path, rng):
     assert sg.scan_json_levels(str(p), native=True) == \
         sg.scan_json_levels(str(p), native=False)
     _assert_shard_parity(str(p), schema_p, (1, 5))
+
+
+def test_native_json_rare_token_parity(tmp_path):
+    """Review r4 parity gaps: an integral ``-0`` token interning into a
+    categorical column must give Python's str(int) level '0', and strings
+    coerced into NUMERIC columns must follow Python float() lexing
+    (whitespace stripped, PEP-515 underscores) — identical columns whether
+    or not the .so is present (the multi-host identical-design contract)."""
+    if not _native_json_ready():
+        pytest.skip("native NDJSON loader unavailable")
+    import sparkglm_tpu as sg
+    p = tmp_path / "rare.jsonl"
+    body = ('{"cat": -0, "num": 1.5}\n'
+            '{"cat": "x", "num": "1_0"}\n'
+            '{"cat": -0.0, "num": " 2.5\\t"}\n')
+    # the scan types BOTH columns categorical (strings present): the
+    # interning path sees the -0 token; levels must agree
+    p.write_text(body + '{"cat": 7, "num": "_1"}\n')
+    cn = sg.read_json(str(p), native=True)
+    cp = sg.read_json(str(p), native=False)
+    assert list(cn["cat"]) == list(cp["cat"]) == ["0", "x", "-0.0", "7"]
+    assert sg.scan_json_levels(str(p), native=True) == \
+        sg.scan_json_levels(str(p), native=False)
+    # string -> NUMERIC coercion (an explicit schema forces it, as the
+    # streaming fit flow does): Python float() lexing on both loaders
+    schema = {"cat": 1, "num": 0}
+    with pytest.raises(ValueError, match="could not convert"):
+        sg.read_json(str(p), schema=schema, native=True)
+    with pytest.raises(ValueError, match="could not convert"):
+        sg.read_json(str(p), schema=schema, native=False)
+    p.write_text(body + '{"cat": 7, "num": "+3_0.5"}\n')
+    cn = sg.read_json(str(p), schema=schema, native=True)
+    cp = sg.read_json(str(p), schema=schema, native=False)
+    np.testing.assert_array_equal(cn["num"], cp["num"])
+    np.testing.assert_allclose(cn["num"], [1.5, 10.0, 2.5, 30.5])
+    assert list(cn["cat"]) == list(cp["cat"]) == ["0", "x", "-0.0", "7"]
+
+
+def test_gzip_ndjson_parity(tmp_path, rng):
+    """A .jsonl.gz twin reads/scans/fits identically to the plain NDJSON
+    file; sharded reads are refused (Spark's non-splittable semantics)."""
+    import gzip
+
+    import sparkglm_tpu as sg
+
+    n = 300
+    x = rng.standard_normal(n)
+    g = rng.choice(["u", "v"], size=n)
+    y = rng.poisson(np.exp(0.2 + 0.4 * x)).astype(float)
+    plain = tmp_path / "d.jsonl"
+    import json as json_mod
+    with open(plain, "w") as fh:
+        for i in range(n):
+            fh.write(json_mod.dumps(
+                {"y": y[i], "x": x[i], "g": str(g[i])}) + "\n")
+    gz = tmp_path / "d.jsonl.gz"
+    with gzip.open(gz, "wt") as fh:
+        fh.write(plain.read_text())
+    assert sg.scan_json_schema(str(gz)) == sg.scan_json_schema(str(plain))
+    assert sg.scan_json_levels(str(gz)) == sg.scan_json_levels(str(plain))
+    cg, cp = sg.read_json(str(gz)), sg.read_json(str(plain))
+    np.testing.assert_array_equal(cg["x"], cp["x"])
+    with pytest.raises(ValueError, match="not splittable"):
+        sg.read_json(str(gz), shard_index=0, num_shards=4)
+    mg = sg.glm_from_json("y ~ x + g", str(gz), family="poisson")
+    mp = sg.glm_from_json("y ~ x + g", str(plain), family="poisson")
+    np.testing.assert_allclose(mg.coefficients, mp.coefficients, rtol=1e-10)
