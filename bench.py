@@ -115,7 +115,8 @@ def main() -> None:
 
     _cast_bf16 = jax.jit(lambda a: a.astype(jnp.bfloat16))
 
-    def time_irls(data, reps=3, engine="einsum", pp=None):
+    def time_irls(data, reps=3, engine="einsum", pp=None, tol=1e-8,
+                  max_iter=25):
         block = _fused_block_rows(pp or p, None)
         kw = dict(family=fam, link=lnk, criterion="relative", refine_steps=1,
                   mesh=mesh, block_rows=block, use_pallas=on_tpu,
@@ -123,10 +124,10 @@ def main() -> None:
 
         def run():
             if engine == "fused":
-                # the single-HBM-pass Pallas kernel — what engine='auto'
-                # picks on TPU for this shape since r03 (HOTLOOP_r03.md)
+                # the single-HBM-pass Pallas kernel (explicit engine since
+                # r5; auto reverted to einsum on the marginal record)
                 out = _irls_fused_kernel(
-                    *data, jnp.float32(1e-8), jnp.int32(25),
+                    *data, jnp.float32(tol), jnp.int32(max_iter),
                     jnp.float32(0.0), **kw)
             elif engine == "fused_bf16":
                 # the r4 mixed-precision schedule (config.bf16_warmup):
@@ -143,7 +144,8 @@ def main() -> None:
                     jnp.float32(0.0), beta0=out1["beta"], warm=True, **kw)
                 out = dict(out, iters=out1["iters"] + out["iters"])
             else:
-                out = _irls_kernel(*data, jnp.float32(1e-8), jnp.int32(25),
+                out = _irls_kernel(*data, jnp.float32(tol),
+                                   jnp.int32(max_iter),
                                    jnp.float32(0.0), family=fam, link=lnk,
                                    criterion="relative", refine_steps=1)
             return out, float(out["dev"])  # host read forces completion
@@ -204,6 +206,40 @@ def main() -> None:
         detail["headline"]["note"] = (
             "CPU fallback: no MFU field — the bf16-peak denominator names "
             "TPU hardware this run never touched")
+
+    # ---- device-time marginals (r5): the per-call numbers above carry the
+    # tunnel's dispatch round-trip (~30-65 ms) — on production hardware that
+    # cost does not exist.  Force k=2 and k=6 iterations (tol=0) and report
+    # (t6 - t2)/4, which cancels every per-call cost; a D2H value fetch
+    # forces completion (block_until_ready returns early for small outputs
+    # on the tunnel platform — benchmarks/hotloop_r05.json methodology).
+    if on_tpu:
+        try:
+            for eng in ("fused", "einsum"):
+                # tol=0 forces exactly k iterations; time_irls's run()
+                # already D2H-fetches dev, the only reliable completion
+                # barrier over the tunnel (block_until_ready returns
+                # early for small outputs — HOTLOOP_r05.md)
+                ts = {k: time_irls(data, engine=eng, tol=0.0, max_iter=k)[0]
+                      for k in (2, 6)}
+                marg = (ts[6] - ts[2]) / 4.0
+                if marg <= 0:
+                    # RTT jitter exceeded the 4-iteration delta: record the
+                    # failure, never a negative time or an absurd MFU
+                    detail[f"marginal_{eng}"] = dict(
+                        error="non-positive marginal (dispatch jitter "
+                              f"exceeded the k-delta): t2={ts[2]:.4f} "
+                              f"t6={ts[6]:.4f}")
+                    continue
+                detail[f"marginal_{eng}"] = dict(
+                    ms_per_iter=round(1e3 * marg, 3),
+                    mfu_vs_bf16_peak=round(
+                        flops_iter / marg / (V5E_PEAK_BF16 * n_chips), 4),
+                    note="(t_k6 - t_k2)/4, forced iterations: device time "
+                         "with per-call dispatch cost cancelled")
+        except Exception as e:  # noqa: BLE001
+            detail["marginal_error"] = str(e)[:200]
+            print(f"bench: marginal measurement failed: {e}", file=sys.stderr)
 
     # ---- the 10M x 1000 x v5e-8 estimate: MEASURE the per-chip share ------
     # 10M rows over 8 chips is 1.25M rows/chip at p=1000 (5 GB f32 — fits

@@ -1,0 +1,212 @@
+"""Round-5 hot-loop decomposition on the real chip.
+
+bench_detail_latest (r5) measures the fused FIT at ~29 ms/iter while the
+raw fused PASS costs ~16 ms (proto_bf16_r05) — ~13 ms/iter of overhead
+around the data pass.  Decompose one IRLS iteration ON DEVICE to find it.
+
+Tunnel methodology (hard-won):
+  * single dispatches cost ~65 ms RTT — EVERY timing must amortize many
+    repetitions inside ONE jitted call (chained lax.scan, k=1 vs k=K
+    marginal), like proto_bf16_master does;
+  * never close a jit over a device-resident design matrix — the 4.3 GB
+    gets captured as an HLO CONSTANT and serialized over the tunnel
+    (first attempt of this script died doing exactly that).  Pass
+    operands as arguments.
+
+Also validates the NEW Mosaic traced-theta path (negbin fam_param as a
+(1,1) SMEM operand) on real hardware.  ONE tunnel client at a time.
+Writes benchmarks/hotloop_r05.json.
+"""
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np  # noqa: E402
+
+OUT = "/root/repo/benchmarks/hotloop_r05.json"
+res = {"device": None}
+
+
+def dump():
+    import os
+    with open(OUT + ".tmp", "w") as f:
+        json.dump(res, f, indent=1)
+    os.replace(OUT + ".tmp", OUT)
+
+
+def timed(fn, *args, reps=4):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    from sparkglm_tpu.families.families import resolve
+    from sparkglm_tpu.models.glm import _irls_fused_kernel, _irls_kernel
+    from sparkglm_tpu.ops.fused import fused_fisher_pass, fused_fisher_pass_ref
+    from sparkglm_tpu.ops.solve import solve_normal
+    import sparkglm_tpu as sg
+
+    res["device"] = str(jax.devices()[0])
+    mesh = sg.make_mesh()
+    fam, lnk = resolve("binomial", "logit")
+    n, p = 2_097_152, 512
+
+    # ---- 0. traced-theta Mosaic validation (small, real chip) ------------
+    nb_fam, nb_lnk = resolve("negative_binomial(2.0)", "log")
+    rngh = np.random.default_rng(5)
+    Xs = rngh.standard_normal((4096, 64)).astype(np.float32)
+    Xs[:, 0] = 1.0
+    mu_s = np.exp(np.clip(Xs @ np.full(64, 0.03), -3, 3))
+    ys = rngh.negative_binomial(2.0, 2.0 / (2.0 + mu_s)).astype(np.float32)
+    a = (jnp.asarray(Xs), jnp.asarray(ys), jnp.ones(4096, jnp.float32),
+         jnp.zeros(4096, jnp.float32), jnp.full((64,), 0.01, jnp.float32))
+    for th in (0.8, 2.0, 5.0):
+        fp = jnp.float32(th)
+        got = fused_fisher_pass(*a, family=nb_fam, link=nb_lnk, first=False,
+                                block_rows=512, fam_param=fp)
+        ref = fused_fisher_pass_ref(*a, family=nb_fam, link=nb_lnk,
+                                    first=False, block_rows=512, fam_param=fp)
+        rel = max(float(jnp.max(jnp.abs(g - r))
+                        / jnp.maximum(jnp.max(jnp.abs(r)), 1e-30))
+                  for g, r in zip(got, ref))
+        res[f"nb_theta_{th}_mosaic_vs_ref_rel"] = rel
+    mnb = sg.glm_fit(Xs, ys, family="negative_binomial(2.0)", link="log",
+                     engine="fused", tol=1e-8, criterion="relative")
+    mne = sg.glm_fit(Xs, ys, family="negative_binomial(2.0)", link="log",
+                     engine="einsum", tol=1e-8, criterion="relative")
+    res["nb_fused_vs_einsum_beta_maxdiff"] = float(
+        np.max(np.abs(mnb.coefficients - mne.coefficients)))
+    res["nb_fused_converged"] = bool(mnb.converged)
+    dump()
+    print("negbin mosaic validated", flush=True)
+
+    # ---- 1. device-resident data -----------------------------------------
+    @jax.jit
+    def gen(key):
+        kx, kb, ku = jax.random.split(key, 3)
+        X = jax.random.normal(kx, (n, p), jnp.float32).at[:, 0].set(1.0)
+        bt = jax.random.normal(kb, (p,), jnp.float32) / (2.0 * p ** 0.5)
+        y = (jax.random.uniform(ku, (n,))
+             < jax.nn.sigmoid(X @ bt)).astype(jnp.float32)
+        return X, y
+    X, y = gen(jax.random.PRNGKey(7))
+    wt = jnp.ones((n,), jnp.float32)
+    off = jnp.zeros((n,), jnp.float32)
+    beta = jnp.zeros((p,), jnp.float32)
+    jax.block_until_ready(y)
+
+    # ---- 2. chained-scan marginals: pass / solve / pass+solve ------------
+    @partial(jax.jit, static_argnames=("k", "with_solve"))
+    def chain(X, y, wt, off, b0, k, with_solve):
+        def body(b, _):
+            A, z, dev = fused_fisher_pass(X, y, wt, off, b, family=fam,
+                                          link=lnk, first=False,
+                                          block_rows=1024)
+            if with_solve:
+                bb, _ = solve_normal(A, z, jitter=jnp.float32(0.0),
+                                     refine_steps=1)
+                return bb, dev
+            # data dependency without a solve (prevents CSE/hoisting)
+            return b + 1e-12 * z, dev
+        bout, devs = lax.scan(body, b0, None, length=k)
+        return bout, devs[-1]
+
+    for tag, ws in (("pass", False), ("pass_plus_solve", True)):
+        t1 = timed(chain, X, y, wt, off, beta, 1, ws)
+        t9 = timed(chain, X, y, wt, off, beta, 9, ws)
+        res[f"{tag}_marginal_ms"] = 1e3 * (t9 - t1) / 8
+        res[f"{tag}_k1_ms"] = 1e3 * t1
+        dump()
+        print(tag, res[f"{tag}_marginal_ms"], flush=True)
+
+    # solve-only marginal: vary A slightly each step to defeat hoisting
+    Afull, zfull, _ = fused_fisher_pass(X, y, wt, off, beta, family=fam,
+                                        link=lnk, first=False,
+                                        block_rows=1024)
+
+    @partial(jax.jit, static_argnames=("k",))
+    def solve_chain(A, z, k):
+        def body(carry, _):
+            b, s = carry
+            Ak = A + (1e-7 * s) * jnp.eye(A.shape[0], dtype=A.dtype)
+            bb, _ = solve_normal(Ak, z + 1e-6 * b, jitter=jnp.float32(0.0),
+                                 refine_steps=1)
+            return (bb, s + 1.0), bb[0]
+        (bb, _), _ = lax.scan(body, (jnp.zeros_like(z), jnp.float32(1.0)),
+                              None, length=k)
+        return bb
+    t1 = timed(solve_chain, Afull, zfull, 1)
+    t9 = timed(solve_chain, Afull, zfull, 9)
+    res["solve_p512_marginal_ms"] = 1e3 * (t9 - t1) / 8
+    dump()
+    print("solve marginal", res["solve_p512_marginal_ms"], flush=True)
+
+    # ---- 3. full kernels at forced iteration counts ----------------------
+    def fit_k(k):
+        def run():
+            return _irls_fused_kernel(
+                X, y, wt, off, jnp.float32(0.0), jnp.int32(k),
+                jnp.float32(0.0), family=fam, link=lnk,
+                criterion="relative", refine_steps=1, mesh=mesh,
+                block_rows=1024, use_pallas=True, precision=None)
+        out = run()
+        jax.block_until_ready(out["beta"])
+        ts = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            out = run()
+            jax.block_until_ready(out["beta"])
+            ts.append(time.perf_counter() - t0)
+        return min(ts), int(out["iters"])
+
+    t1, i1 = fit_k(1)
+    t5, i5 = fit_k(5)
+    res["fit_1iter_ms"] = 1e3 * t1
+    res["fit_5iter_ms"] = 1e3 * t5
+    res["fit_marginal_per_iter_ms"] = 1e3 * (t5 - t1) / max(1, i5 - i1)
+    dump()
+    print("fit marginal/iter", res["fit_marginal_per_iter_ms"], flush=True)
+
+    def efit_k(k):
+        def run():
+            return _irls_kernel(X, y, wt, off, jnp.float32(0.0),
+                                jnp.int32(k), jnp.float32(0.0), family=fam,
+                                link=lnk, criterion="relative",
+                                refine_steps=1)
+        out = run()
+        jax.block_until_ready(out["beta"])
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = run()
+            jax.block_until_ready(out["beta"])
+            ts.append(time.perf_counter() - t0)
+        return min(ts), int(out["iters"])
+
+    e1, j1 = efit_k(1)
+    e5, j5 = efit_k(5)
+    res["einsum_1iter_ms"] = 1e3 * e1
+    res["einsum_5iter_ms"] = 1e3 * e5
+    res["einsum_marginal_per_iter_ms"] = 1e3 * (e5 - e1) / max(1, j5 - j1)
+    res["complete"] = True
+    dump()
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Part 2 of the r5 hot-loop decomposition: solve-only marginal and the
+FULL-kernel per-iteration marginals (t5 - t1 cancels every per-call cost,
+incl. the ~65 ms tunnel RTT that inflates bench.py's per-call numbers).
+Merges into benchmarks/hotloop_r05.json.  ONE tunnel client at a time."""
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+OUT = "/root/repo/benchmarks/hotloop_r05.json"
+with open(OUT) as f:
+    res = json.load(f)
+
+
+def dump():
+    import os
+    with open(OUT + ".tmp", "w") as f:
+        json.dump(res, f, indent=1)
+    os.replace(OUT + ".tmp", OUT)
+
+
+def main():
+    from sparkglm_tpu.families.families import resolve
+    from sparkglm_tpu.models.glm import _irls_fused_kernel, _irls_kernel
+    from sparkglm_tpu.ops.fused import fused_fisher_pass
+    from sparkglm_tpu.ops.solve import solve_normal
+    import sparkglm_tpu as sg
+
+    mesh = sg.make_mesh()
+    fam, lnk = resolve("binomial", "logit")
+    n, p = 2_097_152, 512
+
+    @jax.jit
+    def gen(key):
+        kx, kb, ku = jax.random.split(key, 3)
+        X = jax.random.normal(kx, (n, p), jnp.float32).at[:, 0].set(1.0)
+        bt = jax.random.normal(kb, (p,), jnp.float32) / (2.0 * p ** 0.5)
+        y = (jax.random.uniform(ku, (n,))
+             < jax.nn.sigmoid(X @ bt)).astype(jnp.float32)
+        return X, y
+    X, y = gen(jax.random.PRNGKey(7))
+    wt = jnp.ones((n,), jnp.float32)
+    off = jnp.zeros((n,), jnp.float32)
+    jax.block_until_ready(y)
+
+    def timed(fn, *args, reps=4):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.block_until_ready(out)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    beta = jnp.zeros((p,), jnp.float32)
+    Afull, zfull, _ = fused_fisher_pass(X, y, wt, off, beta, family=fam,
+                                        link=lnk, first=False,
+                                        block_rows=1024)
+
+    @partial(jax.jit, static_argnames=("k",))
+    def solve_chain(A, z, k):
+        def body(carry, _):
+            b, s = carry
+            Ak = A + (1e-7 * s) * jnp.eye(A.shape[0], dtype=A.dtype)
+            bb, _ = solve_normal(Ak, z + 1e-6 * b, jitter=jnp.float32(0.0),
+                                 refine_steps=1)
+            return (bb, s + 1.0), bb[0]
+        (bb, _), _ = lax.scan(body, (jnp.zeros_like(z), jnp.float32(1.0)),
+                              None, length=k)
+        return bb
+    t1 = timed(solve_chain, Afull, zfull, 1)
+    t9 = timed(solve_chain, Afull, zfull, 9)
+    res["solve_p512_marginal_ms"] = 1e3 * (t9 - t1) / 8
+    dump()
+    print("solve marginal", res["solve_p512_marginal_ms"], flush=True)
+
+    def fit_k(kernel, k, **kw):
+        def run():
+            return kernel(X, y, wt, off, jnp.float32(0.0), jnp.int32(k),
+                          jnp.float32(0.0), family=fam, link=lnk,
+                          criterion="relative", refine_steps=1, **kw)
+        out = run()
+        jax.block_until_ready(out["beta"])
+        ts = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            out = run()
+            jax.block_until_ready(out["beta"])
+            ts.append(time.perf_counter() - t0)
+        return min(ts), int(out["iters"])
+
+    fkw = dict(mesh=mesh, block_rows=1024, use_pallas=True, precision=None)
+    t1, i1 = fit_k(_irls_fused_kernel, 1, **fkw)
+    t5, i5 = fit_k(_irls_fused_kernel, 5, **fkw)
+    res["fit_1iter_ms"] = 1e3 * t1
+    res["fit_5iter_ms"] = 1e3 * t5
+    res["fit_marginal_per_iter_ms"] = 1e3 * (t5 - t1) / max(1, i5 - i1)
+    dump()
+    print("fused fit marginal/iter", res["fit_marginal_per_iter_ms"],
+          flush=True)
+
+    e1, j1 = fit_k(_irls_kernel, 1)
+    e5, j5 = fit_k(_irls_kernel, 5)
+    res["einsum_1iter_ms"] = 1e3 * e1
+    res["einsum_5iter_ms"] = 1e3 * e5
+    res["einsum_marginal_per_iter_ms"] = 1e3 * (e5 - e1) / max(1, j5 - j1)
+    res["complete"] = True
+    dump()
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Part 3: isolate WHERE the fused kernel loses ~20 ms/iter vs the chained
+pass+solve floor (19 ms).  Candidates: the per-iteration shard_map
+entry/exit (the kernel wraps EACH pass in shard_map and runs the
+while_loop outside), the while_loop itself, or the carried-state plumbing.
+
+Variants timed as k-marginals (k=2 vs k=6 — the k=1 endpoint behaved
+anomalously over the tunnel):
+  A. plain chained scan of pass+solve (baseline floor, re-measured)
+  B. A wrapped in ONE shard_map around the whole scan (psum inside) —
+     the "loop inside shard_map" restructure candidate
+  C. scan where each step calls a shard_map'd pass (the CURRENT kernel
+     shape: shard_map per iteration)
+Merges into benchmarks/hotloop_r05.json."""
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+OUT = "/root/repo/benchmarks/hotloop_r05.json"
+with open(OUT) as f:
+    res = json.load(f)
+
+
+def dump():
+    import os
+    with open(OUT + ".tmp", "w") as f:
+        json.dump(res, f, indent=1)
+    os.replace(OUT + ".tmp", OUT)
+
+
+def main():
+    from sparkglm_tpu.families.families import resolve
+    from sparkglm_tpu.ops.fused import fused_fisher_pass
+    from sparkglm_tpu.ops.solve import solve_normal
+    from sparkglm_tpu.parallel import mesh as meshlib
+    import sparkglm_tpu as sg
+
+    mesh = sg.make_mesh()
+    fam, lnk = resolve("binomial", "logit")
+    n, p = 2_097_152, 512
+
+    @jax.jit
+    def gen(key):
+        kx, kb, ku = jax.random.split(key, 3)
+        X = jax.random.normal(kx, (n, p), jnp.float32).at[:, 0].set(1.0)
+        bt = jax.random.normal(kb, (p,), jnp.float32) / (2.0 * p ** 0.5)
+        y = (jax.random.uniform(ku, (n,))
+             < jax.nn.sigmoid(X @ bt)).astype(jnp.float32)
+        return X, y
+    X, y = gen(jax.random.PRNGKey(7))
+    wt = jnp.ones((n,), jnp.float32)
+    off = jnp.zeros((n,), jnp.float32)
+    b0 = jnp.zeros((p,), jnp.float32)
+    jax.block_until_ready(y)
+
+    import numpy as _np
+
+    def force(out):
+        # block_until_ready over the axon tunnel returns early for small
+        # outputs (observed: 0.02 ms for a 6-pass chain) — force a real
+        # synchronous D2H value fetch instead; its ~RTT cost cancels in
+        # the k-marginals
+        return float(_np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+
+    def timed(fn, *args, reps=4):
+        force(fn(*args))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            force(fn(*args))
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    def iter_body(Xs, ys, ws, os_, b, *, spmd):
+        A, z, dev = fused_fisher_pass(Xs, ys, ws, os_, b, family=fam,
+                                      link=lnk, first=False, block_rows=1024)
+        if spmd:
+            A = lax.psum(A, meshlib.DATA_AXIS)
+            z = lax.psum(z, meshlib.DATA_AXIS)
+            dev = lax.psum(dev, meshlib.DATA_AXIS)
+        bb, _ = solve_normal(A, z, jitter=jnp.float32(0.0), refine_steps=1)
+        return bb, dev
+
+    # A. plain chained scan (floor)
+    @partial(jax.jit, static_argnames=("k",))
+    def chainA(X, y, wt, off, b, k):
+        def body(b, _):
+            return iter_body(X, y, wt, off, b, spmd=False)
+        return lax.scan(body, b, None, length=k)[0]
+
+    # B. ONE shard_map around the whole scan (loop inside shard_map)
+    d = meshlib.DATA_AXIS
+
+    @partial(jax.jit, static_argnames=("k",))
+    def chainB(X, y, wt, off, b, k):
+        def inner(Xs, ys, ws, os_, b):
+            def body(b, _):
+                return iter_body(Xs, ys, ws, os_, b, spmd=True)
+            return lax.scan(body, b, None, length=k)[0]
+        return jax.shard_map(
+            inner, mesh=mesh, in_specs=(P(d, None), P(d), P(d), P(d), P()),
+            out_specs=P(), check_vma=False)(X, y, wt, off, b)
+
+    # C. shard_map PER iteration (the current kernel shape)
+    @partial(jax.jit, static_argnames=("k",))
+    def chainC(X, y, wt, off, b, k):
+        def one(Xs, ys, ws, os_, b):
+            A, z, dev = fused_fisher_pass(Xs, ys, ws, os_, b, family=fam,
+                                          link=lnk, first=False,
+                                          block_rows=1024)
+            return (lax.psum(A, d), lax.psum(z, d), lax.psum(dev, d))
+        sm = jax.shard_map(
+            one, mesh=mesh, in_specs=(P(d, None), P(d), P(d), P(d), P()),
+            out_specs=(P(), P(), P()), check_vma=False)
+
+        def body(b, _):
+            A, z, dev = sm(X, y, wt, off, b)
+            bb, _ = solve_normal(A, z, jitter=jnp.float32(0.0),
+                                 refine_steps=1)
+            return bb, dev
+        return lax.scan(body, b, None, length=k)[0]
+
+    for tag, fn in (("A_plain", chainA), ("B_loop_inside_shardmap", chainB),
+                    ("C_shardmap_per_iter", chainC)):
+        t2 = timed(fn, X, y, wt, off, b0, 2)
+        t6 = timed(fn, X, y, wt, off, b0, 6)
+        res[f"{tag}_marginal_ms"] = 1e3 * (t6 - t2) / 4
+        res[f"{tag}_k2_ms"] = 1e3 * t2
+        dump()
+        print(tag, res[f"{tag}_marginal_ms"], flush=True)
+
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -81,11 +81,22 @@ sel = sg.step(sg.glm("claims ~ offset(log_expo)", data, family="poisson",
               data, scope="~ age + log(dens) + veh")
 print("step selected:", sel.formula)
 
-# case-deletion influence (exact rank-one downdate for lm; one-step for
-# glm) — the fit-time offset() column travels with the model and is
-# recovered from the data automatically, as in predict()
+# single-model sequential anova — R's anova(fit): terms added first to
+# last (models don't retain data, so pass it back in)
+print(sg.anova(m, data, test="Chisq"))
+
+# case-deletion influence, digit-for-digit R's influence.glm (deviance
+# residuals through the downdate) — the fit-time offset() column travels
+# with the model and is recovered from the data automatically
 infl = sg.dffits(m, data, data["claims"], weights=data["w"])
 print("max |dffits| row:", int(np.argmax(np.abs(infl))))
+im = sg.influence_measures(m, data, data["claims"], weights=data["w"])
+flagged = np.flatnonzero(im.is_inf.any(axis=1))
+print("influence.measures flags", len(flagged), "rows;",
+      im.columns[-4:], "columns")
+print("rstudent extremes:",
+      np.round(np.sort(sg.rstudent(m, data, data["claims"],
+                                   weights=data["w"]))[[0, -1]], 3))
 
 # ---------------------------------------------------------------------------
 # 4. Scoring — host, and sharded over the mesh (the reference's

@@ -774,13 +774,9 @@ def _fit_global(
     on_tpu = jax.default_backend() == "tpu"
     model_par = mesh.shape.get(meshlib.MODEL_AXIS, 1) != 1
     if engine == "auto":
-        # same policy as the resident path: the fused single-pass kernel
-        # where it wins (large-f32 on TPU, unsharded feature axis),
-        # einsum everywhere else
-        big = n_global * p * p > (1 << 31)
-        engine = ("fused" if on_tpu and big and dtype == jnp.float32
-                  and config.matmul_precision is None and p <= 1024
-                  and not model_par else "einsum")
+        # same policy as the resident path (r5 marginal record,
+        # HOTLOOP_r05.md): einsum wins on-device at every measured shape
+        engine = "einsum"
     if engine == "fused" and model_par:
         raise ValueError(
             "engine='fused' does not support a sharded feature axis")
@@ -975,13 +971,13 @@ def fit(
         (kappa ≳ 1e2 at float32) where the f32 Gramian itself is
         noise-dominated.  Slower per iteration (Householder QR instead of
         one MXU matmul).
-      * ``"auto"`` — the fused single-pass kernel on TPU for large float32
-        fits (one HBM pass/iteration ≈ 16 ms vs the einsum engine's
-        ~26-40 ms at 2Mx512 — measured r03 after un-crippling the kernel's
-        Gramian precision, benchmarks/HOTLOOP_r03.md); ``"einsum"``
-        everywhere else (CPU meshes, float64, sharded feature axis, very
-        wide designs, and the small-n regime where the R-parity precision
-        gate makes HIGHEST passes mandatory anyway).
+      * ``"auto"`` — the einsum engine: measured on the real chip with
+        dispatch cost cancelled (r5, benchmarks/HOTLOOP_r05.md), XLA's
+        fused einsum pass runs 12.0 ms/iter at 2Mx512 (MFU 0.47) vs the
+        Pallas kernel's 14.1 AND converges one iteration sooner (no
+        half-step deviance lag).  The r03 measurements that briefly
+        pointed auto at the fused kernel were per-call tunnel timings —
+        retracted in r5.
     """
     from .lm import _detect_intercept
 
@@ -1083,26 +1079,18 @@ def fit(
     checkpointing = (beta0 is not None or on_iteration is not None
                      or checkpoint_every)
     if engine == "auto":
-        # Measured r03 on a v5e (benchmarks/HOTLOOP_r03.md,
-        # proto_fused_r03.json): the single-HBM-pass Pallas kernel at
-        # DEFAULT Gramian precision runs ~16 ms/iter at 2Mx512 vs the
-        # einsum engine's ~26-40 (whose Gramian alone costs 17 ms — the
-        # Xw materialisation makes it ~4 HBM passes).  The r02 sweep that
-        # picked einsum was measuring the kernel 6x-overworked at
-        # Precision.HIGHEST.  Auto picks fused exactly where that holds:
-        # TPU, float32, unsharded feature axis, p small enough for the
-        # (p,p) VMEM accumulator, the large-n regime (small-n parity
-        # fits force HIGHEST passes, where einsum's XLA schedule wins),
-        # Checkpointing (beta0/on_iteration/checkpoint_every) rides the
-        # fused engine too since r4: the init pass warm-starts from beta0
-        # (a regular first=False pass), so the multi-hour fits that most
-        # need checkpoint_every get the fast path.
-        big = n * p * p > (1 << 31)
-        engine = ("fused" if on_tpu and big and dtype == np.float32
-                  and config.matmul_precision is None
-                  and not shard_features and mesh.shape[meshlib.MODEL_AXIS] == 1
-                  and p <= 1024
-                  else "einsum")
+        # Measured r05 on the real chip with per-call dispatch cost
+        # CANCELLED (benchmarks/HOTLOOP_r05.md + bench_detail_latest
+        # marginal_*): the einsum engine's XLA-fused pass runs 12.0
+        # ms/iter at 2Mx512 (MFU 0.47) vs the Pallas fused kernel's 14.1,
+        # and converges one iteration sooner (its deviance is not lagged
+        # by a half-step).  The r03 numbers that flipped auto to fused
+        # (~16 vs ~26-40 ms/iter) were per-call timings carrying the
+        # tunnel's 30-65 ms dispatch RTT divided by different iteration
+        # counts — an artifact, retracted.  Auto is einsum everywhere;
+        # engine="fused" stays available explicitly (its bf16 master-copy
+        # warm-up remains the memory lever, BF16_DECISION_r05.md).
+        engine = "einsum"
     if engine not in ("einsum", "fused", "qr"):
         raise ValueError(
             f"engine must be 'auto', 'einsum', 'fused' or 'qr', got {engine!r}")
