@@ -213,30 +213,32 @@ def main() -> None:
     # (t6 - t2)/4, which cancels every per-call cost; a D2H value fetch
     # forces completion (block_until_ready returns early for small outputs
     # on the tunnel platform — benchmarks/hotloop_r05.json methodology).
+    def marginal_record(d, eng, fl_iter, peak, pp=None):
+        """ONE protocol for every dispatch-cancelled marginal: tol=0
+        forces exactly k iterations; time_irls's run() D2H-fetches dev,
+        the only reliable completion barrier over the tunnel
+        (block_until_ready returns early for small outputs —
+        HOTLOOP_r05.md); (t_k6 - t_k2)/4 cancels per-call cost.  A
+        non-positive delta (RTT jitter ate it) is RECORDED, never a
+        negative time or an absurd MFU."""
+        ts = {k: time_irls(d, engine=eng, pp=pp, tol=0.0, max_iter=k)[0]
+              for k in (2, 6)}
+        marg = (ts[6] - ts[2]) / 4.0
+        if marg <= 0:
+            return dict(error="non-positive marginal (dispatch jitter "
+                              f"exceeded the k-delta): t2={ts[2]:.4f} "
+                              f"t6={ts[6]:.4f}")
+        return dict(
+            ms_per_iter=round(1e3 * marg, 3),
+            mfu_vs_bf16_peak=round(fl_iter / marg / peak, 4),
+            note="(t_k6 - t_k2)/4, forced iterations: device time with "
+                 "per-call dispatch cost cancelled")
+
     if on_tpu:
         try:
             for eng in ("fused", "einsum"):
-                # tol=0 forces exactly k iterations; time_irls's run()
-                # already D2H-fetches dev, the only reliable completion
-                # barrier over the tunnel (block_until_ready returns
-                # early for small outputs — HOTLOOP_r05.md)
-                ts = {k: time_irls(data, engine=eng, tol=0.0, max_iter=k)[0]
-                      for k in (2, 6)}
-                marg = (ts[6] - ts[2]) / 4.0
-                if marg <= 0:
-                    # RTT jitter exceeded the 4-iteration delta: record the
-                    # failure, never a negative time or an absurd MFU
-                    detail[f"marginal_{eng}"] = dict(
-                        error="non-positive marginal (dispatch jitter "
-                              f"exceeded the k-delta): t2={ts[2]:.4f} "
-                              f"t6={ts[6]:.4f}")
-                    continue
-                detail[f"marginal_{eng}"] = dict(
-                    ms_per_iter=round(1e3 * marg, 3),
-                    mfu_vs_bf16_peak=round(
-                        flops_iter / marg / (V5E_PEAK_BF16 * n_chips), 4),
-                    note="(t_k6 - t_k2)/4, forced iterations: device time "
-                         "with per-call dispatch cost cancelled")
+                detail[f"marginal_{eng}"] = marginal_record(
+                    data, eng, flops_iter, V5E_PEAK_BF16 * n_chips)
         except Exception as e:  # noqa: BLE001
             detail["marginal_error"] = str(e)[:200]
             print(f"bench: marginal measurement failed: {e}", file=sys.stderr)
@@ -289,7 +291,19 @@ def main() -> None:
                     / V5E_PEAK_BF16, 4),
                 est_10Mx1000_8chip_s=round(est_headline, 3),
                 note="measured per-chip slice of the v5e-8 headline config; "
-                     "est adds 10% for the per-iteration 4 MB Gramian psum")
+                     "est adds 10% for the per-iteration 4 MB Gramian psum; "
+                     "per-call seconds include the tunnel dispatch RTT — "
+                     "the 'marginal' record (or its error) is the device "
+                     "time")
+            try:
+                rec = marginal_record(wide, eng_h,
+                                      2.0 * n_h8 * p_h * (p_h + 2),
+                                      V5E_PEAK_BF16, pp=p_h)
+                detail["headline_share_10Mx1000"]["marginal"] = rec
+            except Exception as e:  # noqa: BLE001
+                detail["headline_share_10Mx1000"]["marginal"] = dict(
+                    error=str(e)[:200])
+                print(f"bench: share marginal failed: {e}", file=sys.stderr)
             del wide
         except Exception as e:  # noqa: BLE001 — the share run must never
             # cost the round its headline JSON line (16 GB chips OOM here)

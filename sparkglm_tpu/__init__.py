@@ -31,8 +31,8 @@ from .families.families import (FAMILIES, Family, get_family,
 from .families.links import LINKS, Link, get_link
 from .models.anova import AnovaTable, add1, anova, drop1, step
 from .models.diagnostics import (cooks_distance, covratio, dfbeta, dfbetas,
-                                 dffits, hatvalues, influence_measures,
-                                 rstandard, rstudent)
+                                 dffits, hatvalues, influence,
+                                 influence_measures, rstandard, rstudent)
 from .models.glm import GLMModel
 from .models.glm import fit as glm_fit
 from .models.negbin import fit_nb as glm_fit_nb
@@ -59,7 +59,8 @@ __all__ = [
     "anova", "add1", "drop1", "step", "AnovaTable", "confint_profile",
     "TermsPrediction",
     "hatvalues", "rstandard", "rstudent", "cooks_distance",
-    "dfbeta", "dfbetas", "dffits", "covratio", "influence_measures",
+    "dfbeta", "dfbetas", "dffits", "covratio", "influence",
+    "influence_measures",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
     "quasi", "negative_binomial", "glm_nb", "glm_fit_nb", "theta_of",
     "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",

@@ -319,6 +319,30 @@ def covratio(model, data, y, *, weights=None, offset=None,
         return _inf_to_nan((s_i / s) ** (2 * p) / om)
 
 
+def influence(model, data, y, *, weights=None, offset=None,
+              m=None):
+    """R's ``influence(fit)`` list: ``hat``, ``coefficients`` (the dfbeta
+    matrix), ``sigma`` (sigma_(i)), and the residual slots — ``wt_res``
+    for an LM, ``dev_res`` + ``pear_res`` for a GLM (influence.glm renames
+    wt.res to dev.res and appends the Pearson residuals)."""
+    import types
+
+    offset = _recover_offset(model, data, offset)
+    X = _design_of(model, data)
+    dfb, _, ew, _, h, _, s_i, _ = _deletion_pieces(model, X, y,
+                                                   weights=weights,
+                                                   offset=offset, m=m)
+    out = dict(hat=h, coefficients=dfb, sigma=s_i)
+    if hasattr(model, "family"):
+        out["dev_res"] = ew
+        out["pear_res"] = np.asarray(
+            model.residuals(X, y, type="pearson", offset=offset,
+                            weights=weights, m=m), np.float64)
+    else:
+        out["wt_res"] = ew
+    return types.SimpleNamespace(**out)
+
+
 class InfluenceMeasures:
     """R's ``influence.measures`` table: one row per observation, columns
     ``dfb.<name>`` (per non-aliased coefficient), ``dffit``, ``cov.r``,

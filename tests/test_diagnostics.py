@@ -303,3 +303,31 @@ def test_diagnostics_recover_formula_offset(rng):
     # to the fits' own f32 coefficient noise
     np.testing.assert_allclose(sg.hatvalues(ma, X, offset=off),
                                sg.hatvalues(m, data), rtol=5e-3)
+
+
+def test_influence_list_object(mesh1):
+    """R's influence(fit) list: hat / coefficients / sigma plus dev.res +
+    pear.res for a GLM (wt.res for an LM) — consistent with the individual
+    verbs on the Dobson fixture."""
+    from sparkglm_tpu.config import NumericConfig
+    j = _golden()["dobson_poisson"]
+    o = np.tile([(0, 0), (1, 0), (0, 1)], (3, 1))
+    t = np.repeat([(0, 0), (1, 0), (0, 1)], 3, axis=0)
+    X = np.column_stack([np.ones(9), o, t])
+    y = np.asarray(j["data"]["counts"], float)
+    model = sg.glm_fit(X, y, family="poisson", tol=1e-12,
+                       config=NumericConfig(dtype="float64"), mesh=mesh1)
+    inf = sg.influence(model, X, y)
+    g = j["influence"]
+    np.testing.assert_allclose(inf.hat, np.asarray(g["hat"]), rtol=1e-6)
+    np.testing.assert_allclose(inf.sigma, np.asarray(g["sigma"]), rtol=1e-6)
+    np.testing.assert_allclose(inf.coefficients, np.asarray(g["dfbeta"]),
+                               rtol=1e-5, atol=1e-10)
+    d = model.residuals(X, y, type="deviance")
+    np.testing.assert_allclose(inf.dev_res, d, rtol=1e-10)
+    assert hasattr(inf, "pear_res")
+    # LM flavor carries wt_res instead
+    ml = sg.lm_fit(X[:, :3], y, config=NumericConfig(dtype="float64"),
+                   mesh=mesh1)
+    il = sg.influence(ml, X[:, :3], y)
+    assert hasattr(il, "wt_res") and not hasattr(il, "dev_res")
