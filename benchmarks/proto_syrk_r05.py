@@ -1,0 +1,161 @@
+"""Prototype: symmetric (syrk-style) Gramian — can exploiting X'WX's
+symmetry beat XLA's full-GEMM einsum Gramian on the chip?
+
+The IRLS pass is roughly balanced between the HBM read of X (~5-6 ms at
+2M x 512 near peak) and the MXU Gramian (~5.6 ms at DEFAULT precision);
+the full GEMM computes both triangles.  A panel-wise kernel that computes
+only the LOWER triangle does ~half the MXU MACs for the same HBM read:
+for each 128-wide output-column panel j it contracts
+
+    G[j*128:, j*128:(j+1)*128] += Xw[:, j*128:]^T @ X[:, j*128:(j+1)*128]
+
+(a static Python loop over panels inside the kernel; panel shapes shrink
+as j grows).  Timings are dispatch-cancelled k-marginals with a D2H
+fetch (HOTLOOP_r05.md methodology); the chain feeds a scalar weight
+derived from the previous Gramian back into the next one.  CAVEAT found
+on the first run: a SCALAR chain does NOT protect the einsum mode — XLA
+rewrites (sX)'X = s*(X'X) and hoists the loop-invariant X'X, so the
+einsum row is emitted with an `_invalid` marker; only the two Pallas
+rows (opaque to the rewrite) are comparable.
+
+Writes proto_syrk_r{ROUND}.json via _capture.  ONE tunnel client at a
+time.
+"""
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo/benchmarks")
+
+from _capture import dump_atomic, out_path  # noqa: E402
+
+OUT = out_path("proto_syrk")
+res: dict = {}
+
+
+def dump():
+    dump_atomic(res, OUT)
+
+
+PANEL = 128
+
+
+def _gram_kernel(x_ref, s_ref, out_ref, *, lower_only: bool, p: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    X = x_ref[:]
+    Xw = X * s_ref[0, 0]
+    if not lower_only:
+        out_ref[:] += jax.lax.dot_general(
+            Xw, X, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        return
+    for j in range(p // PANEL):
+        lo = j * PANEL
+        out_ref[lo:, lo:lo + PANEL] += jax.lax.dot_general(
+            Xw[:, lo:], X[:, lo:lo + PANEL], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+
+
+@partial(jax.jit, static_argnames=("block_rows", "lower_only"))
+def pallas_gram(X, s, block_rows=1024, lower_only=False):
+    n, p = X.shape
+    assert n % block_rows == 0 and p % PANEL == 0, (
+        "pallas_gram needs n divisible by block_rows and p by the panel "
+        "width; a partial trailing block would be silently dropped")
+    return pl.pallas_call(
+        partial(_gram_kernel, lower_only=lower_only, p=p),
+        grid=(n // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, p), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((p, p), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((p, p), jnp.float32),
+    )(X, s.reshape(1, 1))
+
+
+def main():
+    res["device"] = str(jax.devices()[0])
+    n, p = 2_097_152, 512
+    res["n"], res["p"] = n, p
+
+    @jax.jit
+    def gen(key):
+        return jax.random.normal(key, (n, p), jnp.float32)
+    X = gen(jax.random.PRNGKey(3))
+    jax.block_until_ready(X)
+
+    # ---- correctness first --------------------------------------------------
+    s1 = jnp.float32(1.0)
+    Gf = pallas_gram(X[:4096], s1, lower_only=False)
+    Gl = pallas_gram(X[:4096], s1, lower_only=True)
+    tril = jnp.tril(jnp.ones((p, p), bool))
+    err = float(jnp.max(jnp.abs(jnp.where(tril, Gl - Gf, 0.0))))
+    scale = float(jnp.max(jnp.abs(Gf)))
+    res["lower_vs_full_maxdiff_rel"] = err / scale
+    dump()
+    print("parity rel:", res["lower_vs_full_maxdiff_rel"], flush=True)
+
+    # ---- chained marginals --------------------------------------------------
+    @partial(jax.jit, static_argnames=("k", "mode"))
+    def chain(X, k, mode):
+        def body(c, _):
+            s = 1.0 + 1e-12 * c
+            if mode == "einsum":
+                Xw = X * s
+                G = jnp.einsum("np,nq->pq", Xw, X,
+                               precision=jax.lax.Precision.DEFAULT,
+                               preferred_element_type=jnp.float32)
+            elif mode == "pallas_full":
+                G = pallas_gram(X, jnp.float32(s), lower_only=False)
+            else:
+                G = pallas_gram(X, jnp.float32(s), lower_only=True)
+            return G[0, 0], G[1, 0]
+        c, _ = lax.scan(body, jnp.float32(0.0), None, length=k)
+        return c
+
+    def timed(fn, *args, reps=4):
+        float(np.asarray(fn(*args)))  # warm + D2H barrier
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(np.asarray(fn(*args)))
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    for mode in ("einsum", "pallas_full", "pallas_lower"):
+        t2 = timed(chain, X, 2, mode)
+        t6 = timed(chain, X, 6, mode)
+        res[f"{mode}_marginal_ms"] = 1e3 * (t6 - t2) / 4
+        if mode == "einsum":
+            # XLA factors the scalar out and hoists X'X across the scan —
+            # this row measures almost nothing (see module docstring)
+            res["einsum_marginal_invalid"] = True
+        dump()
+        print(mode, res[f"{mode}_marginal_ms"], flush=True)
+
+    res["complete"] = True
+    dump()
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()
