@@ -331,3 +331,28 @@ def test_influence_list_object(mesh1):
                    mesh=mesh1)
     il = sg.influence(ml, X[:, :3], y)
     assert hasattr(il, "wt_res") and not hasattr(il, "dev_res")
+
+
+def test_rstudent_quasi_divides_by_sigma(mesh1, rng):
+    """R's rstudent.glm special-cases the families NAMED binomial/poisson:
+    quasipoisson (same fit, estimated dispersion) DIVIDES by sigma_(i),
+    so its rstudent differs from poisson's by exactly that factor."""
+    from sparkglm_tpu.config import NumericConfig
+    n = 120
+    x = rng.standard_normal(n)
+    y = rng.poisson(np.exp(0.4 + 0.5 * x)).astype(float)
+    X = np.column_stack([np.ones(n), x])
+    cfg = NumericConfig(dtype="float64")
+    mp = sg.glm_fit(X, y, family="poisson", tol=1e-12, config=cfg,
+                    mesh=mesh1)
+    mq = sg.glm_fit(X, y, family="quasipoisson", tol=1e-12, config=cfg,
+                    mesh=mesh1)
+    rp = sg.rstudent(mp, X, y)
+    rq = sg.rstudent(mq, X, y)
+    # same coefficients -> same deviance/pearson pieces -> same sigma_(i)
+    _, _, ew, _, h, om, s_i, _ = \
+        __import__("sparkglm_tpu.models.diagnostics",
+                   fromlist=["_deletion_pieces"])._deletion_pieces(
+            mq, X, y, weights=None, offset=None, m=None)
+    np.testing.assert_allclose(rq, rp / s_i, rtol=1e-9)
+    assert not np.allclose(rq, rp)

@@ -445,3 +445,21 @@ def test_gzip_streaming_stays_chunked(tmp_path, rng):
     mp = sg.glm_from_csv("y ~ x", str(plain), family="poisson",
                          chunk_bytes=8 << 10)
     np.testing.assert_allclose(mg.coefficients, mp.coefficients, rtol=1e-10)
+
+
+def test_gzip_cache_invalidates_on_rewrite(tmp_path, rng):
+    """The decompression cache keys on (path, mtime, size): rewriting the
+    .gz must serve the NEW contents, never a stale cached copy."""
+    import gzip
+    import os
+    import sparkglm_tpu as sg
+
+    gz = tmp_path / "c.csv.gz"
+    with gzip.open(gz, "wt") as fh:
+        fh.write("y,x\n1,2\n")
+    assert list(sg.read_csv(str(gz))["y"]) == [1.0]
+    with gzip.open(gz, "wt") as fh:
+        fh.write("y,x\n7,8\n9,10\n")
+    os.utime(gz, (os.path.getmtime(gz) + 2, os.path.getmtime(gz) + 2))
+    got = sg.read_csv(str(gz))
+    assert list(got["y"]) == [7.0, 9.0] and list(got["x"]) == [8.0, 10.0]
