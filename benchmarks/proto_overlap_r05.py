@@ -1,0 +1,171 @@
+"""Prototype: can the fused pass hide its VPU chain under the MXU?
+
+HOTLOOP_r05.md: the fused pass costs ~13.9 ms of which the Gramian GEMM
+is ~7 — the rest is the per-block VPU chain (eta/mu/z/w, XtWz sublane
+sum, deviance) executing SEQUENTIALLY with the MXU dot of the same
+block (a real data dependency).  Hypothesis: splitting each grid step
+into two half-blocks creates INDEPENDENT VPU/MXU work the instruction
+scheduler may interleave — half B's VPU math can run while half A's dot
+occupies the MXU:
+
+    Xw_a, z_a = vpu(a); acc += dot(Xw_a)   # MXU busy...
+    Xw_b, z_b = vpu(b); acc += dot(Xw_b)   # ...while this VPU runs?
+
+Variants (k-marginals, D2H barrier — HOTLOOP_r05.md methodology):
+  mono   the production kernel shape (one 1024-row block per grid step)
+  split2 same 1024 rows per grid step, two interleaved 512-row halves
+
+Writes proto_overlap_r{ROUND}.json via _capture.  ONE tunnel client.
+"""
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/benchmarks")
+
+from _capture import dump_atomic, out_path  # noqa: E402
+
+OUT = out_path("proto_overlap")
+res: dict = {}
+
+
+def dump():
+    dump_atomic(res, OUT)
+
+
+def main():
+    from sparkglm_tpu.families.families import resolve
+    from sparkglm_tpu.ops.fused import _step_math
+
+    fam, lnk = resolve("binomial", "logit")
+    res["device"] = str(jax.devices()[0])
+    n, p = 2_097_152, 512
+    res["n"], res["p"] = n, p
+
+    def kernel(x_ref, y_ref, wt_ref, off_ref, beta_ref,
+               xtwx_ref, xtwz_ref, dev_ref, *, halves, block_rows):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            xtwx_ref[:] = jnp.zeros_like(xtwx_ref)
+            xtwz_ref[:] = jnp.zeros_like(xtwz_ref)
+            dev_ref[:] = jnp.zeros_like(dev_ref)
+
+        h = block_rows // halves
+        for a in range(halves):
+            sl = slice(a * h, (a + 1) * h)
+            Xw, z, _, dev = _step_math(
+                x_ref[sl, :], y_ref[sl, :], wt_ref[sl, :], off_ref[sl, :],
+                beta_ref[:], family=fam, link=lnk, first=False)
+            xtwx_ref[:] += jax.lax.dot_general(
+                Xw, x_ref[sl, :], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.DEFAULT)
+            xtwz_ref[:] += jnp.sum(Xw * z, axis=0, keepdims=True)
+            dev_ref[:] += dev
+
+    @partial(jax.jit, static_argnames=("halves", "block_rows"))
+    def fpass(X, y, wt, off, beta, halves=1, block_rows=1024):
+        nn, pp = X.shape
+        vec = lambda: pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)
+        XtWX, XtWz, dev = pl.pallas_call(
+            partial(kernel, halves=halves, block_rows=block_rows),
+            grid=(nn // block_rows,),
+            in_specs=[
+                pl.BlockSpec((block_rows, pp), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                vec(), vec(), vec(),
+                pl.BlockSpec((1, pp), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((pp, pp), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, pp), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((pp, pp), jnp.float32),
+                jax.ShapeDtypeStruct((1, pp), jnp.float32),
+                jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            ],
+        )(X, y.reshape(nn, 1), wt.reshape(nn, 1), off.reshape(nn, 1),
+          beta.reshape(1, pp))
+        return XtWX, XtWz[0], dev[0, 0]
+
+    @jax.jit
+    def gen(key):
+        kx, kb, ku = jax.random.split(key, 3)
+        X = jax.random.normal(kx, (n, p), jnp.float32).at[:, 0].set(1.0)
+        bt = jax.random.normal(kb, (p,), jnp.float32) / (2.0 * p ** 0.5)
+        y = (jax.random.uniform(ku, (n,))
+             < jax.nn.sigmoid(X @ bt)).astype(jnp.float32)
+        return X, y
+    X, y = gen(jax.random.PRNGKey(7))
+    wt = jnp.ones((n,), jnp.float32)
+    off = jnp.zeros((n,), jnp.float32)
+    b0 = jnp.full((p,), 0.01, jnp.float32)
+    jax.block_until_ready(y)
+
+    # parity of the variants
+    A1, z1, d1 = fpass(X[:8192], y[:8192], wt[:8192], off[:8192], b0,
+                       halves=1)
+    A2, z2, d2 = fpass(X[:8192], y[:8192], wt[:8192], off[:8192], b0,
+                       halves=2)
+    res["split_vs_mono_rel"] = float(
+        jnp.max(jnp.abs(A1 - A2)) / jnp.max(jnp.abs(A1)))
+    dump()
+    print("parity:", res["split_vs_mono_rel"], flush=True)
+
+    @partial(jax.jit, static_argnames=("k", "halves", "block_rows"))
+    def chain(X, y, wt, off, b, k, halves, block_rows=1024):
+        def body(b, _):
+            A, z, dev = fpass(X, y, wt, off, b, halves=halves,
+                              block_rows=block_rows)
+            # cheap data dependency; no solve (isolates the pass)
+            return b + 1e-12 * z, dev
+        bb, _ = lax.scan(body, b, None, length=k)
+        return bb
+
+    def timed(fn, *args, reps=4, **kw):
+        float(np.asarray(fn(*args, **kw)).ravel()[0])  # warm + D2H barrier
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(np.asarray(fn(*args, **kw)).ravel()[0])
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    # NOTE: a 2048-row block OOMs scoped VMEM (21.2M > 16M limit) — halves
+    # subdivide WITHIN the 1024-row budget
+    for tag, halves, br in (("mono_b1024", 1, 1024),
+                            ("split2_b1024", 2, 1024),
+                            ("split4_b1024", 4, 1024)):
+        t2 = timed(chain, X, y, wt, off, b0, k=2, halves=halves,
+                   block_rows=br)
+        t6 = timed(chain, X, y, wt, off, b0, k=6, halves=halves,
+                   block_rows=br)
+        res[f"{tag}_marginal_ms"] = 1e3 * (t6 - t2) / 4
+        dump()
+        print(tag, res[f"{tag}_marginal_ms"], flush=True)
+
+    res["complete"] = True
+    dump()
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()
