@@ -98,6 +98,16 @@ print("rstudent extremes:",
       np.round(np.sort(sg.rstudent(m, data, data["claims"],
                                    weights=data["w"]))[[0, -1]], 3))
 
+# parametric bootstrap material: R's simulate() draws new responses from
+# the fitted family at the fitted values; the fit-time by-name weights
+# column is auto-recovered, and (exactly like R's poisson()$simulate)
+# non-unit prior weights draw a warning and are ignored for poisson
+import warnings as _w
+with _w.catch_warnings():
+    _w.simplefilter("ignore")
+    sims = sg.simulate(m, data, nsim=3, seed=0)
+print("simulate:", sims.shape, "col means", np.round(sims.mean(0), 3))
+
 # ---------------------------------------------------------------------------
 # 4. Scoring — host, and sharded over the mesh (the reference's
 #    executor-side predictMultiple, as one SPMD pass)

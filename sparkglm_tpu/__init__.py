@@ -40,6 +40,7 @@ from .models.negbin import theta_of
 from .models.lm import LMModel
 from .models.lm import fit as lm_fit
 from .models.serialize import load_model, save_model
+from .models.simulate import simulate
 from .models.streaming import glm_fit_streaming, lm_fit_streaming
 from .parallel import distributed
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
@@ -55,7 +56,7 @@ __all__ = [
     "read_parquet", "scan_parquet_schema", "scan_parquet_levels",
     "read_json", "scan_json_schema", "scan_json_levels",
     "lm_fit_streaming", "glm_fit_streaming",
-    "LMModel", "GLMModel", "load_model", "save_model",
+    "LMModel", "GLMModel", "load_model", "save_model", "simulate",
     "anova", "add1", "drop1", "step", "AnovaTable", "confint_profile",
     "TermsPrediction",
     "hatvalues", "rstandard", "rstudent", "cooks_distance",

@@ -1,0 +1,135 @@
+"""R's simulate(): family-faithful response draws at the fitted values.
+Distributional parity asserted by moments (numpy streams are not R's;
+the distributions are)."""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+
+
+def test_simulate_poisson_moments(rng):
+    n = 4000
+    x = rng.standard_normal(n)
+    d = {"y": rng.poisson(np.exp(0.4 + 0.5 * x)).astype(float), "x": x}
+    m = sg.glm("y ~ x", d, family="poisson")
+    sims = sg.simulate(m, d, nsim=50, seed=1)
+    assert sims.shape == (n, 50)
+    mu = sg.predict(m, d)
+    np.testing.assert_allclose(sims.mean(axis=1).mean(), mu.mean(), rtol=0.02)
+    np.testing.assert_allclose(sims.var(axis=1).mean(), mu.mean(), rtol=0.05)
+
+
+def test_simulate_binomial_grouped_returns_proportions(rng):
+    n = 1500
+    x = rng.standard_normal(n)
+    msz = rng.integers(5, 30, n).astype(float)
+    pr = 1 / (1 + np.exp(-(0.3 + 0.6 * x)))
+    s = rng.binomial(msz.astype(int), pr).astype(float)
+    d = {"s": s, "f": msz - s, "x": x}
+    m = sg.glm("cbind(s, f) ~ x", d, family="binomial")
+    sims = sg.simulate(m, d, nsim=40, seed=2, m=msz)
+    assert sims.shape == (n, 40)
+    assert sims.min() >= 0.0 and sims.max() <= 1.0  # proportions, as in R
+    mu = sg.predict(m, d)
+    np.testing.assert_allclose(sims.mean(axis=1), mu, atol=0.12)
+    # non-integer weights are refused (R's binomial simulate refuses too)
+    with pytest.raises(ValueError, match="integer size"):
+        sg.simulate(m, d, nsim=2, m=msz + 0.5)
+
+
+def test_simulate_gamma_lm_and_guards(rng):
+    n = 3000
+    x = rng.standard_normal(n)
+    mu = np.exp(0.4 + 0.3 * x)
+    d = {"y": rng.gamma(4.0, mu / 4.0), "x": x}
+    g = sg.glm("y ~ x", d, family="gamma", link="log")
+    sims = sg.simulate(g, d, nsim=60, seed=3)
+    muh = sg.predict(g, d)
+    np.testing.assert_allclose(sims.mean(axis=1).mean(), muh.mean(),
+                               rtol=0.02)
+    # var(Gamma) = disp * mu^2
+    np.testing.assert_allclose(sims.var(axis=1).mean(),
+                               (g.dispersion * muh ** 2).mean(), rtol=0.12)
+    # lm: gaussian at sigma^2
+    lmod = sg.lm("y ~ x", d)
+    sl = sg.simulate(lmod, d, nsim=60, seed=4)
+    np.testing.assert_allclose(sl.std(axis=1).mean(), lmod.sigma, rtol=0.05)
+    # quasi refusal
+    q = sg.glm("y ~ x", {"y": d["y"].round(), "x": x}, family="quasipoisson")
+    with pytest.raises(ValueError, match="quasi"):
+        sg.simulate(q, d, nsim=1)
+
+
+def test_simulate_negbin_and_invgauss_moments(rng):
+    n = 5000
+    x = rng.standard_normal(n)
+    mu = np.exp(0.4 + 0.4 * x)
+    y = rng.negative_binomial(2.0, 2.0 / (2.0 + mu)).astype(float)
+    d = {"y": y, "x": x}
+    m = sg.glm_nb("y ~ x", d)
+    sims = sg.simulate(m, d, nsim=40, seed=5)
+    muh = sg.predict(m, d)
+    th = sg.theta_of(m)
+    np.testing.assert_allclose(sims.mean(axis=1).mean(), muh.mean(),
+                               rtol=0.03)
+    # var(NB) = mu + mu^2/theta
+    np.testing.assert_allclose(sims.var(axis=1).mean(),
+                               (muh + muh ** 2 / th).mean(), rtol=0.1)
+    # inverse gaussian: mean mu, var disp*mu^3
+    mu_ig = 1.0 / np.sqrt(0.5 + 0.3 * np.abs(x) + 0.2)
+    from sparkglm_tpu.models.simulate import _rinvgauss
+    draws = _rinvgauss(np.random.default_rng(0), mu_ig, np.full(n, 5.0), 30)
+    np.testing.assert_allclose(draws.mean(axis=1).mean(), mu_ig.mean(),
+                               rtol=0.02)
+    np.testing.assert_allclose(draws.var(axis=1).mean(),
+                               (mu_ig ** 3 / 5.0).mean(), rtol=0.12)
+
+
+def test_simulate_recovers_fit_time_offset(rng):
+    """A fit-time offset() column travels with the model into simulate
+    exactly as it does into predict — caught live in review: forwarding
+    offset=None was suppressing the recovery."""
+    n = 2000
+    x = rng.standard_normal(n)
+    off = rng.uniform(0, 1, n)
+    d = {"y": rng.poisson(np.exp(0.2 + 0.4 * x + off)).astype(float),
+         "x": x, "lo": off}
+    m = sg.glm("y ~ x + offset(lo)", d, family="poisson")
+    sims = sg.simulate(m, d, nsim=100, seed=1)
+    mu = np.asarray(sg.predict(m, d, type="response"))
+    np.testing.assert_allclose(sims.mean(), mu.mean(), rtol=0.03)
+
+
+def test_simulate_carries_fit_weights_and_gamma_ml_shape(rng):
+    """Fit-time by-name weights travel into simulate (R uses the stored
+    prior.weights); the Gamma shape is the MASS ML estimate from the
+    training response, not 1/Pearson-dispersion."""
+    from sparkglm_tpu.models.simulate import _gamma_shape_ml
+    n = 4000
+    x = rng.standard_normal(n)
+    w = rng.uniform(0.5, 3.0, n)
+    mu = np.exp(0.4 + 0.3 * x)
+    # weighted gamma: obs i ~ Gamma(shape 4*w_i, mean mu_i)
+    y = rng.gamma(4.0 * w, mu / (4.0 * w))
+    d = {"y": y, "x": x, "w": w}
+    g = sg.glm("y ~ x", d, family="gamma", link="log", weights="w")
+    muh = np.asarray(sg.predict(g, d))
+    alpha = _gamma_shape_ml(y, muh, w, g)
+    np.testing.assert_allclose(alpha, 4.0, rtol=0.1)  # ML recovers truth
+    # simulate auto-recovers the weights column: heavier rows draw tighter
+    sims = sg.simulate(g, d, nsim=200, seed=9)
+    v = sims.var(axis=1)
+    lo, hi = w < np.quantile(w, 0.2), w > np.quantile(w, 0.8)
+    # var = mu^2/(alpha w): normalize by mu^2 and compare weight bands
+    assert (v[lo] / muh[lo] ** 2).mean() > 2.0 * (v[hi] / muh[hi] ** 2).mean()
+    # a FORMULA fit with ARRAY weights refuses silent unweighted draws
+    gaw = sg.glm("y ~ x", d, family="gamma", link="log", weights=w)
+    with pytest.raises(ValueError, match="array weights"):
+        sg.simulate(gaw, d, nsim=1)
+    # ...and an array-fit model simulates on its design with explicit
+    # weights (provenance is the caller's there)
+    ga = sg.glm_fit(np.c_[np.ones(n), x].astype(np.float64), y,
+                    family="gamma", link="log", weights=w)
+    s2 = sg.simulate(ga, np.c_[np.ones(n), x], nsim=3, weights=w, y=y)
+    assert s2.shape == (n, 3) and np.all(s2 > 0)
