@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
-.PHONY: all native test bench clean
+.PHONY: all native test bench robust clean
 
 all: native
 
@@ -21,6 +21,11 @@ test: native
 
 bench: native
 	python bench.py
+
+# fault-tolerance suite (sparkglm_tpu/robust): injected transients,
+# checkpoint/resume, step-halving — deterministic, CPU-only, fast
+robust:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_robust.py -q
 
 clean:
 	rm -f $(SO)

@@ -360,6 +360,47 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — a broken kernel must not lose the bench line
             detail["pallas"] = dict(ok=False, error=repr(e)[:300])
 
+    # ---- fault-injection recovery overhead (sparkglm_tpu/robust) -----------
+    # the same streaming fit clean vs with scheduled transient faults
+    # absorbed by retry= (no backoff sleep: the delta is pure re-read +
+    # re-transfer work, the part that scales with chunk size)
+    try:
+        import sparkglm_tpu as sg
+        from sparkglm_tpu.robust import FaultPlan, RetryPolicy, faulty_source
+
+        np_rng = np.random.default_rng(11)
+        nr, pr = 200_000, 32
+        Xr = np_rng.standard_normal((nr, pr)).astype(np.float32)
+        Xr[:, 0] = 1.0
+        btr = (np_rng.standard_normal(pr) / 8).astype(np.float32)
+        yr = (np_rng.random(nr) < 1 / (1 + np.exp(-(Xr @ btr)))).astype(
+            np.float32)
+
+        def chunk_src():
+            for i in range(8):
+                lo, hi = nr * i // 8, nr * (i + 1) // 8
+                yield lambda lo=lo, hi=hi: (Xr[lo:hi], yr[lo:hi], None, None)
+
+        skw = dict(family="binomial", tol=1e-6, cache="none")
+        sg.glm_fit_streaming(chunk_src, **skw)  # warm compile
+        t0 = time.perf_counter()
+        m_clean = sg.glm_fit_streaming(chunk_src, **skw)
+        t_clean = time.perf_counter() - t0
+        plan = FaultPlan(transient_at=(2, 9, 17, 25))
+        t0 = time.perf_counter()
+        m_faulty = sg.glm_fit_streaming(
+            faulty_source(chunk_src, plan), retry=RetryPolicy(
+                sleep=lambda s: None), **skw)
+        t_faulty = time.perf_counter() - t0
+        detail["fault_recovery"] = dict(
+            clean_s=round(t_clean, 4), faulted_s=round(t_faulty, 4),
+            overhead_frac=round(t_faulty / t_clean - 1.0, 4),
+            transients_injected=plan.faults_fired,
+            bit_identical=bool(np.array_equal(m_clean.coefficients,
+                                              m_faulty.coefficients)))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["fault_recovery"] = dict(error=repr(e)[:300])
+
     print(json.dumps({
         "metric": "logistic_"
                   + (f"{n // 1_000_000}M" if n >= 1_000_000 else f"{n // 1000}k")

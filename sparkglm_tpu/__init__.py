@@ -45,6 +45,7 @@ from .models.streaming import glm_fit_streaming, lm_fit_streaming
 from .parallel import distributed
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
 from .utils import profiling
+from . import robust
 
 __version__ = "0.1.0"
 
@@ -71,4 +72,5 @@ __all__ = [
     "make_mesh", "shard_rows", "single_device_mesh", "distributed",
     "profiling",
     "NumericConfig", "DEFAULT",
+    "robust",
 ]

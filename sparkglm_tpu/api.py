@@ -437,7 +437,8 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
                  mesh=None, cache: str = "auto", parse_cache="auto",
                  verbose: bool = False,
                  beta0=None, on_iteration=None, native: bool | None = None,
-                 backend: str = "auto",
+                 backend: str = "auto", retry=None, checkpoint=None,
+                 resume=False,
                  config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """Fit a GLM by formula straight from a CSV too big to load.
 
@@ -453,6 +454,12 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
     The reference's closest analogue collects the whole dataset to the
     driver (``dfToDenseMatrix``, utils.scala:42-49) — there is no
     out-of-memory story there at all (SURVEY.md §7 hard part #4).
+
+    Fault tolerance (``robust``): ``retry=`` (a ``RetryPolicy``) re-reads
+    chunks that fail transiently mid-pass; ``checkpoint=`` (a path or
+    ``CheckpointManager``) persists IRLS state after every iteration and
+    ``resume=True`` (or ``resume=path``) continues a preempted fit
+    bit-for-bit (``models/streaming.py``).
     """
     from .models import streaming
 
@@ -482,6 +489,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
             criterion=criterion, xnames=terms.xnames, yname=yname,
             has_intercept=f.intercept, mesh=mesh, cache=cache,
             verbose=verbose, beta0=beta0, on_iteration=on_iteration,
+            retry=retry, checkpoint=checkpoint, resume=resume,
             config=config)
     finally:
         parse_cleanup()
@@ -495,7 +503,8 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
 def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
                 na_omit: bool = True, chunk_bytes: int = 256 << 20,
                 mesh=None, native: bool | None = None, parse_cache="auto",
-                backend: str = "auto",
+                backend: str = "auto", retry=None, checkpoint=None,
+                resume=False,
                 config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """OLS/WLS by formula straight from a CSV too big to load (two
     streaming passes: Gramian accumulation, then the exact host-f64
@@ -531,7 +540,8 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
     try:
         model = streaming.lm_fit_streaming(
             source, xnames=terms.xnames, yname=f.response,
-            has_intercept=f.intercept, mesh=mesh, config=config)
+            has_intercept=f.intercept, mesh=mesh, retry=retry,
+            checkpoint=checkpoint, resume=resume, config=config)
     finally:
         parse_cleanup()
     import dataclasses

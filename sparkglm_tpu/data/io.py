@@ -292,18 +292,29 @@ def scan_csv_levels(path: str, *, native: bool | None = None,
 
 def read_csv(path: str, *, shard_index: int = 0, num_shards: int = 1,
              schema: dict[str, int] | None = None,
-             native: bool | None = None) -> dict[str, np.ndarray]:
+             native: bool | None = None,
+             retry=None) -> dict[str, np.ndarray]:
     """Read a CSV into name -> column arrays (float64 or str).
 
     ``shard_index``/``num_shards`` select a newline-aligned byte-range slice
     of the file — the per-host loading pattern for multi-host meshes; pass a
     ``scan_csv_schema`` result as ``schema=`` to pin column kinds across
     shards.  ``native=None`` auto-selects the C++ loader when it
-    builds/loads.
+    builds/loads.  ``retry=`` takes a ``robust.RetryPolicy``: transient
+    read failures (OSError and ``TransientSourceError`` by default — NFS
+    blips, object-store timeouts) re-read the slice under capped
+    exponential backoff instead of killing a multi-pass fit.
     """
     if num_shards < 1 or not (0 <= shard_index < num_shards):
         raise ValueError(
             f"need 0 <= shard_index < num_shards, got {shard_index}/{num_shards}")
+    if retry is not None:
+        from ..robust.retry import call_with_retry
+        return call_with_retry(
+            lambda: read_csv(path, shard_index=shard_index,
+                             num_shards=num_shards, schema=schema,
+                             native=native),
+            policy=retry, key=f"read_csv:{path}:{shard_index}/{num_shards}")
     path = resolve_gz(path, shard_index, num_shards, "read_csv")
     lib = _load() if native in (None, True) else None
     if native is True and lib is None:

@@ -111,7 +111,8 @@ def _column_out(pa, col, kind: int) -> np.ndarray:
 
 def read_parquet(path: str, *, shard_index: int = 0, num_shards: int = 1,
                  schema: dict[str, int] | None = None,
-                 columns: list[str] | None = None) -> dict[str, np.ndarray]:
+                 columns: list[str] | None = None,
+                 retry=None) -> dict[str, np.ndarray]:
     """Read a contiguous row-group band into name -> column arrays.
 
     The per-host loading pattern for multi-host meshes, mirroring
@@ -119,11 +120,21 @@ def read_parquet(path: str, *, shard_index: int = 0, num_shards: int = 1,
     band, builds its design from the GLOBAL ``scan_parquet_levels``, and
     streams through its local devices (tests/test_multiprocess.py flow).
     ``columns`` prunes the read to the named columns (Parquet reads are
-    columnar — the pruning actually skips IO, unlike CSV).
+    columnar — the pruning actually skips IO, unlike CSV).  ``retry=``
+    takes a ``robust.RetryPolicy`` and re-reads the band on transient IO
+    failures with capped exponential backoff (``read_csv`` contract).
     """
     if num_shards < 1 or not (0 <= shard_index < num_shards):
         raise ValueError(
             f"need 0 <= shard_index < num_shards, got {shard_index}/{num_shards}")
+    if retry is not None:
+        from ..robust.retry import call_with_retry
+        return call_with_retry(
+            lambda: read_parquet(path, shard_index=shard_index,
+                                 num_shards=num_shards, schema=schema,
+                                 columns=columns),
+            policy=retry,
+            key=f"read_parquet:{path}:{shard_index}/{num_shards}")
     pa, pq = _pq()
     pf = pq.ParquetFile(path)
     if schema is None:

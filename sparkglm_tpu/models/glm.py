@@ -52,6 +52,26 @@ from ..parallel import mesh as meshlib
 
 _BIG = jnp.inf
 
+# step-halving recovery (robust subsystem, device-side piece): when a
+# Newton/Fisher step lands on a non-finite deviance or a genuine deviance
+# INCREASE, halve the step toward the previous beta up to this many times —
+# R glm.fit's inner "step size truncated" loops (plus glm2's
+# halve-on-increase rule) instead of warning-and-returning garbage.  The
+# increase test carries slack in units of the convergence criterion's
+# denominator (|dev| + 0.1): f32 deviance accumulation is only ~eps32
+# reproducible near the optimum, and halving on accumulation noise would
+# stall converged fits.
+STEP_HALVINGS = 15
+_HALF_SLACK = 1e-4
+
+
+def _dev_bad(dev_new, dev_old, slack=_HALF_SLACK):
+    """True when the step producing ``dev_new`` must be halved: non-finite
+    deviance (R glm.fit "inner loop 1") or an increase beyond noise slack
+    over ``dev_old`` (glm2's ascent guard)."""
+    return (~jnp.isfinite(dev_new)
+            | (dev_new - dev_old > slack * (jnp.abs(dev_old) + 0.1)))
+
 
 def _sanitize(x, valid, fill=0.0):
     """Padded (weight-0) rows can produce inf/nan in link space (e.g. the
@@ -127,6 +147,10 @@ def _irls_kernel(
         fac_a=jnp.eye(p, dtype=acc),
         fac_d=jnp.ones((p,), acc),
         singular=jnp.zeros((), jnp.bool_),
+        # True once STEP_HALVINGS halvings could not restore a finite,
+        # non-increasing deviance: the fit cannot make progress from here
+        # (R's "inner loop; cannot correct step size" error, as a flag)
+        stalled=jnp.zeros((), jnp.bool_),
         pivot=jnp.ones((), acc),  # equilibrated min pivot ~ 1/kappa(X)
         # first iteration's Gramian, kept for the singular='drop' host rank
         # check — saves the dedicated pre-pass over the data (ADVICE r1)
@@ -139,7 +163,7 @@ def _irls_kernel(
         d = s["ddev"]
         if criterion == "relative":
             d = d / (jnp.abs(s["dev"]) + 0.1)
-        return (s["it"] < max_iter) & (d > tol) & ~s["singular"]
+        return (s["it"] < max_iter) & (d > tol) & ~s["singular"] & ~s["stalled"]
 
     def body(s):
         mu, eta = s["mu"], s["eta"]
@@ -169,7 +193,37 @@ def _irls_kernel(
         fac_d = jnp.where(singular, s["fac_d"], fac_d)
         eta_new = (X @ beta + offset).astype(X.dtype)      # ref: etaCreate :321-332
         mu_new = jnp.where(valid, link.inverse(eta_new), 1.0).astype(X.dtype)  # ref: muCreate :334-355
-        dev_new = dev_of(mu_new)
+        dev_new = dev_of(mu_new).astype(acc)
+
+        # step-halving recovery: walk beta back toward the previous iterate
+        # while the step's deviance is non-finite or increasing (R glm.fit
+        # "step size truncated due to divergence").  Costs one extra
+        # X @ beta + deviance per halving, and nothing when the step is
+        # fine (the loop condition fails on entry).  Gated to iterations
+        # whose baseline deviance belongs to an actual ITERATE: the cold
+        # start's dev0 is measured at the family-init mu (near-saturated,
+        # no beta produces it), so comparing the first step against it
+        # would halve every fit toward beta=0 (glm2 gates the same way);
+        # a warm start's dev0 is dev(beta0) and halving may engage at once
+        halve_ok = jnp.asarray(True) if warm else s["it"] > 0
+
+        def h_cond(h):
+            return (_dev_bad(h["dev"], s["dev"]) & halve_ok
+                    & (h["k"] < STEP_HALVINGS))
+
+        def h_body(h):
+            b = (0.5 * (h["beta"] + s["beta"])).astype(X.dtype)
+            e = (X @ b + offset).astype(X.dtype)
+            m = jnp.where(valid, link.inverse(e), 1.0).astype(X.dtype)
+            return dict(k=h["k"] + 1, beta=b, eta=e, mu=m,
+                        dev=dev_of(m).astype(acc))
+
+        h = jax.lax.while_loop(h_cond, h_body, dict(
+            k=jnp.zeros((), jnp.int32), beta=beta.astype(X.dtype),
+            eta=eta_new, mu=mu_new, dev=dev_new))
+        beta, eta_new, mu_new, dev_new = h["beta"], h["eta"], h["mu"], h["dev"]
+        # still bad after K halvings (ungated iterations never stall)
+        stalled = _dev_bad(dev_new, s["dev"]) & halve_ok
         if trace:
             # the reference's verbose "iter\tddev" line (GLM.scala:304,461);
             # it_base keeps numbering monotone across checkpoint segments
@@ -187,6 +241,7 @@ def _irls_kernel(
             fac_a=fac_a,
             fac_d=fac_d,
             singular=singular,
+            stalled=stalled,
             pivot=pivot.astype(acc),
             XtWX0=jnp.where(s["it"] == 0, XtWX.astype(acc), s["XtWX0"]),
         )
@@ -206,7 +261,7 @@ def _irls_kernel(
     else:
         cov_final = inv_from_parts(s["fac_a"], s["fac_d"], p, acc)
     d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
-    converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"]
+    converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"] & ~s["stalled"]
 
     return dict(beta=s["beta"], cov_inv=cov_final, dev=s["dev"],
                 eta=s["eta"], iters=s["it"], converged=converged,
@@ -358,10 +413,10 @@ def _irls_fused_kernel(
                     jax.lax.psum(XtWz, meshlib.DATA_AXIS),
                     jax.lax.psum(dev, meshlib.DATA_AXIS))
         d = meshlib.DATA_AXIS
-        fn = jax.shard_map(
+        fn = meshlib.shard_map(
             f, mesh=mesh,
             in_specs=(P(d, None), P(d), P(d), P(d), P(), P()),
-            out_specs=(P(), P(), P()), check_vma=False)
+            out_specs=(P(), P(), P()))
         return lambda Xs, ys, ws, os_, beta: fn(Xs, ys, ws, os_, beta,
                                                 fp_arr)
 
@@ -388,11 +443,16 @@ def _irls_fused_kernel(
         state0 = dict(
             it=jnp.zeros((), jnp.int32),
             beta=beta_init,
+            # no previous iterate survived the crash; zeros (eta=offset) is
+            # the only safe retreat if beta0's very first pass diverges
+            beta_prev=jnp.zeros((p,), bdt),
             dev=dev0,
             ddev=jnp.asarray(_BIG, acc),
+            halvings=jnp.zeros((), jnp.int32),
             fac_a=fac_init[0],
             fac_d=fac_init[1],
             singular=jnp.zeros((), jnp.bool_),
+            stalled=jnp.zeros((), jnp.bool_),
             pivot=jnp.ones((), acc),
             # warm mode captures the first in-loop Gramian for the
             # singular='drop' host rank check (no hoisted pass to take
@@ -408,11 +468,14 @@ def _irls_fused_kernel(
             # iteration numbering (the hoisted init solve is iteration 0)
             it=jnp.zeros((), jnp.int32),
             beta=beta1.astype(bdt),
+            beta_prev=beta_init,
             dev=dev0.astype(acc),
             ddev=jnp.asarray(_BIG, acc),
+            halvings=jnp.zeros((), jnp.int32),
             fac_a=fac0[0],
             fac_d=fac0[1],
             singular=sing0,
+            stalled=jnp.zeros((), jnp.bool_),
             pivot=piv0.astype(acc),
         )
     step = spmd_pass(False)
@@ -423,10 +486,26 @@ def _irls_fused_kernel(
         d = s["ddev"]
         if criterion == "relative":
             d = d / (jnp.abs(s["dev"]) + 0.1)
-        return (s["it"] < max_iter) & (d > tol) & ~s["singular"]
+        return (s["it"] < max_iter) & (d > tol) & ~s["singular"] & ~s["stalled"]
 
     def body(s):
         XtWX, XtWz, dev = step(X, y, wt, offset, s["beta"])
+        dev = dev.astype(acc)
+        # lagged-deviance step-halving: the measured deviance belongs to
+        # the INCOMING beta, so a bad value convicts the step that produced
+        # s["beta"] — retract to the midpoint of s["beta_prev"] (the last
+        # iterate with a good measured deviance) and s["beta"], keep the
+        # old deviance baseline, and re-measure next trip.  A halving
+        # chain therefore spends loop iterations, counted against
+        # max_iter (the einsum kernel's inner halving loop does not).
+        # gated to trips with a REAL retreat target: from the second trip
+        # on, s["beta_prev"] is an iterate whose measured deviance is the
+        # carried baseline; on the first trip the baseline is the init-mu
+        # deviance (cold) or the segment sentinel (warm) and beta_prev is
+        # zeros — comparing/retreating there would stall healthy fits
+        bad = _dev_bad(dev, s["dev"]) & (s["it"] > 0)
+        can_halve = bad & (s["halvings"] < STEP_HALVINGS)
+        stalled = bad & (s["halvings"] >= STEP_HALVINGS)
         beta_new, fac, singular, pivot = solve(XtWX, XtWz, s["beta"],
                                                (s["fac_a"], s["fac_d"]))
         if trace:
@@ -434,16 +513,31 @@ def _irls_fused_kernel(
             jax.debug.print("iter {i}\tdeviance {d}\tddev {dd}",
                             i=s["it"] + 1 + (0 if it_base is None else it_base),
                             d=dev,
-                            dd=jnp.abs(dev.astype(acc) - s["dev"]))
+                            dd=jnp.abs(dev - s["dev"]))
+        mid = (0.5 * (s["beta"].astype(jnp.float32)
+                      + s["beta_prev"].astype(jnp.float32))).astype(bdt)
+        # a retracted (or stalled) trip must not adopt the solve produced
+        # by the diverged pass: its Gramian/factor/singular flag are
+        # computed from garbage weights
+        keep = can_halve | stalled
         out = dict(
             it=s["it"] + 1,
-            beta=beta_new.astype(bdt),
-            dev=dev.astype(acc),
-            ddev=jnp.abs(dev.astype(acc) - s["dev"]),
-            fac_a=fac[0],
-            fac_d=fac[1],
-            singular=singular,
-            pivot=pivot.astype(acc),
+            beta=jnp.where(stalled, s["beta_prev"],
+                           jnp.where(can_halve, mid, beta_new.astype(bdt))),
+            beta_prev=jnp.where(keep, s["beta_prev"], s["beta"]),
+            dev=jnp.where(keep, s["dev"], dev),
+            # inf, not |dev - base|, while halving: a retracted trip has
+            # made no measured progress and must not read as converged
+            ddev=jnp.where(bad, jnp.asarray(_BIG, acc),
+                           jnp.abs(dev - s["dev"])),
+            halvings=jnp.where(can_halve, s["halvings"] + 1,
+                               jnp.where(bad, s["halvings"],
+                                         jnp.zeros((), jnp.int32))),
+            fac_a=jnp.where(keep, s["fac_a"], fac[0]),
+            fac_d=jnp.where(keep, s["fac_d"], fac[1]),
+            singular=jnp.where(keep, s["singular"], singular),
+            stalled=stalled,
+            pivot=jnp.where(keep, s["pivot"], pivot.astype(acc)),
         )
         if warm:
             out["XtWX0"] = jnp.where(s["it"] == 0, XtWX.astype(acc),
@@ -459,7 +553,7 @@ def _irls_fused_kernel(
     beta_f = s["beta"]
     eta = (X @ beta_f + offset).astype(bdt)
     d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
-    converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"]
+    converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"] & ~s["stalled"]
 
     return dict(beta=beta_f, cov_inv=cov_final, dev=s["dev"],
                 eta=eta, iters=s["it"], converged=converged,

@@ -58,7 +58,14 @@ def simulate(model, data, *, nsim: int = 1, seed=None, weights=None,
     is_glm = hasattr(model, "family")
     if getattr(model, "terms", None) is None \
             and isinstance(data, np.ndarray) and data.ndim == 2:
-        # array-fit model scored on its aligned design matrix
+        # array-fit model scored on its aligned design matrix; a fit-time
+        # offset cannot be recovered from a bare matrix, so omitting it
+        # would silently draw at the wrong means (_recover_offset contract,
+        # diagnostics.py)
+        if offset is None and getattr(model, "has_offset", False):
+            raise ValueError(
+                "model was fit with an offset that cannot be recovered from "
+                "a design matrix; pass offset= to simulate")
         mu = (model.predict(data, type="response", offset=offset) if is_glm
               else model.predict(data, offset=offset))
     else:
@@ -90,13 +97,16 @@ def simulate(model, data, *, nsim: int = 1, seed=None, weights=None,
         return rng.normal(mu[:, None], sd[:, None], size=(n, nsim))
     if fam == "binomial":
         sz = np.round(wt).astype(np.int64)
-        if np.any(np.abs(wt - sz) > 1e-8) or np.any(sz < 1):
+        if np.any(np.abs(wt - sz) > 1e-8) or np.any(sz < 0):
             raise ValueError(
                 "binomial simulate needs integer size weights (the group "
                 "sizes m); got non-integer prior weights, as R refuses")
         draws = rng.binomial(sz[:, None], np.clip(mu, 0.0, 1.0)[:, None],
                              size=(n, nsim))
-        return draws / sz[:, None]
+        # a zero-weight row draws rbinom(size=0)=0 and divides to NaN —
+        # exactly R's 0/0 in binomial()$simulate, not an error
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return draws / sz[:, None]
     if fam == "poisson":
         if np.any(wt != 1.0):
             import warnings
@@ -153,7 +163,7 @@ def _resolve_response(model, data, y):
 
 
 def _gamma_shape_ml(y, mu, wt, model, it_lim: int = 10,
-                    eps_max: float = 2e-4):
+                    eps_max: float = float(np.finfo(np.float64).eps) ** 0.25):
     """MASS::gamma.shape.glm — Newton on the ML score for the gamma shape
     alpha with the fitted means held fixed (obs i ~ Gamma(shape = w_i a,
     rate = w_i a / mu_i)):
@@ -161,7 +171,10 @@ def _gamma_shape_ml(y, mu, wt, model, it_lim: int = 10,
         score(a) = sum_i w_i [ log(y_i/mu_i) - y_i/mu_i + 1
                                + log(w_i a) - psi(w_i a) ]
 
-    started from MASS's deviance-based moment estimate."""
+    started from MASS's deviance-based moment estimate.  The convergence
+    tolerance and the non-convergence warning are MASS's own:
+    ``eps.max = .Machine$double.eps^0.25`` and "iteration limit reached"
+    when the Newton loop exits on ``it.lim``."""
     from scipy import special as sp
 
     dbar = float(model.deviance) / max(int(model.df_residual), 1)
@@ -177,6 +190,9 @@ def _gamma_shape_ml(y, mu, wt, model, it_lim: int = 10,
             return None  # degenerate data: caller falls back
         if abs(step) < eps_max:
             break
+    else:
+        import warnings
+        warnings.warn("iteration limit reached", stacklevel=2)
     return float(alpha)
 
 

@@ -214,6 +214,56 @@ def _is_device_chunk(Xc) -> bool:
     return isinstance(Xc, jax.Array)
 
 
+def _source_first_fingerprint(chunks) -> tuple:
+    """Materialize the source's first chunk for checkpoint identity:
+    ``(fingerprint, p)``.  Device-chunk sources (programmatic, on-device
+    RNG) get a shape-only fingerprint — per-scalar corner pulls are RPCs
+    over the tunnel, and such sources are not the changed-file failure
+    class the fingerprint guards."""
+    first = next(iter(chunks()), None)
+    if first is None:
+        raise ValueError("source yielded no chunks")
+    Xc0, yc0, wc0, oc0 = _materialize(first)
+    if _is_device_chunk(Xc0):
+        return (int(Xc0.shape[0]), int(Xc0.shape[1])), int(Xc0.shape[1])
+    Xc0 = np.asarray(Xc0)
+    return _fingerprint(Xc0, yc0, wc0, oc0), int(Xc0.shape[1])
+
+
+def _resolve_resume(checkpoint, resume, nproc: int):
+    """Shared ``checkpoint=``/``resume=`` plumbing for the streaming fits.
+
+    Returns ``(ckpt, resume_ck, state)``: the save target, the resume
+    source (``resume=True`` means "the save target"; a path/manager names
+    one explicitly), and the loaded state (None when there is nothing to
+    resume — a missing checkpoint file starts fresh, which is what a
+    preemption-restart loop wants on its very first run).
+
+    Multi-process coherence: the per-process load results are compared via
+    allgather — a mixed decision (some processes resuming, or from
+    different iterations) would desynchronize the per-pass collectives, so
+    it is refused everywhere instead.
+    """
+    from ..robust.checkpoint import as_checkpoint
+    ckpt = as_checkpoint(checkpoint)
+    resume_ck = ckpt if (resume is True and ckpt is not None) \
+        else as_checkpoint(resume)
+    state = None
+    if resume_ck is not None and resume_ck.exists():
+        state = resume_ck.load()
+    if nproc > 1 and (ckpt is not None or resume_ck is not None):
+        from jax.experimental import multihost_utils as mh
+        have = -1 if state is None else int(state.get("iters", 0))
+        hs = np.asarray(mh.process_allgather(
+            np.asarray([have], np.int64))).ravel()
+        if not (hs == hs[0]).all():
+            raise ValueError(
+                f"inconsistent resume state across processes (per-process "
+                f"checkpoint iterations {hs.tolist()}; -1 = no checkpoint) "
+                "— every process must resume from the same iteration")
+    return ckpt, resume_ck, state
+
+
 def _put_chunk(Xc, yc, wc, oc, mesh, dtype):
     """Shard one chunk; padding rows get weight 0 (inert in every sum).
 
@@ -613,6 +663,9 @@ def lm_fit_streaming(
     yname: str = "y",
     has_intercept: bool | None = None,
     mesh=None,
+    retry=None,
+    checkpoint=None,
+    resume=False,
     config: NumericConfig = DEFAULT,
 ) -> LMModel:
     """OLS/WLS in ONE streaming pass (host-f64 accumulation + solve).
@@ -626,21 +679,57 @@ def lm_fit_streaming(
     Multi-process: each process streams its own chunk source; the host-f64
     accumulators are allsummed across processes (see the multi-host
     composition note above) and every process returns the identical model.
+
+    Fault tolerance (``sparkglm_tpu.robust``): ``retry=`` takes a
+    :class:`~sparkglm_tpu.robust.RetryPolicy` and absorbs transient source
+    errors with capped backoff under a per-pass budget; ``checkpoint=``
+    (path or :class:`~sparkglm_tpu.robust.CheckpointManager`) atomically
+    saves the accumulated Gramian state after the expensive first pass, and
+    ``resume=`` (True, or an explicit path/manager) restores it — skipping
+    that pass — after validating the chunk-source fingerprint.  The cheap
+    host-side residual passes re-run on resume; the result is bit-identical
+    to an uninterrupted fit.
     """
     _check_polish(config)
     nproc = jax.process_count()
     mesh = _streaming_mesh(mesh)
     chunks = _as_source(source, chunk_rows)
+    if retry is not None:
+        from ..robust.retry import retrying_source
+        chunks = retrying_source(chunks, retry)
+    ckpt, resume_ck, _ck_state = _resolve_resume(checkpoint, resume, nproc)
 
     acc = None
     dtype = None
     ones_mask = None
     saw_offset = False
     saw_weights = False
+    src_fp = None
     n = 0
+    if _ck_state is not None:
+        # resume: restore the post-reduction accumulator state (identical
+        # on every process) and skip the Gramian pass below entirely
+        src_fp, p_live = _source_first_fingerprint(chunks)
+        resume_ck.validate(_ck_state, kind="lm", fingerprint=src_fp, p=p_live)
+        acc = {"XtWX": np.asarray(_ck_state["XtWX"], np.float64),
+               "XtWy": np.asarray(_ck_state["XtWy"], np.float64),
+               "sw": float(_ck_state["sw"]),
+               "swy": float(_ck_state["swy"]),
+               "n_ok": float(_ck_state["n_ok"])}
+        n = int(_ck_state["n"])
+        saw_offset = bool(_ck_state["saw_offset"])
+        saw_weights = bool(_ck_state["saw_weights"])
+        om = np.asarray(_ck_state["ones_mask"])
+        ones_mask = om.astype(bool) if om.size else None
+        dtype = np.dtype(str(_ck_state["dtype"]))
     err = None
     try:
-        for Xc, yc, wc, oc in _iter_chunks(chunks):
+        for Xc, yc, wc, oc in ([] if _ck_state is not None
+                               else _iter_chunks(chunks)):
+            if src_fp is None:
+                src_fp = ((int(Xc.shape[0]), int(Xc.shape[1]))
+                          if _is_device_chunk(Xc)
+                          else _fingerprint(Xc, yc, wc, oc))
             if dtype is None:
                 dtype = _resolve_dtype(Xc, config)
             if has_intercept is None:
@@ -688,7 +777,7 @@ def lm_fit_streaming(
         _sync_errors(err)
 
     p = acc["XtWX"].shape[0]
-    if nproc > 1:
+    if nproc > 1 and _ck_state is None:
         from ..parallel import distributed as dist
         _sync_design_width(p)
         flat = np.concatenate(
@@ -706,6 +795,16 @@ def lm_fit_streaming(
         saw_weights = bool(tot[base + 5] > 0)  # any process got weights
         if ones_mask is not None:
             ones_mask = tot[base + 6:] == nproc
+    if ckpt is not None and _ck_state is None:
+        # after the reduction: the saved accumulators are the GLOBAL ones,
+        # so a resumed run restores them on every process without resumming
+        ckpt.save(kind="lm", fingerprint=src_fp, p=p,
+                  XtWX=acc["XtWX"], XtWy=acc["XtWy"], sw=acc["sw"],
+                  swy=acc["swy"], n_ok=acc["n_ok"], n=n,
+                  saw_offset=saw_offset, saw_weights=saw_weights,
+                  ones_mask=(np.zeros(0, np.int8) if ones_mask is None
+                             else ones_mask.astype(np.int8)),
+                  dtype=str(np.dtype(dtype)))
     if xnames is None:
         xnames = tuple(f"x{i}" for i in range(p))
     xnames = tuple(xnames)
@@ -873,6 +972,9 @@ def glm_fit_streaming(
     on_iteration=None,
     cache: str = "auto",
     cache_budget_bytes: int | None = None,
+    retry=None,
+    checkpoint=None,
+    resume=False,
     config: NumericConfig = DEFAULT,
     _null_model: bool = False,
 ) -> GLMModel:
@@ -897,6 +999,21 @@ def glm_fit_streaming(
     last checkpoint, skipping the family-init pass.  A warm-started run
     continues exactly where the interrupted one stopped (same fixed point;
     iteration counts restart).
+
+    The managed version of that contract (``sparkglm_tpu.robust``):
+    ``checkpoint=`` (path or :class:`~sparkglm_tpu.robust.CheckpointManager`)
+    atomically saves (iteration, beta, deviance baseline, chunk-source
+    fingerprint) after every IRLS iteration, and ``resume=`` (True, or an
+    explicit path/manager) validates the fingerprint against the live
+    source and CONTINUES the interrupted trajectory — the resumed run's
+    remaining passes, iteration counts, and final coefficients are
+    bit-for-bit those of an uninterrupted run.  A missing checkpoint file
+    starts fresh, so a preemption-restart loop can pass both arguments
+    unconditionally.  ``retry=`` takes a
+    :class:`~sparkglm_tpu.robust.RetryPolicy` and absorbs transient source
+    errors with capped backoff under a per-pass budget; exhausted budgets
+    (and fatal errors) raise, synchronized across processes by the same
+    flag exchange as any other streaming failure.
     """
     if criterion not in ("absolute", "relative"):
         raise ValueError(
@@ -906,11 +1023,16 @@ def glm_fit_streaming(
     nproc = jax.process_count()
     mesh = _streaming_mesh(mesh)
     chunks = _as_source(source, chunk_rows)
+    if retry is not None:
+        from ..robust.retry import retrying_source
+        chunks = retrying_source(chunks, retry)
+    ckpt, resume_ck, _ck_state = _resolve_resume(checkpoint, resume, nproc)
 
     n_total = 0
     saw_offset = False
     dtype = None
     ones_mask = None
+    src_fp = None  # first-chunk fingerprint, for checkpoint identity
     scan_intercept = has_intercept is None
     scanned = False  # metadata (intercept/offset) scan done on the 1st pass
     ccache = _ChunkCache(cache, mesh, cache_budget_bytes)
@@ -918,7 +1040,7 @@ def glm_fit_streaming(
     def device_chunks():
         """Yield (dX, dy, dw, do, n_true): cached prefix from HBM, the rest
         transferred from the host source (and offered to the cache)."""
-        nonlocal saw_offset, dtype, ones_mask
+        nonlocal saw_offset, dtype, ones_mask, src_fp
         scan_now = not scanned
         yield from ccache.entries
         if ccache.complete:
@@ -971,9 +1093,12 @@ def glm_fit_streaming(
             # device chunks skip the corner-sample fingerprint: each
             # scalar pull is an RPC over the tunnel, and programmatic
             # device sources are not the reorder-bug class it guards
-            ccache.offer(dchunk, int(Xc.shape[0]),
-                         fingerprint=None if _is_device_chunk(Xc)
-                         else _fingerprint(Xc, yc, wc, oc))
+            fp = (None if _is_device_chunk(Xc)
+                  else _fingerprint(Xc, yc, wc, oc))
+            if src_fp is None:
+                src_fp = fp if fp is not None else (
+                    int(Xc.shape[0]), int(Xc.shape[1]))
+            ccache.offer(dchunk, int(Xc.shape[0]), fingerprint=fp)
             yield (*dchunk, int(Xc.shape[0]))
 
     def full_pass(beta, first):
@@ -1055,31 +1180,49 @@ def glm_fit_streaming(
                 ones_mask = meta[2:] == nproc
         return XtWX, XtWz, dev
 
-    if beta0 is not None:
+    it0 = 0
+    if _ck_state is not None:
+        # managed resume: validate the source against the checkpoint, then
+        # restore (beta, deviance baseline, iteration) and SKIP the init
+        # pass — the loop below continues the interrupted trajectory
+        # bit-for-bit (passes are deterministic given the source).  The
+        # metadata scan re-runs naturally in the first loop pass.
+        fp_live, p_live = _source_first_fingerprint(chunks)
+        resume_ck.validate(_ck_state, kind="glm",
+                           fingerprint=fp_live, p=p_live)
+        src_fp = fp_live
+        beta = np.asarray(_ck_state["beta"], np.float64)
+        dev_prev = float(_ck_state["dev"])
+        it0 = int(_ck_state["iters"])
+        if it0 >= max_iter:
+            raise ValueError(
+                f"checkpoint is already at iteration {it0} >= "
+                f"max_iter={max_iter}; raise max_iter to continue the fit")
+        p = beta.shape[0]
+        cho = pivot = None
+    elif beta0 is not None:
         # warm start (resume from a checkpointed beta): the first pass is a
         # regular IRLS pass at beta0 instead of the family-init pass
         XtWX, XtWz, dev_prev = global_pass(np.asarray(beta0, np.float64), False)
     else:
         # init pass from family starting values (first=True ignores beta)
         XtWX, XtWz, dev_prev = global_pass(None, True)
-    p = XtWX.shape[0]
-    if xnames is None:
-        xnames = tuple(f"x{i}" for i in range(p))
-    xnames = tuple(xnames)
-    if has_intercept is None:
-        has_intercept = (
-            any(nm.lower() in ("intercept", "(intercept)") for nm in xnames)
-            or bool(ones_mask.any()))
-    beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
+    if _ck_state is None:
+        p = XtWX.shape[0]
+        beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
 
-    iters = 0
+    iters = it0
     converged = False
     # the per-chunk deviance is computed on device at `dtype`; the relative
     # tolerance is floored at that dtype's resolution (config.effective_tol,
-    # same rule as the resident kernels)
-    tol_eff = effective_tol(tol, criterion, dtype)
-    for it in range(max_iter):
+    # same rule as the resident kernels).  dtype is resolved by the first
+    # pass, so on a managed resume (no init pass) it is known only after
+    # the first loop pass.
+    tol_eff = effective_tol(tol, criterion, dtype) if dtype is not None else None
+    for it in range(it0, max_iter):
         XtWX, XtWz, dev = global_pass(beta, False)
+        if tol_eff is None:
+            tol_eff = effective_tol(tol, criterion, dtype)
         ddev = abs(dev - dev_prev)
         crit = ddev / (abs(dev) + 0.1) if criterion == "relative" else ddev
         dev_prev = dev
@@ -1090,11 +1233,23 @@ def glm_fit_streaming(
         # diag((X'WX)^-1) come from the same final pass, exactly like the
         # resident fused engine's loop body
         beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
+        if ckpt is not None:
+            # post-solve state: a resume restores dev_prev=dev and this
+            # beta, making its next pass exactly the uninterrupted next one
+            ckpt.save(kind="glm", fingerprint=src_fp, p=p,
+                      iters=iters, beta=beta, dev=dev)
         if on_iteration is not None:
             on_iteration(iters, beta.copy(), dev)  # checkpoint hook
         if crit <= tol_eff:
             converged = True
             break
+    if xnames is None:
+        xnames = tuple(f"x{i}" for i in range(p))
+    xnames = tuple(xnames)
+    if has_intercept is None:
+        has_intercept = (
+            any(nm.lower() in ("intercept", "(intercept)") for nm in xnames)
+            or bool(ones_mask.any()))
     diag_inv = _diag_inv64(cho)  # once, from the final factorization
     # the IRLS loop is the cache's only reader; release the pinned device
     # chunks NOW so the host-side stats passes and the recursive null-model

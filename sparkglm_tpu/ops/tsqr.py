@@ -53,9 +53,8 @@ def _householder_tsqr(Xw, mesh=None):
         Rs = jax.lax.all_gather(R, d)          # (n_data, p, p), replicated
         return jnp.linalg.qr(Rs.reshape(-1, R.shape[1]), mode="r")
 
-    return jax.shard_map(
-        f, mesh=mesh, in_specs=(P(d, None),), out_specs=P(),
-        check_vma=False)(Xw)
+    return meshlib.shard_map(
+        f, mesh=mesh, in_specs=(P(d, None),), out_specs=P())(Xw)
 
 
 def _cholqr2_r(Xw):

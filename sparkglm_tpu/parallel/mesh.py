@@ -27,6 +27,18 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions: the public alias (jax >= 0.5,
+    ``check_vma``) or the experimental module (jax < 0.5, ``check_rep``) —
+    replication checking disabled either way, matching every caller here."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+
 def make_mesh(
     n_data: int | None = None,
     n_model: int = 1,

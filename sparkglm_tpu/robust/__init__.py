@@ -1,0 +1,34 @@
+"""Fault tolerance for streaming fits on preemptible fleets.
+
+The reference leans on Spark's lineage recovery for every failure mode
+(SURVEY.md §2.4); this package makes each mode EXPLICIT instead:
+
+  * :mod:`.retry` — typed transient/fatal source errors and a capped
+    exponential-backoff retry policy with deterministic jitter, applied to
+    chunk materialization in the streaming fits and to the CSV/Parquet
+    readers (``data/io.py`` / ``data/parquet.py``).
+  * :mod:`.checkpoint` — preemption-safe atomic checkpoint/resume of
+    streaming IRLS state (beta, iteration, deviance baseline, chunk-source
+    fingerprint); ``glm_fit_streaming(checkpoint=, resume=)`` continues an
+    interrupted pass trajectory bit-for-bit.
+  * :mod:`.faults` — a seeded fault-injection harness wrapping any chunk
+    source or reader with scheduled transient/fatal errors and simulated
+    preemptions; drives the test suite and ``bench.py``'s recovery-overhead
+    measurement.
+
+Step-halving recovery for diverging IRLS steps lives in the kernels
+themselves (``models/glm.py::_irls_kernel`` / ``_irls_fused_kernel``) —
+it is device-side state, not a host wrapper.
+"""
+
+from .checkpoint import CheckpointManager, as_checkpoint
+from .faults import FaultPlan, SimulatedPreemption, faulty_reader, faulty_source
+from .retry import (FatalSourceError, RetryBudgetExhausted, RetryPolicy,
+                    TransientSourceError, call_with_retry, retrying_source)
+
+__all__ = [
+    "TransientSourceError", "FatalSourceError", "RetryBudgetExhausted",
+    "RetryPolicy", "call_with_retry", "retrying_source",
+    "CheckpointManager", "as_checkpoint",
+    "FaultPlan", "SimulatedPreemption", "faulty_source", "faulty_reader",
+]

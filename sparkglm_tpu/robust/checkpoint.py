@@ -1,0 +1,141 @@
+"""Preemption-safe checkpoint/resume of streaming fit state.
+
+A streaming fit carries tiny state between passes — for GLM IRLS the
+coefficient vector, the iteration count and the deviance measured by the
+last pass; for the one-shot LM the accumulated Gramian — so a preempted
+multi-hour fit over a fixed source is resumable from a few-hundred-byte
+file.  The contract mirrors the resident fit's ``checkpoint_every``/
+``beta0`` pair (``models/glm.py``): the streaming GLM saves after every
+completed IRLS iteration, and ``resume=`` restores (beta, iteration,
+deviance baseline) and continues the SAME pass trajectory — passes are
+deterministic given the source, so the resumed run's remaining iterations
+are bit-for-bit the iterations the uninterrupted run would have made.
+
+Durability is by atomic rename: state is serialized to a temp sibling and
+``os.replace``d over the target, so a preemption mid-write leaves either
+the previous complete checkpoint or the new complete checkpoint, never a
+torn file.
+
+Identity is by source fingerprint: the checkpoint records the streaming
+layer's ``_fingerprint`` of the first chunk (shape + corner samples) plus
+the design width; resume validates both and refuses with ``ValueError``
+when the source does not look like the one that produced the checkpoint.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tempfile
+
+import numpy as np
+
+_FORMAT = 1
+_RESERVED = ("format", "kind", "fingerprint", "p")
+
+
+def _fp_array(fingerprint) -> np.ndarray:
+    """Fingerprint tuples may contain None for absent weight/offset corner
+    samples (``streaming._fingerprint``); encode as NaN so the record is a
+    plain f64 vector (compared with equal_nan=True)."""
+    return np.asarray([np.nan if v is None else float(v)
+                       for v in tuple(fingerprint)], dtype=np.float64)
+
+
+class CheckpointManager:
+    """Atomic save/load of streaming-fit state at ``path``.
+
+    The serialized record holds a format version, a model-kind tag
+    (``"glm"``/``"lm"``), the chunk-source fingerprint, the design width
+    ``p``, and an arbitrary payload of numpy-convertible values (the GLM
+    trajectory state or the LM accumulators).
+    """
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = os.fspath(path)
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def save(self, *, kind: str, fingerprint, p: int, **payload) -> None:
+        for k in payload:
+            if k in _RESERVED:
+                raise ValueError(f"payload key {k!r} is reserved")
+        buf = io.BytesIO()
+        np.savez(buf,
+                 format=np.int64(_FORMAT),
+                 kind=np.bytes_(kind.encode()),
+                 fingerprint=_fp_array(fingerprint),
+                 p=np.int64(p),
+                 **{k: np.asarray(v) for k, v in payload.items()})
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt-", suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(buf.getvalue())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def load(self) -> dict:
+        with np.load(self.path) as z:
+            fmt = int(z["format"])
+            if fmt != _FORMAT:
+                raise ValueError(
+                    f"checkpoint {self.path!r} has format {fmt}; this build "
+                    f"reads format {_FORMAT}")
+            out = {
+                "kind": bytes(z["kind"]).decode(),
+                "fingerprint": np.asarray(z["fingerprint"], np.float64),
+                "p": int(z["p"]),
+            }
+            for k in z.files:
+                if k not in _RESERVED:
+                    out[k] = np.asarray(z[k])
+            return out
+
+    def validate(self, state: dict, *, kind: str, fingerprint, p: int) -> None:
+        """Refuse a checkpoint that does not match the live source/model."""
+        if state["kind"] != kind:
+            raise ValueError(
+                f"checkpoint {self.path!r} was written by a "
+                f"{state['kind']!r} fit; cannot resume a {kind!r} fit from it")
+        if state["p"] != p:
+            raise ValueError(
+                f"checkpoint {self.path!r} has {state['p']} coefficients but "
+                f"the source yields {p}; refusing to resume from a different "
+                f"design")
+        want = np.asarray(state["fingerprint"], np.float64)
+        got = _fp_array(fingerprint)
+        if want.shape != got.shape or not np.array_equal(
+                want, got, equal_nan=True):
+            raise ValueError(
+                f"checkpoint {self.path!r} does not match this chunk source "
+                f"(first-chunk fingerprint differs); resuming against a "
+                f"different source would silently corrupt the trajectory — "
+                f"delete the checkpoint (or drop resume=) to start over")
+
+    def remove(self) -> None:
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+def as_checkpoint(spec) -> "CheckpointManager | None":
+    """Coerce a user-facing ``checkpoint=``/``resume=`` value: None (and
+    False) pass through as None, True is rejected here (it means "use the
+    checkpoint= target" and is resolved by the caller), a path becomes a
+    manager, a manager is returned as-is."""
+    if spec is None or spec is False or isinstance(spec, CheckpointManager):
+        return spec or None
+    if spec is True:
+        raise ValueError("resume=True needs a checkpoint= target to resume from")
+    return CheckpointManager(spec)

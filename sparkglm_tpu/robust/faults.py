@@ -1,0 +1,123 @@
+"""Seeded fault injection for chunk sources and readers.
+
+Robustness code that is only exercised by real outages is untested code.
+This module wraps any chunk source (or reader callable) with a
+deterministic, seeded schedule of failures so the retry/checkpoint paths
+run in CI on every ``make robust``:
+
+  * scheduled TRANSIENT errors — raised on chosen (pass, chunk) touches,
+    each fault fires once and then that touch succeeds on retry, modelling
+    a flaky read;
+  * scheduled FATAL errors — always re-raised, modelling corrupt data;
+  * simulated PREEMPTION — :class:`SimulatedPreemption` (a ``BaseException``
+    like a real ``SystemExit``, so retry code cannot eat it) raised on the
+    n-th touch, killing the fit mid-pass to exercise checkpoint/resume.
+
+Counting is by TOUCH: every materialization attempt (chunk yielded, thunk
+called, reader invoked) increments one shared counter, so a schedule like
+``transient_at=(3, 7)`` is reproducible no matter how the touches spread
+over passes.  Probabilistic schedules draw from ``numpy`` Generators seeded
+from ``FaultPlan.seed`` — same seed, same outage.
+
+``bench.py`` uses the same plan to measure recovery overhead: fit a
+streaming GLM with and without injected transients and report the delta.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import numpy as np
+
+from .retry import FatalSourceError, TransientSourceError
+
+
+class SimulatedPreemption(BaseException):
+    """An injected preemption.  Deliberately a ``BaseException`` (like
+    ``KeyboardInterrupt``/``SystemExit``, which real preemption handlers
+    deliver) so neither the retry layer nor a broad ``except Exception``
+    can swallow it."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A deterministic schedule of injected failures.
+
+    ``transient_at``/``fatal_at``/``preempt_at`` are 0-based touch indices
+    (a touch = one materialization attempt anywhere in the wrapped source
+    or reader).  A transient fault at touch ``t`` fires only the FIRST time
+    touch index ``t`` is reached — the retried attempt is a new touch and
+    proceeds — while fatal faults and preemptions always fire.
+    ``p_transient`` adds seeded random transients on top of the scheduled
+    ones.  One plan instance carries one mutable touch counter; share the
+    instance between a source and a reader to schedule across both, or use
+    fresh instances for independent schedules.
+    """
+
+    transient_at: Sequence[int] = ()
+    fatal_at: Sequence[int] = ()
+    preempt_at: Sequence[int] = ()
+    p_transient: float = 0.0
+    seed: int = 0
+
+    def __post_init__(self):
+        self._touch = 0
+        self._fired = set()
+        self._rng = np.random.default_rng(self.seed)
+        self.faults_fired = 0
+
+    def reset(self) -> None:
+        """Rewind the schedule (fresh touch counter, RNG, fired-set)."""
+        self.__post_init__()
+
+    def on_touch(self) -> None:
+        """Advance the touch counter; raise if this touch is scheduled."""
+        t = self._touch
+        self._touch += 1
+        if t in self.preempt_at:
+            self.faults_fired += 1
+            raise SimulatedPreemption(f"injected preemption at touch {t}")
+        if t in self.fatal_at:
+            self.faults_fired += 1
+            raise FatalSourceError(f"injected fatal error at touch {t}")
+        if t in self.transient_at and t not in self._fired:
+            self._fired.add(t)
+            self.faults_fired += 1
+            raise TransientSourceError(f"injected transient error at touch {t}")
+        if self.p_transient > 0.0 and self._rng.random() < self.p_transient:
+            self.faults_fired += 1
+            raise TransientSourceError(f"injected random transient at touch {t}")
+
+
+def faulty_source(chunks: Callable, plan: FaultPlan) -> Callable:
+    """Wrap a chunk-source factory so each chunk delivery is a fault touch.
+
+    Lazy chunks stay lazy: a thunk's touch happens when the THUNK is
+    called, not when it is yielded, matching where a real source fails.
+    Retries re-touch, so one retry consumes one more schedule slot.
+    """
+
+    def gen():
+        for raw in chunks():
+            if callable(raw):
+                def lazy(thunk=raw):
+                    plan.on_touch()
+                    return thunk()
+                yield lazy
+            else:
+                plan.on_touch()
+                yield raw
+
+    return gen
+
+
+def faulty_reader(reader: Callable, plan: FaultPlan) -> Callable:
+    """Wrap a reader callable (``read_csv``-like) so each invocation is a
+    fault touch, for exercising ``retry=`` on the IO layer."""
+
+    def wrapped(*args, **kwargs):
+        plan.on_touch()
+        return reader(*args, **kwargs)
+
+    return wrapped

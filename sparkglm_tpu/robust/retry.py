@@ -1,0 +1,201 @@
+"""Retry/backoff policy for transient chunk-source and reader failures.
+
+A multi-pass streaming fit touches its source O(iterations x chunks) times;
+at fleet scale some of those touches WILL fail transiently (an object-store
+503, a flaky NFS read, a preempted parse worker).  Today's behavior — any
+exception kills the whole fit from iteration zero — is the single biggest
+gap between the streaming path and the ROADMAP's production north star.
+
+The model here is explicit and typed:
+
+  * :class:`TransientSourceError` — raise this (or register exception types
+    via ``RetryPolicy.retryable``) for failures worth retrying.
+  * :class:`FatalSourceError` — never retried, even if its cause would be:
+    wrap a retryable type in this to force a hard stop.
+  * :class:`RetryPolicy` — capped exponential backoff with DETERMINISTIC
+    jitter (hash-seeded, so two runs of the same fit sleep the same
+    schedule — reproducibility is a feature, thundering-herd avoidance
+    still works because the seed folds in the retry key), plus a per-pass
+    retry budget.
+
+Multi-process coherence: a retry is process-local host work between
+collectives, so it needs no coordination while it is being attempted; a
+retry budget that EXHAUSTS raises, and that error reaches the other
+processes through the streaming layer's ``_sync_errors`` flag exchange —
+retry decisions are synchronized exactly like errors are (see
+``models/streaming.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Callable, Sequence
+
+
+class TransientSourceError(Exception):
+    """A chunk-source/reader failure worth retrying (flaky IO, a 5xx from
+    object storage, a preempted parse worker).  Always classified
+    transient by every :class:`RetryPolicy`."""
+
+
+class FatalSourceError(Exception):
+    """A failure that must NOT be retried even when its cause is a type the
+    policy would otherwise classify transient (e.g. corrupt data discovered
+    during a read)."""
+
+
+class RetryBudgetExhausted(RuntimeError):
+    """The per-pass retry budget ran out; carries the last transient error
+    as ``__cause__``."""
+
+
+def _default_sleep(seconds: float) -> None:
+    if seconds > 0:
+        time.sleep(seconds)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff with deterministic jitter.
+
+    ``delay(attempt, key)`` = min(base * 2^attempt, cap) * (1 + jitter*u)
+    where u in [-1, 1) is derived from sha256(seed, key, attempt) — fully
+    deterministic for a given (seed, key) so recovery runs are
+    reproducible, yet de-correlated across chunks/processes (fold the
+    chunk index or process index into ``key``).
+
+    ``budget`` is the PER-PASS retry allowance: each streaming pass gets a
+    fresh :class:`RetryBudget` of this size, so a long fit cannot bleed to
+    death one retry at a time across hundreds of passes, while a genuinely
+    dead source still fails fast within one pass.
+    """
+
+    max_retries: int = 4          # per failing call
+    budget: int = 16              # per pass, across all calls
+    base_delay: float = 0.05      # seconds
+    max_delay: float = 8.0        # backoff cap
+    jitter: float = 0.25          # +/- fraction of the backoff delay
+    seed: int = 0
+    # exception types classified transient IN ADDITION to
+    # TransientSourceError; OSError covers flaky file/network IO
+    retryable: tuple = (OSError,)
+    sleep: Callable[[float], None] = _default_sleep
+
+    def is_transient(self, exc: BaseException) -> bool:
+        if isinstance(exc, FatalSourceError):
+            return False
+        if isinstance(exc, TransientSourceError):
+            return True
+        return isinstance(exc, tuple(self.retryable))
+
+    def delay(self, attempt: int, key: object = "") -> float:
+        raw = min(self.base_delay * (2.0 ** attempt), self.max_delay)
+        h = hashlib.sha256(
+            f"{self.seed}|{key}|{attempt}".encode()).digest()
+        u = int.from_bytes(h[:8], "big") / float(1 << 64)  # [0, 1)
+        return raw * (1.0 + self.jitter * (2.0 * u - 1.0))
+
+    def new_budget(self) -> "RetryBudget":
+        return RetryBudget(self.budget)
+
+
+class RetryBudget:
+    """Mutable per-pass allowance shared by every retried call in the pass."""
+
+    def __init__(self, total: int):
+        self.total = int(total)
+        self.spent = 0
+
+    def spend(self, exc: BaseException) -> None:
+        self.spent += 1
+        if self.spent > self.total:
+            raise RetryBudgetExhausted(
+                f"retry budget ({self.total} per pass) exhausted; last "
+                f"transient error: {exc!r}") from exc
+
+
+def call_with_retry(fn: Callable, *, policy: RetryPolicy,
+                    budget: RetryBudget | None = None, key: object = ""):
+    """Run ``fn()`` retrying transient failures under ``policy``.
+
+    A standalone call (no shared ``budget``) gets a private budget of
+    ``policy.max_retries`` — the reader-level entry used by
+    ``read_csv(retry=)`` / ``read_parquet(retry=)``.
+    """
+    if budget is None:
+        budget = RetryBudget(policy.max_retries)
+    for attempt in range(policy.max_retries + 1):
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — classified right below
+            if attempt >= policy.max_retries or not policy.is_transient(e):
+                raise
+            budget.spend(e)
+            policy.sleep(policy.delay(attempt, key))
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def retrying_source(chunks: Callable, policy: RetryPolicy) -> Callable:
+    """Wrap a chunk-source factory so every pass absorbs transient failures.
+
+    Three failure points are covered, all under ONE per-pass budget:
+
+      * opening the source (``chunks()`` raising),
+      * the iterator raising mid-pass (``next``) — a generator cannot be
+        resumed after it raises, so the pass re-opens the source and
+        fast-forwards past the ``k`` chunks already delivered (thunks are
+        skipped unmaterialized: the fast-forward costs nothing for lazy
+        sources like the from-CSV byte-range parse),
+      * thunk materialization — lazy chunks stay lazy: the yielded thunk
+        retries IN PLACE when called, so the device cache's skip-path
+        economics are untouched.
+
+    Chunk identity under retry is the source's own re-iteration contract
+    (the same one the device cache's cached-prefix skip enforces via
+    ``_fingerprint``): a retried pass must yield the same chunks in the
+    same order.
+    """
+
+    def gen():
+        budget = policy.new_budget()
+
+        def reopen():
+            for attempt in range(policy.max_retries + 1):
+                try:
+                    return iter(chunks())
+                except BaseException as e:  # noqa: BLE001
+                    if (attempt >= policy.max_retries
+                            or not policy.is_transient(e)):
+                        raise
+                    budget.spend(e)
+                    policy.sleep(policy.delay(attempt, "open"))
+            raise AssertionError("unreachable")  # pragma: no cover
+
+        it = reopen()
+        k = 0  # chunks already delivered this pass
+        while True:
+            try:
+                raw = next(it)
+            except StopIteration:
+                return
+            except BaseException as e:  # noqa: BLE001
+                if not policy.is_transient(e):
+                    raise
+                budget.spend(e)
+                policy.sleep(policy.delay(0, ("iter", k)))
+                it = reopen()
+                for _ in range(k):  # skip the already-delivered prefix
+                    next(it)
+                continue
+            if callable(raw):
+                def lazy(thunk=raw, idx=k):
+                    return call_with_retry(thunk, policy=policy,
+                                           budget=budget, key=("chunk", idx))
+                yield lazy
+            else:
+                yield raw
+            k += 1
+
+    return gen

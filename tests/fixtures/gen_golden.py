@@ -317,7 +317,7 @@ def main():
         family="binomial", link="logit",
         fit=r_fit(Xb, succ, "binomial", "logit", m=m_sz),
         influence=r_influence(Xb, succ, "binomial", "logit", m=m_sz),
-        provenance="synthetic; R: glm(cbind(s, m-s) ~ x1, binomial)")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(cbind(s, m-s) ~ x1, binomial)")
 
     # -- 4. poisson with offset ---------------------------------------------
     expo = rng.uniform(0.5, 4.0, n)
@@ -327,14 +327,14 @@ def main():
         data=dict(x1=x1.tolist(), exposure=expo.tolist(), y=yp.tolist()),
         family="poisson", link="log",
         fit=r_fit(Xb, yp, "poisson", "log", offset=np.log(expo)),
-        provenance="synthetic; R: glm(y ~ x1 + offset(log(exposure)), poisson)")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ x1 + offset(log(exposure)), poisson)")
 
     # -- 5. quasipoisson (same fit, Pearson dispersion, AIC = NA) -----------
     cases["quasipoisson"] = dict(
         data=dict(x1=x1.tolist(), y=yp.tolist()),
         family="quasipoisson", link="log",
         fit=r_fit(Xb, yp, "poisson", "log", quasi=True),
-        provenance="synthetic; R: glm(y ~ x1, quasipoisson)")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ x1, quasipoisson)")
 
     # -- 6. weighted gaussian glm (AIC carries -sum(log wt)) ----------------
     wts = rng.uniform(0.5, 3.0, n)
@@ -344,7 +344,7 @@ def main():
         family="gaussian", link="identity",
         fit=r_fit(Xb, yg, "gaussian", "identity", wt=wts),
         influence=r_influence(Xb, yg, "gaussian", "identity", wt=wts),
-        provenance="synthetic; R: glm(y ~ x1, gaussian, weights = w)")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ x1, gaussian, weights = w)")
 
     # -- 7. inverse gaussian ------------------------------------------------
     mu_ig = 1.0 / np.sqrt(0.5 + 0.3 * np.abs(x1) + 0.2)
@@ -359,7 +359,7 @@ def main():
         data=dict(x=np.abs(x1).tolist(), y=yig.tolist()),
         family="inverse_gaussian", link="inverse_squared",
         fit=r_fit(Xig, yig, "inverse_gaussian", "inverse_squared"),
-        provenance="synthetic; R: glm(y ~ x, inverse.gaussian)")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ x, inverse.gaussian)")
 
     # -- 8. binomial cloglog (bernoulli) ------------------------------------
     n2 = 200
@@ -371,7 +371,7 @@ def main():
         data=dict(x=x2.tolist(), y=yb.tolist()),
         family="binomial", link="cloglog",
         fit=r_fit(X2, yb, "binomial", "cloglog"),
-        provenance="synthetic; R: glm(y ~ x, binomial(cloglog))")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ x, binomial(cloglog))")
 
     # -- 9. grouped binomial probit ------------------------------------------
     from scipy.stats import norm as _norm
@@ -382,7 +382,7 @@ def main():
         data=dict(x1=x1.tolist(), m=m9.tolist(), successes=s9.tolist()),
         family="binomial", link="probit",
         fit=r_fit(Xb, s9, "binomial", "probit", m=m9),
-        provenance="synthetic; R: glm(cbind(s, m-s) ~ x1, binomial(probit))")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(cbind(s, m-s) ~ x1, binomial(probit))")
 
     # -- 10. no-intercept binomial (null model is mu = linkinv(0)) ----------
     xn = rng.standard_normal(n) + 0.5
@@ -392,7 +392,7 @@ def main():
         data=dict(x=xn.tolist(), y=yn.tolist()),
         family="binomial", link="logit", no_intercept=True,
         fit=r_fit(xn[:, None], yn, "binomial", "logit", has_intercept=False),
-        provenance="synthetic; R: glm(y ~ x - 1, binomial)")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ x - 1, binomial)")
 
     # -- 11. poisson sqrt link ----------------------------------------------
     mu_s = (1.5 + 0.4 * x1) ** 2
@@ -401,7 +401,7 @@ def main():
         data=dict(x1=x1.tolist(), y=ys.tolist()),
         family="poisson", link="sqrt",
         fit=r_fit(Xb, ys, "poisson", "sqrt"),
-        provenance="synthetic; R: glm(y ~ x1, poisson(sqrt))")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ x1, poisson(sqrt))")
 
     # -- 12. weighted gamma log link ----------------------------------------
     wg = rng.uniform(0.5, 3.0, n)
@@ -411,7 +411,7 @@ def main():
         data=dict(x1=x1.tolist(), w=wg.tolist(), y=yg2.tolist()),
         family="gamma", link="log",
         fit=r_fit(Xb, yg2, "gamma", "log", wt=wg),
-        provenance="synthetic; R: glm(y ~ x1, Gamma(log), weights = w)")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ x1, Gamma(log), weights = w)")
 
     # ------------------------------------------------------------------
     # FORMULA-driven cases (VERDICT r2 weak #5): golden fits that go
@@ -495,7 +495,7 @@ def main():
         family="poisson", link="log",
         xnames=["intercept", "x", "g_b", "x:g_b"],
         fit=r_fit(X4, y4, "poisson", "log"),
-        provenance="synthetic; R: glm(y ~ x * g, poisson)")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ x * g, poisson)")
 
     # F5: weights + offset() by name through the formula — oracle64 values
     n5 = 150
@@ -512,7 +512,7 @@ def main():
         family="gamma", link="log", weights="w",
         xnames=["intercept", "x"],
         fit=r_fit(X5, y5, "gamma", "log", wt=w5, offset=np.log(e5)),
-        provenance="synthetic; R: glm(y ~ x + offset(log_e), Gamma(log), "
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ x + offset(log_e), Gamma(log), "
                    "weights = w)")
 
     # F6: cbind(successes, failures) response — oracle64 values
@@ -530,7 +530,7 @@ def main():
         family="binomial", link="logit",
         xnames=["intercept", "x1", "x2"],
         fit=r_fit(X6, s6, "binomial", "logit", m=m6),
-        provenance="synthetic; R: glm(cbind(s, f) ~ x1 + x2, binomial)")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(cbind(s, f) ~ x1 + x2, binomial)")
 
     # F7: transform + power term — oracle64 values
     n7 = 100
@@ -543,7 +543,7 @@ def main():
         family="gaussian", link="identity",
         xnames=["intercept", "log(u)", "I(u^2)"],
         fit=r_fit(X7, y7, "gaussian", "identity"),
-        provenance="synthetic; R: glm(y ~ log(u) + I(u^2), gaussian)")
+        provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ log(u) + I(u^2), gaussian)")
 
     cases["formula_cases"] = fcases
 

@@ -1,0 +1,422 @@
+"""Fault-tolerant fitting (sparkglm_tpu.robust): retrying chunk sources,
+preemption-safe streaming checkpoint/resume, and IRLS step-halving
+recovery.  Faults are injected deterministically (robust.faults) so every
+recovery path runs in CI, not just in real outages."""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.robust import (CheckpointManager, FatalSourceError,
+                                 FaultPlan, RetryBudgetExhausted, RetryPolicy,
+                                 SimulatedPreemption, TransientSourceError,
+                                 as_checkpoint, call_with_retry,
+                                 faulty_reader, faulty_source,
+                                 retrying_source)
+
+# no real sleeping in tests: the backoff schedule is asserted on, not waited
+NOSLEEP = RetryPolicy(sleep=lambda s: None)
+
+
+def _binomial_data(rng, n=4000, p=4):
+    X = rng.normal(size=(n, p))
+    X[:, 0] = 1.0
+    bt = rng.normal(size=p) / (2 * np.sqrt(p))
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(float)
+    return X, y
+
+
+def _chunk_factory(X, y, n_chunks=5):
+    """A lazy thunk source over row slices (the from-CSV source shape)."""
+    n = X.shape[0]
+
+    def source():
+        for i in range(n_chunks):
+            lo = n * i // n_chunks
+            hi = n * (i + 1) // n_chunks
+            yield lambda lo=lo, hi=hi: (X[lo:hi], y[lo:hi], None, None)
+
+    return source
+
+
+# ---------------------------------------------------------------------------
+# retry policy + budget
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_deterministic_capped_backoff():
+    pol = RetryPolicy(base_delay=0.1, max_delay=1.0, jitter=0.25, seed=7)
+    # deterministic: same (seed, key, attempt) -> same delay
+    assert pol.delay(2, "k") == pol.delay(2, "k")
+    # de-correlated across keys, bounded by the jitter band around the cap
+    d1, d2 = pol.delay(9, "a"), pol.delay(9, "b")
+    assert d1 != d2
+    for d in (d1, d2):
+        assert 0.75 <= d <= 1.25  # min(0.1 * 2^9, 1.0) * (1 +/- 0.25)
+    # transient classification: typed + registered types, fatal never
+    assert pol.is_transient(TransientSourceError("x"))
+    assert pol.is_transient(OSError("x"))
+    assert not pol.is_transient(FatalSourceError("x"))
+    assert not pol.is_transient(ValueError("x"))
+
+
+def test_call_with_retry_transient_then_success():
+    sleeps = []
+    pol = RetryPolicy(max_retries=4, sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientSourceError("blip")
+        return 42
+
+    assert call_with_retry(flaky, policy=pol, key="t") == 42
+    assert calls["n"] == 3
+    # one backoff sleep per retry, on the deterministic schedule
+    assert sleeps == [pol.delay(0, "t"), pol.delay(1, "t")]
+
+
+def test_call_with_retry_fatal_and_max_retries():
+    pol = RetryPolicy(max_retries=2, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise FatalSourceError("corrupt row")
+
+    with pytest.raises(FatalSourceError):
+        call_with_retry(fatal, policy=pol)
+    assert calls["n"] == 1  # fatal is never retried
+
+    calls["n"] = 0
+
+    def always():
+        calls["n"] += 1
+        raise TransientSourceError("down")
+
+    with pytest.raises(TransientSourceError):
+        call_with_retry(always, policy=pol)
+    assert calls["n"] == 3  # initial + max_retries
+
+
+def test_retry_budget_exhausted_raises():
+    pol = RetryPolicy(max_retries=10, budget=3, sleep=lambda s: None)
+    budget = pol.new_budget()
+
+    def always():
+        raise TransientSourceError("down")
+
+    with pytest.raises(RetryBudgetExhausted) as ei:
+        call_with_retry(always, policy=pol, budget=budget)
+    assert isinstance(ei.value.__cause__, TransientSourceError)
+
+
+# ---------------------------------------------------------------------------
+# retrying sources end-to-end through the streaming fit
+# ---------------------------------------------------------------------------
+
+def test_streaming_fit_retries_transients_and_matches_clean(mesh8, rng):
+    X, y = _binomial_data(rng)
+    clean = sg.glm_fit_streaming(_chunk_factory(X, y), family="binomial",
+                                 tol=1e-10, mesh=mesh8)
+    plan = FaultPlan(transient_at=(1, 4, 9))
+    m = sg.glm_fit_streaming(
+        faulty_source(_chunk_factory(X, y), plan), family="binomial",
+        tol=1e-10, mesh=mesh8, retry=NOSLEEP)
+    assert plan.faults_fired == 3  # every scheduled fault actually fired
+    # retried chunks are re-materialized identically: bit-for-bit fit
+    np.testing.assert_array_equal(m.coefficients, clean.coefficients)
+    assert m.deviance == clean.deviance
+    assert m.iterations == clean.iterations
+
+
+def test_streaming_fit_budget_exhaustion_and_fatal(mesh8, rng):
+    X, y = _binomial_data(rng, n=1200)
+    # a source that is down hard: every touch transient -> the per-pass
+    # budget (tighter than the per-call retry cap) exhausts
+    pol = RetryPolicy(max_retries=4, budget=2, sleep=lambda s: None)
+    with pytest.raises(RetryBudgetExhausted):
+        sg.glm_fit_streaming(
+            faulty_source(_chunk_factory(X, y), FaultPlan(p_transient=1.0)),
+            family="binomial", mesh=mesh8, retry=pol)
+    # fatal errors are never absorbed, with or without a retry policy
+    with pytest.raises(FatalSourceError):
+        sg.glm_fit_streaming(
+            faulty_source(_chunk_factory(X, y), FaultPlan(fatal_at=(2,))),
+            family="binomial", mesh=mesh8, retry=NOSLEEP)
+
+
+def test_preemption_passes_through_retry(mesh8, rng):
+    """SimulatedPreemption is a BaseException: the retry layer must not
+    absorb it (a real preemption signal cannot be retried away)."""
+    X, y = _binomial_data(rng, n=1200)
+    with pytest.raises(SimulatedPreemption):
+        sg.glm_fit_streaming(
+            faulty_source(_chunk_factory(X, y), FaultPlan(preempt_at=(3,))),
+            family="binomial", mesh=mesh8, retry=NOSLEEP)
+
+
+def test_faulty_reader_with_reader_retry(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("a,b\n1.0,2.0\n3.0,4.0\n")
+    plan = FaultPlan(transient_at=(0,))
+    reader = faulty_reader(sg.read_csv, plan)
+    cols = call_with_retry(lambda: reader(str(p)), policy=NOSLEEP)
+    assert plan.faults_fired == 1
+    np.testing.assert_allclose(cols["a"], [1.0, 3.0])
+
+
+def test_read_csv_retry_param(tmp_path, monkeypatch):
+    import sparkglm_tpu.data.io as io_mod
+    p = tmp_path / "d.csv"
+    p.write_text("a,b\n1.0,2.0\n3.0,4.0\n")
+    calls = {"n": 0}
+    orig = io_mod.resolve_gz
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("flaky mount")
+        return orig(*a, **k)
+
+    monkeypatch.setattr(io_mod, "resolve_gz", flaky)
+    cols = io_mod.read_csv(str(p), retry=NOSLEEP)
+    assert calls["n"] == 2  # one transient absorbed
+    np.testing.assert_allclose(cols["b"], [2.0, 4.0])
+    # without retry= the same failure propagates
+    calls["n"] = 0
+    with pytest.raises(OSError):
+        io_mod.read_csv(str(p))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+
+def test_as_checkpoint_contract(tmp_path):
+    assert as_checkpoint(None) is None
+    assert as_checkpoint(False) is None
+    ck = as_checkpoint(tmp_path / "c.npz")
+    assert isinstance(ck, CheckpointManager)
+    assert as_checkpoint(ck) is ck
+    with pytest.raises(ValueError, match="checkpoint="):
+        as_checkpoint(True)
+
+
+def test_checkpoint_roundtrip_and_validation(tmp_path):
+    ck = CheckpointManager(tmp_path / "state.npz")
+    assert not ck.exists()
+    fp = (100.0, 3.0, 1.5, None, 0.25, None)  # None = absent w/o samples
+    ck.save(kind="glm", fingerprint=fp, p=3,
+            beta=np.array([1.0, -2.0, 0.5]), iters=4, dev=12.5)
+    assert ck.exists()
+    st = ck.load()
+    assert st["kind"] == "glm" and st["p"] == 3 and int(st["iters"]) == 4
+    np.testing.assert_array_equal(st["beta"], [1.0, -2.0, 0.5])
+    ck.validate(st, kind="glm", fingerprint=fp, p=3)  # matches: no raise
+    with pytest.raises(ValueError, match="'lm'"):
+        ck.validate(st, kind="lm", fingerprint=fp, p=3)
+    with pytest.raises(ValueError, match="coefficients"):
+        ck.validate(st, kind="glm", fingerprint=fp, p=4)
+    with pytest.raises(ValueError, match="fingerprint"):
+        ck.validate(st, kind="glm", fingerprint=(100.0, 3.0, 9.9, None,
+                                                 0.25, None), p=3)
+    # atomic overwrite: a newer save fully replaces the record
+    ck.save(kind="glm", fingerprint=fp, p=3,
+            beta=np.zeros(3), iters=9, dev=1.0)
+    assert int(ck.load()["iters"]) == 9
+    ck.remove()
+    assert not ck.exists()
+    ck.remove()  # idempotent
+
+
+def test_glm_checkpoint_resume_bit_identical(mesh8, rng, tmp_path):
+    """The acceptance test: a fit killed mid-run by an injected preemption
+    resumes from its checkpoint and finishes with ITERATION-IDENTICAL
+    state — same remaining passes, same coefficients, same deviance."""
+    X, y = _binomial_data(rng)
+    src = _chunk_factory(X, y)
+    kw = dict(family="binomial", tol=1e-10, mesh=mesh8)
+    full = sg.glm_fit_streaming(src, **kw)
+    assert full.iterations > 3  # the preemption below lands mid-fit
+
+    ckpt = tmp_path / "glm.ckpt"
+
+    def preempt(it, beta, dev):
+        if it >= 2:
+            raise SimulatedPreemption("killed after iteration 2")
+
+    with pytest.raises(SimulatedPreemption):
+        sg.glm_fit_streaming(src, checkpoint=ckpt, on_iteration=preempt, **kw)
+    assert CheckpointManager(ckpt).exists()
+
+    m = sg.glm_fit_streaming(src, checkpoint=ckpt, resume=True, **kw)
+    np.testing.assert_array_equal(m.coefficients, full.coefficients)
+    np.testing.assert_array_equal(m.std_errors, full.std_errors)
+    assert m.deviance == full.deviance
+    assert m.iterations == full.iterations
+    assert m.converged
+
+
+def test_glm_resume_refuses_wrong_source_and_missing_file(mesh8, rng,
+                                                          tmp_path):
+    X, y = _binomial_data(rng)
+    ckpt = tmp_path / "glm.ckpt"
+    kw = dict(family="binomial", tol=1e-10, mesh=mesh8)
+    sg.glm_fit_streaming(_chunk_factory(X, y), checkpoint=ckpt, **kw)
+    # a perturbed source no longer matches the recorded fingerprint
+    y2 = y.copy()
+    y2[0] = 1.0 - y2[0]
+    with pytest.raises(ValueError, match="fingerprint"):
+        sg.glm_fit_streaming(_chunk_factory(X, y2), checkpoint=ckpt,
+                             resume=True, **kw)
+    # missing checkpoint file: resume starts fresh (the restart-loop
+    # contract — pass checkpoint=/resume= unconditionally)
+    m = sg.glm_fit_streaming(_chunk_factory(X, y),
+                             checkpoint=tmp_path / "absent.ckpt",
+                             resume=True, **kw)
+    assert m.converged
+
+
+def test_lm_checkpoint_resume_identical(mesh8, rng, tmp_path):
+    X, _ = _binomial_data(rng)
+    bt = rng.normal(size=X.shape[1])
+    y = X @ bt + 0.3 * rng.normal(size=X.shape[0])
+
+    def src():
+        for i in range(4):
+            lo, hi = 1000 * i, 1000 * (i + 1)
+            yield lambda lo=lo, hi=hi: (X[lo:hi], y[lo:hi], None, None)
+
+    full = sg.lm_fit_streaming(src, mesh=mesh8)
+    ckpt = tmp_path / "lm.ckpt"
+    sg.lm_fit_streaming(src, mesh=mesh8, checkpoint=ckpt)
+    assert CheckpointManager(ckpt).exists()
+    # resume skips the Gramian pass entirely and reproduces the fit
+    m = sg.lm_fit_streaming(src, mesh=mesh8, checkpoint=ckpt, resume=True)
+    np.testing.assert_array_equal(m.coefficients, full.coefficients)
+    assert m.r_squared == full.r_squared
+    assert m.sigma == full.sigma
+
+
+def test_from_csv_preempt_resume_roundtrip(tmp_path, mesh8, rng):
+    """End-to-end through the api plumbing: glm_from_csv with
+    retry=/checkpoint=/resume= recovers a preempted out-of-core fit."""
+    n = 3000
+    x = rng.standard_normal(n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(0.3 + 0.8 * x)))).astype(float)
+    p = tmp_path / "d.csv"
+    p.write_text("y,x\n" + "\n".join(f"{yi:.0f},{xi:.10g}"
+                                     for yi, xi in zip(y, x)) + "\n")
+    kw = dict(family="binomial", tol=1e-10, chunk_bytes=20_000, mesh=mesh8,
+              retry=NOSLEEP)
+    full = sg.glm_from_csv("y ~ x", str(p), **kw)
+    ckpt = tmp_path / "csvfit.ckpt"
+
+    def preempt(it, beta, dev):
+        if it >= 2:
+            raise SimulatedPreemption("killed")
+
+    with pytest.raises(SimulatedPreemption):
+        sg.glm_from_csv("y ~ x", str(p), checkpoint=ckpt,
+                        on_iteration=preempt, **kw)
+    m = sg.glm_from_csv("y ~ x", str(p), checkpoint=ckpt, resume=True, **kw)
+    np.testing.assert_array_equal(m.coefficients, full.coefficients)
+    assert m.deviance == full.deviance
+    assert m.iterations == full.iterations
+
+
+# ---------------------------------------------------------------------------
+# IRLS step-halving
+# ---------------------------------------------------------------------------
+
+def _diverging_gamma(rng=None):
+    """gamma/inverse with an overshooting warm start: the unhalved Fisher
+    step drives eta through 0 (singular working weights) — the seed
+    kernels raise/diverge here; step-halving recovers it."""
+    r = np.random.default_rng(3)
+    xg = np.linspace(0.2, 3.0, 40)
+    mug = 1.0 / (0.5 + 0.8 * xg)
+    yg = mug * r.gamma(8.0, 1 / 8.0, 40)
+    return np.column_stack([np.ones_like(xg), xg]), yg
+
+
+@pytest.mark.parametrize("engine", ["einsum", "fused"])
+def test_step_halving_recovers_diverging_fit(engine):
+    X, y = _diverging_gamma()
+    m = sg.glm_fit(X, y, family="gamma", link="inverse",
+                   beta0=np.array([6.0, -1.5]), engine=engine)
+    assert m.converged
+    assert np.all(np.isfinite(m.coefficients))
+    # both engines land on the true optimum (cross-checked in the probe:
+    # the cold-started fit reaches the same fixed point)
+    cold = sg.glm_fit(X, y, family="gamma", link="inverse", engine=engine)
+    np.testing.assert_allclose(m.coefficients, cold.coefficients,
+                               rtol=1e-5, atol=1e-8)
+
+
+def test_step_halving_deviance_monotone():
+    """R glm.fit semantics: once iterating, deviance never increases —
+    a worse step is halved toward the previous iterate instead."""
+    X, y = _diverging_gamma()
+    devs = []
+    m = sg.glm_fit(X, y, family="gamma", link="inverse",
+                   beta0=np.array([6.0, -1.5]), engine="einsum",
+                   checkpoint_every=1,
+                   on_iteration=lambda it, beta, dev: devs.append(float(dev)))
+    assert m.converged and len(devs) >= 2
+    slack = 1e-4 * (np.abs(devs) + 0.1)  # the kernels' own _HALF_SLACK band
+    assert np.all(np.diff(devs) <= slack[:-1])
+
+
+def test_step_halving_leaves_healthy_fits_alone(rng):
+    """A well-posed fit must take full Fisher steps — same trajectory and
+    iteration count as before halving existed."""
+    X, y = _binomial_data(rng, n=2000)
+    m = sg.glm_fit(X, y, family="binomial", tol=1e-10, engine="einsum")
+    f = sg.glm_fit(X, y, family="binomial", tol=1e-10, engine="fused")
+    assert m.converged and f.converged
+    np.testing.assert_allclose(m.coefficients, f.coefficients,
+                               rtol=1e-6, atol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# fault plan mechanics
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_touch_semantics():
+    plan = FaultPlan(transient_at=(1,), fatal_at=(3,))
+    plan.on_touch()  # touch 0: clean
+    with pytest.raises(TransientSourceError):
+        plan.on_touch()  # touch 1: scheduled transient fires once
+    plan.on_touch()  # touch 2: clean (the retry's re-touch)
+    with pytest.raises(FatalSourceError):
+        plan.on_touch()  # touch 3: fatal
+    assert plan.faults_fired == 2
+    plan.reset()
+    plan.on_touch()
+    with pytest.raises(TransientSourceError):
+        plan.on_touch()  # schedule rewound
+
+
+def test_retrying_source_mid_iteration_generator_failure(mesh8, rng):
+    """A generator raising mid-pass (not in a thunk) is re-opened and
+    fast-forwarded past the delivered prefix."""
+    X, y = _binomial_data(rng, n=1500)
+    state = {"opens": 0}
+
+    def source():
+        state["opens"] += 1
+        fail_this_open = state["opens"] == 2
+        for i in range(3):
+            lo, hi = 500 * i, 500 * (i + 1)
+            if fail_this_open and i == 1:
+                raise TransientSourceError("iterator died mid-pass")
+            yield X[lo:hi], y[lo:hi], None, None
+
+    clean = sg.glm_fit_streaming(_chunk_factory(X, y, 3), family="binomial",
+                                 tol=1e-10, mesh=mesh8, cache="none")
+    m = sg.glm_fit_streaming(source, family="binomial", tol=1e-10,
+                             mesh=mesh8, cache="none", retry=NOSLEEP)
+    assert state["opens"] >= 3  # the failed pass re-opened the source
+    np.testing.assert_array_equal(m.coefficients, clean.coefficients)
