@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
-.PHONY: all native test bench robust clean
+.PHONY: all native test bench robust obs clean
 
 all: native
 
@@ -26,6 +26,11 @@ bench: native
 # checkpoint/resume, step-halving — deterministic, CPU-only, fast
 robust:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_robust.py -q
+
+# observability suite (sparkglm_tpu/obs): trace events, metrics registry,
+# device-aware spans, traced-vs-untraced bit-identity — CPU-only, fast
+obs:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
 
 clean:
 	rm -f $(SO)

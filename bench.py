@@ -401,6 +401,54 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["fault_recovery"] = dict(error=repr(e)[:300])
 
+    # ---- structured-telemetry overhead (sparkglm_tpu/obs) ------------------
+    # the same streaming fit untraced vs traced into a ring buffer: events
+    # are host-side and sync only at span edges, so the target is <2%
+    try:
+        import sparkglm_tpu as sg
+        from sparkglm_tpu.obs import FitTracer, RingBufferSink
+
+        np_rng = np.random.default_rng(13)
+        nt, pt = 200_000, 32
+        Xt = np_rng.standard_normal((nt, pt)).astype(np.float32)
+        Xt[:, 0] = 1.0
+        btt = (np_rng.standard_normal(pt) / 8).astype(np.float32)
+        yt = (np_rng.random(nt) < 1 / (1 + np.exp(-(Xt @ btt)))).astype(
+            np.float32)
+
+        def chunk_src_t():
+            for i in range(8):
+                lo, hi = nt * i // 8, nt * (i + 1) // 8
+                yield lambda lo=lo, hi=hi: (Xt[lo:hi], yt[lo:hi], None, None)
+
+        tkw = dict(family="binomial", tol=1e-6, cache="none")
+        sg.glm_fit_streaming(chunk_src_t, **tkw)  # warm compile
+
+        def best_of(fit, reps=3):
+            best, model = float("inf"), None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                model = fit()
+                best = min(best, time.perf_counter() - t0)
+            return best, model
+
+        t_plain, m_plain = best_of(
+            lambda: sg.glm_fit_streaming(chunk_src_t, **tkw))
+        ring = RingBufferSink()
+        t_traced, m_traced = best_of(
+            lambda: sg.glm_fit_streaming(chunk_src_t,
+                                         trace=FitTracer([ring]), **tkw))
+        rep = m_traced.fit_report()
+        detail["trace_overhead"] = dict(
+            untraced_s=round(t_plain, 4), traced_s=round(t_traced, 4),
+            overhead_frac=round(t_traced / t_plain - 1.0, 4),
+            events=rep["events"], passes=rep["passes"],
+            bit_identical=bool(np.array_equal(m_plain.coefficients,
+                                              m_traced.coefficients)),
+            ok=bool(t_traced / t_plain - 1.0 < 0.02))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["trace_overhead"] = dict(error=repr(e)[:300])
+
     print(json.dumps({
         "metric": "logistic_"
                   + (f"{n // 1_000_000}M" if n >= 1_000_000 else f"{n // 1000}k")

@@ -44,8 +44,9 @@ from .models.simulate import simulate
 from .models.streaming import glm_fit_streaming, lm_fit_streaming
 from .parallel import distributed
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
+from .obs import FitTracer, JsonlSink, MetricsRegistry, RingBufferSink
 from .utils import profiling
-from . import robust
+from . import obs, robust
 
 __version__ = "0.1.0"
 
@@ -73,4 +74,5 @@ __all__ = [
     "profiling",
     "NumericConfig", "DEFAULT",
     "robust",
+    "obs", "FitTracer", "MetricsRegistry", "JsonlSink", "RingBufferSink",
 ]

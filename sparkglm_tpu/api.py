@@ -147,6 +147,7 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
 def lm(formula: str, data, *, weights=None, offset=None,
        na_omit: bool = True, mesh=None,
        singular: str = "drop", engine: str = "auto",
+       trace=None, metrics=None,
        config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """R-style ``lm(formula, data)`` (ref: sparkLM, R/pkg/R/LM.R:24-44).
 
@@ -172,7 +173,7 @@ def lm(formula: str, data, *, weights=None, offset=None,
         X, y, weights=weights, offset=off_arr, xnames=terms.xnames,
         yname=f.response,
         has_intercept=f.intercept, mesh=mesh, singular=singular,
-        engine=engine, config=config)
+        engine=engine, trace=trace, metrics=metrics, config=config)
     import dataclasses
     return dataclasses.replace(
         model, formula=str(f), terms=terms,
@@ -186,6 +187,7 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         criterion: str = "relative", na_omit: bool = True, mesh=None,
         engine: str = "auto", singular: str = "drop", verbose: bool = False,
         beta0=None, on_iteration=None, checkpoint_every: int = 0,
+        trace=None, metrics=None,
         config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """R-style ``glm(formula, data, family, link, ...)``.
 
@@ -221,7 +223,8 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         yname=yname, has_intercept=f.intercept, mesh=mesh,
         engine=engine, singular=singular, verbose=verbose,
         beta0=beta0, on_iteration=on_iteration,
-        checkpoint_every=checkpoint_every, config=config)
+        checkpoint_every=checkpoint_every, trace=trace, metrics=metrics,
+        config=config)
     import dataclasses
     return dataclasses.replace(
         model, formula=str(f), terms=terms,
@@ -438,7 +441,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
                  verbose: bool = False,
                  beta0=None, on_iteration=None, native: bool | None = None,
                  backend: str = "auto", retry=None, checkpoint=None,
-                 resume=False,
+                 resume=False, trace=None, metrics=None,
                  config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """Fit a GLM by formula straight from a CSV too big to load.
 
@@ -490,7 +493,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
             has_intercept=f.intercept, mesh=mesh, cache=cache,
             verbose=verbose, beta0=beta0, on_iteration=on_iteration,
             retry=retry, checkpoint=checkpoint, resume=resume,
-            config=config)
+            trace=trace, metrics=metrics, config=config)
     finally:
         parse_cleanup()
     import dataclasses
@@ -504,7 +507,7 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
                 na_omit: bool = True, chunk_bytes: int = 256 << 20,
                 mesh=None, native: bool | None = None, parse_cache="auto",
                 backend: str = "auto", retry=None, checkpoint=None,
-                resume=False,
+                resume=False, trace=None, metrics=None,
                 config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """OLS/WLS by formula straight from a CSV too big to load (two
     streaming passes: Gramian accumulation, then the exact host-f64
@@ -541,7 +544,8 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
         model = streaming.lm_fit_streaming(
             source, xnames=terms.xnames, yname=f.response,
             has_intercept=f.intercept, mesh=mesh, retry=retry,
-            checkpoint=checkpoint, resume=resume, config=config)
+            checkpoint=checkpoint, resume=resume, trace=trace,
+            metrics=metrics, config=config)
     finally:
         parse_cleanup()
     import dataclasses

@@ -29,8 +29,11 @@ import os
 import subprocess
 import tempfile
 import threading
+import time
 
 import numpy as np
+
+from ..obs import trace as _obs_trace
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
@@ -290,10 +293,25 @@ def scan_csv_levels(path: str, *, native: bool | None = None,
         lib.sgio_free(h)
 
 
+def _emit_read(fmt: str, path, shard_index: int, num_shards: int,
+               t0: float, out: dict, tracer) -> dict:
+    """Emit one ``read`` event for a completed reader call (shared by the
+    CSV/NDJSON/Parquet readers); returns ``out`` so call sites stay
+    one-liners.  No tracer -> free."""
+    if tracer is not None:
+        rows = len(next(iter(out.values()))) if out else 0
+        nbytes = sum(int(np.asarray(c).nbytes) for c in out.values())
+        tracer.emit("read", format=fmt, path=str(path),
+                    shard=int(shard_index), shards=int(num_shards),
+                    rows=int(rows), cols=len(out), bytes=nbytes,
+                    seconds=time.perf_counter() - t0)
+    return out
+
+
 def read_csv(path: str, *, shard_index: int = 0, num_shards: int = 1,
              schema: dict[str, int] | None = None,
              native: bool | None = None,
-             retry=None) -> dict[str, np.ndarray]:
+             retry=None, trace=None) -> dict[str, np.ndarray]:
     """Read a CSV into name -> column arrays (float64 or str).
 
     ``shard_index``/``num_shards`` select a newline-aligned byte-range slice
@@ -303,7 +321,9 @@ def read_csv(path: str, *, shard_index: int = 0, num_shards: int = 1,
     builds/loads.  ``retry=`` takes a ``robust.RetryPolicy``: transient
     read failures (OSError and ``TransientSourceError`` by default — NFS
     blips, object-store timeouts) re-read the slice under capped
-    exponential backoff instead of killing a multi-pass fit.
+    exponential backoff instead of killing a multi-pass fit.  ``trace=``
+    (or the ambient tracer of an enclosing traced fit) receives one
+    ``read`` event per successful call with row/byte counts and seconds.
     """
     if num_shards < 1 or not (0 <= shard_index < num_shards):
         raise ValueError(
@@ -313,14 +333,19 @@ def read_csv(path: str, *, shard_index: int = 0, num_shards: int = 1,
         return call_with_retry(
             lambda: read_csv(path, shard_index=shard_index,
                              num_shards=num_shards, schema=schema,
-                             native=native),
+                             native=native, trace=trace),
             policy=retry, key=f"read_csv:{path}:{shard_index}/{num_shards}")
+    tracer = _obs_trace.resolve(trace)
+    t0 = time.perf_counter()
+    orig_path = path
     path = resolve_gz(path, shard_index, num_shards, "read_csv")
     lib = _load() if native in (None, True) else None
     if native is True and lib is None:
         raise RuntimeError(f"native loader unavailable: {_lib_error}")
     if lib is None:
-        return _read_csv_py(path, shard_index, num_shards, schema)
+        return _emit_read("csv", orig_path, shard_index, num_shards, t0,
+                          _read_csv_py(path, shard_index, num_shards, schema),
+                          tracer)
 
     # learn names first (cheap: header only matters) to map schema -> kinds
     kinds_ptr, n_kinds = None, 0
@@ -337,7 +362,8 @@ def read_csv(path: str, *, shard_index: int = 0, num_shards: int = 1,
         err = lib.sgio_error(h)
         if err:
             raise OSError(err.decode())
-        return native_table_columns(lib, h)
+        return _emit_read("csv", orig_path, shard_index, num_shards, t0,
+                          native_table_columns(lib, h), tracer)
     finally:
         lib.sgio_free(h)
 

@@ -24,9 +24,12 @@ gated so importing sparkglm_tpu never requires it.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
-from .io import CATEGORICAL, NUMERIC
+from ..obs import trace as _obs_trace
+from .io import CATEGORICAL, NUMERIC, _emit_read
 
 
 def _pq():
@@ -112,7 +115,7 @@ def _column_out(pa, col, kind: int) -> np.ndarray:
 def read_parquet(path: str, *, shard_index: int = 0, num_shards: int = 1,
                  schema: dict[str, int] | None = None,
                  columns: list[str] | None = None,
-                 retry=None) -> dict[str, np.ndarray]:
+                 retry=None, trace=None) -> dict[str, np.ndarray]:
     """Read a contiguous row-group band into name -> column arrays.
 
     The per-host loading pattern for multi-host meshes, mirroring
@@ -122,7 +125,9 @@ def read_parquet(path: str, *, shard_index: int = 0, num_shards: int = 1,
     ``columns`` prunes the read to the named columns (Parquet reads are
     columnar — the pruning actually skips IO, unlike CSV).  ``retry=``
     takes a ``robust.RetryPolicy`` and re-reads the band on transient IO
-    failures with capped exponential backoff (``read_csv`` contract).
+    failures with capped exponential backoff (``read_csv`` contract);
+    ``trace=`` (or an enclosing traced fit's ambient tracer) receives one
+    ``read`` event per successful call.
     """
     if num_shards < 1 or not (0 <= shard_index < num_shards):
         raise ValueError(
@@ -132,9 +137,11 @@ def read_parquet(path: str, *, shard_index: int = 0, num_shards: int = 1,
         return call_with_retry(
             lambda: read_parquet(path, shard_index=shard_index,
                                  num_shards=num_shards, schema=schema,
-                                 columns=columns),
+                                 columns=columns, trace=trace),
             policy=retry,
             key=f"read_parquet:{path}:{shard_index}/{num_shards}")
+    tracer = _obs_trace.resolve(trace)
+    t0 = time.perf_counter()
     pa, pq = _pq()
     pf = pq.ParquetFile(path)
     if schema is None:
@@ -149,9 +156,11 @@ def read_parquet(path: str, *, shard_index: int = 0, num_shards: int = 1,
                 f"(has {names})")
         names = [n for n in names if n in set(columns)]
     if not band:
-        return {n: (np.empty(0, np.float64)
-                    if schema.get(n, NUMERIC) == NUMERIC
-                    else np.empty(0, object)) for n in names}
+        return _emit_read(
+            "parquet", path, shard_index, num_shards, t0,
+            {n: (np.empty(0, np.float64)
+                 if schema.get(n, NUMERIC) == NUMERIC
+                 else np.empty(0, object)) for n in names}, tracer)
     table = pf.read_row_groups(band, columns=names)
     out: dict[str, np.ndarray] = {}
     for name in names:
@@ -161,7 +170,8 @@ def read_parquet(path: str, *, shard_index: int = 0, num_shards: int = 1,
         if pa.types.is_dictionary(col.type):
             col = col.cast(col.type.value_type)
         out[name] = _column_out(pa, col, schema.get(name, NUMERIC))
-    return out
+    return _emit_read("parquet", path, shard_index, num_shards, t0, out,
+                      tracer)
 
 
 def row_group_bands(path: str, chunk_bytes: int) -> int:

@@ -571,7 +571,8 @@ def _step_aic(m, k: float) -> float:
 
 
 def step(model, data, *, scope: str | None = None, direction: str = "both",
-         k: float = 2.0, steps: int = 1000, trace: bool = False, **fit_kw):
+         k: float = 2.0, steps: int = 1000, trace: bool = False, out=None,
+         **fit_kw):
     """R's ``step``: AIC-guided stepwise selection built on
     :func:`add1`/:func:`drop1` moves (the reference has no selection verbs
     at all; R users expect the triple).
@@ -583,9 +584,13 @@ def step(model, data, *, scope: str | None = None, direction: str = "both",
     family/link, by-name weights/offset/m, and PATH data (out-of-core
     streaming refits) all work.  A forward candidate whose marginal terms
     are not yet in the model is skipped until its margins enter.  Returns
-    the final fitted model; ``trace=True`` prints R's per-step lines.
+    the final fitted model; ``trace=True`` prints R's per-step lines to
+    ``out`` (any writable text stream; default stdout) — pass e.g. an
+    ``io.StringIO`` to capture the trace, or ``sys.stderr`` to keep it out
+    of piped output.
     """
     import re as _re
+    import sys as _sys
 
     from .. import api
     from ..data.formula import TERM_RE, _expand_term, canonical_component
@@ -660,9 +665,11 @@ def step(model, data, *, scope: str | None = None, direction: str = "both",
 
     current = model
     cur_aic = _step_aic(current, k)
+    if out is None:
+        out = _sys.stdout
     if trace:
-        print(f"Start:  AIC={cur_aic:.2f}")
-        print(f"{current.formula}\n")
+        print(f"Start:  AIC={cur_aic:.2f}", file=out)
+        print(f"{current.formula}\n", file=out)
     for _ in range(int(steps)):
         term_keys = {frozenset(canonical_component(c) for c in t)
                      for t in current.terms.design}
@@ -700,11 +707,12 @@ def step(model, data, *, scope: str | None = None, direction: str = "both",
                 best = (a, sign, term, cand)
         if trace and evals:
             # the table body without the empty title/heading/spacer lines
-            print("\n".join(str(_move_table(evals, cur_aic)).split("\n")[3:]))
+            print("\n".join(str(_move_table(evals, cur_aic)).split("\n")[3:]),
+                  file=out)
         if best is None or best[0] >= cur_aic - 1e-10:
             break
         cur_aic, _, _, current = best
         if trace:
-            print(f"\nStep:  AIC={cur_aic:.2f}")
-            print(f"{current.formula}\n")
+            print(f"\nStep:  AIC={cur_aic:.2f}", file=out)
+            print(f"{current.formula}\n", file=out)
     return current

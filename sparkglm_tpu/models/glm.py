@@ -44,6 +44,7 @@ from ..config import (DEFAULT, NumericConfig, effective_tol,
                       resolve_matmul_precision)
 from ..families.families import Family, resolve
 from ..families.links import Link
+from ..obs import trace as _obs_trace
 from ..ops.fused import fused_fisher_pass, fused_fisher_pass_ref
 from ..ops.gramian import weighted_gramian
 from ..ops.solve import (factor_parts, factor_singular, inv_from_parts,
@@ -226,11 +227,14 @@ def _irls_kernel(
         stalled = _dev_bad(dev_new, s["dev"]) & halve_ok
         if trace:
             # the reference's verbose "iter\tddev" line (GLM.scala:304,461);
-            # it_base keeps numbering monotone across checkpoint segments
-            jax.debug.print("iter {i}\tdeviance {d}\tddev {dd}",
-                            i=s["it"] + 1 + (0 if it_base is None else it_base),
-                            d=dev_new,
-                            dd=jnp.abs(dev_new - s["dev"]))
+            # it_base keeps numbering monotone across checkpoint segments.
+            # Host callback, not print: the line routes through the
+            # ambient FitTracer (obs/trace.py) so verbose output and
+            # structured tracing share one formatting path
+            jax.debug.callback(
+                _emit_iter_event,
+                s["it"] + 1 + (0 if it_base is None else it_base),
+                dev_new, jnp.abs(dev_new - s["dev"]), h["k"])
         return dict(
             it=s["it"] + 1,
             beta=beta.astype(X.dtype),
@@ -509,11 +513,14 @@ def _irls_fused_kernel(
         beta_new, fac, singular, pivot = solve(XtWX, XtWz, s["beta"],
                                                (s["fac_a"], s["fac_d"]))
         if trace:
-            # it_base keeps numbering monotone across checkpoint segments
-            jax.debug.print("iter {i}\tdeviance {d}\tddev {dd}",
-                            i=s["it"] + 1 + (0 if it_base is None else it_base),
-                            d=dev,
-                            dd=jnp.abs(dev - s["dev"]))
+            # it_base keeps numbering monotone across checkpoint segments;
+            # s["halvings"] is the count so far (this trip's update lands
+            # in the next event).  Same ambient-tracer callback as the
+            # einsum kernel — one formatting path.
+            jax.debug.callback(
+                _emit_iter_event,
+                s["it"] + 1 + (0 if it_base is None else it_base),
+                dev, jnp.abs(dev - s["dev"]), s["halvings"])
         mid = (0.5 * (s["beta"].astype(jnp.float32)
                       + s["beta_prev"].astype(jnp.float32))).astype(bdt)
         # a retracted (or stalled) trip must not adopt the solve produced
@@ -614,6 +621,27 @@ class GLMModel:
     m_col: str | None = None
     has_weights: bool = False
     has_m: bool = False
+    # structured fit telemetry (sparkglm_tpu.obs): the FitTracer's report()
+    # aggregate, attached when the fit ran traced (trace=/metrics=/verbose=).
+    # Plain JSON-able dict so save_model round-trips it; None otherwise.
+    fit_info: dict | None = None
+
+    def fit_report(self) -> dict:
+        """How the fit ran: iterations, wall/device time split, per-pass
+        IO vs compute, fault counts (obs/trace.py event aggregate).
+
+        Untraced fits return the basic convergence record only; fit with
+        ``trace=``/``metrics=`` (or ``verbose=``) for the full report."""
+        rep = {
+            "model": "glm", "family": self.family, "link": self.link,
+            "iterations": int(self.iterations),
+            "converged": bool(self.converged),
+            "deviance": float(self.deviance),
+            "n_obs": int(self.n_obs), "n_params": int(self.n_params),
+        }
+        if self.fit_info:
+            rep.update(self.fit_info)
+        return rep
 
     def predict(self, X, type: str = "response", offset=None,
                 se_fit: bool = False, mesh=None):
@@ -767,10 +795,51 @@ class GLMModel:
             f"type must be deviance/pearson/response/working, got {type!r}")
 
 
+def _emit_iter_event(i, dev, ddev, halvings) -> None:
+    """``jax.debug.callback`` target for the kernels' in-loop trace line.
+
+    Runs on the host (possibly a runtime thread — the ambient tracer is a
+    module global for exactly this reason).  Falls back to the legacy
+    stderr line when no tracer is installed, so ``trace=True`` on a bare
+    kernel call still prints something."""
+    tr = _obs_trace.current_tracer()
+    if tr is not None:
+        tr.iter(int(i), float(dev), float(ddev), halvings=int(halvings))
+    else:  # bare kernel call with trace=True and no ambient tracer
+        import sys
+        print(f"iter {int(i)}\tdeviance {float(dev):.8g}"
+              f"\tddev {float(ddev):.3g}", file=sys.stderr)
+
+
+def _trace_kernel_calls(run_kernel, tracer):
+    """Wrap an engine closure so every compiled segment runs inside a
+    device-aware span (obs/timing.py): blocking happens at the span edge
+    only — the caller reads these outputs immediately anyway, so the
+    compiled while_loop is never perturbed.  The first call emits
+    ``compile`` (wall time including compilation), every call emits
+    ``solve`` with the segment's iteration count."""
+    from ..obs import timing as _obs_timing
+    state = {"calls": 0}
+
+    def wrapped(seg_iters, beta_arr, warm, it_base=0, dev_prev=None):
+        with _obs_timing.span("irls_segment", tracer, device=True) as sp:
+            out = run_kernel(seg_iters, beta_arr, warm, it_base, dev_prev)
+            sp.watch(out)
+        if state["calls"] == 0:
+            tracer.emit("compile", target="irls_kernel", seconds=sp.seconds)
+        state["calls"] += 1
+        tracer.emit("solve", target="irls_segment",
+                    iters=int(np.asarray(out["iters"])), seconds=sp.seconds)
+        return out
+
+    return wrapped
+
+
 def _finalize_model(
     *, fam, lnk, beta, cov_inv, dev, pearson, loglik, wt_sum, n_ok,
     null_dev, iters, converged, n_obs, p, xnames, yname, has_intercept,
     has_offset, n_shards, tol, criterion, verbose, tol_eff=None,
+    tracer=None,
 ) -> GLMModel:
     """Shared tail of every resident fit path: the non-convergence warning,
     dispersion / SEs / AIC (ref: createObj, GLM.scala:59-88) and the model
@@ -794,9 +863,17 @@ def _finalize_model(
     cov_inv = np.asarray(cov_inv, np.float64)
     std_err = np.sqrt(np.maximum(dispersion * np.diag(cov_inv), 0.0))
     aic = float(fam.aic(dev, loglik, float(n_ok), float(p), wt_sum))
-    if verbose:
-        print(f"IRLS finished: {iters} iterations, deviance={dev:.8g}, "
-              f"converged={converged}")
+    if tracer is None and verbose:
+        # verbose fits normally arrive with a tracer (fit's stderr preset);
+        # this covers direct _finalize_model callers only
+        tracer = _obs_trace.current_tracer()
+    if tracer is not None:
+        # drain pending jax.debug.callback iter events so the report counts
+        # them and fit_end lands after every iter in the sequence
+        jax.effects_barrier()
+        # the legacy "IRLS finished" line is the StderrSink's fit_end format
+        tracer.emit("fit_end", iterations=int(iters), deviance=float(dev),
+                    converged=bool(converged))
     return GLMModel(
         coefficients=np.asarray(beta, np.float64),
         std_errors=std_err, xnames=tuple(xnames), yname=yname,
@@ -814,7 +891,7 @@ def _fit_global(
     X, y, weights, offset, fam, lnk, tol, max_iter, criterion,
     xnames, yname, has_intercept, mesh, verbose, config,
     beta0=None, on_iteration=None, checkpoint_every: int = 0,
-    engine: str = "auto",
+    engine: str = "auto", tracer=None,
 ) -> GLMModel:
     """Multi-process fit on already-global row-sharded jax.Arrays.
 
@@ -897,7 +974,7 @@ def _fit_global(
                 family=fam, link=lnk, criterion=criterion,
                 refine_steps=config.refine_steps,
                 mesh=mesh, block_rows=block_rows,
-                use_pallas=pallas_ok, trace=verbose,
+                use_pallas=pallas_ok, trace=verbose or tracer is not None,
                 precision=config.matmul_precision,
                 beta0=jnp.asarray(np.asarray(beta_arr), dtype), warm=warm,
                 it_base=jnp.asarray(it_base, jnp.int32),
@@ -911,13 +988,16 @@ def _fit_global(
                 jnp.asarray(seg_iters, jnp.int32),
                 jnp.asarray(config.jitter, dtype),
                 family=fam, link=lnk, criterion=criterion,
-                refine_steps=config.refine_steps, trace=verbose,
+                refine_steps=config.refine_steps,
+                trace=verbose or tracer is not None,
                 precision=config.matmul_precision,
                 beta0=jnp.asarray(np.asarray(beta_arr), dtype), warm=warm,
                 it_base=jnp.asarray(it_base, jnp.int32),
                 fam_param=fam_param,
             )
 
+    if tracer is not None:
+        run_kernel = _trace_kernel_calls(run_kernel, tracer)
     if beta0 is not None or on_iteration is not None or checkpoint_every:
         # segmented checkpointing: the multi-host recovery story — every
         # process persists beta in its on_iteration and a restarted job
@@ -1006,7 +1086,8 @@ def _fit_global(
         n_obs=n_ok, p=p, xnames=xnames, yname=yname,
         has_intercept=has_intercept, has_offset=has_offset,
         n_shards=mesh.shape[meshlib.DATA_AXIS], tol=tol,
-        criterion=criterion, verbose=verbose, tol_eff=tol_run)
+        criterion=criterion, verbose=verbose, tol_eff=tol_run,
+        tracer=tracer)
 
 
 def fit(
@@ -1032,9 +1113,18 @@ def fit(
     beta0=None,
     on_iteration=None,
     checkpoint_every: int = 0,
+    trace=None,
+    metrics=None,
     config: NumericConfig = DEFAULT,
 ) -> GLMModel:
     """Fit a GLM by IRLS on the device mesh.
+
+    Telemetry (``sparkglm_tpu.obs``): ``trace=`` takes a
+    :class:`~sparkglm_tpu.obs.FitTracer`, a sink, a JSONL path, or True
+    (the stderr preset ``verbose=True`` also selects); ``metrics=`` a
+    :class:`~sparkglm_tpu.obs.MetricsRegistry`.  Traced fits attach the
+    event aggregate as ``model.fit_report()``.  Events are host-side, so
+    traced and untraced fits produce bit-identical coefficients.
 
     Checkpoint/resume (the explicit replacement for Spark lineage
     recovery, SURVEY.md §2.4): ``checkpoint_every=k`` surfaces
@@ -1073,8 +1163,6 @@ def fit(
         pointed auto at the fused kernel were per-call tunnel timings —
         retracted in r5.
     """
-    from .lm import _detect_intercept
-
     if criterion not in ("absolute", "relative"):
         raise ValueError(
             f"criterion must be 'absolute' or 'relative', got {criterion!r}")
@@ -1084,6 +1172,33 @@ def fit(
         raise ValueError(
             f"polish must be None (auto), 'csne' or 'off', got {config.polish!r}")
     fam, lnk = resolve(family, link)
+    tracer = _obs_trace.as_tracer(trace, verbose=verbose, metrics=metrics)
+    kw = dict(weights=weights, offset=offset, m=m, tol=tol,
+              max_iter=max_iter, criterion=criterion, xnames=xnames,
+              yname=yname, has_intercept=has_intercept, mesh=mesh,
+              shard_features=shard_features, engine=engine,
+              singular=singular, verbose=verbose, beta0=beta0,
+              on_iteration=on_iteration, checkpoint_every=checkpoint_every,
+              config=config, tracer=tracer)
+    if tracer is None:
+        return _fit_dispatch(X, y, fam, lnk, **kw)
+    with _obs_trace.ambient(tracer):
+        tracer.emit("fit_start", model="glm", family=fam.name,
+                    link=lnk.name, engine=engine)
+        model = _fit_dispatch(X, y, fam, lnk, **kw)
+    return dataclasses.replace(model, fit_info=tracer.report())
+
+
+def _fit_dispatch(
+    X, y, fam, lnk, *, weights, offset, m, tol, max_iter, criterion,
+    xnames, yname, has_intercept, mesh, shard_features, engine, singular,
+    verbose, beta0, on_iteration, checkpoint_every, config, tracer,
+) -> GLMModel:
+    """Body of :func:`fit` below argument/tracer resolution, factored out
+    so the traced path wraps the whole fit — global-array dispatch
+    included — in one ambient-tracer scope."""
+    from .lm import _detect_intercept
+
     if isinstance(X, jax.Array) and not X.is_fully_addressable:
         # global arrays spanning processes (parallel/distributed.py flow):
         # no host copy of the data exists here, so dispatch to the SPMD path
@@ -1110,7 +1225,8 @@ def fit(
                            criterion, xnames, yname, has_intercept, mesh,
                            verbose, config, beta0=beta0,
                            on_iteration=on_iteration,
-                           checkpoint_every=checkpoint_every, engine=engine)
+                           checkpoint_every=checkpoint_every, engine=engine,
+                           tracer=tracer)
     X = np.asarray(X)
     y = np.asarray(y)
     if y.ndim == 2:
@@ -1251,13 +1367,15 @@ def fit(
                 mesh=mesh, block_rows=block_rows,
                 # the Mosaic kernel is float32; float64 (x64) runs the XLA twin
                 use_pallas=on_tpu and p <= 1024 and dtype == np.float32,
-                trace=verbose,
+                trace=verbose or tracer is not None,
                 precision=config.matmul_precision,
                 beta0=jnp.asarray(beta_arr, dtype), warm=warm,
                 it_base=jnp.asarray(it_base, jnp.int32),
                 dev_prev=None if dev_prev is None else jnp.asarray(dev_prev),
                 fam_param=fam_param,
             )
+        if tracer is not None:
+            run_kernel = _trace_kernel_calls(run_kernel, tracer)
         if checkpointing:
             out = _segmented_irls(run_kernel, p=p, dtype=dtype,
                                   max_iter=max_iter, beta0=beta0,
@@ -1283,7 +1401,8 @@ def fit(
                 refine_steps=config.refine_steps,
                 mesh=mesh, block_rows=block_rows,
                 use_pallas=on_tpu and p <= 1024,
-                trace=verbose, precision=config.matmul_precision,
+                trace=verbose or tracer is not None,
+                precision=config.matmul_precision,
                 fam_param=fam_param)
             it1 = int(np.asarray(warm_out["iters"]))
             if it1 >= int(max_iter):
@@ -1314,7 +1433,7 @@ def fit(
                 jnp.asarray(config.jitter, dtype),
                 family=fam, link=lnk, criterion=criterion,
                 refine_steps=config.refine_steps,
-                trace=verbose,
+                trace=verbose or tracer is not None,
                 precision=config.matmul_precision,
                 solver="qr" if engine == "qr" else "chol",
                 mesh=mesh if engine == "qr" else None,
@@ -1322,6 +1441,8 @@ def fit(
                 it_base=jnp.asarray(it_base, jnp.int32),
                 fam_param=fam_param,
             )
+        if tracer is not None:
+            run_kernel = _trace_kernel_calls(run_kernel, tracer)
         if checkpointing:
             out = _segmented_irls(run_kernel, p=p, dtype=dtype,
                                   max_iter=max_iter, beta0=beta0,
@@ -1365,7 +1486,7 @@ def fit(
                       shard_features=shard_features, engine=engine,
                       singular="error", verbose=verbose, config=config,
                       beta0=sub_beta0, on_iteration=sub_hook,
-                      checkpoint_every=checkpoint_every)
+                      checkpoint_every=checkpoint_every, trace=tracer)
             return expand_aliased(sub, mask, xnames)
     if bool(out["singular"]):
         # vectors were validated up front; name a non-finite design before
@@ -1441,4 +1562,5 @@ def fit(
         converged=bool(out["converged"]), n_obs=n, p=p,
         xnames=xnames, yname=yname, has_intercept=has_intercept,
         has_offset=has_offset, n_shards=mesh.shape[meshlib.DATA_AXIS],
-        tol=tol, criterion=criterion, verbose=verbose, tol_eff=tol_run)
+        tol=tol, criterion=criterion, verbose=verbose, tol_eff=tol_run,
+        tracer=tracer)

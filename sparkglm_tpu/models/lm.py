@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import DEFAULT, NumericConfig
+from ..obs import trace as _obs_trace
 from ..ops.gramian import weighted_gramian, weighted_moments
 from ..ops.solve import (diag_inv_from_cho, factor_singular,
                          independent_columns, inv_from_cho, min_pivot,
@@ -164,6 +165,25 @@ class LMModel:
     # weights VARY (diff(range(w)) != 0) — distinct from has_weights, which
     # records that the CALL had weights (update()/logLik plumbing)
     weights_vary: bool = False
+    # fit telemetry aggregate (obs/trace.py FitTracer.report()), attached
+    # when the fit ran with trace=/metrics=; None otherwise
+    fit_info: dict | None = None
+
+    def fit_report(self) -> dict:
+        """How the fit ran: wall time, per-pass IO vs compute, fault counts
+        (obs/trace.py event aggregate).
+
+        Untraced fits return the basic fit record only; fit with
+        ``trace=``/``metrics=`` for the full report."""
+        rep = {
+            "model": "lm",
+            "n_obs": int(self.n_obs), "n_params": int(self.n_params),
+            "sigma": float(self.sigma),
+            "r_squared": float(self.r_squared),
+        }
+        if self.fit_info:
+            rep.update(self.fit_info)
+        return rep
 
     # -- scoring (LM.scala:29-61) --------------------------------------------
     def predict(self, X, mesh=None, se_fit: bool = False,
@@ -383,6 +403,8 @@ def fit(
     shard_features: bool = False,
     singular: str = "error",
     engine: str = "auto",
+    trace=None,
+    metrics=None,
     config: NumericConfig = DEFAULT,
 ) -> LMModel:
     """Fit OLS/WLS by the normal equations on the device mesh.
@@ -403,7 +425,23 @@ def fit(
     mean.  Coefficients solve the y - offset regression; fitted values,
     R^2 and F follow R's summary.lm fitted-based moments (mss =
     sum w (f - wmean(f))^2 with f INCLUDING the offset).
+
+    ``trace=``/``metrics=`` (``sparkglm_tpu.obs``): structured fit
+    telemetry; host-side only, so traced and untraced fits are
+    bit-identical.  The aggregate lands on ``model.fit_report()``.
     """
+    tracer = _obs_trace.as_tracer(trace, metrics=metrics)
+    if tracer is not None:
+        # self-recursion with trace=None runs the body below while the
+        # tracer is ambient (the kernel span and any readers emit into it)
+        with _obs_trace.ambient(tracer):
+            tracer.emit("fit_start", model="lm", engine=engine)
+            model = fit(X, y, weights=weights, offset=offset, xnames=xnames,
+                        yname=yname, has_intercept=has_intercept, mesh=mesh,
+                        shard_features=shard_features, singular=singular,
+                        engine=engine, config=config)
+            tracer.emit("fit_end", model="lm")
+        return dataclasses.replace(model, fit_info=tracer.report())
     if singular not in ("error", "drop"):
         raise ValueError(f"singular must be 'error' or 'drop', got {singular!r}")
     if engine not in ("auto", "gramian", "qr"):
@@ -444,7 +482,6 @@ def fit(
     mmp = resolve_matmul_precision(config, n, p,
                                    jax.default_backend() == "tpu")
     if mmp != config.matmul_precision:
-        import dataclasses
         config = dataclasses.replace(config, matmul_precision=mmp)
 
     w_host = np.ones((n,), dtype=dtype) if weights is None else np.asarray(weights, dtype=dtype)
@@ -467,11 +504,17 @@ def fit(
     # zero weight on padding rows keeps them inert in every reduction
     wd = meshlib.shard_rows(w_host, mesh)
 
-    out = _lm_kernel(Xd, yd, wd, jnp.asarray(config.jitter, dtype),
-                     refine_steps=config.refine_steps,
-                     precision=config.matmul_precision,
-                     solver="qr" if engine == "qr" else "chol",
-                     mesh=mesh if engine == "qr" else None)
+    from ..obs import timing as _obs_timing
+    _tr = _obs_trace.current_tracer()
+    with _obs_timing.span("lm_kernel", _tr, device=True) as sp:
+        out = _lm_kernel(Xd, yd, wd, jnp.asarray(config.jitter, dtype),
+                         refine_steps=config.refine_steps,
+                         precision=config.matmul_precision,
+                         solver="qr" if engine == "qr" else "chol",
+                         mesh=mesh if engine == "qr" else None)
+        sp.watch(out)
+    if _tr is not None:
+        _tr.emit("solve", target="lm_kernel", p=int(p), seconds=sp.seconds)
     out = jax.tree.map(np.asarray, out)
 
     if singular == "drop":

@@ -46,6 +46,8 @@ pass; single-process fits).
 
 from __future__ import annotations
 
+import dataclasses
+import time
 from functools import partial
 from typing import Callable, Iterator, Sequence
 
@@ -55,6 +57,7 @@ import numpy as np
 import scipy.linalg
 
 from ..config import DEFAULT, NumericConfig, effective_tol
+from ..obs import trace as _obs_trace
 from ..families.families import Family, resolve
 from ..families.links import Link
 from ..ops.fused import fused_fisher_pass_ref
@@ -666,6 +669,8 @@ def lm_fit_streaming(
     retry=None,
     checkpoint=None,
     resume=False,
+    trace=None,
+    metrics=None,
     config: NumericConfig = DEFAULT,
 ) -> LMModel:
     """OLS/WLS in ONE streaming pass (host-f64 accumulation + solve).
@@ -689,7 +694,42 @@ def lm_fit_streaming(
     that pass — after validating the chunk-source fingerprint.  The cheap
     host-side residual passes re-run on resume; the result is bit-identical
     to an uninterrupted fit.
+
+    Telemetry (``sparkglm_tpu.obs``): ``trace=`` takes a
+    :class:`~sparkglm_tpu.obs.FitTracer`, a sink, a JSONL path, or ``True``
+    (stderr); ``metrics=`` a :class:`~sparkglm_tpu.obs.MetricsRegistry`.
+    Events are host-side only — the fitted model is bit-identical either
+    way — and the aggregate lands on ``model.fit_report()``.
     """
+    tracer = _obs_trace.as_tracer(trace, metrics=metrics)
+    kw = dict(chunk_rows=chunk_rows, xnames=xnames, yname=yname,
+              has_intercept=has_intercept, mesh=mesh, retry=retry,
+              checkpoint=checkpoint, resume=resume, config=config,
+              tracer=tracer)
+    if tracer is None:
+        return _lm_fit_streaming_impl(source, **kw)
+    with _obs_trace.ambient(tracer):
+        tracer.emit("fit_start", model="lm_streaming")
+        model = _lm_fit_streaming_impl(source, **kw)
+        tracer.emit("fit_end", model="lm_streaming")
+    return dataclasses.replace(model, fit_info=tracer.report())
+
+
+def _lm_fit_streaming_impl(
+    source,
+    *,
+    chunk_rows,
+    xnames,
+    yname,
+    has_intercept,
+    mesh,
+    retry,
+    checkpoint,
+    resume,
+    config,
+    tracer,
+) -> LMModel:
+    """Body of :func:`lm_fit_streaming` with the tracer already resolved."""
     _check_polish(config)
     nproc = jax.process_count()
     mesh = _streaming_mesh(mesh)
@@ -722,6 +762,15 @@ def lm_fit_streaming(
         om = np.asarray(_ck_state["ones_mask"])
         ones_mask = om.astype(bool) if om.size else None
         dtype = np.dtype(str(_ck_state["dtype"]))
+    # pass telemetry: "compute" is the time blocked on the chunk kernel
+    # (device work + host f64 accumulation); everything else in the pass
+    # wall time is source IO + H2D transfer
+    t_pass0 = time.perf_counter()
+    pass_chunks = 0
+    pass_bytes = 0
+    pass_compute = 0.0
+    if tracer is not None and _ck_state is None:
+        tracer.pass_start("gramian", 1)
     err = None
     try:
         for Xc, yc, wc, oc in ([] if _ck_state is not None
@@ -760,8 +809,13 @@ def lm_fit_streaming(
                 Xd, yd, wd, od = _put_chunk(Xc, yc, wc, oc, mesh, dtype)
                 if oc is not None:
                     yd = _sub_dev(yd, od)
+            pass_chunks += 1
+            pass_bytes += sum(int(a.nbytes) for a in (Xd, yd, wd, od)
+                              if a is not None)
+            t_c = time.perf_counter()
             d = _lm_chunk_pass(Xd, yd, wd)
             d = {k: np.asarray(v, np.float64) for k, v in d.items()}
+            pass_compute += time.perf_counter() - t_c
             yc64, wc64, _ = _host_chunk(yc, wc, None)
             d["sw"] = float(wc64.sum())
             d["swy"] = float(np.sum(wc64 * yc64))
@@ -775,6 +829,12 @@ def lm_fit_streaming(
         err = e
     if nproc > 1:
         _sync_errors(err)
+    if tracer is not None and _ck_state is None:
+        wall = time.perf_counter() - t_pass0
+        tracer.pass_end("gramian", 1, chunks=pass_chunks, rows=n,
+                        bytes=pass_bytes,
+                        io_s=max(0.0, wall - pass_compute),
+                        compute_s=pass_compute)
 
     p = acc["XtWX"].shape[0]
     if nproc > 1 and _ck_state is None:
@@ -813,7 +873,11 @@ def lm_fit_streaming(
             any(nm.lower() in ("intercept", "(intercept)") for nm in xnames)
             or bool(ones_mask.any()))
 
+    t_s = time.perf_counter()
     beta, cho, pivot = _solve64(acc["XtWX"], acc["XtWy"], config.jitter)
+    if tracer is not None:
+        tracer.emit("solve", target="cholesky64", p=int(p),
+                    seconds=time.perf_counter() - t_s)
     diag_inv = _diag_inv64(cho)
     if _sync_polish_decision(
             _resolve_streaming_polish(pivot, dtype, config), nproc):
@@ -847,10 +911,17 @@ def lm_fit_streaming(
     # R's "Weighted Residuals:" header needs diff(range(w)) != 0, so track
     # the global weight range, not just presence
     w_lo, w_hi = np.inf, -np.inf
+    t_pass0 = time.perf_counter()
+    pass_chunks = 0
+    pass_rows = 0
+    if tracer is not None:
+        tracer.pass_start("residuals", 2)
     err = None
     try:
         for Xc, yc, wc, oc in _iter_chunks(chunks):
             xb = _chunk_xbeta(Xc, beta)
+            pass_chunks += 1
+            pass_rows += int(xb.shape[0])
             yc64, wc64, oc64 = _host_chunk(yc, wc, oc)
             f = xb + oc64
             resid = yc64 - f
@@ -887,6 +958,9 @@ def lm_fit_streaming(
             mh.process_allgather(np.asarray([w_lo, w_hi], np.float64)))
         w_lo = float(np.min(rng_all[..., 0]))
         w_hi = float(np.max(rng_all[..., 1]))
+    if tracer is not None:
+        tracer.pass_end("residuals", 2, chunks=pass_chunks, rows=pass_rows,
+                        bytes=0, compute_s=time.perf_counter() - t_pass0)
     weights_vary = np.isfinite(w_lo) and w_hi > w_lo
     if saw_offset:
         # R's summary.lm with an offset: mss from the FITTED values
@@ -975,6 +1049,8 @@ def glm_fit_streaming(
     retry=None,
     checkpoint=None,
     resume=False,
+    trace=None,
+    metrics=None,
     config: NumericConfig = DEFAULT,
     _null_model: bool = False,
 ) -> GLMModel:
@@ -1014,10 +1090,47 @@ def glm_fit_streaming(
     errors with capped backoff under a per-pass budget; exhausted budgets
     (and fatal errors) raise, synchronized across processes by the same
     flag exchange as any other streaming failure.
+
+    Telemetry (``sparkglm_tpu.obs``): ``trace=`` takes a
+    :class:`~sparkglm_tpu.obs.FitTracer`, a sink, a JSONL path, or ``True``
+    (stderr); ``metrics=`` a :class:`~sparkglm_tpu.obs.MetricsRegistry`.
+    ``verbose=True`` is the stderr-sink preset of the same machinery.  The
+    tracer sees ``iter``/``pass_start``/``pass_end``/``solve`` events plus
+    whatever the retry/checkpoint layers emit; events are host-side only
+    (traced and untraced fits are bit-identical) and the aggregate lands on
+    ``model.fit_report()``.
     """
     if criterion not in ("absolute", "relative"):
         raise ValueError(
             f"criterion must be 'absolute' or 'relative', got {criterion!r}")
+    fam, lnk = resolve(family, link)
+    tracer = _obs_trace.as_tracer(trace, verbose=verbose, metrics=metrics)
+    kw = dict(family=fam, link=lnk, tol=tol, max_iter=max_iter,
+              criterion=criterion, chunk_rows=chunk_rows, xnames=xnames,
+              yname=yname, has_intercept=has_intercept, mesh=mesh,
+              verbose=verbose, beta0=beta0, on_iteration=on_iteration,
+              cache=cache, cache_budget_bytes=cache_budget_bytes,
+              retry=retry, checkpoint=checkpoint, resume=resume,
+              config=config, _null_model=_null_model, tracer=tracer)
+    if tracer is None:
+        return _glm_fit_streaming_impl(source, **kw)
+    with _obs_trace.ambient(tracer):
+        tracer.emit("fit_start", model="glm_streaming", family=fam.name,
+                    link=lnk.name)
+        model = _glm_fit_streaming_impl(source, **kw)
+        tracer.emit("fit_end", iterations=int(model.iterations),
+                    deviance=float(model.deviance),
+                    converged=bool(model.converged))
+    return dataclasses.replace(model, fit_info=tracer.report())
+
+
+def _glm_fit_streaming_impl(
+    source, *, family, link, tol, max_iter, criterion, chunk_rows, xnames,
+    yname, has_intercept, mesh, verbose, beta0, on_iteration, cache,
+    cache_budget_bytes, retry, checkpoint, resume, config, _null_model,
+    tracer,
+) -> GLMModel:
+    """Body of :func:`glm_fit_streaming` with the tracer already resolved."""
     _check_polish(config)
     fam, lnk = resolve(family, link)
     nproc = jax.process_count()
@@ -1032,6 +1145,7 @@ def glm_fit_streaming(
     saw_offset = False
     dtype = None
     ones_mask = None
+    pass_no = 0  # telemetry: pass index across init/irls/stats passes
     src_fp = None  # first-chunk fingerprint, for checkpoint identity
     scan_intercept = has_intercept is None
     scanned = False  # metadata (intercept/offset) scan done on the 1st pass
@@ -1102,23 +1216,40 @@ def glm_fit_streaming(
             yield (*dchunk, int(Xc.shape[0]))
 
     def full_pass(beta, first):
-        nonlocal n_total, scanned
+        nonlocal n_total, scanned, pass_no
+        pass_no += 1
+        idx = pass_no
+        label = "init" if first else "irls"
+        if tracer is not None:
+            tracer.pass_start(label, idx)
+        # telemetry split: "compute" is the time blocked draining device
+        # results (device work + host f64 accumulation); the rest of the
+        # pass wall time is source generation + H2D transfer ("io")
+        t_p0 = time.perf_counter()
+        compute_s = 0.0
+        nchunks = 0
+        nbytes = 0
         XtWX = XtWz = None
         dev = 0.0
         count = 0
         pending = None  # chunk k's in-flight device results
 
         def drain(res):
-            nonlocal XtWX, XtWz, dev
+            nonlocal XtWX, XtWz, dev, compute_s
+            t_c = time.perf_counter()
             A, v, dv = res
             A = np.asarray(A, np.float64)   # forces completion
             v = np.asarray(v, np.float64)
             XtWX = A if XtWX is None else XtWX + A
             XtWz = v if XtWz is None else XtWz + v
             dev += float(dv)
+            compute_s += time.perf_counter() - t_c
 
         for dX, dy, dw, do, n_true in device_chunks():
             count += n_true
+            nchunks += 1
+            nbytes += sum(int(a.nbytes) for a in (dX, dy, dw, do)
+                          if a is not None)
             b = jnp.zeros((dX.shape[1],), dX.dtype) if beta is None else \
                 jnp.asarray(beta, dX.dtype)
             # dispatch chunk k+1 (device_put + pass are async) BEFORE
@@ -1138,6 +1269,11 @@ def glm_fit_streaming(
         scanned = True
         if ccache.open:
             ccache.complete = True  # a full pass fit entirely in the budget
+        if tracer is not None:
+            wall = time.perf_counter() - t_p0
+            tracer.pass_end(label, idx, chunks=nchunks, rows=count,
+                            bytes=nbytes, io_s=max(0.0, wall - compute_s),
+                            compute_s=compute_s)
         return XtWX, XtWz, dev
 
     n_rows_global = None  # cross-process row count (n_total stays local)
@@ -1209,7 +1345,11 @@ def glm_fit_streaming(
         XtWX, XtWz, dev_prev = global_pass(None, True)
     if _ck_state is None:
         p = XtWX.shape[0]
+        t_s = time.perf_counter()
         beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
+        if tracer is not None:
+            tracer.emit("solve", target="cholesky64", p=int(p),
+                        seconds=time.perf_counter() - t_s)
 
     iters = it0
     converged = False
@@ -1227,12 +1367,18 @@ def glm_fit_streaming(
         crit = ddev / (abs(dev) + 0.1) if criterion == "relative" else ddev
         dev_prev = dev
         iters = it + 1
-        if verbose:
+        if tracer is not None:
+            tracer.iter(iters, float(dev), float(ddev))
+        elif verbose:  # direct impl calls only; fits route via the tracer
             print(f"iter {iters}\tdeviance {dev:.8g}\tddev {ddev:.3g}")
         # solve before the convergence break so beta and the SE ingredient
         # diag((X'WX)^-1) come from the same final pass, exactly like the
         # resident fused engine's loop body
+        t_s = time.perf_counter()
         beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
+        if tracer is not None:
+            tracer.emit("solve", target="cholesky64", p=int(p),
+                        seconds=time.perf_counter() - t_s)
         if ckpt is not None:
             # post-solve state: a resume restores dev_prev=dev and this
             # beta, making its next pass exactly the uninterrupted next one
@@ -1289,11 +1435,19 @@ def glm_fit_streaming(
     # approximate for R-parity scalars; the chunks are host data anyway, so
     # the linear predictor is one numpy dgemm per chunk)
     from . import hoststats
+    pass_no += 1
+    if tracer is not None:
+        tracer.pass_start("stats", pass_no)
+    t_p0 = time.perf_counter()
+    stats_chunks = 0
+    stats_rows = 0
     stats = None
     err = None
     try:
         for Xc, yc, wc, oc in _iter_chunks(chunks):
             xb = _chunk_xbeta(Xc, beta)
+            stats_chunks += 1
+            stats_rows += int(xb.shape[0])
             yc, wc, oc = _host_chunk(yc, wc, oc)
             eta = xb + oc
             d = hoststats.glm_chunk_stats(fam.name, lnk.name, yc, eta, wc)
@@ -1305,6 +1459,10 @@ def glm_fit_streaming(
     if nproc > 1:
         _sync_errors(err)
         stats = _allsum_scalars(stats)
+    if tracer is not None:
+        tracer.pass_end("stats", pass_no, chunks=stats_chunks,
+                        rows=stats_rows, bytes=0,
+                        compute_s=time.perf_counter() - t_p0)
 
     n = n_rows_global if n_rows_global is not None else n_total
     if not _null_model:

@@ -1,0 +1,33 @@
+"""Structured fit telemetry: trace events, metrics, device-aware timing.
+
+The observability substrate for every fit flavor (resident, streaming,
+multi-process) and the robustness layer:
+
+  * :mod:`.trace` — :class:`FitTracer` emitting typed, deterministically
+    ordered events (``iter``, ``pass_start``/``pass_end``, ``retry``,
+    ``checkpoint_write``, ``resume``, ``compile``, ``solve``, …) to JSONL
+    / stderr / ring-buffer sinks.  Every fit entry point takes ``trace=``;
+    ``verbose=True`` is the stderr-sink preset.
+  * :mod:`.metrics` — process-local counters/gauges/histograms with
+    ``snapshot()`` and JSON export; pass ``metrics=`` to any fit.
+  * :mod:`.timing` — spans that ``block_until_ready`` only at span edges
+    (the compiled ``lax.while_loop`` is never perturbed) plus an opt-in
+    ``jax.profiler`` trace hook.
+
+Events are host-side: tracing never changes device code, so traced and
+untraced fits produce bit-identical coefficients (PARITY.md).  Fitted
+models carry the tracer's aggregate as ``model.fit_report()``.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      get_registry)
+from .timing import Span, profiler_trace, span
+from .trace import (FitTracer, JsonlSink, RingBufferSink, Sink, StderrSink,
+                    TraceEvent, ambient, as_tracer, current_tracer)
+
+__all__ = [
+    "TraceEvent", "Sink", "JsonlSink", "StderrSink", "RingBufferSink",
+    "FitTracer", "as_tracer", "ambient", "current_tracer",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "Span", "span", "profiler_trace",
+]

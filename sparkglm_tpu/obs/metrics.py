@@ -1,0 +1,147 @@
+"""Process-local counters, gauges and histograms with JSON export.
+
+A deliberately tiny metrics substrate (no exporter daemon, no external
+deps): :class:`MetricsRegistry` holds named instruments, ``snapshot()``
+returns a plain nested dict, ``to_json()`` serializes it.  The FitTracer
+feeds one automatically when constructed with ``metrics=`` (obs/trace.py),
+and any later serving/autoscaling layer can scrape ``snapshot()`` on its
+own schedule — the instruments are just numbers behind one lock.
+
+Histograms keep count/sum/min/max plus power-of-two bucket counts
+(``bucket_le[k]`` counts observations <= 2^k seconds), enough for the
+IO-vs-compute pass-latency questions the streaming fits ask without
+storing samples.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry"]
+
+
+class Counter:
+    """Monotone event count."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+    def snapshot(self):
+        return self.value
+
+
+class Gauge:
+    """Last-set value (e.g. the current deviance)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def snapshot(self):
+        return self.value
+
+
+class Histogram:
+    """count/sum/min/max plus log2 bucket counts; no stored samples."""
+
+    __slots__ = ("count", "total", "min", "max", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.buckets: dict[int, int] = {}
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.total += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        # bucket k counts observations <= 2^k (k = ceil(log2 v), clamped)
+        k = max(-30, math.ceil(math.log2(v))) if v > 0 else -30
+        self.buckets[k] = self.buckets.get(k, 0) + 1
+
+    def snapshot(self):
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+            "mean": self.total / self.count if self.count else None,
+            "bucket_le": {f"2^{k}": n
+                          for k, n in sorted(self.buckets.items())},
+        }
+
+
+class MetricsRegistry:
+    """Named instruments behind one lock; get-or-create accessors refuse a
+    name already registered as a different instrument type."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls()
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} is a {type(inst).__name__}, not a "
+                    f"{cls.__name__}")
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> dict:
+        """Plain nested dict of every instrument, grouped by type."""
+        with self._lock:
+            out: dict[str, dict] = {"counters": {}, "gauges": {},
+                                    "histograms": {}}
+            for name, inst in sorted(self._instruments.items()):
+                if isinstance(inst, Counter):
+                    out["counters"][name] = inst.snapshot()
+                elif isinstance(inst, Gauge):
+                    out["gauges"][name] = inst.snapshot()
+                else:
+                    out["histograms"][name] = inst.snapshot()
+            return out
+
+    def to_json(self, indent: int | None = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-default registry (for callers that want one shared
+    registry across fits rather than per-fit instances)."""
+    return _GLOBAL

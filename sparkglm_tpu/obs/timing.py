@@ -1,0 +1,109 @@
+"""Device-aware timing spans.
+
+JAX dispatch is asynchronous: a naive ``perf_counter`` pair around a
+kernel call times the ENQUEUE, not the work.  The existing answer
+(utils/profiling.Timer) force-reads every output leaf; these spans keep
+that honesty but synchronize only at the SPAN EDGES — the compiled
+``lax.while_loop`` itself is never perturbed, so a traced fit runs the
+exact program an untraced one does (the numerics-neutrality contract in
+PARITY.md).
+
+Usage::
+
+    with span("irls_segment", tracer, device=True) as sp:
+        out = run_kernel(...)
+        sp.watch(out)          # block_until_ready(out) at __exit__ only
+
+On exit the span blocks on everything watched, then emits one ``span``
+event (name, seconds, device flag) into ``tracer`` — or the ambient
+tracer when none was given.  ``profiler=True`` additionally brackets the
+span in a ``jax.profiler.TraceAnnotation`` so it shows up on the XLA
+trace timeline (opt-in: annotations are free but nonzero).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+from . import trace as _trace
+
+__all__ = ["Span", "span", "sync", "profiler_trace"]
+
+
+def sync(tree) -> None:
+    """Block until every array in ``tree`` is ready (host values pass
+    through untouched).  The span-edge synchronization primitive."""
+    import jax
+    try:
+        jax.block_until_ready(tree)
+    except Exception:
+        # conservative fallback: force-read leaves that expose the method
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if hasattr(leaf, "block_until_ready"):
+                leaf.block_until_ready()
+
+
+class Span:
+    """Context manager timing a region; blocks on watched arrays only at
+    the edges and emits one ``span`` event on exit."""
+
+    def __init__(self, name: str, tracer=None, *, device: bool = False,
+                 profiler: bool = False):
+        self.name = name
+        self.tracer = tracer
+        self.device = device
+        self.profiler = profiler
+        self.seconds = 0.0
+        self._watched: list = []
+        self._ann = None
+        self._t0 = 0.0
+
+    def watch(self, *trees) -> None:
+        """Register outputs to ``block_until_ready`` at ``__exit__``."""
+        self._watched.extend(trees)
+
+    def __enter__(self) -> "Span":
+        if self.profiler:
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._watched:
+            sync(self._watched)
+        self.seconds = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        if exc and exc[0] is not None:
+            return  # don't emit half-measured spans on error paths
+        tr = self.tracer if self.tracer is not None \
+            else _trace.current_tracer()
+        if tr is not None:
+            tr.emit("span", name=self.name, seconds=self.seconds,
+                    device=bool(self.device or self._watched))
+
+
+def span(name: str, tracer=None, *, device: bool = False,
+         profiler: bool = False) -> Span:
+    """Build a :class:`Span` (see module docstring for the contract)."""
+    return Span(name, tracer, device=device, profiler=profiler)
+
+
+@contextmanager
+def profiler_trace(logdir: str, enabled: bool = True):
+    """Opt-in ``jax.profiler`` trace context around a whole fit: when
+    ``enabled``, writes an XLA trace to ``logdir`` (view with
+    TensorBoard/Perfetto); otherwise a no-op.  The whole-program
+    complement of per-span ``profiler=True`` annotations."""
+    if not enabled:
+        yield
+        return
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,420 @@
+"""Typed, deterministically-ordered fit trace events and sinks.
+
+The reference surfaces nothing about a running fit beyond the final
+summary printer (GLM.scala:998); before this module our port scattered
+convergence progress across ad-hoc ``print``/``jax.debug.print`` calls
+and ran the whole robustness machinery (retries, checkpoint/resume,
+step-halving) silently.  :class:`FitTracer` replaces all of that with one
+structured event stream:
+
+  ``fit_start`` / ``fit_end``   fit lifecycle (fit_end carries the legacy
+                                "IRLS finished" fields)
+  ``iter``                      one IRLS iteration: deviance, |ddev|,
+                                step-halving count
+  ``pass_start`` / ``pass_end`` one streaming pass: chunk/row/byte counts
+                                plus the host-IO vs device-compute split
+  ``read``                      one reader call (data/io.py, data/parquet.py)
+  ``retry`` / ``budget_exhausted``  robust/retry.py fault handling
+  ``checkpoint_write`` / ``resume`` robust/checkpoint.py durability
+  ``compile`` / ``solve``       kernel compilation and linear solves
+  ``span``                      a device-aware timing span (obs/timing.py)
+
+Events are ordered by a per-tracer monotone sequence number assigned under
+a lock, so two runs of the same deterministic fit produce the same
+(seq, kind, fields) sequence — wall-clock timestamps ride along but are
+excluded from :meth:`TraceEvent.key`, the comparison tests use.
+
+Events are HOST-side: emitting them never changes what runs on the
+accelerator (the resident kernels route their in-loop line through
+``jax.debug.callback``, a side effect outside the dataflow), so traced and
+untraced fits produce bit-identical coefficients (PARITY.md).
+
+Sinks: :class:`JsonlSink` (one JSON object per line), :class:`StderrSink`
+(the ``verbose=True`` preset — prints the legacy per-iteration and
+completion lines, keeping one formatting path), and
+:class:`RingBufferSink` (bounded in-memory buffer for tests/notebooks).
+
+The AMBIENT tracer (:func:`ambient` / :func:`current_tracer`) lets layers
+that cannot thread a tracer argument — jitted kernels via
+``jax.debug.callback``, the retry/checkpoint plumbing, readers invoked
+deep inside a chunk source — emit into the fit's tracer.  It is a plain
+module global, not a thread-local, because debug callbacks may run on a
+runtime thread; fits within one process do not run concurrently.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import IO
+
+__all__ = [
+    "TraceEvent", "Sink", "JsonlSink", "StderrSink", "RingBufferSink",
+    "FitTracer", "as_tracer", "ambient", "current_tracer", "resolve",
+]
+
+
+class TraceEvent:
+    """One typed event: monotone ``seq``, ``kind``, wall-clock ``t``
+    (seconds, ``time.perf_counter`` domain), and a flat ``fields`` dict of
+    JSON-able values."""
+
+    __slots__ = ("seq", "kind", "t", "fields")
+
+    def __init__(self, seq: int, kind: str, t: float, fields: dict):
+        self.seq = seq
+        self.kind = kind
+        self.t = t
+        self.fields = fields
+
+    def key(self) -> tuple:
+        """Deterministic identity: everything except the timestamp."""
+        return (self.seq, self.kind, tuple(sorted(self.fields.items())))
+
+    def to_dict(self) -> dict:
+        return {"seq": self.seq, "kind": self.kind, "t": self.t,
+                **self.fields}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"TraceEvent({self.seq}, {self.kind!r}, {self.fields!r})"
+
+
+class Sink:
+    """Event consumer; subclasses override :meth:`emit`."""
+
+    def emit(self, event: TraceEvent) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class JsonlSink(Sink):
+    """Append one JSON object per event to ``path`` (opened lazily so a
+    tracer can be constructed before the target directory exists)."""
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = os.fspath(path)
+        self._f: IO[str] | None = None
+
+    def emit(self, event: TraceEvent) -> None:
+        if self._f is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a", encoding="utf-8")
+        self._f.write(json.dumps(event.to_dict(), sort_keys=True) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class StderrSink(Sink):
+    """Human-readable sink — the ``verbose=True`` preset.
+
+    Prints the legacy per-iteration and completion lines (the single
+    formatting path for every fit flavor; resident and streaming fits used
+    to format these independently).  ``all_events=True`` additionally
+    prints every other event as ``[kind] k=v ...``.
+    """
+
+    def __init__(self, stream: IO[str] | None = None,
+                 all_events: bool = False):
+        self.stream = stream
+        self.all_events = all_events
+
+    def emit(self, event: TraceEvent) -> None:
+        out = self.stream if self.stream is not None else sys.stderr
+        f = event.fields
+        if event.kind == "iter":
+            line = (f"iter {f['i']}\tdeviance {f['deviance']:.8g}"
+                    f"\tddev {f['ddev']:.3g}")
+            if f.get("halvings"):
+                line += f"\thalvings {f['halvings']}"
+        elif event.kind == "fit_end" and "iterations" in f:
+            line = (f"IRLS finished: {f['iterations']} iterations, "
+                    f"deviance={f['deviance']:.8g}, "
+                    f"converged={f['converged']}")
+        elif self.all_events:
+            kv = " ".join(f"{k}={v}" for k, v in sorted(f.items()))
+            line = f"[{event.kind}] {kv}"
+        else:
+            return
+        print(line, file=out, flush=True)
+
+
+class RingBufferSink(Sink):
+    """Keep the last ``capacity`` events in memory (tests, notebooks,
+    post-mortem of long fits without unbounded growth)."""
+
+    def __init__(self, capacity: int = 65536):
+        self._buf: deque[TraceEvent] = deque(maxlen=int(capacity))
+
+    def emit(self, event: TraceEvent) -> None:
+        self._buf.append(event)
+
+    @property
+    def events(self) -> list[TraceEvent]:
+        return list(self._buf)
+
+    def kinds(self) -> list[str]:
+        return [e.kind for e in self._buf]
+
+
+class FitTracer:
+    """Emit typed fit events to sinks and aggregate them into the
+    :meth:`report` dict that backs ``model.fit_report()``.
+
+    ``metrics=`` (an :class:`~sparkglm_tpu.obs.metrics.MetricsRegistry`)
+    additionally maintains process-local counters/histograms per event.
+    A tracer with no sinks still aggregates — ``metrics=`` alone buys
+    ``fit_report()`` at near-zero cost.
+    """
+
+    def __init__(self, sinks=(), metrics=None):
+        self.sinks: list[Sink] = [self._coerce_sink(s) for s in sinks]
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._t0 = time.perf_counter()
+        # aggregates for report(); every value stays JSON-able
+        self._counts: dict[str, int] = {}
+        self._iterations = 0
+        self._halvings = 0
+        self._passes: list[dict] = []
+        self._chunks = 0
+        self._rows_streamed = 0
+        self._bytes_to_device = 0
+        self._io_s = 0.0
+        self._compute_s = 0.0
+        self._device_s = 0.0
+        self._compile_s = 0.0
+        self._reads = 0
+        self._read_bytes = 0
+        self._read_s = 0.0
+        self._retries = 0
+        self._chunks_skipped = 0
+        self._checkpoint_writes = 0
+        self._resumes = 0
+
+    @staticmethod
+    def _coerce_sink(s) -> Sink:
+        if isinstance(s, Sink):
+            return s
+        if s is True or s == "stderr":
+            return StderrSink()
+        if isinstance(s, (str, os.PathLike)):
+            return JsonlSink(s)
+        raise TypeError(
+            f"sink must be a Sink, a JSONL path, or 'stderr'; got {s!r}")
+
+    def add_sink(self, sink) -> "FitTracer":
+        self.sinks.append(self._coerce_sink(sink))
+        return self
+
+    def ring(self) -> RingBufferSink | None:
+        """The first attached ring buffer, if any (test convenience)."""
+        for s in self.sinks:
+            if isinstance(s, RingBufferSink):
+                return s
+        return None
+
+    # -- core -------------------------------------------------------------
+    def emit(self, kind: str, **fields) -> TraceEvent:
+        with self._lock:
+            ev = TraceEvent(self._seq, kind, time.perf_counter() - self._t0,
+                            fields)
+            self._seq += 1
+            self._aggregate(ev)
+        for s in self.sinks:
+            s.emit(ev)
+        return ev
+
+    def _aggregate(self, ev: TraceEvent) -> None:
+        f = ev.fields
+        self._counts[ev.kind] = self._counts.get(ev.kind, 0) + 1
+        m = self.metrics
+        if m is not None:
+            m.counter(f"events.{ev.kind}").inc()
+        if ev.kind == "iter":
+            self._iterations = max(self._iterations, int(f.get("i", 0)))
+            self._halvings += int(f.get("halvings", 0))
+            if m is not None:
+                m.gauge("irls.deviance").set(float(f.get("deviance", 0.0)))
+        elif ev.kind == "pass_end":
+            self._chunks += int(f.get("chunks", 0))
+            self._rows_streamed += int(f.get("rows", 0))
+            self._bytes_to_device += int(f.get("bytes", 0))
+            self._io_s += float(f.get("io_s", 0.0))
+            self._compute_s += float(f.get("compute_s", 0.0))
+            self._passes.append(dict(f))
+            if m is not None:
+                m.histogram("pass.io_s").observe(float(f.get("io_s", 0.0)))
+                m.histogram("pass.compute_s").observe(
+                    float(f.get("compute_s", 0.0)))
+        elif ev.kind == "read":
+            self._reads += 1
+            self._read_bytes += int(f.get("bytes", 0))
+            self._read_s += float(f.get("seconds", 0.0))
+            if m is not None:
+                m.histogram("read.seconds").observe(
+                    float(f.get("seconds", 0.0)))
+        elif ev.kind == "retry":
+            self._retries += 1
+            self._chunks_skipped += int(f.get("skipped", 0))
+            if m is not None:
+                m.counter("faults.retries").inc()
+        elif ev.kind == "checkpoint_write":
+            self._checkpoint_writes += 1
+        elif ev.kind == "resume":
+            self._resumes += 1
+        elif ev.kind == "compile":
+            self._compile_s += float(f.get("seconds", 0.0))
+        elif ev.kind in ("solve", "span"):
+            if f.get("device"):
+                self._device_s += float(f.get("seconds", 0.0))
+
+    # -- typed convenience emitters ---------------------------------------
+    def iter(self, i: int, deviance: float, ddev: float,
+             halvings: int = 0) -> TraceEvent:
+        return self.emit("iter", i=int(i), deviance=float(deviance),
+                         ddev=float(ddev), halvings=int(halvings))
+
+    def pass_start(self, label: str, index: int, **fields) -> TraceEvent:
+        return self.emit("pass_start", label=label, index=int(index),
+                         **fields)
+
+    def pass_end(self, label: str, index: int, *, chunks: int, rows: int,
+                 bytes: int, io_s: float = 0.0,
+                 compute_s: float = 0.0) -> TraceEvent:
+        return self.emit("pass_end", label=label, index=int(index),
+                         chunks=int(chunks), rows=int(rows),
+                         bytes=int(bytes), io_s=float(io_s),
+                         compute_s=float(compute_s))
+
+    # -- lifecycle / report -----------------------------------------------
+    def report(self) -> dict:
+        """JSON-able aggregate of everything emitted so far — the payload
+        ``fit_report()`` attaches to fitted models."""
+        with self._lock:
+            return {
+                "schema": "sparkglm.fit_report.v1",
+                "events": self._seq,
+                "event_counts": dict(sorted(self._counts.items())),
+                "iterations": self._iterations,
+                "halvings": self._halvings,
+                "wall_s": time.perf_counter() - self._t0,
+                "device_s": self._device_s,
+                "compile_s": self._compile_s,
+                "io_s": self._io_s,
+                "compute_s": self._compute_s,
+                "passes": len(self._passes),
+                "chunks": self._chunks,
+                "rows_streamed": self._rows_streamed,
+                "bytes_to_device": self._bytes_to_device,
+                "reads": self._reads,
+                "read_bytes": self._read_bytes,
+                "read_s": self._read_s,
+                "retries": self._retries,
+                "chunks_skipped": self._chunks_skipped,
+                "budget_exhausted": self._counts.get("budget_exhausted", 0),
+                "checkpoint_writes": self._checkpoint_writes,
+                "resumes": self._resumes,
+                "solves": self._counts.get("solve", 0),
+            }
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()
+
+
+# -- coercion of the user-facing trace= argument ---------------------------
+
+def as_tracer(trace=None, *, verbose: bool = False,
+              metrics=None) -> FitTracer | None:
+    """Coerce a ``trace=`` argument into a :class:`FitTracer` (or None).
+
+    ``True`` (and ``verbose=True``) -> the stderr preset; a path ->
+    :class:`JsonlSink`; a :class:`Sink` -> wrapped; a tracer -> returned
+    as-is (``metrics=`` attached if it has none).  ``None`` with neither
+    ``verbose`` nor ``metrics`` -> None, the zero-overhead default.
+    """
+    if isinstance(trace, FitTracer):
+        if metrics is not None and trace.metrics is None:
+            trace.metrics = metrics
+        if verbose and not any(isinstance(s, StderrSink)
+                               for s in trace.sinks):
+            trace.add_sink(StderrSink())
+        return trace
+    sinks: list = []
+    if trace is True:
+        sinks.append(StderrSink())
+    elif isinstance(trace, Sink):
+        sinks.append(trace)
+    elif isinstance(trace, (str, os.PathLike)):
+        sinks.append(JsonlSink(trace))
+    elif trace is not None:
+        raise TypeError(
+            "trace= must be a FitTracer, Sink, JSONL path, True, or None; "
+            f"got {trace!r}")
+    if verbose and not any(isinstance(s, StderrSink) for s in sinks):
+        sinks.append(StderrSink())
+    if not sinks and metrics is None:
+        return None
+    return FitTracer(sinks, metrics=metrics)
+
+
+# -- ambient tracer ---------------------------------------------------------
+# A module global (NOT a thread-local): jax.debug.callback may fire on a
+# runtime thread, and fits within one process never run concurrently.
+
+_AMBIENT: FitTracer | None = None
+
+
+def current_tracer() -> FitTracer | None:
+    return _AMBIENT
+
+
+class ambient:
+    """Context manager installing ``tracer`` as the process-ambient tracer
+    for layers that cannot thread one through (jitted kernels, the retry/
+    checkpoint plumbing, readers inside chunk sources)."""
+
+    def __init__(self, tracer: FitTracer | None):
+        self.tracer = tracer
+        self._prev: FitTracer | None = None
+
+    def __enter__(self) -> FitTracer | None:
+        global _AMBIENT
+        self._prev = _AMBIENT
+        if self.tracer is not None:
+            _AMBIENT = self.tracer
+        return self.tracer
+
+    def __exit__(self, *exc) -> None:
+        global _AMBIENT
+        _AMBIENT = self._prev
+
+
+def resolve(trace) -> FitTracer | None:
+    """An explicit ``trace=`` argument, or the ambient tracer: the reader-
+    level resolution (an explicit tracer wins; plain calls made inside a
+    traced fit inherit the fit's tracer)."""
+    if trace is None:
+        return current_tracer()
+    return as_tracer(trace)
+
+
+def emit_ambient(kind: str, **fields) -> None:
+    """Emit into the ambient tracer if one is installed; no-op otherwise.
+    The hook the robustness layer uses (robust/retry.py, checkpoint.py)."""
+    tr = current_tracer()
+    if tr is not None:
+        tr.emit(kind, **fields)

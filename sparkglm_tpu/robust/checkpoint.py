@@ -34,6 +34,13 @@ _FORMAT = 1
 _RESERVED = ("format", "kind", "fingerprint", "p")
 
 
+def _emit(kind: str, **fields) -> None:
+    """Durability events flow into whatever fit is running (the ambient
+    tracer, obs/trace.py); lazy import keeps robust importable standalone."""
+    from ..obs.trace import emit_ambient
+    emit_ambient(kind, **fields)
+
+
 def _fp_array(fingerprint) -> np.ndarray:
     """Fingerprint tuples may contain None for absent weight/offset corner
     samples (``streaming._fingerprint``); encode as NaN so the record is a
@@ -83,6 +90,12 @@ class CheckpointManager:
             except OSError:
                 pass
             raise
+        # emitted only after the atomic rename: the event means "this
+        # checkpoint is durable", not "a write was attempted"
+        fields = {"path": self.path, "model": kind, "bytes": buf.tell()}
+        if "iters" in payload:
+            fields["iters"] = int(np.asarray(payload["iters"]))
+        _emit("checkpoint_write", **fields)
 
     def load(self) -> dict:
         with np.load(self.path) as z:
@@ -121,6 +134,12 @@ class CheckpointManager:
                 f"(first-chunk fingerprint differs); resuming against a "
                 f"different source would silently corrupt the trajectory — "
                 f"delete the checkpoint (or drop resume=) to start over")
+        # emitted on ACCEPTED resumes only — a rejected checkpoint raises
+        # above and the fit never continues from it
+        fields = {"path": self.path, "model": kind, "p": int(p)}
+        if "iters" in state:
+            fields["iters"] = int(np.asarray(state["iters"]))
+        _emit("resume", **fields)
 
     def remove(self) -> None:
         try:

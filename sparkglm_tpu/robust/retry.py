@@ -56,6 +56,13 @@ def _default_sleep(seconds: float) -> None:
         time.sleep(seconds)
 
 
+def _emit(kind: str, **fields) -> None:
+    """Fault events flow into whatever fit is running (the ambient tracer,
+    obs/trace.py); lazy import keeps robust importable standalone."""
+    from ..obs.trace import emit_ambient
+    emit_ambient(kind, **fields)
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Capped exponential backoff with deterministic jitter.
@@ -111,6 +118,7 @@ class RetryBudget:
     def spend(self, exc: BaseException) -> None:
         self.spent += 1
         if self.spent > self.total:
+            _emit("budget_exhausted", total=self.total, error=repr(exc)[:200])
             raise RetryBudgetExhausted(
                 f"retry budget ({self.total} per pass) exhausted; last "
                 f"transient error: {exc!r}") from exc
@@ -133,7 +141,10 @@ def call_with_retry(fn: Callable, *, policy: RetryPolicy,
             if attempt >= policy.max_retries or not policy.is_transient(e):
                 raise
             budget.spend(e)
-            policy.sleep(policy.delay(attempt, key))
+            delay = policy.delay(attempt, key)
+            _emit("retry", key=str(key), attempt=attempt, delay_s=delay,
+                  error=repr(e)[:200])
+            policy.sleep(delay)
     raise AssertionError("unreachable")  # pragma: no cover
 
 
@@ -170,7 +181,10 @@ def retrying_source(chunks: Callable, policy: RetryPolicy) -> Callable:
                             or not policy.is_transient(e)):
                         raise
                     budget.spend(e)
-                    policy.sleep(policy.delay(attempt, "open"))
+                    delay = policy.delay(attempt, "open")
+                    _emit("retry", key="open", attempt=attempt,
+                          delay_s=delay, error=repr(e)[:200])
+                    policy.sleep(delay)
             raise AssertionError("unreachable")  # pragma: no cover
 
         it = reopen()
@@ -184,7 +198,13 @@ def retrying_source(chunks: Callable, policy: RetryPolicy) -> Callable:
                 if not policy.is_transient(e):
                     raise
                 budget.spend(e)
-                policy.sleep(policy.delay(0, ("iter", k)))
+                delay = policy.delay(0, ("iter", k))
+                # the reopen fast-forwards past the k chunks already
+                # delivered this pass; record that skip — it used to be
+                # silent, hiding how much of the pass was replayed
+                _emit("retry", key=f"iter:{k}", attempt=0, delay_s=delay,
+                      skipped=k, error=repr(e)[:200])
+                policy.sleep(delay)
                 it = reopen()
                 for _ in range(k):  # skip the already-delivered prefix
                     next(it)

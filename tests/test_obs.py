@@ -1,0 +1,426 @@
+"""Structured fit telemetry (sparkglm_tpu.obs): trace events, metrics,
+device-aware spans — and the numerics-neutrality contract: traced and
+untraced fits produce bit-identical coefficients (events are host-side;
+device code is unchanged)."""
+
+import collections
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.models import glm as glm_mod
+from sparkglm_tpu.models import lm as lm_mod
+from sparkglm_tpu.models import streaming
+from sparkglm_tpu.obs import (FitTracer, JsonlSink, MetricsRegistry,
+                              RingBufferSink, Span, StderrSink, as_tracer)
+from sparkglm_tpu.obs import trace as obs_trace
+from sparkglm_tpu.robust import FaultPlan, RetryPolicy, SimulatedPreemption
+from sparkglm_tpu.robust import faulty_source, retrying_source
+
+NOSLEEP = RetryPolicy(sleep=lambda s: None)
+
+
+def _binomial_data(rng, n=4000, p=4):
+    X = rng.normal(size=(n, p))
+    X[:, 0] = 1.0
+    bt = rng.normal(size=p) / (2 * np.sqrt(p))
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(float)
+    return X, y
+
+
+def _chunk_factory(X, y, n_chunks=5):
+    n = X.shape[0]
+
+    def source():
+        for i in range(n_chunks):
+            lo = n * i // n_chunks
+            hi = n * (i + 1) // n_chunks
+            yield lambda lo=lo, hi=hi: (X[lo:hi], y[lo:hi], None, None)
+
+    return source
+
+
+def _ring_tracer():
+    ring = RingBufferSink()
+    return ring, FitTracer(sinks=[ring])
+
+
+# ---------------------------------------------------------------------------
+# trace primitives
+# ---------------------------------------------------------------------------
+
+def test_tracer_events_ordered_and_typed():
+    ring, tr = _ring_tracer()
+    tr.emit("fit_start", model="x")
+    tr.iter(1, 10.0, 1.0)
+    tr.iter(2, 9.5, 0.5, halvings=2)
+    tr.pass_start("irls", 1)
+    tr.pass_end("irls", 1, chunks=3, rows=300, bytes=1200, io_s=0.1,
+                compute_s=0.2)
+    evs = ring.events
+    assert [e.seq for e in evs] == list(range(len(evs)))
+    assert ring.kinds() == ["fit_start", "iter", "iter", "pass_start",
+                            "pass_end"]
+    rep = tr.report()
+    assert rep["iterations"] == 2
+    assert rep["halvings"] == 2
+    assert rep["chunks"] == 3 and rep["rows_streamed"] == 300
+    assert rep["io_s"] == pytest.approx(0.1)
+    # key() excludes the wall timestamp: two tracers emitting the same
+    # events have identical keys even though t differs
+    ring2, tr2 = _ring_tracer()
+    tr2.emit("fit_start", model="x")
+    assert ring2.events[0].key() == evs[0].key()
+
+
+def test_jsonl_sink_roundtrip(tmp_path):
+    path = tmp_path / "sub" / "trace.jsonl"  # parent dir created lazily
+    tr = FitTracer(sinks=[JsonlSink(path)])
+    tr.emit("fit_start", model="glm")
+    tr.iter(1, 2.5, 0.5)
+    tr.close()
+    lines = [json.loads(s) for s in path.read_text().splitlines()]
+    assert [d["kind"] for d in lines] == ["fit_start", "iter"]
+    assert lines[1]["deviance"] == 2.5 and lines[1]["seq"] == 1
+
+
+def test_stderr_sink_formats_legacy_lines():
+    buf = io.StringIO()
+    tr = FitTracer(sinks=[StderrSink(stream=buf)])
+    tr.iter(3, 123.456, 0.01)
+    tr.iter(4, 120.0, 0.002, halvings=1)
+    tr.emit("fit_end", iterations=4, deviance=120.0, converged=True)
+    tr.emit("solve", target="x")  # not printed unless all_events
+    out = buf.getvalue()
+    assert "iter 3\tdeviance 123.456\tddev 0.01" in out
+    assert "halvings 1" in out
+    assert "IRLS finished: 4 iterations" in out
+    assert "solve" not in out
+
+
+def test_as_tracer_coercions(tmp_path):
+    assert as_tracer(None) is None
+    assert isinstance(as_tracer(True).sinks[0], StderrSink)
+    assert isinstance(as_tracer(str(tmp_path / "t.jsonl")).sinks[0],
+                      JsonlSink)
+    tr = FitTracer()
+    assert as_tracer(tr) is tr
+    # verbose=True is the stderr preset — added to an existing tracer once
+    as_tracer(tr, verbose=True)
+    as_tracer(tr, verbose=True)
+    assert sum(isinstance(s, StderrSink) for s in tr.sinks) == 1
+    with pytest.raises(TypeError):
+        as_tracer(12345)
+
+
+def test_metrics_registry_snapshot_and_json():
+    m = MetricsRegistry()
+    m.counter("a").inc()
+    m.counter("a").inc(2)
+    m.gauge("g").set(1.5)
+    h = m.histogram("h")
+    for v in (0.1, 0.2, 0.4):
+        h.observe(v)
+    snap = m.snapshot()
+    assert snap["counters"]["a"] == 3
+    assert snap["gauges"]["g"] == 1.5
+    assert snap["histograms"]["h"]["count"] == 3
+    assert snap["histograms"]["h"]["mean"] == pytest.approx(0.7 / 3)
+    json.loads(m.to_json())  # serializable
+    with pytest.raises(TypeError):
+        m.gauge("a")  # name already a Counter
+
+
+def test_span_emits_into_ambient():
+    ring, tr = _ring_tracer()
+    with obs_trace.ambient(tr):
+        with Span("work") as sp:
+            pass
+    assert ring.kinds() == ["span"]
+    assert ring.events[0].fields["name"] == "work"
+    assert sp.seconds >= 0.0
+    # exceptions suppress the emit (no half-measured spans)
+    with pytest.raises(RuntimeError):
+        with obs_trace.ambient(tr), Span("bad"):
+            raise RuntimeError("x")
+    assert ring.kinds() == ["span"]
+
+
+def test_ambient_restores_previous():
+    t1, t2 = FitTracer(), FitTracer()
+    assert obs_trace.current_tracer() is None
+    with obs_trace.ambient(t1):
+        assert obs_trace.current_tracer() is t1
+        with obs_trace.ambient(t2):
+            assert obs_trace.current_tracer() is t2
+        assert obs_trace.current_tracer() is t1
+    assert obs_trace.current_tracer() is None
+
+
+# ---------------------------------------------------------------------------
+# numerics neutrality: traced == untraced, bit for bit
+# ---------------------------------------------------------------------------
+
+def test_resident_glm_traced_bit_identical(rng):
+    """The overhead guard of the acceptance criteria: tracing must not
+    change a single bit of the resident fit (events ride jax.debug.callback
+    outside the dataflow)."""
+    X, y = _binomial_data(rng)
+    m0 = glm_mod.fit(X, y, family="binomial")
+    ring, tr = _ring_tracer()
+    m1 = glm_mod.fit(X, y, family="binomial", trace=tr)
+    assert np.array_equal(np.asarray(m0.coefficients),
+                          np.asarray(m1.coefficients))
+    assert float(m0.deviance) == float(m1.deviance)
+    assert np.array_equal(np.asarray(m0.std_errors),
+                          np.asarray(m1.std_errors))
+    kinds = set(ring.kinds())
+    assert {"fit_start", "iter", "solve", "fit_end"} <= kinds
+    rep = m1.fit_report()
+    assert rep["iterations"] == m1.iterations
+    assert rep["solves"] >= 1
+    assert m0.fit_info is None  # untraced fits carry no report payload
+
+
+def test_streaming_glm_traced_bit_identical(rng):
+    X, y = _binomial_data(rng)
+    src = _chunk_factory(X, y)
+    m0 = streaming.glm_fit_streaming(src, family="binomial", cache="none")
+    ring, tr = _ring_tracer()
+    m1 = streaming.glm_fit_streaming(src, family="binomial", cache="none",
+                                     trace=tr)
+    assert np.array_equal(np.asarray(m0.coefficients),
+                          np.asarray(m1.coefficients))
+    assert float(m0.deviance) == float(m1.deviance)
+    # iteration events mirror the untraced fit's trajectory exactly
+    iters = [e for e in ring.events if e.kind == "iter"]
+    assert len(iters) == m0.iterations
+    # (approx: the stats pass re-measures deviance, which can move the
+    # last ulp relative to the in-loop measurement the iter event carries)
+    assert iters[-1].fields["deviance"] == pytest.approx(
+        float(m0.deviance), rel=1e-12)
+    rep = m1.fit_report()
+    assert rep["passes"] >= m0.iterations + 2  # init + irls + stats
+    assert rep["rows_streamed"] >= X.shape[0]
+    assert rep["chunks"] > 0 and rep["bytes_to_device"] > 0
+
+
+def test_lm_traced_bit_identical(rng):
+    X, y = _binomial_data(rng)
+    m0 = lm_mod.fit(X, y)
+    ring, tr = _ring_tracer()
+    m1 = lm_mod.fit(X, y, trace=tr)
+    assert np.array_equal(np.asarray(m0.coefficients),
+                          np.asarray(m1.coefficients))
+    assert float(m0.sse) == float(m1.sse)
+    assert {"fit_start", "solve", "span", "fit_end"} <= set(ring.kinds())
+    assert m1.fit_report()["model"] == "lm"
+
+
+# ---------------------------------------------------------------------------
+# deterministic event sequences under seeded faults
+# ---------------------------------------------------------------------------
+
+def _eager_chunk_factory(X, y, n_chunks=5):
+    """Chunks yielded as materialized tuples: a fault injected by
+    faulty_source then raises out of the generator itself (``next``),
+    driving retrying_source's mid-pass reopen + fast-forward path."""
+    n = X.shape[0]
+
+    def source():
+        for i in range(n_chunks):
+            lo = n * i // n_chunks
+            hi = n * (i + 1) // n_chunks
+            yield (X[lo:hi], y[lo:hi], None, None)
+
+    return source
+
+
+def _faulted_fit(rng_seed, trace):
+    rng = np.random.default_rng(rng_seed)
+    X, y = _binomial_data(rng)
+    src = faulty_source(_eager_chunk_factory(X, y),
+                        FaultPlan(transient_at=(7,)))
+    return streaming.glm_fit_streaming(src, family="binomial", cache="none",
+                                       retry=NOSLEEP, trace=trace)
+
+
+# events whose fields carry no wall-clock measurements; their full key()
+# (seq, kind, fields) must match bit-for-bit across runs.  pass_end /
+# solve / span / compile carry seconds — for those only (seq, kind) is
+# stable, which still pins the event SEQUENCE.
+_STABLE_KINDS = {"fit_start", "fit_end", "iter", "retry", "pass_start",
+                 "budget_exhausted"}
+
+
+def _sequence_keys(events):
+    return [e.key() if e.kind in _STABLE_KINDS else (e.seq, e.kind)
+            for e in events]
+
+
+def test_seeded_fault_event_sequence_deterministic():
+    """Two runs of the same seeded FaultPlan fit produce the same event
+    sequence — retries included (RetryPolicy jitter is hash-seeded, so
+    even delay_s matches) — and the same coefficients as an untraced
+    faulted run."""
+    r1, t1 = _ring_tracer()
+    m1 = _faulted_fit(5, t1)
+    r2, t2 = _ring_tracer()
+    m2 = _faulted_fit(5, t2)
+    assert _sequence_keys(r1.events) == _sequence_keys(r2.events)
+    assert "retry" in r1.kinds()
+    retry = next(e for e in r1.events if e.kind == "retry")
+    assert retry.fields["skipped"] == 2  # mid-pass reopen skipped 2 chunks
+    assert np.array_equal(np.asarray(m1.coefficients),
+                          np.asarray(m2.coefficients))
+    m0 = _faulted_fit(5, None)
+    assert np.array_equal(np.asarray(m0.coefficients),
+                          np.asarray(m1.coefficients))
+    assert m1.fit_report()["retries"] == 1
+    assert m1.fit_report()["chunks_skipped"] == 2
+
+
+def test_retrying_source_records_skip_count():
+    """Satellite fix: the silent mid-pass fast-forward now reports how many
+    chunks were skipped on reopen."""
+    ring, tr = _ring_tracer()
+    calls = {"n": 0}
+
+    def chunks():
+        def gen():
+            yield "a"
+            yield "b"
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("flaky")
+            yield "c"
+        return gen()
+
+    with obs_trace.ambient(tr):
+        got = list(retrying_source(chunks, NOSLEEP)())
+    assert got == ["a", "b", "c"]
+    retries = [e for e in ring.events if e.kind == "retry"]
+    assert len(retries) == 1
+    assert retries[0].fields["skipped"] == 2
+
+
+def test_preempt_resume_emits_checkpoint_and_resume_events(rng, tmp_path):
+    """The acceptance scenario: a preempted checkpointed fit resumed to
+    completion records checkpoint_write events before the preemption and a
+    resume event (plus iter events continuing the trajectory) after."""
+    X, y = _binomial_data(rng)
+    ck = str(tmp_path / "fit.ckpt")
+    src = _chunk_factory(X, y)
+    plan = FaultPlan(preempt_at=(12,))
+    r1, t1 = _ring_tracer()
+    with pytest.raises(SimulatedPreemption):
+        streaming.glm_fit_streaming(faulty_source(src, plan),
+                                    family="binomial", cache="none",
+                                    checkpoint=ck, trace=t1)
+    assert "checkpoint_write" in r1.kinds()
+    r2, t2 = _ring_tracer()
+    m = streaming.glm_fit_streaming(src, family="binomial", cache="none",
+                                    checkpoint=ck, resume=True, trace=t2)
+    kinds = collections.Counter(r2.kinds())
+    assert kinds["resume"] == 1
+    assert kinds["iter"] >= 1
+    assert m.fit_report()["resumes"] == 1
+    # resumed trajectory matches the uninterrupted fit bit-for-bit
+    m0 = streaming.glm_fit_streaming(src, family="binomial", cache="none")
+    assert np.array_equal(np.asarray(m.coefficients),
+                          np.asarray(m0.coefficients))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: JSONL acceptance, fit_report persistence, front-ends
+# ---------------------------------------------------------------------------
+
+def test_jsonl_trace_acceptance(rng, tmp_path):
+    """ISSUE acceptance: a streaming fit under an injected transient fault
+    yields a JSONL trace with iteration, retry and checkpoint events, and
+    fit_report() summarizes them."""
+    X, y = _binomial_data(rng)
+    ck = str(tmp_path / "fit.ckpt")
+    jl = str(tmp_path / "trace.jsonl")
+    src = faulty_source(_chunk_factory(X, y), FaultPlan(transient_at=(7,)))
+    m = streaming.glm_fit_streaming(src, family="binomial", cache="none",
+                                    retry=NOSLEEP, checkpoint=ck, trace=jl)
+    events = [json.loads(s) for s in open(jl, encoding="utf-8")]
+    kinds = collections.Counter(d["kind"] for d in events)
+    assert kinds["iter"] == m.iterations
+    assert kinds["retry"] == 1
+    assert kinds["checkpoint_write"] == m.iterations
+    assert kinds["fit_start"] == 1 and kinds["fit_end"] == 1
+    rep = m.fit_report()
+    assert rep["retries"] == 1
+    assert rep["checkpoint_writes"] == m.iterations
+    assert rep["wall_s"] > 0
+
+
+def test_fit_info_survives_save_load(rng, tmp_path):
+    X, y = _binomial_data(rng)
+    ring, tr = _ring_tracer()
+    m = glm_mod.fit(X, y, family="binomial", trace=tr)
+    path = str(tmp_path / "m.model")
+    sg.save_model(m, path)
+    m2 = sg.load_model(path)
+    assert m2.fit_info["schema"] == "sparkglm.fit_report.v1"
+    assert m2.fit_report()["iterations"] == m.iterations
+
+
+def test_formula_frontends_take_trace(tmp_path):
+    rng = np.random.default_rng(3)
+    n = 400
+    data = {"x": rng.normal(size=n), "z": rng.normal(size=n)}
+    eta = 0.4 * data["x"] - 0.3 * data["z"]
+    data["y"] = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(float)
+    ring, tr = _ring_tracer()
+    m = sg.glm("y ~ x + z", data, family="binomial", trace=tr)
+    assert m.fit_info is not None and "fit_start" in ring.kinds()
+    ring2, tr2 = _ring_tracer()
+    m2 = sg.lm("y ~ x + z", data, trace=tr2)
+    assert m2.fit_info is not None and "fit_start" in ring2.kinds()
+
+
+def test_metrics_only_fit_populates_registry(rng):
+    X, y = _binomial_data(rng)
+    reg = MetricsRegistry()
+    m = glm_mod.fit(X, y, family="binomial", metrics=reg)
+    snap = reg.snapshot()
+    assert snap["counters"]["events.iter"] == m.iterations
+    assert snap["gauges"]["irls.deviance"] == pytest.approx(
+        float(m.deviance))
+    assert m.fit_info is not None  # metrics= alone still buys the report
+
+
+def test_read_csv_emits_read_event(tmp_path):
+    path = tmp_path / "d.csv"
+    path.write_text("a,b\n1,2\n3,4\n5,6\n")
+    ring, tr = _ring_tracer()
+    cols = sg.read_csv(str(path), trace=tr)
+    assert set(cols) == {"a", "b"}
+    ev = ring.events[-1]
+    assert ev.kind == "read"
+    assert ev.fields["rows"] == 3 and ev.fields["format"] == "csv"
+    assert ev.fields["bytes"] > 0 and ev.fields["seconds"] >= 0
+    # ambient inheritance: a plain call inside ambient() lands in the tracer
+    with obs_trace.ambient(tr):
+        sg.read_csv(str(path))
+    assert ring.kinds().count("read") == 2
+
+
+def test_anova_step_out_sink(rng, capsys):
+    n = 300
+    data = {"x1": rng.normal(size=n), "x2": rng.normal(size=n)}
+    data["y"] = (1.0 + 2.0 * data["x1"] + 0.01 * rng.normal(size=n))
+    buf = io.StringIO()
+    m = sg.step(sg.lm("y ~ x1 + x2", data), data, trace=True, out=buf)
+    out = buf.getvalue()
+    assert "Start:  AIC=" in out
+    assert "<none>" in out
+    assert m is not None
+    assert capsys.readouterr().out == ""  # nothing leaked to stdout
