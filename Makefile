@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
-.PHONY: all native test bench robust obs clean
+.PHONY: all native test bench robust obs pipeline clean
 
 all: native
 
@@ -31,6 +31,12 @@ robust:
 # device-aware spans, traced-vs-untraced bit-identity — CPU-only, fast
 obs:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
+
+# pipelined streaming engine (sparkglm_tpu/data/pipeline.py): prefetch
+# producer, fixed-shape buckets, pipelined-vs-sequential bit-identity,
+# one-compile-per-flavor — CPU-only, fast
+pipeline:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline.py -q
 
 clean:
 	rm -f $(SO)

@@ -39,10 +39,30 @@ import time
 V5E_PEAK_BF16 = 197e12  # FLOP/s per v5e chip; f32 matmul runs below this
 
 
+_TPU_VERDICT: bool | None = None  # probe once per run, shared by all blocks
+
+
 def _tpu_reachable(probe_timeout_s: float = 90.0,
                    backoffs=(0, 30, 60, 120, 240)) -> bool:
     """The tunnel can be wedged for minutes (it was all of round 1) —
-    retry with backoff rather than giving up on the round's one capture."""
+    retry with backoff rather than giving up on the round's one capture.
+    The full retry ladder burns ~7.5 min (5 x 90 s timeouts + 450 s of
+    sleeps, BENCH_r04), so the verdict is cached for the whole run and
+    ``SPARKGLM_BENCH_NO_TUNNEL=1`` skips the probe entirely (fail-fast to
+    the CPU path for local/dev runs)."""
+    global _TPU_VERDICT
+    if _TPU_VERDICT is not None:
+        return _TPU_VERDICT
+    if os.environ.get("SPARKGLM_BENCH_NO_TUNNEL") == "1":
+        print("bench: SPARKGLM_BENCH_NO_TUNNEL=1 — skipping the tunnel "
+              "probe", file=sys.stderr)
+        _TPU_VERDICT = False
+        return False
+    _TPU_VERDICT = _probe_tunnel(probe_timeout_s, backoffs)
+    return _TPU_VERDICT
+
+
+def _probe_tunnel(probe_timeout_s: float, backoffs) -> bool:
     for wait in backoffs:
         if wait:
             print(f"bench: tunnel probe retry in {wait}s", file=sys.stderr)
@@ -448,6 +468,64 @@ def main() -> None:
             ok=bool(t_traced / t_plain - 1.0 < 0.02))
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["trace_overhead"] = dict(error=repr(e)[:300])
+
+    # ---- pipelined streaming engine (sparkglm_tpu/data/pipeline.py) --------
+    # lm fit over disk-backed binary chunks behind a simulated remote fetch
+    # (the per-chunk sleep stands in for an object-store GET / NFS read —
+    # blocking latency the producer thread genuinely overlaps with the
+    # Gramian compute).  prefetch=2 should land >= 20% under the sequential
+    # wall time, bit-identically.  Local page-cache sources won't show this
+    # on a CPU host: XLA's chunk pass and numpy staging contend for the
+    # same cores, so overlap only pays when the producer BLOCKS.
+    try:
+        import tempfile
+
+        import sparkglm_tpu as sg
+        from sparkglm_tpu.obs import FitTracer
+
+        np_rng = np.random.default_rng(31)
+        rows_c, ps, n_chunks, fetch_s = 100_000, 192, 12, 0.08
+        bts = np_rng.standard_normal(ps).astype(np.float32)
+        with tempfile.TemporaryDirectory() as td:
+            paths = []
+            for i in range(n_chunks):
+                Xc = np_rng.standard_normal((rows_c, ps)).astype(np.float32)
+                yc = Xc @ bts + np_rng.standard_normal(rows_c).astype(
+                    np.float32)
+                paths.append(os.path.join(td, f"chunk{i:02d}.npy"))
+                np.save(paths[-1], np.column_stack([yc, Xc]))
+
+            def source():  # runs on the producer thread when pipelined
+                for pth in paths:
+                    time.sleep(fetch_s)  # simulated remote chunk fetch
+                    blk = np.load(pth)
+                    yield (blk[:, 1:], blk[:, 0], None, None)
+
+            sg.lm_fit_streaming(source)  # warm compile
+
+            def timed(**kw):
+                t0 = time.perf_counter()
+                m = sg.lm_fit_streaming(source, **kw)
+                return time.perf_counter() - t0, m
+
+            t_seq, m_seq = timed()
+            t_pipe, m_pipe = timed(prefetch=2, trace=FitTracer([]))
+            rep = m_pipe.fit_report()
+            detail["streaming_pipeline"] = dict(
+                n=rows_c * n_chunks, p=ps,
+                simulated_fetch_latency_s=fetch_s,
+                chunks_per_pass=rep["chunks"] // rep["passes"],
+                sequential_s=round(t_seq, 4), prefetch2_s=round(t_pipe, 4),
+                speedup_frac=round(1.0 - t_pipe / t_seq, 4),
+                overlap_ratio=round(rep["overlap_ratio"], 4),
+                queue_wait_s=round(rep["queue_wait_s"], 4),
+                bit_identical=bool(
+                    np.array_equal(m_seq.coefficients, m_pipe.coefficients)
+                    and np.array_equal(m_seq.std_errors, m_pipe.std_errors)
+                    and m_seq.sse == m_pipe.sse),
+                ok=bool(t_pipe <= 0.8 * t_seq))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["streaming_pipeline"] = dict(error=repr(e)[:300])
 
     print(json.dumps({
         "metric": "logistic_"

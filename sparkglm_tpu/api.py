@@ -441,9 +441,14 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
                  verbose: bool = False,
                  beta0=None, on_iteration=None, native: bool | None = None,
                  backend: str = "auto", retry=None, checkpoint=None,
-                 resume=False, trace=None, metrics=None,
+                 resume=False, trace=None, metrics=None, prefetch: int = 0,
                  config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """Fit a GLM by formula straight from a CSV too big to load.
+
+    ``prefetch=N`` (N >= 2) pipelines every streaming pass: a background
+    thread parses the next byte ranges while the device computes the
+    current chunk (``data/pipeline.py``; host memory bound ≈
+    ``prefetch x chunk_bytes``).  Bit-identical to the sequential default.
 
     The end-to-end out-of-memory path: one global schema scan + one factor
     -level scan (``data/io.py``, C++ loader when built), then the file
@@ -493,7 +498,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
             has_intercept=f.intercept, mesh=mesh, cache=cache,
             verbose=verbose, beta0=beta0, on_iteration=on_iteration,
             retry=retry, checkpoint=checkpoint, resume=resume,
-            trace=trace, metrics=metrics, config=config)
+            trace=trace, metrics=metrics, prefetch=prefetch, config=config)
     finally:
         parse_cleanup()
     import dataclasses
@@ -507,7 +512,7 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
                 na_omit: bool = True, chunk_bytes: int = 256 << 20,
                 mesh=None, native: bool | None = None, parse_cache="auto",
                 backend: str = "auto", retry=None, checkpoint=None,
-                resume=False, trace=None, metrics=None,
+                resume=False, trace=None, metrics=None, prefetch: int = 0,
                 config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """OLS/WLS by formula straight from a CSV too big to load (two
     streaming passes: Gramian accumulation, then the exact host-f64
@@ -545,7 +550,7 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
             source, xnames=terms.xnames, yname=f.response,
             has_intercept=f.intercept, mesh=mesh, retry=retry,
             checkpoint=checkpoint, resume=resume, trace=trace,
-            metrics=metrics, config=config)
+            metrics=metrics, prefetch=prefetch, config=config)
     finally:
         parse_cleanup()
     import dataclasses

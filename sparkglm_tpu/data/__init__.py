@@ -1,3 +1,4 @@
 from .formula import Formula, parse_formula
 from .frame import as_columns, is_categorical, na_mask, omit_na
 from .model_matrix import Terms, build_terms, model_matrix, transform
+from .pipeline import PassStats, prefetch_iter

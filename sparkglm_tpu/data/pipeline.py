@@ -1,0 +1,155 @@
+"""Bounded producer pipeline: overlap chunk production with consumption.
+
+The streaming fits (models/streaming.py) are per-pass chains of
+  parse/decompress chunk -> device_put -> jitted pass -> host-f64 harvest
+and were strictly serial: the device idled during IO and the host idled
+during compute.  :func:`prefetch_iter` runs the production side (source
+iteration, parsing, validation, H2D staging — whatever the wrapped
+generator does) on ONE background thread, keeping at most ``prefetch``
+finished items queued ahead of the consumer, so streaming-pass wall time
+approaches max(io, compute) instead of io + compute (the
+parallel-and-stream overlap of PAPERS.md arXiv:2111.00032).
+
+Determinism contract (what makes ``prefetch=N`` bit-identical to the
+sequential path, PARITY.md):
+
+* one producer thread, in-order bounded queue: items are consumed in
+  exactly the order the source yields them, so the consumer's left-to-
+  right host-f64 accumulation order is unchanged;
+* errors are part of the stream: an exception raised while producing item
+  k (including ``BaseException`` like robust.faults.SimulatedPreemption)
+  is enqueued AT position k and re-raised on the consumer thread when the
+  stream reaches it — failure semantics match the sequential path;
+* tracer events emitted while producing item k (``retry``, ``read``, …)
+  are captured thread-locally (obs/trace.py::capture) and replayed on the
+  consumer just before item k is handed over, so event sequence numbers
+  are identical to a sequential run's.
+
+Memory bound: at most ``prefetch`` produced items plus the one being
+consumed are alive, so a pipelined pass holds ≈ ``(prefetch + 1) ×
+chunk_bytes`` of host/device chunk data beyond the sequential baseline.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterator
+
+from ..obs import trace as _obs_trace
+
+__all__ = ["PassStats", "prefetch_iter"]
+
+_ITEM, _ERR, _DONE = "item", "err", "done"
+
+
+class PassStats:
+    """Per-pass pipeline counters, read by the fit after the pass ends.
+
+    ``produce_s``    time the producer spent blocked producing items (the
+                     pass's true IO/staging cost, measured off-thread)
+    ``queue_wait_s`` time the consumer spent blocked waiting on the queue
+    ``waits``        number of queue gets that had to wait
+    ``depth_max`` / ``depth_sum`` / ``items``
+                     queue depth observed at each get (max / for mean)
+    """
+
+    __slots__ = ("produce_s", "queue_wait_s", "waits", "depth_max",
+                 "depth_sum", "items")
+
+    def __init__(self):
+        self.produce_s = 0.0
+        self.queue_wait_s = 0.0
+        self.waits = 0
+        self.depth_max = 0
+        self.depth_sum = 0
+        self.items = 0
+
+    def depth_mean(self) -> float:
+        return self.depth_sum / self.items if self.items else 0.0
+
+
+def prefetch_iter(make_iter: Callable[[], Iterator], prefetch: int,
+                  stats: PassStats | None = None) -> Iterator:
+    """Iterate ``make_iter()`` on a background thread, ``prefetch`` ahead.
+
+    Yields the underlying iterator's items in order.  An exception raised
+    by ``make_iter`` or any ``next()`` — ``BaseException`` included, so
+    simulated preemptions pass through — is re-raised here at the position
+    it occurred, after every earlier item has been yielded.  Tracer events
+    emitted on the producer thread are replayed in order on this thread
+    (see module docstring).  Abandoning the iterator early (consumer
+    exception, ``break``) stops and joins the producer.
+    """
+    if prefetch < 1:
+        raise ValueError(f"prefetch must be >= 1, got {prefetch}")
+    return _prefetch_gen(make_iter, int(prefetch), stats)
+
+
+def _prefetch_gen(make_iter, prefetch, stats):
+    q: queue.Queue = queue.Queue(maxsize=prefetch)
+    stop = threading.Event()
+
+    def _put(entry) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(entry, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce():
+        it = None
+        while True:
+            with _obs_trace.capture() as events:
+                t0 = time.perf_counter()
+                try:
+                    if it is None:
+                        it = make_iter()
+                    item = next(it)
+                except StopIteration:
+                    _put((_DONE, None, events))
+                    return
+                except BaseException as e:  # noqa: BLE001 — re-raised in order
+                    _put((_ERR, e, events))
+                    return
+                finally:
+                    if stats is not None:
+                        stats.produce_s += time.perf_counter() - t0
+            if not _put((_ITEM, item, events)):
+                return  # consumer abandoned the stream
+
+    t = threading.Thread(target=produce, name="sparkglm-prefetch",
+                         daemon=True)
+    t.start()
+    try:
+        while True:
+            t0 = time.perf_counter()
+            try:
+                tag, payload, events = q.get_nowait()
+            except queue.Empty:
+                tag, payload, events = q.get()
+                if stats is not None:
+                    stats.queue_wait_s += time.perf_counter() - t0
+                    stats.waits += 1
+            if stats is not None:
+                depth = q.qsize()
+                stats.depth_max = max(stats.depth_max, depth)
+                stats.depth_sum += depth
+                stats.items += 1
+            _obs_trace.replay(events)
+            if tag is _DONE:
+                return
+            if tag is _ERR:
+                raise payload
+            yield payload
+    finally:
+        stop.set()
+        while True:  # unblock a producer parked on a full queue
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=5.0)

@@ -5,9 +5,13 @@ multi-process) and the robustness layer:
 
   * :mod:`.trace` — :class:`FitTracer` emitting typed, deterministically
     ordered events (``iter``, ``pass_start``/``pass_end``, ``retry``,
-    ``checkpoint_write``, ``resume``, ``compile``, ``solve``, …) to JSONL
+    ``checkpoint_write``, ``resume``, ``compile``, ``solve``,
+    ``queue_wait``/``prefetch_depth`` from pipelined passes, …) to JSONL
     / stderr / ring-buffer sinks.  Every fit entry point takes ``trace=``;
-    ``verbose=True`` is the stderr-sink preset.
+    ``verbose=True`` is the stderr-sink preset.  :func:`trace.capture` /
+    :func:`trace.replay` let the prefetch pipeline's producer thread
+    divert its events and re-emit them in chunk order on the consumer,
+    keeping pipelined event sequences identical to sequential ones.
   * :mod:`.metrics` — process-local counters/gauges/histograms with
     ``snapshot()`` and JSON export; pass ``metrics=`` to any fit.
   * :mod:`.timing` — spans that ``block_until_ready`` only at span edges

@@ -18,6 +18,10 @@ structured event stream:
   ``checkpoint_write`` / ``resume`` robust/checkpoint.py durability
   ``compile`` / ``solve``       kernel compilation and linear solves
   ``span``                      a device-aware timing span (obs/timing.py)
+  ``queue_wait`` / ``prefetch_depth``  one each per PIPELINED streaming
+                                pass (data/pipeline.py): total consumer
+                                time blocked on the producer, and the
+                                max/mean prefetch-queue depth observed
 
 Events are ordered by a per-tracer monotone sequence number assigned under
 a lock, so two runs of the same deterministic fit produce the same
@@ -55,6 +59,7 @@ from typing import IO
 __all__ = [
     "TraceEvent", "Sink", "JsonlSink", "StderrSink", "RingBufferSink",
     "FitTracer", "as_tracer", "ambient", "current_tracer", "resolve",
+    "capture", "replay",
 ]
 
 
@@ -203,6 +208,10 @@ class FitTracer:
         self._chunks_skipped = 0
         self._checkpoint_writes = 0
         self._resumes = 0
+        self._queue_wait_s = 0.0
+        self._prefetch_depth_max = 0
+        self._overlap_saved_s = 0.0
+        self._overlap_denom_s = 0.0
 
     @staticmethod
     def _coerce_sink(s) -> Sink:
@@ -227,7 +236,14 @@ class FitTracer:
         return None
 
     # -- core -------------------------------------------------------------
-    def emit(self, kind: str, **fields) -> TraceEvent:
+    def emit(self, kind: str, **fields) -> TraceEvent | None:
+        buf = getattr(_CAPTURE, "buf", None)
+        if buf is not None:
+            # pipeline producer thread: defer — the consumer replays these
+            # in chunk order so seq assignment stays deterministic (it must
+            # match the sequential path's event order exactly)
+            buf.append((self, kind, fields))
+            return None
         with self._lock:
             ev = TraceEvent(self._seq, kind, time.perf_counter() - self._t0,
                             fields)
@@ -252,13 +268,29 @@ class FitTracer:
             self._chunks += int(f.get("chunks", 0))
             self._rows_streamed += int(f.get("rows", 0))
             self._bytes_to_device += int(f.get("bytes", 0))
-            self._io_s += float(f.get("io_s", 0.0))
-            self._compute_s += float(f.get("compute_s", 0.0))
+            io_s = float(f.get("io_s", 0.0))
+            compute_s = float(f.get("compute_s", 0.0))
+            self._io_s += io_s
+            self._compute_s += compute_s
+            if "wall_s" in f:
+                # pipelined pass: io and compute ran concurrently, so the
+                # seconds hidden by overlap are (io + compute) - wall
+                self._overlap_saved_s += max(0.0, io_s + compute_s
+                                             - float(f["wall_s"]))
+                self._overlap_denom_s += min(io_s, compute_s)
             self._passes.append(dict(f))
             if m is not None:
                 m.histogram("pass.io_s").observe(float(f.get("io_s", 0.0)))
                 m.histogram("pass.compute_s").observe(
                     float(f.get("compute_s", 0.0)))
+        elif ev.kind == "queue_wait":
+            self._queue_wait_s += float(f.get("seconds", 0.0))
+            if m is not None:
+                m.histogram("pipeline.queue_wait_s").observe(
+                    float(f.get("seconds", 0.0)))
+        elif ev.kind == "prefetch_depth":
+            self._prefetch_depth_max = max(self._prefetch_depth_max,
+                                           int(f.get("max", 0)))
         elif ev.kind == "read":
             self._reads += 1
             self._read_bytes += int(f.get("bytes", 0))
@@ -292,12 +324,16 @@ class FitTracer:
                          **fields)
 
     def pass_end(self, label: str, index: int, *, chunks: int, rows: int,
-                 bytes: int, io_s: float = 0.0,
-                 compute_s: float = 0.0) -> TraceEvent:
-        return self.emit("pass_end", label=label, index=int(index),
-                         chunks=int(chunks), rows=int(rows),
-                         bytes=int(bytes), io_s=float(io_s),
-                         compute_s=float(compute_s))
+                 bytes: int, io_s: float = 0.0, compute_s: float = 0.0,
+                 wall_s: float | None = None) -> TraceEvent | None:
+        f = dict(label=label, index=int(index), chunks=int(chunks),
+                 rows=int(rows), bytes=int(bytes), io_s=float(io_s),
+                 compute_s=float(compute_s))
+        if wall_s is not None:
+            # only PIPELINED passes carry wall_s: it marks io_s/compute_s
+            # as concurrent (sequential passes have wall == io + compute)
+            f["wall_s"] = float(wall_s)
+        return self.emit("pass_end", **f)
 
     # -- lifecycle / report -----------------------------------------------
     def report(self) -> dict:
@@ -328,6 +364,15 @@ class FitTracer:
                 "checkpoint_writes": self._checkpoint_writes,
                 "resumes": self._resumes,
                 "solves": self._counts.get("solve", 0),
+                "queue_wait_s": self._queue_wait_s,
+                "prefetch_depth_max": self._prefetch_depth_max,
+                # fraction of the overlappable time actually hidden by the
+                # pipeline: (io + compute - wall) / min(io, compute) over
+                # pipelined passes; 0.0 when nothing was pipelined
+                "overlap_ratio": (
+                    min(1.0, max(0.0, self._overlap_saved_s
+                                 / self._overlap_denom_s))
+                    if self._overlap_denom_s > 0 else 0.0),
             }
 
     def close(self) -> None:
@@ -418,3 +463,36 @@ def emit_ambient(kind: str, **fields) -> None:
     tr = current_tracer()
     if tr is not None:
         tr.emit(kind, **fields)
+
+
+# -- deferred emission for pipeline producer threads -------------------------
+# The prefetch producer (data/pipeline.py) runs retry/read/fault plumbing on
+# a background thread.  Emitting from there would interleave seq numbers
+# nondeterministically with consumer-side events, breaking the determinism
+# contract above.  `capture` diverts every emit made on the CURRENT thread
+# into a buffer (interception lives inside FitTracer.emit, so it catches
+# direct tracer calls — e.g. data/io.py's read events — not just
+# emit_ambient); `replay` re-emits a buffer in order on the consumer.
+
+_CAPTURE = threading.local()
+
+
+class capture:
+    """Divert this thread's tracer emissions into a list (returned by
+    ``__enter__``) instead of sequencing them immediately."""
+
+    def __enter__(self) -> list:
+        self._prev = getattr(_CAPTURE, "buf", None)
+        buf: list = []
+        _CAPTURE.buf = buf
+        return buf
+
+    def __exit__(self, *exc) -> None:
+        _CAPTURE.buf = self._prev
+
+
+def replay(buf) -> None:
+    """Emit captured ``(tracer, kind, fields)`` entries in order on the
+    calling thread (assigning their definitive seq numbers)."""
+    for tracer, kind, fields in buf:
+        tracer.emit(kind, **fields)

@@ -1,0 +1,392 @@
+"""Pipelined streaming engine (sparkglm_tpu/data/pipeline.py): prefetch
+producer, fixed-shape chunk buckets, deferred accumulation — and the
+contract that makes it shippable: ``prefetch>=2`` is BIT-identical to the
+sequential path (coefficients, std errors, deviance, trace-event order),
+faults included, and every pass flavor compiles exactly one executable
+despite ragged chunks."""
+
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.data import pipeline
+from sparkglm_tpu.models import streaming
+from sparkglm_tpu.obs import FitTracer, RingBufferSink
+from sparkglm_tpu.obs import trace as obs_trace
+from sparkglm_tpu.robust import (FaultPlan, RetryPolicy,
+                                 SimulatedPreemption, faulty_source)
+
+NOSLEEP = RetryPolicy(sleep=lambda s: None)
+
+# events whose fields are fully deterministic; the rest carry seconds, so
+# only (seq, kind) is compared (same contract as tests/test_obs.py)
+_STABLE_KINDS = {"fit_start", "fit_end", "iter", "retry", "pass_start",
+                 "budget_exhausted"}
+
+
+def _binomial_data(rng, n=4000, p=4):
+    X = rng.normal(size=(n, p))
+    X[:, 0] = 1.0
+    bt = rng.normal(size=p) / (2 * np.sqrt(p))
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ bt)))).astype(float)
+    return X, y
+
+
+def _ragged_factory(X, y, w=None, off=None, rows=997):
+    """Chunk factory with a ragged last chunk (n % rows != 0)."""
+    n = X.shape[0]
+
+    def source():
+        for lo in range(0, n, rows):
+            hi = min(lo + rows, n)
+            yield (X[lo:hi], y[lo:hi],
+                   None if w is None else w[lo:hi],
+                   None if off is None else off[lo:hi])
+    return source
+
+
+def _ring_tracer():
+    ring = RingBufferSink()
+    return ring, FitTracer(sinks=[ring])
+
+
+# ---------------------------------------------------------------------------
+# prefetch_iter primitives
+# ---------------------------------------------------------------------------
+
+def test_prefetch_iter_in_order_and_bounded():
+    produced = []
+
+    def make_iter():
+        for i in range(20):
+            produced.append(i)
+            yield i
+
+    stats = pipeline.PassStats()
+    got = []
+    for item in pipeline.prefetch_iter(make_iter, prefetch=3, stats=stats):
+        # bounded: at most prefetch finished items + 1 being produced may
+        # exist beyond what the consumer has taken
+        assert len(produced) - len(got) <= 3 + 2
+        got.append(item)
+        time.sleep(0.001)  # slow consumer: the producer must stall
+    assert got == list(range(20))
+    assert stats.items > 0
+    assert stats.depth_max <= 3
+
+
+def test_prefetch_iter_reraises_error_at_position():
+    def make_iter():
+        yield 0
+        yield 1
+        raise OSError("boom at 2")
+
+    it = pipeline.prefetch_iter(make_iter, prefetch=4)
+    assert next(it) == 0
+    assert next(it) == 1
+    with pytest.raises(OSError, match="boom at 2"):
+        next(it)
+
+
+def test_prefetch_iter_propagates_base_exception():
+    def make_iter():
+        yield 0
+        raise SimulatedPreemption("preempted")
+
+    it = pipeline.prefetch_iter(make_iter, prefetch=2)
+    assert next(it) == 0
+    with pytest.raises(SimulatedPreemption):
+        next(it)
+
+
+def test_prefetch_iter_early_close_stops_producer():
+    produced = []
+
+    def make_iter():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    it = pipeline.prefetch_iter(make_iter, prefetch=2)
+    assert next(it) == 0
+    it.close()  # abandon: the finally block must stop and join the producer
+    time.sleep(0.05)
+    n1 = len(produced)
+    time.sleep(0.05)
+    assert len(produced) == n1  # no further production after close
+    assert n1 < 1000
+
+
+def test_prefetch_iter_replays_producer_events_in_order():
+    """Tracer events emitted while producing item k land on the consumer
+    in item order with consecutive seq numbers — identical to a
+    sequential run of the same generator."""
+    def make_iter(tracer):
+        def gen():
+            for i in range(5):
+                tracer.emit("read", index=i)
+                yield i
+        return gen
+
+    ring_seq, tr_seq = _ring_tracer()
+    list(make_iter(tr_seq)())
+    ring_pipe, tr_pipe = _ring_tracer()
+    list(pipeline.prefetch_iter(make_iter(tr_pipe), prefetch=3))
+    assert [e.key() for e in ring_pipe.events] \
+        == [e.key() for e in ring_seq.events]
+
+
+def test_capture_diverts_only_current_thread():
+    ring, tr = _ring_tracer()
+    with obs_trace.capture() as buf:
+        tr.emit("read", index=0)
+    assert ring.events == []  # diverted, not sequenced
+    obs_trace.replay(buf)
+    assert ring.kinds() == ["read"]
+    assert ring.events[0].fields == {"index": 0}
+
+
+def test_prefetch_validation():
+    X, y = _binomial_data(np.random.default_rng(0))
+    with pytest.raises(ValueError, match="prefetch"):
+        sg.glm_fit_streaming(_ragged_factory(X, y), family="binomial",
+                             prefetch=-1)
+    with pytest.raises(ValueError, match="prefetch"):
+        pipeline.prefetch_iter(lambda: iter(()), prefetch=0)
+
+
+# ---------------------------------------------------------------------------
+# pipelined vs sequential bit-identity
+# ---------------------------------------------------------------------------
+
+def test_glm_pipelined_bit_identical(rng):
+    X, y = _binomial_data(rng, n=5000, p=5)
+    seq = sg.glm_fit_streaming(_ragged_factory(X, y), family="binomial",
+                               cache="none")
+    pipe = sg.glm_fit_streaming(_ragged_factory(X, y), family="binomial",
+                                cache="none", prefetch=3)
+    np.testing.assert_array_equal(seq.coefficients, pipe.coefficients)
+    np.testing.assert_array_equal(seq.std_errors, pipe.std_errors)
+    assert seq.deviance == pipe.deviance
+    assert seq.null_deviance == pipe.null_deviance
+
+
+def test_lm_pipelined_bit_identical_with_weights_offset(rng):
+    n, p = 5000, 5
+    X = rng.normal(size=(n, p))
+    X[:, 0] = 1.0
+    y = X @ rng.normal(size=p) + rng.normal(size=n)
+    w = rng.uniform(0.5, 2.0, size=n)
+    off = rng.normal(size=n) / 10
+    seq = sg.lm_fit_streaming(_ragged_factory(X, y, w, off))
+    pipe = sg.lm_fit_streaming(_ragged_factory(X, y, w, off), prefetch=2)
+    np.testing.assert_array_equal(seq.coefficients, pipe.coefficients)
+    np.testing.assert_array_equal(seq.std_errors, pipe.std_errors)
+    assert seq.sse == pipe.sse and seq.sst == pipe.sst
+    assert seq.resid_quantiles == pipe.resid_quantiles
+
+
+def test_glm_pipelined_matches_device_cache_modes(rng):
+    """prefetch composes with the device chunk cache: cached prefix on
+    later passes, pipelined overflow — still bit-identical."""
+    X, y = _binomial_data(rng, n=5000, p=5)
+    base = sg.glm_fit_streaming(_ragged_factory(X, y), family="binomial",
+                                cache="none")
+    cached = sg.glm_fit_streaming(_ragged_factory(X, y), family="binomial",
+                                  cache="device", prefetch=2)
+    np.testing.assert_array_equal(base.coefficients, cached.coefficients)
+    assert base.deviance == cached.deviance
+
+
+# ---------------------------------------------------------------------------
+# faults inside the producer: retries and preemption stay deterministic
+# ---------------------------------------------------------------------------
+
+def _faulted_fit(rng_seed, prefetch, trace=None):
+    rng = np.random.default_rng(rng_seed)
+    X, y = _binomial_data(rng, n=4000, p=4)
+    src = faulty_source(_ragged_factory(X, y, rows=800),
+                        FaultPlan(transient_at=(7,)))
+    return sg.glm_fit_streaming(src, family="binomial", cache="none",
+                                retry=NOSLEEP, prefetch=prefetch,
+                                trace=trace)
+
+
+def test_pipelined_fault_retry_bit_identical():
+    """Mid-pass transient faults retried INSIDE the producer thread give
+    the same model as the sequential retry path."""
+    r_seq, t_seq = _ring_tracer()
+    m_seq = _faulted_fit(7, prefetch=0, trace=t_seq)
+    r_pipe, t_pipe = _ring_tracer()
+    m_pipe = _faulted_fit(7, prefetch=3, trace=t_pipe)
+    np.testing.assert_array_equal(m_seq.coefficients, m_pipe.coefficients)
+    np.testing.assert_array_equal(m_seq.std_errors, m_pipe.std_errors)
+    assert m_seq.deviance == m_pipe.deviance
+    assert m_seq.fit_report()["retries"] == m_pipe.fit_report()["retries"] > 0
+    # the retry fired on the producer thread but was replayed in order:
+    # the STABLE event subsequence matches the sequential run's exactly
+    # (pipelined runs additionally carry queue_wait/prefetch_depth events)
+    stable = lambda ring: [  # noqa: E731
+        (e.kind, tuple(sorted(e.fields.items())))
+        for e in ring.events if e.kind in _STABLE_KINDS]
+    assert stable(r_pipe) == stable(r_seq)
+
+
+def test_pipelined_event_sequence_deterministic():
+    """Two identical pipelined faulted fits emit the same event sequence
+    — seq numbers included (producer events are replayed, not raced)."""
+    r1, t1 = _ring_tracer()
+    _faulted_fit(11, prefetch=2, trace=t1)
+    r2, t2 = _ring_tracer()
+    _faulted_fit(11, prefetch=2, trace=t2)
+    k1, k2 = r1.events, r2.events
+    assert [(e.seq, e.kind) for e in k1] == [(e.seq, e.kind) for e in k2]
+    assert [e.key() for e in k1 if e.kind in _STABLE_KINDS] \
+        == [e.key() for e in k2 if e.kind in _STABLE_KINDS]
+
+
+def test_pipelined_preempt_resume_bit_identical(rng, tmp_path):
+    """A pipelined fit preempted mid-stream (BaseException through the
+    producer) resumes from its checkpoint to the same model as an
+    uninterrupted sequential fit."""
+    X, y = _binomial_data(rng, n=4000, p=4)
+    baseline = sg.glm_fit_streaming(_ragged_factory(X, y, rows=800),
+                                    family="binomial", cache="none")
+    ck = str(tmp_path / "ck.npz")
+    plan = FaultPlan(preempt_at=(12,))
+    with pytest.raises(SimulatedPreemption):
+        sg.glm_fit_streaming(
+            faulty_source(_ragged_factory(X, y, rows=800), plan),
+            family="binomial", cache="none", checkpoint=ck, prefetch=2)
+    resumed = sg.glm_fit_streaming(_ragged_factory(X, y, rows=800),
+                                   family="binomial", cache="none",
+                                   checkpoint=ck, resume=True, prefetch=2)
+    np.testing.assert_array_equal(baseline.coefficients, resumed.coefficients)
+    np.testing.assert_array_equal(baseline.std_errors, resumed.std_errors)
+    assert baseline.deviance == resumed.deviance
+
+
+# ---------------------------------------------------------------------------
+# first-chunk fingerprint probe: no double read
+# ---------------------------------------------------------------------------
+
+def test_first_chunk_probe_does_not_double_read(rng):
+    X, y = _binomial_data(rng, n=100, p=3)
+    opens = [0]
+    mats = Counter()
+
+    def chunks():
+        opens[0] += 1
+
+        def gen():
+            for i in range(4):
+                def thunk(i=i):
+                    mats[i] += 1
+                    lo, hi = 25 * i, 25 * (i + 1)
+                    return (X[lo:hi], y[lo:hi], None, None)
+                yield thunk
+        return gen()
+
+    fp, p, wrapped = streaming._source_first_chunk(chunks)
+    assert p == 3
+    assert mats[0] == 1
+    got = [streaming._materialize(c) for c in wrapped()]
+    # the probe's open AND materialized chunk 0 are handed to the first
+    # pass: still one open, chunk 0 still parsed exactly once
+    assert opens[0] == 1
+    assert mats[0] == 1
+    assert len(got) == 4 and mats[3] == 1
+    # later passes re-open the source as usual
+    [streaming._materialize(c) for c in wrapped()]
+    assert opens[0] == 2
+    assert mats[0] == 2
+
+
+# ---------------------------------------------------------------------------
+# fixed-shape buckets: one compile per pass flavor despite ragged chunks
+# ---------------------------------------------------------------------------
+
+def test_bucket_pad_inert_rows():
+    X = np.arange(12.0).reshape(6, 2)
+    y = np.arange(6.0)
+    bucket = {}
+    X0, y0, w0, o0 = streaming._bucket_pad(X, y, None, None, bucket)
+    assert X0.shape == (6, 2) and bucket["rows"] == 6
+    assert np.all(w0 == 1.0)  # explicit weights keep the pass arity fixed
+    Xp, yp, wp, op = streaming._bucket_pad(X[:4], y[:4], None, None, bucket)
+    assert Xp.shape == (6, 2)  # ragged tail padded up to the bucket
+    assert np.all(wp[4:] == 0.0) and np.all(Xp[4:] == 0.0)
+    assert np.all(yp[4:] == 0.0) and op is None
+    # oversized chunk: next multiple of the bucket, not a fresh shape zoo
+    Xb = np.ones((8, 2))
+    Xq, _, wq, _ = streaming._bucket_pad(Xb, np.ones(8), None, None, bucket)
+    assert Xq.shape == (12, 2) and np.all(wq[8:] == 0.0)
+
+
+def test_glm_one_compile_event_per_pass_flavor(rng):
+    """Multi-pass streaming fit over ragged chunks: exactly ONE compile
+    per pass flavor (init / irls), because every chunk is padded to the
+    fit's shape bucket.  Dims are deliberately unusual so the jit cache is
+    cold for this shape within the test process."""
+    X, y = _binomial_data(rng, n=1234, p=11)
+    ring, tracer = _ring_tracer()
+    m = sg.glm_fit_streaming(_ragged_factory(X, y, rows=237),
+                             family="binomial", cache="none",
+                             prefetch=2, trace=tracer)
+    assert m.iterations >= 2  # multi-pass: irls flavor ran more than once
+    comp = Counter(e.fields["target"]
+                   for e in ring.events if e.kind == "compile")
+    assert comp == {"glm_pass:init": 1, "glm_pass:irls": 1}
+
+
+def test_lm_one_compile_event_despite_ragged_chunks(rng):
+    n, p = 1077, 9
+    X = rng.normal(size=(n, p))
+    X[:, 0] = 1.0
+    y = X @ rng.normal(size=p) + rng.normal(size=n)
+    ring, tracer = _ring_tracer()
+    sg.lm_fit_streaming(_ragged_factory(X, y, rows=250), trace=tracer)
+    comp = Counter(e.fields["target"]
+                   for e in ring.events if e.kind == "compile")
+    assert comp == {"lm_gramian": 1}
+
+
+# ---------------------------------------------------------------------------
+# telemetry: queue_wait / prefetch_depth / overlap_ratio
+# ---------------------------------------------------------------------------
+
+def test_pipelined_pass_telemetry(rng):
+    X, y = _binomial_data(rng, n=5000, p=5)
+    ring, tracer = _ring_tracer()
+    m = sg.glm_fit_streaming(_ragged_factory(X, y), family="binomial",
+                             cache="none", prefetch=3, trace=tracer)
+    kinds = Counter(ring.kinds())
+    # one queue_wait + one prefetch_depth per pipelined pass, emitted
+    # right before its pass_end
+    assert kinds["queue_wait"] == kinds["pass_end"]
+    assert kinds["prefetch_depth"] == kinds["pass_end"]
+    pos = {k: [i for i, e in enumerate(ring.events) if e.kind == k]
+           for k in ("queue_wait", "prefetch_depth", "pass_end")}
+    for qw, pd, pe in zip(*pos.values()):
+        assert qw == pe - 2 and pd == pe - 1
+    rep = m.fit_report()
+    assert rep["queue_wait_s"] >= 0.0
+    assert rep["prefetch_depth_max"] >= 1
+    assert 0.0 <= rep["overlap_ratio"] <= 1.0
+    # pipelined pass_end events carry wall_s (io/compute ran concurrently)
+    for e in ring.events:
+        if e.kind == "pass_end":
+            assert "wall_s" in e.fields
+
+
+def test_sequential_fit_has_no_pipeline_events(rng):
+    X, y = _binomial_data(rng, n=3000, p=4)
+    ring, tracer = _ring_tracer()
+    m = sg.glm_fit_streaming(_ragged_factory(X, y), family="binomial",
+                             cache="none", trace=tracer)
+    kinds = set(ring.kinds())
+    assert "queue_wait" not in kinds and "prefetch_depth" not in kinds
+    assert m.fit_report()["overlap_ratio"] == 0.0
