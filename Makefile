@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
-.PHONY: all native test bench robust obs pipeline clean
+.PHONY: all native test bench robust obs pipeline serve clean
 
 all: native
 
@@ -37,6 +37,12 @@ obs:
 # one-compile-per-flavor — CPU-only, fast
 pipeline:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline.py -q
+
+# online serving suite (sparkglm_tpu/serve): registry deploy/rollback,
+# served-vs-offline bit-identity across every padding bucket, zero
+# steady-state recompiles, micro-batch coalescing + typed backpressure
+serve:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q
 
 clean:
 	rm -f $(SO)

@@ -527,6 +527,61 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["streaming_pipeline"] = dict(error=repr(e)[:300])
 
+    # ---- online serving latency (sparkglm_tpu/serve) -----------------------
+    # warm the bucket ladder, then sustained mixed-size load through the
+    # micro-batcher.  The two SLO claims: ZERO recompiles after warmup
+    # (scorer.compiles stays 0 AND the kernel cache is flat), and tail
+    # latency bounded — p99 < 5x p50 under load (no compile stalls hiding
+    # in the tail).
+    try:
+        import sparkglm_tpu as sg
+        from sparkglm_tpu.models.scoring import score_kernel_cache_size
+        from sparkglm_tpu.obs import MetricsRegistry
+        from sparkglm_tpu.serve import BatchPolicy, MicroBatcher, Scorer
+
+        np_rng = np.random.default_rng(17)
+        ns, req_total = 50_000, 400
+        xs = np_rng.standard_normal(ns)
+        gs = np.array(["a", "b", "c"])[np_rng.integers(0, 3, ns)]
+        ys = np_rng.poisson(np.exp(0.3 + 0.4 * xs)).astype(float)
+        msrv = sg.glm("y ~ x + g", {"y": ys, "x": xs, "g": gs},
+                      family="poisson")
+        met = MetricsRegistry()
+        scorer = Scorer(msrv, min_bucket=8, metrics=met, name="bench")
+        warmed = scorer.warmup(buckets=(8, 16, 32, 64, 128, 256))
+        cache_before = score_kernel_cache_size()
+        sizes = (np_rng.integers(1, 97, req_total)).tolist()
+        t0 = time.perf_counter()
+        with MicroBatcher(scorer, BatchPolicy(max_batch=256,
+                                              max_delay_ms=2.0),
+                          metrics=met, name="bench") as mb:
+            futs = []
+            for sz in sizes:
+                idx = np_rng.integers(0, ns, sz)
+                futs.append(mb.submit({"x": xs[idx], "g": gs[idx]}))
+            for f in futs:
+                f.result(60)
+        wall = time.perf_counter() - t0
+        snap = met.snapshot()
+        lat = snap["histograms"]["serve.bench.latency_s"]
+        recompiles = scorer.compiles
+        cache_delta = score_kernel_cache_size() - cache_before
+        detail["serving_latency"] = dict(
+            requests=req_total, rows=int(sum(sizes)),
+            buckets_warmed=list(warmed),
+            batches=snap["counters"]["serve.bench.batches"],
+            wall_s=round(wall, 4),
+            requests_per_s=round(req_total / wall, 1),
+            rows_per_s=round(sum(sizes) / wall, 1),
+            p50_ms=round(lat["p50"] * 1e3, 3),
+            p99_ms=round(lat["p99"] * 1e3, 3),
+            steady_state_recompiles=int(recompiles),
+            kernel_cache_delta=int(cache_delta),
+            ok=bool(recompiles == 0 and cache_delta == 0
+                    and lat["p99"] < 5 * lat["p50"]))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["serving_latency"] = dict(error=repr(e)[:300])
+
     print(json.dumps({
         "metric": "logistic_"
                   + (f"{n // 1_000_000}M" if n >= 1_000_000 else f"{n // 1000}k")

@@ -45,8 +45,9 @@ from .models.streaming import glm_fit_streaming, lm_fit_streaming
 from .parallel import distributed
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
 from .obs import FitTracer, JsonlSink, MetricsRegistry, RingBufferSink
+from .serve import BatchPolicy, MicroBatcher, ModelRegistry, Scorer
 from .utils import profiling
-from . import obs, robust
+from . import obs, robust, serve
 
 __version__ = "0.1.0"
 
@@ -75,4 +76,5 @@ __all__ = [
     "NumericConfig", "DEFAULT",
     "robust",
     "obs", "FitTracer", "MetricsRegistry", "JsonlSink", "RingBufferSink",
+    "serve", "ModelRegistry", "Scorer", "MicroBatcher", "BatchPolicy",
 ]

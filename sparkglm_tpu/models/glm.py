@@ -652,9 +652,11 @@ class GLMModel:
         method, matching R's ``predict.glm(se.fit=TRUE)``).
 
         ``mesh``: score over a device mesh as one row-sharded SPMD pass
-        (models/scoring.py: X·β + inverse link + quadform on device — the
-        reference's executor-side path, LM.scala:52-61); None keeps the
-        host path."""
+        (the reference's executor-side path, LM.scala:52-61); None runs
+        the same kernel on the default device.  Both routes share ONE
+        numerics path (models/scoring.py) — also the one the online
+        serving engine (sparkglm_tpu/serve) compiles per padding bucket,
+        so served and offline predictions are bit-identical."""
         X = np.asarray(X)
         if X.ndim != 2 or X.shape[1] != self.n_params:
             raise ValueError(
@@ -662,28 +664,12 @@ class GLMModel:
         if type not in ("link", "response"):
             raise ValueError(f"type must be 'link' or 'response', got {type!r}")
         from ..families.links import get_link
+        from .scoring import predict_sharded
         lnk = get_link(self.link)
-        if mesh is not None:
-            from .scoring import predict_sharded
-            return predict_sharded(
-                X, self.coefficients, mesh=mesh, offset=offset,
-                vcov=self.vcov() if se_fit else None, link=lnk,
-                type=type, se_fit=se_fit)
-        from .lm import _row_quadform
-        # aliased (NaN) coefficients contribute nothing (R reduced basis)
-        eta = X @ np.nan_to_num(self.coefficients)
-        if offset is not None:
-            eta = eta + np.asarray(offset)
-        mu = (np.asarray(lnk.inverse(jnp.asarray(eta)))
-              if type == "response" else None)
-        fit = eta if type == "link" else mu
-        if not se_fit:
-            return fit
-        se = _row_quadform(X, self.vcov())
-        if type == "response":
-            # delta method: dmu/deta = 1 / g'(mu)
-            se = se / np.abs(np.asarray(lnk.deriv(jnp.asarray(mu))))
-        return fit, se
+        return predict_sharded(
+            X, self.coefficients, mesh=mesh, offset=offset,
+            vcov=self.vcov() if se_fit else None, link=lnk,
+            type=type, se_fit=se_fit)
 
     def summary(self):
         from .summary import GLMSummary

@@ -239,28 +239,13 @@ class LMModel:
             out = np.stack([fit, fit - half, fit + half], axis=1)
             # R's se.fit is always the MEAN's standard error
             return (out, se_mean) if se_fit else out
-        if mesh is not None:
-            from .scoring import predict_sharded
-            return predict_sharded(
-                X, self.coefficients, mesh=mesh, offset=offset,
-                vcov=self.vcov() if se_fit else None, se_fit=se_fit)
-        if se_fit:
-            return (self.predict(X, offset=offset),
-                    _row_quadform(X, self.vcov()))
-        from ..config import x64_enabled
-        if not np.issubdtype(X.dtype, np.floating) or x64_enabled():
-            # f64 whenever x64 allows it — the same precision contract as
-            # the GLM host path (numpy f64) and the sharded scorer
-            X = X.astype(np.float64, copy=False)
-        # jnp.asarray canonicalizes per the x64 setting without the
-        # explicit-dtype truncation warning; beta then matches X's device dtype
-        Xj = jnp.asarray(X)
-        # aliased (NaN) coefficients contribute nothing (R reduced basis)
-        beta = jnp.asarray(np.nan_to_num(self.coefficients), dtype=Xj.dtype)
-        fit = np.asarray(_predict_jit(Xj, beta))
-        if offset is not None:
-            fit = fit + np.asarray(offset, np.float64)
-        return fit
+        # one numerics path for mesh, host, and the serving engine's
+        # padded-bucket executables (models/scoring.py) — served and
+        # offline predictions are bit-identical by construction
+        from .scoring import predict_sharded
+        return predict_sharded(
+            X, self.coefficients, mesh=mesh, offset=offset,
+            vcov=self.vcov() if se_fit else None, se_fit=se_fit)
 
     def summary(self, residuals=None):
         """R-style summary; pass ``residuals=model.residuals(X, y)`` to
@@ -342,11 +327,6 @@ class LMModel:
         """Response residuals y - fitted (models do not retain training
         data; pass it back in, including any fit-time offset)."""
         return _squeeze_column(y) - self.predict(X, offset=offset)
-
-
-@jax.jit
-def _predict_jit(X, beta):
-    return X @ beta
 
 
 def _cov2cor(v: np.ndarray) -> np.ndarray:

@@ -1,4 +1,4 @@
-"""Distributed scoring — the other half of the production loop.
+"""Distributed + online scoring — the other half of the production loop.
 
 The reference scores on the cluster: ``predictMultiple`` runs per-partition
 X·β on executors (/root/reference/src/main/scala/com/Alteryx/sparkGLM/
@@ -14,6 +14,16 @@ share the input sharding).
 The se.fit quadform on device replaces the host-numpy einsum
 (``_row_quadform``) which walked the full design on one core — at 10M rows
 x 1000 features that is a 40 GB host pass; here it is two fused MXU ops.
+
+Since the serving PR this is also the SINGLE numerics path for scoring:
+``mesh=None`` runs the same kernel on the default device, and the host
+``LMModel.predict``/``GLMModel.predict`` paths route through it.  That is
+what makes the online serving engine (``sparkglm_tpu/serve``) numerics-
+neutral: a served request padded to a power-of-2 bucket (``pad_to=``) runs
+the SAME executable family as an offline ``sg.predict``, and zero-padded
+rows are inert in every per-row output (eta, mu, and the se quadform are
+all row-local — there is no cross-row reduction anywhere in the kernel),
+so served predictions are bit-identical to offline ones (PARITY.md).
 """
 
 from __future__ import annotations
@@ -26,12 +36,13 @@ import numpy as np
 
 from ..parallel import mesh as meshlib
 
+_SCORE_STATICS = ("inverse", "deriv", "want_se", "response", "has_offset",
+                  "quad_precision")
 
-@partial(jax.jit, static_argnames=("inverse", "deriv", "want_se", "response",
-                                   "has_offset", "quad_precision"))
-def _score_kernel(X, beta, offset, V, *, inverse=None, deriv=None,
-                  want_se: bool = False, response: bool = False,
-                  has_offset: bool = False, quad_precision=None):
+
+def _score_fn(X, beta, offset, V, *, inverse=None, deriv=None,
+              want_se: bool = False, response: bool = False,
+              has_offset: bool = False, quad_precision=None):
     """eta/mu (+ se) for one row-sharded design.  ``offset``/``V`` are (1,)
     / (1, 1) dummies when the static flags say they are unused — callers
     never ship full-size zero operands.  The eta matvec runs at HIGHEST
@@ -53,15 +64,42 @@ def _score_kernel(X, beta, offset, V, *, inverse=None, deriv=None,
     return fit, se
 
 
-def predict_sharded(X, coefficients, *, mesh, offset=None, vcov=None,
-                    link=None, type: str = "link", se_fit: bool = False):
-    """Score ``X`` over the mesh; returns host float64 ``fit`` or
-    ``(fit, se)``.
+_score_kernel = partial(jax.jit, static_argnames=_SCORE_STATICS)(_score_fn)
+# the serving engine's steady-state variant: the padded request buffer is
+# built fresh per call, so XLA may reuse it for the output (donation).
+# Aliasing changes nothing about the computed values — the two kernels
+# compile the same HLO — but CPU cannot alias, so callers gate on
+# donation_supported() to avoid a per-call "donated buffers were not
+# usable" warning.
+_score_kernel_donated = jax.jit(_score_fn, static_argnames=_SCORE_STATICS,
+                                donate_argnums=(0,))
+
+
+def donation_supported() -> bool:
+    """Input-output buffer aliasing works on accelerator backends; the CPU
+    runtime ignores it (with a per-call warning)."""
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def score_kernel_cache_size() -> int:
+    """Executable count across both kernel variants — the serving bench's
+    "zero steady-state recompiles" counter reads deltas of this."""
+    return int(_score_kernel._cache_size()
+               + _score_kernel_donated._cache_size())
+
+
+def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
+                    link=None, type: str = "link", se_fit: bool = False,
+                    pad_to: int | None = None, donate: bool = False):
+    """Score ``X`` on device; returns host float64 ``fit`` or ``(fit, se)``.
 
     Args:
       X: (n, p) host design aligned to the model's xnames.
       coefficients: (p,) — NaN (aliased) entries contribute nothing
         (R's reduced-basis prediction).
+      mesh: score over a device mesh as one row-sharded SPMD pass; None
+        runs the same kernel on the default device (the host predict
+        path and the serving engine both land here).
       offset: optional (n,) linear-predictor offset.
       vcov: (p, p) coefficient covariance for ``se_fit`` (dispersion
         already applied); NaN rows/columns (aliased) are zeroed, matching
@@ -69,6 +107,13 @@ def predict_sharded(X, coefficients, *, mesh, offset=None, vcov=None,
       link: a families.links.Link for response-scale GLM predictions;
         None means identity (LM, or type="link").
       type: "link" or "response".
+      pad_to: zero-pad the design (and offset) to this many rows before
+        the kernel call, slicing outputs back to ``n`` — the serving
+        engine's fixed-shape bucket contract (one executable per bucket,
+        zero steady-state recompiles).  Padded rows are inert: every
+        kernel output is row-local.
+      donate: donate the (padded) input buffer to the executable where
+        the backend supports aliasing — the serving steady state.
     """
     from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
 
@@ -78,19 +123,41 @@ def predict_sharded(X, coefficients, *, mesh, offset=None, vcov=None,
     # designs to f64 there, so compute at f64 whenever x64 allows it;
     # without x64 (the TPU path) f32 is both the only option and the point
     dtype = np.float64 if x64_enabled() else np.float32
-    Xd = meshlib.shard_rows(X.astype(dtype, copy=False), mesh)
-    od = (meshlib.replicate(np.zeros((1,), dtype), mesh) if offset is None
-          else meshlib.shard_rows(np.asarray(offset, dtype), mesh))
-    beta = meshlib.replicate(
-        np.nan_to_num(np.asarray(coefficients, dtype)), mesh)
-    V = meshlib.replicate(
-        np.nan_to_num(np.asarray(vcov, dtype)) if se_fit
-        else np.zeros((1, 1), dtype), mesh)
+    Xh = X.astype(dtype, copy=False)
+    oh = None if offset is None else np.asarray(offset, dtype).reshape(n)
+    if pad_to is not None and int(pad_to) > n:
+        t = int(pad_to)
+        Xp = np.zeros((t, p), dtype)
+        Xp[:n] = Xh
+        Xh = Xp
+        if oh is not None:
+            op = np.zeros((t,), dtype)
+            op[:n] = oh
+            oh = op
+    if mesh is not None:
+        Xd = meshlib.shard_rows(Xh, mesh)
+        od = (meshlib.replicate(np.zeros((1,), dtype), mesh) if oh is None
+              else meshlib.shard_rows(oh, mesh))
+        beta = meshlib.replicate(
+            np.nan_to_num(np.asarray(coefficients, dtype)), mesh)
+        V = meshlib.replicate(
+            np.nan_to_num(np.asarray(vcov, dtype)) if se_fit
+            else np.zeros((1, 1), dtype), mesh)
+    else:
+        Xd = jnp.asarray(Xh)
+        od = jnp.asarray(oh if oh is not None else np.zeros((1,), dtype))
+        beta = jnp.asarray(np.nan_to_num(np.asarray(coefficients, dtype)))
+        V = jnp.asarray(np.nan_to_num(np.asarray(vcov, dtype)) if se_fit
+                        else np.zeros((1, 1), dtype))
     on_tpu = jax.default_backend() == "tpu"
     quad_prec = ("highest" if dtype == np.float64
-                 else resolve_matmul_precision(DEFAULT, n, p, on_tpu))
+                 else resolve_matmul_precision(DEFAULT, int(Xh.shape[0]), p,
+                                               on_tpu))
     response = type == "response"
-    out = _score_kernel(
+    kernel = (_score_kernel_donated
+              if donate and mesh is None and donation_supported()
+              else _score_kernel)
+    out = kernel(
         Xd, beta, od, V,
         inverse=None if link is None else link.inverse,
         deriv=None if link is None else link.deriv,

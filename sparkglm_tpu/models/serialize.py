@@ -19,7 +19,12 @@ import numpy as np
 # has_m) so update()/drop1()/confint_profile can re-evaluate the original
 # call or refuse.  v1 models predate the flags — their absence is
 # indistinguishable from "fit unweighted", so loading one warns.
-_FORMAT_VERSION = 2
+# v3: an explicit ``schema_version`` travels in the header so a loader
+# older than the artifact fails LEGIBLY (naming the unknown keys) instead
+# of dropping fields it does not know and mis-scoring — the failure mode
+# that matters once a serving registry loads artifacts written by newer
+# trainers (serve/registry.py).
+_FORMAT_VERSION = 3
 
 
 def _split(model) -> tuple[dict, dict]:
@@ -41,6 +46,7 @@ def save_model(model, path: str) -> None:
     arrays, meta = _split(model)
     meta["__class__"] = type(model).__name__
     meta["__format__"] = _FORMAT_VERSION
+    meta["schema_version"] = _FORMAT_VERSION
     header = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
     np.savez(path, __meta__=header, **arrays)
 
@@ -52,9 +58,24 @@ def load_model(path: str):
     with np.load(path if str(path).endswith(".npz") else str(path) + ".npz") as z:
         meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
         arrays = {k: z[k] for k in z.files if k != "__meta__"}
-    cls_name = meta.pop("__class__")
+    cls_name = meta.pop("__class__", None)
     fmt = meta.pop("__format__", 1)
-    cls = {"LMModel": LMModel, "GLMModel": GLMModel}[cls_name]
+    schema = int(meta.pop("schema_version", fmt))
+    classes = {"LMModel": LMModel, "GLMModel": GLMModel}
+    if cls_name not in classes:
+        raise ValueError(
+            f"{path!r} is not a sparkglm model artifact (header class "
+            f"{cls_name!r}; expected one of {sorted(classes)})")
+    cls = classes[cls_name]
+    if schema > _FORMAT_VERSION:
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(meta) - field_names - {"terms"})
+        raise ValueError(
+            f"{path!r} was saved with schema_version {schema}, but this "
+            f"build reads schema_version <= {_FORMAT_VERSION}"
+            + (f"; unknown keys it carries: {unknown}" if unknown else "")
+            + " — upgrade sparkglm_tpu (a newer trainer wrote this "
+            "artifact; silently dropping its fields could mis-score)")
     if fmt < 2:
         import warnings
         warnings.warn(

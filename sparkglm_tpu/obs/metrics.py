@@ -75,6 +75,30 @@ class Histogram:
         k = max(-30, math.ceil(math.log2(v))) if v > 0 else -30
         self.buckets[k] = self.buckets.get(k, 0) + 1
 
+    def quantile(self, q: float) -> float | None:
+        """Estimate the ``q``-quantile from the log2 buckets (no stored
+        samples, so this is bucket-resolution: exact to within one
+        power-of-2 bucket).  Observations in bucket k lie in
+        (2^(k-1), 2^k]; the estimate interpolates geometrically by rank
+        fraction inside the covering bucket and clamps to the exact
+        observed [min, max] — so q=0/q=1 return min/max exactly, and a
+        one-bucket histogram stays inside its true range.  Serving SLOs
+        (p50/p99) read this; ``snapshot()`` exports both."""
+        if not self.count:
+            return None
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q must be in [0, 1], got {q}")
+        target = q * self.count
+        cum = 0
+        for k in sorted(self.buckets):
+            prev, cum = cum, cum + self.buckets[k]
+            if cum >= target:
+                frac = ((target - prev) / self.buckets[k]
+                        if self.buckets[k] else 0.0)
+                est = 2.0 ** (k - 1 + frac)
+                return float(min(max(est, self.min), self.max))
+        return float(self.max)  # pragma: no cover - cum == count >= target
+
     def snapshot(self):
         return {
             "count": self.count,
@@ -82,6 +106,8 @@ class Histogram:
             "min": self.min if self.count else None,
             "max": self.max if self.count else None,
             "mean": self.total / self.count if self.count else None,
+            "p50": self.quantile(0.5),
+            "p99": self.quantile(0.99),
             "bucket_le": {f"2^{k}": n
                           for k, n in sorted(self.buckets.items())},
         }

@@ -23,11 +23,13 @@ it is device-side state, not a host wrapper.
 
 from .checkpoint import CheckpointManager, as_checkpoint
 from .faults import FaultPlan, SimulatedPreemption, faulty_reader, faulty_source
-from .retry import (FatalSourceError, RetryBudgetExhausted, RetryPolicy,
-                    TransientSourceError, call_with_retry, retrying_source)
+from .retry import (FatalSourceError, Overloaded, RetryBudgetExhausted,
+                    RetryPolicy, TransientSourceError, call_with_retry,
+                    retrying_source)
 
 __all__ = [
-    "TransientSourceError", "FatalSourceError", "RetryBudgetExhausted",
+    "TransientSourceError", "FatalSourceError", "Overloaded",
+    "RetryBudgetExhausted",
     "RetryPolicy", "call_with_retry", "retrying_source",
     "CheckpointManager", "as_checkpoint",
     "FaultPlan", "SimulatedPreemption", "faulty_source", "faulty_reader",

@@ -46,6 +46,15 @@ class FatalSourceError(Exception):
     during a read)."""
 
 
+class Overloaded(TransientSourceError):
+    """The serving admission queue is full (sparkglm_tpu/serve/batching.py).
+
+    Transient BY TYPE: backpressure clears as the micro-batcher drains, so
+    a client-side :class:`RetryPolicy` retries it with backoff like any
+    flaky-source failure — one classification scheme for fit-time and
+    serve-time faults."""
+
+
 class RetryBudgetExhausted(RuntimeError):
     """The per-pass retry budget ran out; carries the last transient error
     as ``__cause__``."""

@@ -1,0 +1,30 @@
+"""Online serving: model registry, compiled-scorer cache, micro-batching.
+
+The training side of this repo answers "fit a GLM on more data than fits";
+this package answers the other half of the production loop: "score requests
+against the fitted model in milliseconds, forever".  Three pieces:
+
+  * :class:`~.registry.ModelRegistry` — versioned in-process model store
+    with ``register``/``load``/``deploy``/``rollback``; every version
+    carries its training ``Terms`` so raw feature dicts score through the
+    exact training transform.
+  * :class:`~.engine.Scorer` — the compiled-scorer cache: one donated-
+    buffer executable per (model signature, padding bucket); requests pad
+    to the nearest power-of-2 bucket (inert rows), so steady-state serving
+    NEVER recompiles.  ``warmup()`` pre-pays every compile.
+  * :class:`~.batching.MicroBatcher` — bounded admission queue coalescing
+    concurrent requests into micro-batches under a latency budget
+    (``BatchPolicy``), with typed :class:`~..robust.retry.Overloaded`
+    backpressure and per-model p50/p99 latency + throughput metrics.
+
+Serving is numerics-NEUTRAL: a served prediction is bit-identical to
+``sg.predict`` on the same rows (PARITY.md; test-enforced across every
+padding bucket), because serving runs the same jitted kernel as offline
+scoring and every kernel output is row-local.
+"""
+
+from .batching import BatchPolicy, MicroBatcher
+from .engine import Scorer
+from .registry import ModelRegistry
+
+__all__ = ["BatchPolicy", "MicroBatcher", "ModelRegistry", "Scorer"]

@@ -1,0 +1,202 @@
+"""Compiled-scorer cache: fixed-shape bucketed scoring for online serving.
+
+The latency killer for JAX serving is recompilation: every distinct request
+shape is a new executable, and XLA compiles in O(seconds) while a scoring
+request wants O(milliseconds).  The fix is the same one the streaming fits
+use for ragged tail chunks (``models/streaming.py::_bucket_pad``): quantize
+request sizes to power-of-2 buckets, zero-pad up to the bucket, and slice
+the outputs back.  Padded rows are INERT — every kernel output (eta, mu,
+the se quadform) is row-local, so padding cannot perturb real rows — which
+is what lets the same executable family serve every request size with
+bit-identical results to an offline ``sg.predict`` (test-enforced;
+PARITY.md).
+
+A :class:`Scorer` wraps one fitted model:
+
+  * requests arrive as raw column data (dicts of arrays — CSV-row shaped)
+    and go through the model's own training ``Terms`` transform, the exact
+    ``sg.predict`` path, including fit-time by-name offset recovery;
+  * the design is padded to the nearest bucket and scored through the
+    shared jit kernel (``models/scoring.py``), donating the padded buffer
+    where the backend supports aliasing;
+  * ``warmup(buckets=...)`` pre-compiles the executables so the first real
+    request never pays XLA latency; after warmup, steady state is
+    ZERO recompiles (``compiles`` counts them; bench.py proves the delta).
+
+Because the kernel takes beta/vcov as runtime ARGUMENTS (not baked
+constants), executables are shared across model versions with the same
+signature: a registry ``deploy``/``rollback`` (serve/registry.py) is
+recompile-free hot-swapping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..data.frame import as_columns
+from ..data.model_matrix import transform
+from ..models.scoring import (donation_supported, predict_sharded,
+                              score_kernel_cache_size)
+from ..obs.trace import emit_ambient
+
+__all__ = ["Scorer"]
+
+
+def _next_bucket(n: int, floor: int) -> int:
+    b = max(int(floor), 1)
+    while b < n:
+        b <<= 1
+    return b
+
+
+class Scorer:
+    """Pre-compiled bucketed scoring for ONE model (one (signature, bucket)
+    executable per padding bucket; see module docstring).
+
+    Args:
+      model: a fitted ``LMModel``/``GLMModel`` (must carry ``terms`` to
+        score raw column data; a bare (n, p) design is accepted too).
+      type: "response" (GLM default, ignored for LM) or "link".
+      se_fit: also return delta-method standard errors; requires the
+        model's ``vcov()`` (resolved once, eagerly, so a model that cannot
+        provide one fails at construction, not per-request).
+      min_bucket: smallest padding bucket; buckets are min_bucket * 2^k.
+      donate: donate the padded request buffer to the executable on
+        backends that alias (TPU/GPU); silently off elsewhere.
+      metrics: an ``obs.metrics.MetricsRegistry`` for per-model counters
+        (``serve.<name>.requests/rows/compiles``) and the per-call
+        ``serve.<name>.score_s`` latency histogram.
+      name: metric namespace; defaults to the model class name.
+    """
+
+    def __init__(self, model, *, type: str = "response",
+                 se_fit: bool = False, min_bucket: int = 8,
+                 donate: bool = True, metrics=None, name: str | None = None):
+        if type not in ("link", "response"):
+            raise ValueError(
+                f"type must be 'link' or 'response', got {type!r}")
+        if min_bucket < 1:
+            raise ValueError(f"min_bucket must be >= 1, got {min_bucket}")
+        self.model = model
+        self.is_glm = hasattr(model, "family")
+        if self.is_glm:
+            from ..families.links import get_link
+            self._link = get_link(model.link)
+        else:
+            self._link = None  # LM: identity; type is irrelevant
+        self.type = type
+        self.se_fit = bool(se_fit)
+        self._vcov = model.vcov() if se_fit else None
+        self.min_bucket = int(min_bucket)
+        self._donate = bool(donate) and donation_supported()
+        self.metrics = metrics
+        # NB: the ``type`` parameter shadows the builtin in this scope
+        self.name = name if name is not None else model.__class__.__name__
+        self.compiles = 0           # executables built on our behalf
+        self.buckets = set()        # buckets seen (warmup + live)
+        self._lock = threading.Lock()
+
+    # -- design construction (the sg.predict contract) ----------------------
+
+    def _design(self, data, offset):
+        if isinstance(data, np.ndarray) and data.ndim == 2:
+            X = data
+            if X.shape[1] != self.model.n_params:
+                raise ValueError(
+                    f"design has {X.shape[1]} columns; model expects "
+                    f"{self.model.n_params} (aligned to xnames)")
+            return X, offset
+        if self.model.terms is None:
+            raise ValueError(
+                "model was fit from arrays, not a formula; score with an "
+                "aligned (n, p) design matrix instead of column data")
+        cols = as_columns(data)
+        X = transform(cols, self.model.terms)
+        if offset is None:
+            from ..api import _fit_time_offset
+            offset = _fit_time_offset(self.model, cols)
+        return X, offset
+
+    # -- scoring ------------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """The padding bucket an ``n``-row request runs in (next power of 2
+        >= max(n, min_bucket))."""
+        if n < 1:
+            raise ValueError(f"request must have >= 1 row, got {n}")
+        return _next_bucket(n, self.min_bucket)
+
+    def score(self, data, *, offset=None):
+        """Score one request; returns host ``fit`` or ``(fit, se)`` —
+        bit-identical to ``sg.predict(model, data)`` with the same options.
+
+        ``data``: dict of feature columns (goes through the training
+        ``Terms``, recovering a fit-time by-name offset) or an aligned
+        (n, p) design.  An explicit ``offset=`` overrides the stored one.
+        """
+        t0 = time.perf_counter()
+        X, offset = self._design(data, offset)
+        n = X.shape[0]
+        bucket = self.bucket_for(n)
+        with self._lock:
+            before = score_kernel_cache_size()
+            out = predict_sharded(
+                X, self.model.coefficients, mesh=None, offset=offset,
+                vcov=self._vcov, link=self._link,
+                type=self.type if self.is_glm else "link",
+                se_fit=self.se_fit, pad_to=bucket, donate=self._donate)
+            compiled = score_kernel_cache_size() - before
+            dt = time.perf_counter() - t0
+            if compiled:
+                self.compiles += compiled
+                emit_ambient("compile", target=f"serve:{self.name}",
+                             bucket=bucket, seconds=dt)
+            self.buckets.add(bucket)
+        if self.metrics is not None:
+            self.metrics.counter(f"serve.{self.name}.requests").inc()
+            self.metrics.counter(f"serve.{self.name}.rows").inc(n)
+            if compiled:
+                self.metrics.counter(
+                    f"serve.{self.name}.compiles").inc(compiled)
+            self.metrics.histogram(f"serve.{self.name}.score_s").observe(dt)
+        return out
+
+    def warmup(self, buckets=None) -> tuple[int, ...]:
+        """Pre-compile the bucket executables so no real request pays XLA
+        compile latency.  ``buckets=None`` compiles the power-of-2 ladder
+        from ``min_bucket`` through 1024; pass the bucket sizes you expect
+        (``bucket_for(n)`` maps request sizes to buckets) to warm a custom
+        set.  Returns the buckets compiled, sorted.
+
+        The warmed executable matches the live one exactly: same static
+        flags (se_fit, response, offset-present) — a model fit with a
+        by-name offset warms its offset-carrying variant.
+        """
+        if buckets is None:
+            buckets, b = [], self.min_bucket
+            while b <= 1024:
+                buckets.append(b)
+                b <<= 1
+        p = self.model.n_params
+        has_off = (getattr(self.model, "offset_col", None) is not None
+                   or getattr(self.model, "has_offset", False))
+        done = []
+        for b in sorted(set(int(x) for x in buckets)):
+            X = np.zeros((1, p))
+            off = np.zeros(1) if has_off else None
+            with self._lock:
+                predict_sharded(
+                    X, self.model.coefficients, mesh=None, offset=off,
+                    vcov=self._vcov, link=self._link,
+                    type=self.type if self.is_glm else "link",
+                    se_fit=self.se_fit, pad_to=b, donate=self._donate)
+                self.buckets.add(b)
+            done.append(b)
+        # warmup compiles are expected and paid up-front, so the counter
+        # resets here: after warmup, ``compiles`` reads "steady-state
+        # recompiles since warmup" — the number the SLO bench asserts is 0
+        self.compiles = 0
+        return tuple(done)

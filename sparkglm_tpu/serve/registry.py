@@ -1,0 +1,168 @@
+"""Versioned in-process model registry with deploy/rollback.
+
+The reference's "model persistence" story is keeping the JVM object alive
+(SURVEY.md §5); its serving story is nonexistent.  Here serving is explicit:
+a :class:`ModelRegistry` holds every registered VERSION of each named model
+(versions are immutable once registered — auto-numbered 1, 2, 3, ...), one
+of which is *deployed* at a time.  ``deploy``/``rollback`` move the pointer;
+``scorer()`` hands out a compiled-cache :class:`~.engine.Scorer` for the
+deployed version.
+
+Deployment history is a stack: ``rollback()`` restores the previously
+deployed version (and can be repeated).  Registering a new version does NOT
+auto-deploy it unless asked (``deploy=True``) or it is the first version of
+the name — staging-by-default, so a bad artifact cannot take traffic by
+merely being loaded.
+
+Because the scoring kernel takes coefficients as runtime arguments (one
+executable per (signature, bucket), NOT per model — models/scoring.py),
+deploying a new version with the same design signature reuses the already-
+warm executables: deploy/rollback is recompile-free hot-swapping.
+
+Models loaded from disk come through ``models/serialize.py``, which
+verifies ``schema_version`` and fails legibly (naming the unknown keys) on
+artifacts written by a newer trainer — the registry never scores an
+artifact whose fields it might silently drop.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .engine import Scorer
+
+__all__ = ["ModelRegistry"]
+
+
+class _Entry:
+    __slots__ = ("versions", "deployed", "history")
+
+    def __init__(self):
+        self.versions: dict[int, object] = {}
+        self.deployed: int | None = None
+        self.history: list[int] = []  # deploy stack; [-1] == deployed
+
+
+class ModelRegistry:
+    """Thread-safe named/versioned model store; see module docstring."""
+
+    def __init__(self, *, metrics=None):
+        self._lock = threading.RLock()
+        self._entries: dict[str, _Entry] = {}
+        self._scorers: dict[tuple, Scorer] = {}
+        self.metrics = metrics
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, name: str, model, *, deploy: bool | None = None) -> int:
+        """Add ``model`` as the next version of ``name``; returns the
+        version number.  The model carries its own training ``Terms`` (and
+        by-name offset), so raw column data scores through the exact
+        training transform.  First version of a name auto-deploys;
+        later ones stage unless ``deploy=True``.
+        """
+        with self._lock:
+            e = self._entries.setdefault(name, _Entry())
+            version = max(e.versions, default=0) + 1
+            e.versions[version] = model
+            if deploy or (deploy is None and e.deployed is None):
+                self._deploy_locked(name, e, version)
+            if self.metrics is not None:
+                self.metrics.counter(f"registry.{name}.registered").inc()
+            return version
+
+    def load(self, name: str, path: str, *, deploy: bool | None = None) -> int:
+        """Register a model artifact from disk (``models/serialize.py``
+        format; schema_version-checked)."""
+        from ..models.serialize import load_model
+        return self.register(name, load_model(path), deploy=deploy)
+
+    # -- deployment ----------------------------------------------------------
+
+    def _deploy_locked(self, name: str, e: _Entry, version: int) -> None:
+        e.deployed = version
+        e.history.append(version)
+        # a scorer is version-pinned; drop cached ones for this name so the
+        # next scorer() resolves the new deployment (executables persist in
+        # the jit cache — same signature means no recompile)
+        for k in [k for k in self._scorers if k[0] == name]:
+            del self._scorers[k]
+        if self.metrics is not None:
+            self.metrics.gauge(f"registry.{name}.deployed").set(version)
+
+    def deploy(self, name: str, version: int) -> None:
+        """Point ``name`` at ``version`` (must be registered)."""
+        with self._lock:
+            e = self._require(name)
+            if version not in e.versions:
+                raise KeyError(
+                    f"model {name!r} has no version {version}; registered: "
+                    f"{sorted(e.versions)}")
+            self._deploy_locked(name, e, version)
+
+    def rollback(self, name: str) -> int:
+        """Re-deploy the previously deployed version; returns it.  Raises
+        if there is no earlier deployment to roll back to."""
+        with self._lock:
+            e = self._require(name)
+            if len(e.history) < 2:
+                raise RuntimeError(
+                    f"model {name!r} has no prior deployment to roll back "
+                    f"to (history: {e.history})")
+            e.history.pop()            # discard the current deployment
+            version = e.history.pop()  # _deploy_locked re-appends it
+            self._deploy_locked(name, e, version)
+            return version
+
+    # -- lookup --------------------------------------------------------------
+
+    def _require(self, name: str) -> _Entry:
+        e = self._entries.get(name)
+        if e is None:
+            raise KeyError(
+                f"no model registered under {name!r}; have "
+                f"{sorted(self._entries)}")
+        return e
+
+    def names(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._entries))
+
+    def versions(self, name: str) -> tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(self._require(name).versions))
+
+    def deployed_version(self, name: str) -> int | None:
+        with self._lock:
+            return self._require(name).deployed
+
+    def model(self, name: str, version: int | None = None):
+        """The deployed model (or a specific registered version)."""
+        with self._lock:
+            e = self._require(name)
+            v = e.deployed if version is None else version
+            if v is None:
+                raise RuntimeError(f"model {name!r} has no deployed version")
+            if v not in e.versions:
+                raise KeyError(
+                    f"model {name!r} has no version {v}; registered: "
+                    f"{sorted(e.versions)}")
+            return e.versions[v]
+
+    def scorer(self, name: str, **kwargs) -> Scorer:
+        """A :class:`Scorer` for the deployed version of ``name``, cached
+        per (name, version, scoring options) so repeated calls share
+        compile/bucket state.  ``kwargs`` go to :class:`Scorer` (``type=``,
+        ``se_fit=``, ``min_bucket=``, ...)."""
+        with self._lock:
+            e = self._require(name)
+            if e.deployed is None:
+                raise RuntimeError(f"model {name!r} has no deployed version")
+            metrics = kwargs.pop("metrics", self.metrics)
+            key = (name, e.deployed, tuple(sorted(kwargs.items())))
+            sc = self._scorers.get(key)
+            if sc is None:
+                sc = Scorer(e.versions[e.deployed], name=name,
+                            metrics=metrics, **kwargs)
+                self._scorers[key] = sc
+            return sc

@@ -158,3 +158,67 @@ def test_predict_from_csv_out_path(score_csv, tmp_path):
     fit_w, se_w = sg.predict(m, data, se_fit=True)
     np.testing.assert_array_equal(np.asarray(got["fit"]), fit_w)
     np.testing.assert_array_equal(np.asarray(got["se_fit"]), se_w)
+
+
+# ---------------------------------------------------------------------------
+# direct predict_sharded: offset= and vcov= together on a multi-device mesh
+# ---------------------------------------------------------------------------
+
+def test_predict_sharded_offset_and_vcov_together(mesh8, mesh1, rng):
+    """The serving-era kernel signature exercised directly: an offset AND a
+    coefficient covariance in the same call (se_fit through the quadform
+    with the offset shifting eta), sharded over 8 devices, must match the
+    single-device run bit-for-bit and the host composition to 1e-12."""
+    from sparkglm_tpu.families.links import get_link
+    from sparkglm_tpu.models.scoring import predict_sharded
+
+    X = np.column_stack([np.ones(1003), rng.standard_normal((1003, 4))])
+    beta = rng.standard_normal(5) / 3
+    off = rng.uniform(0.0, 0.5, 1003)
+    A = rng.standard_normal((5, 5))
+    V = A @ A.T / 50.0
+    lnk = get_link("log")
+
+    for type_ in ("link", "response"):
+        fit8, se8 = predict_sharded(X, beta, mesh=mesh8, offset=off, vcov=V,
+                                    link=lnk, type=type_, se_fit=True)
+        fit1, se1 = predict_sharded(X, beta, mesh=mesh1, offset=off, vcov=V,
+                                    link=lnk, type=type_, se_fit=True)
+        fit0, se0 = predict_sharded(X, beta, mesh=None, offset=off, vcov=V,
+                                    link=lnk, type=type_, se_fit=True)
+        np.testing.assert_array_equal(fit8, fit1)
+        np.testing.assert_array_equal(se8, se1)
+        np.testing.assert_array_equal(fit8, fit0)
+        np.testing.assert_array_equal(se8, se0)
+        # host composition: eta = X beta + off; se via quadform
+        eta = X @ beta + off
+        se_link = np.sqrt(np.maximum(np.einsum("ij,jk,ik->i", X, V, X), 0))
+        if type_ == "link":
+            np.testing.assert_allclose(fit8, eta, rtol=1e-12, atol=1e-12)
+            np.testing.assert_allclose(se8, se_link, rtol=1e-9, atol=1e-12)
+        else:
+            mu = np.exp(eta)
+            np.testing.assert_allclose(fit8, mu, rtol=1e-12, atol=1e-12)
+            np.testing.assert_allclose(se8, se_link * np.abs(mu),
+                                       rtol=1e-9, atol=1e-12)
+
+
+def test_predict_sharded_pad_to_is_inert(mesh8, rng):
+    """Zero-padding rows to a bucket (the serving contract) cannot change
+    any real row, padded or sharded: outputs are row-local."""
+    from sparkglm_tpu.models.scoring import predict_sharded
+
+    X = np.column_stack([np.ones(37), rng.standard_normal((37, 3))])
+    beta = rng.standard_normal(4)
+    off = rng.uniform(0.0, 0.5, 37)
+    V = np.eye(4) * 0.01
+    plain = predict_sharded(X, beta, offset=off, vcov=V, se_fit=True)
+    for pad in (37, 64, 128):
+        padded = predict_sharded(X, beta, offset=off, vcov=V, se_fit=True,
+                                 pad_to=pad)
+        np.testing.assert_array_equal(padded[0], plain[0])
+        np.testing.assert_array_equal(padded[1], plain[1])
+    meshed = predict_sharded(X, beta, mesh=mesh8, offset=off, vcov=V,
+                             se_fit=True, pad_to=64)
+    np.testing.assert_array_equal(meshed[0], plain[0])
+    np.testing.assert_array_equal(meshed[1], plain[1])

@@ -1,0 +1,371 @@
+"""Online serving (sparkglm_tpu/serve): registry, compiled-scorer cache,
+micro-batching — plus the satellite contracts (serialize schema_version,
+histogram quantiles, predict-from-path trace events).
+
+The load-bearing assertion throughout: serving is numerics-NEUTRAL.  A
+served request, padded to any power-of-2 bucket and possibly coalesced
+into a micro-batch, must be BIT-identical to an offline ``sg.predict`` on
+the same rows (PARITY.md).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.obs.metrics import Histogram, MetricsRegistry
+from sparkglm_tpu.robust import Overloaded, RetryPolicy, TransientSourceError
+from sparkglm_tpu.serve import BatchPolicy, MicroBatcher, ModelRegistry, Scorer
+
+
+@pytest.fixture
+def poisson_offset_model(rng):
+    """A GLM with a fit-time by-name offset — the offset must travel
+    through the serving path exactly as through sg.predict."""
+    n = 600
+    x = rng.standard_normal(n)
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    lt = rng.uniform(0.1, 0.9, n)
+    y = rng.poisson(np.exp(0.4 + 0.5 * x + 0.6 * (g == "b") + lt)).astype(float)
+    d = {"y": y, "x": x, "g": g, "lt": lt}
+    return sg.glm("y ~ x + g + offset(lt)", d, family="poisson"), d
+
+
+@pytest.fixture
+def binomial_grouped_model(rng):
+    """Grouped binomial (cbind successes/failures) — response scoring goes
+    through the logit inverse link."""
+    n = 500
+    x = rng.standard_normal(n)
+    m_tot = rng.integers(5, 30, n).astype(float)
+    p = 1.0 / (1.0 + np.exp(-(0.3 + 0.8 * x)))
+    s = rng.binomial(m_tot.astype(int), p).astype(float)
+    d = {"s": s, "f": m_tot - s, "x": x}
+    return sg.glm("cbind(s, f) ~ x", d, family="binomial"), d
+
+
+def _newdata(rng, d, size):
+    idx = rng.integers(0, len(next(iter(d.values()))), size)
+    return {k: np.asarray(v)[idx] for k, v in d.items()}
+
+
+# ---------------------------------------------------------------------------
+# registry: register / load / deploy / rollback
+# ---------------------------------------------------------------------------
+
+def test_registry_register_deploy_rollback(poisson_offset_model, rng):
+    m, d = poisson_offset_model
+    m2 = sg.glm("y ~ x + offset(lt)", d, family="poisson")
+    reg = ModelRegistry()
+
+    assert reg.register("traffic", m) == 1
+    assert reg.deployed_version("traffic") == 1          # first auto-deploys
+    assert reg.register("traffic", m2) == 2
+    assert reg.deployed_version("traffic") == 1          # staged, not live
+    assert reg.versions("traffic") == (1, 2)
+    assert reg.model("traffic") is m
+    assert reg.model("traffic", 2) is m2
+
+    reg.deploy("traffic", 2)
+    assert reg.deployed_version("traffic") == 2
+    assert reg.rollback("traffic") == 1
+    assert reg.model("traffic") is m
+    # rollback is a stack: a fresh single-deployment name cannot roll back
+    reg2 = ModelRegistry()
+    reg2.register("solo", m)
+    with pytest.raises(RuntimeError, match="no prior deployment"):
+        reg2.rollback("solo")
+    with pytest.raises(KeyError, match="no model registered"):
+        reg.scorer("nope")
+    with pytest.raises(KeyError, match="no version 9"):
+        reg.deploy("traffic", 9)
+
+
+def test_registry_load_from_disk_and_serve(poisson_offset_model, tmp_path, rng):
+    """Artifacts load through serialize.py (terms travel) and serve
+    bit-identically to the in-memory model."""
+    m, d = poisson_offset_model
+    p = str(tmp_path / "m.npz")
+    m.save(p)
+    reg = ModelRegistry()
+    assert reg.load("traffic", p) == 1
+    sc = reg.scorer("traffic")
+    new = _newdata(rng, d, 23)
+    np.testing.assert_array_equal(sc.score(new), sg.predict(m, new))
+
+
+def test_registry_scorer_cached_per_deployment(poisson_offset_model):
+    m, d = poisson_offset_model
+    reg = ModelRegistry()
+    reg.register("traffic", m)
+    assert reg.scorer("traffic") is reg.scorer("traffic")
+    reg.register("traffic", m, deploy=True)     # redeploy invalidates cache
+    sc2 = reg.scorer("traffic")
+    assert sc2 is reg.scorer("traffic")
+
+
+# ---------------------------------------------------------------------------
+# scorer: bit-identity across EVERY padding bucket + zero recompiles
+# ---------------------------------------------------------------------------
+
+def test_served_bit_identical_every_bucket_offset_model(
+        poisson_offset_model, rng):
+    """One request size per padding bucket (plus edges): served ==
+    sg.predict exactly, for a model whose offset travels by name."""
+    sc = Scorer(poisson_offset_model[0], min_bucket=8)
+    buckets = sc.warmup(buckets=(8, 16, 32, 64, 128))
+    assert buckets == (8, 16, 32, 64, 128)
+    m, d = poisson_offset_model
+    for size in (1, 7, 8, 9, 16, 31, 32, 57, 64, 100, 128):
+        new = _newdata(rng, d, size)
+        np.testing.assert_array_equal(sc.score(new), sg.predict(m, new))
+        assert sc.bucket_for(size) in sc.buckets
+    assert sc.compiles == 0, "steady-state serving must never recompile"
+
+
+def test_served_bit_identical_grouped_binomial_se_fit(
+        binomial_grouped_model, rng):
+    m, d = binomial_grouped_model
+    sc = Scorer(m, se_fit=True, min_bucket=8)
+    sc.warmup(buckets=(8, 16, 32, 64))
+    for size in (3, 8, 20, 33, 64):
+        new = _newdata(rng, d, size)
+        fit_s, se_s = sc.score(new)
+        fit_o, se_o = sg.predict(m, new, se_fit=True)
+        np.testing.assert_array_equal(fit_s, fit_o)
+        np.testing.assert_array_equal(se_s, se_o)
+    assert sc.compiles == 0
+
+
+def test_scorer_link_scale_and_explicit_offset(poisson_offset_model, rng):
+    m, d = poisson_offset_model
+    sc = Scorer(m, type="link")
+    new = _newdata(rng, d, 11)
+    np.testing.assert_array_equal(sc.score(new),
+                                  sg.predict(m, new, type="link"))
+    ov = rng.uniform(0, 1, 11)
+    np.testing.assert_array_equal(
+        sc.score(new, offset=ov),
+        sg.predict(m, new, type="link", offset=ov))
+
+
+def test_scorer_design_matrix_requests(rng):
+    """Array-fit models (no terms) serve aligned designs; dict data is
+    refused with the sg.predict message."""
+    X = np.column_stack([np.ones(300), rng.standard_normal((300, 3))])
+    y = X @ rng.standard_normal(4) + 0.1 * rng.standard_normal(300)
+    m = sg.lm_fit(X, y)
+    sc = Scorer(m)
+    Xn = np.column_stack([np.ones(17), rng.standard_normal((17, 3))])
+    np.testing.assert_array_equal(sc.score(Xn), m.predict(Xn))
+    with pytest.raises(ValueError, match="fit from arrays"):
+        sc.score({"x": np.zeros(3)})
+    with pytest.raises(ValueError, match="model expects"):
+        sc.score(np.zeros((5, 9)))
+    with pytest.raises(ValueError, match=">= 1 row"):
+        sc.score(np.zeros((0, 4)))
+
+
+def test_scorer_validation():
+    d = {"y": np.arange(20.0), "x": np.arange(20.0)}
+    m = sg.lm("y ~ x", d)
+    with pytest.raises(ValueError, match="type must be"):
+        Scorer(m, type="bogus")
+    with pytest.raises(ValueError, match="min_bucket"):
+        Scorer(m, min_bucket=0)
+    sc = Scorer(m, min_bucket=4)
+    assert [sc.bucket_for(k) for k in (1, 4, 5, 8, 9)] == [4, 4, 8, 8, 16]
+
+
+# ---------------------------------------------------------------------------
+# micro-batching: coalescing bit-neutrality, ordering, backpressure
+# ---------------------------------------------------------------------------
+
+def test_microbatcher_coalesced_results_bit_identical(
+        poisson_offset_model, rng):
+    """A burst of same-signature requests coalesces (fewer kernel calls
+    than requests) and every sliced result equals offline sg.predict."""
+    m, d = poisson_offset_model
+    met = MetricsRegistry()
+    sc = Scorer(m, min_bucket=8, metrics=met, name="traffic")
+    sc.warmup(buckets=(8, 16, 32, 64, 128, 256))
+    with MicroBatcher(sc, BatchPolicy(max_batch=128, max_delay_ms=20),
+                      metrics=met) as mb:
+        wants, futs = [], []
+        for i in range(30):
+            new = _newdata(rng, d, (i % 9) + 1)
+            wants.append(sg.predict(m, new))
+            futs.append(mb.submit(new))
+        for want, fut in zip(wants, futs):
+            np.testing.assert_array_equal(fut.result(10), want)
+    snap = met.snapshot()
+    assert snap["counters"]["serve.traffic.batches"] < 30, \
+        "burst should coalesce into fewer kernel calls than requests"
+    assert snap["counters"]["serve.traffic.batched_rows"] == \
+        sum((i % 9) + 1 for i in range(30))
+    lat = snap["histograms"]["serve.traffic.latency_s"]
+    assert lat["count"] == 30 and lat["p50"] is not None \
+        and lat["p99"] is not None
+    assert snap["gauges"]["serve.traffic.rows_per_s"] is None or \
+        snap["gauges"]["serve.traffic.rows_per_s"] > 0
+
+
+def test_microbatcher_error_isolated_in_order(poisson_offset_model, rng):
+    """A bad request (unknown level reaches the strict transform? use a
+    missing column) fails ITS future; requests before and after still
+    serve.  Different signature -> it cannot poison a shared batch."""
+    m, d = poisson_offset_model
+    sc = Scorer(m)
+    with MicroBatcher(sc, BatchPolicy(max_delay_ms=5)) as mb:
+        good1 = _newdata(rng, d, 5)
+        bad = {"x": np.zeros(4)}                      # missing g / lt
+        good2 = _newdata(rng, d, 6)
+        f1, fb, f2 = mb.submit(good1), mb.submit(bad), mb.submit(good2)
+        np.testing.assert_array_equal(f1.result(10), sg.predict(m, good1))
+        with pytest.raises(Exception):
+            fb.result(10)
+        np.testing.assert_array_equal(f2.result(10), sg.predict(m, good2))
+
+
+class _BlockingScorer:
+    """Scorer stand-in whose score() parks until released — makes the
+    queue-full path deterministic."""
+
+    metrics = None
+    name = "blocked"
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def score(self, data, *, offset=None):
+        self.entered.set()
+        assert self.release.wait(10)
+        n = (data.shape[0] if isinstance(data, np.ndarray)
+             else len(next(iter(data.values()))))
+        return np.zeros(n)
+
+
+def test_microbatcher_overload_is_typed_and_transient():
+    bs = _BlockingScorer()
+    met = MetricsRegistry()
+    mb = MicroBatcher(bs, BatchPolicy(max_queue=2, max_delay_ms=0),
+                      metrics=met, name="blocked")
+    try:
+        first = mb.submit(np.zeros((1, 2)))     # thread takes it, parks
+        assert bs.entered.wait(10)
+        held = [mb.submit(np.zeros((1, 2))) for _ in range(2)]  # fills queue
+        with pytest.raises(Overloaded) as ei:
+            mb.submit(np.zeros((1, 2)))
+        # typed backpressure: client retry policies classify it transient
+        assert isinstance(ei.value, TransientSourceError)
+        assert RetryPolicy().is_transient(ei.value)
+        assert met.snapshot()["counters"]["serve.blocked.overloaded"] == 1
+    finally:
+        bs.release.set()
+        mb.close()
+    for f in [first] + held:
+        assert f.result(10) is not None
+    with pytest.raises(RuntimeError, match="closed"):
+        mb.submit(np.zeros((1, 2)))
+
+
+def test_batch_policy_validation():
+    with pytest.raises(ValueError, match="max_batch"):
+        BatchPolicy(max_batch=0)
+    with pytest.raises(ValueError, match="max_delay_ms"):
+        BatchPolicy(max_delay_ms=-1)
+    with pytest.raises(ValueError, match="max_queue"):
+        BatchPolicy(max_queue=0)
+
+
+# ---------------------------------------------------------------------------
+# satellites: serialize schema_version, histogram quantiles, path tracing
+# ---------------------------------------------------------------------------
+
+def test_serialize_schema_version_roundtrip_and_forward_refusal(
+        rng, tmp_path):
+    d = {"y": rng.standard_normal(50), "x": rng.standard_normal(50)}
+    m = sg.lm("y ~ x", d)
+    p = str(tmp_path / "m.npz")
+    m.save(p)
+    # current artifacts round-trip and carry schema_version
+    m2 = sg.load_model(p)
+    np.testing.assert_array_equal(m2.coefficients, m.coefficients)
+    with np.load(p) as z:
+        meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    assert isinstance(meta["schema_version"], int)
+    # forge a FUTURE artifact with fields this build does not know
+    meta["schema_version"] = meta["schema_version"] + 7
+    meta["calibration_curve"] = [1, 2, 3]
+    meta["monotone_constraints"] = "auto"
+    header = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    fut = str(tmp_path / "future.npz")
+    np.savez(fut, __meta__=header, **arrays)
+    with pytest.raises(ValueError) as ei:
+        sg.load_model(fut)
+    msg = str(ei.value)
+    assert "schema_version" in msg
+    assert "calibration_curve" in msg and "monotone_constraints" in msg
+    assert "upgrade" in msg
+
+
+def test_histogram_quantiles():
+    h = Histogram()
+    assert h.quantile(0.5) is None                  # empty
+    for v in [0.001] * 50 + [0.002] * 45 + [5.0] * 5:
+        h.observe(v)
+    assert h.quantile(0.0) == pytest.approx(0.001)  # clamps to observed min
+    assert h.quantile(1.0) == pytest.approx(5.0)    # clamps to observed max
+    p50, p99 = h.quantile(0.5), h.quantile(0.99)
+    assert 0.0005 <= p50 <= 0.004                   # within its log2 bucket
+    assert 2.0 <= p99 <= 5.0
+    assert p50 <= p99
+    with pytest.raises(ValueError, match="in \\[0, 1\\]"):
+        h.quantile(1.5)
+    snap = h.snapshot()
+    assert snap["p50"] == p50 and snap["p99"] == p99
+    # quantiles survive JSON export (the SLO scrape path)
+    reg = MetricsRegistry()
+    reg.histogram("lat").observe(0.25)
+    out = json.loads(reg.to_json())
+    assert out["histograms"]["lat"]["p50"] == 0.25
+
+
+def test_predict_from_path_emits_read_and_score_events(
+        poisson_offset_model, tmp_path):
+    """Out-of-core scoring is observable like fitting: reader `read`
+    events flow through the ambient tracer and each chunk emits `score`
+    with rows/seconds."""
+    import csv as csv_mod
+    from sparkglm_tpu.obs.trace import FitTracer, RingBufferSink
+
+    m, d = poisson_offset_model
+    p = tmp_path / "serve_in.csv"
+    with open(p, "w", newline="") as fh:
+        w = csv_mod.writer(fh)
+        w.writerow(list(d))
+        for i in range(len(d["y"])):
+            w.writerow([d[k][i] for k in d])
+    sink = RingBufferSink(512)
+    met = MetricsRegistry()
+    out = str(tmp_path / "scored.csv")
+    ret = sg.predict(m, str(p), chunk_bytes=1 << 12, out_path=out,
+                     trace=FitTracer([sink], metrics=met), metrics=met)
+    assert ret == out
+    events = list(sink.events)
+    reads = [e for e in events if e.kind == "read"]
+    scores = [e for e in events if e.kind == "score"]
+    assert len(reads) >= 2 and len(scores) >= 2
+    assert all(e.fields["rows"] >= 1 for e in scores)
+    assert all(e.fields["seconds"] >= 0 for e in scores)
+    assert all(e.fields["out"] == "file" for e in scores)
+    snap = met.snapshot()
+    assert snap["counters"]["events.score"] == len(scores)
+    assert snap["counters"]["events.read"] == len(reads)
+    # scored rows across chunks == file rows
+    assert sum(e.fields["rows"] for e in scores) == len(d["y"])
