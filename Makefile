@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
-.PHONY: all native test bench robust obs pipeline serve clean
+.PHONY: all native test bench robust obs pipeline serve categorical clean
 
 all: native
 
@@ -43,6 +43,13 @@ pipeline:
 # steady-state recompiles, micro-batch coalescing + typed backpressure
 serve:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q
+
+# factor-aware Gramian engine (sparkglm_tpu/ops/factor_gramian.py): the
+# structured test suite plus the categorical_gramian bench block (dense
+# one-hot vs segment-sum s/iter + coefficient agreement)
+categorical: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_structured.py -q
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:
 	rm -f $(SO)

@@ -582,6 +582,70 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["serving_latency"] = dict(error=repr(e)[:300])
 
+    # ---- factor-aware Gramian engine (ops/factor_gramian.py) ---------------
+    # one wide categorical: the dense path one-hot-expands the factor to
+    # p = 1 + numerics + (levels - 1) columns and pays O(n p^2) einsum FLOPs
+    # per IRLS pass; the structured engine keeps the factor as an index
+    # vector and segment-sums, paying O(n (d^2 + d L)) on the same pass.
+    # Target (ISSUE 5): >= 2x s/iter at the bench shape, coefficients
+    # matching the dense fit within f32 solve noise.
+    try:
+        from sparkglm_tpu.data.model_matrix import (build_terms, transform,
+                                                    transform_structured)
+        from sparkglm_tpu.models import glm as cat_glm
+
+        np_rng = np.random.default_rng(23)
+        nc, d_num, lv = (2_097_152, 32, 512) if on_tpu else (65_536, 32, 512)
+        cols = {f"x{i:02d}": np_rng.standard_normal(nc).astype(np.float32)
+                for i in range(d_num)}
+        fac = np_rng.integers(0, lv, nc)
+        fac[:lv] = np.arange(lv)  # every level appears: deterministic width
+        cols["f"] = np.array([f"c{i:04d}" for i in fac])
+        fac_eff = (np_rng.standard_normal(lv) * 0.5).astype(np.float32)
+        eta_c = 0.3 * cols["x00"] - 0.2 * cols["x01"] + fac_eff[fac]
+        yc = (np_rng.random(nc) < 1 / (1 + np.exp(-eta_c))).astype(np.float32)
+        terms_c = build_terms(
+            cols, columns=[f"x{i:02d}" for i in range(d_num)] + ["f"],
+            intercept=True)
+        Xd_c = transform(cols, terms_c)
+        Xs_c = transform_structured(cols, terms_c)
+
+        def fit_cat(Xc, reps=2):
+            def run():
+                return cat_glm.fit(Xc, yc, family="binomial", mesh=mesh,
+                                   xnames=terms_c.xnames, tol=1e-6,
+                                   criterion="relative")
+            run()  # warm-up: compile + one full solve
+            best, model = float("inf"), None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                model = run()
+                best = min(best, time.perf_counter() - t0)
+            return best, model
+
+        t_dense, m_dense = fit_cat(Xd_c)
+        t_struct, m_struct = fit_cat(Xs_c)
+        spi_d = t_dense / max(1, m_dense.iterations)
+        spi_s = t_struct / max(1, m_struct.iterations)
+        coef_diff = float(np.max(np.abs(m_dense.coefficients
+                                        - m_struct.coefficients)))
+        detail["categorical_gramian"] = dict(
+            n=nc, numerics=d_num, levels=lv, p_dense=int(Xd_c.shape[1]),
+            dense=dict(engine=m_dense.gramian_engine,
+                       seconds=round(t_dense, 4),
+                       iters=int(m_dense.iterations),
+                       s_per_iter=round(spi_d, 5)),
+            structured=dict(engine=m_struct.gramian_engine,
+                            seconds=round(t_struct, 4),
+                            iters=int(m_struct.iterations),
+                            s_per_iter=round(spi_s, 5)),
+            speedup_s_per_iter=round(spi_d / spi_s, 3),
+            coef_maxdiff=coef_diff,
+            ok=bool(m_struct.gramian_engine == "structured"
+                    and spi_d / spi_s >= 2.0 and coef_diff < 1e-3))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["categorical_gramian"] = dict(error=repr(e)[:300])
+
     print(json.dumps({
         "metric": "logistic_"
                   + (f"{n // 1_000_000}M" if n >= 1_000_000 else f"{n // 1000}k")

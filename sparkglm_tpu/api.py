@@ -17,7 +17,8 @@ import numpy as np
 from .config import DEFAULT, NumericConfig
 from .data.formula import parse_formula
 from .data.frame import as_columns, is_categorical, omit_na
-from .data.model_matrix import build_terms, transform
+from .data.model_matrix import (build_terms, transform, transform_structured,
+                                wants_structured)
 from .models import glm as glm_mod
 from .models import lm as lm_mod
 
@@ -78,7 +79,11 @@ def _offset_col_value(f, offset):
     return names[0] if len(names) == 1 else names
 
 
-def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
+def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=(),
+            design: str = "dense"):
+    if design not in ("dense", "structured", "auto"):
+        raise ValueError(
+            f"design must be 'dense', 'structured' or 'auto', got {design!r}")
     f = parse_formula(formula)
     cols = as_columns(data)
     predictors = f.resolve_predictors(list(cols))
@@ -110,7 +115,13 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
     # R's model.matrix coding for '- 1' formulas: first factor keeps all k
     terms = build_terms(cols, predictors, intercept=f.intercept,
                         no_intercept_coding="full_k_first")
-    X = transform(cols, terms, dtype=dtype)
+    # design="auto" structures the design exactly when a factor main effect
+    # is wide enough for the segment-sum Gramian engine to win
+    # (model_matrix.wants_structured; ops/factor_gramian.py)
+    structured = (design == "structured"
+                  or (design == "auto" and wants_structured(terms)))
+    X = (transform_structured(cols, terms, dtype=dtype) if structured
+         else transform(cols, terms, dtype=dtype))
     # R evaluates transforms IN the model frame, so na.action sees their
     # output: rows where log(x)/I(x^k)/... produced non-finite values are
     # dropped (with a warning) exactly like raw-NA rows.  The scan runs
@@ -119,8 +130,10 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
     from .data.formula import parse_component
     has_transform = any(parse_component(c)[0] is not None
                         for comps in terms.design for c in comps)
-    bad = (~np.isfinite(X).all(axis=1) if has_transform
-           else np.zeros(X.shape[0], bool))
+    # only the dense block can carry transform outputs (level indices are
+    # integers by construction), so the structured scan reads the dense leaf
+    bad = (~np.isfinite(np.asarray(X.dense) if structured else X).all(axis=1)
+           if has_transform else np.zeros(X.shape[0], bool))
     if bad.any():
         if not na_omit:
             raise ValueError(
@@ -142,13 +155,14 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=()):
     # dtype=f64 accumulates without materialising an f64 copy of X.
     import dataclasses as _dc
     terms = _dc.replace(
-        terms, col_means=tuple(X.mean(axis=0, dtype=np.float64)))
+        terms, col_means=tuple(X.col_means64() if structured
+                               else X.mean(axis=0, dtype=np.float64)))
     return f, X, y, terms, cols, keep
 
 
 def lm(formula: str, data, *, weights=None, offset=None,
        na_omit: bool = True, mesh=None,
-       singular: str = "drop", engine: str = "auto",
+       singular: str = "drop", engine: str = "auto", design: str = "auto",
        trace=None, metrics=None,
        config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """R-style ``lm(formula, data)`` (ref: sparkLM, R/pkg/R/LM.R:24-44).
@@ -157,10 +171,17 @@ def lm(formula: str, data, *, weights=None, offset=None,
     NaN coefficients (``singular="error"`` to raise instead).  ``offset``
     (argument or ``offset()`` formula terms) follows R's ``lm`` semantics:
     coefficients solve the y - offset regression, fitted values include
-    the offset, R^2/F use the fitted-based moments of summary.lm."""
+    the offset, R^2/F use the fitted-based moments of summary.lm.
+
+    ``design``: "dense" materializes every one-hot block; "structured"
+    carries factor main effects as level-index vectors and assembles the
+    Gramian via segment sums (ops/factor_gramian.py); "auto" (default)
+    structures exactly when a factor is wide enough to win
+    (``model_matrix.WIDE_FACTOR_LEVELS``).  Requires the einsum engine."""
     f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
                                          dtype=np.dtype(config.dtype),
-                                         extra_cols=(weights, offset))
+                                         extra_cols=(weights, offset),
+                                         design=design)
     if f.response2 is not None:
         raise ValueError(
             "cbind() responses are for binomial glm(); lm() fits a single "
@@ -187,7 +208,8 @@ def lm(formula: str, data, *, weights=None, offset=None,
 def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         offset=None, m=None, tol: float = 1e-8, max_iter: int = 100,
         criterion: str = "relative", na_omit: bool = True, mesh=None,
-        engine: str = "auto", singular: str = "drop", verbose: bool = False,
+        engine: str = "auto", singular: str = "drop", design: str = "auto",
+        verbose: bool = False,
         beta0=None, on_iteration=None, checkpoint_every: int = 0,
         trace=None, metrics=None,
         config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
@@ -196,10 +218,14 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
     ``offset``/``m`` may be column names in ``data`` or arrays.
     ``beta0`` is R's ``start=`` (warm-start coefficients — e.g. a
     checkpoint); ``on_iteration``/``checkpoint_every`` surface the
-    compiled IRLS in segments for checkpoint/resume (models/glm.py)."""
+    compiled IRLS in segments for checkpoint/resume (models/glm.py).
+    ``design`` chooses the design representation ("dense" | "structured" |
+    "auto" — see :func:`lm`); structured designs run the segment-sum
+    Gramian engine and require ``engine`` to resolve to einsum."""
     f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
                                          dtype=np.dtype(config.dtype),
-                                         extra_cols=(weights, offset, m))
+                                         extra_cols=(weights, offset, m),
+                                         design=design)
 
     weights_arg, m_arg = weights, m  # pre-resolution, for the model record
     yname = f.response
@@ -326,7 +352,8 @@ def _stream_io(path, *, chunk_bytes, native, backend: str = "auto",
 
 
 def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
-                       chunk_bytes, native, backend: str = "auto"):
+                       chunk_bytes, native, backend: str = "auto",
+                       design: str = "auto"):
     """Shared plan for the from-file streaming fits: global schema + factor
     levels in one pass each (native C++ loader for CSV; pyarrow row-group
     pruned scans for Parquet), a chunking of the file aligned to its IO
@@ -334,6 +361,11 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
     every chunk transforms through.  Returns ``(f, terms, num_chunks,
     extract)`` where ``extract(chunk_index)`` yields the per-chunk
     model-frame pieces.
+
+    ``design="auto"`` emits :class:`StructuredDesign` chunks when a factor
+    is wide (the streaming engine's chunk passes segment-sum those blocks);
+    ``"dense"`` forces one-hot chunks — the constrained-refit profiles need
+    dense column access.
     """
     f = parse_formula(formula)
     for what, v in named_cols.items():
@@ -361,6 +393,7 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
             "data and fit resident")
     terms = build_terms(chunk0, predictors, intercept=f.intercept,
                         levels=levels, no_intercept_coding="full_k_first")
+    structured = design == "auto" and wants_structured(terms)
     used = _used_columns(f, predictors, named_cols.values())
     missing = [c for c in used if c not in chunk0]
     if missing:
@@ -408,11 +441,14 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
             msz = y + np.asarray(cols[f.response2], np.float64)
             y = y / np.maximum(msz, 1e-30)
             w = msz if w is None else w * msz
-        X = transform(cols, terms, dtype=dtype)
+        X = (transform_structured(cols, terms, dtype=dtype) if structured
+             else transform(cols, terms, dtype=dtype))
         if has_transform:
             # same model-frame semantics as _design: na_omit drops rows a
             # transform made non-finite (warned once), else it is an error
-            bad = ~np.isfinite(X).all(axis=1)
+            # (a structured chunk's transforms live in the dense leaf)
+            bad = ~np.isfinite(np.asarray(X.dense) if structured
+                               else X).all(axis=1)
             if bad.any():
                 if not na_omit:
                     raise ValueError(
@@ -643,6 +679,11 @@ def _parse_cache_wrap(extract, mode, csv_bytes: int):
                    if os.path.exists(base + ".off.npy") else None)
             return X, y, w, off
         chunk = extract(i)
+        if not isinstance(chunk[0], np.ndarray):
+            # StructuredDesign chunks skip the disk tier (multi-leaf layout
+            # does not fit the per-array .npy scheme); the streaming HBM
+            # cache still pins them after the first pass
+            return chunk
         if i not in state["seen"]:
             state["seen"].add(i)     # first touch: maybe the only one
             return chunk
@@ -909,7 +950,8 @@ def _csv_constrained_dev(model, path: str, *, weights=None, offset=None,
         model.formula, path,
         named_cols={"weights": weights, "offset": off_name},
         na_omit=na_omit, dtype=np.dtype(config.dtype),
-        chunk_bytes=chunk_bytes, native=native)
+        chunk_bytes=chunk_bytes, native=native,
+        design="dense")  # constrained refits slice X[:, j] — dense only
     if terms.xnames != tuple(model.xnames):
         raise ValueError(
             f"file rebuilds design columns {terms.xnames} but the model "
@@ -1212,13 +1254,18 @@ def predict(model, data, **kwargs) -> np.ndarray:
     if _is_path(data):
         return _predict_from_path(model, str(data), **kwargs)
     cols = as_columns(data)
-    X = transform(cols, model.terms)
     if kwargs.get("type") == "terms":
         extra = set(kwargs) - {"type"}
         if extra:
             raise ValueError(
                 f"type='terms' takes no other predict arguments, got {extra}")
-        return _predict_terms(model, X)
+        # per-term centering walks column spans — a dense-only concern
+        return _predict_terms(model, transform(cols, model.terms))
+    # wide-factor terms score through the structured representation (no
+    # one-hot materialization) — the same predicate fit's design="auto"
+    # used, so scoring cost tracks fitting cost
+    X = (transform_structured(cols, model.terms)
+         if wants_structured(model.terms) else transform(cols, model.terms))
     # a fit-time by-name offset travels with the model (R's predict.glm uses
     # the stored model-frame offset); an explicit offset kwarg overrides
     if "offset" not in kwargs:

@@ -446,16 +446,41 @@ def _component_values(cols, comp: str) -> np.ndarray:
         return _transform_fn(func)(c)
 
 
+def _level_index(values, kept) -> np.ndarray:
+    """Kept-level index per row, one O(1) dict probe per DISTINCT value
+    (the matchCols role without per-level ``cs == lv`` scans — the old
+    coding walked the column once per level, O(n*k) for a k-level factor).
+    Returns int32 with value ``i`` for kept level ``kept[i]`` and
+    ``len(kept)`` (the trash bucket, data/structured.py) for the dropped
+    first level and for categories unseen at training time — densifying
+    the trash gives the all-zero dummy row of the matchCols zero-fill
+    contract (utils.scala:28-33)."""
+    cs = np.asarray(values).astype(str)
+    lut = {lv: i for i, lv in enumerate(kept)}
+    trash = len(kept)
+    uniq, inv = np.unique(cs, return_inverse=True)
+    uidx = np.fromiter((lut.get(u, trash) for u in uniq), np.int32,
+                       count=len(uniq))
+    return np.ascontiguousarray(uidx[inv.reshape(-1)])
+
+
+def _onehot_into(blk: np.ndarray, idx: np.ndarray, k: int) -> None:
+    """Scatter-write the (n, k) one-hot block for ``idx`` (trash rows stay
+    all-zero) into ``blk``, which may be an uninitialised slice."""
+    blk[:] = 0
+    hit = np.flatnonzero(idx < k)
+    blk[hit, idx[hit]] = 1
+
+
 def _coded_block(cols, comp: str, terms: Terms, dtype) -> np.ndarray:
     """(n, k) coding of one component: k-1 dummies for a factor, the
     k-column orthogonal basis for poly(col, k), else the (possibly
     transformed) numeric column."""
     if comp in terms.levels:
-        cs = np.asarray(cols[comp]).astype(str)
         kept = terms.levels[comp]
-        out = np.empty((cs.shape[0], len(kept)), dtype=dtype)
-        for j, lv in enumerate(kept):
-            out[:, j] = (cs == lv).astype(dtype)
+        idx = _level_index(cols[comp], kept)
+        out = np.empty((idx.shape[0], len(kept)), dtype=dtype)
+        _onehot_into(out, idx, len(kept))
         return out
     from .formula import canonical_component, parse_component
     func, nm, _ = parse_component(comp)
@@ -502,10 +527,10 @@ def transform(data, terms: Terms, *, dtype=np.float32) -> np.ndarray:
         if len(comps) == 1:
             nm = comps[0]
             if nm in terms.levels:
-                cs = np.asarray(cols[nm]).astype(str)
-                for lv in terms.levels[nm]:
-                    out[:, j] = (cs == lv).astype(dtype)
-                    j += 1
+                k = len(terms.levels[nm])
+                _onehot_into(out[:, j:j + k],
+                             _level_index(cols[nm], terms.levels[nm]), k)
+                j += k
             elif _pc(nm)[0] in BASIS_FUNCS:
                 blk = block_of(nm)
                 out[:, j:j + blk.shape[1]] = blk
@@ -524,6 +549,118 @@ def transform(data, terms: Terms, *, dtype=np.float32) -> np.ndarray:
         j += b.shape[1]
     assert j == len(terms.xnames)
     return out
+
+
+# factors at or above this many KEPT levels make design="auto" choose the
+# structured representation (ops/factor_gramian.py): below it the dense
+# one-hot blocks are narrow enough that the einsum engine's MXU contraction
+# wins; above it the O(n*k) one-hot FLOPs dominate the fit
+WIDE_FACTOR_LEVELS = 32
+
+
+def wants_structured(terms: Terms) -> bool:
+    """``design="auto"`` rule: structure the design iff some factor MAIN
+    EFFECT has >= ``WIDE_FACTOR_LEVELS`` kept levels (interactions always
+    densify — data/structured.py scope note — so a wide factor appearing
+    only inside interactions gains nothing from structuring)."""
+    return any(len(comps) == 1 and comps[0] in terms.levels
+               and len(terms.levels[comps[0]]) >= WIDE_FACTOR_LEVELS
+               for comps in terms.design)
+
+
+def structured_layout(terms: Terms):
+    """Column geometry of the structured design for ``terms``: factor main
+    effects become index blocks, every other term (intercept, numerics,
+    bases, interactions) lands in the dense block — same column ORDER as
+    :func:`transform`, recorded in ``block_cols``."""
+    from .formula import parse_component as _pc
+    from .structured import StructuredLayout
+    dense_out: list[int] = []
+    factors: list[tuple[str, int]] = []
+    factor_out: list[int] = []
+    j = 0
+    if terms.intercept:
+        dense_out.append(0)
+        j = 1
+    for comps in terms.design:
+        if len(comps) == 1 and comps[0] in terms.levels:
+            L = len(terms.levels[comps[0]])
+            factors.append((comps[0], L))
+            factor_out.extend(range(j, j + L))
+            j += L
+            continue
+        width = 1
+        for comp in comps:
+            if comp in terms.levels:
+                width *= len(terms.levels[comp])
+            else:
+                func, _, deg = _pc(comp)
+                if func in BASIS_FUNCS:
+                    width *= deg
+        dense_out.extend(range(j, j + width))
+        j += width
+    assert j == len(terms.xnames)
+    lay = StructuredLayout(
+        p=len(terms.xnames), n_dense=len(dense_out),
+        factors=tuple(factors),
+        block_cols=tuple(dense_out) + tuple(factor_out),
+        intercept=terms.intercept)
+    lay.validate()
+    return lay
+
+
+def transform_structured(data, terms: Terms, *, dtype=np.float32):
+    """Build a :class:`~sparkglm_tpu.data.structured.StructuredDesign` for
+    ``data`` under ``terms`` — column-for-column equivalent to
+    :func:`transform` (``transform_structured(...).densify()`` equals
+    ``transform(...)``), but factor MAIN EFFECTS are carried as int32
+    level-index vectors instead of one-hot blocks.  Interactions (including
+    ones crossing a factor), bases and transforms materialize into the
+    dense block; unseen categories take the trash index (the all-zero-dummy
+    matchCols zero-fill, as in :func:`transform`)."""
+    cols = as_columns(data)
+    for nm in terms.columns:
+        if nm not in cols:
+            raise KeyError(f"column {nm!r} required by the model is missing from data")
+    n = len(next(iter(cols.values()))) if cols else 0
+    lay = structured_layout(terms)
+    D = np.empty((n, lay.n_dense), dtype=dtype)
+    idxs: list[np.ndarray] = []
+    j = 0
+    if terms.intercept:
+        D[:, j] = 1.0
+        j += 1
+    coded: dict[str, np.ndarray] = {}
+
+    def block_of(comp: str) -> np.ndarray:
+        if comp not in coded:
+            coded[comp] = _coded_block(cols, comp, terms, dtype)
+        return coded[comp]
+
+    from .formula import parse_component as _pc
+    for comps in terms.design:
+        if len(comps) == 1:
+            nm = comps[0]
+            if nm in terms.levels:
+                idxs.append(_level_index(cols[nm], terms.levels[nm]))
+            elif _pc(nm)[0] in BASIS_FUNCS:
+                blk = block_of(nm)
+                D[:, j:j + blk.shape[1]] = blk
+                j += blk.shape[1]
+            else:
+                D[:, j] = _component_values(cols, nm).astype(dtype)
+                j += 1
+            continue
+        b = block_of(comps[0])
+        for comp in comps[1:]:
+            # first component varies fastest, exactly as transform()
+            cb = block_of(comp)
+            b = (cb[:, :, None] * b[:, None, :]).reshape(n, -1)
+        D[:, j:j + b.shape[1]] = b
+        j += b.shape[1]
+    assert j == lay.n_dense and len(idxs) == len(lay.factors)
+    from .structured import StructuredDesign
+    return StructuredDesign(D, tuple(idxs), lay)
 
 
 def model_matrix(data, columns=None, *, intercept: bool = False,

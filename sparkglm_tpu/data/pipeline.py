@@ -28,6 +28,11 @@ sequential path, PARITY.md):
 Memory bound: at most ``prefetch`` produced items plus the one being
 consumed are alive, so a pipelined pass holds ≈ ``(prefetch + 1) ×
 chunk_bytes`` of host/device chunk data beyond the sequential baseline.
+
+The pipeline is representation-agnostic: items are opaque, so structured
+chunks (``data/structured.py`` — a dense leaf plus per-factor level-index
+vectors) ride through exactly like dense matrices, and the determinism
+contract above applies unchanged to the segment-sum streaming passes.
 """
 
 from __future__ import annotations

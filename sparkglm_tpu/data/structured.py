@@ -1,0 +1,228 @@
+"""Structured (factor-aware) design representation.
+
+The reference's ``modelMatrix`` dummy-codes every categorical column into a
+dense k-1 one-hot block (modelMatrix.scala:56-85), so a 512-level factor
+costs O(n*k) HBM and MXU FLOPs for Gramian blocks that are structurally
+O(n) segment sums.  A :class:`StructuredDesign` keeps the information
+content without the zeros: the dense numeric columns stay a (n, d) matrix,
+and each factor MAIN-EFFECT block is carried as one (n,) int32 vector of
+kept-level indices.  ``ops/factor_gramian.py`` assembles the exact
+``(X'WX, X'Wz)`` the dense one-hot design would produce from this
+representation, blockwise.
+
+Index convention (the "trash bucket"): a row's index for factor ``f`` is
+``j`` when the row takes kept level ``j`` (``0 <= j < L``), and ``L`` when
+no kept level is active — the dropped first level under k-1 coding, an
+unseen category at scoring time (matchCols zero-fill semantics), or a
+zero-weight pad row.  Every consumer allocates ``L + 1`` segments and
+drops segment ``L``, so all three cases are exactly the all-zero one-hot
+row they would be in the dense design.
+
+Scope: only factor main effects are structured.  Interactions, polynomial /
+spline bases and arithmetic transforms — including interactions that CROSS
+a factor — are materialized into the dense block by
+``model_matrix.transform_structured``; their Gramian blocks go through the
+ordinary einsum engine.  This keeps the segment-sum engine small while
+capturing the O(n*k) -> O(n) win where the width actually lives.
+
+``StructuredDesign`` is a registered JAX pytree: the dense block and index
+vectors are leaves; the :class:`StructuredLayout` (static, hashable) is
+auxiliary data.  ``jax.jit`` therefore caches per layout, and a dense
+``ndarray`` and a ``StructuredDesign`` passed to the same jitted kernel
+compile separate executables — which is how the models' kernels dispatch
+on ``isinstance`` at trace time with zero runtime cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+__all__ = ["StructuredLayout", "StructuredDesign"]
+
+
+@dataclasses.dataclass(frozen=True)
+class StructuredLayout:
+    """Static column geometry of a :class:`StructuredDesign` (hashable —
+    it rides jit traces as auxiliary pytree data).
+
+    Attributes:
+      p: total design width (== len(terms.xnames)).
+      n_dense: number of dense (materialized) columns.
+      factors: ``(name, n_levels)`` per structured factor block, in block
+        order; ``n_levels`` counts KEPT levels (k-1 coding drops the first).
+      block_cols: length-p permutation; ``block_cols[k]`` is the
+        xnames-order column index of block column ``k``, where block order
+        is [dense columns | factor 0 levels | factor 1 levels | ...].
+      intercept: dense column 0 is the all-ones intercept.
+    """
+
+    p: int
+    n_dense: int
+    factors: tuple[tuple[str, int], ...]
+    block_cols: tuple[int, ...]
+    intercept: bool
+
+    def validate(self) -> None:
+        if self.n_dense + sum(L for _, L in self.factors) != self.p:
+            raise ValueError(
+                f"layout widths {self.n_dense} + factors "
+                f"{[L for _, L in self.factors]} != p={self.p}")
+        if sorted(self.block_cols) != list(range(self.p)):
+            raise ValueError("block_cols is not a permutation of range(p)")
+
+
+def _out_positions(layout: StructuredLayout) -> np.ndarray:
+    """block -> xnames column map as an int64 array (host constant)."""
+    return np.asarray(layout.block_cols, np.int64)
+
+
+class StructuredDesign:
+    """Dense numeric columns + per-factor level-index vectors (see module
+    docstring).  ``dense`` is (n, n_dense); ``idx`` is one (n,) int32 array
+    per ``layout.factors`` entry with values in ``[0, L]`` (L = trash).
+
+    No value validation happens here: pytree unflattening rebuilds
+    instances around tracers during jit.  ``model_matrix.
+    transform_structured`` (the builder) validates.
+    """
+
+    __slots__ = ("dense", "idx", "layout")
+
+    def __init__(self, dense, idx, layout: StructuredLayout):
+        self.dense = dense
+        self.idx = tuple(idx)
+        self.layout = layout
+
+    # -- array-protocol surface the model layer relies on -------------------
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return (self.dense.shape[0], self.layout.p)
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def dtype(self):
+        return self.dense.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.dense.nbytes) + sum(int(i.nbytes) for i in self.idx)
+
+    def astype(self, dtype, copy: bool = True) -> "StructuredDesign":
+        """Cast the DENSE block (indices are positions, never cast)."""
+        if not copy and self.dense.dtype == np.dtype(dtype):
+            return self
+        return StructuredDesign(
+            self.dense.astype(dtype, copy=copy)
+            if isinstance(self.dense, np.ndarray)
+            else self.dense.astype(dtype), self.idx, self.layout)
+
+    def __getitem__(self, key) -> "StructuredDesign":
+        """Row selection (slice / int array / bool mask).  Column selection
+        has no structured form — ``densify()`` first."""
+        if isinstance(key, tuple):
+            raise TypeError(
+                "StructuredDesign supports row indexing only; call "
+                ".densify() for column selection")
+        return StructuredDesign(
+            self.dense[key], tuple(i[key] for i in self.idx), self.layout)
+
+    def __len__(self) -> int:
+        return int(self.dense.shape[0])
+
+    # -- host (numpy, f64-capable) helpers ----------------------------------
+
+    def densify(self, dtype=None) -> np.ndarray:
+        """Materialize the exact dense one-hot design (host numpy) — the
+        fallback for paths with no structured form (QR/TSQR polish,
+        column-drop refits, se_fit scoring)."""
+        lay = self.layout
+        D = np.asarray(self.dense)
+        dt = np.dtype(dtype) if dtype is not None else D.dtype
+        n = int(D.shape[0])
+        out = np.zeros((n, lay.p), dt)
+        bc = _out_positions(lay)
+        if lay.n_dense:
+            out[:, bc[:lay.n_dense]] = D
+        o = lay.n_dense
+        rows = np.arange(n)
+        for (_, L), ix in zip(lay.factors, self.idx):
+            ix = np.asarray(ix)
+            hit = ix < L
+            out[rows[hit], bc[o:o + L][ix[hit]]] = 1
+            o += L
+        return out
+
+    def matvec64(self, beta) -> np.ndarray:
+        """Host float64 ``X @ beta`` without densifying (streaming stats
+        passes, lm offset moments)."""
+        lay = self.layout
+        bb = np.asarray(beta, np.float64)[_out_positions(lay)]
+        eta = np.asarray(self.dense, np.float64) @ bb[:lay.n_dense]
+        o = lay.n_dense
+        for (_, L), ix in zip(lay.factors, self.idx):
+            bf = np.concatenate([bb[o:o + L], [0.0]])
+            eta = eta + bf[np.asarray(ix)]
+            o += L
+        return eta
+
+    def ones_colmask(self) -> np.ndarray:
+        """Per-xnames-column "is identically 1.0" mask (host) — intercept
+        detection.  A one-hot factor column is all-ones only for a
+        single-kept-level degenerate factor; those still read correctly
+        from the level counts."""
+        lay = self.layout
+        D = np.asarray(self.dense)
+        n = int(D.shape[0])
+        mask = np.zeros(lay.p, bool)
+        bc = _out_positions(lay)
+        if n and lay.n_dense:
+            mask[bc[:lay.n_dense]] = (D.min(axis=0) == 1.0) & (D.max(axis=0) == 1.0)
+        o = lay.n_dense
+        for (_, L), ix in zip(lay.factors, self.idx):
+            if n:
+                cnt = np.bincount(np.asarray(ix), minlength=L + 1)[:L]
+                mask[bc[o:o + L]] = cnt == n
+            o += L
+        return mask
+
+    def col_means64(self) -> np.ndarray:
+        """Per-xnames-column mean in float64 (Terms.col_means without
+        densifying — a one-hot column's mean is its level frequency)."""
+        lay = self.layout
+        D = np.asarray(self.dense)
+        n = int(D.shape[0])
+        out = np.zeros(lay.p)
+        bc = _out_positions(lay)
+        if n and lay.n_dense:
+            out[bc[:lay.n_dense]] = D.mean(axis=0, dtype=np.float64)
+        o = lay.n_dense
+        for (_, L), ix in zip(lay.factors, self.idx):
+            if n:
+                cnt = np.bincount(np.asarray(ix), minlength=L + 1)[:L]
+                out[bc[o:o + L]] = cnt / n
+            o += L
+        return out
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"StructuredDesign(n={self.dense.shape[0]}, "
+                f"p={self.layout.p}, n_dense={self.layout.n_dense}, "
+                f"factors={[(nm, L) for nm, L in self.layout.factors]})")
+
+
+def _sd_flatten(sd: StructuredDesign):
+    return ((sd.dense, sd.idx), sd.layout)
+
+
+def _sd_unflatten(layout: StructuredLayout, children) -> StructuredDesign:
+    dense, idx = children
+    return StructuredDesign(dense, idx, layout)
+
+
+jax.tree_util.register_pytree_node(StructuredDesign, _sd_flatten, _sd_unflatten)

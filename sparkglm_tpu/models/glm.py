@@ -45,8 +45,9 @@ from ..config import (DEFAULT, NumericConfig, effective_tol,
 from ..families.families import Family, resolve
 from ..families.links import Link
 from ..obs import trace as _obs_trace
+from ..data.structured import StructuredDesign
+from ..ops.factor_gramian import design_gramian, design_matvec
 from ..ops.fused import fused_fisher_pass, fused_fisher_pass_ref
-from ..ops.gramian import weighted_gramian
 from ..ops.solve import (factor_parts, factor_singular, inv_from_parts,
                          min_pivot, solve_normal)
 from ..parallel import mesh as meshlib
@@ -126,7 +127,7 @@ def _irls_kernel(
         # NaN entries (aliased coefficients from a checkpointed drop-path
         # fit) contribute nothing, as in predict's reduced basis
         beta_init = jnp.nan_to_num(beta0).astype(X.dtype)
-        eta0 = (X @ beta_init + offset).astype(X.dtype)
+        eta0 = (design_matvec(X, beta_init) + offset).astype(X.dtype)
         mu0 = jnp.where(valid, link.inverse(eta0), 1.0)
     else:
         beta_init = jnp.zeros((p,), X.dtype)
@@ -181,8 +182,10 @@ def _irls_kernel(
             XtWX = (R.T @ R).astype(acc)  # Gramian for the drop-path rank check
             fac_a, fac_d = R.astype(acc), s["fac_d"]
         else:
-            XtWX, XtWz = weighted_gramian(X, z, w, accum_dtype=acc,
-                                          precision=precision)
+            # dispatch is static at trace time: a StructuredDesign is a
+            # distinct pytree, so it keys its own executable
+            XtWX, XtWz = design_gramian(X, z, w, accum_dtype=acc,
+                                        precision=precision)
             beta, cho = solve_normal(XtWX, XtWz, jitter=jitter,
                                      refine_steps=refine_steps)
             fac_a, fac_d = factor_parts(cho)
@@ -192,7 +195,7 @@ def _irls_kernel(
         beta = jnp.where(singular, s["beta"], beta)
         fac_a = jnp.where(singular, s["fac_a"], fac_a)
         fac_d = jnp.where(singular, s["fac_d"], fac_d)
-        eta_new = (X @ beta + offset).astype(X.dtype)      # ref: etaCreate :321-332
+        eta_new = (design_matvec(X, beta) + offset).astype(X.dtype)  # ref: etaCreate :321-332
         mu_new = jnp.where(valid, link.inverse(eta_new), 1.0).astype(X.dtype)  # ref: muCreate :334-355
         dev_new = dev_of(mu_new).astype(acc)
 
@@ -214,7 +217,7 @@ def _irls_kernel(
 
         def h_body(h):
             b = (0.5 * (h["beta"] + s["beta"])).astype(X.dtype)
-            e = (X @ b + offset).astype(X.dtype)
+            e = (design_matvec(X, b) + offset).astype(X.dtype)
             m = jnp.where(valid, link.inverse(e), 1.0).astype(X.dtype)
             return dict(k=h["k"] + 1, beta=b, eta=e, mu=m,
                         dev=dev_of(m).astype(acc))
@@ -625,6 +628,10 @@ class GLMModel:
     # aggregate, attached when the fit ran traced (trace=/metrics=/verbose=).
     # Plain JSON-able dict so save_model round-trips it; None otherwise.
     fit_info: dict | None = None
+    # which Gramian engine produced X'WX: "einsum" (dense MXU contraction),
+    # "fused" (single-kernel pass), "structured" (factor-aware segment
+    # sums), or "qr" (no Gramian solve)
+    gramian_engine: str | None = None
 
     def fit_report(self) -> dict:
         """How the fit ran: iterations, wall/device time split, per-pass
@@ -638,6 +645,7 @@ class GLMModel:
             "converged": bool(self.converged),
             "deviance": float(self.deviance),
             "n_obs": int(self.n_obs), "n_params": int(self.n_params),
+            "gramian_engine": self.gramian_engine,
         }
         if self.fit_info:
             rep.update(self.fit_info)
@@ -657,7 +665,8 @@ class GLMModel:
         numerics path (models/scoring.py) — also the one the online
         serving engine (sparkglm_tpu/serve) compiles per padding bucket,
         so served and offline predictions are bit-identical."""
-        X = np.asarray(X)
+        if not isinstance(X, StructuredDesign):
+            X = np.asarray(X)
         if X.ndim != 2 or X.shape[1] != self.n_params:
             raise ValueError(
                 f"predict expects (n, {self.n_params}) aligned to xnames; got {X.shape}")
@@ -797,25 +806,30 @@ def _emit_iter_event(i, dev, ddev, halvings) -> None:
               f"\tddev {float(ddev):.3g}", file=sys.stderr)
 
 
-def _trace_kernel_calls(run_kernel, tracer):
+def _trace_kernel_calls(run_kernel, tracer, gramian_engine=None):
     """Wrap an engine closure so every compiled segment runs inside a
     device-aware span (obs/timing.py): blocking happens at the span edge
     only — the caller reads these outputs immediately anyway, so the
     compiled while_loop is never perturbed.  The first call emits
     ``compile`` (wall time including compilation), every call emits
-    ``solve`` with the segment's iteration count."""
+    ``solve`` with the segment's iteration count.  ``gramian_engine``
+    stamps both events with which X'WX assembly ran (einsum | fused |
+    structured | qr)."""
     from ..obs import timing as _obs_timing
     state = {"calls": 0}
+    extra = {} if gramian_engine is None else {"gramian_engine": gramian_engine}
 
     def wrapped(seg_iters, beta_arr, warm, it_base=0, dev_prev=None):
         with _obs_timing.span("irls_segment", tracer, device=True) as sp:
             out = run_kernel(seg_iters, beta_arr, warm, it_base, dev_prev)
             sp.watch(out)
         if state["calls"] == 0:
-            tracer.emit("compile", target="irls_kernel", seconds=sp.seconds)
+            tracer.emit("compile", target="irls_kernel", seconds=sp.seconds,
+                        **extra)
         state["calls"] += 1
         tracer.emit("solve", target="irls_segment",
-                    iters=int(np.asarray(out["iters"])), seconds=sp.seconds)
+                    iters=int(np.asarray(out["iters"])), seconds=sp.seconds,
+                    **extra)
         return out
 
     return wrapped
@@ -825,7 +839,7 @@ def _finalize_model(
     *, fam, lnk, beta, cov_inv, dev, pearson, loglik, wt_sum, n_ok,
     null_dev, iters, converged, n_obs, p, xnames, yname, has_intercept,
     has_offset, n_shards, tol, criterion, verbose, tol_eff=None,
-    tracer=None,
+    tracer=None, gramian_engine=None,
 ) -> GLMModel:
     """Shared tail of every resident fit path: the non-convergence warning,
     dispersion / SEs / AIC (ref: createObj, GLM.scala:59-88) and the model
@@ -870,7 +884,8 @@ def _finalize_model(
         converged=bool(converged), n_obs=n_obs, n_params=p,
         n_shards=n_shards, tol=tol, has_intercept=bool(has_intercept),
         cov_unscaled=cov_inv, has_offset=bool(has_offset),
-        dispersion_fixed=bool(fam.dispersion_fixed))
+        dispersion_fixed=bool(fam.dispersion_fixed),
+        gramian_engine=gramian_engine)
 
 
 def _fit_global(
@@ -983,7 +998,7 @@ def _fit_global(
             )
 
     if tracer is not None:
-        run_kernel = _trace_kernel_calls(run_kernel, tracer)
+        run_kernel = _trace_kernel_calls(run_kernel, tracer, engine)
     if beta0 is not None or on_iteration is not None or checkpoint_every:
         # segmented checkpointing: the multi-host recovery story — every
         # process persists beta in its on_iteration and a restarted job
@@ -1073,7 +1088,7 @@ def _fit_global(
         has_intercept=has_intercept, has_offset=has_offset,
         n_shards=mesh.shape[meshlib.DATA_AXIS], tol=tol,
         criterion=criterion, verbose=verbose, tol_eff=tol_run,
-        tracer=tracer)
+        tracer=tracer, gramian_engine=engine)
 
 
 def fit(
@@ -1213,7 +1228,9 @@ def _fit_dispatch(
                            on_iteration=on_iteration,
                            checkpoint_every=checkpoint_every, engine=engine,
                            tracer=tracer)
-    X = np.asarray(X)
+    is_structured = isinstance(X, StructuredDesign)
+    if not is_structured:
+        X = np.asarray(X)
     y = np.asarray(y)
     if y.ndim == 2:
         if y.shape[1] != 1:
@@ -1294,6 +1311,17 @@ def _fit_dispatch(
                                       or mesh.shape[meshlib.MODEL_AXIS] != 1):
         raise ValueError(
             f"engine={engine!r} does not support a sharded feature axis")
+    if is_structured:
+        if engine != "einsum":
+            raise ValueError(
+                f"engine={engine!r} has no structured form (the fused and "
+                "TSQR kernels stream dense row blocks) — fit with "
+                "design='dense' or densify() first")
+        if shard_features:
+            raise ValueError(
+                "structured designs cannot be feature-sharded — densify "
+                "first or use shard_features=False")
+    g_engine = "structured" if is_structured else engine
     if config.bf16_warmup and not (
             engine == "fused" and dtype == np.float32
             and criterion == "relative" and not checkpointing):
@@ -1361,7 +1389,8 @@ def _fit_dispatch(
                 fam_param=fam_param,
             )
         if tracer is not None:
-            run_kernel = _trace_kernel_calls(run_kernel, tracer)
+            run_kernel = _trace_kernel_calls(run_kernel, tracer,
+                                             g_engine)
         if checkpointing:
             out = _segmented_irls(run_kernel, p=p, dtype=dtype,
                                   max_iter=max_iter, beta0=beta0,
@@ -1428,7 +1457,8 @@ def _fit_dispatch(
                 fam_param=fam_param,
             )
         if tracer is not None:
-            run_kernel = _trace_kernel_calls(run_kernel, tracer)
+            run_kernel = _trace_kernel_calls(run_kernel, tracer,
+                                             g_engine)
         if checkpointing:
             out = _segmented_irls(run_kernel, p=p, dtype=dtype,
                                   max_iter=max_iter, beta0=beta0,
@@ -1463,8 +1493,12 @@ def _fit_dispatch(
             sub_beta0 = (None if beta0 is None
                          else np.asarray(beta0, np.float64)[mask])
             # slice back to the unpadded rows; wt64/y64 already carry any m
-            # conversion, so the recursive fit must not re-apply it
-            sub = fit(X[:n, mask], y64, family=fam, link=lnk,
+            # conversion, so the recursive fit must not re-apply it.  The
+            # aliased refit selects COLUMNS, which has no structured form —
+            # densify for the (rare, rank-deficient) recursion
+            Xsub = (X.densify()[:n][:, mask] if is_structured
+                    else X[:n, mask])
+            sub = fit(Xsub, y64, family=fam, link=lnk,
                       weights=wt64, offset=off64, tol=tol,
                       max_iter=max_iter, criterion=criterion,
                       xnames=tuple(np.asarray(xnames)[mask]), yname=yname,
@@ -1490,7 +1524,7 @@ def _fit_dispatch(
         engine=engine,
         polish_active=polish_active, polish_cfg=config.polish,
         can_polish=not shard_features
-        and mesh.shape[meshlib.MODEL_AXIS] == 1)
+        and mesh.shape[meshlib.MODEL_AXIS] == 1 and not is_structured)
     if polish_active:
         # TSQR + corrected seminormal equations at the final weights
         # (ops/tsqr.py): error ~eps*kappa instead of ~eps*kappa^2 (measured
@@ -1549,4 +1583,4 @@ def _fit_dispatch(
         xnames=xnames, yname=yname, has_intercept=has_intercept,
         has_offset=has_offset, n_shards=mesh.shape[meshlib.DATA_AXIS],
         tol=tol, criterion=criterion, verbose=verbose, tol_eff=tol_run,
-        tracer=tracer)
+        tracer=tracer, gramian_engine=g_engine)

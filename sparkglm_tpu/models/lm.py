@@ -29,8 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import DEFAULT, NumericConfig
+from ..data.structured import StructuredDesign
 from ..obs import trace as _obs_trace
-from ..ops.gramian import weighted_gramian, weighted_moments
+from ..ops.factor_gramian import design_gramian, design_matvec
+from ..ops.gramian import weighted_moments
 from ..ops.solve import (diag_inv_from_cho, factor_singular,
                          independent_columns, inv_from_cho, min_pivot,
                          solve_normal)
@@ -101,8 +103,11 @@ def _lm_kernel(X, y, w, jitter, refine_steps: int = 1, compute_cov: bool = True,
         cov_unscaled = cov_full if compute_cov else jnp.zeros((p, p), acc)
         singular = ~jnp.all(jnp.isfinite(beta)) | (pivot < 1e-6)
     else:
-        XtWX, XtWy = weighted_gramian(X, y, w, accum_dtype=acc,
-                                      precision=precision)
+        # design_gramian dispatches at trace time: the einsum engine for a
+        # dense X, segment-sum assembly for a StructuredDesign (the pytree
+        # treedef keys the jit cache, so the branch is static)
+        XtWX, XtWy = design_gramian(X, y, w, accum_dtype=acc,
+                                    precision=precision)
         beta, cho = solve_normal(XtWX, XtWy, jitter=jitter,
                                  refine_steps=refine_steps)
         diag_inv = diag_inv_from_cho(cho, p, XtWX.dtype)
@@ -110,7 +115,7 @@ def _lm_kernel(X, y, w, jitter, refine_steps: int = 1, compute_cov: bool = True,
                         else jnp.zeros((p, p), XtWX.dtype))
         singular = ~jnp.all(jnp.isfinite(beta)) | factor_singular(cho)
         pivot = min_pivot(cho)
-    resid = y - X @ beta
+    resid = y - design_matvec(X, beta)
     sse = jnp.sum(w.astype(acc) * resid.astype(acc) ** 2)
     n, ybar, sst_centered = weighted_moments(y, w, accum_dtype=acc)
     sst_raw = sst_centered + n * ybar * ybar  # uncentered sum of squares
@@ -168,6 +173,9 @@ class LMModel:
     # fit telemetry aggregate (obs/trace.py FitTracer.report()), attached
     # when the fit ran with trace=/metrics=; None otherwise
     fit_info: dict | None = None
+    # which Gramian engine produced X'WX: "einsum" (dense MXU contraction),
+    # "structured" (factor-aware segment sums), or "qr" (no Gramian solve)
+    gramian_engine: str | None = None
 
     def fit_report(self) -> dict:
         """How the fit ran: wall time, per-pass IO vs compute, fault counts
@@ -180,6 +188,7 @@ class LMModel:
             "n_obs": int(self.n_obs), "n_params": int(self.n_params),
             "sigma": float(self.sigma),
             "r_squared": float(self.r_squared),
+            "gramian_engine": self.gramian_engine,
         }
         if self.fit_info:
             rep.update(self.fit_info)
@@ -203,7 +212,8 @@ class LMModel:
         (models/scoring.py — the reference's executor-side
         ``predictMultiple``, LM.scala:52-61), including the se.fit
         quadform on device.  None keeps the single-device path."""
-        X = np.asarray(X)
+        if not isinstance(X, StructuredDesign):
+            X = np.asarray(X)
         if X.ndim != 2 or X.shape[1] != self.n_params:
             raise ValueError(
                 f"predict expects (n, {self.n_params}) design matrix aligned to "
@@ -363,6 +373,10 @@ def _detect_intercept(X: np.ndarray, xnames: Sequence[str] | None) -> bool:
     present iff some column is constant 1 (or is named 'intercept')."""
     if xnames is not None and any(n.lower() in ("intercept", "(intercept)") for n in xnames):
         return True
+    if isinstance(X, StructuredDesign):
+        # the layout records whether the builder placed an intercept; a
+        # manually-assembled design still gets the all-ones scan
+        return bool(X.layout.intercept or X.ones_colmask().any())
     # O(1) endpoint guard per column, full O(n) scan only on survivors;
     # stops at the first constant-ones column (usually column 0)
     return any(
@@ -432,7 +446,17 @@ def fit(
     if config.polish not in (None, "csne", "off"):
         raise ValueError(
             f"polish must be None (auto), 'csne' or 'off', got {config.polish!r}")
-    X = np.asarray(X)
+    is_structured = isinstance(X, StructuredDesign)
+    if is_structured:
+        if engine == "qr":
+            raise ValueError(
+                "engine='qr' has no structured form (TSQR factors dense row "
+                "blocks) — fit with design='dense' or densify() first")
+        if shard_features:
+            raise ValueError(
+                "structured designs cannot be feature-sharded")
+    else:
+        X = np.asarray(X)
     y = np.asarray(y)
     if y.ndim == 2:
         if y.shape[1] != 1:
@@ -493,8 +517,11 @@ def fit(
                          solver="qr" if engine == "qr" else "chol",
                          mesh=mesh if engine == "qr" else None)
         sp.watch(out)
+    g_engine = ("qr" if engine == "qr"
+                else "structured" if is_structured else "einsum")
     if _tr is not None:
-        _tr.emit("solve", target="lm_kernel", p=int(p), seconds=sp.seconds)
+        _tr.emit("solve", target="lm_kernel", p=int(p), seconds=sp.seconds,
+                 gramian_engine=g_engine)
     out = jax.tree.map(np.asarray, out)
 
     if singular == "drop":
@@ -505,7 +532,10 @@ def fit(
         mask = independent_columns(out["XtWX"].astype(np.float64),
                                    tol=rank_tol)
         if not mask.all() and mask.any():
-            sub = fit(X[:, mask], y, weights=weights, offset=offset,
+            # the aliased refit selects COLUMNS, which has no structured
+            # form — densify for the (rare, rank-deficient) recursion
+            Xsub = X.densify()[:, mask] if is_structured else X[:, mask]
+            sub = fit(Xsub, y, weights=weights, offset=offset,
                       xnames=tuple(np.asarray(xnames)[mask]), yname=yname,
                       has_intercept=has_intercept, mesh=mesh,
                       shard_features=shard_features, singular="error",
@@ -531,7 +561,7 @@ def fit(
         engine=engine,
         polish_active=polish_active, polish_cfg=config.polish,
         can_polish=not shard_features
-        and mesh.shape[meshlib.MODEL_AXIS] == 1)
+        and mesh.shape[meshlib.MODEL_AXIS] == 1 and not is_structured)
     if polish_active:
         # TSQR + corrected seminormal equations at the final weights
         # (ops/tsqr.py): error ~eps*kappa instead of the normal equations'
@@ -565,7 +595,8 @@ def fit(
         # matvec is reused when it ran)
         xb64 = out.get("_xb64")
         if xb64 is None:
-            xb64 = X.astype(np.float64) @ out["beta"].astype(np.float64)
+            xb64 = (X.matvec64(out["beta"]) if is_structured
+                    else X.astype(np.float64) @ out["beta"].astype(np.float64))
         f64 = xb64 + off64
         w64 = w_host.astype(np.float64)
         if has_intercept:
@@ -601,4 +632,5 @@ def fit(
         n_shards=mesh.shape[meshlib.DATA_AXIS],
         cov_unscaled=out["cov_unscaled"].astype(np.float64),
         has_offset=bool(off64 is not None and np.any(off64 != 0)),
+        gramian_engine=g_engine,
     )

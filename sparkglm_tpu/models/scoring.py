@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..data.structured import StructuredDesign
+from ..ops.factor_gramian import design_matvec
 from ..parallel import mesh as meshlib
 
 _SCORE_STATICS = ("inverse", "deriv", "want_se", "response", "has_offset",
@@ -48,8 +50,14 @@ def _score_fn(X, beta, offset, V, *, inverse=None, deriv=None,
     never ship full-size zero operands.  The eta matvec runs at HIGHEST
     (full-f32 MXU passes; its FLOPs are O(n p), trivial), the se quadform's
     O(n p^2) X@V at ``quad_precision`` (resolve_matmul_precision: HIGHEST
-    where it is free, backend default where it dominates)."""
-    eta = jnp.matmul(X, beta, precision=jax.lax.Precision.HIGHEST)
+    where it is free, backend default where it dominates).
+
+    ``X`` may be a :class:`StructuredDesign` (a pytree, so it keys its own
+    executables inside the same jit caches): eta becomes the dense matvec
+    plus one gather per factor.  ``want_se`` never sees a structured X —
+    ``predict_sharded`` densifies first (the quadform has no structured
+    form short of per-block expansion, and se.fit is a small-batch path)."""
+    eta = design_matvec(X, beta, precision=jax.lax.Precision.HIGHEST)
     if has_offset:
         eta = eta + offset
     fit = inverse(eta) if (response and inverse is not None) else eta
@@ -83,7 +91,10 @@ def donation_supported() -> bool:
 
 def score_kernel_cache_size() -> int:
     """Executable count across both kernel variants — the serving bench's
-    "zero steady-state recompiles" counter reads deltas of this."""
+    "zero steady-state recompiles" counter reads deltas of this.  The
+    structured-design executables live in these same caches (a
+    ``StructuredDesign`` is a pytree keying its own entries), so the
+    accounting covers both representations."""
     return int(_score_kernel._cache_size()
                + _score_kernel_donated._cache_size())
 
@@ -94,7 +105,9 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
     """Score ``X`` on device; returns host float64 ``fit`` or ``(fit, se)``.
 
     Args:
-      X: (n, p) host design aligned to the model's xnames.
+      X: (n, p) host design aligned to the model's xnames — a dense
+        matrix or a ``StructuredDesign`` (which scores without one-hot
+        materialization; ``se_fit`` densifies it first).
       coefficients: (p,) — NaN (aliased) entries contribute nothing
         (R's reduced-basis prediction).
       mesh: score over a device mesh as one row-sharded SPMD pass; None
@@ -117,7 +130,14 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
     """
     from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
 
-    X = np.asarray(X)
+    structured = isinstance(X, StructuredDesign)
+    if structured and se_fit:
+        # the se quadform walks X@V column-wise — no structured form; se.fit
+        # requests are small batches, so the one-hot expansion is cheap
+        X = X.densify()
+        structured = False
+    if not structured:
+        X = np.asarray(X)
     n, p = X.shape
     # match the host predict's precision contract: numpy upcasts f32
     # designs to f64 there, so compute at f64 whenever x64 allows it;
@@ -127,9 +147,22 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
     oh = None if offset is None else np.asarray(offset, dtype).reshape(n)
     if pad_to is not None and int(pad_to) > n:
         t = int(pad_to)
-        Xp = np.zeros((t, p), dtype)
-        Xp[:n] = Xh
-        Xh = Xp
+        if structured:
+            # dense leaf zero-pads; index leaves pad with the trash bucket
+            # (L) so pad rows gather the appended zero — inert before the
+            # [:n] slice even touches them
+            Dp = np.zeros((t, Xh.dense.shape[1]), dtype)
+            Dp[:n] = np.asarray(Xh.dense)
+            idxp = []
+            for (_, L), ix in zip(Xh.layout.factors, Xh.idx):
+                v = np.full((t,), L, np.asarray(ix).dtype)
+                v[:n] = np.asarray(ix)
+                idxp.append(v)
+            Xh = StructuredDesign(Dp, tuple(idxp), Xh.layout)
+        else:
+            Xp = np.zeros((t, p), dtype)
+            Xp[:n] = Xh
+            Xh = Xp
         if oh is not None:
             op = np.zeros((t,), dtype)
             op[:n] = oh
@@ -144,7 +177,7 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
             np.nan_to_num(np.asarray(vcov, dtype)) if se_fit
             else np.zeros((1, 1), dtype), mesh)
     else:
-        Xd = jnp.asarray(Xh)
+        Xd = jax.device_put(Xh) if structured else jnp.asarray(Xh)
         od = jnp.asarray(oh if oh is not None else np.zeros((1,), dtype))
         beta = jnp.asarray(np.nan_to_num(np.asarray(coefficients, dtype)))
         V = jnp.asarray(np.nan_to_num(np.asarray(vcov, dtype)) if se_fit

@@ -58,11 +58,12 @@ import scipy.linalg
 
 from ..config import DEFAULT, NumericConfig, effective_tol
 from ..data import pipeline as _pipeline
+from ..data.structured import StructuredDesign
 from ..obs import trace as _obs_trace
 from ..families.families import Family, resolve
 from ..families.links import Link
+from ..ops.factor_gramian import design_gramian, structured_fisher_pass
 from ..ops.fused import fused_fisher_pass_ref
-from ..ops.gramian import weighted_gramian
 from ..parallel import mesh as meshlib
 from .glm import GLMModel
 from .lm import LMModel
@@ -132,6 +133,8 @@ def _ones_colmask(Xc) -> np.ndarray:
     chunks scan on device (pulling only the (p,) mask)."""
     if _is_device_chunk(Xc):
         return np.asarray(_ones_colmask_dev(Xc))
+    if isinstance(Xc, StructuredDesign):
+        return Xc.ones_colmask()
     Xc = np.asarray(Xc)
     return (Xc.min(axis=0) == 1.0) & (Xc.max(axis=0) == 1.0)
 
@@ -163,6 +166,8 @@ def _chunk_xbeta(Xc, beta) -> np.ndarray:
     if _is_device_chunk(Xc):
         return np.asarray(
             _matvec_hi(Xc, jnp.asarray(beta, Xc.dtype)), np.float64)
+    if isinstance(Xc, StructuredDesign):
+        return Xc.matvec64(beta)
     return np.asarray(Xc, np.float64) @ beta
 
 
@@ -176,7 +181,8 @@ def _check_finite_design_any(Xc) -> None:
                 "generator's output")
         return
     from .validate import check_finite_design
-    check_finite_design(np.asarray(Xc))
+    check_finite_design(Xc if isinstance(Xc, StructuredDesign)
+                        else np.asarray(Xc))
 
 
 # ---------------------------------------------------------------------------
@@ -199,17 +205,30 @@ def _fingerprint(Xc, yc, wc=None, oc=None) -> tuple:
     later pass — which the cached-prefix skip would otherwise silently
     double-count (ADVICE r2).  Scalar indexing only: costs nothing even on
     multi-GB chunks."""
-    Xc = np.asarray(Xc)
-    n = int(Xc.shape[0])
-    if n == 0:
-        return (0, int(Xc.shape[1]))
-
     def corners(v):
         if v is None:
             return (None, None)
         v = np.ravel(np.asarray(v))
         return (float(v[0]), float(v[-1]))
 
+    if isinstance(Xc, StructuredDesign):
+        # corner-sample every leaf: the dense block (when it has columns)
+        # plus each factor's index vector
+        n = int(Xc.shape[0])
+        if n == 0:
+            return (0, int(Xc.shape[1]))
+        D = np.asarray(Xc.dense)
+        parts = [n, int(Xc.shape[1])]
+        if D.shape[1]:
+            parts += [float(D[0, 0]), float(D[-1, -1])]
+        for ix in Xc.idx:
+            v = np.ravel(np.asarray(ix))
+            parts += [int(v[0]), int(v[-1])]
+        return (*parts, *corners(yc), *corners(wc), *corners(oc))
+    Xc = np.asarray(Xc)
+    n = int(Xc.shape[0])
+    if n == 0:
+        return (0, int(Xc.shape[1]))
     return (n, int(Xc.shape[1]), float(Xc[0, 0]), float(Xc[-1, -1]),
             *corners(yc), *corners(wc), *corners(oc))
 
@@ -252,10 +271,12 @@ def _is_device_chunk(Xc) -> bool:
 
 def _source_first_chunk(chunks):
     """Materialize the source's first chunk ONCE for checkpoint identity:
-    ``(fingerprint, p, chunks')``.  Device-chunk sources (programmatic,
-    on-device RNG) get a shape-only fingerprint — per-scalar corner pulls
-    are RPCs over the tunnel, and such sources are not the changed-file
-    failure class the fingerprint guards.
+    ``(fingerprint, p, structured, chunks')``.  Device-chunk sources
+    (programmatic, on-device RNG) get a shape-only fingerprint — per-scalar
+    corner pulls are RPCs over the tunnel, and such sources are not the
+    changed-file failure class the fingerprint guards.  ``structured``
+    flags a :class:`StructuredDesign` chunk source, which the resumed
+    drivers need for the polish gate without re-streaming the pass.
 
     ``chunks'`` hands the drawn chunk straight to the next pass: its FIRST
     invocation replays the materialized chunk 0 and then continues the
@@ -270,7 +291,7 @@ def _source_first_chunk(chunks):
     if _is_device_chunk(Xc0):
         fp = (int(Xc0.shape[0]), int(Xc0.shape[1]))
     else:
-        fp = _fingerprint(np.asarray(Xc0), yc0, wc0, oc0)
+        fp = _fingerprint(Xc0, yc0, wc0, oc0)
     fresh = [True]
 
     def wrapped():
@@ -282,7 +303,7 @@ def _source_first_chunk(chunks):
                 yield from it
             return gen()
         return chunks()
-    return fp, int(Xc0.shape[1]), wrapped
+    return fp, int(Xc0.shape[1]), isinstance(Xc0, StructuredDesign), wrapped
 
 
 def _bucket_pad(Xc, yc, wc, oc, bucket: dict):
@@ -313,9 +334,23 @@ def _bucket_pad(Xc, yc, wc, oc, bucket: dict):
         if wc is None:
             wc = np.ones((n,), np.float64)
         return Xc, yc, wc, oc
-    pad = target - n
-    Xp = np.zeros((target, int(Xc.shape[1])), np.asarray(Xc).dtype)
-    Xp[:n] = np.asarray(Xc)
+    if isinstance(Xc, StructuredDesign):
+        # pad leaf-wise: dense rows zero (inert like the one-hot rows they
+        # represent), index rows to the factor's TRASH bucket (L — sliced
+        # off every segment sum), so pad rows touch no real level even
+        # before the weight-0 guarantee kicks in
+        Dp = np.zeros((target, int(Xc.dense.shape[1])),
+                      np.asarray(Xc.dense).dtype)
+        Dp[:n] = np.asarray(Xc.dense)
+        idxp = []
+        for (_, L), ix in zip(Xc.layout.factors, Xc.idx):
+            v = np.full((target,), L, np.asarray(ix).dtype)
+            v[:n] = np.asarray(ix)
+            idxp.append(v)
+        Xp = StructuredDesign(Dp, tuple(idxp), Xc.layout)
+    else:
+        Xp = np.zeros((target, int(Xc.shape[1])), np.asarray(Xc).dtype)
+        Xp[:n] = np.asarray(Xc)
 
     def padv(v, fill):
         out = np.full((target,), fill, np.float64)
@@ -329,11 +364,14 @@ def _bucket_pad(Xc, yc, wc, oc, bucket: dict):
     return Xp, yp, wp, op
 
 
-def _traced_call(fn, tracer, target: str, *args, **kw):
+def _traced_call(fn, tracer, target: str, *args, engine: str | None = None,
+                 **kw):
     """Invoke a jitted pass, emitting a ``compile`` event when the call
     grew the executable cache (jit traces/compiles synchronously on a
     cache miss, so the wrapped call's extra latency IS the compile time;
-    steady-state calls pay one integer read)."""
+    steady-state calls pay one integer read).  ``engine`` stamps the event
+    with which X'WX assembly compiled (einsum | structured), mirroring the
+    resident fits' compile/solve events."""
     size = getattr(fn, "_cache_size", None)
     if tracer is None or size is None:
         return fn(*args, **kw)
@@ -341,8 +379,9 @@ def _traced_call(fn, tracer, target: str, *args, **kw):
     t0 = time.perf_counter()
     out = fn(*args, **kw)
     if size() > before:
+        extra = {} if engine is None else {"gramian_engine": engine}
         tracer.emit("compile", target=target,
-                    seconds=time.perf_counter() - t0)
+                    seconds=time.perf_counter() - t0, **extra)
     return out
 
 
@@ -405,7 +444,10 @@ def _put_chunk(Xc, yc, wc, oc, mesh, dtype):
 
         return (jax.device_put(jnp.asarray(Xc, dtype), sh_m),
                 putv(yc, 0.0), putv(wc, 1.0), putv(oc, 0.0))
-    Xc = np.asarray(Xc, dtype=dtype)
+    if isinstance(Xc, StructuredDesign):
+        Xc = Xc.astype(dtype, copy=False)   # casts the dense leaf only
+    else:
+        Xc = np.asarray(Xc, dtype=dtype)
     nc = Xc.shape[0]
     yc = np.asarray(yc, dtype=dtype).reshape(nc)
     wc = (np.ones((nc,), dtype) if wc is None
@@ -426,6 +468,11 @@ def _glm_chunk_pass(Xc, yc, wc, oc, beta, *, family: Family, link: Link,
     # HIGHEST is pinned: streaming is H2D-bandwidth-bound, so the full-f32
     # Gramian passes are free and keep chunked accumulation at r02 accuracy
     # (the twin's None default now mirrors the fast Mosaic kernel instead)
+    if isinstance(Xc, StructuredDesign):
+        return structured_fisher_pass(Xc, yc, wc, oc, beta,
+                                      family=family, link=link, first=first,
+                                      precision="highest",
+                                      fam_param=fam_param)
     return fused_fisher_pass_ref(Xc, yc, wc, oc, beta,
                                  family=family, link=link, first=first,
                                  precision="highest", fam_param=fam_param)
@@ -438,7 +485,9 @@ def _lm_chunk_pass(Xc, yc, wc):
     f32 cancels catastrophically for near-exact fits at 50M rows —
     ADVICE r1)."""
     acc = Xc.dtype if Xc.dtype == jnp.float64 else jnp.float32
-    XtWX, XtWy = weighted_gramian(Xc, yc, wc, accum_dtype=acc)
+    # dispatch is static at trace time: a StructuredDesign chunk is a
+    # distinct pytree, so it keys its own (single) executable
+    XtWX, XtWy = design_gramian(Xc, yc, wc, accum_dtype=acc)
     return dict(XtWX=XtWX, XtWy=XtWy)
 
 
@@ -635,17 +684,21 @@ def _diag_inv64(factor) -> np.ndarray:
     return np.diag(scipy.linalg.cho_solve(cho, np.eye(cho[0].shape[0]))) * dinv * dinv
 
 
-def _resolve_streaming_polish(pivot: float, dtype, config) -> bool:
+def _resolve_streaming_polish(pivot: float, dtype, config,
+                              structured: bool = False) -> bool:
     """Chunk Gramians are computed in f32 on device (accumulation is host
     f64, but the per-chunk products already carry ~eps32 noise), so the
     resident fits' conditioning policy applies here too — and since r4 the
     CHUNKED TSQR polish (:func:`_streaming_csne`) can actually run, so the
-    policy escalates instead of warning (can_polish=True)."""
+    policy escalates instead of warning (can_polish=True).  Structured
+    chunk sources cannot polish (the chunked TSQR factors dense row
+    blocks), matching the resident fits' gate."""
     from .conditioning import resolve_ill_conditioning
     return resolve_ill_conditioning(
         pivot, is_f32=np.dtype(dtype) != np.float64,
-        engine="einsum", polish_active=config.polish == "csne",
-        polish_cfg=config.polish, can_polish=True, stacklevel=4)
+        engine="structured" if structured else "einsum",
+        polish_active=config.polish == "csne",
+        polish_cfg=config.polish, can_polish=not structured, stacklevel=4)
 
 
 @jax.jit
@@ -869,6 +922,7 @@ def _lm_fit_streaming_impl(
     ones_mask = None
     saw_offset = False
     saw_weights = False
+    saw_structured = False
     src_fp = None
     n = 0
     if _ck_state is not None:
@@ -876,7 +930,7 @@ def _lm_fit_streaming_impl(
         # on every process) and skip the Gramian pass below entirely.
         # The fingerprint probe's chunk 0 is handed to the next pass
         # instead of being re-parsed (_source_first_chunk).
-        src_fp, p_live, chunks = _source_first_chunk(chunks)
+        src_fp, p_live, saw_structured, chunks = _source_first_chunk(chunks)
         resume_ck.validate(_ck_state, kind="lm", fingerprint=src_fp, p=p_live)
         acc = {"XtWX": np.asarray(_ck_state["XtWX"], np.float64),
                "XtWy": np.asarray(_ck_state["XtWy"], np.float64),
@@ -903,7 +957,8 @@ def _lm_fit_streaming_impl(
         the host-f64 scalar moments.  With ``prefetch>=2`` this whole
         generator runs on the pipeline's background thread; the device
         dispatch and the deferred f64 harvest stay on the consumer."""
-        nonlocal src_fp, dtype, ones_mask, saw_offset, saw_weights, n
+        nonlocal src_fp, dtype, ones_mask, saw_offset, saw_weights, n, \
+            saw_structured
         for Xc, yc, wc, oc in _iter_chunks(chunks):
             if src_fp is None:
                 src_fp = ((int(Xc.shape[0]), int(Xc.shape[1]))
@@ -911,6 +966,11 @@ def _lm_fit_streaming_impl(
                           else _fingerprint(Xc, yc, wc, oc))
             if dtype is None:
                 dtype = _resolve_dtype(Xc, config)
+            if isinstance(Xc, StructuredDesign):
+                saw_structured = True
+                if tracer is not None and tracer.metrics is not None:
+                    tracer.metrics.counter(
+                        "streaming.structured_chunks").inc()
             if has_intercept is None:
                 cm = _ones_colmask(Xc)
                 ones_mask = cm if ones_mask is None else ones_mask & cm
@@ -978,7 +1038,10 @@ def _lm_fit_streaming_impl(
                 # harvest eagerly — one chunk in flight, simplest to debug
                 t_c = time.perf_counter()
                 fut = _traced_call(_lm_chunk_pass, tracer, "lm_gramian",
-                                   Xd, yd, wd)
+                                   Xd, yd, wd,
+                                   engine=("structured"
+                                           if isinstance(Xd, StructuredDesign)
+                                           else "einsum"))
                 pass_compute += time.perf_counter() - t_c
                 if pending is not None:
                     drain(pending)
@@ -1047,10 +1110,13 @@ def _lm_fit_streaming_impl(
     beta, cho, pivot = _solve64(acc["XtWX"], acc["XtWy"], config.jitter)
     if tracer is not None:
         tracer.emit("solve", target="cholesky64", p=int(p),
-                    seconds=time.perf_counter() - t_s)
+                    seconds=time.perf_counter() - t_s,
+                    gramian_engine=("structured" if saw_structured
+                                    else "einsum"))
     diag_inv = _diag_inv64(cho)
     if _sync_polish_decision(
-            _resolve_streaming_polish(pivot, dtype, config), nproc):
+            _resolve_streaming_polish(pivot, dtype, config,
+                                      structured=saw_structured), nproc):
         pol = _streaming_csne(chunks, beta, fam_name=None, lnk_name=None,
                               dtype=dtype, mesh=mesh, nproc=nproc)
         if pol is not None:
@@ -1205,7 +1271,8 @@ def _lm_fit_streaming_impl(
         has_offset=bool(saw_offset),
         has_weights=bool(saw_weights),
         weights_vary=bool(weights_vary),
-        resid_quantiles=resid_q)
+        resid_quantiles=resid_q,
+        gramian_engine="structured" if saw_structured else "einsum")
 
 
 def glm_fit_streaming(
@@ -1334,6 +1401,7 @@ def _glm_fit_streaming_impl(
 
     n_total = 0
     saw_offset = False
+    saw_structured = False
     dtype = None
     ones_mask = None
     pass_no = 0  # telemetry: pass index across init/irls/stats passes
@@ -1346,7 +1414,7 @@ def _glm_fit_streaming_impl(
     def device_chunks():
         """Yield (dX, dy, dw, do, n_true): cached prefix from HBM, the rest
         transferred from the host source (and offered to the cache)."""
-        nonlocal saw_offset, dtype, ones_mask, src_fp
+        nonlocal saw_offset, dtype, ones_mask, src_fp, saw_structured
         scan_now = not scanned
         yield from ccache.entries
         if ccache.complete:
@@ -1377,6 +1445,11 @@ def _glm_fit_streaming_impl(
             Xc, yc, wc, oc = _materialize(raw)
             if dtype is None:
                 dtype = _resolve_dtype(Xc, config)
+            if isinstance(Xc, StructuredDesign):
+                saw_structured = True
+                if tracer is not None and tracer.metrics is not None:
+                    tracer.metrics.counter(
+                        "streaming.structured_chunks").inc()
             if scan_now and scan_intercept:
                 cm = _ones_colmask(Xc)
                 ones_mask = cm if ones_mask is None else ones_mask & cm
@@ -1457,6 +1530,9 @@ def _glm_fit_streaming_impl(
             fut = _traced_call(_glm_chunk_pass, tracer,
                                f"glm_pass:{label}",
                                dX, dy, dw, do, b,
+                               engine=("structured"
+                                       if isinstance(dX, StructuredDesign)
+                                       else "einsum"),
                                family=fam, link=lnk, first=first,
                                fam_param=fam.param_operand())
             if pending is not None:
@@ -1530,7 +1606,7 @@ def _glm_fit_streaming_impl(
         # metadata scan re-runs naturally in the first loop pass.
         # the fingerprint probe's chunk 0 is handed straight to the first
         # loop pass instead of being re-parsed (_source_first_chunk)
-        fp_live, p_live, chunks = _source_first_chunk(chunks)
+        fp_live, p_live, saw_structured, chunks = _source_first_chunk(chunks)
         resume_ck.validate(_ck_state, kind="glm",
                            fingerprint=fp_live, p=p_live)
         src_fp = fp_live
@@ -1556,7 +1632,9 @@ def _glm_fit_streaming_impl(
         beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
         if tracer is not None:
             tracer.emit("solve", target="cholesky64", p=int(p),
-                        seconds=time.perf_counter() - t_s)
+                        seconds=time.perf_counter() - t_s,
+                        gramian_engine=("structured" if saw_structured
+                                        else "einsum"))
 
     iters = it0
     converged = False
@@ -1585,7 +1663,9 @@ def _glm_fit_streaming_impl(
         beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
         if tracer is not None:
             tracer.emit("solve", target="cholesky64", p=int(p),
-                        seconds=time.perf_counter() - t_s)
+                        seconds=time.perf_counter() - t_s,
+                        gramian_engine=("structured" if saw_structured
+                                        else "einsum"))
         if ckpt is not None:
             # post-solve state: a resume restores dev_prev=dev and this
             # beta, making its next pass exactly the uninterrupted next one
@@ -1613,7 +1693,8 @@ def _glm_fit_streaming_impl(
     ccache.bytes = 0
     ccache.open = False
     if not _null_model and _sync_polish_decision(
-            _resolve_streaming_polish(pivot, dtype, config), nproc):
+            _resolve_streaming_polish(pivot, dtype, config,
+                                      structured=saw_structured), nproc):
         # chunked TSQR + CSNE at the converged beta — the streaming
         # analogue of the resident auto-escalation (models/conditioning.py)
         pol = _streaming_csne(chunks, beta, fam_name=fam.name,
@@ -1746,4 +1827,5 @@ def _glm_fit_streaming_impl(
         converged=bool(converged), n_obs=n, n_params=p,
         dispersion_fixed=bool(fam.dispersion_fixed),
         n_shards=mesh.shape[meshlib.DATA_AXIS], tol=tol,
-        has_intercept=bool(has_intercept), has_offset=bool(saw_offset))
+        has_intercept=bool(has_intercept), has_offset=bool(saw_offset),
+        gramian_engine="structured" if saw_structured else "einsum")

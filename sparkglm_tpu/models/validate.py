@@ -25,7 +25,11 @@ def check_finite_vector(name: str, v) -> None:
 def check_finite_design(X) -> None:
     """Raise for a non-finite design matrix.  Callers run this lazily (on a
     failure path or a non-finite eta) so the happy path never pays a full
-    scan of X."""
+    scan of X.  For a structured design only the dense block can carry
+    non-finite values (level indices are integers by construction)."""
+    from ..data.structured import StructuredDesign
+    if isinstance(X, StructuredDesign):
+        X = np.asarray(X.dense)
     if not np.all(np.isfinite(X)):
         raise ValueError("NA/NaN/Inf in the design matrix — drop or impute "
                          f"missing predictors{_HINT}")

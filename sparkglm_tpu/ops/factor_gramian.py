@@ -1,0 +1,177 @@
+"""Factor-aware Gramian assembly: segment-sum kernels for categorical designs.
+
+For a design whose factor blocks are one-hot, most of ``X'WX`` is
+structurally sparse and the dense einsum (``ops/gramian.py``) pays O(n*k)
+MXU FLOPs per k-level factor for what are O(n) scatter-adds:
+
+  * factor x factor (same block) is DIAGONAL — the weighted count of each
+    level: ``segment_sum(w, idx)``;
+  * factor x dense is a per-level sum of weighted dense rows:
+    ``segment_sum(w[:, None] * D, idx)``;
+  * factor x response likewise: ``segment_sum(w * z, idx)``;
+  * factor x factor (different blocks) is the weighted contingency table,
+    one segment_sum over the joint index ``idx_f * (L_g + 1) + idx_g``;
+  * dense x dense / dense x response go through the existing einsum engine
+    unchanged.
+
+Each factor index vector stores ``L`` (one past the kept levels — the
+"trash bucket", see ``data/structured.py``) for rows with no active level;
+every segment sum here allocates ``L + 1`` segments and slices the trash
+off, so dropped-first-level rows, unseen scoring levels and zero-weight
+pad rows contribute exactly what their all-zero one-hot rows would:
+nothing.  Weight-0 inertness is inherited from the algebra — every block
+is a sum of ``w``-scaled terms — which is what keeps streaming bucket
+padding exactly inert (models/streaming.py::_bucket_pad).
+
+Sharding: under a ``"data"``-axis row-sharded mesh the segment sums are
+per-shard scatter-adds and GSPMD inserts the same psum it already inserts
+for the einsum engine's row contraction, so outputs come back replicated
+with no explicit collectives here (test-enforced: the 8-device CPU mesh
+fit matches single-device).
+
+Accumulation contract mirrors ``weighted_gramian``: products are formed at
+input precision and accumulated in ``accum_dtype``.  Accumulation ORDER
+differs from the dense einsum (scatter-add per level vs a row-major MXU
+contraction), so f32 results agree to ~eps32 * row-count noise, not
+bitwise; f64 fits agree to f64 golden-fixture tolerance (PARITY.md r10).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.structured import StructuredDesign
+from .gramian import weighted_gramian
+
+__all__ = ["structured_gramian", "structured_matvec",
+           "structured_fisher_pass", "design_gramian", "design_matvec"]
+
+_TINY = 1e-30
+
+
+def _inv_perm(layout) -> np.ndarray:
+    """xnames-order column -> block-order column (static host constant)."""
+    return np.argsort(np.asarray(layout.block_cols, np.int64))
+
+
+def structured_gramian(sd: StructuredDesign, z, w, *,
+                       accum_dtype=jnp.float32, precision=None):
+    """``(X'WX, X'Wz)`` of the dense design ``sd`` REPRESENTS, assembled
+    blockwise (same signature/contract as ``gramian.weighted_gramian``).
+    Outputs are in xnames column order."""
+    lay = sd.layout
+    D, idx = sd.dense, sd.idx
+    acc = accum_dtype
+    # dense x dense and dense x z: the existing einsum engine, unchanged
+    G_dd, b_d = weighted_gramian(D, z, w, accum_dtype=acc, precision=precision)
+    G_dd = G_dd.astype(acc)
+    b_d = b_d.astype(acc)
+    # per-row weighted operands, formed at input precision then accumulated
+    # in acc — the einsum engine's product/accumulate split
+    Dw = (D * w[:, None]).astype(acc)
+    wz = (w * z).astype(acc)
+    wa = w.astype(acc)
+    FD, diag, bz = [], [], []
+    for (_, L), ix in zip(lay.factors, idx):
+        FD.append(jax.ops.segment_sum(Dw, ix, num_segments=L + 1)[:L])
+        diag.append(jax.ops.segment_sum(wa, ix, num_segments=L + 1)[:L])
+        bz.append(jax.ops.segment_sum(wz, ix, num_segments=L + 1)[:L])
+    nf = len(lay.factors)
+    cross = {}
+    for i in range(nf):
+        Li = lay.factors[i][1]
+        for j in range(i + 1, nf):
+            Lj = lay.factors[j][1]
+            joint = idx[i] * (Lj + 1) + idx[j]
+            C = jax.ops.segment_sum(wa, joint,
+                                    num_segments=(Li + 1) * (Lj + 1))
+            cross[(i, j)] = C.reshape(Li + 1, Lj + 1)[:Li, :Lj]
+    rows = [jnp.concatenate([G_dd] + [M.T for M in FD], axis=1)]
+    for i in range(nf):
+        parts = [FD[i]]
+        for j in range(nf):
+            if j == i:
+                parts.append(jnp.diag(diag[i]))
+            elif j > i:
+                parts.append(cross[(i, j)])
+            else:
+                parts.append(cross[(j, i)].T)
+        rows.append(jnp.concatenate(parts, axis=1))
+    G_blk = jnp.concatenate(rows, axis=0)
+    b_blk = jnp.concatenate([b_d] + bz) if nf else b_d
+    inv = _inv_perm(lay)
+    return G_blk[inv][:, inv], b_blk[inv]
+
+
+def structured_matvec(sd: StructuredDesign, beta, *, precision=None):
+    """``X @ beta`` without densifying: dense matvec + one gather per
+    factor (``beta`` in xnames order; the dropped/unseen bucket gathers an
+    appended literal zero)."""
+    lay = sd.layout
+    bb = jnp.asarray(beta)[np.asarray(lay.block_cols, np.int64)]
+    eta = jnp.matmul(sd.dense, bb[:lay.n_dense], precision=precision)
+    o = lay.n_dense
+    for (_, L), ix in zip(lay.factors, sd.idx):
+        bf = jnp.concatenate([bb[o:o + L], jnp.zeros((1,), bb.dtype)])
+        eta = eta + bf[ix]
+        o += L
+    return eta
+
+
+def structured_fisher_pass(sd: StructuredDesign, y, wt, offset, beta, *,
+                           family, link, first: bool = False,
+                           precision=None, fam_param=None):
+    """Structured twin of ``ops/fused.py::fused_fisher_pass_ref`` — one
+    IRLS data pass returning ``(XtWX (p,p), XtWz (p,), dev ())`` with the
+    identical per-row math (``_step_math``) but the blockwise Gramian.
+
+    Used by the streaming engine's chunk pass; the resident IRLS kernel
+    reaches the same blocks through ``design_gramian`` inside its
+    while_loop instead.
+    """
+    family = family.with_param(fam_param)
+    valid = wt > 0.0
+    if first:
+        mu = jnp.where(valid, family.init_mu(y, jnp.maximum(wt, _TINY)), 1.0)
+        eta = link.link(mu)
+    else:
+        eta = structured_matvec(sd, beta) + offset
+        mu = jnp.where(valid, link.inverse(eta), 1.0)
+    g = link.deriv(mu)
+    var = family.variance(mu)
+    w_raw = wt / jnp.maximum(var * g * g, _TINY)
+    w = jnp.where(valid,
+                  jnp.nan_to_num(w_raw, nan=0.0, posinf=0.0, neginf=0.0), 0.0)
+    z_raw = eta - offset + (y - mu) * g
+    z = jnp.where(valid,
+                  jnp.nan_to_num(z_raw, nan=0.0, posinf=0.0, neginf=0.0), 0.0)
+    dev = jnp.sum(jnp.where(
+        valid,
+        jnp.nan_to_num(family.dev_resids(y, mu, wt),
+                       nan=0.0, posinf=0.0, neginf=0.0), 0.0))
+    acc = sd.dtype if sd.dtype == jnp.float64 else jnp.float32
+    XtWX, XtWz = structured_gramian(sd, z, w, accum_dtype=acc,
+                                    precision=precision)
+    return XtWX, XtWz, dev
+
+
+# -- engine dispatch (static at trace time: the pytree treedef keys the jit
+# cache, so a dense array and a StructuredDesign never share an executable)
+
+def design_gramian(X, z, w, *, accum_dtype=jnp.float32, precision=None):
+    """``weighted_gramian`` for dense ``X``; ``structured_gramian`` for a
+    :class:`StructuredDesign`."""
+    if isinstance(X, StructuredDesign):
+        return structured_gramian(X, z, w, accum_dtype=accum_dtype,
+                                  precision=precision)
+    return weighted_gramian(X, z, w, accum_dtype=accum_dtype,
+                            precision=precision)
+
+
+def design_matvec(X, beta, *, precision=None):
+    """``X @ beta`` for either design representation."""
+    if isinstance(X, StructuredDesign):
+        return structured_matvec(X, beta, precision=precision)
+    return jnp.matmul(X, beta, precision=precision)

@@ -99,7 +99,24 @@ def shard_rows(
     WLS core always carries a per-row weight vector, so a zero-weight row
     contributes nothing to X'WX, X'Wz, deviance, or SSE).  Callers that build
     weights themselves must use :func:`pad_mask`.
+
+    A ``StructuredDesign`` (data/structured.py) shards leaf-wise: the dense
+    block zero-pads like any matrix and each index vector pads with the
+    factor's TRASH bucket (L — sliced off every segment sum), so pad rows
+    touch no real level even before their zero weight makes every
+    contribution exactly zero (ops/factor_gramian.py).
     """
+    from ..data.structured import StructuredDesign
+    if isinstance(x, StructuredDesign):
+        if shard_features:
+            raise ValueError(
+                "structured designs cannot be feature-sharded — densify "
+                "first or use shard_features=False")
+        return StructuredDesign(
+            shard_rows(x.dense, mesh, pad_value=pad_value),
+            tuple(shard_rows(ix, mesh, pad_value=L)
+                  for (_, L), ix in zip(x.layout.factors, x.idx)),
+            x.layout)
     x = np.asarray(x)
     n = x.shape[0]
     n_pad = padded_rows(n, mesh)

@@ -37,7 +37,9 @@ import time
 import numpy as np
 
 from ..data.frame import as_columns
-from ..data.model_matrix import transform
+from ..data.model_matrix import (structured_layout, transform,
+                                 transform_structured, wants_structured)
+from ..data.structured import StructuredDesign
 from ..models.scoring import (donation_supported, predict_sharded,
                               score_kernel_cache_size)
 from ..obs.trace import emit_ambient
@@ -102,7 +104,8 @@ class Scorer:
     # -- design construction (the sg.predict contract) ----------------------
 
     def _design(self, data, offset):
-        if isinstance(data, np.ndarray) and data.ndim == 2:
+        if isinstance(data, StructuredDesign) or (
+                isinstance(data, np.ndarray) and data.ndim == 2):
             X = data
             if X.shape[1] != self.model.n_params:
                 raise ValueError(
@@ -114,7 +117,12 @@ class Scorer:
                 "model was fit from arrays, not a formula; score with an "
                 "aligned (n, p) design matrix instead of column data")
         cols = as_columns(data)
-        X = transform(cols, self.model.terms)
+        # same predicate as sg.predict: wide-factor terms score through the
+        # structured (segment/gather) representation, so served results stay
+        # bit-identical to offline predictions
+        X = (transform_structured(cols, self.model.terms)
+             if wants_structured(self.model.terms)
+             else transform(cols, self.model.terms))
         if offset is None:
             from ..api import _fit_time_offset
             offset = _fit_time_offset(self.model, cols)
@@ -183,9 +191,20 @@ class Scorer:
         p = self.model.n_params
         has_off = (getattr(self.model, "offset_col", None) is not None
                    or getattr(self.model, "has_offset", False))
+        # warm the representation live requests will use: structured when
+        # the terms want it (se_fit densifies, so it warms the dense family)
+        lay = (structured_layout(self.model.terms)
+               if (self.model.terms is not None and not self.se_fit
+                   and wants_structured(self.model.terms)) else None)
         done = []
         for b in sorted(set(int(x) for x in buckets)):
-            X = np.zeros((1, p))
+            if lay is not None:
+                X = StructuredDesign(
+                    np.zeros((1, lay.n_dense)),
+                    tuple(np.full((1,), L, np.int32)
+                          for _, L in lay.factors), lay)
+            else:
+                X = np.zeros((1, p))
             off = np.zeros(1) if has_off else None
             with self._lock:
                 predict_sharded(

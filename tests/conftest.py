@@ -32,6 +32,20 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` under JAX_PLATFORMS=cpu: everything
+    # unmarked (including the structured-design suite) is tier-1 by
+    # default.  `multichip` tags tests that exercise the 8-virtual-device
+    # mesh — they still run in tier-1 on the CPU mesh, and the marker lets
+    # real-hardware runs select them (`-m multichip`).  `slow` opts OUT of
+    # tier-1 entirely.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 command (-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "multichip: exercises a multi-device mesh (virtual CPU "
+        "devices in tier-1; selectable for real-pod runs)")
+
+
 @pytest.fixture(scope="session")
 def mesh1():
     import sparkglm_tpu as sg

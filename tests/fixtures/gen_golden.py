@@ -545,6 +545,31 @@ def main():
         fit=r_fit(X7, y7, "gaussian", "identity"),
         provenance="synthetic; oracle64-verified (not run through R); R cross-check: glm(y ~ log(u) + I(u^2), gaussian)")
 
+    # F8: categorical-heavy — a 48-level factor crosses WIDE_FACTOR_LEVELS,
+    # so design="auto" fits this case through the STRUCTURED (segment-sum)
+    # Gramian engine while the oracle stays dense one-hot f64: the golden
+    # assertion pins the structured path to the independent oracle.
+    n8 = 2400
+    lv8 = 48
+    x8 = rng.standard_normal(n8)
+    f8 = rng.integers(0, lv8, n8)
+    f8[:lv8] = np.arange(lv8)  # every level appears: deterministic coding
+    eff8 = rng.standard_normal(lv8) * 0.5
+    mu8 = np.exp(0.2 + 0.3 * x8 + eff8[f8])
+    y8 = rng.poisson(np.clip(mu8, 0, 60)).astype(float)
+    onehot8 = (f8[:, None] == np.arange(1, lv8)[None, :]).astype(float)
+    X8 = np.column_stack([np.ones(n8), x8, onehot8])
+    fcases["wide_factor_poisson"] = dict(
+        data=dict(y=y8.tolist(), x=x8.tolist(),
+                  f=[f"L{i:02d}" for i in f8]),
+        formula="y ~ x + f",
+        family="poisson", link="log",
+        xnames=["intercept", "x"] + [f"f_L{i:02d}" for i in range(1, lv8)],
+        fit=r_fit(X8, y8, "poisson", "log"),
+        provenance="synthetic; oracle64-verified (not run through R); "
+                   "48-level factor exercises the structured Gramian auto "
+                   "path; R cross-check: glm(y ~ x + f, poisson)")
+
     cases["formula_cases"] = fcases
 
     out = os.path.join(HERE, "r_golden.json")

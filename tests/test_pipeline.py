@@ -290,8 +290,9 @@ def test_first_chunk_probe_does_not_double_read(rng):
                 yield thunk
         return gen()
 
-    fp, p, wrapped = streaming._source_first_chunk(chunks)
+    fp, p, structured, wrapped = streaming._source_first_chunk(chunks)
     assert p == 3
+    assert structured is False
     assert mats[0] == 1
     got = [streaming._materialize(c) for c in wrapped()]
     # the probe's open AND materialized chunk 0 are handed to the first
