@@ -7,7 +7,8 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
-.PHONY: all native test bench robust obs pipeline serve categorical clean
+.PHONY: all native test bench robust obs pipeline serve categorical \
+        penalized clean
 
 all: native
 
@@ -49,6 +50,14 @@ serve:
 # one-hot vs segment-sum s/iter + coefficient agreement)
 categorical: native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_structured.py -q
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# penalized GLM subsystem (sparkglm_tpu/penalized): glmnet-golden parity,
+# the one-executable lambda-path contract, warm-start determinism,
+# select/serialize/serve round-trips, streaming path parity — plus the
+# regularization_path bench block (path-vs-refit speedup, <= 2 executables)
+penalized:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m penalized
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:

@@ -646,6 +646,81 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["categorical_gramian"] = dict(error=repr(e)[:300])
 
+    # ---- penalized lambda paths (sparkglm_tpu/penalized) -------------------
+    # the whole elastic-net grid is ONE executable (lambda traced through a
+    # lax.scan), so a 100-point path costs one compile + one device program,
+    # vs 100 independent single-lambda fits each paying a full cold-start
+    # IRLS.  Targets (ISSUE 6): <= 2 executables for the whole path on the
+    # wide-factor binomial shape, >= 10x over per-lambda refits.
+    try:
+        from sparkglm_tpu.data.model_matrix import (build_terms as _bt,
+                                                    transform_structured
+                                                    as _ts)
+        from sparkglm_tpu.penalized import ElasticNet as _EN
+        from sparkglm_tpu.penalized.path import _glm_path_kernel, fit_path
+
+        np_rng = np.random.default_rng(31)
+        npen, dpen, lpen, n_lam, n_refit = (
+            (65_536, 32, 512, 100, 5) if on_tpu
+            else (16_384, 8, 64, 50, 3))
+        cols_p = {f"x{i:02d}": np_rng.standard_normal(npen).astype(np.float32)
+                  for i in range(dpen)}
+        fac_p = np_rng.integers(0, lpen, npen)
+        fac_p[:lpen] = np.arange(lpen)
+        cols_p["f"] = np.array([f"c{i:04d}" for i in fac_p])
+        eta_p = (0.4 * cols_p["x00"] - 0.3 * cols_p["x01"]
+                 + 0.5 * np_rng.standard_normal(lpen).astype(np.float32)[fac_p])
+        yp = (np_rng.random(npen) < 1 / (1 + np.exp(-eta_p))).astype(np.float32)
+        terms_p = _bt(cols_p,
+                      columns=[f"x{i:02d}" for i in range(dpen)] + ["f"],
+                      intercept=True)
+        Xp = _ts(cols_p, terms_p)
+        pen = _EN(alpha=1.0, n_lambda=n_lam)
+
+        before_k = _glm_path_kernel._cache_size()
+        pm = fit_path(Xp, yp, family="binomial", penalty=pen,
+                      xnames=terms_p.xnames)  # cold: includes the compile
+        executables = _glm_path_kernel._cache_size() - before_k
+        t0 = time.perf_counter()
+        pm = fit_path(Xp, yp, family="binomial", penalty=pen,
+                      xnames=terms_p.xnames)
+        t_path = time.perf_counter() - t0
+        # refit baseline: one single-lambda fit per grid point, timed warm
+        # on a sample of the grid and extrapolated to the full path
+        lam_sample = [float(pm.lambdas[i])
+                      for i in np.linspace(0, n_lam - 1, n_refit).astype(int)]
+        fit_path(Xp, yp, family="binomial", xnames=terms_p.xnames,
+                 penalty=_EN(alpha=1.0, lambdas=[lam_sample[0]]))  # warm-up
+        t1 = time.perf_counter()
+        for lam in lam_sample:
+            fit_path(Xp, yp, family="binomial", xnames=terms_p.xnames,
+                     penalty=_EN(alpha=1.0, lambdas=[lam]))
+        t_refit_each = (time.perf_counter() - t1) / n_refit
+        t_refit_est = t_refit_each * n_lam
+        speedup = t_refit_est / t_path
+        # the >= 10x acceptance bar is for the TPU shape, where 100
+        # separate fits pay 100x dispatch + transfer + cold IRLS; the tiny
+        # CPU-fallback shape is CD-bound on both sides, so its bar is the
+        # direction-of-effect check
+        target = 10.0 if on_tpu else 2.0
+        detail["regularization_path"] = dict(
+            n=npen, numerics=dpen, levels=lpen, p=int(pm.n_params),
+            n_lambda=n_lam, alpha=1.0, engine=pm.gramian_engine,
+            executables=int(executables),
+            path_seconds=round(t_path, 4),
+            refit_seconds_each=round(t_refit_each, 4),
+            refit_seconds_est_total=round(t_refit_est, 3),
+            refits_sampled=n_refit,
+            speedup_vs_refits=round(speedup, 2),
+            speedup_target=target,
+            df_max=int(pm.df.max(initial=0)),
+            dev_ratio_max=round(float(pm.dev_ratio.max(initial=0.0)), 4),
+            converged=bool(pm.converged), kkt_clean=bool(pm.kkt_clean),
+            ok=bool(executables <= 2 and speedup >= target
+                    and pm.gramian_engine == "structured"))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["regularization_path"] = dict(error=repr(e)[:300])
+
     print(json.dumps({
         "metric": "logistic_"
                   + (f"{n // 1_000_000}M" if n >= 1_000_000 else f"{n // 1000}k")

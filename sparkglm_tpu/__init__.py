@@ -44,6 +44,7 @@ from .models.simulate import simulate
 from .models.streaming import glm_fit_streaming, lm_fit_streaming
 from .parallel import distributed
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
+from .penalized import ElasticNet, PathModel
 from .obs import FitTracer, JsonlSink, MetricsRegistry, RingBufferSink
 from .serve import BatchPolicy, MicroBatcher, ModelRegistry, Scorer
 from .utils import profiling
@@ -60,6 +61,7 @@ __all__ = [
     "read_json", "scan_json_schema", "scan_json_levels",
     "lm_fit_streaming", "glm_fit_streaming",
     "LMModel", "GLMModel", "load_model", "save_model", "simulate",
+    "ElasticNet", "PathModel",
     "anova", "add1", "drop1", "step", "AnovaTable", "confint_profile",
     "TermsPrediction",
     "hatvalues", "rstandard", "rstudent", "cooks_distance",

@@ -160,10 +160,35 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=(),
     return f, X, y, terms, cols, keep
 
 
+def _reject_penalty_args(*, mesh=None, engine="auto", beta0=None,
+                         on_iteration=None, checkpoint_every=0,
+                         retry=None, checkpoint=None, resume=False,
+                         prefetch=0):
+    """Penalized paths run their own compiled solvers; the options that
+    parameterize the unpenalized IRLS/solve machinery have no meaning
+    there.  Refuse them loudly rather than silently ignoring them."""
+    if mesh is not None:
+        raise ValueError("penalty= does not support mesh= (sharded "
+                         "penalized fits are not implemented yet)")
+    if engine not in ("auto", "einsum"):
+        raise ValueError(
+            f"penalty= requires the einsum/structured Gramian engine; "
+            f"engine={engine!r} does not apply to the penalized path")
+    if beta0 is not None or on_iteration is not None or checkpoint_every:
+        raise ValueError("penalty= does not support beta0=/on_iteration=/"
+                         "checkpoint_every= (the path warm-starts itself)")
+    if retry is not None or checkpoint is not None or resume:
+        raise ValueError("penalty= does not support retry=/checkpoint=/"
+                         "resume= yet")
+    if prefetch:
+        raise ValueError("penalty= does not support prefetch= yet (path "
+                         "passes stream sequentially)")
+
+
 def lm(formula: str, data, *, weights=None, offset=None,
        na_omit: bool = True, mesh=None,
        singular: str = "drop", engine: str = "auto", design: str = "auto",
-       trace=None, metrics=None,
+       penalty=None, trace=None, metrics=None,
        config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """R-style ``lm(formula, data)`` (ref: sparkLM, R/pkg/R/LM.R:24-44).
 
@@ -177,7 +202,12 @@ def lm(formula: str, data, *, weights=None, offset=None,
     carries factor main effects as level-index vectors and assembles the
     Gramian via segment sums (ops/factor_gramian.py); "auto" (default)
     structures exactly when a factor is wide enough to win
-    (``model_matrix.WIDE_FACTOR_LEVELS``).  Requires the einsum engine."""
+    (``model_matrix.WIDE_FACTOR_LEVELS``).  Requires the einsum engine.
+
+    ``penalty=ElasticNet(...)`` fits the elastic-net lambda path instead
+    and returns a :class:`~sparkglm_tpu.penalized.PathModel` (glmnet
+    semantics — PARITY.md r11); ``penalty=None`` is the exact unpenalized
+    fit, bit-identical to before the option existed."""
     f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
                                          dtype=np.dtype(config.dtype),
                                          extra_cols=(weights, offset),
@@ -192,6 +222,20 @@ def lm(formula: str, data, *, weights=None, offset=None,
     elif weights is not None:
         weights = _subset_extra(weights, keep, "weights")
     off_arr = _assemble_offset(f, cols, keep, offset)
+    if penalty is not None:
+        _reject_penalty_args(mesh=mesh, engine=engine)
+        from .penalized import path as _pen_path
+        import dataclasses
+        pm = _pen_path.fit_path(
+            X, y, family="gaussian", weights=weights, offset=off_arr,
+            penalty=penalty, xnames=terms.xnames, yname=f.response,
+            has_intercept=f.intercept, kind="lm", trace=trace,
+            metrics=metrics, config=config)
+        return dataclasses.replace(
+            pm, formula=str(f), terms=terms,
+            offset_col=_offset_col_value(f, offset),
+            weights_col=weights_arg if isinstance(weights_arg, str) else None,
+            has_weights=weights_arg is not None)
     model = lm_mod.fit(
         X, y, weights=weights, offset=off_arr, xnames=terms.xnames,
         yname=f.response,
@@ -211,7 +255,7 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         engine: str = "auto", singular: str = "drop", design: str = "auto",
         verbose: bool = False,
         beta0=None, on_iteration=None, checkpoint_every: int = 0,
-        trace=None, metrics=None,
+        penalty=None, trace=None, metrics=None,
         config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """R-style ``glm(formula, data, family, link, ...)``.
 
@@ -221,7 +265,12 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
     compiled IRLS in segments for checkpoint/resume (models/glm.py).
     ``design`` chooses the design representation ("dense" | "structured" |
     "auto" — see :func:`lm`); structured designs run the segment-sum
-    Gramian engine and require ``engine`` to resolve to einsum."""
+    Gramian engine and require ``engine`` to resolve to einsum.
+
+    ``penalty=ElasticNet(...)`` fits the elastic-net lambda path instead
+    and returns a :class:`~sparkglm_tpu.penalized.PathModel` (glmnet
+    semantics — PARITY.md r11); ``penalty=None`` is the exact unpenalized
+    fit, bit-identical to before the option existed."""
     f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
                                          dtype=np.dtype(config.dtype),
                                          extra_cols=(weights, offset, m),
@@ -241,6 +290,28 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         yname = f"cbind({f.response}, {f.response2})"
 
     off_arr = _assemble_offset(f, cols, keep, offset)
+    if penalty is not None:
+        _reject_penalty_args(mesh=mesh, engine=engine, beta0=beta0,
+                             on_iteration=on_iteration,
+                             checkpoint_every=checkpoint_every)
+        from .penalized import path as _pen_path
+        import dataclasses
+        pm = _pen_path.fit_path(
+            X, y, family=family, link=link,
+            weights=_col_or_subset(cols, keep, weights, "weights"),
+            offset=off_arr,
+            m=(m if f.response2 is not None
+               else _col_or_subset(cols, keep, m, "m")),
+            penalty=penalty, xnames=terms.xnames, yname=yname,
+            has_intercept=f.intercept, kind="glm", verbose=verbose,
+            trace=trace, metrics=metrics, config=config)
+        return dataclasses.replace(
+            pm, formula=str(f), terms=terms,
+            offset_col=_offset_col_value(f, offset),
+            weights_col=weights_arg if isinstance(weights_arg, str) else None,
+            m_col=m_arg if isinstance(m_arg, str) else None,
+            has_weights=weights_arg is not None,
+            has_m=m_arg is not None)
     model = glm_mod.fit(
         X, y, family=family, link=link,
         weights=_col_or_subset(cols, keep, weights, "weights"),
@@ -479,7 +550,8 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
                  verbose: bool = False,
                  beta0=None, on_iteration=None, native: bool | None = None,
                  backend: str = "auto", retry=None, checkpoint=None,
-                 resume=False, trace=None, metrics=None, prefetch: int = 0,
+                 resume=False, penalty=None, trace=None, metrics=None,
+                 prefetch: int = 0,
                  config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """Fit a GLM by formula straight from a CSV too big to load.
 
@@ -529,6 +601,25 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
 
     yname = (f"cbind({f.response}, {f.response2})"
              if f.response2 is not None else f.response)
+    if penalty is not None:
+        _reject_penalty_args(mesh=mesh, beta0=beta0,
+                             on_iteration=on_iteration, retry=retry,
+                             checkpoint=checkpoint, resume=resume,
+                             prefetch=prefetch)
+        from .penalized import stream as _pen_stream
+        import dataclasses
+        try:
+            pm = _pen_stream.glm_path_streaming(
+                source, family=family, link=link, penalty=penalty,
+                xnames=terms.xnames, yname=yname,
+                has_intercept=f.intercept, verbose=verbose, trace=trace,
+                metrics=metrics, config=config)
+        finally:
+            parse_cleanup()
+        return dataclasses.replace(
+            pm, formula=str(f), terms=terms,
+            offset_col=_offset_col_value(f, offset),
+            weights_col=weights, has_weights=weights is not None)
     try:
         model = streaming.glm_fit_streaming(
             source, family=family, link=link, tol=tol, max_iter=max_iter,
@@ -550,7 +641,8 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
                 na_omit: bool = True, chunk_bytes: int = 256 << 20,
                 mesh=None, native: bool | None = None, parse_cache="auto",
                 backend: str = "auto", retry=None, checkpoint=None,
-                resume=False, trace=None, metrics=None, prefetch: int = 0,
+                resume=False, penalty=None, trace=None, metrics=None,
+                prefetch: int = 0,
                 config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """OLS/WLS by formula straight from a CSV too big to load (two
     streaming passes: Gramian accumulation, then the exact host-f64
@@ -583,6 +675,22 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
         for i in range(num_chunks):
             yield lambda i=i: extract(i)
 
+    if penalty is not None:
+        _reject_penalty_args(mesh=mesh, retry=retry, checkpoint=checkpoint,
+                             resume=resume, prefetch=prefetch)
+        from .penalized import stream as _pen_stream
+        import dataclasses
+        try:
+            pm = _pen_stream.lm_path_streaming(
+                source, penalty=penalty, xnames=terms.xnames,
+                yname=f.response, has_intercept=f.intercept, trace=trace,
+                metrics=metrics, config=config)
+        finally:
+            parse_cleanup()
+        return dataclasses.replace(
+            pm, formula=str(f), terms=terms, weights_col=weights,
+            offset_col=_offset_col_value(f, offset),
+            has_weights=weights is not None)
     try:
         model = streaming.lm_fit_streaming(
             source, xnames=terms.xnames, yname=f.response,

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.structured import StructuredDesign
-from ..ops.factor_gramian import design_matvec
+from ..ops.factor_gramian import design_matvec, structured_quadform
 from ..parallel import mesh as meshlib
 
 _SCORE_STATICS = ("inverse", "deriv", "want_se", "response", "has_offset",
@@ -54,17 +54,22 @@ def _score_fn(X, beta, offset, V, *, inverse=None, deriv=None,
 
     ``X`` may be a :class:`StructuredDesign` (a pytree, so it keys its own
     executables inside the same jit caches): eta becomes the dense matvec
-    plus one gather per factor.  ``want_se`` never sees a structured X —
-    ``predict_sharded`` densifies first (the quadform has no structured
-    form short of per-block expansion, and se.fit is a small-batch path)."""
+    plus one gather per factor, and the se quadform runs blockwise
+    (``structured_quadform``: dense-block matmul + per-factor row/column
+    gathers of V — a 512-level factor no longer forces an (n, p) one-hot
+    materialization just to read diag(X V X'))."""
     eta = design_matvec(X, beta, precision=jax.lax.Precision.HIGHEST)
     if has_offset:
         eta = eta + offset
     fit = inverse(eta) if (response and inverse is not None) else eta
     if not want_se:
         return (fit,)
-    XV = jnp.matmul(X, V, precision=quad_precision)     # (n, p) MXU
-    se = jnp.sqrt(jnp.maximum(jnp.sum(XV * X, axis=1), 0.0))
+    if isinstance(X, StructuredDesign):
+        q = structured_quadform(X, V, precision=quad_precision)
+    else:
+        XV = jnp.matmul(X, V, precision=quad_precision)  # (n, p) MXU
+        q = jnp.sum(XV * X, axis=1)
+    se = jnp.sqrt(jnp.maximum(q, 0.0))
     if response and deriv is not None:
         # delta method: se_response = se_link / |g'(mu)| (models/glm.py
         # host twin; R's predict.glm(se.fit=TRUE, type="response"))
@@ -106,8 +111,9 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
 
     Args:
       X: (n, p) host design aligned to the model's xnames — a dense
-        matrix or a ``StructuredDesign`` (which scores without one-hot
-        materialization; ``se_fit`` densifies it first).
+        matrix or a ``StructuredDesign``, which scores without one-hot
+        materialization for BOTH the fit and the se quadform
+        (``ops/factor_gramian.structured_quadform``).
       coefficients: (p,) — NaN (aliased) entries contribute nothing
         (R's reduced-basis prediction).
       mesh: score over a device mesh as one row-sharded SPMD pass; None
@@ -131,11 +137,6 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
     from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
 
     structured = isinstance(X, StructuredDesign)
-    if structured and se_fit:
-        # the se quadform walks X@V column-wise — no structured form; se.fit
-        # requests are small batches, so the one-hot expansion is cheap
-        X = X.densify()
-        structured = False
     if not structured:
         X = np.asarray(X)
     n, p = X.shape

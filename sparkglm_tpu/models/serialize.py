@@ -35,6 +35,10 @@ def _split(model) -> tuple[dict, dict]:
             arrays[f.name] = v
         elif f.name == "terms" and v is not None:
             meta["terms"] = v.to_dict() if hasattr(v, "to_dict") else None
+        elif f.name == "penalty" and v is not None:
+            # a PathModel's ElasticNet spec: a frozen dataclass of JSON-able
+            # scalars/tuples — stored as its field dict
+            meta["penalty"] = dataclasses.asdict(v)
         elif isinstance(v, tuple):
             meta[f.name] = list(v)
         else:
@@ -52,6 +56,7 @@ def save_model(model, path: str) -> None:
 
 
 def load_model(path: str):
+    from ..penalized.model import PathModel
     from .glm import GLMModel
     from .lm import LMModel
 
@@ -61,7 +66,8 @@ def load_model(path: str):
     cls_name = meta.pop("__class__", None)
     fmt = meta.pop("__format__", 1)
     schema = int(meta.pop("schema_version", fmt))
-    classes = {"LMModel": LMModel, "GLMModel": GLMModel}
+    classes = {"LMModel": LMModel, "GLMModel": GLMModel,
+               "PathModel": PathModel}
     if cls_name not in classes:
         raise ValueError(
             f"{path!r} is not a sparkglm model artifact (header class "
@@ -89,6 +95,10 @@ def load_model(path: str):
         meta["terms"] = Terms.from_dict(terms_meta)
     else:
         meta["terms"] = None
+    pen_meta = meta.pop("penalty", None)
+    if pen_meta is not None:
+        from ..penalized.penalty import ElasticNet
+        meta["penalty"] = ElasticNet(**pen_meta)
     field_names = {f.name for f in dataclasses.fields(cls)}
     kwargs = {k: v for k, v in meta.items() if k in field_names}
     for k in ("xnames",):

@@ -45,8 +45,9 @@ import numpy as np
 from ..data.structured import StructuredDesign
 from .gramian import weighted_gramian
 
-__all__ = ["structured_gramian", "structured_matvec",
-           "structured_fisher_pass", "design_gramian", "design_matvec"]
+__all__ = ["structured_gramian", "structured_matvec", "structured_colsum",
+           "structured_quadform", "structured_fisher_pass",
+           "design_gramian", "design_matvec", "design_colsum"]
 
 _TINY = 1e-30
 
@@ -120,6 +121,56 @@ def structured_matvec(sd: StructuredDesign, beta, *, precision=None):
     return eta
 
 
+def structured_colsum(sd: StructuredDesign, r, *,
+                      accum_dtype=jnp.float32, precision=None):
+    """``X' r`` (per-column sums against a row vector) without densifying:
+    dense einsum + one segment_sum per factor.  Output in xnames order.
+    Used by the penalized path's lambda_max gradient (``X'Wz``, ``X'W1``)."""
+    lay = sd.layout
+    acc = accum_dtype
+    c_d = jnp.einsum("np,n->p", sd.dense, r, preferred_element_type=acc,
+                     precision=precision)
+    parts = [c_d.astype(acc)]
+    ra = r.astype(acc)
+    for (_, L), ix in zip(lay.factors, sd.idx):
+        parts.append(jax.ops.segment_sum(ra, ix, num_segments=L + 1)[:L])
+    return jnp.concatenate(parts)[_inv_perm(lay)]
+
+
+def structured_quadform(sd: StructuredDesign, V, *, precision=None):
+    """Per-row quadratic forms ``q_i = x_i' V x_i`` without densifying.
+
+    The scoring path's se_fit needs ``diag(X V X')`` against the (p, p)
+    unscaled-vcov factor; densifying a wide-factor design to get it undoes
+    exactly what StructuredDesign exists for.  Instead: permute ``V`` to
+    block order, form ``M = X V`` structurally (dense matmul for the dense
+    block, a row gather of ``V``'s factor rows per factor — each one-hot
+    row of the block picks one row of ``V``), then the row-wise dot
+    ``q_i = M_i . x_i`` the same way (dense multiply-sum + one column
+    gather of ``M`` per factor).  Trash-bucket rows gather appended zeros,
+    matching their all-zero one-hot rows.  O(n(p*d + p*nf)) instead of the
+    densified O(n*p^2) with an (n, p) materialisation."""
+    lay = sd.layout
+    bc = np.asarray(lay.block_cols, np.int64)
+    Vb = jnp.asarray(V)[bc][:, bc]  # both axes to block order
+    d = lay.n_dense
+    M = jnp.matmul(sd.dense, Vb[:d, :], precision=precision)  # (n, p)
+    o = d
+    for (_, L), ix in zip(lay.factors, sd.idx):
+        Vf = jnp.concatenate([Vb[o:o + L, :],
+                              jnp.zeros((1, Vb.shape[1]), Vb.dtype)])
+        M = M + Vf[ix]
+        o += L
+    q = jnp.sum(M[:, :d] * sd.dense, axis=1)
+    o = d
+    for (_, L), ix in zip(lay.factors, sd.idx):
+        Mf = jnp.concatenate([M[:, o:o + L],
+                              jnp.zeros((M.shape[0], 1), M.dtype)], axis=1)
+        q = q + jnp.take_along_axis(Mf, ix[:, None], axis=1)[:, 0]
+        o += L
+    return q
+
+
 def structured_fisher_pass(sd: StructuredDesign, y, wt, offset, beta, *,
                            family, link, first: bool = False,
                            precision=None, fam_param=None):
@@ -175,3 +226,12 @@ def design_matvec(X, beta, *, precision=None):
     if isinstance(X, StructuredDesign):
         return structured_matvec(X, beta, precision=precision)
     return jnp.matmul(X, beta, precision=precision)
+
+
+def design_colsum(X, r, *, accum_dtype=jnp.float32, precision=None):
+    """``X' r`` for either design representation."""
+    if isinstance(X, StructuredDesign):
+        return structured_colsum(X, r, accum_dtype=accum_dtype,
+                                 precision=precision)
+    return jnp.einsum("np,n->p", X, r, preferred_element_type=accum_dtype,
+                      precision=precision)

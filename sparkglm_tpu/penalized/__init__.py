@@ -1,0 +1,24 @@
+"""Penalized GLMs: elastic-net lambda paths compiled as one executable.
+
+The subsystem behind ``penalty=ElasticNet(...)`` on the ``lm``/``glm``
+and ``*_from_csv`` front-ends (ROADMAP item 2; glmnet is the behavioral
+oracle — PARITY.md r11 documents the correspondence and tolerances).
+
+  * ``penalty.py``  — the :class:`ElasticNet` spec (alpha mix, lambda
+    grid request, standardization, penalty factors, solver tolerances).
+  * ``path.py``     — the compiled kernels: one-executable lax.scan
+    lambda path with traced lambda, strong-rule screening + KKT
+    verification, warm starts; Gramian-level gaussian path; the
+    single-solve kernel the streaming driver reuses.
+  * ``stream.py``   — out-of-core paths: penalization operates on the
+    ACCUMULATED X'WX / X'Wz, so the chunked streaming engine's passes
+    feed the same solvers.
+  * ``model.py``    — :class:`PathModel` (coefficients over lambda, df,
+    deviance explained) and ``select()`` back to ordinary models.
+"""
+
+from .model import PathModel
+from .path import fit_path
+from .penalty import ElasticNet
+
+__all__ = ["ElasticNet", "PathModel", "fit_path"]

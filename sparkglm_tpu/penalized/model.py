@@ -1,0 +1,205 @@
+"""PathModel — the fitted elastic-net lambda path.
+
+A frozen record of the whole regularization path (coefficient matrix over
+the descending lambda grid, per-lambda df / deviance / deviance-ratio)
+plus :meth:`PathModel.select`, which collapses one path point into an
+ORDINARY fitted model (:class:`~sparkglm_tpu.models.lm.LMModel` /
+:class:`~sparkglm_tpu.models.glm.GLMModel`).  Selection is the bridge to
+the rest of the system: a selected model predicts, serializes
+(models/serialize.py — PathModel itself round-trips too), registers and
+serves (serve/) exactly like an unpenalized fit.
+
+Penalized models carry NO sampling-theory inference: std_errors are NaN
+and ``cov_unscaled`` is None (the lasso's post-selection distribution is
+not the Wald one), and GLM ``loglik``/``aic`` are NaN — the ``criterion=``
+options of :meth:`select` use the standard path heuristics
+``deviance + 2 df`` / ``deviance + log(n) df`` instead (documented in
+PARITY.md r11), where df counts nonzero penalized coefficients plus the
+intercept.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["PathModel"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PathModel:
+    """Fitted elastic-net lambda path (largest lambda first)."""
+
+    lambdas: np.ndarray          # (n_lambda,) descending
+    alpha: float
+    coefficients: np.ndarray     # (n_lambda, p) on the ORIGINAL scale
+    df: np.ndarray               # (n_lambda,) nonzero penalized coefs
+    deviance: np.ndarray         # (n_lambda,) raw-weight deviance
+    dev_ratio: np.ndarray        # (n_lambda,) 1 - deviance/null_deviance
+    null_deviance: float
+    family: str
+    link: str
+    xnames: tuple
+    yname: str
+    n_obs: int
+    n_ok: int                    # weights > 0 row count (R's "good" rows)
+    n_params: int
+    has_intercept: bool
+    standardize: bool
+    penalty: object              # the ElasticNet spec that produced this
+    converged: bool
+    kkt_clean: bool              # no unresolved strong-rule violations
+    iterations: int              # total IRLS iterations over the path
+    dispersion_fixed: bool | None = None
+    kind: str = "glm"            # "lm" | "glm": what select() builds
+    formula: str | None = None
+    terms: object | None = None
+    has_offset: bool = False
+    offset_col: str | None = None
+    weights_col: str | None = None
+    m_col: str | None = None
+    has_weights: bool = False
+    has_m: bool = False
+    fit_info: dict | None = None
+    gramian_engine: str | None = None
+
+    # -- path accessors ----------------------------------------------------
+
+    def __len__(self) -> int:
+        return int(len(self.lambdas))
+
+    def lambda_index(self, lambda_: float) -> int:
+        """Nearest grid index to ``lambda_`` (log-scale distance, matching
+        the grid's geometry)."""
+        lam = float(lambda_)
+        if not np.isfinite(lam) or lam < 0:
+            raise ValueError(f"lambda_ must be finite and >= 0, got {lambda_!r}")
+        grid = np.maximum(np.asarray(self.lambdas, np.float64), 1e-300)
+        return int(np.argmin(np.abs(np.log(grid) - np.log(max(lam, 1e-300)))))
+
+    def coef(self, lambda_: float | None = None) -> np.ndarray:
+        """The (n_lambda, p) coefficient matrix, or the row nearest a
+        specific ``lambda_``."""
+        if lambda_ is None:
+            return self.coefficients
+        return self.coefficients[self.lambda_index(lambda_)]
+
+    def criterion_values(self, criterion: str = "aic") -> np.ndarray:
+        """Per-lambda selection scores: ``deviance + k * df_total`` with
+        k = 2 (aic) or log(n_ok) (bic) — the glmnet-style path heuristic,
+        NOT a likelihood-exact information criterion (PARITY.md r11)."""
+        if criterion not in ("aic", "bic"):
+            raise ValueError(
+                f"criterion must be 'aic' or 'bic', got {criterion!r}")
+        k = 2.0 if criterion == "aic" else float(np.log(max(self.n_ok, 2)))
+        df_total = self.df.astype(np.float64) + (1.0 if self.has_intercept
+                                                 else 0.0)
+        return np.asarray(self.deviance, np.float64) + k * df_total
+
+    # -- selection ---------------------------------------------------------
+
+    def select(self, lambda_: float | None = None,
+               criterion: str | None = None):
+        """Collapse one path point into an ordinary fitted model.
+
+        Exactly one of ``lambda_`` (nearest grid point) or ``criterion``
+        (``"aic"`` | ``"bic"``, minimized over the path) must be given.
+        The result is a plain :class:`LMModel`/:class:`GLMModel` —
+        predict/serialize/registry/Scorer all apply — with NaN standard
+        errors (no post-selection inference) and the selection recorded
+        in ``fit_info["penalized"]``."""
+        if (lambda_ is None) == (criterion is None):
+            raise ValueError(
+                "pass exactly one of lambda_= or criterion='aic'|'bic'")
+        if lambda_ is not None:
+            i = self.lambda_index(lambda_)
+        else:
+            i = int(np.argmin(self.criterion_values(criterion)))
+        return self._model_at(i, criterion=criterion)
+
+    def _model_at(self, i: int, criterion: str | None = None):
+        p = int(self.n_params)
+        beta = np.asarray(self.coefficients[i], np.float64)
+        nan_se = np.full(p, np.nan)
+        df_used = int(self.df[i]) + (1 if self.has_intercept else 0)
+        df_resid = max(int(self.n_ok) - df_used, 0)
+        sel_info = {
+            "penalized": {
+                "alpha": float(self.alpha),
+                "lambda": float(self.lambdas[i]),
+                "lambda_index": int(i),
+                "n_lambda": int(len(self.lambdas)),
+                "criterion": criterion,
+                "df": int(self.df[i]),
+                "dev_ratio": float(self.dev_ratio[i]),
+                "standardize": bool(self.standardize),
+            }
+        }
+        common = dict(
+            coefficients=beta, std_errors=nan_se, xnames=tuple(self.xnames),
+            yname=self.yname, n_obs=int(self.n_obs), n_params=p,
+            has_intercept=bool(self.has_intercept), n_shards=1,
+            cov_unscaled=None, formula=self.formula, terms=self.terms,
+            offset_col=self.offset_col, has_offset=bool(self.has_offset),
+            weights_col=self.weights_col, has_weights=bool(self.has_weights),
+            fit_info=sel_info, gramian_engine=self.gramian_engine)
+        if self.kind == "lm":
+            from ..models.lm import LMModel
+            sse = float(self.deviance[i])
+            sst = float(self.null_deviance)
+            r2 = float(self.dev_ratio[i])
+            dfm = max(df_used - (1 if self.has_intercept else 0), 0)
+            sigma = float(np.sqrt(sse / df_resid)) if df_resid > 0 else float("nan")
+            adj = (1.0 - (1.0 - r2) * (self.n_ok - (1 if self.has_intercept
+                                                    else 0)) / df_resid
+                   if df_resid > 0 else float("nan"))
+            return LMModel(df_model=dfm, df_resid=df_resid, sse=sse,
+                           sst=sst, r_squared=r2, adj_r_squared=float(adj),
+                           sigma=sigma, f_statistic=float("nan"), **common)
+        from ..models.glm import GLMModel
+        disp = 1.0 if self.dispersion_fixed else float("nan")
+        return GLMModel(
+            family=self.family, link=self.link,
+            deviance=float(self.deviance[i]),
+            null_deviance=float(self.null_deviance),
+            pearson_chi2=float("nan"), loglik=float("nan"),
+            aic=float("nan"), dispersion=disp, df_residual=df_resid,
+            df_null=int(self.n_ok) - (1 if self.has_intercept else 0),
+            iterations=int(self.iterations), converged=bool(self.converged),
+            tol=float(self.penalty.tol if self.penalty is not None else 1e-7),
+            dispersion_fixed=self.dispersion_fixed, m_col=self.m_col,
+            has_m=bool(self.has_m), **common)
+
+    # -- reporting ---------------------------------------------------------
+
+    def fit_report(self) -> dict:
+        """Path-level fit telemetry: the tracer aggregate (when the fit ran
+        traced) plus the path block (lambda range, total IRLS iterations,
+        CD sweeps, compile count)."""
+        rep = {
+            "model": f"penalized_{self.kind}", "family": self.family,
+            "link": self.link, "alpha": float(self.alpha),
+            "n_lambda": int(len(self.lambdas)),
+            "lambda_max": float(self.lambdas[0]) if len(self.lambdas) else None,
+            "lambda_min": float(self.lambdas[-1]) if len(self.lambdas) else None,
+            "df_max": int(self.df.max(initial=0)),
+            "dev_ratio_max": float(np.max(self.dev_ratio, initial=0.0)),
+            "iterations": int(self.iterations),
+            "converged": bool(self.converged),
+            "kkt_clean": bool(self.kkt_clean),
+            "n_obs": int(self.n_obs), "n_params": int(self.n_params),
+            "gramian_engine": self.gramian_engine,
+        }
+        if self.fit_info:
+            rep.update(self.fit_info)
+        return rep
+
+    def __repr__(self) -> str:
+        lam0 = float(self.lambdas[0]) if len(self.lambdas) else float("nan")
+        lam1 = float(self.lambdas[-1]) if len(self.lambdas) else float("nan")
+        return (f"PathModel({self.kind}, family={self.family!r}, "
+                f"alpha={self.alpha:g}, n_lambda={len(self.lambdas)}, "
+                f"lambda=[{lam0:.4g} .. {lam1:.4g}], "
+                f"df_max={int(self.df.max(initial=0))}, "
+                f"dev_ratio_max={float(np.max(self.dev_ratio, initial=0.0)):.4f})")

@@ -1,0 +1,703 @@
+"""Elastic-net lambda-path kernels: the whole path as ONE executable.
+
+The subsystem's fitting core (ROADMAP item 2; glmnet as the behavioral
+oracle, PAPERS.md arXiv 1902.06391 for IRLS-with-l1 convergence).  Three
+compiled kernels:
+
+  * :func:`_glm_path_kernel` — the general resident path.  A single jit
+    holds the standardization-stats Gramian, the intercept-only null IRLS
+    (O(n) per iteration — no p x p work), the data-derived lambda_max and
+    automatic log grid, and a ``lax.scan`` over the DESCENDING lambda grid
+    with lambda as a traced scalar.  Each scan step warm-starts from the
+    previous solution, screens with the sequential strong rule, runs IRLS
+    (working response -> weighted Gramian -> coordinate descent on the
+    standardized normal equations), and re-checks the KKT conditions of
+    screened-out coordinates, re-solving with violators admitted (bounded
+    rounds).  A 100-point path therefore costs ~100 extra solves and ZERO
+    extra compiles — the one-executable contract tests assert the jit
+    cache-size delta, as ``data/pipeline.py`` does for streaming chunks.
+  * :func:`_gram_path_kernel` — the gaussian/identity path on an already
+    ACCUMULATED Gramian ``(X'WX, X'Wz)``.  The quadratic objective never
+    re-weights, so the data is touched once (resident: one stats kernel;
+    streaming: one chunk-accumulation pass) and the whole path is p x p
+    work.  This is what makes out-of-core lm paths one-data-pass.
+  * :func:`_cd_solve_kernel` — one standardized elastic-net solve with
+    lambda traced, the inner step of the streaming GLM path driver
+    (``penalized/stream.py``), which must interleave host-side chunk
+    passes with device solves and so cannot fuse the scan.
+
+Solver semantics (PARITY.md r11): prior weights are normalized to sum 1,
+making every Gramian an observation-average — the objective is glmnet's
+
+    sum_i (w_i / sum w) nll_i + lambda sum_j pf_j (alpha |b_j|
+                                                   + (1 - alpha)/2 b_j^2)
+
+Columns are standardized by the weighted standard deviation about the
+weighted mean (1/n denominator) but NOT centered: with an unpenalized
+intercept the centered and uncentered problems have identical penalized
+coefficients (the intercept absorbs the shift), and skipping centering
+keeps StructuredDesign factor blocks one-gather sparse.  Coefficients
+return on the ORIGINAL scale.  Coordinate updates are the classic
+covariance-form soft-threshold:
+
+    b_j <- S(g_j, lambda alpha pf_j) / (A_jj + lambda (1-alpha) pf_j),
+    g_j = b_s[j] - (A_s b)_j + A_s[j,j] b_j
+
+with ``A_s = D (X'WX) D``, ``b_s = D X'Wz``, ``D = diag(1/sd)`` (all on
+normalized weights).  IRLS outer convergence is glmnet's
+``max_j A_jj (db_j)^2 < tol``; the CD sweeps share the same functional.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import trace as _obs_trace
+from ..ops.factor_gramian import design_colsum, design_gramian, design_matvec
+
+__all__ = ["fit_path"]
+
+_TINY = 1e-30
+_NULL_MAX_ITER = 50
+_NULL_TOL = 1e-9          # relative ddev; the null fit is O(n) per iteration
+_KKT_ROUNDS = 3           # violator-admission re-solves per lambda
+_ALPHA_FLOOR = 1e-3       # glmnet's lambda_max guard as alpha -> 0 (ridge)
+_SD_FLOOR = 1e-10         # below this a column is constant: sd forced to 1
+
+
+def _soft(x, t):
+    """Soft-threshold S(x, t) = sign(x) max(|x| - t, 0)."""
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
+
+
+def _work(y, wt, wp, off, eta, mu, family, link):
+    """One IRLS re-weighting: working weights/response on the NORMALIZED
+    prior weights ``wp`` (they feed the averaged Gramian), deviance on the
+    RAW weights ``wt`` (it is reported next to unpenalized fits).  Per-row
+    sanitization mirrors ``ops/factor_gramian.structured_fisher_pass``."""
+    valid = wt > 0.0
+    g = link.deriv(mu)
+    var = family.variance(mu)
+    w_raw = wp / jnp.maximum(var * g * g, _TINY)
+    w = jnp.where(valid,
+                  jnp.nan_to_num(w_raw, nan=0.0, posinf=0.0, neginf=0.0), 0.0)
+    z_raw = eta - off + (y - mu) * g
+    z = jnp.where(valid,
+                  jnp.nan_to_num(z_raw, nan=0.0, posinf=0.0, neginf=0.0), 0.0)
+    dev = jnp.sum(jnp.where(
+        valid,
+        jnp.nan_to_num(family.dev_resids(y, mu, wt),
+                       nan=0.0, posinf=0.0, neginf=0.0), 0.0))
+    return w, z, dev
+
+
+def _cd_solve(As, bs, beta0, lam, alpha, pf, mask, cd_tol, cd_max_sweeps):
+    """Cyclic coordinate descent on the standardized normal equations,
+    restricted to ``mask`` (screened-out coordinates stay exactly 0).
+    Returns ``(beta, sweeps, last_delta)``."""
+    acc = As.dtype
+    diag = jnp.diag(As)
+    l1 = (lam * alpha * pf).astype(acc)
+    denom = jnp.maximum(diag + lam * (1.0 - alpha) * pf, _TINY).astype(acc)
+    beta_start = jnp.where(mask, beta0, 0.0).astype(acc)
+    p = bs.shape[0]
+
+    def coord(j, bt):
+        gj = bs[j] - As[j] @ bt + diag[j] * bt[j]
+        bj = _soft(gj, l1[j]) / denom[j]
+        return bt.at[j].set(jnp.where(mask[j], bj, bt[j]))
+
+    def sweep(s):
+        bnew = jax.lax.fori_loop(0, p, coord, s["beta"])
+        d = jnp.max(diag * (bnew - s["beta"]) ** 2)
+        return dict(beta=bnew, delta=d, sweeps=s["sweeps"] + 1)
+
+    def cond(s):
+        return (s["sweeps"] == 0) | ((s["delta"] > cd_tol)
+                                     & (s["sweeps"] < cd_max_sweeps))
+
+    out = jax.lax.while_loop(cond, sweep, dict(
+        beta=beta_start, delta=jnp.asarray(jnp.inf, acc),
+        sweeps=jnp.zeros((), jnp.int32)))
+    return out["beta"], out["sweeps"], out["delta"]
+
+
+def _null_model(y, wt, wp, off, valid, family, link, icol, acc):
+    """Intercept-only IRLS (scalar normal equation, O(n)/iteration).
+    Returns ``(b0, null_dev, w, z)`` with the working vectors at the null
+    solution — the lambda_max gradient needs them."""
+    mu0 = jnp.where(valid, family.init_mu(y, jnp.maximum(wt, _TINY)), 1.0)
+    eta0 = link.link(mu0)
+    w0, z0, dev0 = _work(y, wt, wp, off, eta0, mu0, family, link)
+    if icol is None:
+        # no intercept: the null model is eta = offset, beta = 0
+        mu = jnp.where(valid, link.inverse(off), 1.0)
+        w, z, dev = _work(y, wt, wp, off, off, mu, family, link)
+        return jnp.zeros((), acc), dev.astype(acc), w, z
+
+    def body(s):
+        b0 = jnp.sum(s["w"] * s["z"]) / jnp.maximum(jnp.sum(s["w"]), _TINY)
+        eta = b0 + off
+        mu = jnp.where(valid, link.inverse(eta), 1.0)
+        w, z, dev = _work(y, wt, wp, off, eta, mu, family, link)
+        return dict(b0=b0.astype(acc), w=w, z=z, dev=dev.astype(acc),
+                    ddev=jnp.abs(dev - s["dev"]).astype(acc),
+                    it=s["it"] + 1)
+
+    def cond(s):
+        return (s["it"] == 0) | (
+            (s["ddev"] > _NULL_TOL * (jnp.abs(s["dev"]) + 0.1))
+            & (s["it"] < _NULL_MAX_ITER))
+
+    s = jax.lax.while_loop(cond, body, dict(
+        b0=jnp.zeros((), acc), w=w0, z=z0, dev=dev0.astype(acc),
+        ddev=jnp.asarray(jnp.inf, acc), it=jnp.zeros((), jnp.int32)))
+    return s["b0"], s["dev"], s["w"], s["z"]
+
+
+def _emit_path_point(k, lam, df, dev, iters, sweeps) -> None:
+    """``jax.debug.callback`` target: one ``path_point`` + one ``solve``
+    event per lambda, routed through the ambient tracer (obs/trace.py)."""
+    tr = _obs_trace.current_tracer()
+    if tr is not None:
+        tr.emit("path_point", index=int(k), lambda_=float(lam), df=int(df),
+                deviance=float(dev), iters=int(iters), sweeps=int(sweeps))
+        tr.emit("solve", target="path_lambda", index=int(k),
+                iters=int(iters))
+
+
+def _build_grid(lam_max, lambdas, lmr, n_lambda, auto_grid, acc):
+    if auto_grid:
+        lg = jnp.log(lam_max)
+        return jnp.exp(jnp.linspace(lg, lg + jnp.log(lmr),
+                                    n_lambda)).astype(acc)
+    return lambdas.astype(acc)
+
+
+_GLM_STATICS = ("family", "link", "auto_grid", "n_lambda", "standardize",
+                "icol", "max_iter", "cd_max_sweeps", "kkt_rounds",
+                "precision", "trace")
+
+
+@functools.partial(jax.jit, static_argnames=_GLM_STATICS)
+def _glm_path_kernel(X, y, wt, off, lambdas, lmr, alpha, pf, tol, cd_tol,
+                     fam_param, *, family, link, auto_grid, n_lambda,
+                     standardize, icol, max_iter, cd_max_sweeps,
+                     kkt_rounds, precision, trace):
+    """The whole GLM lambda-path in one executable (module docstring)."""
+    family = family.with_param(fam_param)
+    dt = X.dtype
+    acc = jnp.float64 if dt == jnp.float64 else jnp.float32
+    n, p = X.shape
+    wt = wt.astype(dt)
+    y = y.astype(dt)
+    off = off.astype(dt)
+    valid = wt > 0.0
+    wp = (wt / jnp.sum(wt.astype(acc)).astype(dt))
+    pen = pf > 0.0
+    alpha = alpha.astype(acc)
+    pf = pf.astype(acc)
+
+    # standardization stats: one averaged Gramian gives both first and
+    # second weighted moments of every column
+    one = jnp.ones((n,), dt)
+    A1, s1 = design_gramian(X, one, wp, accum_dtype=acc, precision=precision)
+    var_c = jnp.diag(A1.astype(acc)) - s1.astype(acc) ** 2
+    if standardize:
+        sdv = jnp.sqrt(jnp.maximum(var_c, 0.0))
+        sd = jnp.where(pen & (sdv > _SD_FLOOR), sdv, 1.0)
+    else:
+        sd = jnp.ones((p,), acc)
+    isd = (1.0 / sd).astype(acc)
+
+    b0, null_dev, w_n, z_n = _null_model(y, wt, wp, off, valid, family,
+                                         link, icol, acc)
+
+    # lambda_max: the standardized null-model gradient.  X'W(z - b0) with
+    # sum-1 weights needs no /n; b0 folds in through X'W1.
+    u = design_colsum(X, w_n * z_n, accum_dtype=acc, precision=precision)
+    v = design_colsum(X, w_n, accum_dtype=acc, precision=precision)
+    g0 = (u - b0 * v) * isd
+    al = jnp.maximum(alpha, _ALPHA_FLOOR)
+    lam_max = jnp.max(jnp.where(
+        pen, jnp.abs(g0) / (al * jnp.maximum(pf, _TINY)), 0.0))
+    lam_max = jnp.maximum(lam_max, _TINY)
+    lams = _build_grid(lam_max, lambdas, lmr.astype(acc), n_lambda,
+                       auto_grid, acc)
+
+    beta_init = jnp.zeros((p,), acc)
+    if icol is not None:
+        beta_init = beta_init.at[icol].set(b0)  # sd[icol] is 1 (unpenalized)
+    free = ~pen
+
+    def irls_cond(s):
+        return (s["it"] == 0) | ((s["crit"] > tol) & (s["it"] < max_iter))
+
+    def step(carry, xs):
+        lam, k = xs
+        lam = lam.astype(acc)
+        # sequential strong rule off the previous solution's gradient
+        strong = pen & (jnp.abs(carry["g"])
+                        >= alpha * pf * (2.0 * lam - carry["lam_prev"])
+                        - 1e-12)
+        mask0 = free | carry["ever"] | strong
+
+        def irls(beta, mask):
+            def ib(s):
+                eta = (design_matvec(X, (s["beta"] * isd).astype(dt))
+                       + off).astype(dt)
+                mu = jnp.where(valid, link.inverse(eta), 1.0).astype(dt)
+                w, z, dev = _work(y, wt, wp, off, eta, mu, family, link)
+                A, b = design_gramian(X, z, w, accum_dtype=acc,
+                                      precision=precision)
+                As = A.astype(acc) * isd[:, None] * isd[None, :]
+                bs = b.astype(acc) * isd
+                bnew, sweeps, _ = _cd_solve(As, bs, s["beta"], lam, alpha,
+                                            pf, mask, cd_tol, cd_max_sweeps)
+                crit = jnp.max(jnp.diag(As) * (bnew - s["beta"]) ** 2)
+                return dict(beta=bnew, As=As, bs=bs, dev=dev.astype(acc),
+                            crit=crit.astype(acc), it=s["it"] + 1,
+                            sweeps=s["sweeps"] + sweeps)
+            return jax.lax.while_loop(irls_cond, ib, dict(
+                beta=beta, As=jnp.zeros((p, p), acc),
+                bs=jnp.zeros((p,), acc), dev=jnp.zeros((), acc),
+                crit=jnp.asarray(jnp.inf, acc),
+                it=jnp.zeros((), jnp.int32),
+                sweeps=jnp.zeros((), jnp.int32)))
+
+        def kkt_body(ks):
+            r = irls(ks["beta"], ks["mask"])
+            g = r["bs"] - r["As"] @ r["beta"]
+            viol = pen & ~ks["mask"] & (
+                jnp.abs(g) > alpha * pf * lam * (1.0 + 1e-4) + 1e-9)
+            return dict(beta=r["beta"], mask=ks["mask"] | viol, g=g,
+                        it=ks["it"] + r["it"],
+                        sweeps=ks["sweeps"] + r["sweeps"],
+                        crit=r["crit"], go=jnp.any(viol),
+                        rounds=ks["rounds"] + 1)
+
+        def kkt_cond(ks):
+            return ks["go"] & (ks["rounds"] < kkt_rounds)
+
+        ks = jax.lax.while_loop(kkt_cond, kkt_body, dict(
+            beta=carry["beta"], mask=mask0, g=jnp.zeros((p,), acc),
+            it=jnp.zeros((), jnp.int32), sweeps=jnp.zeros((), jnp.int32),
+            crit=jnp.asarray(jnp.inf, acc), go=jnp.asarray(True),
+            rounds=jnp.zeros((), jnp.int32)))
+        beta = ks["beta"]
+        # reported deviance, exactly at the returned solution
+        eta = (design_matvec(X, (beta * isd).astype(dt)) + off).astype(dt)
+        mu = jnp.where(valid, link.inverse(eta), 1.0).astype(dt)
+        dev = jnp.sum(jnp.where(
+            valid,
+            jnp.nan_to_num(family.dev_resids(y, mu, wt),
+                           nan=0.0, posinf=0.0, neginf=0.0),
+            0.0)).astype(acc)
+        nz = pen & (jnp.abs(beta) > 0.0)
+        df = jnp.sum(nz).astype(jnp.int32)
+        if trace:
+            jax.debug.callback(_emit_path_point, k, lam, df, dev, ks["it"],
+                               ks["sweeps"], ordered=True)
+        new_carry = dict(beta=beta, ever=carry["ever"] | nz, g=ks["g"],
+                         lam_prev=lam)
+        ys = dict(beta=(beta * isd), df=df, dev=dev, iters=ks["it"],
+                  sweeps=ks["sweeps"], conv=(ks["crit"] <= tol),
+                  kkt_ok=~ks["go"])
+        return new_carry, ys
+
+    carry0 = dict(beta=beta_init, ever=jnp.zeros((p,), bool), g=g0,
+                  lam_prev=lam_max)
+    _, ys = jax.lax.scan(step, carry0,
+                         (lams, jnp.arange(lams.shape[0], dtype=jnp.int32)))
+    return dict(lambdas=lams, null_dev=null_dev, b0=b0, sd=sd, **ys)
+
+
+_GRAM_STATICS = ("auto_grid", "n_lambda", "standardize", "icol",
+                 "cd_max_sweeps", "kkt_rounds", "trace")
+
+
+@functools.partial(jax.jit, static_argnames=_GRAM_STATICS)
+def _gram_path_kernel(A, b, s1, yty, wsum, lambdas, lmr, alpha, pf, cd_tol,
+                      *, auto_grid, n_lambda, standardize, icol,
+                      cd_max_sweeps, kkt_rounds, trace):
+    """Gaussian/identity lambda-path from an ACCUMULATED weighted Gramian.
+
+    ``A = X'WX``, ``b = X'Wz``, ``s1 = X'W1``, ``yty = z'Wz`` with
+    W = diag(w / sum w) and ``z = y - offset``; ``wsum`` restores the
+    RAW-weight deviance scale for reporting.  The quadratic objective
+    needs no re-weighting, so the path never touches the data again —
+    the enabling property for one-data-pass out-of-core lm paths."""
+    acc = A.dtype
+    p = b.shape[0]
+    pen = pf > 0.0
+    alpha = alpha.astype(acc)
+    pf = pf.astype(acc)
+    var_c = jnp.diag(A) - s1 ** 2
+    if standardize:
+        sdv = jnp.sqrt(jnp.maximum(var_c, 0.0))
+        sd = jnp.where(pen & (sdv > _SD_FLOOR), sdv, 1.0)
+    else:
+        sd = jnp.ones((p,), acc)
+    isd = (1.0 / sd).astype(acc)
+    As = A * isd[:, None] * isd[None, :]
+    bs = b * isd
+
+    if icol is not None:
+        # intercept-only WLS: one scalar normal equation
+        b0 = b[icol] / jnp.maximum(A[icol, icol], _TINY)
+        null_rss = jnp.maximum(yty - b0 * b0 * A[icol, icol], 0.0)
+    else:
+        b0 = jnp.zeros((), acc)
+        null_rss = yty
+    beta_init = jnp.zeros((p,), acc)
+    if icol is not None:
+        beta_init = beta_init.at[icol].set(b0)
+    g0 = bs - As @ beta_init
+    al = jnp.maximum(alpha, _ALPHA_FLOOR)
+    lam_max = jnp.max(jnp.where(
+        pen, jnp.abs(g0) / (al * jnp.maximum(pf, _TINY)), 0.0))
+    lam_max = jnp.maximum(lam_max, _TINY)
+    lams = _build_grid(lam_max, lambdas, lmr.astype(acc), n_lambda,
+                       auto_grid, acc)
+    free = ~pen
+
+    def step(carry, xs):
+        lam, k = xs
+        lam = lam.astype(acc)
+        strong = pen & (jnp.abs(carry["g"])
+                        >= alpha * pf * (2.0 * lam - carry["lam_prev"])
+                        - 1e-12)
+        mask0 = free | carry["ever"] | strong
+
+        def kkt_body(ks):
+            beta, sweeps, delta = _cd_solve(As, bs, ks["beta"], lam, alpha,
+                                            pf, ks["mask"], cd_tol,
+                                            cd_max_sweeps)
+            g = bs - As @ beta
+            viol = pen & ~ks["mask"] & (
+                jnp.abs(g) > alpha * pf * lam * (1.0 + 1e-4) + 1e-9)
+            return dict(beta=beta, mask=ks["mask"] | viol, g=g,
+                        sweeps=ks["sweeps"] + sweeps, delta=delta,
+                        go=jnp.any(viol), rounds=ks["rounds"] + 1)
+
+        def kkt_cond(ks):
+            return ks["go"] & (ks["rounds"] < kkt_rounds)
+
+        ks = jax.lax.while_loop(kkt_cond, kkt_body, dict(
+            beta=carry["beta"], mask=mask0, g=jnp.zeros((p,), acc),
+            sweeps=jnp.zeros((), jnp.int32),
+            delta=jnp.asarray(jnp.inf, acc), go=jnp.asarray(True),
+            rounds=jnp.zeros((), jnp.int32)))
+        beta = ks["beta"]
+        beta_orig = beta * isd
+        # RSS on the averaged weights, rescaled to the raw-weight deviance
+        rss = jnp.maximum(
+            yty - 2.0 * (beta_orig @ b) + beta_orig @ (A @ beta_orig), 0.0)
+        dev = (wsum * rss).astype(acc)
+        nz = pen & (jnp.abs(beta) > 0.0)
+        df = jnp.sum(nz).astype(jnp.int32)
+        if trace:
+            jax.debug.callback(_emit_path_point, k, lam, df, dev,
+                               jnp.ones((), jnp.int32), ks["sweeps"],
+                               ordered=True)
+        new_carry = dict(beta=beta, ever=carry["ever"] | nz, g=ks["g"],
+                         lam_prev=lam)
+        ys = dict(beta=beta_orig, df=df, dev=dev,
+                  iters=jnp.ones((), jnp.int32), sweeps=ks["sweeps"],
+                  conv=(ks["delta"] <= cd_tol), kkt_ok=~ks["go"])
+        return new_carry, ys
+
+    carry0 = dict(beta=beta_init, ever=jnp.zeros((p,), bool), g=g0,
+                  lam_prev=lam_max)
+    _, ys = jax.lax.scan(step, carry0,
+                         (lams, jnp.arange(lams.shape[0], dtype=jnp.int32)))
+    return dict(lambdas=lams, null_dev=(wsum * null_rss).astype(acc),
+                b0=b0, sd=sd, **ys)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _quad_stats_kernel(X, y, wt, off, *, precision):
+    """Single data pass feeding :func:`_gram_path_kernel` for resident
+    gaussian/identity fits: the averaged Gramian, column means, response
+    quadratic and raw weight sum."""
+    dt = X.dtype
+    acc = jnp.float64 if dt == jnp.float64 else jnp.float32
+    wsum = jnp.sum(wt.astype(acc))
+    wp = (wt / wsum.astype(wt.dtype)).astype(dt)
+    z = (y - off).astype(dt)
+    A, b = design_gramian(X, z, wp, accum_dtype=acc, precision=precision)
+    s1 = design_colsum(X, wp, accum_dtype=acc, precision=precision)
+    za = z.astype(acc)
+    yty = jnp.sum(wp.astype(acc) * za * za)
+    return dict(A=A.astype(acc), b=b.astype(acc), s1=s1.astype(acc),
+                yty=yty, wsum=wsum)
+
+
+@functools.partial(jax.jit, static_argnames=("cd_max_sweeps",))
+def _cd_solve_kernel(As, bs, beta0, lam, alpha, pf, mask, cd_tol, *,
+                     cd_max_sweeps):
+    """One warm-started elastic-net solve on a standardized Gramian with
+    lambda TRACED — the streaming GLM driver calls this once per IRLS
+    iteration per lambda and never recompiles across the grid."""
+    beta, sweeps, delta = _cd_solve(As, bs, beta0, lam, alpha, pf, mask,
+                                    cd_tol, cd_max_sweeps)
+    g = bs - As @ beta
+    crit = jnp.max(jnp.diag(As) * (beta - beta0) ** 2)
+    return dict(beta=beta, g=g, sweeps=sweeps, delta=delta, crit=crit)
+
+
+# ---------------------------------------------------------------------------
+# host driver
+
+
+def resolve_penalty_vector(penalty, xnames, has_intercept, icol):
+    """Expand ``penalty.penalty_factor`` to the full xnames-aligned vector,
+    glmnet-rescaled to sum to the number of penalized variables.  The
+    intercept entry is forced to 0 (never penalized)."""
+    p = len(xnames)
+    nvars = p - (1 if icol is not None else 0)
+    if nvars == 0:
+        raise ValueError("the design has no penalizable columns")
+    pf = penalty.penalty_factor
+    if pf is None:
+        pfv = np.ones(p, np.float64)
+    else:
+        pfv = np.asarray(pf, np.float64).ravel()
+        if icol is not None and pfv.shape[0] == p - 1:
+            pfv = np.insert(pfv, icol, 0.0)  # user gave non-intercept factors
+        if pfv.shape[0] != p:
+            raise ValueError(
+                f"penalty_factor must have {p - 1 if icol is not None else p}"
+                f" (non-intercept) or {p} entries aligned to xnames, got "
+                f"{pfv.shape[0]}")
+    if icol is not None:
+        pfv[icol] = 0.0
+    s = pfv.sum()
+    if s <= 0.0:
+        raise ValueError(
+            "penalty_factor zeroes every column — that is an unpenalized "
+            "fit; drop penalty= instead")
+    # glmnet internally rescales penalty.factor to sum to nvars
+    pfv = pfv * (nvars / s)
+    return pfv
+
+
+def intercept_col(xnames, has_intercept):
+    """Index of the intercept column, or None."""
+    if not has_intercept:
+        return None
+    from ..data.model_matrix import INTERCEPT_NAME
+    try:
+        return xnames.index(INTERCEPT_NAME)
+    except ValueError:
+        return 0
+
+
+def fit_path(X, y, *, family="gaussian", link=None, weights=None,
+             offset=None, m=None, penalty, xnames=None, yname="y",
+             has_intercept=None, kind="glm", verbose=False, trace=None,
+             metrics=None, config=None):
+    """Fit an elastic-net lambda path; returns a
+    :class:`~sparkglm_tpu.penalized.model.PathModel`.
+
+    The resident entry point behind ``penalty=`` on :func:`sparkglm_tpu.lm`
+    / :func:`sparkglm_tpu.glm`.  Dispatch: gaussian/identity goes through
+    the accumulated-Gramian pair (stats kernel + path kernel, two
+    executables, one data pass); every other family runs the fused
+    one-executable GLM path kernel."""
+    import dataclasses as _dc
+
+    from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
+    from ..families.families import resolve as _resolve
+    from ..models.validate import (check_finite_vector,
+                                   check_response_domain)
+    from .penalty import ElasticNet
+
+    if not isinstance(penalty, ElasticNet):
+        raise TypeError(
+            f"penalty must be an ElasticNet instance, got {type(penalty)!r}")
+    if config is None:
+        config = DEFAULT
+    fam, lnk = _resolve(family, link)
+    if not hasattr(X, "shape") or len(X.shape) != 2:
+        raise ValueError("X must be a 2-D design")
+    n, p = X.shape
+    if xnames is None:
+        xnames = tuple(f"x{i}" for i in range(p))
+    xnames = tuple(xnames)
+    if has_intercept is None:
+        has_intercept = xnames and "intercept" in xnames
+    icol = intercept_col(list(xnames), has_intercept)
+
+    use_f64 = X.dtype == np.float64 and x64_enabled()
+    dtype = np.float64 if use_f64 else np.float32
+
+    def _check_len(v, what):
+        v = np.asarray(v, np.float64)
+        if v.shape != (n,):
+            raise ValueError(f"{what} must have shape ({n},), got {v.shape}")
+        return v
+
+    y64 = np.asarray(y, np.float64).reshape(-1)
+    if y64.shape != (n,):
+        raise ValueError(f"y must have shape ({n},), got {y64.shape}")
+    wt64 = (np.ones((n,), np.float64) if weights is None
+            else _check_len(weights, "weights"))
+    check_finite_vector("y", y64)
+    check_finite_vector("weights", wt64)
+    if m is not None:
+        m64 = _check_len(m, "m")
+        check_finite_vector("m", m64)
+        if fam.name not in ("binomial", "quasibinomial"):
+            raise ValueError(
+                "group sizes m only apply to the (quasi)binomial family")
+        y64 = y64 / np.maximum(m64, 1e-30)  # counts -> proportions
+        wt64 = wt64 * m64
+    off64 = (np.zeros((n,), np.float64) if offset is None
+             else _check_len(offset, "offset"))
+    check_finite_vector("offset", off64)
+    check_response_domain(fam.name, y64)
+    if wt64.sum() <= 0.0:
+        raise ValueError("weights sum to zero; nothing to fit")
+
+    pfv = resolve_penalty_vector(penalty, list(xnames), has_intercept, icol)
+    explicit = penalty.resolved_lambdas()
+    auto_grid = explicit is None
+    n_lambda = penalty.grid_size()
+    lmr = penalty.min_ratio(n, p - (1 if icol is not None else 0))
+
+    tracer = _obs_trace.as_tracer(trace, verbose=verbose, metrics=metrics)
+    on_tpu = jax.default_backend() == "tpu"
+    mmp = resolve_matmul_precision(config, n, p, on_tpu)
+
+    Xd = X.astype(dtype)
+    yd = y64.astype(dtype)
+    wtd = wt64.astype(dtype)
+    offd = off64.astype(dtype)
+    alpha = np.asarray(penalty.alpha, dtype)
+    pf_in = pfv.astype(dtype)
+    lam_in = (np.zeros((n_lambda,), dtype) if auto_grid
+              else explicit.astype(dtype))
+    lmr_in = np.asarray(lmr, dtype)
+    gaussian_identity = fam.name == "gaussian" and lnk.name == "identity"
+
+    from ..obs import timing as _obs_timing
+
+    def _run():
+        if gaussian_identity:
+            before_s = _quad_stats_kernel._cache_size()
+            st = _quad_stats_kernel(Xd, yd, wtd, offd, precision=mmp)
+            before_p = _gram_path_kernel._cache_size()
+            out = _gram_path_kernel(
+                st["A"], st["b"], st["s1"], st["yty"], st["wsum"],
+                lam_in, lmr_in, alpha, pf_in,
+                np.asarray(penalty.cd_tol, dtype),
+                auto_grid=auto_grid, n_lambda=n_lambda,
+                standardize=penalty.standardize, icol=icol,
+                cd_max_sweeps=penalty.cd_max_sweeps,
+                kkt_rounds=_KKT_ROUNDS, trace=tracer is not None)
+            compiles = ((_quad_stats_kernel._cache_size() - before_s)
+                        + (_gram_path_kernel._cache_size() - before_p))
+            return out, compiles, "gram_path"
+        before = _glm_path_kernel._cache_size()
+        out = _glm_path_kernel(
+            Xd, yd, wtd, offd, lam_in, lmr_in, alpha, pf_in,
+            np.asarray(penalty.tol, dtype),
+            np.asarray(penalty.cd_tol, dtype), fam.param_operand(dtype),
+            family=fam, link=lnk, auto_grid=auto_grid, n_lambda=n_lambda,
+            standardize=penalty.standardize, icol=icol,
+            max_iter=penalty.max_iter, cd_max_sweeps=penalty.cd_max_sweeps,
+            kkt_rounds=_KKT_ROUNDS, precision=mmp,
+            trace=tracer is not None)
+        return out, _glm_path_kernel._cache_size() - before, "glm_path"
+
+    from ..data.structured import StructuredDesign
+    engine = ("structured" if isinstance(X, StructuredDesign) else "einsum")
+    with _obs_trace.ambient(tracer):
+        if tracer is not None:
+            tracer.emit("fit_start", model="penalized_path",
+                        family=fam.name, link=lnk.name,
+                        alpha=float(penalty.alpha), n_lambda=n_lambda,
+                        n=int(n), p=int(p))
+        with _obs_timing.span("path_fit", tracer, device=True) as sp:
+            out, compiles, target = _run()
+            sp.watch(out)
+        if tracer is not None:
+            if compiles:
+                tracer.emit("compile", target=target, seconds=sp.seconds,
+                            executables=int(compiles),
+                            gramian_engine=engine)
+            jax.effects_barrier()  # drain path_point callbacks before fit_end
+
+    n_ok = int((wt64 > 0).sum())
+    return assemble_path_model(
+        out, penalty=penalty, fam=fam, lnk=lnk, xnames=xnames, yname=yname,
+        n_obs=int(n), n_ok=n_ok, has_intercept=bool(has_intercept),
+        kind=kind, engine=engine, tracer=tracer, compiles=int(compiles),
+        has_offset=offset is not None)
+
+
+def assemble_path_model(out, *, penalty, fam, lnk, xnames, yname, n_obs,
+                        n_ok, has_intercept, kind, engine, tracer, compiles,
+                        has_offset):
+    """Shared tail of every path fit (resident and streaming): host-side
+    unpacking, the non-convergence warning, path trace aggregates, and the
+    :class:`PathModel` record."""
+    from .model import PathModel
+
+    lambdas = np.asarray(out["lambdas"], np.float64)
+    betas = np.asarray(out["beta"], np.float64)
+    dev = np.asarray(out["dev"], np.float64)
+    null_dev = float(out["null_dev"])
+    df = np.asarray(out["df"], np.int64)
+    conv = np.asarray(out["conv"], bool)
+    kkt_ok = np.asarray(out["kkt_ok"], bool)
+    iters = np.asarray(out["iters"], np.int64)
+    sweeps = np.asarray(out["sweeps"], np.int64)
+    dev_ratio = 1.0 - dev / null_dev if null_dev > 0 else np.zeros_like(dev)
+
+    if not conv.all():
+        import warnings
+        bad = int((~conv).sum())
+        warnings.warn(
+            f"penalized path: {bad}/{len(conv)} lambda points hit the "
+            f"iteration cap (max_iter={penalty.max_iter}, "
+            f"cd_max_sweeps={penalty.cd_max_sweeps}) before reaching "
+            f"tol={penalty.tol:g}; estimates there may be loose",
+            stacklevel=3)
+
+    fit_info = None
+    if tracer is not None:
+        tracer.emit("fit_end", model="penalized_path",
+                    n_lambda=int(len(lambdas)),
+                    df_max=int(df.max(initial=0)),
+                    dev_ratio_max=float(np.max(dev_ratio, initial=0.0)),
+                    converged=bool(conv.all()))
+        fit_info = tracer.report()
+        fit_info["path"] = {
+            "n_lambda": int(len(lambdas)),
+            "lambda_max": float(lambdas[0]) if len(lambdas) else None,
+            "lambda_min": float(lambdas[-1]) if len(lambdas) else None,
+            "alpha": float(penalty.alpha),
+            "irls_iters_total": int(iters.sum()),
+            "cd_sweeps_total": int(sweeps.sum()),
+            "kkt_clean": bool(kkt_ok.all()),
+            "executables": int(compiles),
+        }
+
+    return PathModel(
+        lambdas=lambdas, alpha=float(penalty.alpha), coefficients=betas,
+        df=df, deviance=dev, dev_ratio=np.asarray(dev_ratio, np.float64),
+        null_deviance=null_dev, family=fam.name, link=lnk.name,
+        xnames=tuple(xnames), yname=yname, n_obs=int(n_obs), n_ok=int(n_ok),
+        n_params=int(len(xnames)), has_intercept=bool(has_intercept),
+        standardize=bool(penalty.standardize),
+        penalty=penalty, converged=bool(conv.all()),
+        kkt_clean=bool(kkt_ok.all()), iterations=int(iters.sum()),
+        dispersion_fixed=bool(fam.dispersion_fixed), kind=kind,
+        has_offset=bool(has_offset),
+        gramian_engine=engine, fit_info=fit_info)

@@ -1,0 +1,115 @@
+"""Elastic-net penalty specification.
+
+``ElasticNet`` is the user-facing ``penalty=`` argument of ``lm``/``glm``
+and the ``*_from_csv`` front-ends (api.py): a frozen, hashable record of
+the penalty geometry (``alpha`` blends l1 and l2) and the lambda-path
+request (an explicit grid, or an automatic lambda_max-anchored log grid).
+The solver semantics follow glmnet (Friedman/Hastie/Tibshirani), the
+behavioral oracle named in ROADMAP item 2 — see PARITY.md r11 for the
+exact correspondence (weight normalization, standardization moments,
+intercept handling) and its documented tolerances.
+
+The objective, for a fitted mean eta = X beta and prior weights w
+rescaled to sum n (glmnet's internal rescaling):
+
+    (1/n) * sum_i w_i * nll_i(y_i, eta_i)
+      + lambda * sum_j pf_j * (alpha * |beta_j| + (1-alpha)/2 * beta_j^2)
+
+with nll the family's unit deviance / 2 (gaussian: (y-eta)^2 / 2).  The
+intercept (and any ``penalty_factor`` zero) is never penalized.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["ElasticNet"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticNet:
+    """Elastic-net penalty over a lambda path.
+
+    Attributes:
+      alpha: l1/l2 mix in [0, 1] — 1 is the lasso, 0 is ridge (glmnet's
+        ``alpha``).
+      lambdas: explicit penalty grid (any order; fitted descending).  None
+        (default) builds the glmnet-style automatic grid: ``n_lambda``
+        log-spaced points from the data-derived lambda_max (the smallest
+        lambda with every penalized coefficient zero) down to
+        ``lambda_min_ratio * lambda_max``.
+      n_lambda: automatic-grid length (glmnet ``nlambda``, default 100).
+      lambda_min_ratio: automatic-grid floor ratio; None picks glmnet's
+        default (1e-4 when n > p, else 1e-2).
+      standardize: scale each penalized column by its weighted standard
+        deviation (moments about the weighted mean, 1/n denominator)
+        before penalizing; coefficients are always returned on the
+        ORIGINAL scale.  glmnet's ``standardize=TRUE`` default.
+      penalty_factor: optional per-column multipliers aligned to xnames
+        (glmnet ``penalty.factor``); 0 exempts a column.  The intercept
+        is forced to 0 regardless.
+      max_iter: IRLS (outer quadratic-approximation) iterations per
+        lambda; warm starts along the path typically need 1-3.
+      tol: IRLS convergence threshold on the weighted coefficient change
+        ``max_j A_jj (dbeta_j)^2`` (glmnet's outer criterion).
+      cd_tol: coordinate-descent sweep threshold, same functional
+        (glmnet ``thresh``).
+      cd_max_sweeps: CD sweep cap per inner solve.
+    """
+
+    alpha: float = 1.0
+    lambdas: tuple | None = None
+    n_lambda: int = 100
+    lambda_min_ratio: float | None = None
+    standardize: bool = True
+    penalty_factor: tuple | None = None
+    max_iter: int = 25
+    tol: float = 1e-7
+    cd_tol: float = 1e-7
+    cd_max_sweeps: int = 1000
+
+    def __post_init__(self):
+        a = float(self.alpha)
+        if not np.isfinite(a) or not 0.0 <= a <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {self.alpha!r}")
+        object.__setattr__(self, "alpha", a)
+        if self.lambdas is not None:
+            lams = tuple(float(l) for l in np.asarray(self.lambdas).ravel())
+            if not lams:
+                raise ValueError("lambdas must be non-empty when given")
+            if any(not np.isfinite(l) or l < 0.0 for l in lams):
+                raise ValueError(
+                    f"lambdas must be finite and >= 0, got {self.lambdas!r}")
+            # fitted largest-first so warm starts walk a shrinking penalty;
+            # PathModel keeps this descending order
+            object.__setattr__(self, "lambdas",
+                               tuple(sorted(set(lams), reverse=True)))
+        if int(self.n_lambda) < 1:
+            raise ValueError(f"n_lambda must be >= 1, got {self.n_lambda!r}")
+        object.__setattr__(self, "n_lambda", int(self.n_lambda))
+        if self.lambda_min_ratio is not None:
+            r = float(self.lambda_min_ratio)
+            if not 0.0 < r < 1.0:
+                raise ValueError(
+                    f"lambda_min_ratio must be in (0, 1), got {r!r}")
+        if self.penalty_factor is not None:
+            pf = tuple(float(v) for v in np.asarray(self.penalty_factor).ravel())
+            if any(not np.isfinite(v) or v < 0.0 for v in pf):
+                raise ValueError("penalty_factor entries must be finite and >= 0")
+            object.__setattr__(self, "penalty_factor", pf)
+
+    def resolved_lambdas(self) -> np.ndarray | None:
+        """The explicit descending grid, or None for the automatic one."""
+        if self.lambdas is None:
+            return None
+        return np.asarray(self.lambdas, np.float64)
+
+    def grid_size(self) -> int:
+        return len(self.lambdas) if self.lambdas is not None else self.n_lambda
+
+    def min_ratio(self, n: int, p: int) -> float:
+        if self.lambda_min_ratio is not None:
+            return float(self.lambda_min_ratio)
+        return 1e-4 if n > p else 1e-2

@@ -1,0 +1,480 @@
+"""Out-of-core elastic-net lambda paths.
+
+Penalization operates on the ACCUMULATED weighted Gramian — the whole
+point of routing it through the streaming engine (ISSUE 6 tentpole):
+chunked ``*_from_csv`` fits and ``design="structured"`` chunks feed the
+same standardized coordinate-descent solvers as resident fits.
+
+Two drivers, mirroring the resident dispatch in ``path.py``:
+
+  * :func:`lm_path_streaming` — gaussian/identity.  The quadratic
+    objective never re-weights, so ONE chunked data pass accumulates
+    ``(X'WX, X'Wz, X'W1, z'Wz, sum w)`` in host f64 (left-to-right, the
+    streaming engine's determinism contract) and the entire path then
+    runs on the Gramian via the compiled ``_gram_path_kernel`` — the
+    out-of-core path costs one data pass plus p x p work.
+  * :func:`glm_path_streaming` — general families.  The lambda loop and
+    IRLS loop run on the host (each IRLS step needs a full data pass for
+    the re-weighted Gramian), but every device step goes through a FIXED
+    set of jitted pass flavors — stats/fisher/deviance chunk kernels with
+    bucket-padded shapes (``models/streaming.py::_bucket_pad``) and the
+    lambda-TRACED ``_cd_solve_kernel`` — so executable count stays
+    constant in both the chunk count and the grid length (compile events
+    via the ``_traced_call`` cache-delta idiom).
+
+Strong-rule screening + KKT verification run on the host here (numpy on
+p-vectors), with identical thresholds to the compiled resident scan.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import trace as _obs_trace
+from ..ops.factor_gramian import design_colsum, design_gramian, design_matvec
+from .path import (_ALPHA_FLOOR, _KKT_ROUNDS, _SD_FLOOR, _TINY,
+                   _NULL_MAX_ITER, _NULL_TOL, _gram_path_kernel,
+                   _cd_solve_kernel, _work, assemble_path_model,
+                   intercept_col, resolve_penalty_vector)
+
+__all__ = ["lm_path_streaming", "glm_path_streaming"]
+
+
+# -- chunk pass kernels (one executable per flavor; weights are RAW here —
+# linear accumulations normalize by the global weight sum on the host)
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _stats_chunk_kernel(X, y, w, off, *, precision):
+    """Gaussian accumulation chunk: raw-weight ``(X'WX, X'Wz, X'W1, z'Wz,
+    sum w, rows w>0)`` with ``z = y - offset``.  Doubles as the GLM stats
+    pass (only A's diagonal, s1 and wsum are read there)."""
+    dt = X.dtype
+    acc = jnp.float64 if dt == jnp.float64 else jnp.float32
+    z = (y - off).astype(dt)
+    A, b = design_gramian(X, z, w, accum_dtype=acc, precision=precision)
+    s1 = design_colsum(X, w, accum_dtype=acc, precision=precision)
+    wa = w.astype(acc)
+    za = z.astype(acc)
+    return dict(A=A.astype(acc), b=b.astype(acc), s1=s1.astype(acc),
+                yty=jnp.sum(wa * za * za), wsum=jnp.sum(wa),
+                n_ok=jnp.sum(w > 0.0))
+
+
+_FAM_STATICS = ("family", "link", "precision")
+
+
+@functools.partial(jax.jit, static_argnames=_FAM_STATICS + ("first",))
+def _null_chunk_kernel(y, wt, off, b0, fam_param, *, family, link, first,
+                       precision):
+    """Intercept-only IRLS chunk: scalar partials ``(sum w, sum w z,
+    deviance)`` at ``eta = b0 + offset`` (or the family init when
+    ``first``).  O(n) — no design access."""
+    family = family.with_param(fam_param)
+    dt = y.dtype
+    acc = jnp.float64 if dt == jnp.float64 else jnp.float32
+    valid = wt > 0.0
+    if first:
+        mu = jnp.where(valid, family.init_mu(y, jnp.maximum(wt, _TINY)), 1.0)
+        eta = link.link(mu)
+    else:
+        eta = b0 + off
+        mu = jnp.where(valid, link.inverse(eta), 1.0)
+    w, z, dev = _work(y, wt, wt, off, eta, mu, family, link)
+    return dict(sw=jnp.sum(w.astype(acc)), swz=jnp.sum((w * z).astype(acc)),
+                dev=dev.astype(acc))
+
+
+@functools.partial(jax.jit, static_argnames=_FAM_STATICS)
+def _grad_chunk_kernel(X, y, wt, off, b0, fam_param, *, family, link,
+                       precision):
+    """lambda_max chunk: raw-weight ``(X'Wz, X'W1)`` at the null solution
+    ``eta = b0 + offset``."""
+    family = family.with_param(fam_param)
+    dt = X.dtype
+    acc = jnp.float64 if dt == jnp.float64 else jnp.float32
+    valid = wt > 0.0
+    eta = (b0 + off).astype(dt)
+    mu = jnp.where(valid, link.inverse(eta), 1.0)
+    w, z, _ = _work(y, wt, wt, off, eta, mu, family, link)
+    u = design_colsum(X, w * z, accum_dtype=acc, precision=precision)
+    v = design_colsum(X, w, accum_dtype=acc, precision=precision)
+    return dict(u=u.astype(acc), v=v.astype(acc))
+
+
+@functools.partial(jax.jit, static_argnames=_FAM_STATICS)
+def _fisher_chunk_kernel(X, y, wt, off, beta, fam_param, *, family, link,
+                         precision):
+    """One IRLS data chunk at ``beta`` (ORIGINAL scale): raw-weight
+    ``(X'WX, X'Wz, deviance)`` — the streaming twin of the resident path
+    kernel's inner Gramian."""
+    family = family.with_param(fam_param)
+    dt = X.dtype
+    acc = jnp.float64 if dt == jnp.float64 else jnp.float32
+    valid = wt > 0.0
+    eta = (design_matvec(X, beta.astype(dt)) + off).astype(dt)
+    mu = jnp.where(valid, link.inverse(eta), 1.0).astype(dt)
+    w, z, dev = _work(y, wt, wt, off, eta, mu, family, link)
+    A, b = design_gramian(X, z, w, accum_dtype=acc, precision=precision)
+    return dict(A=A.astype(acc), b=b.astype(acc), dev=dev.astype(acc))
+
+
+@functools.partial(jax.jit, static_argnames=_FAM_STATICS)
+def _dev_chunk_kernel(X, y, wt, off, beta, fam_param, *, family, link,
+                      precision):
+    """Deviance-only chunk at ``beta`` — the per-lambda reporting pass
+    (O(n p) matvec, no Gramian)."""
+    family = family.with_param(fam_param)
+    dt = X.dtype
+    acc = jnp.float64 if dt == jnp.float64 else jnp.float32
+    valid = wt > 0.0
+    eta = (design_matvec(X, beta.astype(dt)) + off).astype(dt)
+    mu = jnp.where(valid, link.inverse(eta), 1.0).astype(dt)
+    dev = jnp.sum(jnp.where(
+        valid,
+        jnp.nan_to_num(family.dev_resids(y, mu, wt),
+                       nan=0.0, posinf=0.0, neginf=0.0), 0.0))
+    return dict(dev=dev.astype(acc))
+
+
+# -- host plumbing -----------------------------------------------------------
+
+
+def _stream_pass(source, label, tracer, bucket, dtype, per_chunk):
+    """Drive one chunked pass: materialize thunks, validate, bucket-pad to
+    the fixed shape set, and fold ``per_chunk(X, y, w, off)`` host-f64
+    partials left-to-right.  Returns ``(totals dict, chunks, rows)``."""
+    import time as _time
+
+    from ..models.streaming import _bucket_pad, _materialize
+
+    totals: dict = {}
+    chunks = rows = 0
+    t0 = _time.perf_counter()
+    if tracer is not None:
+        tracer.pass_start(label, 0)
+    for chunk in source():
+        Xc, yc, wc, oc = _materialize(chunk)
+        n = int(Xc.shape[0])
+        if n == 0:
+            continue
+        rows += n
+        chunks += 1
+        Xc, yc, wc, oc = _bucket_pad(Xc, yc, wc, oc, bucket)
+        Xc = Xc.astype(dtype)
+        yc = np.asarray(yc, dtype)
+        wc = (np.ones(Xc.shape[0], dtype) if wc is None
+              else np.asarray(wc, dtype))
+        oc = (np.zeros(Xc.shape[0], dtype) if oc is None
+              else np.asarray(oc, dtype))
+        part = per_chunk(Xc, yc, wc, oc)
+        for k, v in part.items():
+            v = np.asarray(v, np.float64)
+            totals[k] = v if k not in totals else totals[k] + v
+    if tracer is not None:
+        tracer.pass_end(label, 0, chunks=chunks, rows=rows, bytes=0,
+                        compute_s=_time.perf_counter() - t0)
+    return totals, chunks, rows
+
+
+def _grid_from(lam_max, penalty, n, p_pen):
+    explicit = penalty.resolved_lambdas()
+    if explicit is not None:
+        return explicit
+    lmr = penalty.min_ratio(n, p_pen)
+    lg = np.log(max(lam_max, _TINY))
+    return np.exp(np.linspace(lg, lg + np.log(lmr), penalty.grid_size()))
+
+
+def _sd_from_moments(diagA, s1, pen, standardize, p):
+    var_c = diagA - s1 ** 2
+    if standardize:
+        sdv = np.sqrt(np.maximum(var_c, 0.0))
+        return np.where(pen & (sdv > _SD_FLOOR), sdv, 1.0)
+    return np.ones(p)
+
+
+def _prepare(penalty, xnames, has_intercept):
+    from .penalty import ElasticNet
+
+    if not isinstance(penalty, ElasticNet):
+        raise TypeError(
+            f"penalty must be an ElasticNet instance, got {type(penalty)!r}")
+    xnames = tuple(xnames)
+    icol = intercept_col(list(xnames), has_intercept)
+    pfv = resolve_penalty_vector(penalty, list(xnames), has_intercept, icol)
+    return xnames, icol, pfv
+
+
+def lm_path_streaming(source, *, penalty, xnames, yname="y",
+                      has_intercept=None, verbose=False, trace=None,
+                      metrics=None, config=None):
+    """Gaussian/identity lambda path from a chunk source in ONE data pass
+    (module docstring).  ``source()`` yields ``(X, y, w, off)`` tuples or
+    thunks, the ``models/streaming.py`` contract."""
+    from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
+
+    if config is None:
+        config = DEFAULT
+    xnames, icol, pfv = _prepare(penalty, xnames, has_intercept)
+    p = len(xnames)
+    dtype = np.float64 if x64_enabled() else np.float32
+    tracer = _obs_trace.as_tracer(trace, verbose=verbose, metrics=metrics)
+    mmp = resolve_matmul_precision(config, 1 << 20, p,
+                                   jax.default_backend() == "tpu")
+    bucket: dict = {}
+    compiles = [0]
+    engine = ["einsum"]
+
+    def per_chunk(Xc, yc, wc, oc):
+        from ..data.structured import StructuredDesign
+        from ..models.streaming import _traced_call
+        if isinstance(Xc, StructuredDesign):
+            engine[0] = "structured"
+        before = _stats_chunk_kernel._cache_size()
+        out = _traced_call(_stats_chunk_kernel, tracer, "penalized_stats",
+                           Xc, yc, wc, oc, engine=engine[0], precision=mmp)
+        compiles[0] += _stats_chunk_kernel._cache_size() - before
+        return out
+
+    with _obs_trace.ambient(tracer):
+        if tracer is not None:
+            tracer.emit("fit_start", model="penalized_path_streaming",
+                        family="gaussian", link="identity",
+                        alpha=float(penalty.alpha))
+        totals, chunks, rows = _stream_pass(source, "penalized_gramian",
+                                            tracer, bucket, dtype, per_chunk)
+        if rows == 0:
+            raise ValueError("chunk source produced no rows")
+        wsum = float(totals["wsum"])
+        if wsum <= 0:
+            raise ValueError("weights sum to zero; nothing to fit")
+        n_ok = int(totals["n_ok"])
+        A = totals["A"] / wsum
+        b = totals["b"] / wsum
+        s1 = totals["s1"] / wsum
+        yty = float(totals["yty"]) / wsum
+
+        before = _gram_path_kernel._cache_size()
+        explicit = penalty.resolved_lambdas()
+        auto_grid = explicit is None
+        n_lambda = penalty.grid_size()
+        lmr = penalty.min_ratio(rows, p - (1 if icol is not None else 0))
+        out = _gram_path_kernel(
+            A.astype(dtype), b.astype(dtype), s1.astype(dtype),
+            np.asarray(yty, dtype), np.asarray(wsum, dtype),
+            (np.zeros(n_lambda, dtype) if auto_grid
+             else explicit.astype(dtype)),
+            np.asarray(lmr, dtype), np.asarray(penalty.alpha, dtype),
+            pfv.astype(dtype), np.asarray(penalty.cd_tol, dtype),
+            auto_grid=auto_grid, n_lambda=n_lambda,
+            standardize=penalty.standardize, icol=icol,
+            cd_max_sweeps=penalty.cd_max_sweeps, kkt_rounds=_KKT_ROUNDS,
+            trace=tracer is not None)
+        delta = _gram_path_kernel._cache_size() - before
+        compiles[0] += delta
+        if tracer is not None and delta:
+            tracer.emit("compile", target="gram_path",
+                        executables=int(delta), gramian_engine=engine[0])
+        jax.effects_barrier()
+
+        from ..families.families import resolve as _resolve
+        fam, lnk = _resolve("gaussian", None)
+        return assemble_path_model(
+            out, penalty=penalty, fam=fam, lnk=lnk, xnames=xnames,
+            yname=yname, n_obs=rows, n_ok=n_ok,
+            has_intercept=bool(has_intercept), kind="lm", engine=engine[0],
+            tracer=tracer, compiles=int(compiles[0]), has_offset=False)
+
+
+def glm_path_streaming(source, *, family="binomial", link=None, penalty,
+                       xnames, yname="y", has_intercept=None, verbose=False,
+                       trace=None, metrics=None, config=None):
+    """General-family lambda path from a chunk source: host lambda/IRLS
+    loops over a fixed set of compiled chunk-pass flavors plus the
+    lambda-traced CD solve kernel (module docstring)."""
+    from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
+    from ..families.families import resolve as _resolve
+    from ..models.streaming import _traced_call
+
+    if config is None:
+        config = DEFAULT
+    fam, lnk = _resolve(family, link)
+    if fam.name == "gaussian" and lnk.name == "identity":
+        return lm_path_streaming(
+            source, penalty=penalty, xnames=xnames, yname=yname,
+            has_intercept=has_intercept, verbose=verbose, trace=trace,
+            metrics=metrics, config=config)
+    xnames, icol, pfv = _prepare(penalty, xnames, has_intercept)
+    p = len(xnames)
+    dtype = np.float64 if x64_enabled() else np.float32
+    tracer = _obs_trace.as_tracer(trace, verbose=verbose, metrics=metrics)
+    mmp = resolve_matmul_precision(config, 1 << 20, p,
+                                   jax.default_backend() == "tpu")
+    fam_param = fam.param_operand(dtype)
+    bucket: dict = {}
+    compiles = [0]
+    engine = ["einsum"]
+    fam_kw = dict(family=fam, link=lnk, precision=mmp)
+
+    def counted(kernel, target, *args, **kw):
+        from ..data.structured import StructuredDesign
+        if args and isinstance(args[0], StructuredDesign):
+            engine[0] = "structured"
+        before = kernel._cache_size()
+        out = _traced_call(kernel, tracer, target, *args,
+                           engine=engine[0], **kw)
+        compiles[0] += kernel._cache_size() - before
+        return out
+
+    with _obs_trace.ambient(tracer):
+        if tracer is not None:
+            tracer.emit("fit_start", model="penalized_path_streaming",
+                        family=fam.name, link=lnk.name,
+                        alpha=float(penalty.alpha))
+        # pass 1: standardization stats (first/second weighted moments)
+        totals, chunks, rows = _stream_pass(
+            source, "penalized_stats", tracer, bucket, dtype,
+            lambda Xc, yc, wc, oc: counted(
+                _stats_chunk_kernel, "penalized_stats", Xc, yc, wc, oc,
+                precision=mmp))
+        if rows == 0:
+            raise ValueError("chunk source produced no rows")
+        wsum = float(totals["wsum"])
+        if wsum <= 0:
+            raise ValueError("weights sum to zero; nothing to fit")
+        n_ok = int(totals["n_ok"])
+        pen = pfv > 0.0
+        sd = _sd_from_moments(np.diag(totals["A"]) / wsum,
+                              totals["s1"] / wsum, pen,
+                              penalty.standardize, p)
+        isd = 1.0 / sd
+
+        # pass 2..k: intercept-only null IRLS (scalar chunk partials)
+        def null_pass(b0, first):
+            tot, _, _ = _stream_pass(
+                source, "penalized_null", tracer, bucket, dtype,
+                lambda Xc, yc, wc, oc: counted(
+                    _null_chunk_kernel,
+                    "penalized_null_first" if first else "penalized_null",
+                    yc, wc, oc, np.asarray(b0, dtype), fam_param,
+                    first=first, **fam_kw))
+            return (float(tot["sw"]), float(tot["swz"]), float(tot["dev"]))
+
+        b0 = 0.0
+        if icol is not None:
+            sw, swz, dev_prev = null_pass(0.0, True)
+            for it in range(_NULL_MAX_ITER):
+                b0 = swz / max(sw, _TINY)
+                sw, swz, dev = null_pass(b0, False)
+                if abs(dev - dev_prev) <= _NULL_TOL * (abs(dev) + 0.1):
+                    dev_prev = dev
+                    break
+                dev_prev = dev
+            null_dev = dev_prev
+        else:
+            _, _, null_dev = null_pass(0.0, False)
+
+        # lambda_max gradient at the null solution
+        gtot, _, _ = _stream_pass(
+            source, "penalized_grad", tracer, bucket, dtype,
+            lambda Xc, yc, wc, oc: counted(
+                _grad_chunk_kernel, "penalized_grad", Xc, yc, wc, oc,
+                np.asarray(b0, dtype), fam_param, **fam_kw))
+        g = (gtot["u"] - b0 * gtot["v"]) * isd / wsum
+        al = max(float(penalty.alpha), _ALPHA_FLOOR)
+        lam_max = float(np.max(np.where(
+            pen, np.abs(g) / (al * np.maximum(pfv, _TINY)), 0.0)))
+        lam_max = max(lam_max, _TINY)
+        lams = _grid_from(lam_max, penalty, rows,
+                          p - (1 if icol is not None else 0))
+
+        # the path: host lambda loop, host IRLS loop, compiled passes
+        alpha = float(penalty.alpha)
+        free = ~pen
+        ever = np.zeros(p, bool)
+        beta_std = np.zeros(p)
+        if icol is not None:
+            beta_std[icol] = b0
+        lam_prev = lam_max
+        betas, dfs, devs, its, sws, convs, kkts = [], [], [], [], [], [], []
+
+        def fisher(beta_orig):
+            tot, _, _ = _stream_pass(
+                source, "penalized_fisher", tracer, bucket, dtype,
+                lambda Xc, yc, wc, oc: counted(
+                    _fisher_chunk_kernel, "penalized_fisher", Xc, yc, wc,
+                    oc, beta_orig.astype(dtype), fam_param, **fam_kw))
+            As = (tot["A"] / wsum) * isd[:, None] * isd[None, :]
+            bs = (tot["b"] / wsum) * isd
+            return As, bs, float(tot["dev"])
+
+        for k, lam in enumerate(lams):
+            lam = float(lam)
+            strong = pen & (np.abs(g)
+                            >= alpha * pfv * (2.0 * lam - lam_prev) - 1e-12)
+            mask = free | ever | strong
+            go, rounds = True, 0
+            it_total = sweeps_total = 0
+            crit = np.inf
+            while go and rounds < _KKT_ROUNDS:
+                it = 0
+                while it == 0 or (crit > penalty.tol
+                                  and it < penalty.max_iter):
+                    As, bs, _ = fisher(beta_std * isd)
+                    sol = counted(
+                        _cd_solve_kernel, "penalized_cd",
+                        As.astype(dtype), bs.astype(dtype),
+                        beta_std.astype(dtype), np.asarray(lam, dtype),
+                        np.asarray(alpha, dtype), pfv.astype(dtype),
+                        mask, np.asarray(penalty.cd_tol, dtype),
+                        cd_max_sweeps=penalty.cd_max_sweeps)
+                    beta_std = np.asarray(sol["beta"], np.float64)
+                    crit = float(sol["crit"])
+                    sweeps_total += int(sol["sweeps"])
+                    it += 1
+                it_total += it
+                g = np.asarray(sol["g"], np.float64)
+                viol = pen & ~mask & (np.abs(g)
+                                      > alpha * pfv * lam * (1 + 1e-4)
+                                      + 1e-9)
+                mask |= viol
+                go = bool(viol.any())
+                rounds += 1
+            beta_orig = beta_std * isd
+            dtot, _, _ = _stream_pass(
+                source, "penalized_dev", tracer, bucket, dtype,
+                lambda Xc, yc, wc, oc: counted(
+                    _dev_chunk_kernel, "penalized_dev", Xc, yc, wc, oc,
+                    beta_orig.astype(dtype), fam_param, **fam_kw))
+            dev = float(dtot["dev"])
+            nz = pen & (np.abs(beta_std) > 0.0)
+            ever |= nz
+            lam_prev = lam
+            betas.append(beta_orig)
+            dfs.append(int(nz.sum()))
+            devs.append(dev)
+            its.append(it_total)
+            sws.append(sweeps_total)
+            convs.append(crit <= penalty.tol)
+            kkts.append(not go)
+            if tracer is not None:
+                tracer.emit("path_point", index=k, lambda_=lam,
+                            df=int(nz.sum()), deviance=dev, iters=it_total,
+                            sweeps=sweeps_total)
+                tracer.emit("solve", target="path_lambda", index=k,
+                            iters=it_total)
+
+        out = dict(lambdas=np.asarray(lams), beta=np.asarray(betas),
+                   dev=np.asarray(devs), null_dev=null_dev,
+                   df=np.asarray(dfs), conv=np.asarray(convs),
+                   kkt_ok=np.asarray(kkts), iters=np.asarray(its),
+                   sweeps=np.asarray(sws))
+        return assemble_path_model(
+            out, penalty=penalty, fam=fam, lnk=lnk, xnames=xnames,
+            yname=yname, n_obs=rows, n_ok=n_ok,
+            has_intercept=bool(has_intercept), kind="glm", engine=engine[0],
+            tracer=tracer, compiles=int(compiles[0]), has_offset=False)

@@ -192,9 +192,10 @@ class Scorer:
         has_off = (getattr(self.model, "offset_col", None) is not None
                    or getattr(self.model, "has_offset", False))
         # warm the representation live requests will use: structured when
-        # the terms want it (se_fit densifies, so it warms the dense family)
+        # the terms want it (the se quadform runs structured too, via
+        # ops/factor_gramian.structured_quadform)
         lay = (structured_layout(self.model.terms)
-               if (self.model.terms is not None and not self.se_fit
+               if (self.model.terms is not None
                    and wants_structured(self.model.terms)) else None)
         done = []
         for b in sorted(set(int(x) for x in buckets)):

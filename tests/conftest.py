@@ -44,6 +44,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "multichip: exercises a multi-device mesh (virtual CPU "
         "devices in tier-1; selectable for real-pod runs)")
+    config.addinivalue_line(
+        "markers", "penalized: the elastic-net path subsystem "
+        "(`make penalized` selects these; still tier-1 by default)")
 
 
 @pytest.fixture(scope="session")

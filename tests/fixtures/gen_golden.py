@@ -259,6 +259,116 @@ def r_influence(X, y, family=None, link=None, wt=None, offset=None, m=None,
 # cases
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# glmnet-semantics elastic-net path oracle (independent of sparkglm_tpu)
+# ---------------------------------------------------------------------------
+
+_DLINK = {  # d eta / d mu
+    "identity": lambda m: np.ones_like(m),
+    "logit": lambda m: 1.0 / (m * (1 - m)),
+    "log": lambda m: 1.0 / m,
+}
+
+
+def _enet_cd(A, b, beta, lam, alpha, pf, tol=1e-14, sweeps=100000):
+    """Cyclic coordinate descent for
+    min 0.5 b'Ab - b'b_vec + lam sum_j pf_j (alpha |b_j| + (1-alpha)/2 b_j^2)
+    — the glmnet covariance-update form on an (averaged) Gramian."""
+    diag = np.diag(A).copy()
+    p = len(b)
+    for _ in range(sweeps):
+        dmax = 0.0
+        for j in range(p):
+            g = b[j] - A[j] @ beta + diag[j] * beta[j]
+            t = lam * alpha * pf[j]
+            bj = (np.sign(g) * max(abs(g) - t, 0.0)
+                  / max(diag[j] + lam * (1.0 - alpha) * pf[j], 1e-300))
+            dmax = max(dmax, diag[j] * (bj - beta[j]) ** 2)
+            beta[j] = bj
+        if dmax < tol:
+            break
+    return beta
+
+
+def glmnet_path(X, y, family, link, alpha, lambdas, wt=None,
+                standardize=True):
+    """Elastic-net lambda path with glmnet's exact semantics, derived
+    independently of sparkglm_tpu:
+
+      * prior weights normalized to sum 1 (every Gramian is an observation
+        average — glmnet's internal ``w = w/sum(w)``);
+      * objective  sum_i (w_i/sum w) nll_i
+                   + lam sum_j pf_j (alpha |b_j| + (1-alpha)/2 b_j^2);
+      * ``standardize=TRUE``: columns scaled by the weighted sd about the
+        weighted mean (1/n denominator) WITHOUT centering — the unpenalized
+        intercept absorbs centering exactly; coefficients are reported on
+        the ORIGINAL x scale;
+      * the intercept (column 0 in every fixture) is never penalized.
+
+    Full cyclic CD (no screening) + IRLS to tight tolerance per lambda,
+    warm-started along the descending grid.  Returns
+    (coefs (n_lambda, p), deviances, null_deviance) with deviance on the
+    RAW prior weights — R/glmnet's ``dev.ratio`` denominator scale."""
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.float64)
+    n, p = X.shape
+    wt = np.ones(n) if wt is None else np.asarray(wt, np.float64)
+    wp = wt / wt.sum()
+    pf = np.ones(p)
+    pf[0] = 0.0
+    xm = wp @ X
+    x2 = wp @ (X * X)
+    if standardize:
+        sdv = np.sqrt(np.maximum(x2 - xm ** 2, 0.0))
+        sd = np.where((pf > 0) & (sdv > 1e-10), sdv, 1.0)
+    else:
+        sd = np.ones(p)
+    Xs = X / sd
+
+    # null model: intercept-only IRLS (the warm start for the first lambda)
+    mubar = float(wp @ y)
+    if family == "binomial":
+        mubar = min(max(mubar, 1e-10), 1 - 1e-10)
+    elif family == "poisson":
+        mubar = max(mubar, 1e-10)
+    b0 = {"identity": lambda m: m, "logit": sp.logit,
+          "log": np.log}[link](mubar)
+    for _ in range(200):
+        eta0 = np.full(n, b0)
+        mu0 = _linkinv(link, eta0)
+        gp = _DLINK[link](mu0)
+        w0 = wp / (_variance(family, mu0) * gp * gp)
+        z0 = eta0 + (y - mu0) * gp
+        b0_new = float(np.sum(w0 * z0) / np.sum(w0))
+        if abs(b0_new - b0) < 1e-14:
+            b0 = b0_new
+            break
+        b0 = b0_new
+    null_dev = float(np.sum(_dev_resids(family, y, _linkinv(
+        link, np.full(n, b0)), wt)))
+
+    beta = np.zeros(p)
+    beta[0] = b0           # sd[0] == 1 (unpenalized), so scales coincide
+    coefs, devs = [], []
+    for lam in lambdas:
+        for _ in range(200):
+            eta = Xs @ beta
+            mu = _linkinv(link, eta)
+            gp = _DLINK[link](mu)
+            w = wp / (_variance(family, mu) * gp * gp)
+            z = eta + (y - mu) * gp
+            A = (Xs * w[:, None]).T @ Xs
+            bvec = Xs.T @ (w * z)
+            prev = beta.copy()
+            beta = _enet_cd(A, bvec, beta.copy(), float(lam), alpha, pf)
+            if np.max(np.diag(A) * (beta - prev) ** 2) < 1e-14:
+                break
+        mu = _linkinv(link, Xs @ beta)
+        devs.append(float(np.sum(_dev_resids(family, y, mu, wt))))
+        coefs.append((beta / sd).tolist())
+    return coefs, devs, null_dev
+
+
 def main():
     cases = {}
 
@@ -571,13 +681,112 @@ def main():
                    "path; R cross-check: glm(y ~ x + f, poisson)")
 
     cases["formula_cases"] = fcases
+    cases["penalized_cases"] = penalized_cases()
 
     out = os.path.join(HERE, "r_golden.json")
     with open(out, "w") as f:
         json.dump(cases, f, indent=1)
-    print(f"wrote {out} with {len(cases) - 1} cases + "
-          f"{len(fcases)} formula cases")
+    print(f"wrote {out} with {len(cases) - 2} cases + "
+          f"{len(fcases)} formula cases + "
+          f"{len(cases['penalized_cases'])} penalized cases")
+
+
+def penalized_cases():
+    """Elastic-net golden paths (glmnet semantics).  A fresh seeded stream,
+    callable standalone: when only this section changes, splice it into the
+    committed r_golden.json rather than regenerating the whole file — float
+    last-ulp noise across BLAS builds would churn the byte-identical
+    legacy cases (``python gen_golden.py --splice-penalized``)."""
+    prng = np.random.default_rng(20260805)
+    pcases = {}
+
+    def _pen_case(name, family, link, X, y, data, formula, xnames,
+                  lambdas, wt=None, weights_col=None, r_family=None):
+        fits = {}
+        for alpha in (1.0, 0.5, 0.0):
+            coefs, devs, nulldev = glmnet_path(X, y, family, link, alpha,
+                                               lambdas, wt=wt)
+            fits[f"alpha_{alpha:g}"] = dict(
+                alpha=alpha, coefficients=coefs, deviance=devs,
+                null_deviance=nulldev)
+        pcases[name] = dict(
+            data=data, formula=formula, family=family, link=link,
+            xnames=xnames, lambdas=list(lambdas), standardize=True,
+            weights=weights_col, fits=fits,
+            provenance="synthetic; oracle64 elastic-net CD+IRLS (glmnet "
+                       "semantics: sum-1 weight normalization, weighted-sd "
+                       "standardization without centering, coefficients on "
+                       "the original scale, unpenalized intercept); R "
+                       f"cross-check: glmnet(x, y, family='{r_family or family}'"
+                       ", alpha=a, lambda=c(...), standardize=TRUE, "
+                       "thresh=1e-14) for a in c(1, 0.5, 0)")
+
+    # P1: gaussian/identity with non-uniform weights (exercises the sum-1
+    # weight normalization and the Gramian-level gaussian path kernel)
+    np1 = 150
+    Xp1 = prng.standard_normal((np1, 4))
+    wp1 = prng.uniform(0.5, 2.0, np1)
+    yp1 = (0.5 + 1.2 * Xp1[:, 0] - 0.8 * Xp1[:, 1] + 0.3 * Xp1[:, 2]
+           + 0.4 * prng.standard_normal(np1))
+    _pen_case(
+        "gaussian_enet", "gaussian", "identity",
+        np.column_stack([np.ones(np1), Xp1]), yp1,
+        data=dict(y=yp1.tolist(), x1=Xp1[:, 0].tolist(),
+                  x2=Xp1[:, 1].tolist(), x3=Xp1[:, 2].tolist(),
+                  x4=Xp1[:, 3].tolist(), w=wp1.tolist()),
+        formula="y ~ x1 + x2 + x3 + x4",
+        xnames=["intercept", "x1", "x2", "x3", "x4"],
+        lambdas=[0.5, 0.2, 0.05, 0.01, 0.002], wt=wp1, weights_col="w")
+
+    # P2: binomial/logit
+    np2 = 200
+    Xp2 = prng.standard_normal((np2, 4))
+    pr2 = sp.expit(-0.3 + 1.0 * Xp2[:, 0] - 0.7 * Xp2[:, 1])
+    yp2 = prng.binomial(1, pr2).astype(float)
+    _pen_case(
+        "binomial_enet", "binomial", "logit",
+        np.column_stack([np.ones(np2), Xp2]), yp2,
+        data=dict(y=yp2.tolist(), x1=Xp2[:, 0].tolist(),
+                  x2=Xp2[:, 1].tolist(), x3=Xp2[:, 2].tolist(),
+                  x4=Xp2[:, 3].tolist()),
+        formula="y ~ x1 + x2 + x3 + x4",
+        xnames=["intercept", "x1", "x2", "x3", "x4"],
+        lambdas=[0.1, 0.05, 0.02, 0.008, 0.002])
+
+    # P3: poisson/log
+    np3 = 180
+    Xp3 = prng.standard_normal((np3, 4))
+    mu3 = np.exp(0.3 + 0.5 * Xp3[:, 0] - 0.4 * Xp3[:, 1])
+    yp3 = prng.poisson(np.clip(mu3, 0, 40)).astype(float)
+    _pen_case(
+        "poisson_enet", "poisson", "log",
+        np.column_stack([np.ones(np3), Xp3]), yp3,
+        data=dict(y=yp3.tolist(), x1=Xp3[:, 0].tolist(),
+                  x2=Xp3[:, 1].tolist(), x3=Xp3[:, 2].tolist(),
+                  x4=Xp3[:, 3].tolist()),
+        formula="y ~ x1 + x2 + x3 + x4",
+        xnames=["intercept", "x1", "x2", "x3", "x4"],
+        lambdas=[0.3, 0.1, 0.04, 0.01, 0.003])
+
+    return pcases
+
+
+def splice_penalized():
+    """Rewrite ONLY the penalized_cases key of the committed r_golden.json,
+    leaving every other case's bytes untouched (json round-trips Python
+    floats through their shortest repr, so load -> dump is byte-stable)."""
+    out = os.path.join(HERE, "r_golden.json")
+    with open(out) as f:
+        cases = json.load(f)
+    cases["penalized_cases"] = penalized_cases()
+    with open(out, "w") as f:
+        json.dump(cases, f, indent=1)
+    print(f"spliced penalized_cases "
+          f"({len(cases['penalized_cases'])} cases) into {out}")
 
 
 if __name__ == "__main__":
-    main()
+    if "--splice-penalized" in sys.argv:
+        splice_penalized()
+    else:
+        main()

@@ -1,0 +1,475 @@
+"""Penalized GLM subsystem (sparkglm_tpu/penalized) — elastic-net paths.
+
+The contracts under test, in the order the subsystem promises them:
+
+  * glmnet-semantics golden parity (tests/fixtures/r_golden.json
+    ``penalized_cases``: an independent f64 CD+IRLS oracle with glmnet's
+    weight normalization / no-centering standardization — PARITY.md r11
+    documents the correspondence and these tolerances);
+  * the ONE-EXECUTABLE lambda path: the whole grid is a lax.scan with
+    lambda traced, so a second same-shape fit adds ZERO executables and
+    the first adds exactly one per pass flavor (jit cache-size deltas,
+    the data/pipeline.py counting idiom);
+  * warm-start determinism: the scan carry is forward-only, so fitting an
+    explicit prefix of the auto grid reproduces the full path's prefix
+    BIT-identically;
+  * ``penalty=None`` keeps the ordinary fits byte-identical;
+  * a PathModel selects back into an ordinary LMModel/GLMModel that
+    predicts, serializes, and serves;
+  * the streaming drivers (``*_from_csv(penalty=...)``) agree with the
+    resident path.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu import ElasticNet
+from sparkglm_tpu.config import NumericConfig
+from sparkglm_tpu.obs import FitTracer, RingBufferSink
+
+
+def _ring():
+    ring = RingBufferSink()
+    return ring, FitTracer(sinks=[ring])
+
+pytestmark = pytest.mark.penalized
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "r_golden.json")
+with open(FIXTURES) as f:
+    PEN_GOLDEN = json.load(f)["penalized_cases"]
+
+F64 = NumericConfig(dtype="float64")
+
+
+def _golden_params():
+    return [(name, akey) for name in sorted(PEN_GOLDEN)
+            for akey in sorted(PEN_GOLDEN[name]["fits"])]
+
+
+# ---------------------------------------------------------------------------
+# glmnet-semantics golden parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name,akey", _golden_params())
+def test_penalized_golden(name, akey):
+    case = PEN_GOLDEN[name]
+    fit = case["fits"][akey]
+    data = {k: np.asarray(v) for k, v in case["data"].items()}
+    pen = ElasticNet(alpha=fit["alpha"], lambdas=case["lambdas"])
+    pm = sg.glm(case["formula"], data, family=case["family"],
+                link=case["link"], weights=case.get("weights"),
+                penalty=pen, config=F64)
+    assert list(pm.xnames) == case["xnames"]
+    assert len(pm) == len(case["lambdas"])
+    # PARITY.md r11 tolerances: f32/f64 solver vs the f64 oracle, both
+    # stopping at their own cd_tol
+    np.testing.assert_allclose(pm.coefficients, fit["coefficients"],
+                               atol=2e-4, rtol=1e-4)
+    np.testing.assert_allclose(pm.deviance, fit["deviance"], rtol=1e-4)
+    assert pm.null_deviance == pytest.approx(fit["null_deviance"],
+                                             rel=1e-6)
+    assert pm.converged and pm.kkt_clean
+
+
+def test_gaussian_kind_lm_matches_glm_kernel():
+    """The lm front-end and the gaussian glm front-end share the Gramian
+    path kernel — identical numbers, different selected-model class."""
+    case = PEN_GOLDEN["gaussian_enet"]
+    data = {k: np.asarray(v) for k, v in case["data"].items()}
+    pen = ElasticNet(alpha=0.5, lambdas=case["lambdas"])
+    pl = sg.lm(case["formula"], data, weights="w", penalty=pen, config=F64)
+    pg = sg.glm(case["formula"], data, family="gaussian", link="identity",
+                weights="w", penalty=pen, config=F64)
+    np.testing.assert_array_equal(pl.coefficients, pg.coefficients)
+    assert pl.kind == "lm" and pg.kind == "glm"
+    assert type(pl.select(criterion="bic")).__name__ == "LMModel"
+    assert type(pg.select(criterion="bic")).__name__ == "GLMModel"
+
+
+# ---------------------------------------------------------------------------
+# the one-executable contract + warm-start determinism
+# ---------------------------------------------------------------------------
+
+
+def _sim(seed, n=300, p=6, family="binomial"):
+    r = np.random.default_rng(seed)
+    X = r.standard_normal((n, p))
+    eta = 0.4 + X[:, 0] - 0.6 * X[:, 1]
+    if family == "binomial":
+        y = r.binomial(1, 1 / (1 + np.exp(-eta))).astype(float)
+    else:
+        y = eta + r.normal(scale=0.5, size=n)
+    cols = {f"x{i}": X[:, i] for i in range(p)}
+    cols["y"] = y
+    return cols
+
+
+FORMULA6 = "y ~ x0 + x1 + x2 + x3 + x4 + x5"
+
+
+def test_glm_path_is_one_executable():
+    """The whole binomial lambda path — grid generation, strong rules,
+    KKT loops, every IRLS solve — compiles as ONE executable; a second
+    same-shape fit on different data adds zero."""
+    from sparkglm_tpu.penalized.path import _glm_path_kernel
+
+    pen = ElasticNet(alpha=0.8, n_lambda=25)
+    # warm the traced flavor (trace= is a static: it bakes in the debug
+    # callbacks), then a same-shape different-data fit must add ZERO
+    sg.glm(FORMULA6, _sim(0), family="binomial", penalty=pen,
+           trace=_ring()[1], config=F64)
+    base = _glm_path_kernel._cache_size()
+    pm = sg.glm(FORMULA6, _sim(1), family="binomial", penalty=pen,
+                trace=_ring()[1], config=F64)
+    assert _glm_path_kernel._cache_size() - base == 0
+    assert pm.fit_info["path"]["executables"] == 0
+    # a COLD shape compiles exactly ONE executable for the whole path
+    before = _glm_path_kernel._cache_size()
+    pm3 = sg.glm("y ~ x0 + x1 + x2", _sim(2, p=3), family="binomial",
+                 penalty=pen, trace=_ring()[1], config=F64)
+    assert _glm_path_kernel._cache_size() - before == 1
+    assert pm3.fit_info["path"]["executables"] == 1
+
+
+def test_gram_path_is_two_executables():
+    """Gaussian/identity: one stats pass + one Gramian-level path kernel
+    (the acceptance bound: a full path compiles <= 2 executables)."""
+    from sparkglm_tpu.penalized.path import (_gram_path_kernel,
+                                             _quad_stats_kernel)
+
+    pen = ElasticNet(alpha=0.5, n_lambda=30)
+    pm = sg.lm(FORMULA6, _sim(3, family="gaussian"), penalty=pen,
+               trace=_ring()[1], config=F64)
+    assert pm.fit_info["path"]["executables"] <= 2     # acceptance bound
+    bs, bp = _quad_stats_kernel._cache_size(), _gram_path_kernel._cache_size()
+    sg.lm(FORMULA6, _sim(4, family="gaussian"), penalty=pen,
+          trace=_ring()[1], config=F64)
+    assert _quad_stats_kernel._cache_size() - bs == 0
+    assert _gram_path_kernel._cache_size() - bp == 0
+
+
+def test_lambda_is_traced_across_grids():
+    """Different explicit lambda VALUES (same grid length) reuse the same
+    executable — lambda is a traced operand, not a static."""
+    from sparkglm_tpu.penalized.path import _glm_path_kernel
+
+    data = _sim(5)
+    sg.glm(FORMULA6, data, family="binomial",
+           penalty=ElasticNet(lambdas=[0.3, 0.1, 0.03]), config=F64)
+    base = _glm_path_kernel._cache_size()
+    sg.glm(FORMULA6, data, family="binomial",
+           penalty=ElasticNet(lambdas=[0.25, 0.08, 0.02]), config=F64)
+    assert _glm_path_kernel._cache_size() - base == 0
+
+
+def test_warm_start_prefix_property():
+    """Fitting the first k auto-grid lambdas explicitly reproduces the
+    full path's first k rows BIT-identically: the scan carry is
+    forward-only, so the path up to lambda_k cannot depend on anything
+    after it."""
+    data = _sim(6)
+    full = sg.glm(FORMULA6, data, family="binomial",
+                  penalty=ElasticNet(alpha=0.7, n_lambda=20), config=F64)
+    k = 5
+    prefix = sg.glm(FORMULA6, data, family="binomial",
+                    penalty=ElasticNet(alpha=0.7,
+                                       lambdas=full.lambdas[:k].tolist()),
+                    config=F64)
+    np.testing.assert_array_equal(prefix.coefficients,
+                                  full.coefficients[:k])
+    np.testing.assert_array_equal(prefix.deviance, full.deviance[:k])
+    np.testing.assert_array_equal(prefix.df, full.df[:k])
+
+
+def test_path_shape_and_monotonicity():
+    pm = sg.glm(FORMULA6, _sim(7), family="binomial",
+                penalty=ElasticNet(alpha=1.0, n_lambda=30), config=F64)
+    assert pm.coefficients.shape == (30, 7)
+    assert np.all(np.diff(pm.lambdas) < 0)          # descending grid
+    assert pm.df[0] == 0                            # lambda_max: all zero
+    assert np.all(np.diff(pm.deviance) <= 1e-6)     # deviance decreases
+    assert pm.dev_ratio[-1] > pm.dev_ratio[0]
+
+
+def test_penalty_none_is_bit_identical():
+    """penalty=None must not perturb the ordinary fits at all."""
+    data = _sim(8)
+    a = sg.glm(FORMULA6, data, family="binomial", config=F64)
+    b = sg.glm(FORMULA6, data, family="binomial", penalty=None, config=F64)
+    assert type(b) is type(a)
+    np.testing.assert_array_equal(a.coefficients, b.coefficients)
+    np.testing.assert_array_equal(a.std_errors, b.std_errors)
+    assert a.deviance == b.deviance
+
+
+def test_unsupported_options_raise():
+    data = _sim(9)
+    pen = ElasticNet(n_lambda=5)
+    with pytest.raises(ValueError, match="mesh"):
+        sg.glm(FORMULA6, data, family="binomial", penalty=pen, mesh=object())
+    with pytest.raises(ValueError, match="beta0"):
+        sg.glm(FORMULA6, data, family="binomial", penalty=pen,
+               beta0=np.zeros(7))
+    with pytest.raises(ValueError, match="engine"):
+        sg.lm(FORMULA6, data, penalty=pen, engine="qr")
+
+
+def test_elasticnet_validation():
+    with pytest.raises(ValueError):
+        ElasticNet(alpha=1.5)
+    with pytest.raises(ValueError):
+        ElasticNet(n_lambda=0)
+    with pytest.raises(ValueError):
+        ElasticNet(lambdas=[0.1, -0.5])
+    with pytest.raises(ValueError):
+        ElasticNet(lambda_min_ratio=2.0)
+    # lambdas are stored sorted descending regardless of input order
+    assert ElasticNet(lambdas=[0.01, 1.0, 0.1]).resolved_lambdas().tolist() \
+        == [1.0, 0.1, 0.01]
+    with pytest.raises(TypeError):
+        sg.glm(FORMULA6, _sim(10), family="binomial", penalty="lasso")
+
+
+# ---------------------------------------------------------------------------
+# PathModel -> ordinary model: select / predict / serialize / serve
+# ---------------------------------------------------------------------------
+
+
+def test_select_and_criteria():
+    pm = sg.glm(FORMULA6, _sim(11), family="binomial",
+                penalty=ElasticNet(alpha=1.0, n_lambda=25), config=F64)
+    with pytest.raises(ValueError):
+        pm.select()                                   # exactly one required
+    with pytest.raises(ValueError):
+        pm.select(lambda_=0.1, criterion="aic")
+    with pytest.raises(ValueError):
+        pm.select(criterion="cp")
+    m_aic = pm.select(criterion="aic")
+    m_bic = pm.select(criterion="bic")
+    i_aic = m_aic.fit_info["penalized"]["lambda_index"]
+    assert i_aic == int(np.argmin(pm.criterion_values("aic")))
+    # BIC penalizes df harder: never selects a denser model than AIC
+    assert (m_bic.fit_info["penalized"]["df"]
+            <= m_aic.fit_info["penalized"]["df"])
+    # select by lambda_ lands on the nearest grid point
+    m_at = pm.select(lambda_=float(pm.lambdas[3]) * 1.01)
+    assert m_at.fit_info["penalized"]["lambda_index"] == 3
+    np.testing.assert_array_equal(m_at.coefficients, pm.coefficients[3])
+    # no post-selection sampling theory: NaN SEs, real deviance
+    assert np.all(np.isnan(m_at.std_errors))
+    assert m_at.deviance == pytest.approx(float(pm.deviance[3]))
+
+
+def test_selected_model_predicts_and_serializes(tmp_path):
+    data = _sim(12)
+    pm = sg.glm(FORMULA6, data, family="binomial",
+                penalty=ElasticNet(alpha=0.5, n_lambda=20), config=F64)
+    m = pm.select(criterion="bic")
+    mu = sg.predict(m, data, type="response")
+    assert mu.shape == (300,) and np.all((mu > 0) & (mu < 1))
+    path = os.path.join(tmp_path, "selected.json")
+    sg.save_model(m, path)
+    m2 = sg.load_model(path)
+    np.testing.assert_array_equal(m2.coefficients, m.coefficients)
+    assert m2.fit_info["penalized"]["alpha"] == 0.5
+    np.testing.assert_allclose(sg.predict(m2, data, type="response"), mu,
+                               rtol=1e-12)
+
+
+def test_pathmodel_round_trips(tmp_path):
+    """The PATH itself serializes too — coefficient matrix, grid, penalty
+    spec and all — and select() works identically after reload."""
+    pm = sg.glm(FORMULA6, _sim(21), family="binomial",
+                penalty=ElasticNet(alpha=0.4, n_lambda=10,
+                                   penalty_factor=[1, 1, 1, 0, 1, 1]),
+                config=F64)
+    path = os.path.join(tmp_path, "path_model")
+    sg.save_model(pm, path)
+    pm2 = sg.load_model(path)
+    assert type(pm2).__name__ == "PathModel"
+    np.testing.assert_array_equal(pm2.coefficients, pm.coefficients)
+    np.testing.assert_array_equal(pm2.lambdas, pm.lambdas)
+    assert pm2.penalty == pm.penalty
+    m, m2 = pm.select(criterion="bic"), pm2.select(criterion="bic")
+    np.testing.assert_array_equal(m2.coefficients, m.coefficients)
+    assert m2.fit_info["penalized"] == m.fit_info["penalized"]
+
+
+def test_selected_model_serves():
+    from sparkglm_tpu.serve import Scorer
+
+    data = _sim(13)
+    pm = sg.glm(FORMULA6, data, family="binomial",
+                penalty=ElasticNet(alpha=1.0, n_lambda=15), config=F64)
+    m = pm.select(criterion="aic")
+    sc = Scorer(m, min_bucket=8)
+    req = {k: v[:5] for k, v in data.items() if k != "y"}
+    out = sc.score(req)
+    np.testing.assert_allclose(
+        out, sg.predict(m, req, type="response"), rtol=1e-12)
+
+
+def test_trace_and_fit_report():
+    ring, tr = _ring()
+    pm = sg.glm(FORMULA6, _sim(14), family="binomial",
+                penalty=ElasticNet(alpha=0.9, n_lambda=12), trace=tr,
+                config=F64)
+    kinds = ring.kinds()
+    assert kinds.count("path_point") == 12
+    assert "fit_start" in kinds and "fit_end" in kinds
+    pts = [e for e in ring.events if e.kind == "path_point"]
+    assert [p.fields["index"] for p in pts] == list(range(12))
+    solves = [e for e in ring.events if e.kind == "solve"
+              and e.fields.get("target") == "path_lambda"]
+    assert len(solves) == 12
+    rep = pm.fit_report()
+    assert rep["path"]["n_lambda"] == 12
+    assert rep["path"]["lambda_max"] == pytest.approx(float(pm.lambdas[0]))
+    assert rep["path"]["cd_sweeps_total"] > 0
+
+
+# ---------------------------------------------------------------------------
+# structured designs + streaming drivers
+# ---------------------------------------------------------------------------
+
+
+def test_structured_design_path():
+    """A wide factor routes the path through the segment-sum Gramian;
+    numbers match the dense one-hot route."""
+    r = np.random.default_rng(15)
+    n, L = 2000, 40
+    data = {"x": r.standard_normal(n),
+            "f": np.array([f"L{i:02d}" for i in r.integers(0, L, n)]),
+            }
+    eta = 0.3 + 0.5 * data["x"]
+    data["y"] = r.binomial(1, 1 / (1 + np.exp(-eta))).astype(float)
+    pen = ElasticNet(alpha=0.5, n_lambda=10)
+    ps = sg.glm("y ~ x + f", data, family="binomial", penalty=pen,
+                design="structured", config=F64)
+    pd = sg.glm("y ~ x + f", data, family="binomial", penalty=pen,
+                design="dense", config=F64)
+    assert ps.gramian_engine == "structured"
+    assert pd.gramian_engine == "einsum"
+    np.testing.assert_allclose(ps.coefficients, pd.coefficients,
+                               atol=1e-8)
+    np.testing.assert_allclose(ps.deviance, pd.deviance, rtol=1e-10)
+
+
+def _write_csv(tmp_path, data, name="pen.csv"):
+    import csv
+    path = os.path.join(tmp_path, name)
+    keys = list(data)
+    with open(path, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(keys)
+        for i in range(len(data[keys[0]])):
+            w.writerow([data[k][i] for k in keys])
+    return path
+
+
+def test_streaming_glm_path_matches_resident(tmp_path):
+    data = _sim(16)
+    pen = ElasticNet(alpha=0.6, n_lambda=12)
+    res = sg.glm(FORMULA6, data, family="binomial", penalty=pen, config=F64)
+    path = _write_csv(tmp_path, data)
+    ring, tr = _ring()
+    strm = sg.glm_from_csv(FORMULA6, path, family="binomial", penalty=pen,
+                           chunk_bytes=8192, trace=tr, config=F64)
+    np.testing.assert_allclose(strm.coefficients, res.coefficients,
+                               atol=1e-7)
+    np.testing.assert_allclose(strm.deviance, res.deviance, rtol=1e-8)
+    np.testing.assert_allclose(strm.lambdas, res.lambdas, rtol=1e-10)
+    # the chunked passes + lambda-traced CD solve are a FIXED executable
+    # set: compile events happen once per flavor, not per chunk or lambda
+    assert [e.fields["index"] for e in ring.events
+            if e.kind == "path_point"] == list(range(12))
+
+
+def test_streaming_lm_path_matches_resident(tmp_path):
+    data = _sim(17, family="gaussian")
+    pen = ElasticNet(alpha=0.5, n_lambda=15)
+    res = sg.lm(FORMULA6, data, penalty=pen, config=F64)
+    path = _write_csv(tmp_path, data)
+    strm = sg.lm_from_csv(FORMULA6, path, penalty=pen, chunk_bytes=8192,
+                          config=F64)
+    # ONE data pass accumulates the Gramian; the path then runs on it —
+    # host-f64 left-to-right accumulation vs the resident one-shot kernel
+    np.testing.assert_allclose(strm.coefficients, res.coefficients,
+                               atol=1e-7)
+    assert strm.kind == "lm"
+    assert type(strm.select(criterion="bic")).__name__ == "LMModel"
+
+
+def test_streaming_rejects_unsupported(tmp_path):
+    data = _sim(18)
+    path = _write_csv(tmp_path, data)
+    pen = ElasticNet(n_lambda=5)
+    with pytest.raises(ValueError, match="prefetch"):
+        sg.glm_from_csv(FORMULA6, path, family="binomial", penalty=pen,
+                        prefetch=2)
+    with pytest.raises(ValueError, match="resume"):
+        sg.lm_from_csv(FORMULA6, path, penalty=pen, resume=True)
+
+
+# ---------------------------------------------------------------------------
+# solver details
+# ---------------------------------------------------------------------------
+
+
+def test_ridge_matches_closed_form():
+    """alpha=0 gaussian with standardize: CD must land on the exact ridge
+    normal-equation solution."""
+    r = np.random.default_rng(19)
+    n, p = 400, 5
+    X = r.standard_normal((n, p))
+    y = X @ np.array([1.0, -0.5, 0.3, 0.0, 0.2]) + r.normal(scale=0.4,
+                                                            size=n)
+    data = {f"x{i}": X[:, i] for i in range(p)}
+    data["y"] = y
+    lam = 0.7
+    pm = sg.lm("y ~ x0 + x1 + x2 + x3 + x4", data,
+               penalty=ElasticNet(alpha=0.0, lambdas=[lam], cd_tol=1e-13),
+               config=F64)
+    # reproduce on the standardized, weight-averaged scale
+    Xf = np.column_stack([np.ones(n), X])
+    wp = np.full(n, 1.0 / n)
+    A = (Xf * wp[:, None]).T @ Xf
+    b = Xf.T @ (wp * y)
+    sd = np.sqrt(np.maximum(np.diag(A) - (wp @ Xf) ** 2, 0.0))
+    sd[0] = 1.0
+    As = A / sd[:, None] / sd[None, :]
+    bs = b / sd
+    pf = np.ones(p + 1)
+    pf[0] = 0.0
+    beta_s = np.linalg.solve(As + lam * np.diag(pf), bs)
+    np.testing.assert_allclose(pm.coefficients[0], beta_s / sd, atol=5e-6)
+
+
+def test_penalty_factor_and_offset():
+    """penalty_factor=0 unpenalizes a column (always active); offsets
+    shift the linear predictor exactly as in the unpenalized fit."""
+    r = np.random.default_rng(20)
+    n = 500
+    data = {"x0": r.standard_normal(n), "x1": r.standard_normal(n),
+            "e": r.uniform(0.5, 2.0, n)}
+    mu = np.exp(0.2 + 0.8 * data["x0"] - 0.3 * data["x1"]) * data["e"]
+    data["y"] = r.poisson(mu).astype(float)
+    data["log_e"] = np.log(data["e"])
+    pen = ElasticNet(alpha=1.0, n_lambda=8, penalty_factor=[0.0, 1.0])
+    pm = sg.glm("y ~ x0 + x1 + offset(log_e)", data, family="poisson",
+                penalty=pen, config=F64)
+    # x0 is unpenalized: nonzero at EVERY lambda including lambda_max
+    j = list(pm.xnames).index("x0")
+    assert np.all(pm.coefficients[:, j] != 0.0)
+    assert pm.has_offset
+    # at the smallest lambda the fit approaches the unpenalized MLE
+    ref = sg.glm("y ~ x0 + x1 + offset(log_e)", data, family="poisson",
+                 config=F64)
+    np.testing.assert_allclose(pm.coefficients[-1], ref.coefficients,
+                               atol=5e-3)

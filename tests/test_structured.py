@@ -265,14 +265,62 @@ def test_structured_predict_pad_to_and_se(rng):
     full = predict_sharded(Xs, m.coefficients)
     padded = predict_sharded(Xs[:100], m.coefficients, pad_to=256)
     np.testing.assert_array_equal(padded, full[:100])
-    # se_fit densifies: agrees with the dense design's quadform
+    # the structured se quadform (blockwise gathers of V, no one-hot
+    # materialization) agrees with the dense design's quadform to
+    # summation-order noise
     fit_s, se_s = predict_sharded(Xs[:64], m.coefficients, vcov=m.vcov(),
                                   se_fit=True)
     Xd = transform(df, m.terms, dtype=np.float64)[:64]
     fit_d, se_d = predict_sharded(Xd, m.coefficients, vcov=m.vcov(),
                                   se_fit=True)
-    np.testing.assert_array_equal(fit_s, fit_d)
-    np.testing.assert_array_equal(se_s, se_d)
+    np.testing.assert_allclose(fit_s, fit_d, rtol=1e-13, atol=1e-15)
+    np.testing.assert_allclose(se_s, se_d, rtol=1e-12, atol=1e-15)
+
+
+def test_structured_se_512_levels_no_densify(rng):
+    """The satellite contract: se_fit on a 512-level factor runs the
+    structured quadform — never a (n, 512+) one-hot densification — and
+    matches the dense reference through the PUBLIC predict path."""
+    from sparkglm_tpu.data.structured import StructuredDesign
+
+    n, L = 4000, 512
+    # f32-representable numerics: api.predict transforms at the default
+    # float32, so the f64 dense reference below sees identical designs
+    df = {
+        "x1": rng.normal(size=n).astype(np.float32).astype(np.float64),
+        "x2": rng.normal(size=n).astype(np.float32).astype(np.float64),
+        "f": np.array([f"lv{i:03d}" for i in rng.integers(0, L, n)]),
+    }
+    df["y"] = (0.5 + 0.3 * df["x1"] - 0.2 * df["x2"]
+               + rng.normal(scale=0.1, size=n))
+    m = api.lm("y ~ x1 + x2 + f", df, config=F64)
+    assert m.gramian_engine == "structured"
+    assert len(np.unique(df["f"])) == L
+    fit_s, se_s = api.predict(m, df, se_fit=True)
+    # densify() is the ONLY way a StructuredDesign becomes a dense matrix;
+    # the scoring path must never call it
+    calls = []
+    orig = StructuredDesign.densify
+
+    def counting(self, *a, **k):
+        calls.append(1)
+        return orig(self, *a, **k)
+
+    StructuredDesign.densify = counting
+    try:
+        fit_s2, se_s2 = api.predict(m, df, se_fit=True)
+    finally:
+        StructuredDesign.densify = orig
+    assert not calls, "structured se_fit densified the design"
+    np.testing.assert_array_equal(fit_s2, fit_s)
+    np.testing.assert_array_equal(se_s2, se_s)
+    # dense reference through the same kernel
+    from sparkglm_tpu.models.scoring import predict_sharded
+    Xd = transform(df, m.terms, dtype=np.float64)
+    fit_d, se_d = predict_sharded(Xd, m.coefficients, vcov=m.vcov(),
+                                  se_fit=True)
+    np.testing.assert_allclose(fit_s, fit_d, rtol=1e-12, atol=1e-13)
+    np.testing.assert_allclose(se_s, se_d, rtol=1e-10, atol=1e-14)
 
 
 def test_serve_structured_bit_identical_and_no_recompiles(rng):
