@@ -8,7 +8,7 @@ CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve categorical \
-        penalized clean
+        penalized elastic clean
 
 all: native
 
@@ -58,6 +58,13 @@ categorical: native
 # regularization_path bench block (path-vs-refit speedup, <= 2 executables)
 penalized:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m penalized
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# elastic shard-parallel fitting (sparkglm_tpu/elastic): preemptible
+# workers, one-shot combine, graceful degraded convergence — plus the
+# elastic_recovery bench block (kill-one-worker overhead vs undisturbed)
+elastic:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:

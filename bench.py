@@ -421,6 +421,54 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["fault_recovery"] = dict(error=repr(e)[:300])
 
+    # ---- elastic kill-one-worker recovery overhead (sparkglm_tpu/elastic) --
+    # the same elastic shard fit undisturbed vs with one worker preempted
+    # mid-IRLS: the killed shard resumes from its checkpoint on a survivor,
+    # so the overhead is one resume + the re-run tail of one shard pass
+    try:
+        import sparkglm_tpu as sg
+        from sparkglm_tpu.robust import FaultPlan, faulty_source
+
+        np_rng = np.random.default_rng(12)
+        ne, pe = 200_000, 32
+        Xe = np_rng.standard_normal((ne, pe)).astype(np.float32)
+        Xe[:, 0] = 1.0
+        bte = (np_rng.standard_normal(pe) / 8).astype(np.float32)
+        ye = (np_rng.random(ne) < 1 / (1 + np.exp(-(Xe @ bte)))).astype(
+            np.float32)
+
+        def elastic_src():
+            for i in range(9):
+                lo, hi = ne * i // 9, ne * (i + 1) // 9
+                yield lambda lo=lo, hi=hi: (Xe[lo:hi], ye[lo:hi], None, None)
+
+        ekw = dict(family="binomial", workers=3, tol=1e-6, cache="none")
+        sg.glm_fit_elastic(elastic_src, **ekw)  # warm compile
+        t0 = time.perf_counter()
+        m_undisturbed = sg.glm_fit_elastic(elastic_src, **ekw)
+        t_undisturbed = time.perf_counter() - t0
+        # pass 2 = an early IRLS pass of some shard fit, after its first
+        # durable checkpoint — the restart genuinely resumes mid-fit (a kill
+        # after a shard's final solve would instead redo one confirming
+        # fixpoint step, moving beta by roundoff)
+        eplan = FaultPlan(preempt_chunk_at=((2, 0),))
+        t0 = time.perf_counter()
+        m_killed = sg.glm_fit_elastic(faulty_source(elastic_src, eplan),
+                                      **ekw)
+        t_killed = time.perf_counter() - t0
+        detail["elastic_recovery"] = dict(
+            undisturbed_s=round(t_undisturbed, 4),
+            killed_s=round(t_killed, 4),
+            recovery_overhead_frac=round(t_killed / t_undisturbed - 1.0, 4),
+            preemptions=m_killed.fit_info["elastic"]["preemptions"],
+            shard_retries=m_killed.fit_info["elastic"]["shard_retries"],
+            degraded=m_killed.fit_info["elastic"]["degraded"],
+            bit_identical=bool(np.array_equal(
+                np.asarray(m_undisturbed.coefficients),
+                np.asarray(m_killed.coefficients))))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["elastic_recovery"] = dict(error=repr(e)[:300])
+
     # ---- structured-telemetry overhead (sparkglm_tpu/obs) ------------------
     # the same streaming fit untraced vs traced into a ring buffer: events
     # are host-side and sync only at span edges, so the target is <2%

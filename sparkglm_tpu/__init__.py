@@ -41,14 +41,16 @@ from .models.lm import LMModel
 from .models.lm import fit as lm_fit
 from .models.serialize import load_model, save_model
 from .models.simulate import simulate
-from .models.streaming import glm_fit_streaming, lm_fit_streaming
+from .models.streaming import (glm_fit_streaming, lm_fit_streaming,
+                               lm_merge_checkpoints)
+from .elastic import glm_fit_elastic, lm_fit_elastic
 from .parallel import distributed
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
 from .penalized import ElasticNet, PathModel
 from .obs import FitTracer, JsonlSink, MetricsRegistry, RingBufferSink
 from .serve import BatchPolicy, MicroBatcher, ModelRegistry, Scorer
 from .utils import profiling
-from . import obs, robust, serve
+from . import elastic, obs, robust, serve
 
 __version__ = "0.1.0"
 
@@ -60,6 +62,7 @@ __all__ = [
     "read_parquet", "scan_parquet_schema", "scan_parquet_levels",
     "read_json", "scan_json_schema", "scan_json_levels",
     "lm_fit_streaming", "glm_fit_streaming",
+    "elastic", "lm_fit_elastic", "glm_fit_elastic", "lm_merge_checkpoints",
     "LMModel", "GLMModel", "load_model", "save_model", "simulate",
     "ElasticNet", "PathModel",
     "anova", "add1", "drop1", "step", "AnovaTable", "confint_profile",

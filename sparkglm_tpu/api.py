@@ -162,11 +162,12 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=(),
 
 def _reject_penalty_args(*, mesh=None, engine="auto", beta0=None,
                          on_iteration=None, checkpoint_every=0,
-                         retry=None, checkpoint=None, resume=False,
-                         prefetch=0):
+                         checkpoint=None, resume=False, prefetch=0):
     """Penalized paths run their own compiled solvers; the options that
     parameterize the unpenalized IRLS/solve machinery have no meaning
-    there.  Refuse them loudly rather than silently ignoring them."""
+    there.  Refuse them loudly rather than silently ignoring them.
+    (``retry=`` is NOT rejected: the penalized streaming drivers honor it
+    on every chunk pass.)"""
     if mesh is not None:
         raise ValueError("penalty= does not support mesh= (sharded "
                          "penalized fits are not implemented yet)")
@@ -177,12 +178,35 @@ def _reject_penalty_args(*, mesh=None, engine="auto", beta0=None,
     if beta0 is not None or on_iteration is not None or checkpoint_every:
         raise ValueError("penalty= does not support beta0=/on_iteration=/"
                          "checkpoint_every= (the path warm-starts itself)")
-    if retry is not None or checkpoint is not None or resume:
-        raise ValueError("penalty= does not support retry=/checkpoint=/"
-                         "resume= yet")
+    if checkpoint is not None or resume:
+        raise ValueError(
+            "penalty= does not support checkpoint=/resume=: lambda-path "
+            "state has no checkpoint format yet, so an interrupted path "
+            "re-runs from scratch — drop checkpoint=/resume= (retry= IS "
+            "supported and re-reads failed chunks in place)")
     if prefetch:
         raise ValueError("penalty= does not support prefetch= yet (path "
                          "passes stream sequentially)")
+
+
+def _reject_elastic_args(*, penalty=None, beta0=None, on_iteration=None,
+                         resume=False):
+    """Options that conflict with the elastic shard scheduler.  Everything
+    else (retry=, checkpoint=, prefetch=, trace=, metrics=, mesh=) flows
+    through to the shard fits."""
+    if penalty is not None:
+        raise ValueError(
+            "penalty= does not support engine='elastic' (the lambda path "
+            "has no shard combine rule yet); fit the penalized path on a "
+            "single controller")
+    if beta0 is not None or on_iteration is not None:
+        raise ValueError(
+            "engine='elastic' does not support beta0=/on_iteration= (the "
+            "combine step warm-starts the polish pass itself)")
+    if resume:
+        raise ValueError(
+            "engine='elastic' resumes implicitly from the checkpoint= "
+            "shard directory after a restart; drop resume=")
 
 
 def lm(formula: str, data, *, weights=None, offset=None,
@@ -551,7 +575,8 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
                  beta0=None, on_iteration=None, native: bool | None = None,
                  backend: str = "auto", retry=None, checkpoint=None,
                  resume=False, penalty=None, trace=None, metrics=None,
-                 prefetch: int = 0,
+                 prefetch: int = 0, engine: str = "auto",
+                 workers: int | None = None,
                  config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """Fit a GLM by formula straight from a CSV too big to load.
 
@@ -578,6 +603,15 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
     ``CheckpointManager``) persists IRLS state after every iteration and
     ``resume=True`` (or ``resume=path``) continues a preempted fit
     bit-for-bit (``models/streaming.py``).
+
+    ``engine="elastic"`` (or any ``workers=``) routes through the elastic
+    shard scheduler (``elastic/``): the file is round-robin partitioned
+    into independent shard fits on preemptible in-process workers, the
+    shard solutions combine in one shot, and a polishing IRLS pass over
+    the surviving data finishes the fit.  ``checkpoint=`` then names the
+    shard-checkpoint DIRECTORY, preempted shards resume implicitly, and a
+    permanently lost shard degrades the fit gracefully
+    (``fit_info["elastic"]["degraded"]``) instead of failing it.
     """
     from .models import streaming
 
@@ -601,9 +635,34 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
 
     yname = (f"cbind({f.response}, {f.response2})"
              if f.response2 is not None else f.response)
+    if engine not in ("auto", "elastic"):
+        raise ValueError(
+            f"glm_from_csv supports engine='auto' or engine='elastic', "
+            f"got {engine!r}")
+    if engine == "elastic" or workers is not None:
+        _reject_elastic_args(penalty=penalty, beta0=beta0,
+                             on_iteration=on_iteration, resume=resume)
+        from .elastic import glm_fit_elastic
+        import dataclasses
+        try:
+            model = glm_fit_elastic(
+                source, family=family, link=link,
+                workers=(4 if workers is None else workers),
+                tol=tol, max_iter=max_iter, criterion=criterion,
+                xnames=terms.xnames, yname=yname,
+                has_intercept=f.intercept, mesh=mesh, cache=cache,
+                verbose=verbose, retry=retry, checkpoint=checkpoint,
+                trace=trace, metrics=metrics, prefetch=prefetch,
+                config=config)
+        finally:
+            parse_cleanup()
+        return dataclasses.replace(
+            model, formula=str(f), terms=terms,
+            offset_col=_offset_col_value(f, offset),
+            weights_col=weights, has_weights=weights is not None)
     if penalty is not None:
         _reject_penalty_args(mesh=mesh, beta0=beta0,
-                             on_iteration=on_iteration, retry=retry,
+                             on_iteration=on_iteration,
                              checkpoint=checkpoint, resume=resume,
                              prefetch=prefetch)
         from .penalized import stream as _pen_stream
@@ -612,8 +671,8 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
             pm = _pen_stream.glm_path_streaming(
                 source, family=family, link=link, penalty=penalty,
                 xnames=terms.xnames, yname=yname,
-                has_intercept=f.intercept, verbose=verbose, trace=trace,
-                metrics=metrics, config=config)
+                has_intercept=f.intercept, verbose=verbose, retry=retry,
+                trace=trace, metrics=metrics, config=config)
         finally:
             parse_cleanup()
         return dataclasses.replace(
@@ -642,7 +701,8 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
                 mesh=None, native: bool | None = None, parse_cache="auto",
                 backend: str = "auto", retry=None, checkpoint=None,
                 resume=False, penalty=None, trace=None, metrics=None,
-                prefetch: int = 0,
+                prefetch: int = 0, engine: str = "auto",
+                workers: int | None = None,
                 config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """OLS/WLS by formula straight from a CSV too big to load (two
     streaming passes: Gramian accumulation, then the exact host-f64
@@ -651,6 +711,10 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
     ``weights``/``offset`` must be column names; ``offset()`` formula
     terms follow R's ``lm`` semantics like the resident :func:`lm`
     (VERDICT r3 #6 — streaming was the one place lm offset parity ended).
+
+    ``engine="elastic"`` / ``workers=`` shard the fit across preemptible
+    workers with exact Gramian-additive combine (see :func:`glm_from_csv`
+    and ``elastic/``).
     """
     from .models import streaming
 
@@ -675,16 +739,37 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
         for i in range(num_chunks):
             yield lambda i=i: extract(i)
 
+    if engine not in ("auto", "elastic"):
+        raise ValueError(
+            f"lm_from_csv supports engine='auto' or engine='elastic', "
+            f"got {engine!r}")
+    if engine == "elastic" or workers is not None:
+        _reject_elastic_args(penalty=penalty, resume=resume)
+        from .elastic import lm_fit_elastic
+        import dataclasses
+        try:
+            model = lm_fit_elastic(
+                source, workers=(4 if workers is None else workers),
+                xnames=terms.xnames, yname=f.response,
+                has_intercept=f.intercept, mesh=mesh, retry=retry,
+                checkpoint=checkpoint, trace=trace, metrics=metrics,
+                prefetch=prefetch, config=config)
+        finally:
+            parse_cleanup()
+        return dataclasses.replace(
+            model, formula=str(f), terms=terms, weights_col=weights,
+            offset_col=_offset_col_value(f, offset),
+            has_weights=weights is not None)
     if penalty is not None:
-        _reject_penalty_args(mesh=mesh, retry=retry, checkpoint=checkpoint,
+        _reject_penalty_args(mesh=mesh, checkpoint=checkpoint,
                              resume=resume, prefetch=prefetch)
         from .penalized import stream as _pen_stream
         import dataclasses
         try:
             pm = _pen_stream.lm_path_streaming(
                 source, penalty=penalty, xnames=terms.xnames,
-                yname=f.response, has_intercept=f.intercept, trace=trace,
-                metrics=metrics, config=config)
+                yname=f.response, has_intercept=f.intercept, retry=retry,
+                trace=trace, metrics=metrics, config=config)
         finally:
             parse_cleanup()
         return dataclasses.replace(

@@ -1,0 +1,82 @@
+"""Shard-aware chunk sources for elastic (loosely-coupled) fitting.
+
+The elastic scheduler (``sparkglm_tpu/elastic``) partitions ONE streaming
+chunk source into ``num_shards`` independent sub-sources and fits each on
+its own worker.  The partition is deterministic round-robin by chunk
+index — chunk ``i`` belongs to shard ``i % num_shards`` — so
+
+  * every worker sees a stable, re-iterable sub-source (the checkpoint
+    fingerprint contract of ``robust/checkpoint.py`` holds per shard: a
+    resumed shard fit replays exactly the same chunks in the same order);
+  * the union of the shard sources in shard order is a fixed permutation
+    of the original chunks, making the combine step reproducible
+    run-to-run (PARITY r12);
+  * adjacent chunks land on different shards, spreading any locality in
+    the data (a sorted CSV, say) evenly across workers.
+
+Laziness is preserved: the wrappers re-yield the source's items without
+touching them, so thunks belonging to OTHER shards are never materialized
+— selecting one shard out of S costs S× iteration but only 1/S of the
+parse/IO work for lazy sources like the from-CSV byte-range reader.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+__all__ = ["shard_source", "surviving_source"]
+
+
+def _check(num_shards: int) -> int:
+    num_shards = int(num_shards)
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    return num_shards
+
+
+def shard_source(chunks: Callable, shard: int, num_shards: int) -> Callable:
+    """Sub-source factory yielding only the chunks of one shard.
+
+    ``chunks`` is a chunk-source factory (the ``models/streaming.py``
+    contract: calling it returns an iterable of ``(X, y, w, off)`` tuples
+    or thunks); the result is another factory selecting chunk indices
+    ``i`` with ``i % num_shards == shard``, items untouched (thunks stay
+    lazy and unmaterialized when skipped).
+    """
+    num_shards = _check(num_shards)
+    shard = int(shard)
+    if not 0 <= shard < num_shards:
+        raise ValueError(
+            f"shard must be in [0, {num_shards}), got {shard}")
+
+    def gen():
+        for i, raw in enumerate(chunks()):
+            if i % num_shards == shard:
+                yield raw
+
+    return gen
+
+
+def surviving_source(chunks: Callable, survivors: Iterable[int],
+                     num_shards: int) -> Callable:
+    """Source over the union of the surviving shards, in global chunk
+    order — the degraded-combine / polish input when shards were lost.
+    With all shards surviving this is a pass-through of the original
+    source (same chunks, same order: the polish pass over it is
+    bit-identical to a single-controller fit of the full data).
+    """
+    num_shards = _check(num_shards)
+    keep = frozenset(int(s) for s in survivors)
+    if not keep:
+        raise ValueError("surviving_source needs at least one shard")
+    bad = [s for s in keep if not 0 <= s < num_shards]
+    if bad:
+        raise ValueError(
+            f"surviving shards {sorted(bad)} out of range [0, {num_shards})")
+
+    def gen():
+        for i, raw in enumerate(chunks()):
+            if i % num_shards in keep:
+                yield raw
+
+    return gen

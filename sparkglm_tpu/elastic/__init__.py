@@ -1,0 +1,22 @@
+"""Elastic shard-parallel fitting over loosely-coupled workers.
+
+The ROADMAP's step beyond the single mesh: partition a streaming source
+into independent shard fits (``data/shards.py``), run each on a
+preemptible worker (``scheduler.py`` — worker = one call into the
+existing streaming drivers, checkpointed and resumable bit-for-bit),
+combine the shard results in one shot (``combine.py`` — exact Gramian
+addition for LM, information-weighted averaging per arXiv 2111.00032 for
+GLM), and polish with a final pass over the surviving data.  Failures
+degrade instead of killing the fit: lost shards are dropped, flagged on
+``fit_info["elastic"]``, and everything is observable through typed
+``obs`` events.
+
+Entry points: :func:`glm_fit_elastic` / :func:`lm_fit_elastic`, or
+``engine="elastic"`` / ``workers=`` on the ``*_from_csv`` front-ends.
+"""
+
+from .combine import combine_glm, glm_shard_information
+from .scheduler import glm_fit_elastic, lm_fit_elastic
+
+__all__ = ["glm_fit_elastic", "lm_fit_elastic", "combine_glm",
+           "glm_shard_information"]

@@ -1,0 +1,104 @@
+"""One-shot combine of independent shard fits (arXiv 2111.00032).
+
+The parallel-and-stream design fits each shard to convergence on its own
+worker and merges the results ONCE, with no cross-worker traffic during
+the fits.  Two combine rules, one per model family:
+
+  * LM — the Gramian is exactly additive: the full-data ``(X'WX, X'Wy,
+    moments)`` is the sum of the shard accumulators, which each shard's
+    checkpoint already carries (``models/streaming.py::
+    lm_merge_checkpoints``).  Nothing here but the merge call — the
+    combined checkpoint IS the polished fit's resume state.
+  * GLM — IRLS solutions are not additive, so the combine is the paper's
+    information-weighted average: one extra Fisher pass per shard at the
+    shard's own solution ``beta_s`` yields the observed information
+    ``I_s = X_s' W(beta_s) X_s``, and
+
+        beta_comb = (sum_s I_s)^{-1} sum_s I_s beta_s
+
+    — the minimum-variance linear combination under the usual asymptotics,
+    accurate to O(1/n) of the full-data MLE.  A polishing IRLS pass over
+    the surviving data (``glm_fit_streaming(beta0=beta_comb)``) then
+    removes even that gap, warm-started close enough to converge in a
+    couple of iterations.
+
+Everything accumulates host-f64 left-to-right in shard order — the same
+determinism contract as the streaming engine, so elastic fits are
+bit-reproducible run-to-run for a fixed shard layout.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import streaming as _stream
+
+__all__ = ["glm_shard_information", "combine_glm"]
+
+
+def glm_shard_information(chunks, beta, *, fam, lnk, mesh, config,
+                          tracer=None, label="combine_fisher", index=0):
+    """One streaming Fisher pass over a shard source at fixed ``beta``.
+
+    Returns host-f64 ``(XtWX, rows)`` — the shard's observed information
+    at its own solution, accumulated left-to-right like every other
+    streaming pass (same chunk kernel as the IRLS driver, so the weights
+    are the genuine IRLS working weights at ``beta``).
+    """
+    mesh = _stream._streaming_mesh(mesh)
+    bucket: dict = {}
+    dtype = None
+    XtWX = None
+    rows = 0
+    beta64 = np.asarray(beta, np.float64)
+    t0 = time.perf_counter()
+    if tracer is not None:
+        tracer.pass_start(label, int(index))
+    nchunks = 0
+    for Xc, yc, wc, oc in _stream._iter_chunks(chunks):
+        if int(Xc.shape[0]) == 0:
+            continue
+        rows += int(Xc.shape[0])
+        nchunks += 1
+        if dtype is None:
+            dtype = _stream._resolve_dtype(Xc, config)
+        Xp, yp, wp, op = _stream._bucket_pad(Xc, yc, wc, oc, bucket)
+        dX, dy, dw, do = _stream._put_chunk(Xp, yp, wp, op, mesh, dtype)
+        out = _stream._traced_call(
+            _stream._glm_chunk_pass, tracer, "elastic_fisher",
+            dX, dy, dw, do, jnp.asarray(beta64, dX.dtype),
+            engine=("structured"
+                    if isinstance(dX, _stream.StructuredDesign)
+                    else "einsum"),
+            family=fam, link=lnk, first=False,
+            fam_param=fam.param_operand())
+        A = np.asarray(out[0], np.float64)
+        XtWX = A if XtWX is None else XtWX + A
+    if XtWX is None:
+        raise ValueError("source yielded no chunks")
+    if tracer is not None:
+        tracer.pass_end(label, int(index), chunks=nchunks, rows=rows,
+                        bytes=0, compute_s=time.perf_counter() - t0)
+    return XtWX, rows
+
+
+def combine_glm(infos, betas, *, jitter):
+    """Information-weighted one-shot combine (module docstring):
+    ``beta_comb = (sum I_s)^{-1} sum I_s beta_s``, summed in shard order
+    and solved with the streaming engine's own host-f64 equilibrated
+    Cholesky (same jitter semantics as every other solve)."""
+    if len(infos) != len(betas) or not infos:
+        raise ValueError("combine_glm needs matching, non-empty info/beta "
+                         f"lists (got {len(infos)}/{len(betas)})")
+    A = None
+    rhs = None
+    for I_s, b_s in zip(infos, betas):
+        I_s = np.asarray(I_s, np.float64)
+        v = I_s @ np.asarray(b_s, np.float64)
+        A = I_s if A is None else A + I_s
+        rhs = v if rhs is None else rhs + v
+    beta, _cho, _pivot = _stream._solve64(A, rhs, jitter)
+    return beta

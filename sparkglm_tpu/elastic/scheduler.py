@@ -1,0 +1,436 @@
+"""Elastic shard scheduler: preemptible workers, retry, graceful loss.
+
+The single-controller streaming fits (``models/streaming.py``) die with
+their process; the mesh path dies with any one host.  This scheduler is
+the ROADMAP's "loosely-coupled workers" step: it round-robins the chunk
+source into ``shards`` independent sub-sources (``data/shards.py``), fits
+each to convergence on its own worker, and merges the results ONCE
+(``combine.py``) — workers share nothing but a checkpoint directory.
+
+Workers are in-process here (worker = one call into the existing
+streaming LM/IRLS drivers); the failure model is real:
+
+  * PREEMPTIBLE — every shard fit runs with ``checkpoint=<dir>/shard-k``
+    and ``resume=True`` unconditionally, so a killed worker restarts its
+    shard from the last durable iteration bit-for-bit (the PR-1 contract)
+    on a surviving worker.  :class:`~sparkglm_tpu.robust.faults.
+    SimulatedPreemption` is caught HERE — at the scheduler, where a real
+    preemption notice arrives — never inside the drivers.
+  * BUDGETED — all shard restarts (preemptions and transient failures
+    alike) draw from ONE shared :class:`~sparkglm_tpu.robust.retry.
+    RetryBudget` (``retry=`` policy's budget; default policy otherwise),
+    so a fleet-wide outage fails shards fast instead of each burning a
+    private allowance.
+  * DEGRADED — a shard that exhausts the budget, or dies fatally
+    (``FatalSourceError`` / a sub-fit's ``RetryBudgetExhausted``), is
+    declared LOST: the combine proceeds on the surviving shards, the
+    polish pass fits the surviving rows, and the model is flagged
+    ``fit_info["elastic"]["degraded"]`` with the lost row fraction.
+    Anything else (a validation error, a bug) propagates — a
+    deterministic error would lose every shard, and silently degrading on
+    it would hide the bug.
+
+Every decision emits a typed event (``shard_start`` / ``shard_end`` /
+``shard_lost`` / ``combine`` / ``polish`` plus the robust layer's
+``retry`` / ``resume`` / ``checkpoint_write`` / ``budget_exhausted``)
+through one :class:`~sparkglm_tpu.obs.FitTracer`, and the aggregate lands
+in ``fit_report()["robustness"]``.
+
+Determinism (PARITY r12): shards run in shard order, per-shard resume is
+bit-for-bit, and the combine/polish accumulate in shard order — so a
+preempted-and-resumed elastic fit is bit-identical to the undisturbed
+elastic fit, and an undisturbed elastic fit is bit-reproducible
+run-to-run.  Against the single controller the polish pass sees the same
+chunks in the same order whenever no shard is lost, so the LM/GLM polish
+trajectory matches it to summation-order tolerance (bit-identical for
+the GLM polish iterations themselves; the combined warm start differs
+from the single fit's trajectory only in its starting point).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import time
+
+import numpy as np
+
+from ..config import DEFAULT, NumericConfig
+from ..data.shards import shard_source, surviving_source
+from ..models import streaming as _stream
+from ..obs import trace as _obs_trace
+from ..robust.checkpoint import CheckpointManager
+from ..robust.faults import SimulatedPreemption
+from ..robust.retry import (FatalSourceError, RetryBudgetExhausted,
+                            RetryPolicy)
+from .combine import combine_glm, glm_shard_information
+
+__all__ = ["glm_fit_elastic", "lm_fit_elastic"]
+
+_EMPTY_MSG = "source yielded no chunks"
+
+
+class _WorkerPool:
+    """In-process stand-in for a fleet of preemptible workers.
+
+    Tracks which worker ids are alive; shard ``k`` runs on
+    ``alive[k % len(alive)]``.  A preempted worker leaves the pool and its
+    shard is re-assigned to a survivor; when the last worker dies the pool
+    provisions a replacement id (an autoscaler replacing a reclaimed VM) —
+    the fit itself is never wedged by running out of workers.
+    """
+
+    def __init__(self, n: int):
+        self.alive = list(range(int(n)))
+        self._next = int(n)
+        self.preemptions = 0
+
+    def assign(self, shard: int) -> int:
+        return self.alive[shard % len(self.alive)]
+
+    def preempt(self, worker: int) -> None:
+        self.preemptions += 1
+        if worker in self.alive:
+            self.alive.remove(worker)
+        if not self.alive:
+            self.alive.append(self._next)
+            self._next += 1
+
+
+def _elastic_setup(source, chunk_rows, workers, shards, checkpoint, retry,
+                   trace, metrics, verbose):
+    chunks = _stream._as_source(source, chunk_rows)
+    workers = int(workers)
+    if workers < 1:
+        raise ValueError(f"workers must be >= 1, got {workers}")
+    num_shards = workers if shards is None else int(shards)
+    if num_shards < 1:
+        raise ValueError(f"shards must be >= 1, got {num_shards}")
+    # elastic fits ALWAYS carry a tracer: fit_info["elastic"] (and the
+    # robustness aggregates) must exist even with trace=None — a sink-less
+    # tracer aggregates at near-zero cost
+    tracer = _obs_trace.as_tracer(trace, verbose=verbose, metrics=metrics)
+    if tracer is None:
+        tracer = _obs_trace.FitTracer(())
+    policy = retry if retry is not None else RetryPolicy()
+    budget = policy.new_budget()  # ONE budget across every shard restart
+    tmp = None
+    if checkpoint is None:
+        # workers and the combiner communicate through checkpoint FILES,
+        # so elastic always has a directory — private and ephemeral unless
+        # the caller names one (which then survives a controller restart)
+        tmp = tempfile.TemporaryDirectory(prefix="sparkglm-elastic-")
+        ckpt_dir = tmp.name
+    else:
+        if not isinstance(checkpoint, (str, os.PathLike)):
+            raise TypeError(
+                "elastic checkpoint= names the shard-checkpoint DIRECTORY "
+                "(a str or path), not a CheckpointManager — got "
+                f"{type(checkpoint).__name__}")
+        ckpt_dir = os.fspath(checkpoint)
+        os.makedirs(ckpt_dir, exist_ok=True)
+    return chunks, workers, num_shards, tracer, policy, budget, ckpt_dir, tmp
+
+
+def _spend(budget, exc) -> bool:
+    """Charge one shard restart to the shared budget; False = exhausted
+    (the ``budget_exhausted`` event is emitted by the budget itself)."""
+    try:
+        budget.spend(exc)
+        return True
+    except RetryBudgetExhausted:
+        return False
+
+
+def _run_shards(chunks, num_shards, pool, ckpt_dir, policy, budget, tracer,
+                fit_one):
+    """Run every shard fit in shard order, classifying failures.
+
+    Returns ``(fitted, paths, lost, empty, shard_retries)``: fitted models
+    by shard, per-shard checkpoint paths, lost shards with reasons, empty
+    shards (fewer chunks than shards), and the restart count.
+    """
+    fitted: dict = {}
+    paths: dict = {}
+    lost: dict = {}
+    empty: list = []
+    shard_retries = 0
+    for k in range(num_shards):
+        sub = shard_source(chunks, k, num_shards)
+        path = os.path.join(ckpt_dir, f"shard-{k:04d}.npz")
+        paths[k] = path
+        worker = pool.assign(k)
+        tracer.emit("shard_start", shard=k, worker=worker)
+        t0 = time.perf_counter()
+        attempt = 0
+
+        def fail(reason, e):
+            lost[k] = f"{reason}: {e!r}"[:200]
+            tracer.emit("shard_lost", shard=k, worker=worker, reason=reason,
+                        error=repr(e)[:200])
+
+        while True:
+            try:
+                model = fit_one(sub, path)
+            except SimulatedPreemption as e:
+                # the worker is gone; the shard itself is fine — restart it
+                # from checkpoint on a surviving worker, budget permitting
+                pool.preempt(worker)
+                attempt += 1
+                if attempt > policy.max_retries or not _spend(budget, e):
+                    fail("preemption_budget", e)
+                    break
+                worker = pool.assign(k)
+                shard_retries += 1
+                tracer.emit("retry", key=f"shard:{k}", scope="shard",
+                            attempt=attempt - 1, worker=worker,
+                            delay_s=0.0, error=repr(e)[:200])
+                continue
+            except (FatalSourceError, RetryBudgetExhausted) as e:
+                fail("fatal" if isinstance(e, FatalSourceError)
+                     else "retry_budget", e)
+                break
+            except ValueError as e:
+                if str(e) == _EMPTY_MSG:
+                    # more shards than chunks: an empty shard is NOT lost —
+                    # it holds no rows, so the combine loses nothing
+                    empty.append(k)
+                    tracer.emit("shard_end", shard=k, worker=worker,
+                                empty=True, attempts=attempt + 1,
+                                seconds=time.perf_counter() - t0)
+                    break
+                raise
+            except Exception as e:
+                if not policy.is_transient(e):
+                    raise
+                attempt += 1
+                if attempt > policy.max_retries or not _spend(budget, e):
+                    fail("transient_budget", e)
+                    break
+                shard_retries += 1
+                delay = policy.delay(attempt - 1, ("shard", k))
+                tracer.emit("retry", key=f"shard:{k}", scope="shard",
+                            attempt=attempt - 1, worker=worker,
+                            delay_s=delay, error=repr(e)[:200])
+                policy.sleep(delay)
+                continue
+            else:
+                fitted[k] = model
+                tracer.emit("shard_end", shard=k, worker=worker, empty=False,
+                            attempts=attempt + 1,
+                            seconds=time.perf_counter() - t0)
+                break
+    return fitted, paths, lost, empty, shard_retries
+
+
+def _elastic_info(workers, pool, num_shards, rows_by_shard, lost, empty,
+                  shard_retries) -> dict:
+    """The ``fit_info["elastic"]`` block.  Lost shards died before
+    reporting a row count, so the lost row fraction is estimated from the
+    surviving shards' mean (round-robin sharding keeps shard sizes within
+    one chunk of each other; the flag records that it is an estimate)."""
+    rows_fitted = int(sum(rows_by_shard.values()))
+    n_lost = len(lost)
+    if n_lost and rows_by_shard:
+        lost_rows = (rows_fitted / len(rows_by_shard)) * n_lost
+        frac = lost_rows / (rows_fitted + lost_rows)
+    else:
+        frac = 0.0
+    return {
+        "engine": "elastic",
+        "workers": int(workers),
+        "shards": int(num_shards),
+        "shards_fitted": len(rows_by_shard),
+        "shards_empty": sorted(empty),
+        "shards_lost": sorted(lost),
+        "lost_reasons": {str(k): v for k, v in sorted(lost.items())},
+        "degraded": bool(lost),
+        "lost_row_fraction": float(frac),
+        "lost_rows_estimated": bool(lost),
+        "rows_fitted": rows_fitted,
+        "preemptions": int(pool.preemptions),
+        "shard_retries": int(shard_retries),
+    }
+
+
+def _attach_info(model, tracer, info):
+    fi = dict(tracer.report())
+    fi["elastic"] = info
+    return dataclasses.replace(model, fit_info=fi)
+
+
+def glm_fit_elastic(
+    source,
+    *,
+    family="binomial",
+    link=None,
+    workers: int = 4,
+    shards: int | None = None,
+    tol: float = 1e-8,
+    max_iter: int = 100,
+    criterion: str = "relative",
+    chunk_rows: int = _stream.DEFAULT_CHUNK_ROWS,
+    xnames=None,
+    yname: str = "y",
+    has_intercept: bool | None = None,
+    mesh=None,
+    cache: str = "auto",
+    verbose: bool = False,
+    retry=None,
+    checkpoint=None,
+    trace=None,
+    metrics=None,
+    prefetch: int = 0,
+    config: NumericConfig = DEFAULT,
+):
+    """Elastic GLM: independent shard IRLS fits, information-weighted
+    one-shot combine, polishing IRLS over the surviving data.
+
+    ``workers`` sizes the (in-process) preemptible pool; ``shards``
+    defaults to ``workers``.  ``checkpoint=`` names the shard-checkpoint
+    DIRECTORY (default: a private temp dir); ``retry=`` is a
+    :class:`~sparkglm_tpu.robust.RetryPolicy` — its budget is shared
+    across all shard restarts, and it is also passed through to each
+    shard fit's chunk-level retry.  See the module docstring for the
+    failure model, and :mod:`sparkglm_tpu.elastic.combine` for the math.
+    """
+    from ..families.families import resolve as _resolve
+    fam, lnk = _resolve(family, link)
+    (chunks, workers, num_shards, tracer, policy, budget, ckpt_dir,
+     tmp) = _elastic_setup(source, chunk_rows, workers, shards, checkpoint,
+                           retry, trace, metrics, verbose)
+    pool = _WorkerPool(workers)
+    fit_kw = dict(family=fam, link=lnk, tol=tol, max_iter=max_iter,
+                  criterion=criterion, xnames=xnames, yname=yname,
+                  has_intercept=has_intercept, mesh=mesh, cache=cache,
+                  retry=retry, trace=tracer, prefetch=prefetch,
+                  config=config)
+
+    def fit_one(sub, path):
+        return _stream.glm_fit_streaming(sub, checkpoint=path, resume=True,
+                                         **fit_kw)
+
+    try:
+        with _obs_trace.ambient(tracer):
+            tracer.emit("fit_start", model="glm_elastic", family=fam.name,
+                        link=lnk.name, workers=workers, shards=num_shards)
+            fitted, paths, lost, empty, shard_retries = _run_shards(
+                chunks, num_shards, pool, ckpt_dir, policy, budget, tracer,
+                fit_one)
+            if not fitted:
+                raise RuntimeError(
+                    f"elastic fit failed: no shard survived "
+                    f"({len(lost)} lost: {dict(sorted(lost.items()))}; "
+                    f"{len(empty)} empty)")
+            # one-shot combine: one Fisher pass per surviving shard at its
+            # own solution, then the information-weighted average
+            infos, betas, rows_by_shard = [], [], {}
+            for k in sorted(fitted):
+                I_k, r_k = glm_shard_information(
+                    shard_source(chunks, k, num_shards),
+                    fitted[k].coefficients, fam=fam, lnk=lnk, mesh=mesh,
+                    config=config, tracer=tracer, index=k)
+                infos.append(I_k)
+                betas.append(np.asarray(fitted[k].coefficients, np.float64))
+                rows_by_shard[k] = r_k
+            beta_comb = combine_glm(infos, betas, jitter=config.jitter)
+            tracer.emit("combine", target="glm", shards=len(infos),
+                        degraded=bool(lost), p=int(beta_comb.shape[0]))
+            survivors = sorted(set(fitted) | set(empty))
+            surv = surviving_source(chunks, survivors, num_shards)
+            tracer.emit("polish", target="glm", shards=len(survivors),
+                        degraded=bool(lost))
+            model = _stream.glm_fit_streaming(surv, beta0=beta_comb,
+                                              **fit_kw)
+            info = _elastic_info(workers, pool, num_shards, rows_by_shard,
+                                 lost, empty, shard_retries)
+            tracer.emit("fit_end", model="glm_elastic",
+                        degraded=bool(lost),
+                        iterations=int(model.iterations),
+                        deviance=float(model.deviance),
+                        converged=bool(model.converged))
+            return _attach_info(model, tracer, info)
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def lm_fit_elastic(
+    source,
+    *,
+    workers: int = 4,
+    shards: int | None = None,
+    chunk_rows: int = _stream.DEFAULT_CHUNK_ROWS,
+    xnames=None,
+    yname: str = "y",
+    has_intercept: bool | None = None,
+    mesh=None,
+    verbose: bool = False,
+    retry=None,
+    checkpoint=None,
+    trace=None,
+    metrics=None,
+    prefetch: int = 0,
+    config: NumericConfig = DEFAULT,
+):
+    """Elastic LM: independent shard Gramian fits, exact additive combine
+    through the shard checkpoints, residual polish over the surviving
+    data.
+
+    The combine needs no extra data pass: each shard fit's checkpoint
+    already holds its Gramian accumulators, so the merged checkpoint
+    (:func:`~sparkglm_tpu.models.streaming.lm_merge_checkpoints`) feeds
+    the polishing :func:`~sparkglm_tpu.models.streaming.lm_fit_streaming`
+    as its ``resume=`` state — the Gramian pass is skipped and only the
+    cheap residual passes stream.  Parameters as in
+    :func:`glm_fit_elastic`.
+    """
+    (chunks, workers, num_shards, tracer, policy, budget, ckpt_dir,
+     tmp) = _elastic_setup(source, chunk_rows, workers, shards, checkpoint,
+                           retry, trace, metrics, verbose)
+    pool = _WorkerPool(workers)
+    fit_kw = dict(xnames=xnames, yname=yname, has_intercept=has_intercept,
+                  mesh=mesh, retry=retry, trace=tracer, prefetch=prefetch,
+                  config=config)
+
+    def fit_one(sub, path):
+        return _stream.lm_fit_streaming(sub, checkpoint=path, resume=True,
+                                        **fit_kw)
+
+    try:
+        with _obs_trace.ambient(tracer):
+            tracer.emit("fit_start", model="lm_elastic", workers=workers,
+                        shards=num_shards)
+            fitted, paths, lost, empty, shard_retries = _run_shards(
+                chunks, num_shards, pool, ckpt_dir, policy, budget, tracer,
+                fit_one)
+            if not fitted:
+                raise RuntimeError(
+                    f"elastic fit failed: no shard survived "
+                    f"({len(lost)} lost: {dict(sorted(lost.items()))}; "
+                    f"{len(empty)} empty)")
+            states, rows_by_shard = [], {}
+            for k in sorted(fitted):
+                st = CheckpointManager(paths[k]).load()
+                states.append(st)
+                rows_by_shard[k] = int(st["n"])
+            merged = _stream.lm_merge_checkpoints(states)
+            combined = CheckpointManager(os.path.join(ckpt_dir,
+                                                      "combined.npz"))
+            combined.save(**merged)
+            tracer.emit("combine", target="lm", shards=len(states),
+                        degraded=bool(lost), p=int(merged["p"]))
+            survivors = sorted(set(fitted) | set(empty))
+            surv = surviving_source(chunks, survivors, num_shards)
+            tracer.emit("polish", target="lm", shards=len(survivors),
+                        degraded=bool(lost))
+            model = _stream.lm_fit_streaming(surv, resume=combined,
+                                             **fit_kw)
+            info = _elastic_info(workers, pool, num_shards, rows_by_shard,
+                                 lost, empty, shard_retries)
+            tracer.emit("fit_end", model="lm_elastic", degraded=bool(lost))
+            return _attach_info(model, tracer, info)
+    finally:
+        if tmp is not None:
+            tmp.cleanup()

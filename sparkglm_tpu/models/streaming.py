@@ -824,6 +824,64 @@ def _streaming_csne(chunks, beta, *, fam_name, lnk_name, dtype, mesh,
 # public fits
 # ---------------------------------------------------------------------------
 
+def lm_merge_checkpoints(states: Sequence[dict]) -> dict:
+    """Merge per-shard LM checkpoint states into one combined payload.
+
+    The elastic engine's LM combine is EXACTLY the additivity of the
+    Gramian accumulators: each shard's checkpoint (saved by
+    :func:`lm_fit_streaming` after its Gramian pass) carries the shard's
+    ``(X'WX, X'Wy, sum w, sum w y, n_ok, n)``, and the full-data state is
+    their sum — checkpoint FILES are the worker-to-combiner transport, so
+    workers need share nothing but a directory.  ``states`` must be the
+    surviving shards' loaded states in shard order; the merged fingerprint
+    is the first state's (its first chunk IS the surviving source's first
+    chunk under the round-robin partition of ``data/shards.py``), which is
+    what ``resume=`` validation of the polishing fit checks against.
+
+    Returns the keyword payload for ``CheckpointManager.save`` — feeding
+    the merged checkpoint to ``lm_fit_streaming(source, resume=...)`` over
+    the union source runs only the cheap residual passes and yields the
+    model the single controller would have produced from one Gramian pass
+    in this summation order.
+    """
+    if not states:
+        raise ValueError("lm_merge_checkpoints needs at least one state")
+    for st in states:
+        if str(st.get("kind")) != "lm":
+            raise ValueError(
+                f"can only merge kind='lm' checkpoints, got {st.get('kind')!r}")
+    p = int(states[0]["p"])
+    dt = str(states[0]["dtype"])
+    if any(int(st["p"]) != p for st in states):
+        raise ValueError(
+            f"shard checkpoints disagree on design width: "
+            f"{[int(st['p']) for st in states]}")
+    if any(str(st["dtype"]) != dt for st in states):
+        raise ValueError(
+            f"shard checkpoints disagree on dtype: "
+            f"{[str(st['dtype']) for st in states]}")
+    masks = [np.asarray(st["ones_mask"]) for st in states]
+    if len({int(m.size) for m in masks}) > 1:
+        raise ValueError(
+            "shard checkpoints disagree on intercept detection "
+            "(mixed empty/non-empty ones_mask)")
+    ones = masks[0].astype(bool)
+    for m in masks[1:]:
+        ones = ones & m.astype(bool)
+    out = dict(
+        kind="lm", fingerprint=states[0]["fingerprint"], p=p,
+        XtWX=sum(np.asarray(st["XtWX"], np.float64) for st in states),
+        XtWy=sum(np.asarray(st["XtWy"], np.float64) for st in states),
+        sw=float(sum(float(st["sw"]) for st in states)),
+        swy=float(sum(float(st["swy"]) for st in states)),
+        n_ok=float(sum(float(st["n_ok"]) for st in states)),
+        n=int(sum(int(st["n"]) for st in states)),
+        saw_offset=bool(any(bool(st["saw_offset"]) for st in states)),
+        saw_weights=bool(any(bool(st["saw_weights"]) for st in states)),
+        ones_mask=ones.astype(np.int8), dtype=dt)
+    return out
+
+
 def lm_fit_streaming(
     source,
     *,

@@ -22,6 +22,12 @@ structured event stream:
                                 pass (data/pipeline.py): total consumer
                                 time blocked on the producer, and the
                                 max/mean prefetch-queue depth observed
+  ``shard_start`` / ``shard_end`` / ``shard_lost``  elastic shard fits
+                                (elastic/scheduler.py): one worker's fit
+                                of one shard — lost means dropped from
+                                the combine after the retry budget
+  ``combine`` / ``polish``      the elastic one-shot merge of shard
+                                results and the final polishing pass
 
 Events are ordered by a per-tracer monotone sequence number assigned under
 a lock, so two runs of the same deterministic fit produce the same
@@ -208,6 +214,8 @@ class FitTracer:
         self._chunks_skipped = 0
         self._checkpoint_writes = 0
         self._resumes = 0
+        self._shard_retries = 0
+        self._shards_lost = 0
         self._queue_wait_s = 0.0
         self._prefetch_depth_max = 0
         self._overlap_saved_s = 0.0
@@ -303,10 +311,24 @@ class FitTracer:
             self._chunks_skipped += int(f.get("skipped", 0))
             if m is not None:
                 m.counter("faults.retries").inc()
+            if f.get("scope") == "shard":
+                # an elastic shard RESTART (scheduler-level), not a
+                # chunk-level re-read — reported separately so degraded
+                # fleets are visible at a glance
+                self._shard_retries += 1
+                if m is not None:
+                    m.counter("elastic.shard_retries").inc()
         elif ev.kind == "checkpoint_write":
             self._checkpoint_writes += 1
         elif ev.kind == "resume":
             self._resumes += 1
+        elif ev.kind == "shard_lost":
+            self._shards_lost += 1
+            if m is not None:
+                m.counter("elastic.shards_lost").inc()
+        elif ev.kind == "shard_end":
+            if m is not None:
+                m.counter("elastic.shards_fitted").inc()
         elif ev.kind == "compile":
             self._compile_s += float(f.get("seconds", 0.0))
         elif ev.kind in ("solve", "span"):
@@ -364,6 +386,19 @@ class FitTracer:
                 "checkpoint_writes": self._checkpoint_writes,
                 "resumes": self._resumes,
                 "solves": self._counts.get("solve", 0),
+                # one glanceable fault-tolerance block (the elastic
+                # engine's acceptance surface; the flat keys above stay
+                # for compatibility)
+                "robustness": {
+                    "retries": self._retries,
+                    "shard_retries": self._shard_retries,
+                    "resumes": self._resumes,
+                    "checkpoint_writes": self._checkpoint_writes,
+                    "budget_exhausted": self._counts.get(
+                        "budget_exhausted", 0),
+                    "shards": self._counts.get("shard_start", 0),
+                    "shards_lost": self._shards_lost,
+                },
                 "queue_wait_s": self._queue_wait_s,
                 "prefetch_depth_max": self._prefetch_depth_max,
                 # fraction of the overlappable time actually hidden by the

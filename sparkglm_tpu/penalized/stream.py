@@ -210,15 +210,22 @@ def _prepare(penalty, xnames, has_intercept):
 
 
 def lm_path_streaming(source, *, penalty, xnames, yname="y",
-                      has_intercept=None, verbose=False, trace=None,
-                      metrics=None, config=None):
+                      has_intercept=None, verbose=False, retry=None,
+                      trace=None, metrics=None, config=None):
     """Gaussian/identity lambda path from a chunk source in ONE data pass
     (module docstring).  ``source()`` yields ``(X, y, w, off)`` tuples or
-    thunks, the ``models/streaming.py`` contract."""
+    thunks, the ``models/streaming.py`` contract.
+
+    ``retry=`` (a ``robust.RetryPolicy``) wraps the source so every chunk
+    pass absorbs transient read failures in place, each pass under its own
+    fresh budget (``robust/retry.py::retrying_source``)."""
     from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
 
     if config is None:
         config = DEFAULT
+    if retry is not None:
+        from ..robust.retry import retrying_source
+        source = retrying_source(source, retry)
     xnames, icol, pfv = _prepare(penalty, xnames, has_intercept)
     p = len(xnames)
     dtype = np.float64 if x64_enabled() else np.float32
@@ -292,10 +299,12 @@ def lm_path_streaming(source, *, penalty, xnames, yname="y",
 
 def glm_path_streaming(source, *, family="binomial", link=None, penalty,
                        xnames, yname="y", has_intercept=None, verbose=False,
-                       trace=None, metrics=None, config=None):
+                       retry=None, trace=None, metrics=None, config=None):
     """General-family lambda path from a chunk source: host lambda/IRLS
     loops over a fixed set of compiled chunk-pass flavors plus the
-    lambda-traced CD solve kernel (module docstring)."""
+    lambda-traced CD solve kernel (module docstring).  ``retry=`` wraps the
+    source exactly as in :func:`lm_path_streaming` — every pass of the
+    lambda/IRLS loops absorbs transient chunk failures in place."""
     from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
     from ..families.families import resolve as _resolve
     from ..models.streaming import _traced_call
@@ -306,8 +315,11 @@ def glm_path_streaming(source, *, family="binomial", link=None, penalty,
     if fam.name == "gaussian" and lnk.name == "identity":
         return lm_path_streaming(
             source, penalty=penalty, xnames=xnames, yname=yname,
-            has_intercept=has_intercept, verbose=verbose, trace=trace,
-            metrics=metrics, config=config)
+            has_intercept=has_intercept, verbose=verbose, retry=retry,
+            trace=trace, metrics=metrics, config=config)
+    if retry is not None:
+        from ..robust.retry import retrying_source
+        source = retrying_source(source, retry)
     xnames, icol, pfv = _prepare(penalty, xnames, has_intercept)
     p = len(xnames)
     dtype = np.float64 if x64_enabled() else np.float32

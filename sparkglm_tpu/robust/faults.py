@@ -11,7 +11,11 @@ run in CI on every ``make robust``:
   * scheduled FATAL errors — always re-raised, modelling corrupt data;
   * simulated PREEMPTION — :class:`SimulatedPreemption` (a ``BaseException``
     like a real ``SystemExit``, so retry code cannot eat it) raised on the
-    n-th touch, killing the fit mid-pass to exercise checkpoint/resume.
+    n-th touch, killing the fit mid-pass to exercise checkpoint/resume;
+  * scheduled WORKER KILLS — preemptions addressed by ``(pass, chunk)``
+    coordinates instead of the touch counter (``preempt_chunk_at``), each
+    firing once, so the elastic engine's kill-resume-recover loop is
+    reproducible independent of how many retries shifted the touch stream.
 
 Counting is by TOUCH: every materialization attempt (chunk yielded, thunk
 called, reader invoked) increments one shared counter, so a schedule like
@@ -53,17 +57,33 @@ class FaultPlan:
     ones.  One plan instance carries one mutable touch counter; share the
     instance between a source and a reader to schedule across both, or use
     fresh instances for independent schedules.
+
+    ``preempt_chunk_at`` is the WORKER-KILL schedule the elastic engine
+    tests with: ``(pass, chunk)`` pairs addressed by the wrapped source's
+    own counters — ``pass`` counts openings of the wrapped source over
+    the plan's lifetime (one per streaming pass; monotonic across a kill
+    and restart, so a resumed fit's passes get fresh indices and cannot
+    re-die at the old coordinate), ``chunk`` counts chunks within that
+    pass.  Unlike the touch-indexed ``preempt_at`` it is position-stable
+    under retries (a retried touch shifts every later touch index but no
+    chunk index) and each pair additionally fires ONCE, so the schedule
+    stays a finite set of kills even when coordinates recur after
+    :meth:`reset`.
     """
 
     transient_at: Sequence[int] = ()
     fatal_at: Sequence[int] = ()
     preempt_at: Sequence[int] = ()
+    preempt_chunk_at: Sequence[tuple] = ()
     p_transient: float = 0.0
     seed: int = 0
 
     def __post_init__(self):
         self._touch = 0
+        self._passes = 0
         self._fired = set()
+        self._preempt_pairs = {tuple(int(v) for v in pc)
+                               for pc in self.preempt_chunk_at}
         self._rng = np.random.default_rng(self.seed)
         self.faults_fired = 0
 
@@ -89,6 +109,15 @@ class FaultPlan:
             self.faults_fired += 1
             raise TransientSourceError(f"injected random transient at touch {t}")
 
+    def on_chunk_touch(self, pass_idx: int, chunk_idx: int) -> None:
+        """Fire a scheduled worker kill at ``(pass_idx, chunk_idx)`` — once."""
+        key = (pass_idx, chunk_idx)
+        if key in self._preempt_pairs and key not in self._fired:
+            self._fired.add(key)
+            self.faults_fired += 1
+            raise SimulatedPreemption(
+                f"injected worker kill at pass {pass_idx}, chunk {chunk_idx}")
+
 
 def faulty_source(chunks: Callable, plan: FaultPlan) -> Callable:
     """Wrap a chunk-source factory so each chunk delivery is a fault touch.
@@ -99,13 +128,17 @@ def faulty_source(chunks: Callable, plan: FaultPlan) -> Callable:
     """
 
     def gen():
-        for raw in chunks():
+        pass_idx = plan._passes
+        plan._passes += 1
+        for chunk_idx, raw in enumerate(chunks()):
             if callable(raw):
-                def lazy(thunk=raw):
+                def lazy(thunk=raw, pi=pass_idx, ci=chunk_idx):
+                    plan.on_chunk_touch(pi, ci)
                     plan.on_touch()
                     return thunk()
                 yield lazy
             else:
+                plan.on_chunk_touch(pass_idx, chunk_idx)
                 plan.on_touch()
                 yield raw
 

@@ -118,11 +118,20 @@ class RetryPolicy:
 
 
 class RetryBudget:
-    """Mutable per-pass allowance shared by every retried call in the pass."""
+    """Mutable retry allowance shared by every retried call in one scope.
+
+    The streaming fits give each PASS a fresh budget; the elastic
+    scheduler shares ONE instance across every shard's restart attempts
+    so a fleet-wide outage fails fast instead of each shard burning a
+    private allowance (``sparkglm_tpu/elastic/scheduler.py``).
+    """
 
     def __init__(self, total: int):
         self.total = int(total)
         self.spent = 0
+
+    def remaining(self) -> int:
+        return max(0, self.total - self.spent)
 
     def spend(self, exc: BaseException) -> None:
         self.spent += 1

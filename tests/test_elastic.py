@@ -1,0 +1,395 @@
+"""Elastic shard-parallel fitting (sparkglm_tpu.elastic).
+
+The ISSUE-7 contract: round-robin shard fits on preemptible in-process
+workers, one-shot combine (exact Gramian addition for LM,
+information-weighted averaging for GLM), polishing pass over the
+surviving data — with deterministic recovery (a killed worker resumes its
+shard bit-for-bit) and graceful degradation (a permanently lost shard
+flags ``fit_info["elastic"]["degraded"]`` instead of failing the fit).
+"""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.data.shards import shard_source, surviving_source
+from sparkglm_tpu.models import streaming as st
+from sparkglm_tpu.obs import FitTracer, RingBufferSink
+from sparkglm_tpu.robust import (CheckpointManager, FaultPlan, RetryPolicy,
+                                 faulty_source)
+
+NOSLEEP = RetryPolicy(sleep=lambda s: None)
+XN = ["(Intercept)", "x1", "x2", "x3"]
+
+
+def _data(rng, n=600):
+    X = np.concatenate([np.ones((n, 1)), rng.normal(size=(n, 3))], axis=1)
+    bt = np.array([0.5, -1.0, 0.3, 0.8])
+    eta = X @ bt
+    yb = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(float)
+    yl = eta + rng.normal(size=n)
+    return X, yb, yl
+
+
+def _factory(X, y, n_chunks=6):
+    n = X.shape[0]
+
+    def source():
+        for i in range(n_chunks):
+            lo, hi = n * i // n_chunks, n * (i + 1) // n_chunks
+            yield lambda lo=lo, hi=hi: (X[lo:hi], y[lo:hi], None, None)
+
+    return source
+
+
+def _ring():
+    ring = RingBufferSink()
+    return ring, FitTracer([ring])
+
+
+# ---------------------------------------------------------------------------
+# shard sources
+# ---------------------------------------------------------------------------
+
+def test_shard_source_round_robin_and_lazy():
+    mats = []
+
+    def chunks():
+        for i in range(7):
+            yield lambda i=i: mats.append(i) or (i,)
+
+    # shard k gets chunks k, k+3, ... and NEVER materializes the others
+    got = [t() for t in shard_source(chunks, 1, 3)()]
+    assert [g[0] for g in got] == [1, 4] and mats == [1, 4]
+    mats.clear()
+    got = [t() for t in surviving_source(chunks, [0, 2], 3)()]
+    assert [g[0] for g in got] == [0, 2, 3, 5, 6] and mats == [0, 2, 3, 5, 6]
+    with pytest.raises(ValueError):
+        shard_source(chunks, 3, 3)
+    with pytest.raises(ValueError):
+        surviving_source(chunks, [], 3)
+    with pytest.raises(ValueError):
+        surviving_source(chunks, [5], 3)
+
+
+# ---------------------------------------------------------------------------
+# undisturbed elastic fits vs the single controller
+# ---------------------------------------------------------------------------
+
+def test_lm_elastic_matches_single_controller(rng):
+    X, _, yl = _data(rng)
+    single = st.lm_fit_streaming(_factory(X, yl), xnames=XN,
+                                 has_intercept=True)
+    m = sg.lm_fit_elastic(_factory(X, yl), workers=3, xnames=XN,
+                          has_intercept=True)
+    # the combine is EXACT Gramian addition: shard sums agree with the
+    # single controller's left-to-right accumulation to summation-order
+    # tolerance, and the residual polish runs on the identical chunks
+    np.testing.assert_allclose(m.coefficients, single.coefficients,
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(m.std_errors, single.std_errors, rtol=1e-10)
+    assert m.n_obs == single.n_obs
+    ei = m.fit_info["elastic"]
+    assert ei["engine"] == "elastic" and ei["shards"] == 3
+    assert ei["shards_fitted"] == 3 and not ei["degraded"]
+    assert ei["rows_fitted"] == 600 and ei["lost_row_fraction"] == 0.0
+    rb = m.fit_info["robustness"]
+    assert rb["shards"] == 3 and rb["shards_lost"] == 0
+    assert rb["checkpoint_writes"] >= 3  # one durable state per shard
+
+
+def test_glm_elastic_matches_single_and_is_deterministic(rng):
+    X, yb, _ = _data(rng)
+    single = st.glm_fit_streaming(_factory(X, yb), family="binomial",
+                                  xnames=XN, has_intercept=True)
+    kw = dict(family="binomial", workers=3, xnames=XN, has_intercept=True)
+    m1 = sg.glm_fit_elastic(_factory(X, yb), **kw)
+    m2 = sg.glm_fit_elastic(_factory(X, yb), **kw)
+    # combine + warm-started polish converges to the same optimum
+    np.testing.assert_allclose(m1.coefficients, single.coefficients,
+                               atol=1e-6)
+    assert m1.converged
+    # ... and the elastic fit itself is bit-reproducible run-to-run
+    np.testing.assert_array_equal(m1.coefficients, m2.coefficients)
+    assert m1.deviance == m2.deviance
+    assert m1.iterations == m2.iterations
+    assert not m1.fit_info["elastic"]["degraded"]
+
+
+def test_elastic_empty_shards_when_workers_exceed_chunks(rng):
+    X, _, yl = _data(rng)
+    single = st.lm_fit_streaming(_factory(X, yl), xnames=XN,
+                                 has_intercept=True)
+    m = sg.lm_fit_elastic(_factory(X, yl), workers=8, xnames=XN,
+                          has_intercept=True)
+    ei = m.fit_info["elastic"]
+    # shards 6,7 see no chunks: empty, NOT lost — nothing degrades
+    assert ei["shards_empty"] == [6, 7] and ei["shards_fitted"] == 6
+    assert not ei["degraded"]
+    np.testing.assert_allclose(m.coefficients, single.coefficients,
+                               rtol=1e-12, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# the LM combine rule
+# ---------------------------------------------------------------------------
+
+def test_lm_merge_checkpoints(rng, tmp_path):
+    X, _, yl = _data(rng)
+    chunks = _factory(X, yl)
+    states = []
+    for k in range(2):
+        ck = tmp_path / f"s{k}.npz"
+        st.lm_fit_streaming(shard_source(chunks, k, 2), xnames=XN,
+                            has_intercept=True, checkpoint=ck)
+        states.append(CheckpointManager(ck).load())
+    merged = st.lm_merge_checkpoints(states)
+    full = tmp_path / "full.npz"
+    st.lm_fit_streaming(chunks, xnames=XN, has_intercept=True,
+                        checkpoint=full)
+    ref = CheckpointManager(full).load()
+    # additivity: shard accumulators sum to the full-data accumulators
+    np.testing.assert_allclose(merged["XtWX"], ref["XtWX"], rtol=1e-12)
+    np.testing.assert_allclose(merged["XtWy"], ref["XtWy"], rtol=1e-12)
+    assert int(merged["n"]) == int(ref["n"])
+    # the merged fingerprint is shard 0's = the full source's first chunk
+    np.testing.assert_array_equal(merged["fingerprint"],
+                                  ref["fingerprint"])
+    # validation: mixed kinds and mismatched p are refused
+    bad = dict(states[0], kind="glm")
+    with pytest.raises(ValueError, match="kind"):
+        st.lm_merge_checkpoints([states[0], bad])
+    with pytest.raises(ValueError, match="design width"):
+        st.lm_merge_checkpoints([states[0], dict(states[1], p=99)])
+    with pytest.raises(ValueError, match="at least one"):
+        st.lm_merge_checkpoints([])
+
+
+# ---------------------------------------------------------------------------
+# preemption: deterministic recovery (the acceptance test)
+# ---------------------------------------------------------------------------
+
+def test_elastic_preempted_worker_resumes_bit_identical(rng):
+    """Seeded mid-fit worker kill: the shard restarts from its checkpoint
+    on a surviving worker and the final coefficients are BIT-IDENTICAL to
+    the undisturbed elastic fit."""
+    X, yb, _ = _data(rng)
+    kw = dict(family="binomial", workers=3, xnames=XN, has_intercept=True)
+    base = sg.glm_fit_elastic(_factory(X, yb), **kw)
+    # pass 3 = an IRLS pass of some shard fit, after its first durable
+    # checkpoint — the restart genuinely RESUMES rather than refitting
+    plan = FaultPlan(preempt_chunk_at=((3, 0),))
+    ring, tr = _ring()
+    m = sg.glm_fit_elastic(faulty_source(_factory(X, yb), plan),
+                           trace=tr, **kw)
+    assert plan.faults_fired == 1
+    np.testing.assert_array_equal(m.coefficients, base.coefficients)
+    np.testing.assert_array_equal(m.std_errors, base.std_errors)
+    assert m.deviance == base.deviance
+    ei = m.fit_info["elastic"]
+    assert ei["preemptions"] == 1 and ei["shard_retries"] == 1
+    assert not ei["degraded"]
+    rb = m.fit_info["robustness"]
+    assert rb["shard_retries"] == 1 and rb["resumes"] >= 1
+    kinds = [e.kind for e in ring.events]
+    assert "retry" in kinds and "combine" in kinds and "polish" in kinds
+    # the preempted worker left the pool: its shard restarted elsewhere
+    retry = next(e for e in ring.events if e.kind == "retry")
+    assert retry.fields["scope"] == "shard"
+
+
+def test_elastic_preemption_exhausts_budget_degrades(rng):
+    """With no retry allowance the preempted shard is LOST, not retried —
+    and the fit still completes, degraded."""
+    X, yb, _ = _data(rng)
+    plan = FaultPlan(preempt_chunk_at=((0, 0),))
+    m = sg.glm_fit_elastic(
+        faulty_source(_factory(X, yb), plan), family="binomial", workers=3,
+        xnames=XN, has_intercept=True,
+        retry=RetryPolicy(max_retries=0, sleep=lambda s: None))
+    ei = m.fit_info["elastic"]
+    assert ei["degraded"] and ei["shards_lost"] == [0]
+    assert "preemption_budget" in ei["lost_reasons"]["0"]
+    assert m.converged
+
+
+# ---------------------------------------------------------------------------
+# permanent loss: graceful degradation (the acceptance test)
+# ---------------------------------------------------------------------------
+
+def test_elastic_fatal_shard_lost_degrades_gracefully(rng):
+    X, yb, _ = _data(rng)
+    full = st.glm_fit_streaming(_factory(X, yb), family="binomial",
+                                xnames=XN, has_intercept=True)
+    kw = dict(family="binomial", workers=3, xnames=XN, has_intercept=True)
+    plan = FaultPlan(fatal_at=(2,))
+    ring, tr = _ring()
+    m = sg.glm_fit_elastic(faulty_source(_factory(X, yb), plan),
+                           retry=NOSLEEP, trace=tr, **kw)
+    ei = m.fit_info["elastic"]
+    assert ei["degraded"] and len(ei["shards_lost"]) == 1
+    assert ei["lost_reasons"][str(ei["shards_lost"][0])].startswith("fatal")
+    # round-robin keeps shards within one chunk of each other: losing one
+    # of three drops about a third of the rows
+    assert 0.2 < ei["lost_row_fraction"] < 0.45
+    assert ei["rows_fitted"] == 400
+    assert m.converged
+    # the degraded fit IS the fit on the surviving shards ...
+    k = ei["shards_lost"][0]
+    survivors = [s for s in range(3) if s != k]
+    ref = st.glm_fit_streaming(
+        surviving_source(_factory(X, yb), survivors, 3), family="binomial",
+        xnames=XN, has_intercept=True)
+    np.testing.assert_allclose(m.coefficients, ref.coefficients, atol=1e-6)
+    # ... and stays within the documented tolerance of the full-data fit
+    # (PARITY r12: O(1/sqrt(n)) statistical noise, not a numerical gap)
+    assert np.max(np.abs(np.asarray(m.coefficients)
+                         - np.asarray(full.coefficients))) < 0.25
+    assert [e.kind for e in ring.events].count("shard_lost") == 1
+    assert m.fit_info["robustness"]["shards_lost"] == 1
+
+
+def test_elastic_transient_retry_layers(rng):
+    """A transient chunk failure is absorbed at the innermost layer that
+    has a policy: chunk-level retry when ``retry=`` is given (the shard
+    never restarts), the scheduler's whole-shard restart otherwise — the
+    final fit is bit-identical either way."""
+    X, _, yl = _data(rng)
+    base = sg.lm_fit_elastic(_factory(X, yl), workers=3, xnames=XN,
+                             has_intercept=True)
+    plan = FaultPlan(transient_at=(1,))
+    m = sg.lm_fit_elastic(faulty_source(_factory(X, yl), plan), workers=3,
+                          xnames=XN, has_intercept=True, retry=NOSLEEP)
+    assert plan.faults_fired == 1
+    assert m.fit_info["robustness"]["retries"] >= 1  # chunk-level
+    assert m.fit_info["elastic"]["shard_retries"] == 0
+    np.testing.assert_array_equal(m.coefficients, base.coefficients)
+    # no retry= -> the shard fit has no chunk-level policy, the failure
+    # bubbles to the scheduler, and the shard restarts from checkpoint
+    # under the default policy's shared budget (one short real backoff)
+    plan2 = FaultPlan(transient_at=(1,))
+    m2 = sg.lm_fit_elastic(faulty_source(_factory(X, yl), plan2), workers=3,
+                           xnames=XN, has_intercept=True)
+    assert plan2.faults_fired == 1
+    assert m2.fit_info["elastic"]["shard_retries"] == 1
+    np.testing.assert_array_equal(m2.coefficients, base.coefficients)
+
+
+def test_elastic_no_survivor_raises(rng):
+    X, yb, _ = _data(rng)
+    plan = FaultPlan(fatal_at=tuple(range(12)))
+    with pytest.raises(RuntimeError, match="no shard survived"):
+        sg.glm_fit_elastic(faulty_source(_factory(X, yb), plan),
+                           family="binomial", workers=2, xnames=XN,
+                           has_intercept=True, retry=NOSLEEP)
+
+
+def test_elastic_deterministic_event_sequence(rng):
+    X, yb, _ = _data(rng)
+    seqs = []
+    for _ in range(2):
+        ring, tr = _ring()
+        sg.glm_fit_elastic(_factory(X, yb), family="binomial", workers=3,
+                           xnames=XN, has_intercept=True, trace=tr)
+        seqs.append([(e.seq, e.kind) for e in ring.events])
+    assert seqs[0] == seqs[1]
+    kinds = [k for _, k in seqs[0]]
+    assert kinds.count("shard_start") == 3 == kinds.count("shard_end")
+    assert kinds.count("combine") == 1 == kinds.count("polish")
+
+
+# ---------------------------------------------------------------------------
+# a named checkpoint directory survives a controller restart
+# ---------------------------------------------------------------------------
+
+def test_elastic_named_checkpoint_dir_resumes_finished_shards(rng,
+                                                              tmp_path):
+    X, yb, _ = _data(rng)
+    kw = dict(family="binomial", workers=3, xnames=XN, has_intercept=True,
+              checkpoint=tmp_path / "shards")
+    m1 = sg.glm_fit_elastic(_factory(X, yb), **kw)
+    # a restarted controller reuses the durable per-shard states: every
+    # shard fit resumes from its converged checkpoint (one confirming
+    # IRLS step each — the converged solution is a fixpoint to roundoff)
+    m2 = sg.glm_fit_elastic(_factory(X, yb), **kw)
+    np.testing.assert_allclose(m1.coefficients, m2.coefficients,
+                               rtol=1e-12, atol=1e-14)
+    assert m2.fit_info["robustness"]["resumes"] >= 3
+
+
+def test_elastic_validation(rng):
+    X, yb, _ = _data(rng)
+    with pytest.raises(ValueError, match="workers"):
+        sg.glm_fit_elastic(_factory(X, yb), workers=0)
+    with pytest.raises(ValueError, match="shards"):
+        sg.lm_fit_elastic(_factory(X, yb), workers=2, shards=0)
+    with pytest.raises(TypeError, match="DIRECTORY"):
+        sg.lm_fit_elastic(_factory(X, yb), workers=2,
+                          checkpoint=CheckpointManager("x.npz"))
+
+
+# ---------------------------------------------------------------------------
+# the from-CSV front-end and serving
+# ---------------------------------------------------------------------------
+
+def _write_csv(tmp_path, rng, n=400):
+    import csv
+    X, yb, yl = _data(rng, n=n)
+    p = tmp_path / "d.csv"
+    with open(p, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["y", "yl", "x1", "x2", "x3"])
+        for i in range(n):
+            w.writerow([yb[i], yl[i], X[i, 1], X[i, 2], X[i, 3]])
+    return str(p)
+
+
+def test_from_csv_elastic_parity_predict_and_serve(rng, tmp_path):
+    path = _write_csv(tmp_path, rng)
+    kw = dict(family="binomial", chunk_bytes=4096)
+    single = sg.glm_from_csv("y ~ x1 + x2 + x3", path, **kw)
+    m = sg.glm_from_csv("y ~ x1 + x2 + x3", path, engine="elastic",
+                        workers=3, **kw)
+    np.testing.assert_allclose(m.coefficients, single.coefficients,
+                               atol=1e-6)
+    assert m.fit_info["elastic"]["shards"] == 3
+    assert m.formula == single.formula
+    # workers= alone implies elastic
+    m2 = sg.glm_from_csv("y ~ x1 + x2 + x3", path, workers=2, **kw)
+    assert m2.fit_info["elastic"]["shards"] == 2
+    # the fitted model carries Terms: predict and serve work as usual
+    new = {"x1": np.array([0.1, -0.2]), "x2": np.array([1.0, 0.0]),
+           "x3": np.array([0.5, -0.5])}
+    mu = sg.predict(m, new)
+    np.testing.assert_allclose(mu, sg.predict(single, new), atol=1e-6)
+    sc = sg.Scorer(m)
+    np.testing.assert_array_equal(np.asarray(sc.score(new)), np.asarray(mu))
+    reg = sg.ModelRegistry()
+    reg.register("elastic", m, deploy=True)
+    np.testing.assert_array_equal(
+        np.asarray(reg.scorer("elastic").score(new)), np.asarray(mu))
+
+
+def test_from_csv_lm_elastic_parity(rng, tmp_path):
+    path = _write_csv(tmp_path, rng)
+    single = sg.lm_from_csv("yl ~ x1 + x2 + x3", path, chunk_bytes=4096)
+    m = sg.lm_from_csv("yl ~ x1 + x2 + x3", path, chunk_bytes=4096,
+                       workers=3)
+    # the CSV path parses at the configured (float32 by default) dtype, so
+    # shard-order vs controller-order accumulation differs at f32 roundoff
+    np.testing.assert_allclose(m.coefficients, single.coefficients,
+                               rtol=1e-6, atol=1e-7)
+    assert m.fit_info["elastic"]["engine"] == "elastic"
+
+
+def test_from_csv_elastic_rejections(rng, tmp_path):
+    path = _write_csv(tmp_path, rng, n=60)
+    with pytest.raises(ValueError, match="engine"):
+        sg.glm_from_csv("y ~ x1", path, engine="qr")
+    with pytest.raises(ValueError, match="elastic"):
+        sg.glm_from_csv("y ~ x1", path, engine="elastic",
+                        penalty=sg.ElasticNet(n_lambda=3))
+    with pytest.raises(ValueError, match="resume"):
+        sg.lm_from_csv("yl ~ x1", path, workers=2, resume=True)
+    with pytest.raises(ValueError, match="beta0"):
+        sg.glm_from_csv("y ~ x1", path, workers=2, beta0=np.zeros(2))

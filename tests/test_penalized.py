@@ -415,6 +415,67 @@ def test_streaming_rejects_unsupported(tmp_path):
                         prefetch=2)
     with pytest.raises(ValueError, match="resume"):
         sg.lm_from_csv(FORMULA6, path, penalty=pen, resume=True)
+    # no path checkpoint format exists yet: checkpoint= is refused loudly
+    # rather than silently ignored ...
+    with pytest.raises(ValueError, match="checkpoint"):
+        sg.glm_from_csv(FORMULA6, path, family="binomial", penalty=pen,
+                        checkpoint=str(tmp_path / "c.npz"))
+    with pytest.raises(ValueError, match="checkpoint"):
+        sg.lm_from_csv(FORMULA6, path, penalty=pen,
+                       checkpoint=str(tmp_path / "c.npz"))
+
+
+def test_streaming_path_honors_retry(tmp_path):
+    """retry= IS wired through the penalized drivers: transient chunk
+    failures are absorbed on every pass of the lambda/IRLS loops and the
+    path is bit-identical to the undisturbed one."""
+    from sparkglm_tpu.robust import FaultPlan, RetryPolicy, faulty_source
+    from sparkglm_tpu.penalized import stream as pen_stream
+    from sparkglm_tpu.data.model_matrix import build_terms, transform
+
+    nosleep = RetryPolicy(sleep=lambda s: None)
+    data = _sim(19, family="gaussian")
+    terms = build_terms(data, columns=[f"x{i}" for i in range(6)],
+                        intercept=True)
+    X = np.asarray(transform(data, terms), np.float64)
+    y = np.asarray(data["y"], np.float64)
+
+    def factory():
+        def source():
+            for i in range(4):
+                lo, hi = 75 * i, 75 * (i + 1)
+                yield lambda lo=lo, hi=hi: (X[lo:hi], y[lo:hi], None, None)
+        return source
+
+    pen = ElasticNet(alpha=0.6, n_lambda=8)
+    kw = dict(penalty=pen, xnames=terms.xnames, has_intercept=True,
+              config=F64)
+    # gaussian driver: one Gramian pass
+    clean = pen_stream.lm_path_streaming(factory(), **kw)
+    plan = FaultPlan(transient_at=(1,))
+    m = pen_stream.lm_path_streaming(
+        faulty_source(factory(), plan), retry=nosleep, **kw)
+    assert plan.faults_fired == 1
+    np.testing.assert_array_equal(m.coefficients, clean.coefficients)
+    # general-family driver: many passes, each under a fresh budget
+    gkw = dict(family="binomial", penalty=pen, xnames=terms.xnames,
+               has_intercept=True, config=F64)
+    yb = (np.asarray(data["y"]) > np.median(data["y"])).astype(float)
+
+    def bfactory():
+        def source():
+            for i in range(4):
+                lo, hi = 75 * i, 75 * (i + 1)
+                yield lambda lo=lo, hi=hi: (X[lo:hi], yb[lo:hi], None, None)
+        return source
+
+    gclean = pen_stream.glm_path_streaming(bfactory(), **gkw)
+    gplan = FaultPlan(transient_at=(2, 9, 17))
+    gm = pen_stream.glm_path_streaming(
+        faulty_source(bfactory(), gplan), retry=nosleep, **gkw)
+    assert gplan.faults_fired == 3
+    np.testing.assert_array_equal(gm.coefficients, gclean.coefficients)
+    np.testing.assert_array_equal(gm.deviance, gclean.deviance)
 
 
 # ---------------------------------------------------------------------------

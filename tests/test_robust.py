@@ -399,6 +399,32 @@ def test_fault_plan_touch_semantics():
         plan.on_touch()  # schedule rewound
 
 
+def test_fault_plan_preempt_chunk_coordinates():
+    """The worker-kill fault kind: fires at a seeded (pass, chunk)
+    coordinate, ONCE — passes count source openings monotonically over the
+    plan's lifetime, so a restarted fit's fresh passes never re-die at the
+    same coordinate."""
+    plan = FaultPlan(preempt_chunk_at=((1, 2),))
+    src = faulty_source(lambda: iter([(i,) for i in range(4)]), plan)
+
+    def drain():
+        return [c[0] for c in src()]
+
+    assert drain() == [0, 1, 2, 3]  # pass 0: clean
+    got = []
+    with pytest.raises(SimulatedPreemption):  # pass 1 dies AT chunk 2
+        for c in src():
+            got.append(c[0])
+    assert got == [0, 1]
+    assert plan.faults_fired == 1
+    # the "restarted worker" re-opens the source: pass 2, no re-fire
+    assert drain() == [0, 1, 2, 3]
+    # distinct from transient source errors: a kill is a BaseException
+    # (never absorbed by retry) and is positioned, not touch-counted
+    assert issubclass(SimulatedPreemption, BaseException)
+    assert not issubclass(SimulatedPreemption, Exception)
+
+
 def test_retrying_source_mid_iteration_generator_failure(mesh8, rng):
     """A generator raising mid-pass (not in a thunk) is re-opened and
     fast-forwarded past the delivered prefix."""
