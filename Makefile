@@ -8,7 +8,7 @@ CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve categorical \
-        penalized elastic clean
+        penalized elastic sketch clean
 
 all: native
 
@@ -65,6 +65,14 @@ penalized:
 # elastic_recovery bench block (kill-one-worker overhead vs undisturbed)
 elastic:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# sketched-IRLS engine + sparse designs (sparkglm_tpu/ops/sketch.py,
+# data/sparse.py): seeded determinism, golden sketch-vs-exact parity,
+# engine-combination guards — plus the sketch_solve bench block (sketched
+# vs exact-dense s/iter + coef maxdiff at the ultra-wide sparse shape)
+sketch:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m sketch
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:

@@ -769,6 +769,101 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["regularization_path"] = dict(error=repr(e)[:300])
 
+    # ---- sketched IRLS at the ultra-wide sparse shape (ops/sketch.py) ------
+    # engine="sketch" never forms the exact p x p Gramian: per IRLS
+    # iteration one O(nnz) countsketch pass builds the CG preconditioner
+    # and config.sketch_refine exact-matvec CG steps recover the exact
+    # step.  s/iter baseline is the exact DENSE einsum path — the O(n p^2)
+    # workload the sketch engine exists to avoid.  TPU shape: 2M x 8192
+    # sparse (ISSUE 9 / ROADMAP item 1); the dense baseline design at that
+    # shape is 64 GB, so it is timed on a row subsample and its s/iter
+    # scaled linearly in n (the Gramian pass is row-linear).  Coefficient
+    # agreement is checked against the exact SPARSE einsum fit at the full
+    # shape (same algebra as dense, no materialization).  Targets:
+    # >= 3x s/iter over exact dense, one executable per pass flavor,
+    # coef maxdiff within the PARITY r13 tolerance scaled to run dtype.
+    try:
+        from sparkglm_tpu.data import sparse as _sparse_mod
+        from sparkglm_tpu.models.glm import _irls_sketch_kernel
+
+        np_rng = np.random.default_rng(41)
+        # sketch advantage needs the exact n*p^2 Gramian to be FLOP-bound
+        # relative to the sketch path's O(nnz + 4p*p^2) work, so the CPU
+        # fallback keeps p wide (1024) rather than n huge; the target
+        # relaxes off-TPU like regularization_path's does
+        ns, psp, dns, ks = ((2_097_152, 8192 - 16, 16, 8) if on_tpu
+                            else (40_000, 1024 - 16, 16, 8))
+        target_sk = 3.0 if on_tpu else 2.0
+        n_base = min(ns, 131_072)  # dense-baseline row subsample
+        rows_s = np.repeat(np.arange(ns), ks)
+        cols_s = np_rng.integers(0, psp, ns * ks)
+        cols_s[:psp] = np.arange(psp)  # every column occupied: full rank
+        vals_s = np_rng.uniform(0.5, 1.5, ns * ks).astype(np.float32)
+        dense_blk = np.concatenate(
+            [np.ones((ns, 1), np.float32),
+             np_rng.standard_normal((ns, dns - 1)).astype(np.float32)],
+            axis=1)
+        spd_b = _sparse_mod.from_coo(rows_s, cols_s, vals_s, ns, psp,
+                                     dense=dense_blk, intercept=True)
+        bt_s = np.concatenate([
+            np.array([-0.2], np.float64),
+            np_rng.standard_normal(dns - 1) * 0.1,
+            np_rng.standard_normal(psp) * (0.5 / np.sqrt(ks))])
+        eta_b = spd_b.matvec64(bt_s)
+        yb_s = (np_rng.random(ns)
+                < 1.0 / (1.0 + np.exp(-eta_b))).astype(np.float32)
+        bkw = dict(family="binomial", tol=1e-6, max_iter=12)
+
+        sg.glm_fit(spd_b, yb_s, engine="sketch", **bkw)  # warm compile
+        before_sk = _irls_sketch_kernel._cache_size()
+        t0 = time.perf_counter()
+        m_sk = sg.glm_fit(spd_b, yb_s, engine="sketch", **bkw)
+        t_sk = time.perf_counter() - t0
+        sk_executables = _irls_sketch_kernel._cache_size() - before_sk
+        spi_sk = t_sk / max(int(m_sk.iterations), 1)
+
+        # exact sparse einsum fit at the full shape: the coef oracle
+        m_exact = sg.glm_fit(spd_b, yb_s, engine="einsum", **bkw)
+        coef_diff = float(np.nanmax(np.abs(
+            np.asarray(m_sk.coefficients) - np.asarray(m_exact.coefficients))))
+
+        # exact dense baseline (densified design, row subsample on TPU)
+        Xd_b = spd_b[:n_base].densify(np.float32)
+        yd_b = yb_s[:n_base]
+        sg.glm_fit(Xd_b, yd_b, engine="einsum", **bkw)  # warm compile
+        t0 = time.perf_counter()
+        m_dn = sg.glm_fit(Xd_b, yd_b, engine="einsum", **bkw)
+        t_dn = time.perf_counter() - t0
+        spi_dn = (t_dn / max(int(m_dn.iterations), 1)) * (ns / n_base)
+
+        # run dtype sets the agreement bar: 1e-4 is the f64 PARITY r13
+        # contract; the f32 default path carries the Gramian roundoff of
+        # both engines on top
+        diff_bar = 1e-4 if np.asarray(m_exact.coefficients).dtype == \
+            np.float64 and not on_tpu else 5e-3
+        detail["sketch_solve"] = dict(
+            n=ns, p=int(spd_b.shape[1]), n_sparse=psp, nnz_per_row=ks,
+            sketch_dim=int(m_sk.sketch_dim),
+            sketch_refine=int(m_sk.sketch_refine),
+            engine=m_sk.gramian_engine,
+            executables=int(sk_executables),
+            sketch=dict(seconds=round(t_sk, 4),
+                        iters=int(m_sk.iterations),
+                        s_per_iter=round(spi_sk, 5)),
+            exact_dense=dict(rows_timed=n_base,
+                             seconds=round(t_dn, 4),
+                             iters=int(m_dn.iterations),
+                             s_per_iter_scaled=round(spi_dn, 5)),
+            speedup_s_per_iter=round(spi_dn / spi_sk, 3),
+            speedup_target=target_sk,
+            coef_maxdiff_vs_exact=coef_diff,
+            ok=bool(m_sk.gramian_engine == "sketch"
+                    and sk_executables == 0
+                    and spi_dn / spi_sk >= target_sk
+                    and coef_diff < diff_bar))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["sketch_solve"] = dict(error=repr(e)[:300])
+
     print(json.dumps({
         "metric": "logistic_"
                   + (f"{n // 1_000_000}M" if n >= 1_000_000 else f"{n // 1000}k")

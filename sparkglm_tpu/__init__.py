@@ -26,6 +26,9 @@ from .data.frame import as_columns, omit_na
 from .data.io import (native_available, read_csv, scan_csv_levels,
                       scan_csv_schema)
 from .data.model_matrix import Terms, build_terms, model_matrix, transform
+from .data.sparse import SparseDesign, SparseLayout
+from .data.sparse import from_coo as sparse_from_coo
+from .data.sparse import from_csr as sparse_from_csr
 from .families.families import (FAMILIES, Family, get_family,
                                 negative_binomial, quasi)
 from .families.links import LINKS, Link, get_link
@@ -72,6 +75,7 @@ __all__ = [
     "influence_measures",
     "Family", "Link", "FAMILIES", "LINKS", "get_family", "get_link",
     "quasi", "negative_binomial", "glm_nb", "glm_fit_nb", "theta_of",
+    "SparseDesign", "SparseLayout", "sparse_from_csr", "sparse_from_coo",
     "Formula", "parse_formula", "Terms", "build_terms", "model_matrix",
     "transform", "as_columns", "omit_na", "read_csv", "scan_csv_schema",
     "scan_csv_levels",

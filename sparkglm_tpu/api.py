@@ -171,6 +171,12 @@ def _reject_penalty_args(*, mesh=None, engine="auto", beta0=None,
     if mesh is not None:
         raise ValueError("penalty= does not support mesh= (sharded "
                          "penalized fits are not implemented yet)")
+    if engine == "sketch":
+        raise ValueError(
+            "penalty= does not support engine='sketch': the coordinate-"
+            "descent lambda path screens and checks KKT conditions against "
+            "exact Gramian columns, and a sketched X'WX would bias every "
+            "one of them — fit the penalized path with engine='auto'")
     if engine not in ("auto", "einsum"):
         raise ValueError(
             f"penalty= requires the einsum/structured Gramian engine; "
@@ -190,10 +196,16 @@ def _reject_penalty_args(*, mesh=None, engine="auto", beta0=None,
 
 
 def _reject_elastic_args(*, penalty=None, beta0=None, on_iteration=None,
-                         resume=False):
+                         resume=False, engine="elastic"):
     """Options that conflict with the elastic shard scheduler.  Everything
     else (retry=, checkpoint=, prefetch=, trace=, metrics=, mesh=) flows
     through to the shard fits."""
+    if engine == "sketch":
+        raise ValueError(
+            "workers= (the elastic shard scheduler) does not support "
+            "engine='sketch': the one-shot shard combine is Gramian-"
+            "additive and needs exact per-shard X'WX — drop workers= to "
+            "stream a sketched fit on a single controller")
     if penalty is not None:
         raise ValueError(
             "penalty= does not support engine='elastic' (the lambda path "
@@ -612,6 +624,11 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
     shard-checkpoint DIRECTORY, preempted shards resume implicitly, and a
     permanently lost shard degrades the fit gracefully
     (``fit_info["elastic"]["degraded"]``) instead of failing it.
+
+    ``engine="sketch"`` streams the sketched IRLS solver instead of the
+    exact Gramian passes (``models/streaming.py``; README "Sketched
+    solvers") — opt-in, never auto-selected; incompatible with
+    ``penalty=``/``workers=`` and leaves standard errors NaN.
     """
     from .models import streaming
 
@@ -635,13 +652,14 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
 
     yname = (f"cbind({f.response}, {f.response2})"
              if f.response2 is not None else f.response)
-    if engine not in ("auto", "elastic"):
+    if engine not in ("auto", "elastic", "sketch"):
         raise ValueError(
-            f"glm_from_csv supports engine='auto' or engine='elastic', "
+            f"glm_from_csv supports engine='auto', 'elastic' or 'sketch', "
             f"got {engine!r}")
     if engine == "elastic" or workers is not None:
         _reject_elastic_args(penalty=penalty, beta0=beta0,
-                             on_iteration=on_iteration, resume=resume)
+                             on_iteration=on_iteration, resume=resume,
+                             engine=engine)
         from .elastic import glm_fit_elastic
         import dataclasses
         try:
@@ -661,7 +679,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
             offset_col=_offset_col_value(f, offset),
             weights_col=weights, has_weights=weights is not None)
     if penalty is not None:
-        _reject_penalty_args(mesh=mesh, beta0=beta0,
+        _reject_penalty_args(mesh=mesh, engine=engine, beta0=beta0,
                              on_iteration=on_iteration,
                              checkpoint=checkpoint, resume=resume,
                              prefetch=prefetch)
@@ -686,6 +704,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
             has_intercept=f.intercept, mesh=mesh, cache=cache,
             verbose=verbose, beta0=beta0, on_iteration=on_iteration,
             retry=retry, checkpoint=checkpoint, resume=resume,
+            engine=("sketch" if engine == "sketch" else "auto"),
             trace=trace, metrics=metrics, prefetch=prefetch, config=config)
     finally:
         parse_cleanup()
@@ -739,6 +758,12 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
         for i in range(num_chunks):
             yield lambda i=i: extract(i)
 
+    if engine == "sketch":
+        raise ValueError(
+            "lm_from_csv has no sketched solver: OLS/WLS streams the exact "
+            "normal equations in two passes and never iterates, so there "
+            "is no per-iteration Gramian to sketch — engine='sketch' is a "
+            "GLM option (glm_from_csv / glm)")
     if engine not in ("auto", "elastic"):
         raise ValueError(
             f"lm_from_csv supports engine='auto' or engine='elastic', "

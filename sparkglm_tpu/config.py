@@ -63,6 +63,25 @@ class NumericConfig:
         can hold), not a speed lever.
       bf16_switch_tol: relative |ddev| at which the warm-up hands over
         (default 1e-4 ~ the bf16 storage-rounding deviance floor).
+      sketch_dim: sketch rows m for ``engine="sketch"`` (ops/sketch.py).
+        None = auto: ``min(max(4p, 64), n)``.  The sketched Gramian is
+        only a PRECONDITIONER for CG on the exact normal equations
+        (models/glm.py::_irls_sketch_kernel), so m sets the per-step
+        contraction (~3-5x at m ~ 4p, measured), never correctness —
+        a poor sketch slows the inner solve but cannot bias or diverge it.
+      sketch_refine: preconditioned-CG steps per IRLS iteration on the
+        exact system ``X'WX u = X'Wz``, warm-started from the previous
+        iterate.  Each step costs one exact residual matvec + colsum
+        (O(nnz)) plus an O(p^2) triangular solve; the default 8 combined
+        with the warm start puts the sketch error well below f64
+        golden-fixture tolerance (PARITY.md r13).
+      sketch_seed: base PRNG seed for the sketch draws; each IRLS
+        iteration re-seeds with ``fold_in(iteration)`` (and streaming
+        chunks with ``fold_in(chunk_idx)``), so a fixed seed gives
+        bit-identical refits.
+      sketch_method: "countsketch" (input-sparsity, the default and the
+        only method for SparseDesign) or "srht" (Hadamard transform,
+        dense designs only).
     """
 
     dtype: jnp.dtype = jnp.float32
@@ -73,6 +92,10 @@ class NumericConfig:
     polish: str | None = None
     bf16_warmup: bool = False
     bf16_switch_tol: float = 1e-4
+    sketch_dim: int | None = None
+    sketch_refine: int = 8
+    sketch_seed: int = 0
+    sketch_method: str = "countsketch"
 
 
 DEFAULT = NumericConfig()

@@ -1,0 +1,335 @@
+"""Sparse (CSR/COO-fed) design representation for ultra-wide models.
+
+``StructuredDesign`` (data/structured.py) rescues factor MAIN effects —
+blocks that are exactly one-hot, one level per row.  Text features, hashed
+interactions and generic one-hot designs are sparse but NOT one-hot: a row
+carries a handful of arbitrary (column, value) pairs out of p_sp columns
+with p_sp in the 10^4..10^6 range.  Densifying those costs O(n * p_sp) HBM
+for a matrix that is ~99.9% zeros; a :class:`SparseDesign` keeps the dense
+numeric columns as a (n, d) matrix and the sparse block in ELL (row-padded)
+form: ``cols`` (n, k) int32 column indices and ``vals`` (n, k) values,
+where k is the max per-row nonzero count.  ELL — not raw CSR — because
+every consumer here needs ROW operations (chunk slicing, shard_rows,
+bucket padding, per-row matvecs) and fixed-width rows keep all of them
+fixed-shape under jit.
+
+Index convention (the "trash bucket", same as structured.py): a slot's
+column index is ``j`` for a real entry (``0 <= j < p_sp``) and ``p_sp``
+for padding — short rows, zero-weight pad rows, unseen hash buckets.
+Padding slots carry value 0, consumers allocate ``p_sp + 1`` columns and
+slice the trash off, so padded slots contribute exactly nothing.  The
+double guard (trash column AND zero value) means even a consumer that
+forgets the slice stays correct.
+
+Builders accept CSR (``from_csr``) or COO (``from_coo``) input and pad to
+ELL on the host.  ``SparseDesign`` is a registered JAX pytree: dense /
+cols / vals are leaves, the :class:`SparseLayout` (static, hashable) is
+auxiliary data — jit caches per layout, so sparse, structured and plain
+dense designs never share an executable and the models' kernels dispatch
+on ``isinstance`` at trace time with zero runtime cost (the
+StructuredDesign contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+__all__ = ["SparseLayout", "SparseDesign", "from_csr", "from_coo"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseLayout:
+    """Static column geometry of a :class:`SparseDesign` (hashable — it
+    rides jit traces as auxiliary pytree data).
+
+    Attributes:
+      p: total design width (dense + sparse columns).
+      n_dense: number of dense (materialized) columns.
+      n_sparse: number of sparse columns (the ELL trash index is n_sparse).
+      k: ELL row width — max nonzeros per row the block was padded to.
+      block_cols: length-p permutation; ``block_cols[j]`` is the
+        xnames-order column index of block column ``j``, where block order
+        is [dense columns | sparse columns].
+      intercept: dense column 0 is the all-ones intercept.
+    """
+
+    p: int
+    n_dense: int
+    n_sparse: int
+    k: int
+    block_cols: tuple[int, ...]
+    intercept: bool
+
+    def validate(self) -> None:
+        if self.n_dense + self.n_sparse != self.p:
+            raise ValueError(
+                f"layout widths {self.n_dense} + {self.n_sparse} "
+                f"!= p={self.p}")
+        if self.k < 0:
+            raise ValueError(f"ELL width k must be >= 0, got {self.k}")
+        if sorted(self.block_cols) != list(range(self.p)):
+            raise ValueError("block_cols is not a permutation of range(p)")
+
+
+def _out_positions(layout: SparseLayout) -> np.ndarray:
+    """block -> xnames column map as an int64 array (host constant)."""
+    return np.asarray(layout.block_cols, np.int64)
+
+
+class SparseDesign:
+    """Dense numeric columns + an ELL sparse block (see module docstring).
+    ``dense`` is (n, n_dense); ``cols`` is (n, k) int32 with values in
+    ``[0, n_sparse]`` (n_sparse = trash); ``vals`` is (n, k) with 0 in
+    trash slots.
+
+    No value validation happens here: pytree unflattening rebuilds
+    instances around tracers during jit.  The :func:`from_csr` /
+    :func:`from_coo` builders validate.
+    """
+
+    __slots__ = ("dense", "cols", "vals", "layout")
+
+    def __init__(self, dense, cols, vals, layout: SparseLayout):
+        self.dense = dense
+        self.cols = cols
+        self.vals = vals
+        self.layout = layout
+
+    # -- array-protocol surface the model layer relies on -------------------
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return (self.dense.shape[0], self.layout.p)
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def dtype(self):
+        return self.dense.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return (int(self.dense.nbytes) + int(self.cols.nbytes)
+                + int(self.vals.nbytes))
+
+    def astype(self, dtype, copy: bool = True) -> "SparseDesign":
+        """Cast the dense block and sparse VALUES (cols are positions,
+        never cast)."""
+        if not copy and self.dense.dtype == np.dtype(dtype) \
+                and self.vals.dtype == np.dtype(dtype):
+            return self
+        if isinstance(self.dense, np.ndarray):
+            dense = self.dense.astype(dtype, copy=copy)
+            vals = self.vals.astype(dtype, copy=copy)
+        else:
+            dense = self.dense.astype(dtype)
+            vals = self.vals.astype(dtype)
+        return SparseDesign(dense, self.cols, vals, self.layout)
+
+    def __getitem__(self, key) -> "SparseDesign":
+        """Row selection (slice / int array / bool mask).  Column selection
+        has no sparse form — ``densify()`` first."""
+        if isinstance(key, tuple):
+            raise TypeError(
+                "SparseDesign supports row indexing only; call "
+                ".densify() for column selection")
+        return SparseDesign(
+            self.dense[key], self.cols[key], self.vals[key], self.layout)
+
+    def __len__(self) -> int:
+        return int(self.dense.shape[0])
+
+    # -- host (numpy, f64-capable) helpers ----------------------------------
+
+    def densify(self, dtype=None) -> np.ndarray:
+        """Materialize the exact dense design (host numpy) — the fallback
+        for paths with no sparse form (QR/TSQR polish, column-drop refits)
+        and the oracle the f64 agreement tests compare against.  Duplicate
+        (row, col) slots accumulate, matching every sparse op here."""
+        lay = self.layout
+        D = np.asarray(self.dense)
+        dt = np.dtype(dtype) if dtype is not None else D.dtype
+        n = int(D.shape[0])
+        out = np.zeros((n, lay.p), dt)
+        bc = _out_positions(lay)
+        if lay.n_dense:
+            out[:, bc[:lay.n_dense]] = D
+        if lay.k:
+            C = np.asarray(self.cols)
+            V = np.asarray(self.vals)
+            rows = np.repeat(np.arange(n), lay.k)
+            c = C.ravel()
+            hit = c < lay.n_sparse
+            np.add.at(out, (rows[hit], bc[lay.n_dense:][c[hit]]),
+                      V.ravel()[hit].astype(dt))
+        return out
+
+    def matvec64(self, beta) -> np.ndarray:
+        """Host float64 ``X @ beta`` without densifying (streaming stats
+        passes, lm offset moments)."""
+        lay = self.layout
+        bb = np.asarray(beta, np.float64)[_out_positions(lay)]
+        eta = np.asarray(self.dense, np.float64) @ bb[:lay.n_dense]
+        if lay.k:
+            bs = np.concatenate([bb[lay.n_dense:], [0.0]])
+            eta = eta + np.sum(
+                np.asarray(self.vals, np.float64)
+                * bs[np.asarray(self.cols)], axis=1)
+        return eta
+
+    def ones_colmask(self) -> np.ndarray:
+        """Per-xnames-column "is identically 1.0" mask (host) — intercept
+        detection.  A sparse column qualifies only when every row carries
+        exactly one value-1.0 entry in it."""
+        lay = self.layout
+        D = np.asarray(self.dense)
+        n = int(D.shape[0])
+        mask = np.zeros(lay.p, bool)
+        bc = _out_positions(lay)
+        if n and lay.n_dense:
+            mask[bc[:lay.n_dense]] = \
+                (D.min(axis=0) == 1.0) & (D.max(axis=0) == 1.0)
+        if n and lay.k and lay.n_sparse:
+            C = np.asarray(self.cols).ravel()
+            V = np.asarray(self.vals, np.float64).ravel()
+            hit = C < lay.n_sparse
+            cnt = np.bincount(C[hit], minlength=lay.n_sparse)
+            ones = np.bincount(C[hit], weights=(V[hit] == 1.0),
+                               minlength=lay.n_sparse)
+            mask[bc[lay.n_dense:]] = (cnt == n) & (ones == n)
+        return mask
+
+    def col_means64(self) -> np.ndarray:
+        """Per-xnames-column mean in float64 (Terms.col_means without
+        densifying — a sparse column's mean is its value sum over n)."""
+        lay = self.layout
+        D = np.asarray(self.dense)
+        n = int(D.shape[0])
+        out = np.zeros(lay.p)
+        bc = _out_positions(lay)
+        if n and lay.n_dense:
+            out[bc[:lay.n_dense]] = D.mean(axis=0, dtype=np.float64)
+        if n and lay.k and lay.n_sparse:
+            C = np.asarray(self.cols).ravel()
+            V = np.asarray(self.vals, np.float64).ravel()
+            hit = C < lay.n_sparse
+            out[bc[lay.n_dense:]] = np.bincount(
+                C[hit], weights=V[hit], minlength=lay.n_sparse) / n
+        return out
+
+    @property
+    def nnz(self) -> int:
+        """Stored (non-trash) entries in the sparse block (host)."""
+        return int(np.count_nonzero(
+            np.asarray(self.cols) < self.layout.n_sparse))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"SparseDesign(n={self.dense.shape[0]}, p={self.layout.p}, "
+                f"n_dense={self.layout.n_dense}, "
+                f"n_sparse={self.layout.n_sparse}, k={self.layout.k})")
+
+
+def _sp_flatten(sp: SparseDesign):
+    return ((sp.dense, sp.cols, sp.vals), sp.layout)
+
+
+def _sp_unflatten(layout: SparseLayout, children) -> SparseDesign:
+    dense, cols, vals = children
+    return SparseDesign(dense, cols, vals, layout)
+
+
+jax.tree_util.register_pytree_node(SparseDesign, _sp_flatten, _sp_unflatten)
+
+
+# -- host builders (validate here, never inside the pytree) -----------------
+
+
+def _ell_from_rowidx(row_counts, order_rows, col, val, n, n_sparse, k_min=1):
+    """Pack COO triplets (already grouped per row via ``order_rows``) into
+    padded ELL arrays."""
+    k = max(int(row_counts.max()) if row_counts.size else 0, int(k_min))
+    cols = np.full((n, k), n_sparse, np.int32)
+    vals = np.zeros((n, k), val.dtype)
+    slot = np.concatenate([np.arange(c) for c in row_counts]) \
+        if row_counts.size else np.zeros(0, np.int64)
+    cols[order_rows, slot] = col
+    vals[order_rows, slot] = val
+    return cols, vals, k
+
+
+def _finish(dense, cols, vals, k, n, n_sparse, block_cols, intercept):
+    d = 0 if dense is None else int(np.asarray(dense).shape[1])
+    p = d + int(n_sparse)
+    if dense is None:
+        dense = np.zeros((n, 0), vals.dtype)
+    else:
+        dense = np.asarray(dense)
+        if dense.shape[0] != n:
+            raise ValueError(
+                f"dense block has {dense.shape[0]} rows; sparse block "
+                f"has {n}")
+        vals = vals.astype(dense.dtype, copy=False)
+    if block_cols is None:
+        block_cols = tuple(range(p))
+    lay = SparseLayout(p=p, n_dense=d, n_sparse=int(n_sparse), k=int(k),
+                       block_cols=tuple(int(c) for c in block_cols),
+                       intercept=bool(intercept))
+    lay.validate()
+    return SparseDesign(dense, cols, vals, lay)
+
+
+def from_csr(indptr, indices, data, n_sparse, *, dense=None,
+             block_cols=None, intercept: bool = False) -> SparseDesign:
+    """Build a :class:`SparseDesign` from CSR arrays (scipy's
+    ``csr_matrix`` attribute triple works directly: ``from_csr(m.indptr,
+    m.indices, m.data, m.shape[1], dense=...)``).
+
+    ``dense=None`` yields a purely sparse design; otherwise the (n, d)
+    dense block is prepended in block order.  ``block_cols`` permutes
+    block order to xnames order (identity when omitted).
+    """
+    indptr = np.asarray(indptr, np.int64)
+    indices = np.asarray(indices, np.int64)
+    data = np.asarray(data)
+    n = int(indptr.shape[0]) - 1
+    if n < 0:
+        raise ValueError("indptr must have at least one entry")
+    counts = np.diff(indptr)
+    if counts.min(initial=0) < 0:
+        raise ValueError("indptr must be nondecreasing")
+    if int(indptr[-1]) != indices.shape[0] or indices.shape != data.shape:
+        raise ValueError("indptr/indices/data lengths are inconsistent")
+    if indices.size and (indices.min() < 0 or indices.max() >= n_sparse):
+        raise ValueError(
+            f"column index out of range [0, {n_sparse})")
+    order_rows = np.repeat(np.arange(n), counts)
+    cols, vals, k = _ell_from_rowidx(
+        counts, order_rows, indices.astype(np.int32), data, n, n_sparse)
+    return _finish(dense, cols, vals, k, n, n_sparse, block_cols, intercept)
+
+
+def from_coo(row, col, val, n, n_sparse, *, dense=None,
+             block_cols=None, intercept: bool = False) -> SparseDesign:
+    """Build a :class:`SparseDesign` from COO triplets.  Duplicate
+    (row, col) pairs are kept as separate slots and accumulate (matching
+    scipy COO semantics under ``tocsr().sum_duplicates``-free use)."""
+    row = np.asarray(row, np.int64)
+    col = np.asarray(col, np.int64)
+    val = np.asarray(val)
+    if not (row.shape == col.shape == val.shape):
+        raise ValueError("row/col/val must have identical shapes")
+    if row.size and (row.min() < 0 or row.max() >= n):
+        raise ValueError(f"row index out of range [0, {n})")
+    if col.size and (col.min() < 0 or col.max() >= n_sparse):
+        raise ValueError(f"column index out of range [0, {n_sparse})")
+    order = np.argsort(row, kind="stable")
+    counts = np.bincount(row, minlength=n).astype(np.int64)
+    cols, vals, k = _ell_from_rowidx(
+        counts, row[order], col[order].astype(np.int32), val[order],
+        n, n_sparse)
+    return _finish(dense, cols, vals, k, n, n_sparse, block_cols, intercept)

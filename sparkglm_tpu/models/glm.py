@@ -45,8 +45,9 @@ from ..config import (DEFAULT, NumericConfig, effective_tol,
 from ..families.families import Family, resolve
 from ..families.links import Link
 from ..obs import trace as _obs_trace
+from ..data.sparse import SparseDesign
 from ..data.structured import StructuredDesign
-from ..ops.factor_gramian import design_gramian, design_matvec
+from ..ops.factor_gramian import design_colsum, design_gramian, design_matvec
 from ..ops.fused import fused_fisher_pass, fused_fisher_pass_ref
 from ..ops.solve import (factor_parts, factor_singular, inv_from_parts,
                          min_pivot, solve_normal)
@@ -324,6 +325,204 @@ def _segmented_irls(run_kernel, *, p, dtype, max_iter: int,
             break
     out["iters"] = np.asarray(iters_total, np.int32)
     return out
+
+
+@partial(jax.jit, static_argnames=("family", "link", "criterion", "trace",
+                                   "precision", "warm", "m", "sketch_refine",
+                                   "sketch_method"))
+def _irls_sketch_kernel(
+    X, y, wt, offset, key,
+    tol, max_iter, jitter,
+    family: Family, link: Link,
+    criterion: str = "absolute",
+    m: int = 64,
+    sketch_refine: int = 8,
+    sketch_method: str = "countsketch",
+    trace: bool = False,
+    precision=None,
+    beta0=None,
+    warm: bool = False,
+    it_base=None,
+    fam_param=None,
+):
+    """Sketched IRLS (sketch-and-precondition Hessian solves) to
+    convergence in one compiled while_loop — ``engine="sketch"``.
+
+    Per iteration the exact weighted Gramian ``G = X'WX`` is never formed.
+    Instead the Gramian of a seeded m-row sketch of ``sqrt(W) X``
+    (ops/sketch.py) is factored once per iteration and used as the
+    PRECONDITIONER for a fixed count of conjugate-gradient steps on the
+    EXACT normal equations ``G u = X'Wz``, warm-started from the previous
+    IRLS iterate.  Each CG step costs one O(nnz) exact matvec + colsum
+    plus one O(p^2) triangular solve against the sketched factor.
+
+    Why PCG and not the raw IHS update ``beta += Gs^{-1} X'W(z - X beta)``:
+    the raw update is a Richardson iteration whose contraction factor is
+    the spectral radius of ``I - Gs^{-1} G`` — it DIVERGES whenever the
+    sketch misestimates G by more than 2x in any direction, which both
+    countsketch and SRHT readily do at m ~ 4p (measured: rho 1.5-2.2 at
+    m = 4p..5p on a benign 12-column design).  PCG instead converges
+    monotonically in the G-norm for ANY SPD preconditioner; the sketch
+    quality only sets the rate (~3-5x error reduction per step at m ~ 4p,
+    measured), and the warm start makes the inner residual shrink with
+    the outer IRLS error, so the trajectory lands on the exact IRLS path
+    to solver precision and golden-fixture parity holds by construction
+    (PARITY.md r13).  Each iteration re-seeds with ``fold_in(it +
+    it_base)`` so no two iterations (across checkpoint segments too)
+    share a sketch.
+
+    The returned ``cov_inv`` is NaN: (SA'SA)^{-1} is a biased estimate of
+    (X'WX)^{-1} and exact standard errors need the full Gramian — the fit
+    front-ends reject ``se=True`` with ``engine="sketch"`` (api.py).
+
+    Everything else — step-halving recovery, convergence criteria,
+    checkpoint/warm-start semantics, trace events — mirrors
+    :func:`_irls_kernel`; ``m``/``sketch_refine``/``sketch_method`` are
+    static, so each pass flavor compiles to ONE executable.
+    """
+    from jax.scipy.linalg import cho_solve
+    from ..ops.sketch import sketched_gramian
+    acc = X.dtype if X.dtype == jnp.float64 else jnp.float32
+    p = X.shape[1]
+    valid = wt > 0
+    family = family.with_param(fam_param)
+    itb = 0 if it_base is None else it_base
+
+    def dev_of(mu):
+        return jnp.sum(_sanitize(family.dev_resids(y, mu, wt), valid))
+
+    if warm:
+        beta_init = jnp.nan_to_num(beta0).astype(X.dtype)
+        eta0 = (design_matvec(X, beta_init) + offset).astype(X.dtype)
+        mu0 = jnp.where(valid, link.inverse(eta0), 1.0)
+    else:
+        beta_init = jnp.zeros((p,), X.dtype)
+        mu0 = jnp.where(valid, family.init_mu(y, jnp.maximum(wt, 1e-30)), 1.0)
+        eta0 = link.link(mu0)
+    dev0 = dev_of(mu0)
+
+    state0 = dict(
+        it=jnp.zeros((), jnp.int32),
+        beta=beta_init,
+        eta=eta0.astype(X.dtype),
+        mu=mu0.astype(X.dtype),
+        dev=dev0.astype(acc),
+        ddev=jnp.asarray(_BIG, acc),
+        singular=jnp.zeros((), jnp.bool_),
+        stalled=jnp.zeros((), jnp.bool_),
+        pivot=jnp.ones((), acc),
+    )
+
+    def not_converged(s):
+        d = s["ddev"]
+        if criterion == "relative":
+            d = d / (jnp.abs(s["dev"]) + 0.1)
+        return (s["it"] < max_iter) & (d > tol) & ~s["singular"] & ~s["stalled"]
+
+    def body(s):
+        mu, eta = s["mu"], s["eta"]
+        g = link.deriv(mu)
+        var = family.variance(mu)
+        w = _sanitize(wt / jnp.maximum(var * g * g, 1e-30), valid)
+        z = _sanitize(eta - offset + (y - mu) * g, valid)
+        # fresh sketch per iteration (a FIXED sketch would bias the
+        # trajectory even though the fixed point is exact)
+        key_it = jax.random.fold_in(key, s["it"] + itb)
+        Gs = sketched_gramian(X, w, key_it, m, method=sketch_method,
+                              accum_dtype=acc, precision=precision)
+        # sketch-and-precondition: factor Gs once, then run sketch_refine
+        # CG steps on the EXACT normal equations G u = X'Wz with Gs as
+        # the preconditioner, warm-started from the previous iterate.
+        # Unlike the raw IHS Richardson update this cannot diverge on a
+        # poor sketch — quality only sets the per-step contraction.
+        rhs = design_colsum(X, w * z, accum_dtype=acc, precision=precision)
+        _, fac = solve_normal(Gs, rhs, jitter=jitter, refine_steps=0)
+        cho, dinv = fac
+
+        def G_mv(v):
+            return design_colsum(
+                X, w * design_matvec(X, v.astype(X.dtype),
+                                     precision=precision),
+                accum_dtype=acc, precision=precision)
+
+        def prec(r):
+            return dinv * cho_solve(cho, dinv * r)
+
+        u = s["beta"].astype(acc)
+        r = rhs - G_mv(u)
+        zv = prec(r)
+        pvec = zv
+        rz = jnp.vdot(r, zv)
+        for _ in range(sketch_refine):
+            Ap = G_mv(pvec)
+            denom = jnp.vdot(pvec, Ap)
+            # denom <= 0 only off the SPD happy path (singular/indefinite
+            # G); rz == 0 means the solve is already exact — both freeze
+            # the iterate instead of poisoning it with inf/NaN.
+            ok = (denom > 0) & (rz != 0)
+            alpha = jnp.where(ok, rz / jnp.where(denom == 0, 1.0, denom), 0.0)
+            u = u + alpha * pvec
+            r = r - alpha * Ap
+            z_new = prec(r)
+            rz_new = jnp.vdot(r, z_new)
+            bcg = jnp.where(ok, rz_new / jnp.where(rz == 0, 1.0, rz), 0.0)
+            pvec = z_new + bcg * pvec
+            rz = rz_new
+        beta = u
+        singular = factor_singular(fac)
+        pivot = min_pivot(fac)
+        singular = ~jnp.all(jnp.isfinite(beta)) | singular
+        beta = jnp.where(singular, s["beta"].astype(acc), beta)
+        eta_new = (design_matvec(X, beta.astype(X.dtype)) + offset).astype(X.dtype)
+        mu_new = jnp.where(valid, link.inverse(eta_new), 1.0).astype(X.dtype)
+        dev_new = dev_of(mu_new).astype(acc)
+
+        halve_ok = jnp.asarray(True) if warm else s["it"] > 0
+
+        def h_cond(h):
+            return (_dev_bad(h["dev"], s["dev"]) & halve_ok
+                    & (h["k"] < STEP_HALVINGS))
+
+        def h_body(h):
+            b = (0.5 * (h["beta"] + s["beta"])).astype(X.dtype)
+            e = (design_matvec(X, b) + offset).astype(X.dtype)
+            mm = jnp.where(valid, link.inverse(e), 1.0).astype(X.dtype)
+            return dict(k=h["k"] + 1, beta=b, eta=e, mu=mm,
+                        dev=dev_of(mm).astype(acc))
+
+        h = jax.lax.while_loop(h_cond, h_body, dict(
+            k=jnp.zeros((), jnp.int32), beta=beta.astype(X.dtype),
+            eta=eta_new, mu=mu_new, dev=dev_new))
+        beta, eta_new, mu_new, dev_new = h["beta"], h["eta"], h["mu"], h["dev"]
+        stalled = _dev_bad(dev_new, s["dev"]) & halve_ok
+        if trace:
+            jax.debug.callback(
+                _emit_iter_event,
+                s["it"] + 1 + (0 if it_base is None else it_base),
+                dev_new, jnp.abs(dev_new - s["dev"]), h["k"])
+        return dict(
+            it=s["it"] + 1,
+            beta=beta.astype(X.dtype),
+            eta=eta_new,
+            mu=mu_new,
+            dev=dev_new,
+            ddev=jnp.abs(dev_new - s["dev"]),
+            singular=singular,
+            stalled=stalled,
+            pivot=pivot.astype(acc),
+        )
+
+    s = jax.lax.while_loop(not_converged, body, state0)
+
+    d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
+    converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"] & ~s["stalled"]
+
+    return dict(beta=s["beta"],
+                cov_inv=jnp.full((p, p), jnp.nan, acc),
+                dev=s["dev"], eta=s["eta"], iters=s["it"],
+                converged=converged, singular=s["singular"],
+                pivot=s["pivot"],
+                XtWX0=jnp.zeros((p, p), acc))
 
 
 @partial(jax.jit, static_argnames=("family", "link", "mesh", "steps"))
@@ -630,8 +829,13 @@ class GLMModel:
     fit_info: dict | None = None
     # which Gramian engine produced X'WX: "einsum" (dense MXU contraction),
     # "fused" (single-kernel pass), "structured" (factor-aware segment
-    # sums), or "qr" (no Gramian solve)
+    # sums), "sparse" (exact ELL segment sums), "sketch" (IHS, ops/
+    # sketch.py), or "qr" (no Gramian solve)
     gramian_engine: str | None = None
+    # engine="sketch" record: sketch rows m and IHS refinement passes per
+    # IRLS step (None on non-sketch fits)
+    sketch_dim: int | None = None
+    sketch_refine: int | None = None
 
     def fit_report(self) -> dict:
         """How the fit ran: iterations, wall/device time split, per-pass
@@ -647,6 +851,9 @@ class GLMModel:
             "n_obs": int(self.n_obs), "n_params": int(self.n_params),
             "gramian_engine": self.gramian_engine,
         }
+        if self.gramian_engine == "sketch":
+            rep["sketch_dim"] = self.sketch_dim
+            rep["sketch_refine"] = self.sketch_refine
         if self.fit_info:
             rep.update(self.fit_info)
         return rep
@@ -665,7 +872,8 @@ class GLMModel:
         numerics path (models/scoring.py) — also the one the online
         serving engine (sparkglm_tpu/serve) compiles per padding bucket,
         so served and offline predictions are bit-identical."""
-        if not isinstance(X, StructuredDesign):
+        from ..data.sparse import SparseDesign
+        if not isinstance(X, (StructuredDesign, SparseDesign)):
             X = np.asarray(X)
         if X.ndim != 2 or X.shape[1] != self.n_params:
             raise ValueError(
@@ -734,6 +942,12 @@ class GLMModel:
     def vcov(self) -> np.ndarray:
         """dispersion * (X'WX)^-1 — R's vcov(glm)."""
         if self.cov_unscaled is None:
+            if self.gramian_engine == "sketch":
+                raise ValueError(
+                    "engine='sketch' fits carry no covariance: the sketched "
+                    "Gramian is a biased estimate of X'WX, so exact standard "
+                    "errors / se_fit=True need the full Gramian — refit with "
+                    "engine='einsum' for inference (PARITY.md r13)")
             raise ValueError("model was fit without the unscaled covariance "
                              "(streaming fits keep only its diagonal)")
         return self.dispersion * self.cov_unscaled
@@ -806,7 +1020,7 @@ def _emit_iter_event(i, dev, ddev, halvings) -> None:
               f"\tddev {float(ddev):.3g}", file=sys.stderr)
 
 
-def _trace_kernel_calls(run_kernel, tracer, gramian_engine=None):
+def _trace_kernel_calls(run_kernel, tracer, gramian_engine=None, extra=None):
     """Wrap an engine closure so every compiled segment runs inside a
     device-aware span (obs/timing.py): blocking happens at the span edge
     only — the caller reads these outputs immediately anyway, so the
@@ -814,10 +1028,13 @@ def _trace_kernel_calls(run_kernel, tracer, gramian_engine=None):
     ``compile`` (wall time including compilation), every call emits
     ``solve`` with the segment's iteration count.  ``gramian_engine``
     stamps both events with which X'WX assembly ran (einsum | fused |
-    structured | qr)."""
+    structured | sparse | sketch | qr); ``extra`` adds engine-specific
+    fields (the sketch engine's m and refinement count)."""
     from ..obs import timing as _obs_timing
     state = {"calls": 0}
-    extra = {} if gramian_engine is None else {"gramian_engine": gramian_engine}
+    extra = dict(extra or {})
+    if gramian_engine is not None:
+        extra["gramian_engine"] = gramian_engine
 
     def wrapped(seg_iters, beta_arr, warm, it_base=0, dev_prev=None):
         with _obs_timing.span("irls_segment", tracer, device=True) as sp:
@@ -1156,6 +1373,15 @@ def fit(
         (kappa ≳ 1e2 at float32) where the f32 Gramian itself is
         noise-dominated.  Slower per iteration (Householder QR instead of
         one MXU matmul).
+      * ``"sketch"`` — sketch-and-precondition IRLS (ops/sketch.py,
+        ``_irls_sketch_kernel``): never forms the exact p x p Gramian;
+        factors a seeded m-row sketch of sqrt(W)X per iteration and runs
+        ``config.sketch_refine`` preconditioned-CG steps on the exact
+        normal equations.  The only engine that fits ultra-wide
+        ``SparseDesign`` blocks in input-sparsity time (also accepts
+        dense arrays).  Opt-in — never auto-selected: no covariance
+        (``vcov()``/``se_fit`` refuse), ``singular="error"`` only
+        (README "Sketched solvers"; PARITY.md r13).
       * ``"auto"`` — the einsum engine: measured on the real chip with
         dispatch cost cancelled (r5, benchmarks/HOTLOOP_r05.md), XLA's
         fused einsum pass runs 12.0 ms/iter at 2Mx512 (MFU 0.47) vs the
@@ -1229,7 +1455,8 @@ def _fit_dispatch(
                            checkpoint_every=checkpoint_every, engine=engine,
                            tracer=tracer)
     is_structured = isinstance(X, StructuredDesign)
-    if not is_structured:
+    is_sparse = isinstance(X, SparseDesign)
+    if not (is_structured or is_sparse):
         X = np.asarray(X)
     y = np.asarray(y)
     if y.ndim == 2:
@@ -1304,24 +1531,49 @@ def _fit_dispatch(
         # engine="fused" stays available explicitly (its bf16 master-copy
         # warm-up remains the memory lever, BF16_DECISION_r05.md).
         engine = "einsum"
-    if engine not in ("einsum", "fused", "qr"):
+    if engine not in ("einsum", "fused", "qr", "sketch"):
         raise ValueError(
-            f"engine must be 'auto', 'einsum', 'fused' or 'qr', got {engine!r}")
-    if engine in ("fused", "qr") and (shard_features
-                                      or mesh.shape[meshlib.MODEL_AXIS] != 1):
+            f"engine must be 'auto', 'einsum', 'fused', 'qr' or 'sketch', "
+            f"got {engine!r}")
+    if engine in ("fused", "qr", "sketch") and (
+            shard_features or mesh.shape[meshlib.MODEL_AXIS] != 1):
         raise ValueError(
             f"engine={engine!r} does not support a sharded feature axis")
     if is_structured:
         if engine != "einsum":
             raise ValueError(
                 f"engine={engine!r} has no structured form (the fused and "
-                "TSQR kernels stream dense row blocks) — fit with "
-                "design='dense' or densify() first")
+                "TSQR kernels stream dense row blocks; the sketch engine "
+                "covers SparseDesign) — fit with design='dense' or "
+                "densify() first")
         if shard_features:
             raise ValueError(
                 "structured designs cannot be feature-sharded — densify "
                 "first or use shard_features=False")
-    g_engine = "structured" if is_structured else engine
+    if is_sparse and engine not in ("einsum", "sketch"):
+        raise ValueError(
+            f"engine={engine!r} has no sparse form — sparse designs fit "
+            "with engine='einsum' (exact, O(p_sp^2) Gramian) or "
+            "engine='sketch' (IHS, input-sparsity time)")
+    if engine == "sketch":
+        # opt-in only (never auto-selected): no exact covariance, so no
+        # SEs, and the host rank check needs the exact first Gramian
+        if singular == "drop":
+            raise ValueError(
+                "engine='sketch' supports singular='error' only — the "
+                "drop path's rank check needs the exact Gramian; fit the "
+                "aliased design with engine='einsum'")
+        if config.sketch_method == "srht" and is_sparse:
+            raise ValueError(
+                "sketch_method='srht' has no input-sparsity form; use "
+                "sketch_method='countsketch' for sparse designs")
+        if config.sketch_method not in ("countsketch", "srht"):
+            raise ValueError(
+                "sketch_method must be 'countsketch' or 'srht', got "
+                f"{config.sketch_method!r}")
+    g_engine = ("sketch" if engine == "sketch"
+                else "structured" if is_structured
+                else "sparse" if is_sparse else engine)
     if config.bf16_warmup and not (
             engine == "fused" and dtype == np.float32
             and criterion == "relative" and not checkpointing):
@@ -1339,8 +1591,12 @@ def _fit_dispatch(
             "fused float32 engine with criterion='relative' and no "
             "checkpointing", stacklevel=2)
     # the qr engine's corrected-seminormal solve already delivers the
-    # polish's ~eps*kappa accuracy every iteration — skip the redundant TSQR
-    polish_active = config.polish == "csne" and engine != "qr"
+    # polish's ~eps*kappa accuracy every iteration — skip the redundant
+    # TSQR.  The sketch engine's refinement passes are its own polish
+    # (exact-residual IHS steps), and TSQR streams dense row blocks the
+    # sparse representation doesn't have.
+    polish_active = (config.polish == "csne"
+                     and engine not in ("qr", "sketch") and not is_sparse)
     if polish_active and (shard_features
                           or mesh.shape[meshlib.MODEL_AXIS] != 1):
         import warnings
@@ -1438,6 +1694,39 @@ def _fit_dispatch(
                     it1 + int(np.asarray(out["iters"])), np.int32))
         else:
             out = run_kernel(max_iter, np.zeros((p,), dtype), False)
+    elif engine == "sketch":
+        from ..ops.sketch import sketch_dim as _sketch_dim
+        m_run = _sketch_dim(n, p, config.sketch_dim)
+        sk_key = jax.random.PRNGKey(int(config.sketch_seed))
+
+        def run_kernel(seg_iters, beta_arr, warm, it_base=0, dev_prev=None):
+            # it_base also seeds the per-iteration sketch (fold_in), so
+            # checkpoint segments never replay a sketch
+            return _irls_sketch_kernel(
+                Xd, yd, wd, od, sk_key, tol_dev,
+                jnp.asarray(seg_iters, jnp.int32),
+                jnp.asarray(config.jitter, dtype),
+                family=fam, link=lnk, criterion=criterion,
+                m=m_run, sketch_refine=int(config.sketch_refine),
+                sketch_method=config.sketch_method,
+                trace=verbose or tracer is not None,
+                precision=config.matmul_precision,
+                beta0=jnp.asarray(beta_arr, dtype), warm=warm,
+                it_base=jnp.asarray(it_base, jnp.int32),
+                fam_param=fam_param,
+            )
+        if tracer is not None:
+            run_kernel = _trace_kernel_calls(
+                run_kernel, tracer, g_engine,
+                extra={"sketch_dim": m_run,
+                       "sketch_refine": int(config.sketch_refine)})
+        if checkpointing:
+            out = _segmented_irls(run_kernel, p=p, dtype=dtype,
+                                  max_iter=max_iter, beta0=beta0,
+                                  on_iteration=on_iteration,
+                                  checkpoint_every=checkpoint_every)
+        else:
+            out = run_kernel(max_iter, np.zeros((p,), dtype), False)
     else:
         def run_kernel(seg_iters, beta_arr, warm, it_base=0, dev_prev=None):
             # dev_prev is the fused kernel's segment-boundary ddev baseline;
@@ -1524,7 +1813,8 @@ def _fit_dispatch(
         engine=engine,
         polish_active=polish_active, polish_cfg=config.polish,
         can_polish=not shard_features
-        and mesh.shape[meshlib.MODEL_AXIS] == 1 and not is_structured)
+        and mesh.shape[meshlib.MODEL_AXIS] == 1 and not is_structured
+        and not is_sparse and engine != "sketch")
     if polish_active:
         # TSQR + corrected seminormal equations at the final weights
         # (ops/tsqr.py): error ~eps*kappa instead of ~eps*kappa^2 (measured
@@ -1571,7 +1861,7 @@ def _fit_dispatch(
         null_dev = hoststats.null_deviance(
             fam.name, lnk.name, y64, wt64, off64, has_intercept)
 
-    return _finalize_model(
+    model = _finalize_model(
         fam=fam, lnk=lnk, beta=out["beta"], cov_inv=out["cov_inv"],
         dev=dev, pearson=hs["pearson"], loglik=hs["loglik"],
         wt_sum=hs["wt_sum"],
@@ -1584,3 +1874,11 @@ def _fit_dispatch(
         has_offset=has_offset, n_shards=mesh.shape[meshlib.DATA_AXIS],
         tol=tol, criterion=criterion, verbose=verbose, tol_eff=tol_run,
         tracer=tracer, gramian_engine=g_engine)
+    if engine == "sketch":
+        # no exact covariance exists on this path: the kernel's cov_inv is
+        # NaN (so std_errors are NaN), and cov_unscaled=None makes vcov()
+        # raise instead of scaling a biased sketched inverse
+        model = dataclasses.replace(
+            model, cov_unscaled=None, sketch_dim=int(m_run),
+            sketch_refine=int(config.sketch_refine))
+    return model

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import DEFAULT, NumericConfig
+from ..data.sparse import SparseDesign
 from ..data.structured import StructuredDesign
 from ..obs import trace as _obs_trace
 from ..ops.factor_gramian import design_gramian, design_matvec
@@ -212,7 +213,7 @@ class LMModel:
         (models/scoring.py — the reference's executor-side
         ``predictMultiple``, LM.scala:52-61), including the se.fit
         quadform on device.  None keeps the single-device path."""
-        if not isinstance(X, StructuredDesign):
+        if not isinstance(X, (StructuredDesign, SparseDesign)):
             X = np.asarray(X)
         if X.ndim != 2 or X.shape[1] != self.n_params:
             raise ValueError(
@@ -373,7 +374,7 @@ def _detect_intercept(X: np.ndarray, xnames: Sequence[str] | None) -> bool:
     present iff some column is constant 1 (or is named 'intercept')."""
     if xnames is not None and any(n.lower() in ("intercept", "(intercept)") for n in xnames):
         return True
-    if isinstance(X, StructuredDesign):
+    if isinstance(X, (StructuredDesign, SparseDesign)):
         # the layout records whether the builder placed an intercept; a
         # manually-assembled design still gets the all-ones scan
         return bool(X.layout.intercept or X.ones_colmask().any())

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..data.sparse import SparseDesign
 from ..data.structured import StructuredDesign
 from ..ops.factor_gramian import design_matvec, structured_quadform
 from ..parallel import mesh as meshlib
@@ -66,6 +67,9 @@ def _score_fn(X, beta, offset, V, *, inverse=None, deriv=None,
         return (fit,)
     if isinstance(X, StructuredDesign):
         q = structured_quadform(X, V, precision=quad_precision)
+    elif isinstance(X, SparseDesign):
+        from ..ops.sketch import sparse_quadform
+        q = sparse_quadform(X, V, precision=quad_precision)
     else:
         XV = jnp.matmul(X, V, precision=quad_precision)  # (n, p) MXU
         q = jnp.sum(XV * X, axis=1)
@@ -111,9 +115,11 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
 
     Args:
       X: (n, p) host design aligned to the model's xnames — a dense
-        matrix or a ``StructuredDesign``, which scores without one-hot
-        materialization for BOTH the fit and the se quadform
-        (``ops/factor_gramian.structured_quadform``).
+        matrix, a ``StructuredDesign`` (scores without one-hot
+        materialization for BOTH the fit and the se quadform,
+        ``ops/factor_gramian.structured_quadform``), or a
+        ``SparseDesign`` (ELL matvec + ``ops/sketch.sparse_quadform``,
+        never densified).
       coefficients: (p,) — NaN (aliased) entries contribute nothing
         (R's reduced-basis prediction).
       mesh: score over a device mesh as one row-sharded SPMD pass; None
@@ -136,7 +142,7 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
     """
     from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
 
-    structured = isinstance(X, StructuredDesign)
+    structured = isinstance(X, (StructuredDesign, SparseDesign))
     if not structured:
         X = np.asarray(X)
     n, p = X.shape
@@ -148,7 +154,7 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
     oh = None if offset is None else np.asarray(offset, dtype).reshape(n)
     if pad_to is not None and int(pad_to) > n:
         t = int(pad_to)
-        if structured:
+        if isinstance(Xh, StructuredDesign):
             # dense leaf zero-pads; index leaves pad with the trash bucket
             # (L) so pad rows gather the appended zero — inert before the
             # [:n] slice even touches them
@@ -160,6 +166,18 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
                 v[:n] = np.asarray(ix)
                 idxp.append(v)
             Xh = StructuredDesign(Dp, tuple(idxp), Xh.layout)
+        elif isinstance(Xh, SparseDesign):
+            # ELL leaves: slot columns pad with the sparse trash column
+            # (n_sparse, sliced off every gather), values with zero
+            lay = Xh.layout
+            Dp = np.zeros((t, lay.n_dense), dtype)
+            Dp[:n] = np.asarray(Xh.dense)
+            Cp = np.full((t, lay.k), lay.n_sparse,
+                         np.asarray(Xh.cols).dtype)
+            Cp[:n] = np.asarray(Xh.cols)
+            Vp = np.zeros((t, lay.k), dtype)
+            Vp[:n] = np.asarray(Xh.vals)
+            Xh = SparseDesign(Dp, Cp, Vp, lay)
         else:
             Xp = np.zeros((t, p), dtype)
             Xp[:n] = Xh

@@ -58,14 +58,16 @@ import scipy.linalg
 
 from ..config import DEFAULT, NumericConfig, effective_tol
 from ..data import pipeline as _pipeline
+from ..data.sparse import SparseDesign
 from ..data.structured import StructuredDesign
 from ..obs import trace as _obs_trace
 from ..families.families import Family, resolve
 from ..families.links import Link
-from ..ops.factor_gramian import design_gramian, structured_fisher_pass
+from ..ops.factor_gramian import (design_colsum, design_gramian,
+                                  design_matvec, structured_fisher_pass)
 from ..ops.fused import fused_fisher_pass_ref
 from ..parallel import mesh as meshlib
-from .glm import GLMModel
+from .glm import GLMModel, _sanitize
 from .lm import LMModel
 
 DEFAULT_CHUNK_ROWS = 262_144
@@ -133,7 +135,7 @@ def _ones_colmask(Xc) -> np.ndarray:
     chunks scan on device (pulling only the (p,) mask)."""
     if _is_device_chunk(Xc):
         return np.asarray(_ones_colmask_dev(Xc))
-    if isinstance(Xc, StructuredDesign):
+    if isinstance(Xc, (StructuredDesign, SparseDesign)):
         return Xc.ones_colmask()
     Xc = np.asarray(Xc)
     return (Xc.min(axis=0) == 1.0) & (Xc.max(axis=0) == 1.0)
@@ -166,7 +168,7 @@ def _chunk_xbeta(Xc, beta) -> np.ndarray:
     if _is_device_chunk(Xc):
         return np.asarray(
             _matvec_hi(Xc, jnp.asarray(beta, Xc.dtype)), np.float64)
-    if isinstance(Xc, StructuredDesign):
+    if isinstance(Xc, (StructuredDesign, SparseDesign)):
         return Xc.matvec64(beta)
     return np.asarray(Xc, np.float64) @ beta
 
@@ -181,7 +183,7 @@ def _check_finite_design_any(Xc) -> None:
                 "generator's output")
         return
     from .validate import check_finite_design
-    check_finite_design(Xc if isinstance(Xc, StructuredDesign)
+    check_finite_design(Xc if isinstance(Xc, (StructuredDesign, SparseDesign))
                         else np.asarray(Xc))
 
 
@@ -224,6 +226,21 @@ def _fingerprint(Xc, yc, wc=None, oc=None) -> tuple:
         for ix in Xc.idx:
             v = np.ravel(np.asarray(ix))
             parts += [int(v[0]), int(v[-1])]
+        return (*parts, *corners(yc), *corners(wc), *corners(oc))
+    if isinstance(Xc, SparseDesign):
+        # corner-sample every ELL leaf: dense block, slot columns, values
+        n = int(Xc.shape[0])
+        if n == 0:
+            return (0, int(Xc.shape[1]))
+        parts = [n, int(Xc.shape[1])]
+        D = np.asarray(Xc.dense)
+        if D.shape[1]:
+            parts += [float(D[0, 0]), float(D[-1, -1])]
+        if Xc.layout.k:
+            C = np.asarray(Xc.cols)
+            V = np.asarray(Xc.vals)
+            parts += [int(C[0, 0]), int(C[-1, -1]),
+                      float(V[0, 0]), float(V[-1, -1])]
         return (*parts, *corners(yc), *corners(wc), *corners(oc))
     Xc = np.asarray(Xc)
     n = int(Xc.shape[0])
@@ -348,6 +365,19 @@ def _bucket_pad(Xc, yc, wc, oc, bucket: dict):
             v[:n] = np.asarray(ix)
             idxp.append(v)
         Xp = StructuredDesign(Dp, tuple(idxp), Xc.layout)
+    elif isinstance(Xc, SparseDesign):
+        # pad ELL leaf-wise: dense rows zero, slot columns to the sparse
+        # TRASH column (n_sparse — sliced off every segment sum) with
+        # value 0, so pad rows touch no real column even before the
+        # weight-0 guarantee kicks in (same convention as shard_rows)
+        lay = Xc.layout
+        Dp = np.zeros((target, lay.n_dense), np.asarray(Xc.dense).dtype)
+        Dp[:n] = np.asarray(Xc.dense)
+        Cp = np.full((target, lay.k), lay.n_sparse, np.asarray(Xc.cols).dtype)
+        Cp[:n] = np.asarray(Xc.cols)
+        Vp = np.zeros((target, lay.k), np.asarray(Xc.vals).dtype)
+        Vp[:n] = np.asarray(Xc.vals)
+        Xp = SparseDesign(Dp, Cp, Vp, lay)
     else:
         Xp = np.zeros((target, int(Xc.shape[1])), np.asarray(Xc).dtype)
         Xp[:n] = np.asarray(Xc)
@@ -444,8 +474,8 @@ def _put_chunk(Xc, yc, wc, oc, mesh, dtype):
 
         return (jax.device_put(jnp.asarray(Xc, dtype), sh_m),
                 putv(yc, 0.0), putv(wc, 1.0), putv(oc, 0.0))
-    if isinstance(Xc, StructuredDesign):
-        Xc = Xc.astype(dtype, copy=False)   # casts the dense leaf only
+    if isinstance(Xc, (StructuredDesign, SparseDesign)):
+        Xc = Xc.astype(dtype, copy=False)   # casts the float leaves only
     else:
         Xc = np.asarray(Xc, dtype=dtype)
     nc = Xc.shape[0]
@@ -476,6 +506,73 @@ def _glm_chunk_pass(Xc, yc, wc, oc, beta, *, family: Family, link: Link,
     return fused_fisher_pass_ref(Xc, yc, wc, oc, beta,
                                  family=family, link=link, first=first,
                                  precision="highest", fam_param=fam_param)
+
+
+def _glm_irls_state(Xc, yc, wc, oc, beta, *, family, link, first):
+    """The frozen per-chunk IRLS state ``(w, z, dev)`` at ``beta`` —
+    shared by the sketch pass and its CG refinement passes so they see
+    bit-identical weights (trace-time family/link dispatch, device math
+    at chunk dtype like the exact chunk pass)."""
+    valid = wc > 0
+    if first:
+        mu = jnp.where(valid, family.init_mu(yc, jnp.maximum(wc, 1e-30)), 1.0)
+        eta = link.link(mu).astype(Xc.dtype)
+    else:
+        eta = (design_matvec(Xc, beta,
+                             precision=jax.lax.Precision.HIGHEST)
+               + oc).astype(Xc.dtype)
+        mu = jnp.where(valid, link.inverse(eta), 1.0)
+    g = link.deriv(mu)
+    var = family.variance(mu)
+    w = _sanitize(wc / jnp.maximum(var * g * g, 1e-30), valid)
+    z = _sanitize(eta - oc + (yc - mu) * g, valid)
+    dev = jnp.sum(_sanitize(family.dev_resids(yc, mu, wc), valid))
+    return w, z, mu, g, dev
+
+
+@partial(jax.jit, static_argnames=("family", "link", "first", "m", "method"))
+def _glm_sketch_chunk_pass(Xc, yc, wc, oc, beta, key, *, family: Family,
+                           link: Link, first: bool, m: int, method: str,
+                           fam_param=None):
+    """Sketch-engine chunk pass: ``(Gs_c, g_c, dev_c)`` — the sketched
+    Gramian of this chunk's ``sqrt(W) X`` (its own ``key``, so the pass
+    total is a block-diagonal sketch of the full design), the EXACT
+    gradient ``X'W(z - X beta)``, and the chunk deviance.  Same
+    host-f64-accumulated triple shape as the exact chunk pass, so it
+    rides the same per-pass machinery (drain/allsum/cache).
+
+    ``z - X beta`` collapses to ``(y - mu) * dmu_deta^-1`` at the incoming
+    beta, so the gradient costs one colsum, no extra matvec."""
+    from ..ops.sketch import sketched_gramian
+    family = family.with_param(fam_param)
+    acc = Xc.dtype if Xc.dtype == jnp.float64 else jnp.float32
+    w, z, mu, g, dev = _glm_irls_state(Xc, yc, wc, oc, beta, family=family,
+                                       link=link, first=first)
+    valid = wc > 0
+    Gs = sketched_gramian(Xc, w, key, m, method=method, accum_dtype=acc,
+                          precision=jax.lax.Precision.HIGHEST)
+    resid = z if first else _sanitize((yc - mu) * g, valid)
+    grad = design_colsum(Xc, w * resid, accum_dtype=acc,
+                         precision=jax.lax.Precision.HIGHEST)
+    return Gs, grad, dev
+
+
+@partial(jax.jit, static_argnames=("family", "link", "first"))
+def _glm_cg_chunk_pass(Xc, yc, wc, oc, beta, v, *, family: Family,
+                       link: Link, first: bool, fam_param=None):
+    """CG refinement chunk pass for the sketch engine: the exact
+    ``X'W(X v)`` at the FROZEN IRLS state (w rebuilt from the same beta
+    the sketch pass saw — bit-identical by construction).  Returned as
+    the standard pass triple with a scalar dummy Gramian slot so the
+    host accumulation/allsum path needs no second shape."""
+    family = family.with_param(fam_param)
+    acc = Xc.dtype if Xc.dtype == jnp.float64 else jnp.float32
+    w, _, _, _, _ = _glm_irls_state(Xc, yc, wc, oc, beta, family=family,
+                                    link=link, first=first)
+    Ap = design_colsum(
+        Xc, w * design_matvec(Xc, v, precision=jax.lax.Precision.HIGHEST),
+        accum_dtype=acc, precision=jax.lax.Precision.HIGHEST)
+    return jnp.zeros((1, 1), acc), Ap, jnp.zeros((), acc)
 
 
 @jax.jit
@@ -1357,12 +1454,27 @@ def glm_fit_streaming(
     trace=None,
     metrics=None,
     prefetch: int = 0,
+    engine: str = "auto",
     config: NumericConfig = DEFAULT,
     _null_model: bool = False,
 ) -> GLMModel:
     """IRLS with one streaming pass per iteration; beta is the only carried
     state.  Deviance measured in a pass belongs to the incoming beta (same
     lagged-|ddev| convergence as the fused resident engine, models/glm.py).
+
+    ``engine``: ``"auto"``/``"einsum"`` accumulate the exact per-chunk
+    Gramian (structured chunks dispatch their factor-aware pass
+    automatically); ``"sketch"`` runs the sketched solver — each IRLS
+    iteration is ONE sketch pass (per-chunk sketched Gramians summing to
+    a block-diagonal sketch of the whole design, plus the exact gradient
+    and deviance) followed by ``config.sketch_refine`` CG passes that
+    apply the exact ``X'WX`` matvec at the frozen weights, preconditioned
+    by the pass's sketched factor (the streaming twin of the resident
+    ``engine="sketch"``, models/glm.py::_irls_sketch_kernel — same
+    exact fixed point, NaN std_errors, ``vcov()`` refused).
+    :class:`~sparkglm_tpu.data.sparse.SparseDesign` chunks REQUIRE
+    ``engine="sketch"`` (the exact chunk pass would densify); the sketch
+    engine is never auto-selected.
 
     ``cache`` controls the device-resident chunk cache (:class:`_ChunkCache`
     — the ``.persist()`` the reference lacks, SURVEY.md §2.4): ``"auto"``
@@ -1425,8 +1537,8 @@ def glm_fit_streaming(
               verbose=verbose, beta0=beta0, on_iteration=on_iteration,
               cache=cache, cache_budget_bytes=cache_budget_bytes,
               retry=retry, checkpoint=checkpoint, resume=resume,
-              prefetch=prefetch, config=config, _null_model=_null_model,
-              tracer=tracer)
+              prefetch=prefetch, engine=engine, config=config,
+              _null_model=_null_model, tracer=tracer)
     if tracer is None:
         return _glm_fit_streaming_impl(source, **kw)
     with _obs_trace.ambient(tracer):
@@ -1442,11 +1554,20 @@ def glm_fit_streaming(
 def _glm_fit_streaming_impl(
     source, *, family, link, tol, max_iter, criterion, chunk_rows, xnames,
     yname, has_intercept, mesh, verbose, beta0, on_iteration, cache,
-    cache_budget_bytes, retry, checkpoint, resume, prefetch, config,
+    cache_budget_bytes, retry, checkpoint, resume, prefetch, engine, config,
     _null_model, tracer,
 ) -> GLMModel:
     """Body of :func:`glm_fit_streaming` with the tracer already resolved."""
     _check_polish(config)
+    if engine not in ("auto", "einsum", "sketch"):
+        raise ValueError(
+            "streaming engine must be 'auto', 'einsum' or 'sketch', "
+            f"got {engine!r}")
+    sketch_run = engine == "sketch"
+    if sketch_run and config.sketch_method not in ("countsketch", "srht"):
+        raise ValueError(
+            "sketch_method must be 'countsketch' or 'srht', "
+            f"got {config.sketch_method!r}")
     prefetch = _check_prefetch(prefetch)
     fam, lnk = resolve(family, link)
     nproc = jax.process_count()
@@ -1541,11 +1662,11 @@ def _glm_fit_streaming_impl(
             ccache.offer(dchunk, n_true, fingerprint=fp)
             yield (*dchunk, n_true)
 
-    def full_pass(beta, first):
+    def full_pass(beta, first, label=None, chunk_call=None):
         nonlocal n_total, scanned, pass_no
         pass_no += 1
         idx = pass_no
-        label = "init" if first else "irls"
+        label = label or ("init" if first else "irls")
         if tracer is not None:
             tracer.pass_start(label, idx)
         # telemetry split: "compute" is the time blocked draining device
@@ -1585,14 +1706,23 @@ def _glm_fit_streaming_impl(
             # dispatch chunk k+1 (device_put + pass are async) BEFORE
             # blocking on chunk k's results: host IO/encode and H2D overlap
             # device compute (double buffering — ADVICE/VERDICT r1 #8)
-            fut = _traced_call(_glm_chunk_pass, tracer,
-                               f"glm_pass:{label}",
-                               dX, dy, dw, do, b,
-                               engine=("structured"
-                                       if isinstance(dX, StructuredDesign)
-                                       else "einsum"),
-                               family=fam, link=lnk, first=first,
-                               fam_param=fam.param_operand())
+            if chunk_call is not None:
+                fut = chunk_call(dX, dy, dw, do, b, nchunks - 1)
+            else:
+                if isinstance(dX, SparseDesign):
+                    raise ValueError(
+                        "streaming SparseDesign chunks require "
+                        "engine='sketch' (the exact chunk pass would "
+                        "densify the ELL blocks); pass engine='sketch' "
+                        "to glm_fit_streaming")
+                fut = _traced_call(_glm_chunk_pass, tracer,
+                                   f"glm_pass:{label}",
+                                   dX, dy, dw, do, b,
+                                   engine=("structured"
+                                           if isinstance(dX, StructuredDesign)
+                                           else "einsum"),
+                                   family=fam, link=lnk, first=first,
+                                   fam_param=fam.param_operand())
             if pending is not None:
                 drain(pending)
             pending = fut
@@ -1617,30 +1747,33 @@ def _glm_fit_streaming_impl(
 
     n_rows_global = None  # cross-process row count (n_total stays local)
 
-    def global_pass(beta, first):
+    def global_pass(beta, first, label=None, chunk_call=None):
         """One full pass, summed across processes: every process leaves
         with the identical global (X'WX, X'Wz, dev) and solves in
         lockstep (see the multi-host composition note above)."""
         nonlocal n_rows_global, ones_mask, saw_offset
         if nproc == 1:
-            XtWX, XtWz, dev = full_pass(beta, first)
+            XtWX, XtWz, dev = full_pass(beta, first, label, chunk_call)
             n_rows_global = n_total
             return XtWX, XtWz, dev
         err = None
         try:
-            XtWX, XtWz, dev = full_pass(beta, first)
+            XtWX, XtWz, dev = full_pass(beta, first, label, chunk_call)
         except Exception as e:  # noqa: BLE001 — re-raised by _sync_errors
             err = e
         _sync_errors(err)
         from ..parallel import distributed as dist
-        pp = XtWX.shape[0]
+        pp = XtWz.shape[0]
         if n_rows_global is None:
             _sync_design_width(pp)
+        # sizes, not pp*pp: a CG refinement pass carries a scalar dummy in
+        # the Gramian slot (see _glm_cg_chunk_pass)
+        sA, sV = XtWX.size, XtWz.size
         flat = np.concatenate([np.ravel(XtWX), np.ravel(XtWz),
                                [float(dev)]])
         tot = dist.allsum_f64(flat)
-        XtWX = tot[:pp * pp].reshape(pp, pp)
-        XtWz = tot[pp * pp:pp * pp + pp]
+        XtWX = tot[:sA].reshape(XtWX.shape)
+        XtWz = tot[sA:sA + sV]
         dev = float(tot[-1])
         if n_rows_global is None:
             # first-pass metadata: global row count, intercept columns
@@ -1654,6 +1787,89 @@ def _glm_fit_streaming_impl(
             if ones_mask is not None:
                 ones_mask = meta[2:] == nproc
         return XtWX, XtWz, dev
+
+    from ..ops.sketch import sketch_dim as _sk_dim
+    sk_base = (jax.random.PRNGKey(int(config.sketch_seed)) if sketch_run
+               else None)
+    sk_refine = int(config.sketch_refine)
+    m_used = 0
+
+    def sketch_update(beta_in, first, pass_idx):
+        """One sketched IRLS update: a sketch pass (per-chunk sketched
+        Gramians — a block-diagonal sketch of the whole design — plus the
+        exact gradient at ``beta_in`` and the lagged deviance), then up to
+        ``sketch_refine`` preconditioned-CG passes applying the exact
+        ``X'WX`` matvec at the frozen weights.  The streaming twin of the
+        resident kernel's inner loop (models/glm.py::_irls_sketch_kernel):
+        same exact fixed point, with Gs/g/Ap accumulated host-f64 across
+        chunks and processes exactly like the exact path's (X'WX, X'Wz).
+        Chunk sketches re-seed with ``fold_in(pass_idx)`` then
+        ``fold_in(chunk_idx)``, so refits are bit-identical and resumed
+        runs replay the uninterrupted key sequence."""
+        nonlocal m_used
+        key_pass = jax.random.fold_in(sk_base, pass_idx)
+
+        def sk_call(dX, dy, dw, do, b, k):
+            nonlocal m_used
+            if isinstance(dX, StructuredDesign):
+                raise ValueError(
+                    "structured chunks have no sketched form — use the "
+                    "exact engine (engine='auto'), or densify to a "
+                    "SparseDesign for engine='sketch'")
+            m_c = _sk_dim(int(dX.shape[0]), int(dX.shape[1]),
+                          config.sketch_dim)
+            m_used = max(m_used, m_c)
+            return _traced_call(
+                _glm_sketch_chunk_pass, tracer, "glm_pass:sketch",
+                dX, dy, dw, do, b, jax.random.fold_in(key_pass, k),
+                engine="sketch", family=fam, link=lnk, first=first,
+                m=m_c, method=config.sketch_method,
+                fam_param=fam.param_operand())
+
+        Gs, g, dev = global_pass(beta_in, first,
+                                 "init" if first else "irls", sk_call)
+        t_s = time.perf_counter()
+        pw = g.shape[0]
+        _, fac, pivot = _solve64(Gs, g, config.jitter)
+        chof, dinv = fac
+        if tracer is not None:
+            tracer.emit("solve", target="cholesky64", p=int(pw),
+                        seconds=time.perf_counter() - t_s,
+                        gramian_engine="sketch", sketch_dim=int(m_used),
+                        sketch_refine=sk_refine)
+
+        def prec(r):
+            return dinv * scipy.linalg.cho_solve(chof, dinv * r)
+
+        u = (np.zeros(pw) if beta_in is None
+             else np.asarray(beta_in, np.float64).copy())
+        r = g.copy()
+        zv = prec(r)
+        pvec = zv
+        rz = float(r @ zv)
+        for _ in range(sk_refine):
+            if rz <= 0:
+                break  # solved exactly (or left the SPD happy path)
+
+            def cg_call(dX, dy, dw, do, b, k, _v=pvec):
+                return _traced_call(
+                    _glm_cg_chunk_pass, tracer, "glm_pass:cg",
+                    dX, dy, dw, do, b, jnp.asarray(_v, dX.dtype),
+                    engine="sketch", family=fam, link=lnk, first=first,
+                    fam_param=fam.param_operand())
+
+            _, Ap, _ = global_pass(beta_in, first, "cg", cg_call)
+            denom = float(pvec @ Ap)
+            if denom <= 0:
+                break
+            alpha = rz / denom
+            u = u + alpha * pvec
+            r = r - alpha * Ap
+            zv = prec(r)
+            rz_new = float(r @ zv)
+            pvec = zv + (rz_new / rz) * pvec
+            rz = rz_new
+        return u, dev, fac, pivot
 
     it0 = 0
     if _ck_state is not None:
@@ -1677,6 +1893,11 @@ def _glm_fit_streaming_impl(
                 f"max_iter={max_iter}; raise max_iter to continue the fit")
         p = beta.shape[0]
         cho = pivot = None
+    elif sketch_run:
+        # the sketched init/warm-start update: pass index 0 either way
+        b_in = None if beta0 is None else np.asarray(beta0, np.float64)
+        beta, dev_prev, cho, pivot = sketch_update(b_in, beta0 is None, 0)
+        p = beta.shape[0]
     elif beta0 is not None:
         # warm start (resume from a checkpointed beta): the first pass is a
         # regular IRLS pass at beta0 instead of the family-init pass
@@ -1684,7 +1905,7 @@ def _glm_fit_streaming_impl(
     else:
         # init pass from family starting values (first=True ignores beta)
         XtWX, XtWz, dev_prev = global_pass(None, True)
-    if _ck_state is None:
+    if _ck_state is None and not sketch_run:
         p = XtWX.shape[0]
         t_s = time.perf_counter()
         beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
@@ -1703,7 +1924,13 @@ def _glm_fit_streaming_impl(
     # the first loop pass.
     tol_eff = effective_tol(tol, criterion, dtype) if dtype is not None else None
     for it in range(it0, max_iter):
-        XtWX, XtWz, dev = global_pass(beta, False)
+        if sketch_run:
+            # the sketched update solves before the deviance bookkeeping
+            # (its CG passes ARE the solve); dev is still measured at the
+            # incoming beta, so the lagged convergence is identical
+            beta_new, dev, cho, pivot = sketch_update(beta, False, it + 1)
+        else:
+            XtWX, XtWz, dev = global_pass(beta, False)
         if tol_eff is None:
             tol_eff = effective_tol(tol, criterion, dtype)
         ddev = abs(dev - dev_prev)
@@ -1717,13 +1944,16 @@ def _glm_fit_streaming_impl(
         # solve before the convergence break so beta and the SE ingredient
         # diag((X'WX)^-1) come from the same final pass, exactly like the
         # resident fused engine's loop body
-        t_s = time.perf_counter()
-        beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
-        if tracer is not None:
-            tracer.emit("solve", target="cholesky64", p=int(p),
-                        seconds=time.perf_counter() - t_s,
-                        gramian_engine=("structured" if saw_structured
-                                        else "einsum"))
+        if sketch_run:
+            beta = beta_new
+        else:
+            t_s = time.perf_counter()
+            beta, cho, pivot = _solve64(XtWX, XtWz, config.jitter)
+            if tracer is not None:
+                tracer.emit("solve", target="cholesky64", p=int(p),
+                            seconds=time.perf_counter() - t_s,
+                            gramian_engine=("structured" if saw_structured
+                                            else "einsum"))
         if ckpt is not None:
             # post-solve state: a resume restores dev_prev=dev and this
             # beta, making its next pass exactly the uninterrupted next one
@@ -1741,7 +1971,10 @@ def _glm_fit_streaming_impl(
         has_intercept = (
             any(nm.lower() in ("intercept", "(intercept)") for nm in xnames)
             or bool(ones_mask.any()))
-    diag_inv = _diag_inv64(cho)  # once, from the final factorization
+    # sketch fits return NaN std errors: diag(Gs^-1) is a biased estimate
+    # of diag((X'WX)^-1), mirroring the resident engine's NaN cov_inv
+    diag_inv = (np.full((p,), np.nan) if sketch_run
+                else _diag_inv64(cho))  # once, from the final factorization
     # the IRLS loop is the cache's only reader; release the pinned device
     # chunks NOW so the host-side stats passes and the recursive null-model
     # fit (which builds its own cache under the same budget) don't run with
@@ -1750,7 +1983,10 @@ def _glm_fit_streaming_impl(
     ccache.fingerprints.clear()
     ccache.bytes = 0
     ccache.open = False
-    if not _null_model and _sync_polish_decision(
+    # no CSNE for sketch fits: the chunked TSQR factors dense row blocks,
+    # and the sketched trajectory's conditioning probe is the sketched
+    # Gramian's — an approximation the polish policy was not written for
+    if not _null_model and not sketch_run and _sync_polish_decision(
             _resolve_streaming_polish(pivot, dtype, config,
                                       structured=saw_structured), nproc):
         # chunked TSQR + CSNE at the converged beta — the streaming
@@ -1885,5 +2121,8 @@ def _glm_fit_streaming_impl(
         converged=bool(converged), n_obs=n, n_params=p,
         dispersion_fixed=bool(fam.dispersion_fixed),
         n_shards=mesh.shape[meshlib.DATA_AXIS], tol=tol,
+        sketch_dim=int(m_used) if sketch_run else None,
+        sketch_refine=sk_refine if sketch_run else None,
         has_intercept=bool(has_intercept), has_offset=bool(saw_offset),
-        gramian_engine="structured" if saw_structured else "einsum")
+        gramian_engine=("sketch" if sketch_run
+                        else "structured" if saw_structured else "einsum"))

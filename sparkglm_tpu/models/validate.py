@@ -26,9 +26,16 @@ def check_finite_design(X) -> None:
     """Raise for a non-finite design matrix.  Callers run this lazily (on a
     failure path or a non-finite eta) so the happy path never pays a full
     scan of X.  For a structured design only the dense block can carry
-    non-finite values (level indices are integers by construction)."""
+    non-finite values (level indices are integers by construction); a
+    sparse design adds its ELL value slots."""
+    from ..data.sparse import SparseDesign
     from ..data.structured import StructuredDesign
-    if isinstance(X, StructuredDesign):
+    if isinstance(X, SparseDesign):
+        if not np.all(np.isfinite(np.asarray(X.vals))):
+            raise ValueError("NA/NaN/Inf in the design matrix — drop or "
+                             f"impute missing predictors{_HINT}")
+        X = np.asarray(X.dense)
+    elif isinstance(X, StructuredDesign):
         X = np.asarray(X.dense)
     if not np.all(np.isfinite(X)):
         raise ValueError("NA/NaN/Inf in the design matrix — drop or impute "

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..data.sparse import SparseDesign
 from ..data.structured import StructuredDesign
 from .gramian import weighted_gramian
 
@@ -209,29 +210,42 @@ def structured_fisher_pass(sd: StructuredDesign, y, wt, offset, beta, *,
 
 
 # -- engine dispatch (static at trace time: the pytree treedef keys the jit
-# cache, so a dense array and a StructuredDesign never share an executable)
+# cache, so a dense array, a StructuredDesign and a SparseDesign never
+# share an executable)
 
 def design_gramian(X, z, w, *, accum_dtype=jnp.float32, precision=None):
     """``weighted_gramian`` for dense ``X``; ``structured_gramian`` for a
-    :class:`StructuredDesign`."""
+    :class:`StructuredDesign`; ``sparse_gramian`` for a
+    :class:`~sparkglm_tpu.data.sparse.SparseDesign`."""
     if isinstance(X, StructuredDesign):
         return structured_gramian(X, z, w, accum_dtype=accum_dtype,
                                   precision=precision)
+    if isinstance(X, SparseDesign):
+        from .sketch import sparse_gramian
+        return sparse_gramian(X, z, w, accum_dtype=accum_dtype,
+                              precision=precision)
     return weighted_gramian(X, z, w, accum_dtype=accum_dtype,
                             precision=precision)
 
 
 def design_matvec(X, beta, *, precision=None):
-    """``X @ beta`` for either design representation."""
+    """``X @ beta`` for any design representation."""
     if isinstance(X, StructuredDesign):
         return structured_matvec(X, beta, precision=precision)
+    if isinstance(X, SparseDesign):
+        from .sketch import sparse_matvec
+        return sparse_matvec(X, beta, precision=precision)
     return jnp.matmul(X, beta, precision=precision)
 
 
 def design_colsum(X, r, *, accum_dtype=jnp.float32, precision=None):
-    """``X' r`` for either design representation."""
+    """``X' r`` for any design representation."""
     if isinstance(X, StructuredDesign):
         return structured_colsum(X, r, accum_dtype=accum_dtype,
                                  precision=precision)
+    if isinstance(X, SparseDesign):
+        from .sketch import sparse_colsum
+        return sparse_colsum(X, r, accum_dtype=accum_dtype,
+                             precision=precision)
     return jnp.einsum("np,n->p", X, r, preferred_element_type=accum_dtype,
                       precision=precision)

@@ -1,0 +1,280 @@
+"""Sketching kernels + sparse-design ops for the sketched-IRLS engine.
+
+Iterative Hessian Sketch ("Iterative Hessian Sketch in Input Sparsity
+Time", arXiv 1910.14166) replaces each IRLS step's exact weighted Gramian
+``A'A`` (A = sqrt(W)·X, O(n p^2) FLOPs) with the Gramian of a SKETCH
+``SA`` (m x p, m ~ 4p): O(nnz) to form under countsketch, O(m p^2) to
+square.  The sketched Hessian is a preconditioner, not an estimate: the
+solver (models/glm.py::_irls_sketch_kernel) factors ``Gs = (SA)'(SA)``
+once per IRLS iteration and runs preconditioned CG on the EXACT normal
+equations ``X'WX u = X'Wz`` — the gradient and matvecs stay exact (one
+O(nnz) pass each), only the metric is sketched, so the iterate converges
+to the exact IRLS step for ANY sketch quality.  Quality sets only the
+per-step contraction (~3-5x at m ~ 4p, measured) — which is what makes
+the engine's golden-fixture parity a guarantee instead of a tolerance
+gamble (PARITY.md r13).  (The raw IHS Richardson update ``beta +=
+Gs^{-1} X'W(z - X beta)`` is NOT used: it diverges whenever the sketch
+misestimates the Gramian by more than 2x in some direction, which both
+sketches readily do at m ~ 4p.)
+
+Two sketches:
+
+  * countsketch — each row lands in one of m buckets with a ±1 sign:
+    ``SA = segment_sum(s * a_i, h)``.  O(nnz) regardless of
+    representation; the sparse ELL block scatters straight into the
+    (m, p_sp) output.  The default, and the only sketch with an
+    input-sparsity form (the paper's point).
+  * SRHT — ``(1/sqrt(m)) * sample_rows(H D A)`` with H the
+    Walsh–Hadamard transform (:func:`fwht`, O(n p log n)) and D random
+    signs.  Dense designs only; rows are padded to the next power of two
+    with zero rows (inert — they carry weight 0 through sqrt(W)).
+
+Both are seeded through ``jax.random`` keys: same key -> bit-identical
+sketch (test-enforced), and the IRLS kernel re-seeds per iteration with
+``fold_in(it)`` so no iteration shares a sketch (a fixed S would bias
+the *trajectory* even though the fixed point is exact).  E[S'S] = I for
+both (test-enforced on the identity design).
+
+The sparse-design ops here (:func:`sparse_matvec`/``colsum``/``gramian``/
+``quadform``) are the exact-algebra twins of ops/factor_gramian.py's
+structured ops, built on the ELL trash-bucket convention
+(data/sparse.py): padding slots index ``p_sp`` with value 0, every
+segment sum allocates ``p_sp + 1`` and slices the trash, so short rows
+and weight-0 pad rows contribute exactly nothing.  ``sparse_gramian``
+materialises O(p_sp^2) — it is the exact-path oracle for moderate widths
+and the agreement-test reference; ``engine="sketch"`` exists to avoid it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.sparse import SparseDesign
+from .gramian import weighted_gramian
+
+__all__ = ["sparse_matvec", "sparse_colsum", "sparse_gramian",
+           "sparse_quadform", "countsketch", "srht", "fwht",
+           "sketch_design", "sketched_gramian", "sketch_dim"]
+
+
+def _inv_perm(layout) -> np.ndarray:
+    """xnames-order column -> block-order column (static host constant)."""
+    return np.argsort(np.asarray(layout.block_cols, np.int64))
+
+
+def _block_perm(layout) -> np.ndarray:
+    return np.asarray(layout.block_cols, np.int64)
+
+
+# -- exact sparse-design algebra (the ELL twins of the structured ops) ------
+
+
+def sparse_matvec(sp: SparseDesign, beta, *, precision=None):
+    """``X @ beta`` without densifying: dense matvec + per-slot gather
+    (``beta`` in xnames order; trash slots gather an appended zero AND
+    carry value 0 — double-guarded)."""
+    lay = sp.layout
+    bb = jnp.asarray(beta)[_block_perm(lay)]
+    eta = jnp.matmul(sp.dense, bb[:lay.n_dense], precision=precision)
+    if lay.n_sparse and lay.k:
+        bs = jnp.concatenate([bb[lay.n_dense:], jnp.zeros((1,), bb.dtype)])
+        eta = eta + jnp.sum(sp.vals * bs[sp.cols], axis=1)
+    return eta
+
+
+def sparse_colsum(sp: SparseDesign, r, *, accum_dtype=jnp.float32,
+                  precision=None):
+    """``X' r`` without densifying: dense einsum + one segment_sum over
+    the flattened ELL slots.  Output in xnames order.  This is the exact
+    ``X'W(z - X beta)`` ingredient of every CG step in the sketched
+    solver."""
+    lay = sp.layout
+    acc = accum_dtype
+    c_d = jnp.einsum("np,n->p", sp.dense, r, preferred_element_type=acc,
+                     precision=precision)
+    parts = [c_d.astype(acc)]
+    if lay.n_sparse and lay.k:
+        contrib = (sp.vals * r[:, None]).astype(acc)
+        parts.append(jax.ops.segment_sum(
+            contrib.ravel(), sp.cols.ravel(),
+            num_segments=lay.n_sparse + 1)[:lay.n_sparse])
+    return jnp.concatenate(parts)[_inv_perm(lay)]
+
+
+def sparse_gramian(sp: SparseDesign, z, w, *, accum_dtype=jnp.float32,
+                   precision=None):
+    """Exact ``(X'WX, X'Wz)`` of the design ``sp`` represents, assembled
+    blockwise (same signature/contract as ``gramian.weighted_gramian``;
+    outputs in xnames order).
+
+    The sparse x sparse block goes through one segment_sum over the
+    (p_sp+1)^2 joint index — O(p_sp^2) memory, which is exactly the cost
+    ``engine="sketch"`` exists to avoid; this op is the exact-path oracle
+    for moderate widths and the f64 agreement-test reference."""
+    lay = sp.layout
+    acc = accum_dtype
+    D, C, V = sp.dense, sp.cols, sp.vals
+    G_dd, b_d = weighted_gramian(D, z, w, accum_dtype=acc,
+                                 precision=precision)
+    G_dd = G_dd.astype(acc)
+    b_d = b_d.astype(acc)
+    S = lay.n_sparse
+    if S == 0 or lay.k == 0:
+        return G_dd, b_d
+    n, k = C.shape
+    # products at input precision, accumulated in acc (the einsum engine's
+    # product/accumulate split, ops/factor_gramian.py contract)
+    Vw = V * w[:, None]
+    b_s = jax.ops.segment_sum(
+        ((w * z)[:, None] * V).astype(acc).ravel(), C.ravel(),
+        num_segments=S + 1)[:S]
+    d = lay.n_dense
+    if d:
+        G_sd = jax.ops.segment_sum(
+            (Vw[:, :, None] * D[:, None, :]).astype(acc).reshape(n * k, d),
+            C.ravel(), num_segments=S + 1)[:S]
+    else:
+        G_sd = jnp.zeros((S, 0), acc)
+    joint = (C.astype(jnp.int64)[:, :, None] * (S + 1)
+             + C[:, None, :]).reshape(n * k * k)
+    prod = (Vw[:, :, None] * V[:, None, :]).astype(acc).reshape(n * k * k)
+    G_ss = jax.ops.segment_sum(
+        prod, joint, num_segments=(S + 1) * (S + 1)
+    ).reshape(S + 1, S + 1)[:S, :S]
+    G_blk = jnp.concatenate([
+        jnp.concatenate([G_dd, G_sd.T], axis=1),
+        jnp.concatenate([G_sd, G_ss], axis=1)], axis=0)
+    b_blk = jnp.concatenate([b_d, b_s])
+    inv = _inv_perm(lay)
+    return G_blk[inv][:, inv], b_blk[inv]
+
+
+def sparse_quadform(sp: SparseDesign, Vm, *, precision=None):
+    """Per-row quadratic forms ``q_i = x_i' V x_i`` without densifying
+    (the se_fit scoring ingredient; mirrors ``structured_quadform``)."""
+    lay = sp.layout
+    bc = _block_perm(lay)
+    Vb = jnp.asarray(Vm)[bc][:, bc]
+    d = lay.n_dense
+    M = jnp.matmul(sp.dense, Vb[:d, :], precision=precision)  # (n, p)
+    if lay.n_sparse and lay.k:
+        Vs = jnp.concatenate([Vb[d:, :],
+                              jnp.zeros((1, Vb.shape[1]), Vb.dtype)])
+        M = M + jnp.sum(sp.vals[:, :, None] * Vs[sp.cols], axis=1)
+    q = jnp.sum(M[:, :d] * sp.dense, axis=1)
+    if lay.n_sparse and lay.k:
+        Ms = jnp.concatenate([M[:, d:],
+                              jnp.zeros((M.shape[0], 1), M.dtype)], axis=1)
+        q = q + jnp.sum(sp.vals * jnp.take_along_axis(Ms, sp.cols, axis=1),
+                        axis=1)
+    return q
+
+
+# -- seeded sketches --------------------------------------------------------
+
+
+def countsketch(X, w, key, m: int, *, precision=None):
+    """``S (sqrt(W) X)`` for the seeded countsketch S (m x n): row i lands
+    in bucket ``h_i`` with sign ``s_i``.  Output (m, p) in xnames order
+    for a :class:`SparseDesign`, plain column order for an ndarray.
+
+    Same key -> bit-identical output (the hash/sign draws and the
+    scatter order are deterministic).  Weight-0 rows scale to zero before
+    scattering, so shard/bucket padding is inert regardless of where the
+    hash sends it.  E[S'S] = I: the diagonal is exactly 1 per row, the
+    off-diagonal is a mean-zero ±1 collision indicator.
+    """
+    kh, ks = jax.random.split(key)
+    n = X.shape[0]
+    h = jax.random.randint(kh, (n,), 0, m)
+    dt = X.dtype
+    s = jax.random.rademacher(ks, (n,), dt)
+    r = s * jnp.sqrt(jnp.maximum(w, 0.0)).astype(dt)
+    if not isinstance(X, SparseDesign):
+        return jax.ops.segment_sum(X * r[:, None], h, num_segments=m)
+    lay = X.layout
+    parts = []
+    if lay.n_dense:
+        parts.append(jax.ops.segment_sum(X.dense * r[:, None], h,
+                                         num_segments=m))
+    else:
+        parts.append(jnp.zeros((m, 0), dt))
+    if lay.n_sparse:
+        SA_s = jnp.zeros((m, lay.n_sparse + 1), dt)
+        SA_s = SA_s.at[h[:, None], X.cols].add(X.vals * r[:, None])
+        parts.append(SA_s[:, :lay.n_sparse])
+    SA = jnp.concatenate(parts, axis=1)
+    return SA[:, _inv_perm(lay)]
+
+
+def fwht(x):
+    """Walsh–Hadamard transform along axis 0 (unnormalized: H H' = n I).
+    Length must be a (static) power of two; log2(n) reshape/add rounds,
+    each one O(n) elementwise — no materialised H."""
+    n = x.shape[0]
+    if n & (n - 1):
+        raise ValueError(f"fwht length must be a power of two, got {n}")
+    rest = x.shape[1:]
+    h = 1
+    while h < n:
+        x = x.reshape((n // (2 * h), 2, h) + rest)
+        x = jnp.concatenate([x[:, 0] + x[:, 1], x[:, 0] - x[:, 1]], axis=1)
+        h *= 2
+    return x.reshape((n,) + rest)
+
+
+def srht(X, w, key, m: int):
+    """Subsampled randomized Hadamard transform of ``sqrt(W) X``:
+    ``(1/sqrt(m)) * (H D A)[rows]`` with D random signs, H the raw
+    Walsh–Hadamard transform and ``rows`` m iid uniform draws — the scale
+    makes E[S'S] = I exactly.  Dense ndarrays only (the transform mixes
+    every row, so there is no input-sparsity form); n is zero-padded to
+    the next power of two (padding is inert: zero rows stay zero under
+    D and contribute nothing to H's sums)."""
+    if isinstance(X, SparseDesign):
+        raise TypeError(
+            "SRHT has no input-sparsity form; use method='countsketch' "
+            "for SparseDesign")
+    n = X.shape[0]
+    n2 = 1 << max(int(n) - 1, 0).bit_length()
+    kd, kp = jax.random.split(key)
+    d = jax.random.rademacher(kd, (n2,), X.dtype)
+    A = X * jnp.sqrt(jnp.maximum(w, 0.0)).astype(X.dtype)[:, None]
+    A = jnp.pad(A, [(0, n2 - n), (0, 0)]) * d[:, None]
+    Y = fwht(A)
+    idx = jax.random.randint(kp, (m,), 0, n2)
+    return Y[idx] * jnp.asarray(1.0 / np.sqrt(m), X.dtype)
+
+
+def sketch_design(X, w, key, m: int, *, method: str = "countsketch",
+                  precision=None):
+    """Sketch ``sqrt(W) X`` down to m rows with the seeded sketch
+    ``method`` ("countsketch" | "srht")."""
+    if method == "countsketch":
+        return countsketch(X, w, key, m, precision=precision)
+    if method == "srht":
+        return srht(X, w, key, m)
+    raise ValueError(
+        f"sketch method must be 'countsketch' or 'srht', got {method!r}")
+
+
+def sketched_gramian(X, w, key, m: int, *, method: str = "countsketch",
+                     accum_dtype=jnp.float32, precision=None):
+    """``Gs = (SA)'(SA)`` — the sketched Hessian the solver factors as
+    its CG preconditioner."""
+    SA = sketch_design(X, w, key, m, method=method, precision=precision)
+    return jnp.einsum("mp,mq->pq", SA, SA,
+                      preferred_element_type=accum_dtype,
+                      precision=precision)
+
+
+def sketch_dim(n: int, p: int, requested=None) -> int:
+    """Resolve the (static) sketch dimension m: the requested value, else
+    ``max(4p, 64)``, capped at n (beyond n the sketch costs more than the
+    exact Gramian).  m only sets the preconditioner quality — the CG
+    contraction per refinement step — never correctness (see module
+    docstring), so the auto rule favors cheapness."""
+    m = int(requested) if requested else max(4 * int(p), 64)
+    return max(1, min(m, max(int(n), 1)))

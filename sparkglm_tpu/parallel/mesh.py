@@ -104,8 +104,11 @@ def shard_rows(
     block zero-pads like any matrix and each index vector pads with the
     factor's TRASH bucket (L — sliced off every segment sum), so pad rows
     touch no real level even before their zero weight makes every
-    contribution exactly zero (ops/factor_gramian.py).
+    contribution exactly zero (ops/factor_gramian.py).  A ``SparseDesign``
+    (data/sparse.py) does the same with its ELL slots: pad rows carry the
+    sparse trash column (n_sparse) with value 0.
     """
+    from ..data.sparse import SparseDesign
     from ..data.structured import StructuredDesign
     if isinstance(x, StructuredDesign):
         if shard_features:
@@ -116,6 +119,16 @@ def shard_rows(
             shard_rows(x.dense, mesh, pad_value=pad_value),
             tuple(shard_rows(ix, mesh, pad_value=L)
                   for (_, L), ix in zip(x.layout.factors, x.idx)),
+            x.layout)
+    if isinstance(x, SparseDesign):
+        if shard_features:
+            raise ValueError(
+                "sparse designs cannot be feature-sharded — densify "
+                "first or use shard_features=False")
+        return SparseDesign(
+            shard_rows(x.dense, mesh, pad_value=pad_value),
+            shard_rows(x.cols, mesh, pad_value=x.layout.n_sparse),
+            shard_rows(x.vals, mesh, pad_value=0.0),
             x.layout)
     x = np.asarray(x)
     n = x.shape[0]

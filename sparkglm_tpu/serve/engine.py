@@ -39,6 +39,7 @@ import numpy as np
 from ..data.frame import as_columns
 from ..data.model_matrix import (structured_layout, transform,
                                  transform_structured, wants_structured)
+from ..data.sparse import SparseDesign, SparseLayout
 from ..data.structured import StructuredDesign
 from ..models.scoring import (donation_supported, predict_sharded,
                               score_kernel_cache_size)
@@ -104,7 +105,7 @@ class Scorer:
     # -- design construction (the sg.predict contract) ----------------------
 
     def _design(self, data, offset):
-        if isinstance(data, StructuredDesign) or (
+        if isinstance(data, (StructuredDesign, SparseDesign)) or (
                 isinstance(data, np.ndarray) and data.ndim == 2):
             X = data
             if X.shape[1] != self.model.n_params:
@@ -172,7 +173,8 @@ class Scorer:
             self.metrics.histogram(f"serve.{self.name}.score_s").observe(dt)
         return out
 
-    def warmup(self, buckets=None) -> tuple[int, ...]:
+    def warmup(self, buckets=None, *,
+               sparse_layout: SparseLayout | None = None) -> tuple[int, ...]:
         """Pre-compile the bucket executables so no real request pays XLA
         compile latency.  ``buckets=None`` compiles the power-of-2 ladder
         from ``min_bucket`` through 1024; pass the bucket sizes you expect
@@ -182,6 +184,14 @@ class Scorer:
         The warmed executable matches the live one exactly: same static
         flags (se_fit, response, offset-present) — a model fit with a
         by-name offset warms its offset-carrying variant.
+
+        ``sparse_layout``: warm ``SparseDesign`` executables instead, for a
+        model that will be scored with sparse requests (jit caches key on
+        the layout, so the SAME ``SparseLayout`` the live requests carry
+        must be passed — a model fit from a sparse design has no ``terms``
+        to derive it from).  The warm rows are all-trash ELL rows (every
+        slot column = n_sparse, value 0), inert by the double-guard
+        convention.
         """
         if buckets is None:
             buckets, b = [], self.min_bucket
@@ -191,6 +201,10 @@ class Scorer:
         p = self.model.n_params
         has_off = (getattr(self.model, "offset_col", None) is not None
                    or getattr(self.model, "has_offset", False))
+        if sparse_layout is not None and sparse_layout.p != p:
+            raise ValueError(
+                f"sparse_layout has p={sparse_layout.p} columns; model "
+                f"expects {p}")
         # warm the representation live requests will use: structured when
         # the terms want it (the se quadform runs structured too, via
         # ops/factor_gramian.structured_quadform)
@@ -199,7 +213,13 @@ class Scorer:
                    and wants_structured(self.model.terms)) else None)
         done = []
         for b in sorted(set(int(x) for x in buckets)):
-            if lay is not None:
+            if sparse_layout is not None:
+                sl = sparse_layout
+                X = SparseDesign(
+                    np.zeros((1, sl.n_dense)),
+                    np.full((1, sl.k), sl.n_sparse, np.int32),
+                    np.zeros((1, sl.k)), sl)
+            elif lay is not None:
                 X = StructuredDesign(
                     np.zeros((1, lay.n_dense)),
                     tuple(np.full((1,), L, np.int32)

@@ -47,6 +47,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "penalized: the elastic-net path subsystem "
         "(`make penalized` selects these; still tier-1 by default)")
+    config.addinivalue_line(
+        "markers", "sketch: the sketched-IRLS engine + sparse designs "
+        "(`make sketch` selects these; still tier-1 by default)")
 
 
 @pytest.fixture(scope="session")

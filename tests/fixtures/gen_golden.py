@@ -682,6 +682,7 @@ def main():
 
     cases["formula_cases"] = fcases
     cases["penalized_cases"] = penalized_cases()
+    cases["sparse_cases"] = sparse_cases()
 
     out = os.path.join(HERE, "r_golden.json")
     with open(out, "w") as f:
@@ -771,6 +772,68 @@ def penalized_cases():
     return pcases
 
 
+def sparse_cases():
+    """Wide-sparse golden fixture for the sketched-IRLS engine (PARITY r13).
+    A fresh seeded stream like :func:`penalized_cases`, spliceable
+    standalone (``python gen_golden.py --splice-sparse``).
+
+    The design is the ultra-wide shape the sketch engine targets, scaled
+    to fixture size: a 2-column dense block ([1, x]) plus an 80-column
+    sparse block with ~5 nonzeros per row (hashed-feature shape), stored
+    as COO triplets so the test rebuilds the exact SparseDesign.  The
+    oracle densifies and runs the independent f64 IRLS — the sketch
+    engine's coefficients must land within the PARITY-documented 1e-4
+    maxdiff of it, and the exact sparse (einsum) engine within solver
+    precision."""
+    prng = np.random.default_rng(20260806)
+    n, n_sp = 1200, 80
+    x = prng.standard_normal(n)
+    # every sparse column appears in a deterministic anchor row (full
+    # column rank, so the sketch engine's singular="error" contract holds)
+    rows, cols = [np.arange(n_sp)], [np.arange(n_sp)]
+    nnz = prng.integers(3, 7, n)
+    for i in range(n):
+        c = prng.choice(n_sp, size=int(nnz[i]), replace=False)
+        rows.append(np.full(c.shape, i))
+        cols.append(c)
+    row = np.concatenate(rows)
+    col = np.concatenate(cols)
+    val = prng.uniform(0.5, 1.5, row.shape[0])
+    eff = prng.standard_normal(n_sp) * 0.15
+    Xd = np.column_stack([np.ones(n), x])
+    Xs = np.zeros((n, n_sp))
+    np.add.at(Xs, (row, col), val)  # duplicates accumulate (COO contract)
+    X = np.column_stack([Xd, Xs])
+    mu = np.exp(0.4 + 0.25 * x + Xs @ eff)
+    y = prng.poisson(np.clip(mu, 0, 80)).astype(float)
+    return {
+        "wide_sparse_poisson": dict(
+            data=dict(y=y.tolist(), x=x.tolist(),
+                      coo_row=row.tolist(), coo_col=col.tolist(),
+                      coo_val=val.tolist()),
+            n=n, n_sparse=n_sp, family="poisson", link="log",
+            xnames=["intercept", "x"] + [f"s{j:02d}" for j in range(n_sp)],
+            fit=r_fit(X, y, "poisson", "log"),
+            provenance="synthetic; oracle64-verified (not run through R); "
+                       "dense [1, x] + 80-col ~5nnz/row sparse block, COO-"
+                       "stored; the sketch-engine parity fixture (PARITY "
+                       "r13); R cross-check: glm(y ~ x + S, poisson) with "
+                       "S the densified sparse block")}
+
+
+def splice_sparse():
+    """Rewrite ONLY the sparse_cases key of the committed r_golden.json
+    (same byte-stability rationale as :func:`splice_penalized`)."""
+    out = os.path.join(HERE, "r_golden.json")
+    with open(out) as f:
+        cases = json.load(f)
+    cases["sparse_cases"] = sparse_cases()
+    with open(out, "w") as f:
+        json.dump(cases, f, indent=1)
+    print(f"spliced sparse_cases "
+          f"({len(cases['sparse_cases'])} cases) into {out}")
+
+
 def splice_penalized():
     """Rewrite ONLY the penalized_cases key of the committed r_golden.json,
     leaving every other case's bytes untouched (json round-trips Python
@@ -788,5 +851,7 @@ def splice_penalized():
 if __name__ == "__main__":
     if "--splice-penalized" in sys.argv:
         splice_penalized()
+    elif "--splice-sparse" in sys.argv:
+        splice_sparse()
     else:
         main()

@@ -1,0 +1,404 @@
+"""Sketched-IRLS engine + SparseDesign (ISSUE 9; PARITY.md r13).
+
+Four assertion tiers:
+  * sketch ops — seeded determinism (same key -> bit-identical sketch),
+    E[S'S] = I unbiasedness, and CSR/COO <-> dense agreement at f64;
+  * golden parity — ``engine="sketch"`` coefficients against the
+    independent f64 oracle (r_golden.json), on existing flat cases and the
+    wide sparse fixture, within the PARITY-documented 1e-4 maxdiff (the
+    sketch-and-precondition solver lands far inside it: the sketched
+    Gramian is only a CG preconditioner, the normal equations stay exact);
+  * engine-combination guards — sketch x {penalty, elastic/workers,
+    se/vcov, singular="drop", structured designs, exact streaming} all
+    refuse with pointed errors;
+  * integration — streaming chunk buckets + prefetch pipelining, the
+    serve Scorer's sparse warmup/score path, fit_report/trace stamping,
+    serialization round-trip, one executable per pass flavor.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.config import DEFAULT
+from sparkglm_tpu.data import sparse as sparse_mod
+from sparkglm_tpu.models import glm as glm_mod
+from sparkglm_tpu.models import streaming
+from sparkglm_tpu.obs import FitTracer, RingBufferSink
+from sparkglm_tpu.ops import sketch as sk
+
+pytestmark = pytest.mark.sketch
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "r_golden.json")
+with open(FIXTURES) as f:
+    GOLDEN = json.load(f)
+SPARSE_CASE = GOLDEN["sparse_cases"]["wide_sparse_poisson"]
+
+
+def _sparse_case_design():
+    """Rebuild the wide-sparse fixture's exact SparseDesign + response."""
+    d = SPARSE_CASE["data"]
+    x = np.asarray(d["x"], float)
+    spd = sparse_mod.from_coo(
+        d["coo_row"], d["coo_col"], d["coo_val"],
+        SPARSE_CASE["n"], SPARSE_CASE["n_sparse"],
+        dense=np.column_stack([np.ones(len(x)), x]), intercept=True)
+    return spd, np.asarray(d["y"], float)
+
+
+def _rand_sparse(rng, n=400, n_sp=30, d=2, nnz=4):
+    """Seeded random SparseDesign with a dense [1, x] block."""
+    rows, cols = [np.arange(n_sp) % n], [np.arange(n_sp)]
+    for i in range(n):
+        c = rng.choice(n_sp, size=nnz, replace=False)
+        rows.append(np.full(nnz, i))
+        cols.append(c)
+    row = np.concatenate(rows)
+    col = np.concatenate(cols)
+    val = rng.uniform(0.5, 1.5, row.shape[0])
+    dense = np.column_stack([np.ones(n), rng.standard_normal((n, d - 1))])
+    return sparse_mod.from_coo(row, col, val, n, n_sp, dense=dense,
+                               intercept=True)
+
+
+# ---------------------------------------------------------------------------
+# sketch ops: seeded determinism + unbiasedness + dense agreement
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("method", ["countsketch", "srht"])
+def test_sketch_seeded_determinism(method, rng):
+    X = rng.standard_normal((300, 7))
+    w = rng.uniform(0.1, 2.0, 300)
+    m = 32
+    a = np.asarray(sk.sketch_design(X, w, jax.random.PRNGKey(7), m,
+                                    method=method))
+    b = np.asarray(sk.sketch_design(X, w, jax.random.PRNGKey(7), m,
+                                    method=method))
+    c = np.asarray(sk.sketch_design(X, w, jax.random.PRNGKey(8), m,
+                                    method=method))
+    assert np.array_equal(a, b)  # same seed -> bit-identical
+    assert not np.array_equal(a, c)
+    assert a.shape == (m, 7)
+
+
+def test_countsketch_sparse_matches_dense_and_is_seeded(rng):
+    spd = _rand_sparse(rng)
+    Xd = spd.densify(np.float64)
+    w = rng.uniform(0.1, 2.0, Xd.shape[0])
+    key = jax.random.PRNGKey(3)
+    a = np.asarray(sk.countsketch(spd.astype(np.float64), w, key, 64))
+    b = np.asarray(sk.countsketch(spd.astype(np.float64), w, key, 64))
+    dense = np.asarray(sk.countsketch(Xd, w, key, 64))
+    assert np.array_equal(a, b)
+    # the sparse ELL scatter and the dense segment_sum draw the same
+    # hashes/signs from the key, so they sketch to the same matrix
+    np.testing.assert_allclose(a, dense, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("method", ["countsketch", "srht"])
+def test_sketch_unbiased_expected_gramian(method):
+    """E[(SA)'(SA)] = A'A — averaged over seeds on a fixed design."""
+    rng = np.random.default_rng(11)
+    X = rng.standard_normal((48, 5))
+    w = np.ones(48)
+    G = X.T @ X
+    acc = np.zeros_like(G)
+    reps = 400
+    for s in range(reps):
+        acc += np.asarray(sk.sketched_gramian(
+            X, w, jax.random.PRNGKey(s), 24, method=method,
+            accum_dtype=np.float64))
+    err = np.abs(acc / reps - G).max() / np.abs(G).max()
+    assert err < 0.05  # mean-zero fluctuation shrinks as 1/sqrt(reps)
+
+
+def test_sparse_ops_agree_with_dense_f64(rng):
+    spd = _rand_sparse(rng).astype(np.float64)
+    Xd = spd.densify(np.float64)
+    n, p = Xd.shape
+    beta = rng.standard_normal(p)
+    r = rng.standard_normal(n)
+    w = rng.uniform(0.1, 2.0, n)
+    z = rng.standard_normal(n)
+    V = rng.standard_normal((p, p))
+    V = V @ V.T
+    np.testing.assert_allclose(
+        np.asarray(sk.sparse_matvec(spd, beta)), Xd @ beta,
+        rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(sk.sparse_colsum(spd, r, accum_dtype=np.float64)),
+        Xd.T @ r, rtol=1e-12, atol=1e-10)
+    G, b = sk.sparse_gramian(spd, z, w, accum_dtype=np.float64)
+    np.testing.assert_allclose(np.asarray(G), Xd.T @ (w[:, None] * Xd),
+                               rtol=1e-12, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(b), Xd.T @ (w * z),
+                               rtol=1e-12, atol=1e-10)
+    np.testing.assert_allclose(
+        np.asarray(sk.sparse_quadform(spd, V)),
+        np.sum((Xd @ V) * Xd, axis=1), rtol=1e-11, atol=1e-9)
+
+
+def test_from_csr_from_coo_agree(rng):
+    from scipy import sparse as sp_sparse
+    M = sp_sparse.random(60, 15, density=0.15, random_state=4,
+                         format="csr")
+    a = sparse_mod.from_csr(M.indptr, M.indices, M.data, 15)
+    coo = M.tocoo()
+    b = sparse_mod.from_coo(coo.row, coo.col, coo.data, 60, 15)
+    np.testing.assert_array_equal(a.densify(np.float64),
+                                  b.densify(np.float64))
+    np.testing.assert_array_equal(a.densify(np.float64), M.toarray())
+
+
+# ---------------------------------------------------------------------------
+# golden parity (PARITY.md r13)
+# ---------------------------------------------------------------------------
+
+def _flat_design(case):
+    d = case["data"]
+    kw = dict(family=case["family"], link=case["link"], tol=1e-12,
+              criterion="relative", max_iter=200)
+    x1 = np.asarray(d.get("x1", d.get("x")), float)
+    X = np.column_stack([np.ones(len(x1)), x1])
+    y = np.asarray(d["y"], float)
+    if "w" in d:
+        kw["weights"] = np.asarray(d["w"], float)
+    if "exposure" in d:
+        kw["offset"] = np.log(np.asarray(d["exposure"], float))
+    return X, y, kw
+
+
+@pytest.mark.parametrize("name", ["gaussian_weighted", "bernoulli_cloglog",
+                                  "poisson_offset"])
+def test_sketch_matches_golden_flat_cases(name):
+    case = GOLDEN[name]
+    X, y, kw = _flat_design(case)
+    model = glm_mod.fit(X, y, engine="sketch", **kw)
+    gold = np.asarray(case["fit"]["coefficients"])
+    assert np.abs(model.coefficients - gold).max() <= 1e-4
+    assert model.deviance == pytest.approx(case["fit"]["deviance"],
+                                           rel=1e-6)
+    assert model.gramian_engine == "sketch"
+    assert np.isnan(model.std_errors).all()  # no exact covariance
+
+
+def test_sketch_matches_golden_wide_sparse():
+    spd, y = _sparse_case_design()
+    gold = np.asarray(SPARSE_CASE["fit"]["coefficients"])
+    kw = dict(family="poisson", link="log", tol=1e-12,
+              criterion="relative", max_iter=200)
+    exact = glm_mod.fit(spd, y, engine="einsum", singular="error", **kw)
+    assert np.abs(exact.coefficients - gold).max() <= 1e-6
+    assert exact.gramian_engine == "sparse"
+    sketched = glm_mod.fit(spd, y, engine="sketch", **kw)
+    # the PARITY r13 contract: <= 1e-4 coef maxdiff at f64 with refinement
+    assert np.abs(sketched.coefficients - gold).max() <= 1e-4
+    assert sketched.deviance == pytest.approx(
+        SPARSE_CASE["fit"]["deviance"], rel=1e-6)
+    rep = sketched.fit_report()
+    assert rep["gramian_engine"] == "sketch"
+    assert rep["sketch_dim"] >= 1
+    assert rep["sketch_refine"] == DEFAULT.sketch_refine
+
+
+def test_sketch_srht_and_seed_determinism():
+    spd, y = _sparse_case_design()
+    Xd = spd.densify(np.float64)
+    kw = dict(family="poisson", link="log", tol=1e-12,
+              criterion="relative", max_iter=200)
+    gold = np.asarray(SPARSE_CASE["fit"]["coefficients"])
+    cfg = dataclasses.replace(DEFAULT, sketch_method="srht")
+    m_srht = glm_mod.fit(Xd, y, engine="sketch", config=cfg, **kw)
+    assert np.abs(m_srht.coefficients - gold).max() <= 1e-4
+    # same seed -> bit-identical refit; different seed still converges to
+    # the same solution (the sketch is only a preconditioner)
+    a = glm_mod.fit(spd, y, engine="sketch", **kw)
+    b = glm_mod.fit(spd, y, engine="sketch", **kw)
+    assert np.array_equal(a.coefficients, b.coefficients)
+    c = glm_mod.fit(spd, y, engine="sketch", **kw,
+                    config=dataclasses.replace(DEFAULT, sketch_seed=123))
+    assert np.abs(c.coefficients - gold).max() <= 1e-4
+
+
+# ---------------------------------------------------------------------------
+# streaming: sparse chunk buckets, prefetch pipelining, engine plumbing
+# ---------------------------------------------------------------------------
+
+def _sparse_chunk_source(spd, y, n_chunks=4):
+    n = spd.shape[0]
+
+    def source():
+        for i in range(n_chunks):
+            lo, hi = n * i // n_chunks, n * (i + 1) // n_chunks
+            yield lambda lo=lo, hi=hi: (spd[lo:hi], y[lo:hi], None, None)
+
+    return source
+
+
+def test_streaming_sketch_parity_and_prefetch():
+    spd, y = _sparse_case_design()
+    gold = np.asarray(SPARSE_CASE["fit"]["coefficients"])
+    kw = dict(family="poisson", tol=1e-12, criterion="relative",
+              max_iter=200, cache="none")
+    m0 = streaming.glm_fit_streaming(_sparse_chunk_source(spd, y),
+                                     engine="sketch", **kw)
+    assert np.abs(m0.coefficients - gold).max() <= 1e-4
+    assert m0.gramian_engine == "sketch"
+    assert m0.sketch_dim >= 1 and m0.sketch_refine == DEFAULT.sketch_refine
+    assert np.isnan(m0.std_errors).all()
+    # prefetch=2 pipelines the same passes bit-identically
+    m2 = streaming.glm_fit_streaming(_sparse_chunk_source(spd, y),
+                                     engine="sketch", prefetch=2, **kw)
+    assert np.array_equal(m0.coefficients, m2.coefficients)
+    assert float(m0.deviance) == float(m2.deviance)
+    # refit determinism: the per-(pass, chunk) fold_in key schedule is
+    # part of the fit contract
+    m1 = streaming.glm_fit_streaming(_sparse_chunk_source(spd, y),
+                                     engine="sketch", **kw)
+    assert np.array_equal(m0.coefficients, m1.coefficients)
+
+
+def test_streaming_sketch_dense_chunks_match_exact():
+    """Dense chunks run the sketched solver too — same exact-IRLS fixed
+    point as the exact streaming engine."""
+    spd, y = _sparse_case_design()
+    Xd = spd.densify(np.float64)
+    kw = dict(family="poisson", tol=1e-12, criterion="relative",
+              max_iter=200, cache="none")
+    exact = streaming.glm_fit_streaming((Xd, y), **kw)
+    sketched = streaming.glm_fit_streaming((Xd, y), engine="sketch", **kw)
+    assert np.abs(sketched.coefficients - exact.coefficients).max() <= 1e-4
+
+
+def test_streaming_sparse_chunks_require_sketch():
+    spd, y = _sparse_case_design()
+    with pytest.raises(ValueError, match="engine='sketch'"):
+        streaming.glm_fit_streaming(_sparse_chunk_source(spd, y),
+                                    family="poisson", cache="none")
+
+
+# ---------------------------------------------------------------------------
+# engine-combination guards (pointed errors, api.py)
+# ---------------------------------------------------------------------------
+
+def test_guard_penalty_rejects_sketch(rng):
+    data = {"y": rng.standard_normal(50), "x": rng.standard_normal(50)}
+    with pytest.raises(ValueError, match="engine='sketch'"):
+        sg.glm("y ~ x", data, family="gaussian", link="identity",
+               engine="sketch", penalty=sg.ElasticNet(lambdas=[0.1]))
+
+
+def test_guard_elastic_workers_reject_sketch(tmp_path, rng):
+    p = tmp_path / "d.csv"
+    y = rng.standard_normal(80)
+    x = rng.standard_normal(80)
+    with open(p, "w") as fh:
+        fh.write("y,x\n")
+        for a, b in zip(y, x):
+            fh.write(f"{a},{b}\n")
+    with pytest.raises(ValueError, match="workers="):
+        sg.glm_from_csv("y ~ x", str(p), family="gaussian",
+                        link="identity", engine="sketch", workers=2)
+    with pytest.raises(ValueError, match="sketch"):
+        sg.lm_from_csv("y ~ x", str(p), engine="sketch")
+
+
+def test_guard_se_vcov_rejects_sketch():
+    spd, y = _sparse_case_design()
+    model = glm_mod.fit(spd, y, family="poisson", engine="sketch",
+                        tol=1e-10)
+    with pytest.raises(ValueError, match="engine='sketch'"):
+        model.vcov()
+    with pytest.raises(ValueError, match="engine='sketch'"):
+        model.predict(spd[:8], se_fit=True)
+    with pytest.raises(ValueError, match="engine='sketch'"):
+        sg.serve.Scorer(model, se_fit=True)
+
+
+def test_guard_singular_drop_and_structured_reject_sketch(rng):
+    spd, y = _sparse_case_design()
+    with pytest.raises(ValueError, match="singular='error'"):
+        glm_mod.fit(spd, y, family="poisson", engine="sketch",
+                    singular="drop")
+    n = 300
+    data = {"y": rng.standard_normal(n), "x": rng.standard_normal(n),
+            "g": rng.integers(0, 8, n).astype(str)}
+    with pytest.raises(ValueError, match="no structured form"):
+        sg.glm("y ~ x + g", data, family="gaussian", link="identity",
+               design="structured", engine="sketch", singular="error")
+    with pytest.raises(ValueError, match="countsketch"):
+        glm_mod.fit(spd, y, family="poisson", engine="sketch",
+                    config=dataclasses.replace(DEFAULT,
+                                               sketch_method="srht"))
+
+
+def test_sketch_never_auto_selected():
+    """engine='auto' must keep resolving to the exact path, even on a
+    SparseDesign (opt-in contract, PARITY.md r13)."""
+    spd, y = _sparse_case_design()
+    model = glm_mod.fit(spd, y, family="poisson", engine="auto",
+                        singular="error", tol=1e-10)
+    assert model.gramian_engine == "sparse"  # exact ELL segment sums
+    assert np.isfinite(model.std_errors).all()
+
+
+# ---------------------------------------------------------------------------
+# integration: executables, serving, reporting, persistence
+# ---------------------------------------------------------------------------
+
+def test_one_executable_per_pass_flavor():
+    spd, y = _sparse_case_design()
+    kw = dict(family="poisson", engine="sketch", tol=1e-10)
+    glm_mod.fit(spd, y, **kw)
+    before = glm_mod._irls_sketch_kernel._cache_size()
+    glm_mod.fit(spd, y, **kw)  # identical flavor: zero new executables
+    assert glm_mod._irls_sketch_kernel._cache_size() == before
+
+
+def test_serve_scorer_sparse_warmup_and_score():
+    spd, y = _sparse_case_design()
+    model = glm_mod.fit(spd, y, family="poisson", engine="sketch",
+                        tol=1e-10)
+    scorer = sg.serve.Scorer(model, type="response")
+    with pytest.raises(ValueError, match="columns"):
+        scorer.warmup([8], sparse_layout=dataclasses.replace(
+            spd.layout, p=spd.layout.p + 1, n_dense=spd.layout.n_dense + 1))
+    assert scorer.warmup([8, 16], sparse_layout=spd.layout) == (8, 16)
+    assert scorer.compiles == 0  # warmup resets the steady-state counter
+    req = spd[:5]
+    out = scorer.score(req)
+    np.testing.assert_allclose(out, model.predict(req), rtol=0, atol=0)
+    assert scorer.compiles == 0  # bucket 8 was warmed: no live compile
+    assert scorer.bucket_for(5) == 8
+
+
+def test_fit_report_trace_and_serialize(tmp_path):
+    spd, y = _sparse_case_design()
+    ring = RingBufferSink()
+    model = glm_mod.fit(spd, y, family="poisson", engine="sketch",
+                        tol=1e-10, trace=FitTracer(sinks=[ring]))
+    rep = model.fit_report()
+    assert rep["gramian_engine"] == "sketch"
+    assert rep["sketch_dim"] == model.sketch_dim
+    assert rep["sketch_refine"] == DEFAULT.sketch_refine
+    stamped = [e for e in ring.events if e.kind in ("compile", "solve")]
+    assert stamped, "sketch fit emitted no compile/solve events"
+    for e in stamped:
+        assert e.fields["gramian_engine"] == "sketch"
+        assert e.fields["sketch_dim"] == model.sketch_dim
+        assert e.fields["sketch_refine"] == DEFAULT.sketch_refine
+    path = os.path.join(tmp_path, "m.npz")
+    sg.save_model(model, path)
+    loaded = sg.load_model(path)
+    assert loaded.gramian_engine == "sketch"
+    assert loaded.sketch_dim == model.sketch_dim
+    assert loaded.sketch_refine == model.sketch_refine
+    np.testing.assert_array_equal(loaded.coefficients, model.coefficients)
+    with pytest.raises(ValueError, match="engine='sketch'"):
+        loaded.vcov()
