@@ -8,7 +8,7 @@ CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve categorical \
-        penalized elastic sketch clean
+        penalized elastic sketch fleet clean
 
 all: native
 
@@ -73,6 +73,14 @@ elastic:
 # vs exact-dense s/iter + coef maxdiff at the ultra-wide sparse shape)
 sketch:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m sketch
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# fleet fitting + model-family serving (sparkglm_tpu/fleet, serve): fleet-
+# vs-solo bit-identity, the one-executable/warm-refit contracts, grouped
+# ingestion, family deploy/rollback + batched (tenant, x) scoring — plus
+# the fleet_fit bench block (fleet vs K sequential solo fits s/model)
+fleet:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fleet
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:

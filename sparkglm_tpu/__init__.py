@@ -14,9 +14,10 @@ Quick start::
     mu = sg.predict(model, new_data)
 """
 
-from .api import (TermsPrediction, confint_profile, glm,
+from .api import (TermsPrediction, confint_profile, glm, glm_fleet,
                   glm_from_csv, glm_from_json, glm_from_parquet, glm_nb, lm,
                   lm_from_csv, lm_from_json, lm_from_parquet, predict, update)
+from .fleet import FleetModel, fit_many, glm_fit_fleet
 from .data.json import read_json, scan_json_levels, scan_json_schema
 from .data.parquet import (read_parquet, scan_parquet_levels,
                            scan_parquet_schema)
@@ -51,9 +52,10 @@ from .parallel import distributed
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
 from .penalized import ElasticNet, PathModel
 from .obs import FitTracer, JsonlSink, MetricsRegistry, RingBufferSink
-from .serve import BatchPolicy, MicroBatcher, ModelRegistry, Scorer
+from .serve import (BatchPolicy, FamilyScorer, MicroBatcher, ModelFamily,
+                    ModelRegistry, Scorer)
 from .utils import profiling
-from . import elastic, obs, robust, serve
+from . import elastic, fleet, obs, robust, serve
 
 __version__ = "0.1.0"
 
@@ -86,4 +88,6 @@ __all__ = [
     "robust",
     "obs", "FitTracer", "MetricsRegistry", "JsonlSink", "RingBufferSink",
     "serve", "ModelRegistry", "Scorer", "MicroBatcher", "BatchPolicy",
+    "fleet", "fit_many", "glm_fit_fleet", "glm_fleet", "FleetModel",
+    "ModelFamily", "FamilyScorer",
 ]

@@ -221,6 +221,51 @@ def _reject_elastic_args(*, penalty=None, beta0=None, on_iteration=None,
             "shard directory after a restart; drop resume=")
 
 
+def _reject_fleet_args(*, engine="auto", penalty=None, design="dense",
+                       mesh=None, beta0=None, on_iteration=None,
+                       checkpoint_every=0):
+    """Options that have no meaning on the fleet path — each per-segment
+    model is a small single-device IRLS mapped over the model axis, so the
+    solo fit's scale-out machinery does not apply.  Refuse loudly rather
+    than silently ignoring (same contract as ``_reject_penalty_args``)."""
+    if engine == "sketch":
+        raise ValueError(
+            "fleet fitting does not support engine='sketch': per-segment "
+            "models are SMALL (the whole point of batching them), so a "
+            "sketched Gramian would trade exactness for a speedup that "
+            "isn't there — fit the fleet with engine='auto'")
+    if engine == "elastic":
+        raise ValueError(
+            "fleet fitting does not support engine='elastic': the fleet "
+            "kernel already IS the parallel axis (one executable over all "
+            "models); shard-parallel workers would nest parallelism to no "
+            "benefit — drop engine='elastic'")
+    if engine not in ("auto", "einsum"):
+        raise ValueError(
+            f"fleet fitting requires the einsum Gramian engine; "
+            f"engine={engine!r} does not apply to the fleet path")
+    if penalty is not None:
+        raise ValueError(
+            "fleet fitting does not support penalty= (no batched lambda-"
+            "path kernel yet); fit penalized models one segment at a time "
+            "with glm(..., penalty=...)")
+    if design == "structured":
+        raise ValueError(
+            "fleet fitting does not support design='structured': the "
+            "segment-sum Gramian engine batches over factor levels, which "
+            "conflicts with batching over the model axis — use the dense "
+            "design (per-segment models are narrow)")
+    if mesh is not None:
+        raise ValueError(
+            "fleet fitting does not support mesh= (each per-segment model "
+            "is single-device; the model axis is the parallel dimension)")
+    if beta0 is not None or on_iteration is not None or checkpoint_every:
+        raise ValueError(
+            "fleet fitting does not support beta0=/on_iteration=/"
+            "checkpoint_every= (the fleet kernel runs all models to "
+            "convergence in one pass)")
+
+
 def lm(formula: str, data, *, weights=None, offset=None,
        na_omit: bool = True, mesh=None,
        singular: str = "drop", engine: str = "auto", design: str = "auto",
@@ -369,6 +414,68 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
         has_weights=weights_arg is not None,
         # cbind() group sizes travel with the formula itself, not m=
         has_m=m_arg is not None)
+
+
+def glm_fleet(formula: str, data, *, groups, family="binomial", link=None,
+              weights=None, offset=None, tol: float = 1e-8,
+              max_iter: int = 100, criterion: str = "relative",
+              na_omit: bool = True, batch: str = "exact",
+              bucket: int | None = None, sort: bool = True,
+              verbose: bool = False, trace=None, metrics=None,
+              engine: str = "auto", penalty=None, design: str = "dense",
+              mesh=None, beta0=None, on_iteration=None,
+              checkpoint_every: int = 0,
+              config: NumericConfig = DEFAULT):
+    """One GLM per group of a long-format frame, fitted as a FLEET — a
+    single compiled kernel call for every model (fleet/fitting.py).
+
+    ``groups`` is the segmentation key: a column name in ``data`` or an
+    (n,) array aligned with its rows.  The design is built ONCE on the
+    long frame (shared columns, factor coding and NA policy for every
+    model — the fleet contract), then rows are split by key, ragged
+    groups padded with weight-0 trash rows, and the stack fitted by
+    :func:`~sparkglm_tpu.fleet.glm_fit_fleet`.  Returns a
+    :class:`~sparkglm_tpu.fleet.FleetModel`; ``fleet["label"]`` is an
+    ordinary GLMModel carrying this formula's terms for ``predict``.
+
+    ``batch``/``bucket`` tune the fleet kernel (see fleet/); solo-fit
+    scale-out options (``engine='sketch'/'elastic'``, ``penalty=``,
+    ``design='structured'``, ``mesh=``, warm-start/checkpoint hooks) do
+    not apply and are rejected loudly.
+    """
+    _reject_fleet_args(engine=engine, penalty=penalty, design=design,
+                       mesh=mesh, beta0=beta0, on_iteration=on_iteration,
+                       checkpoint_every=checkpoint_every)
+    f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
+                                         dtype=np.dtype(config.dtype),
+                                         extra_cols=(weights, offset),
+                                         design="dense")
+    if f.response2 is not None:
+        raise ValueError(
+            "cbind() responses are not supported by glm_fleet yet; pass "
+            "proportions with per-row weights instead")
+    group_name = groups if isinstance(groups, str) else "group"
+    if isinstance(groups, str):
+        if groups not in cols:
+            raise KeyError(
+                f"groups column {groups!r} not found in data columns "
+                f"{list(cols)}")
+        grp = np.asarray(cols[groups])
+    else:
+        grp = _subset_extra(np.asarray(groups), keep, "groups")
+    w_arr = _col_or_subset(cols, keep, weights, "weights")
+    off_arr = _assemble_offset(f, cols, keep, offset)
+
+    from .fleet import fit_many as _fit_many
+    fleet = _fit_many(
+        y, X, groups=grp, weights=w_arr, offset=off_arr, sort=sort,
+        group_name=group_name, family=family, link=link, tol=tol,
+        max_iter=max_iter, criterion=criterion, xnames=terms.xnames,
+        yname=f.response, has_intercept=f.intercept, batch=batch,
+        bucket=bucket, verbose=verbose, trace=trace, metrics=metrics,
+        config=config)
+    import dataclasses
+    return dataclasses.replace(fleet, formula=str(f), terms=terms)
 
 
 def _stream_io(path, *, chunk_bytes, native, backend: str = "auto",

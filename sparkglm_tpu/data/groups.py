@@ -1,0 +1,103 @@
+"""Grouped-data ingestion for fleet fits: long-format -> stacked (K, n, p).
+
+The fleet kernel (fleet/kernel.py) wants one array per operand with a
+leading MODEL axis — a shared design layout, per-model rows.  Real fleets
+are ragged (one model per region/cohort/SKU, each with its own row count),
+so this module splits a long-format design by a key column and pads every
+group to a common row count with weight-0 trash rows — the same inertness
+mechanism the streaming engine's ``_bucket_pad`` and the mesh row padding
+already rely on: a zero weight excludes the row from every Gramian sum,
+deviance, and reported statistic (models/glm._sanitize, hoststats._mask_sum).
+
+The MODEL axis itself is padded to a power-of-2 bucket (``next_bucket``,
+the serve Scorer's ladder) with all-weight-0 trash models, so a warm refit
+of any fleet with K <= bucket re-enters the same compiled executable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: smallest fleet bucket — matches the serve Scorer's padding floor, so
+#: tiny fleets (K=2..8) share one executable instead of one per K
+MIN_BUCKET = 8
+
+
+def next_bucket(k: int, floor: int = MIN_BUCKET) -> int:
+    """Smallest power of two >= ``k`` (and >= ``floor``)."""
+    b = max(int(floor), 1)
+    while b < k:
+        b *= 2
+    return b
+
+
+def stack_groups(groups, X, y, weights=None, offset=None, *,
+                 n_rows: int | None = None, sort: bool = True):
+    """Split long-format arrays by a group key into the stacked fleet layout.
+
+    Args:
+      groups: (n,) key per row (strings, ints, anything np.unique handles).
+      X: (n, p) dense design (shared column layout across groups — build it
+        ONCE on the long frame so factor codings agree fleet-wide).
+      y: (n,) response.
+      weights / offset: optional (n,) per-row arrays.
+      n_rows: force the per-model row count (>= the largest group); default
+        is the largest group's size.  Pass a fixed value to keep refits on
+        growing data inside one compiled shape.
+      sort: sorted unique labels (default, deterministic); ``False`` keeps
+        first-appearance order.
+
+    Returns ``(labels, Xs, ys, ws, offs, n_real)`` — labels a tuple of K
+    python scalars, arrays stacked ``(K, n_rows, p)`` / ``(K, n_rows)``,
+    ``n_real`` the (K,) true row counts.  Padding rows carry weight 0 and
+    zero X/y/offset.
+    """
+    g = np.asarray(groups)
+    X = np.asarray(X)
+    y = np.asarray(y)
+    if y.ndim == 2 and y.shape[1] == 1:
+        y = y[:, 0]
+    n, p = X.shape
+    if g.shape != (n,) or y.shape != (n,):
+        raise ValueError(
+            f"groups/y must be ({n},) matching X rows, got {g.shape}/{y.shape}")
+    if sort:
+        labels, inv = np.unique(g, return_inverse=True)
+    else:
+        labels, first, inv = np.unique(g, return_index=True,
+                                       return_inverse=True)
+        order = np.argsort(first, kind="stable")
+        labels = labels[order]
+        inv = np.argsort(order, kind="stable")[inv]
+    K = len(labels)
+    counts = np.bincount(inv, minlength=K)
+    n_max = int(counts.max()) if K else 0
+    if n_rows is None:
+        n_rows = n_max
+    elif n_rows < n_max:
+        raise ValueError(
+            f"n_rows={n_rows} is smaller than the largest group ({n_max})")
+    wt = (np.ones(n, np.float64) if weights is None
+          else np.asarray(weights, np.float64))
+    off = (np.zeros(n, np.float64) if offset is None
+           else np.asarray(offset, np.float64))
+    if wt.shape != (n,) or off.shape != (n,):
+        raise ValueError("weights/offset must match X rows")
+
+    Xs = np.zeros((K, n_rows, p), X.dtype if X.dtype.kind == "f" else np.float64)
+    ys = np.zeros((K, n_rows), np.float64)
+    ws = np.zeros((K, n_rows), np.float64)   # pad rows stay weight 0 -> inert
+    offs = np.zeros((K, n_rows), np.float64)
+    # stable within-group order = original row order, as a solo fit on the
+    # group's rows would see them
+    order = np.argsort(inv, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    for k in range(K):
+        rows = order[starts[k]:starts[k + 1]]
+        c = len(rows)
+        Xs[k, :c] = X[rows]
+        ys[k, :c] = y[rows]
+        ws[k, :c] = wt[rows]
+        offs[k, :c] = off[rows]
+    return (tuple(labels.tolist()), Xs, ys, ws, offs,
+            counts.astype(np.int64))

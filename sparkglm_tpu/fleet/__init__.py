@@ -1,0 +1,29 @@
+"""Fleet fitting: the model axis as a first-class, compiled dimension.
+
+GLM practice at "millions of users" scale means thousands of small
+per-segment models (one per region / cohort / SKU / tenant), not one giant
+fit.  The reference sparkGLM fits one model per driver call; this
+subsystem amortizes compilation and dispatch across the whole fleet — one
+executable fits every model (ROADMAP item 3).
+
+    import sparkglm_tpu as sg
+    fleet = sg.fit_many(y, X, groups=region, family="binomial")
+    fleet["emea"].summary()          # an ordinary GLMModel
+    fam = sg.ModelFamily.from_fleet(fleet, name="churn")
+    scorer = fam.scorer()            # batched (tenant, x) serving
+
+Entry points: :func:`fit_many` (long-format + group key),
+:func:`glm_fit_fleet` (pre-stacked (K, n, p) arrays),
+:class:`FleetModel` (stacked results, indexable to GLMModels),
+``data/groups.stack_groups`` (the ingestion helper).
+"""
+
+from ..data.groups import MIN_BUCKET, next_bucket, stack_groups
+from .fitting import fit_many, glm_fit_fleet
+from .kernel import fleet_kernel_cache_size
+from .model import FleetModel
+
+__all__ = [
+    "fit_many", "glm_fit_fleet", "FleetModel", "stack_groups",
+    "next_bucket", "MIN_BUCKET", "fleet_kernel_cache_size",
+]

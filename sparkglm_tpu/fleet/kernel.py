@@ -1,0 +1,78 @@
+"""The fleet IRLS kernel: one executable for a whole stack of models.
+
+``_irls_fleet_kernel`` maps the SOLO IRLS core (models/glm._irls_core — the
+exact per-model computation graph every resident ``glm_fit`` compiles) over
+a leading model axis.  Two batch modes, both ONE executable per (shape,
+static-arg) flavor:
+
+  * ``batch="exact"`` (default) — ``lax.map`` over the model axis: each
+    model runs the UNBATCHED solo graph to its own convergence inside one
+    compiled scan.  Early-converged models are fully inert (their
+    while_loop simply stops — zero flops afterwards), and every model's
+    coefficients / covariance / eta are bit-identical to a solo
+    ``_irls_kernel`` call on the same (padded) row layout at any dtype.
+    Cross-model parallelism is sacrificed; dispatch and compilation are
+    amortized (the fleet win at thousands-of-small-models scale).
+
+  * ``batch="vmap"`` — ``jax.vmap`` over the model axis: every iteration
+    runs BATCHED Gramians/solves across all still-active models.  JAX's
+    while_loop batching rule applies the per-model convergence predicate as
+    an update MASK (``select(pred, new, old)``), so early-converged models
+    go inert bit-stably: their carried state freezes the iteration they
+    converge.  Iteration counts match solo fits exactly; coefficients agree
+    to roundoff (~1e-15 at f64) rather than bitwise, because a batched
+    GEMM's reduction order differs from the unbatched one.  This is the
+    throughput mode for batched hardware (MXU-friendly (K,n,p) einsums).
+
+Padding contracts (data/groups.py): trash ROWS carry weight 0 — inert in
+every sum via the core's ``_sanitize``/valid masking; trash MODELS (fleet
+bucket padding) carry all-zero weights — their first Gramian is singular,
+the loop exits after one iteration, and the driver slices them off.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from ..models.glm import _irls_core
+
+BATCH_MODES = ("exact", "vmap")
+
+
+@partial(jax.jit, static_argnames=("family", "link", "criterion",
+                                   "refine_steps", "precision", "batch"))
+def _irls_fleet_kernel(
+    X, y, wt, offset,
+    tol, max_iter, jitter,
+    family, link,
+    criterion: str = "relative",
+    refine_steps: int = 1,
+    precision=None,
+    batch: str = "exact",
+    fam_param=None,
+):
+    """Run IRLS for a stacked fleet: X (K, n, p); y/wt/offset (K, n).
+
+    Returns the solo kernel's output dict with a leading (K,) axis on every
+    leaf (beta (K, p), cov_inv (K, p, p), dev/iters/converged/singular/
+    pivot (K,), eta (K, n), XtWX0 (K, p, p)).
+    """
+    def one(Xk, yk, wk, ok):
+        return _irls_core(
+            Xk, yk, wk, ok, tol, max_iter, jitter,
+            family=family, link=link, criterion=criterion,
+            refine_steps=refine_steps, trace=False, precision=precision,
+            solver="chol", mesh=None, warm=False, fam_param=fam_param)
+
+    if batch == "vmap":
+        return jax.vmap(one)(X, y, wt, offset)
+    return jax.lax.map(lambda ops: one(*ops), (X, y, wt, offset))
+
+
+def fleet_kernel_cache_size() -> int:
+    """Compiled-executable count for the fleet kernel — the contract-test
+    and bench probe (one executable per pass flavor; warm refits at any
+    K <= bucket add nothing)."""
+    return int(_irls_fleet_kernel._cache_size())

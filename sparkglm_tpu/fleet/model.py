@@ -1,0 +1,158 @@
+"""FleetModel — stacked per-segment GLMs with solo-model indexing.
+
+One fleet fit produces K models that share a design layout (same columns,
+same family/link/tol) but have their own rows, coefficients, covariance and
+convergence record.  The container keeps everything STACKED (leading (K,)
+axis) so serving can gather coefficient rows in one batched dispatch
+(serve.FamilyScorer), while ``fleet[k]`` / ``fleet["label"]`` materializes
+an ordinary :class:`~sparkglm_tpu.models.glm.GLMModel` whose every field —
+and therefore whose serialization — matches what a solo ``glm_fit`` of the
+same (padded) row layout on a single-device mesh produces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..models.glm import GLMModel
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetModel:
+    """K stacked GLMs fitted in one fleet kernel call."""
+
+    # stacked per-model results (leading axis K)
+    coefficients: np.ndarray        # (K, p) float64
+    std_errors: np.ndarray          # (K, p) float64
+    cov_unscaled: np.ndarray        # (K, p, p) float64
+    deviance: np.ndarray            # (K,) float64
+    null_deviance: np.ndarray       # (K,)
+    pearson_chi2: np.ndarray        # (K,)
+    loglik: np.ndarray              # (K,)
+    aic: np.ndarray                 # (K,)
+    dispersion: np.ndarray          # (K,)
+    df_residual: np.ndarray         # (K,) int64
+    df_null: np.ndarray             # (K,) int64
+    iterations: np.ndarray          # (K,) int64
+    converged: np.ndarray           # (K,) bool
+    singular: np.ndarray            # (K,) bool
+    n_ok: np.ndarray                # (K,) int64 — R's weights>0 row count
+    has_offset: np.ndarray          # (K,) bool — per-model nonzero offset
+    # shared metadata
+    group_names: tuple              # K labels, aligned with the model axis
+    group_name: str                 # the key column / axis name
+    xnames: tuple
+    yname: str
+    family: str
+    link: str
+    n_obs: int                      # padded per-model row count (layout rows)
+    n_params: int
+    tol: float
+    criterion: str
+    has_intercept: bool
+    dispersion_fixed: bool
+    batch: str                      # "exact" | "vmap"
+    bucket: int                     # padded power-of-2 fleet size
+    formula: str | None = None
+    terms: object | None = None
+    fit_info: dict | None = None
+
+    @property
+    def n_models(self) -> int:
+        return len(self.group_names)
+
+    def __len__(self) -> int:
+        return self.n_models
+
+    def index_of(self, key) -> int:
+        """Model index for a group label (or pass an int through)."""
+        if isinstance(key, (int, np.integer)):
+            k = int(key)
+            if not -self.n_models <= k < self.n_models:
+                raise IndexError(
+                    f"model index {k} out of range for fleet of "
+                    f"{self.n_models}")
+            return k % self.n_models
+        try:
+            return self.group_names.index(key)
+        except ValueError:
+            raise KeyError(
+                f"{key!r} is not a fleet group (first few: "
+                f"{list(self.group_names[:5])!r})") from None
+
+    def __getitem__(self, key) -> GLMModel:
+        k = self.index_of(key)
+        return GLMModel(
+            coefficients=np.asarray(self.coefficients[k], np.float64),
+            std_errors=np.asarray(self.std_errors[k], np.float64),
+            xnames=tuple(self.xnames), yname=self.yname,
+            family=self.family, link=self.link,
+            deviance=float(self.deviance[k]),
+            null_deviance=float(self.null_deviance[k]),
+            pearson_chi2=float(self.pearson_chi2[k]),
+            loglik=float(self.loglik[k]), aic=float(self.aic[k]),
+            dispersion=float(self.dispersion[k]),
+            df_residual=int(self.df_residual[k]),
+            df_null=int(self.df_null[k]),
+            iterations=int(self.iterations[k]),
+            converged=bool(self.converged[k]),
+            n_obs=int(self.n_obs), n_params=int(self.n_params),
+            n_shards=1, tol=float(self.tol),
+            has_intercept=bool(self.has_intercept),
+            cov_unscaled=np.asarray(self.cov_unscaled[k], np.float64),
+            has_offset=bool(self.has_offset[k]),
+            dispersion_fixed=bool(self.dispersion_fixed),
+            gramian_engine="einsum")
+
+    def models(self):
+        """Iterate ``(label, GLMModel)`` over the fleet."""
+        for k, name in enumerate(self.group_names):
+            yield name, self[k]
+
+    def predict(self, X, group, *, offset=None, type: str = "link"):
+        """Score rows against ONE fleet member's coefficients (host numpy).
+
+        The batched serving path — many (tenant, x) requests in one
+        dispatch — is :class:`sparkglm_tpu.serve.FamilyScorer`.
+        """
+        k = self.index_of(group)
+        X = np.asarray(X, np.float64)
+        eta = X @ np.asarray(self.coefficients[k], np.float64)
+        if offset is not None:
+            eta = eta + np.asarray(offset, np.float64)
+        if type == "link":
+            return eta
+        if type == "response":
+            from ..models import hoststats
+            return hoststats.link_inverse(self.link, eta)
+        raise ValueError(f"type must be 'link' or 'response', got {type!r}")
+
+    def fit_report(self) -> dict:
+        """The fleet fit's observability aggregate (obs/trace.py report),
+        including the ``fleet`` block: executables compiled, per-iteration
+        inert-model fraction, convergence census."""
+        return self.fit_info or {}
+
+    def summary(self) -> str:
+        """Compact per-model census — one line per fleet member."""
+        lines = [
+            f"Model fleet: {self.n_models} x {self.family}({self.link}) "
+            f"[{self.yname} ~ {len(self.xnames)} cols, "
+            f"bucket={self.bucket}, batch={self.batch}]",
+            f"{self.group_name:>16}  n_ok  iters  conv  deviance        aic",
+        ]
+        for k, name in enumerate(self.group_names):
+            flag = ("yes" if self.converged[k]
+                    else "SING" if self.singular[k] else "NO")
+            lines.append(
+                f"{str(name):>16}  {int(self.n_ok[k]):4d}  "
+                f"{int(self.iterations[k]):5d}  {flag:>4}  "
+                f"{float(self.deviance[k]):<14.6g}  "
+                f"{float(self.aic[k]):<10.6g}")
+        return "\n".join(lines)
+
+    def save(self, path) -> None:
+        from ..models.serialize import save_model
+        save_model(self, path)

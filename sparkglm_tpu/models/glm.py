@@ -83,10 +83,7 @@ def _sanitize(x, valid, fill=0.0):
     return jnp.where(valid, jnp.nan_to_num(x, nan=fill, posinf=fill, neginf=fill), fill)
 
 
-@partial(jax.jit, static_argnames=("family", "link", "criterion", "refine_steps",
-                                   "trace", "precision", "solver", "mesh",
-                                   "warm"))
-def _irls_kernel(
+def _irls_core(
     X, y, wt, offset,
     tol, max_iter, jitter,
     family: Family, link: Link,
@@ -274,6 +271,15 @@ def _irls_kernel(
     return dict(beta=s["beta"], cov_inv=cov_final, dev=s["dev"],
                 eta=s["eta"], iters=s["it"], converged=converged,
                 singular=s["singular"], pivot=s["pivot"], XtWX0=s["XtWX0"])
+
+
+# the jitted entry every solo fit path calls; the undecorated _irls_core
+# stays importable so the fleet subsystem (fleet/kernel.py) can map/vmap the
+# SAME per-model computation graph over a stacked model axis — per-model
+# results are then bit-identical to a solo fit of the same row layout
+_irls_kernel = partial(jax.jit, static_argnames=(
+    "family", "link", "criterion", "refine_steps", "trace", "precision",
+    "solver", "mesh", "warm"))(_irls_core)
 
 
 def _segmented_irls(run_kernel, *, p, dtype, max_iter: int,

@@ -24,7 +24,12 @@ import numpy as np
 # of dropping fields it does not know and mis-scoring — the failure mode
 # that matters once a serving registry loads artifacts written by newer
 # trainers (serve/registry.py).
-_FORMAT_VERSION = 3
+# v4: stacked artifacts — FleetModel (fleet/model.py) and ModelFamily
+# (serve/registry.py, member models stored under ``m{i}__`` key prefixes).
+# ``np.savez`` writes fixed zip timestamps, so serialization is
+# byte-deterministic: indexing a deserialized fleet and saving the member
+# yields the SAME bytes as saving it before the round-trip.
+_FORMAT_VERSION = 4
 
 
 def _split(model) -> tuple[dict, dict]:
@@ -47,6 +52,10 @@ def _split(model) -> tuple[dict, dict]:
 
 
 def save_model(model, path: str) -> None:
+    from ..serve.registry import ModelFamily
+
+    if isinstance(model, ModelFamily):
+        return _save_family(model, path)
     arrays, meta = _split(model)
     meta["__class__"] = type(model).__name__
     meta["__format__"] = _FORMAT_VERSION
@@ -55,10 +64,58 @@ def save_model(model, path: str) -> None:
     np.savez(path, __meta__=header, **arrays)
 
 
-def load_model(path: str):
+def _save_family(family, path: str) -> None:
+    """A ModelFamily artifact: one npz holding every (tenant, version)
+    member's arrays under ``m{i}__`` prefixes plus the family's deploy
+    state, so a serving process restores the exact deploy/rollback
+    history in one read."""
+    members, fam_meta = family._export()
+    arrays, models = {}, []
+    for i, (tenant, version, mdl) in enumerate(members):
+        a, mm = _split(mdl)
+        for k, v in a.items():
+            arrays[f"m{i}__{k}"] = v
+        models.append(dict(tenant=tenant, version=int(version),
+                           cls=type(mdl).__name__, meta=mm))
+    meta = dict(fam_meta, models=models, __class__="ModelFamily",
+                __format__=_FORMAT_VERSION,
+                schema_version=_FORMAT_VERSION)
+    header = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(path, __meta__=header, **arrays)
+
+
+def _member_classes():
     from ..penalized.model import PathModel
     from .glm import GLMModel
     from .lm import LMModel
+    return {"LMModel": LMModel, "GLMModel": GLMModel,
+            "PathModel": PathModel}
+
+
+def _build(cls, meta: dict, arrays: dict):
+    """Reassemble one dataclass model from its meta dict + array dict."""
+    terms_meta = meta.pop("terms", None)
+    if terms_meta is not None:
+        from ..data.model_matrix import Terms
+        meta["terms"] = Terms.from_dict(terms_meta)
+    else:
+        meta["terms"] = None
+    pen_meta = meta.pop("penalty", None)
+    if pen_meta is not None:
+        from ..penalized.penalty import ElasticNet
+        meta["penalty"] = ElasticNet(**pen_meta)
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {k: v for k, v in meta.items() if k in field_names}
+    for k in ("xnames", "group_names"):
+        if k in kwargs and isinstance(kwargs[k], list):
+            kwargs[k] = tuple(kwargs[k])
+    kwargs.update(arrays)
+    return cls(**kwargs)
+
+
+def load_model(path: str):
+    from ..fleet.model import FleetModel
+    from ..serve.registry import ModelFamily
 
     with np.load(path if str(path).endswith(".npz") else str(path) + ".npz") as z:
         meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
@@ -66,15 +123,16 @@ def load_model(path: str):
     cls_name = meta.pop("__class__", None)
     fmt = meta.pop("__format__", 1)
     schema = int(meta.pop("schema_version", fmt))
-    classes = {"LMModel": LMModel, "GLMModel": GLMModel,
-               "PathModel": PathModel}
+    classes = dict(_member_classes(), FleetModel=FleetModel,
+                   ModelFamily=ModelFamily)
     if cls_name not in classes:
         raise ValueError(
             f"{path!r} is not a sparkglm model artifact (header class "
             f"{cls_name!r}; expected one of {sorted(classes)})")
     cls = classes[cls_name]
     if schema > _FORMAT_VERSION:
-        field_names = {f.name for f in dataclasses.fields(cls)}
+        field_names = ({f.name for f in dataclasses.fields(cls)}
+                       if dataclasses.is_dataclass(cls) else set())
         unknown = sorted(set(meta) - field_names - {"terms"})
         raise ValueError(
             f"{path!r} was saved with schema_version {schema}, but this "
@@ -89,20 +147,15 @@ def load_model(path: str):
             "(format v1): update()/drop1()/confint_profile cannot detect a "
             "fit-time weights= or m= argument on it — re-pass those "
             "explicitly if the original fit used them", stacklevel=2)
-    terms_meta = meta.pop("terms", None)
-    if terms_meta is not None:
-        from ..data.model_matrix import Terms
-        meta["terms"] = Terms.from_dict(terms_meta)
-    else:
-        meta["terms"] = None
-    pen_meta = meta.pop("penalty", None)
-    if pen_meta is not None:
-        from ..penalized.penalty import ElasticNet
-        meta["penalty"] = ElasticNet(**pen_meta)
-    field_names = {f.name for f in dataclasses.fields(cls)}
-    kwargs = {k: v for k, v in meta.items() if k in field_names}
-    for k in ("xnames",):
-        if k in kwargs and isinstance(kwargs[k], list):
-            kwargs[k] = tuple(kwargs[k])
-    kwargs.update(arrays)
-    return cls(**kwargs)
+    if cls_name == "ModelFamily":
+        member_classes = _member_classes()
+        members = []
+        for i, rec in enumerate(meta.pop("models")):
+            mcls = member_classes[rec["cls"]]
+            pre = f"m{i}__"
+            m_arrays = {k[len(pre):]: v for k, v in arrays.items()
+                        if k.startswith(pre)}
+            members.append((rec["tenant"], int(rec["version"]),
+                            _build(mcls, dict(rec["meta"]), m_arrays)))
+        return ModelFamily._restore(members, meta)
+    return _build(cls, meta, arrays)

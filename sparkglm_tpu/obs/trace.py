@@ -220,6 +220,10 @@ class FitTracer:
         self._prefetch_depth_max = 0
         self._overlap_saved_s = 0.0
         self._overlap_denom_s = 0.0
+        # fleet fits (sparkglm_tpu.fleet): the fleet_end census — model
+        # count, executables compiled, inert-model fraction per iteration
+        self._fleet: dict | None = None
+        self._models_converged = 0
 
     @staticmethod
     def _coerce_sink(s) -> Sink:
@@ -331,6 +335,16 @@ class FitTracer:
                 m.counter("elastic.shards_fitted").inc()
         elif ev.kind == "compile":
             self._compile_s += float(f.get("seconds", 0.0))
+        elif ev.kind == "model_converged":
+            self._models_converged += 1
+            if m is not None:
+                m.counter("fleet.models_converged").inc()
+        elif ev.kind == "fleet_end":
+            self._fleet = dict(f)
+            if m is not None:
+                m.gauge("fleet.models").set(float(f.get("models", 0)))
+                m.gauge("fleet.executables").set(
+                    float(f.get("executables", 0)))
         elif ev.kind in ("solve", "span"):
             if f.get("device"):
                 self._device_s += float(f.get("seconds", 0.0))
@@ -399,6 +413,15 @@ class FitTracer:
                     "shards": self._counts.get("shard_start", 0),
                     "shards_lost": self._shards_lost,
                 },
+                # fleet-fit block (sparkglm_tpu.fleet): the fleet_end
+                # event's census verbatim — models/bucket, converged and
+                # singular counts, executables compiled by this fit, and
+                # the inert-model fraction per iteration (share of models
+                # whose convergence mask had already frozen them before
+                # iteration t); None on non-fleet fits
+                "fleet": (dict(self._fleet,
+                               models_converged=self._models_converged)
+                          if self._fleet is not None else None),
                 "queue_wait_s": self._queue_wait_s,
                 "prefetch_depth_max": self._prefetch_depth_max,
                 # fraction of the overlappable time actually hidden by the

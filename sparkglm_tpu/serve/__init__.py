@@ -12,6 +12,11 @@ against the fitted model in milliseconds, forever".  Three pieces:
     buffer executable per (model signature, padding bucket); requests pad
     to the nearest power-of-2 bucket (inert rows), so steady-state serving
     NEVER recompiles.  ``warmup()`` pre-pays every compile.
+  * :class:`~.registry.ModelFamily` / :class:`~.engine.FamilyScorer` —
+    the fleet-serving pair: per-tenant versioned deploy/rollback over ONE
+    shared design signature, scored as mixed ``(tenant, x)`` batches in a
+    single gather-score dispatch (with sticky A/B splits and shadow
+    scoring in the same executable).
   * :class:`~.batching.MicroBatcher` — bounded admission queue coalescing
     concurrent requests into micro-batches under a latency budget
     (``BatchPolicy``), with typed :class:`~..robust.retry.Overloaded`
@@ -24,7 +29,8 @@ scoring and every kernel output is row-local.
 """
 
 from .batching import BatchPolicy, MicroBatcher
-from .engine import Scorer
-from .registry import ModelRegistry
+from .engine import FamilyScorer, Scorer, family_score_cache_size
+from .registry import ModelFamily, ModelRegistry
 
-__all__ = ["BatchPolicy", "MicroBatcher", "ModelRegistry", "Scorer"]
+__all__ = ["BatchPolicy", "FamilyScorer", "MicroBatcher", "ModelFamily",
+           "ModelRegistry", "Scorer", "family_score_cache_size"]

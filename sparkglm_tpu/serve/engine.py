@@ -33,7 +33,11 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
+from functools import partial
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..data.frame import as_columns
@@ -45,7 +49,7 @@ from ..models.scoring import (donation_supported, predict_sharded,
                               score_kernel_cache_size)
 from ..obs.trace import emit_ambient
 
-__all__ = ["Scorer"]
+__all__ = ["FamilyScorer", "Scorer"]
 
 
 def _next_bucket(n: int, floor: int) -> int:
@@ -238,5 +242,238 @@ class Scorer:
         # warmup compiles are expected and paid up-front, so the counter
         # resets here: after warmup, ``compiles`` reads "steady-state
         # recompiles since warmup" — the number the SLO bench asserts is 0
+        self.compiles = 0
+        return tuple(done)
+
+
+# -- family scoring: one dispatch for a mixed (tenant, x) batch ---------------
+
+@partial(jax.jit, static_argnames=("link", "type", "shadow"))
+def _family_score_kernel(X, tidx, arm, B, C, S, offset, *,
+                         link, type, shadow):
+    """Gather-score a mixed-tenant request batch in one executable.
+
+    ``B``/``C``/``S`` are stacked (T, p) coefficient tables (champion /
+    challenger / shadow); ``tidx`` picks each request row's tenant,
+    ``arm`` routes a row to the challenger table (A/B).  Every output is
+    row-local, so bucket-padded trash rows are inert.  Tables are runtime
+    ARGUMENTS — a family deploy/rollback swaps tables without recompiling.
+    """
+    rows = jnp.where(arm[:, None], C[tidx], B[tidx])
+    eta = jnp.einsum("np,np->n", X, rows) + offset
+
+    def out(e):
+        if type == "response" and link is not None:
+            from ..families.links import get_link
+            return get_link(link).inverse(e)
+        return e
+
+    if shadow:
+        eta_s = jnp.einsum("np,np->n", X, S[tidx]) + offset
+        return out(eta), out(eta_s)
+    return out(eta), None
+
+
+def family_score_cache_size() -> int:
+    """Executables held by the family scoring kernel (compile-contract
+    tests and bench.py count deltas of this)."""
+    return int(_family_score_kernel._cache_size())
+
+
+class FamilyScorer:
+    """Batched serving for a :class:`~.registry.ModelFamily`: requests from
+    MANY tenants score through one bucketed dispatch.
+
+    At construction the scorer snapshots the family's deployed coefficient
+    table (``deployed_matrix()``) and pins the family *generation* it came
+    from; a later deploy/rollback does not mutate a live scorer — ask the
+    family for a fresh one (``family.scorer()`` caches per generation).
+
+    A/B and shadow deployments:
+
+      * ``challenger={tenant: version}`` + ``ab_fraction``: requests for
+        those tenants are deterministically split by ``keys=`` (stable
+        request identity, e.g. user id) — a key hashes to the same arm
+        forever, the standard sticky A/B contract.  Other tenants always
+        serve the champion.
+      * ``shadow={tenant: version}``: every request ALSO scores against
+        the shadow table (champion rows except the overridden tenants) in
+        the same dispatch; ``score`` returns ``(fit, shadow_fit)`` and
+        only ``fit`` should be served.
+
+    Args:
+      family: the :class:`~.registry.ModelFamily` to snapshot.
+      type: "response" (GLM default) or "link".
+      min_bucket: smallest request padding bucket (power-of-2 ladder).
+      challenger: ``{tenant: version}`` champion overrides for A/B.
+      ab_fraction: challenger traffic share in [0, 1] (default 0.5).
+      shadow: ``{tenant: version}`` overrides scored in shadow.
+      metrics: ``obs.metrics.MetricsRegistry`` for request counters.
+      name: metric namespace; defaults to the family name.
+    """
+
+    def __init__(self, family, *, type: str = "response",
+                 min_bucket: int = 8, challenger: dict | None = None,
+                 ab_fraction: float = 0.5, shadow: dict | None = None,
+                 metrics=None, name: str | None = None):
+        if type not in ("link", "response"):
+            raise ValueError(
+                f"type must be 'link' or 'response', got {type!r}")
+        if min_bucket < 1:
+            raise ValueError(f"min_bucket must be >= 1, got {min_bucket}")
+        if not 0.0 <= float(ab_fraction) <= 1.0:
+            raise ValueError(
+                f"ab_fraction must be in [0, 1], got {ab_fraction}")
+        self.family = family
+        self.name = name if name is not None else family.name
+        self.type = type
+        self.min_bucket = int(min_bucket)
+        self.ab_fraction = float(ab_fraction)
+        self.metrics = metrics
+        self.tenants, self._B = family.deployed_matrix()
+        self._index = {t: i for i, t in enumerate(self.tenants)}
+        self._link = family.link
+        self.generation = family.generation()
+        self._challenger = dict(challenger) if challenger else None
+        self._C = self._override_table(self._challenger)
+        self._shadow = dict(shadow) if shadow else None
+        self._S = self._override_table(self._shadow)
+        self.compiles = 0
+        self.buckets = set()
+        self._lock = threading.Lock()
+
+    def _override_table(self, overrides: dict | None) -> np.ndarray:
+        """The champion table with ``{tenant: version}`` rows swapped in
+        (versions resolve — and fail — at construction, not per request)."""
+        table = self._B
+        if overrides:
+            table = self._B.copy()
+            for tenant, version in overrides.items():
+                i = self._index.get(str(tenant))
+                if i is None:
+                    raise KeyError(
+                        f"override names unknown tenant {tenant!r}")
+                table[i] = np.asarray(
+                    self.family.model(str(tenant),
+                                      int(version)).coefficients,
+                    np.float64)
+        return table
+
+    # -- A/B routing ---------------------------------------------------------
+
+    def assignments(self, tenants, keys) -> np.ndarray:
+        """The deterministic challenger-arm mask ``score`` uses: True where
+        a request serves the challenger.  Sticky per key — re-computable
+        offline for experiment analysis."""
+        tenants = np.atleast_1d(np.asarray(tenants, object))
+        if self._challenger is None:
+            return np.zeros(tenants.shape[0], bool)
+        keys = np.atleast_1d(np.asarray(keys, object))
+        in_ch = np.array([str(t) in self._challenger for t in tenants])
+        cut = int(self.ab_fraction * 10_000)
+        hashed = np.array([
+            zlib.crc32(f"{self.name}:{k}".encode()) % 10_000 < cut
+            for k in keys])
+        return in_ch & hashed
+
+    # -- scoring -------------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        if n < 1:
+            raise ValueError(f"request must have >= 1 row, got {n}")
+        return _next_bucket(n, self.min_bucket)
+
+    def score(self, tenants, X, *, offset=None, keys=None):
+        """Score a mixed-tenant batch in one dispatch.
+
+        Args:
+          tenants: per-row tenant labels (length n; a single label
+            broadcasts over all rows).
+          X: (n, p) design aligned to the family ``xnames``.
+          offset: optional per-row offset added to eta.
+          keys: stable per-request identities for A/B routing; REQUIRED
+            when the scorer has a ``challenger``.
+
+        Returns host ``fit`` — or ``(fit, shadow_fit)`` when the scorer
+        carries a ``shadow`` table.
+        """
+        t0 = time.perf_counter()
+        X = np.asarray(X, np.float64)
+        if X.ndim != 2 or X.shape[1] != self._B.shape[1]:
+            raise ValueError(
+                f"design must be (n, {self._B.shape[1]}) aligned to the "
+                f"family columns; got shape {X.shape}")
+        n = X.shape[0]
+        if isinstance(tenants, str):
+            tenants = [tenants] * n
+        tenants = np.asarray(tenants, object)
+        if tenants.shape[0] != n:
+            raise ValueError(
+                f"{tenants.shape[0]} tenant labels for {n} design rows")
+        try:
+            tidx = np.array([self._index[str(t)] for t in tenants],
+                            np.int32)
+        except KeyError as exc:
+            raise KeyError(
+                f"{exc.args[0]!r} is not a tenant of family "
+                f"{self.family.name!r}") from None
+        if self._challenger is not None and keys is None:
+            raise ValueError(
+                "this scorer has a challenger A/B split; pass keys= "
+                "(stable per-request identities) so arm assignment is "
+                "deterministic and sticky")
+        arm = self.assignments(tenants, keys)
+        off = (np.zeros(n) if offset is None
+               else np.asarray(offset, np.float64))
+        bucket = self.bucket_for(n)
+        pad = bucket - n
+        Xp = np.concatenate([X, np.zeros((pad, X.shape[1]))]) if pad else X
+        tp = np.concatenate([tidx, np.zeros(pad, np.int32)]) if pad else tidx
+        ap = np.concatenate([arm, np.zeros(pad, bool)]) if pad else arm
+        op = np.concatenate([off, np.zeros(pad)]) if pad else off
+        with self._lock:
+            before = family_score_cache_size()
+            fit, sh = _family_score_kernel(
+                Xp, tp, ap, self._B, self._C, self._S, op,
+                link=self._link, type=self.type,
+                shadow=self._shadow is not None)
+            fit = np.asarray(fit)[:n]
+            sh = None if sh is None else np.asarray(sh)[:n]
+            compiled = family_score_cache_size() - before
+            dt = time.perf_counter() - t0
+            if compiled:
+                self.compiles += compiled
+                emit_ambient("compile", target=f"serve:{self.name}",
+                             bucket=bucket, seconds=dt)
+            self.buckets.add(bucket)
+        if self.metrics is not None:
+            self.metrics.counter(f"serve.{self.name}.requests").inc()
+            self.metrics.counter(f"serve.{self.name}.rows").inc(n)
+            if compiled:
+                self.metrics.counter(
+                    f"serve.{self.name}.compiles").inc(compiled)
+            self.metrics.histogram(f"serve.{self.name}.score_s").observe(dt)
+        return fit if sh is None else (fit, sh)
+
+    def warmup(self, buckets=None) -> tuple[int, ...]:
+        """Pre-compile the bucket executables (power-of-2 ladder from
+        ``min_bucket`` through 1024 by default) so no live request pays
+        XLA compile latency; resets ``compiles`` to 0 afterwards."""
+        if buckets is None:
+            buckets, b = [], self.min_bucket
+            while b <= 1024:
+                buckets.append(b)
+                b <<= 1
+        p = self._B.shape[1]
+        done = []
+        for b in sorted(set(int(x) for x in buckets)):
+            with self._lock:
+                _family_score_kernel(
+                    np.zeros((b, p)), np.zeros(b, np.int32),
+                    np.zeros(b, bool), self._B, self._C, self._S,
+                    np.zeros(b), link=self._link, type=self.type,
+                    shadow=self._shadow is not None)
+                self.buckets.add(b)
+            done.append(b)
         self.compiles = 0
         return tuple(done)

@@ -29,9 +29,11 @@ from __future__ import annotations
 
 import threading
 
-from .engine import Scorer
+import numpy as np
 
-__all__ = ["ModelRegistry"]
+from .engine import FamilyScorer, Scorer
+
+__all__ = ["ModelFamily", "ModelRegistry"]
 
 
 class _Entry:
@@ -166,3 +168,272 @@ class ModelRegistry:
                             metrics=metrics, **kwargs)
                 self._scorers[key] = sc
             return sc
+
+
+class ModelFamily:
+    """Per-tenant versioned registry over ONE shared design signature.
+
+    A fleet fit (``fleet/``) produces thousands of per-segment models that
+    share columns, family and link.  :class:`ModelRegistry` treats each as
+    an unrelated name; a ``ModelFamily`` instead keys on *tenant* and
+    enforces the shared signature — which is what lets serving stack every
+    tenant's deployed coefficients into one (T, p) matrix and score a mixed
+    batch of ``(tenant, x)`` requests in ONE dispatch
+    (:class:`~.engine.FamilyScorer`).
+
+    Per tenant, the deployment semantics are exactly ModelRegistry's:
+    versions are immutable and auto-numbered, the first registered version
+    auto-deploys, later ones stage unless ``deploy=True``, and
+    ``rollback`` pops the per-tenant deploy stack.  Any deploy change bumps
+    the family *generation*; scorers are pinned to the generation they were
+    built from, so a stale scorer is never silently served — ``scorer()``
+    hands out a fresh (cached per generation+options) one.
+
+    Persistence: ``family.save(path)`` / ``models/serialize.py`` round-trip
+    the whole family — every registered version plus the deploy history —
+    through the ``_export()``/``_restore()`` hooks.
+    """
+
+    def __init__(self, name: str, *, metrics=None):
+        self._lock = threading.RLock()
+        self._entries: dict[str, _Entry] = {}
+        self._scorers: dict[tuple, FamilyScorer] = {}
+        self._generation = 0
+        self.name = str(name)
+        self.metrics = metrics
+        # shared design signature — fixed by the first registered model
+        self._xnames: tuple | None = None
+        self._family: str | None = None
+        self._link: str | None = None
+
+    # -- signature -----------------------------------------------------------
+
+    @property
+    def xnames(self) -> tuple | None:
+        return self._xnames
+
+    @property
+    def family(self) -> str | None:
+        return self._family
+
+    @property
+    def link(self) -> str | None:
+        return self._link
+
+    @property
+    def n_params(self) -> int | None:
+        return None if self._xnames is None else len(self._xnames)
+
+    def _check_signature(self, tenant: str, model) -> None:
+        xn = tuple(getattr(model, "xnames", ()) or ())
+        fam = getattr(model, "family", None)
+        lnk = getattr(model, "link", None)
+        if self._xnames is None:
+            self._xnames, self._family, self._link = xn, fam, lnk
+            return
+        if xn != self._xnames:
+            raise ValueError(
+                f"tenant {tenant!r}: model columns {list(xn)} do not match "
+                f"family {self.name!r} signature {list(self._xnames)} — a "
+                "ModelFamily shares ONE design layout so batched scoring "
+                "can stack coefficients")
+        if (fam, lnk) != (self._family, self._link):
+            raise ValueError(
+                f"tenant {tenant!r}: model is {fam}({lnk}); family "
+                f"{self.name!r} is {self._family}({self._link})")
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, tenant: str, model, *,
+                 deploy: bool | None = None) -> int:
+        """Add ``model`` as the next version for ``tenant``; returns the
+        version number.  First version of a tenant auto-deploys; later
+        ones stage unless ``deploy=True``."""
+        tenant = str(tenant)
+        with self._lock:
+            self._check_signature(tenant, model)
+            e = self._entries.setdefault(tenant, _Entry())
+            version = max(e.versions, default=0) + 1
+            e.versions[version] = model
+            if deploy or (deploy is None and e.deployed is None):
+                self._deploy_locked(tenant, e, version)
+            if self.metrics is not None:
+                self.metrics.counter(
+                    f"family.{self.name}.registered").inc()
+            return version
+
+    @classmethod
+    def from_fleet(cls, fleet, name: str, *, metrics=None) -> "ModelFamily":
+        """Build a family from a :class:`~sparkglm_tpu.fleet.FleetModel`:
+        one tenant per fleet group, each group's solo-equivalent
+        ``GLMModel`` registered as version 1 and deployed."""
+        fam = cls(name, metrics=metrics)
+        for label, model in fleet.models():
+            fam.register(str(label), model)
+        return fam
+
+    # -- deployment ----------------------------------------------------------
+
+    def _deploy_locked(self, tenant: str, e: _Entry, version: int) -> None:
+        e.deployed = version
+        e.history.append(version)
+        self._generation += 1
+        self._scorers.clear()  # scorers pin a coefficient snapshot
+        if self.metrics is not None:
+            self.metrics.gauge(
+                f"family.{self.name}.{tenant}.deployed").set(version)
+
+    def deploy(self, tenant: str, version: int) -> None:
+        with self._lock:
+            e = self._require(tenant)
+            if version not in e.versions:
+                raise KeyError(
+                    f"tenant {tenant!r} has no version {version}; "
+                    f"registered: {sorted(e.versions)}")
+            self._deploy_locked(tenant, e, version)
+
+    def rollback(self, tenant: str) -> int:
+        """Re-deploy the tenant's previously deployed version."""
+        with self._lock:
+            e = self._require(tenant)
+            if len(e.history) < 2:
+                raise RuntimeError(
+                    f"tenant {tenant!r} has no prior deployment to roll "
+                    f"back to (history: {e.history})")
+            e.history.pop()
+            version = e.history.pop()  # _deploy_locked re-appends it
+            self._deploy_locked(tenant, e, version)
+            return version
+
+    # -- lookup --------------------------------------------------------------
+
+    def _require(self, tenant: str) -> _Entry:
+        e = self._entries.get(str(tenant))
+        if e is None:
+            raise KeyError(
+                f"no tenant {tenant!r} in family {self.name!r}; have "
+                f"{sorted(self._entries)[:8]}"
+                f"{'...' if len(self._entries) > 8 else ''}")
+        return e
+
+    def tenants(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._entries))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def versions(self, tenant: str) -> tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(self._require(tenant).versions))
+
+    def deployed_version(self, tenant: str) -> int | None:
+        with self._lock:
+            return self._require(tenant).deployed
+
+    def model(self, tenant: str, version: int | None = None):
+        with self._lock:
+            e = self._require(tenant)
+            v = e.deployed if version is None else version
+            if v is None:
+                raise RuntimeError(
+                    f"tenant {tenant!r} has no deployed version")
+            if v not in e.versions:
+                raise KeyError(
+                    f"tenant {tenant!r} has no version {v}; registered: "
+                    f"{sorted(e.versions)}")
+            return e.versions[v]
+
+    def generation(self) -> int:
+        """Deploy-state counter; bumps on every deploy/rollback.  Scorers
+        record the generation they snapshot."""
+        with self._lock:
+            return self._generation
+
+    def deployed_matrix(self) -> tuple[tuple[str, ...], np.ndarray]:
+        """``(tenants, (T, p) float64 coefficients)`` for the deployed
+        version of every tenant — the FamilyScorer gather table."""
+        with self._lock:
+            tenants = tuple(sorted(self._entries))
+            if not tenants:
+                raise RuntimeError(
+                    f"family {self.name!r} has no tenants to serve")
+            rows = []
+            for t in tenants:
+                e = self._entries[t]
+                if e.deployed is None:
+                    raise RuntimeError(
+                        f"tenant {t!r} has no deployed version")
+                rows.append(np.asarray(
+                    e.versions[e.deployed].coefficients, np.float64))
+            return tenants, np.stack(rows)
+
+    # -- scoring -------------------------------------------------------------
+
+    def scorer(self, **kwargs) -> FamilyScorer:
+        """A :class:`~.engine.FamilyScorer` over the family's CURRENT
+        deploy state, cached per (generation, options) — any
+        deploy/rollback invalidates the cache so the next call snapshots
+        fresh coefficients.  ``kwargs`` go to :class:`FamilyScorer`
+        (``type=``, ``min_bucket=``, ``challenger=``, ``shadow=``, ...)."""
+        with self._lock:
+            metrics = kwargs.pop("metrics", self.metrics)
+            key = (self._generation,
+                   tuple(sorted((k, _freeze(v))
+                                for k, v in kwargs.items())))
+            sc = self._scorers.get(key)
+            if sc is None:
+                sc = FamilyScorer(self, metrics=metrics, **kwargs)
+                self._scorers[key] = sc
+            return sc
+
+    # -- persistence ---------------------------------------------------------
+
+    def save(self, path) -> None:
+        from ..models.serialize import save_model
+        save_model(self, path)
+
+    def _export(self):
+        """Serialization hook: ``(members, fam_meta)`` where members is a
+        deterministic ``[(tenant, version, model), ...]`` over EVERY
+        registered version and fam_meta carries the deploy state."""
+        with self._lock:
+            members = []
+            for tenant in sorted(self._entries):
+                e = self._entries[tenant]
+                for version in sorted(e.versions):
+                    members.append((tenant, version, e.versions[version]))
+            fam_meta = dict(
+                name=self.name,
+                deployed={t: self._entries[t].deployed
+                          for t in sorted(self._entries)},
+                history={t: list(self._entries[t].history)
+                         for t in sorted(self._entries)})
+            return members, fam_meta
+
+    @classmethod
+    def _restore(cls, members, meta) -> "ModelFamily":
+        """Serialization hook: rebuild from ``_export()`` output."""
+        fam = cls(meta["name"])
+        for tenant, version, model in members:
+            fam._check_signature(tenant, model)
+            e = fam._entries.setdefault(tenant, _Entry())
+            e.versions[int(version)] = model
+        for tenant, dep in (meta.get("deployed") or {}).items():
+            e = fam._entries.get(tenant)
+            if e is not None:
+                e.deployed = None if dep is None else int(dep)
+                e.history = [int(v)
+                             for v in (meta.get("history") or {})
+                             .get(tenant, [] if dep is None else [dep])]
+        return fam
+
+
+def _freeze(v):
+    """Hashable view of a scorer kwarg for the per-options cache key."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple, set)):
+        return tuple(_freeze(x) for x in v)
+    return v

@@ -50,6 +50,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "sketch: the sketched-IRLS engine + sparse designs "
         "(`make sketch` selects these; still tier-1 by default)")
+    config.addinivalue_line(
+        "markers", "fleet: batched per-segment fleet fitting + model-"
+        "family serving (`make fleet` selects these; still tier-1 by "
+        "default)")
 
 
 @pytest.fixture(scope="session")

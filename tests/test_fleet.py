@@ -1,0 +1,403 @@
+"""Fleet fitting + model-family serving (fleet/, serve.ModelFamily).
+
+The contracts under test, in the order the subsystem makes them:
+
+  * bit-identity: at float64 with ``batch="exact"``, every fleet member
+    equals a solo ``glm_fit`` of the SAME padded row layout on a single-
+    device mesh — coefficients, std errors, and iteration counts exactly
+    (convergence masks make early-converged members inert, so one slow
+    member cannot perturb its neighbors);
+  * one executable: a whole fleet compiles exactly one IRLS executable
+    per pass flavor, and a warm refit of any K <= bucket compiles ZERO;
+  * serving: a ModelFamily scores mixed (tenant, x) batches in one
+    dispatch, with sticky A/B splits and shadow scoring, and round-trips
+    through models/serialize.py with its deploy history.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.data.groups import next_bucket, stack_groups
+from sparkglm_tpu.fleet import fit_many, glm_fit_fleet, fleet_kernel_cache_size
+from sparkglm_tpu.serve import ModelFamily, family_score_cache_size
+
+pytestmark = pytest.mark.fleet
+
+
+def _segments(rng, sizes, p=3, seed_sep=None):
+    """Long-format logistic data with per-group sizes (ragged) and
+    per-group coefficients (so iteration counts differ)."""
+    groups, Xr, yr = [], [], []
+    for g, size in enumerate(sizes):
+        X = np.column_stack([np.ones(size),
+                             rng.normal(size=(size, p - 1))])
+        beta = rng.normal(size=p) * (0.3 + 0.9 * g)
+        eta = X @ beta
+        if seed_sep is not None and g == seed_sep:
+            # perfectly separated member: IRLS walks toward the boundary
+            # and cannot converge in few iterations
+            y = (X[:, 1] > 0).astype(float)
+        else:
+            y = (rng.random(size) < 1 / (1 + np.exp(-eta))).astype(float)
+        groups += [f"g{g}"] * size
+        Xr.append(X)
+        yr.append(y)
+    return np.array(groups), np.vstack(Xr), np.concatenate(yr)
+
+
+def _solo(Xk, yk, wk, **kw):
+    """The parity oracle: a solo fit of the same padded row layout on a
+    single-device mesh (fleet members are unsharded per-model fits)."""
+    return sg.glm_fit(Xk, yk, weights=wk, family="binomial",
+                      has_intercept=True, mesh=sg.single_device_mesh(),
+                      **kw)
+
+
+class TestBitIdentity:
+    def test_members_match_solo_fits_exactly(self, rng):
+        groups, X, y = _segments(rng, [210, 140, 90, 180])
+        labels, Xs, ys, ws, offs, n_real = stack_groups(groups, X, y)
+        fleet = fit_many(y, X, groups=groups, family="binomial",
+                         has_intercept=True)
+        assert fleet.group_names == tuple(labels)
+        # ragged groups genuinely pad: all sizes differ from the layout
+        assert fleet.n_obs == 210 and set(n_real) == {210, 140, 90, 180}
+        iters = set()
+        for k in range(len(fleet)):
+            solo = _solo(Xs[k], ys[k], ws[k])
+            m = fleet[k]
+            np.testing.assert_array_equal(m.coefficients, solo.coefficients)
+            np.testing.assert_array_equal(m.std_errors, solo.std_errors)
+            np.testing.assert_array_equal(m.cov_unscaled, solo.cov_unscaled)
+            assert m.iterations == solo.iterations
+            assert m.converged and solo.converged
+            assert m.deviance == solo.deviance
+            assert m.null_deviance == solo.null_deviance
+            assert m.loglik == solo.loglik
+            assert m.aic == solo.aic
+            assert m.dispersion == solo.dispersion
+            assert m.df_residual == solo.df_residual
+            assert m.df_null == solo.df_null
+            iters.add(m.iterations)
+        # the masked-update claim is only interesting if members genuinely
+        # stop at different iterations
+        assert len(iters) > 1
+
+    def test_nonconverging_member_does_not_poison_neighbors(self, rng):
+        groups, X, y = _segments(rng, [150, 150, 150], seed_sep=1)
+        labels, Xs, ys, ws, _, _ = stack_groups(groups, X, y)
+        fleet = fit_many(y, X, groups=groups, family="binomial",
+                         has_intercept=True, max_iter=6)
+        assert not fleet.converged[1]          # separated member runs out
+        for k in (0, 2):
+            solo = _solo(Xs[k], ys[k], ws[k], max_iter=6)
+            assert fleet.converged[k] and solo.converged
+            np.testing.assert_array_equal(fleet[k].coefficients,
+                                          solo.coefficients)
+            np.testing.assert_array_equal(fleet[k].std_errors,
+                                          solo.std_errors)
+            assert fleet[k].iterations == solo.iterations
+        # the separated member itself still matches ITS solo fit exactly
+        solo1 = _solo(Xs[1], ys[1], ws[1], max_iter=6)
+        np.testing.assert_array_equal(fleet[1].coefficients,
+                                      solo1.coefficients)
+
+    def test_vmap_mode_same_iterations_roundoff_coefs(self, rng):
+        groups, X, y = _segments(rng, [160, 120, 200])
+        exact = fit_many(y, X, groups=groups, family="binomial",
+                         has_intercept=True, batch="exact")
+        vm = fit_many(y, X, groups=groups, family="binomial",
+                      has_intercept=True, batch="vmap")
+        # the while_loop batching rule masks per-model carries, so the
+        # iteration trajectory is identical; only GEMM reduction order
+        # differs (roundoff)
+        np.testing.assert_array_equal(exact.iterations, vm.iterations)
+        np.testing.assert_array_equal(exact.converged, vm.converged)
+        np.testing.assert_allclose(exact.coefficients, vm.coefficients,
+                                   rtol=1e-9, atol=1e-12)
+
+
+class TestCompileContract:
+    def test_one_executable_then_warm_refits_free(self, rng):
+        # unique row count so no earlier test has warmed these shapes
+        n_rows, p = 173, 3
+        def fleet_of(K, seed):
+            r = np.random.default_rng(seed)
+            X = np.zeros((K, n_rows, p))
+            X[..., 0] = 1.0
+            X[..., 1:] = r.normal(size=(K, n_rows, p - 1))
+            y = (r.random((K, n_rows)) < 0.5).astype(float)
+            return X, y
+        X, y = fleet_of(5, 0)
+        before = fleet_kernel_cache_size()
+        f1 = glm_fit_fleet(X, y, family="binomial", has_intercept=True)
+        assert fleet_kernel_cache_size() - before == 1  # ONE executable
+        assert f1.bucket == 8
+        # warm refits at any K <= bucket: zero compiles
+        for K in (3, 7, 8):
+            X, y = fleet_of(K, K)
+            before = fleet_kernel_cache_size()
+            fk = glm_fit_fleet(X, y, family="binomial", has_intercept=True)
+            assert fleet_kernel_cache_size() - before == 0
+            assert fk.bucket == 8 and len(fk) == K
+        # K over the bucket compiles the next bucket once, then is warm
+        X, y = fleet_of(9, 9)
+        before = fleet_kernel_cache_size()
+        glm_fit_fleet(X, y, family="binomial", has_intercept=True)
+        assert fleet_kernel_cache_size() - before == 1
+
+    def test_offset_adds_exactly_one_null_pass_flavor(self, rng):
+        # with an intercept AND a nonzero offset the null deviance needs
+        # its own fleet pass on the ones design — exactly one more flavor
+        n_rows, p, K = 91, 3, 4
+        X = np.zeros((K, n_rows, p))
+        X[..., 0] = 1.0
+        X[..., 1:] = rng.normal(size=(K, n_rows, p - 1))
+        y = (rng.random((K, n_rows)) < 0.5).astype(float)
+        off = np.full((K, n_rows), 0.25)
+        before = fleet_kernel_cache_size()
+        glm_fit_fleet(X, y, offset=off, family="binomial",
+                      has_intercept=True)
+        assert fleet_kernel_cache_size() - before == 2
+        before = fleet_kernel_cache_size()
+        glm_fit_fleet(X, y, offset=off * 2, family="binomial",
+                      has_intercept=True)
+        assert fleet_kernel_cache_size() - before == 0
+
+    def test_report_records_executables_and_inertness(self, rng):
+        groups, X, y = _segments(rng, [100, 100, 100])
+        fleet = fit_many(y, X, groups=groups, family="binomial",
+                         has_intercept=True, trace=sg.FitTracer())
+        blk = fleet.fit_report()["fleet"]
+        assert blk["models"] == 3 and blk["bucket"] == 8
+        assert blk["executables"] >= 0
+        assert blk["models_converged"] == int(fleet.converged.sum())
+        # the inert fraction is a nondecreasing ramp ending below 1
+        ramp = blk["inert_fraction_per_iter"]
+        assert ramp == sorted(ramp) and len(ramp) == blk["iters_max"]
+
+
+class TestIngestion:
+    def test_stack_groups_pads_with_inert_rows(self, rng):
+        groups, X, y = _segments(rng, [50, 30])
+        labels, Xs, ys, ws, offs, n_real = stack_groups(groups, X, y)
+        assert labels == ("g0", "g1")
+        assert Xs.shape == (2, 50, 3)
+        assert list(n_real) == [50, 30]
+        assert (ws[1, 30:] == 0).all() and (Xs[1, 30:] == 0).all()
+        # weight-0 padding is exactly inert: same model as the raw rows
+        # fitted at the same layout
+        fleet = glm_fit_fleet(Xs, ys, weights=ws, family="binomial",
+                              has_intercept=True, labels=labels)
+        solo = _solo(Xs[1], ys[1], ws[1])
+        np.testing.assert_array_equal(fleet["g1"].coefficients,
+                                      solo.coefficients)
+        assert fleet["g1"].n_obs == 50  # layout rows, like a padded solo
+        assert int(fleet.n_ok[1]) == 30  # but only the real rows count
+
+    def test_next_bucket(self):
+        assert [next_bucket(k) for k in (1, 8, 9, 250)] == [8, 8, 16, 256]
+
+    def test_glm_fleet_formula_front_end(self, rng):
+        n = 300
+        data = {"y": (rng.random(n) < 0.4).astype(float),
+                "x1": rng.normal(size=n),
+                "seg": rng.choice(["a", "b", "c"], n)}
+        fleet = sg.glm_fleet("y ~ x1", data, groups="seg",
+                             family="binomial")
+        assert fleet.group_names == ("a", "b", "c")
+        assert fleet.group_name == "seg"
+        assert fleet.formula == "y ~ x1"
+        assert fleet.terms is not None
+        # label and index access agree
+        np.testing.assert_array_equal(fleet["b"].coefficients,
+                                      fleet[1].coefficients)
+
+    def test_front_end_guards(self, rng):
+        n = 60
+        data = {"y": (rng.random(n) < 0.5).astype(float),
+                "x1": rng.normal(size=n),
+                "seg": rng.choice(["a", "b"], n)}
+        with pytest.raises(ValueError, match="sketch"):
+            sg.glm_fleet("y ~ x1", data, groups="seg", engine="sketch")
+        with pytest.raises(ValueError, match="elastic"):
+            sg.glm_fleet("y ~ x1", data, groups="seg", engine="elastic")
+        with pytest.raises(ValueError, match="penalty"):
+            sg.glm_fleet("y ~ x1", data, groups="seg",
+                         penalty=sg.ElasticNet(alpha=1.0))
+        with pytest.raises(ValueError, match="structured"):
+            sg.glm_fleet("y ~ x1", data, groups="seg", design="structured")
+        with pytest.raises(ValueError, match="mesh"):
+            sg.glm_fleet("y ~ x1", data, groups="seg", mesh=object())
+        with pytest.raises(KeyError, match="nope"):
+            sg.glm_fleet("y ~ x1", data, groups="nope")
+
+
+class TestSerialization:
+    def test_fleet_roundtrip_members_byte_identical(self, rng, tmp_path):
+        groups, X, y = _segments(rng, [120, 80, 100])
+        fleet = fit_many(y, X, groups=groups, family="binomial",
+                         has_intercept=True)
+        fp = tmp_path / "fleet.npz"
+        fleet.save(str(fp))
+        back = sg.load_model(str(fp))
+        assert back.group_names == fleet.group_names
+        np.testing.assert_array_equal(back.coefficients, fleet.coefficients)
+        # indexing a DESERIALIZED fleet serializes byte-identically to
+        # indexing the live one (np.savez is byte-deterministic)
+        for k in range(len(fleet)):
+            a, b = tmp_path / f"a{k}.npz", tmp_path / f"b{k}.npz"
+            sg.save_model(fleet[k], str(a))
+            sg.save_model(back[k], str(b))
+            assert a.read_bytes() == b.read_bytes()
+
+    def test_family_roundtrip_with_deploy_history(self, rng, tmp_path):
+        groups, X, y = _segments(rng, [100, 100])
+        fleet = fit_many(y, X, groups=groups, family="binomial",
+                         has_intercept=True)
+        fam = ModelFamily.from_fleet(fleet, "churn")
+        v2 = fam.register("g0", fleet[0], deploy=True)
+        assert (fam.deployed_version("g0"), v2) == (2, 2)
+        fp = tmp_path / "fam.npz"
+        fam.save(str(fp))
+        back = sg.load_model(str(fp))
+        assert isinstance(back, ModelFamily)
+        assert back.tenants() == ("g0", "g1")
+        assert back.versions("g0") == (1, 2)
+        assert back.deployed_version("g0") == 2
+        np.testing.assert_array_equal(back.model("g1").coefficients,
+                                      fam.model("g1").coefficients)
+        # the deploy HISTORY round-trips: rollback works on the restored
+        # family exactly as it would have on the live one
+        assert back.rollback("g0") == 1
+
+    def test_schema_version_guard(self, rng, tmp_path):
+        import json
+        groups, X, y = _segments(rng, [60, 60])
+        fleet = fit_many(y, X, groups=groups, family="binomial",
+                         has_intercept=True)
+        fp = tmp_path / "fleet.npz"
+        fleet.save(str(fp))
+        with np.load(str(fp)) as z:
+            meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
+            arrays = {k: z[k] for k in z.files if k != "__meta__"}
+        meta["schema_version"] = 99
+        meta["from_the_future"] = True
+        header = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+        np.savez(str(fp), __meta__=header, **arrays)
+        with pytest.raises(ValueError, match="schema_version 99"):
+            sg.load_model(str(fp))
+
+    def test_mixed_versions_reject_signature_drift(self, rng):
+        groups, X, y = _segments(rng, [80, 80])
+        fleet = fit_many(y, X, groups=groups, family="binomial",
+                         has_intercept=True)
+        fam = ModelFamily.from_fleet(fleet, "churn")
+        other = sg.glm_fit(np.column_stack([np.ones(50),
+                                            rng.normal(size=(50, 3))]),
+                           (rng.random(50) < 0.5).astype(float),
+                           family="binomial")
+        with pytest.raises(ValueError, match="signature"):
+            fam.register("g0", other)
+
+
+class TestFamilyScoring:
+    @pytest.fixture()
+    def family(self, rng):
+        groups, X, y = _segments(rng, [200, 150, 180])
+        fleet = fit_many(y, X, groups=groups, family="binomial",
+                         has_intercept=True)
+        return fleet, ModelFamily.from_fleet(fleet, "churn")
+
+    def test_batched_scoring_matches_per_model_predict(self, family, rng):
+        fleet, fam = family
+        sc = fam.scorer(type="link")
+        n = 17
+        X = np.column_stack([np.ones(n), rng.normal(size=(n, 2))])
+        tenants = rng.choice(fam.tenants(), n)
+        out = sc.score(list(tenants), X)
+        ref = np.array([fleet.predict(X[i:i + 1], str(tenants[i]))[0]
+                        for i in range(n)])
+        np.testing.assert_allclose(out, ref, rtol=1e-12)
+        resp = fam.scorer(type="response").score(list(tenants), X)
+        assert ((0 <= resp) & (resp <= 1)).all()
+
+    def test_padding_rows_inert_and_warm_path_compiles_nothing(
+            self, family, rng):
+        fleet, fam = family
+        sc = fam.scorer(type="link", min_bucket=8)
+        X = np.column_stack([np.ones(11), rng.normal(size=(11, 2))])
+        tenants = ["g0"] * 11
+        out11 = sc.score(tenants, X)        # bucket 16
+        out5 = sc.score(tenants[:5], X[:5])  # bucket 8 — different pad
+        np.testing.assert_array_equal(out11[:5], out5[:5])
+        before = family_score_cache_size()
+        again = sc.score(tenants, X)
+        assert family_score_cache_size() - before == 0
+        np.testing.assert_array_equal(again, out11)
+
+    def test_warmup_prepays_compiles(self, family, rng):
+        _, fam = family
+        sc = fam.scorer(type="response", min_bucket=8)
+        sc.warmup(buckets=(8, 16))
+        assert sc.compiles == 0
+        X = np.column_stack([np.ones(6), rng.normal(size=(6, 2))])
+        sc.score(["g1"] * 6, X)
+        assert sc.compiles == 0  # steady state: zero recompiles
+
+    def test_ab_split_sticky_and_scoped_to_challenger(self, family, rng):
+        fleet, fam = family
+        fam.register("g0", fleet[1])  # v2 for g0: a genuinely different row
+        sc = fam.scorer(type="link", challenger={"g0": 2}, ab_fraction=0.5)
+        n = 40
+        X = np.column_stack([np.ones(n), rng.normal(size=(n, 2))])
+        tenants = ["g0"] * (n // 2) + ["g1"] * (n // 2)
+        keys = [f"user{i % 10}" for i in range(n)]
+        with pytest.raises(ValueError, match="keys"):
+            sc.score(tenants, X)
+        arm = sc.assignments(tenants, keys)
+        assert arm.any() and not arm.all()
+        assert not arm[n // 2:].any()  # g1 has no challenger: all champion
+        out = sc.score(tenants, X, keys=keys)
+        np.testing.assert_array_equal(out, sc.score(tenants, X, keys=keys))
+        plain = fam.scorer(type="link").score(tenants, X)
+        chall = fleet.predict(X, "g1")  # v2 of g0 IS g1's model
+        np.testing.assert_allclose(out[arm], chall[arm], rtol=1e-12)
+        np.testing.assert_array_equal(out[~arm], plain[~arm])
+
+    def test_shadow_scores_in_same_dispatch(self, family, rng):
+        fleet, fam = family
+        fam.register("g2", fleet[0])
+        sc = fam.scorer(type="link", shadow={"g2": 2})
+        X = np.column_stack([np.ones(8), rng.normal(size=(8, 2))])
+        fit, shadow = sc.score(["g2"] * 8, X)
+        plain = fam.scorer(type="link").score(["g2"] * 8, X)
+        np.testing.assert_array_equal(fit, plain)      # serving unchanged
+        np.testing.assert_allclose(shadow, fleet.predict(X, "g0"),
+                                   rtol=1e-12)
+
+    def test_deploy_invalidates_scorer_cache(self, family, rng):
+        fleet, fam = family
+        sc1 = fam.scorer(type="link")
+        assert fam.scorer(type="link") is sc1      # cached per generation
+        v = fam.register("g1", fleet[0], deploy=True)
+        sc2 = fam.scorer(type="link")
+        assert sc2 is not sc1
+        X = np.column_stack([np.ones(4), rng.normal(size=(4, 2))])
+        np.testing.assert_allclose(sc2.score(["g1"] * 4, X),
+                                   fleet.predict(X, "g0"), rtol=1e-12)
+        fam.rollback("g1")
+        np.testing.assert_allclose(
+            fam.scorer(type="link").score(["g1"] * 4, X),
+            fleet.predict(X, "g1"), rtol=1e-12)
+        assert v == 2
+
+    def test_unknown_tenant_is_legible(self, family, rng):
+        _, fam = family
+        sc = fam.scorer()
+        X = np.ones((2, 3))
+        with pytest.raises(KeyError, match="not a tenant"):
+            sc.score(["nope", "g0"], X)
