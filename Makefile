@@ -7,8 +7,8 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
-.PHONY: all native test bench robust obs pipeline serve categorical \
-        penalized elastic sketch fleet clean
+.PHONY: all native test bench robust obs pipeline serve serve_async \
+        categorical penalized elastic sketch fleet clean
 
 all: native
 
@@ -44,6 +44,12 @@ pipeline:
 # steady-state recompiles, micro-batch coalescing + typed backpressure
 serve:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q
+
+# async replicated serving (sparkglm_tpu/serve/async_engine.py):
+# continuous batching, deficit-round-robin fairness, recompile-free
+# deploy/rollback under load, f64 bit-identity + the bf16 tier bound
+serve_async:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m asyncio
 
 # factor-aware Gramian engine (sparkglm_tpu/ops/factor_gramian.py): the
 # structured test suite plus the categorical_gramian bench block (dense

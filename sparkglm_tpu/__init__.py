@@ -52,8 +52,9 @@ from .parallel import distributed
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
 from .penalized import ElasticNet, PathModel
 from .obs import FitTracer, JsonlSink, MetricsRegistry, RingBufferSink
-from .serve import (BatchPolicy, FamilyScorer, MicroBatcher, ModelFamily,
-                    ModelRegistry, Scorer)
+from .serve import (AsyncEngine, BatchPolicy, EnginePolicy, FamilyScorer,
+                    MicroBatcher, ModelFamily, ModelRegistry,
+                    ReplicatedScorer, Scorer)
 from .utils import profiling
 from . import elastic, fleet, obs, robust, serve
 
@@ -88,6 +89,7 @@ __all__ = [
     "robust",
     "obs", "FitTracer", "MetricsRegistry", "JsonlSink", "RingBufferSink",
     "serve", "ModelRegistry", "Scorer", "MicroBatcher", "BatchPolicy",
+    "AsyncEngine", "EnginePolicy", "ReplicatedScorer",
     "fleet", "fit_many", "glm_fit_fleet", "glm_fleet", "FleetModel",
     "ModelFamily", "FamilyScorer",
 ]

@@ -119,6 +119,29 @@ def resolve_matmul_precision(config: "NumericConfig", n: int, p: int,
     return "highest" if n * p * p <= SMALL_PROBLEM_MAC_CAP else None
 
 
+# Online-serving precision tiers (sparkglm_tpu/serve/async_engine.py).
+# "default" serves at the ambient dtype (f64 under x64, f32 on TPU) and is
+# bit-identical to host model.predict — the tier every correctness claim is
+# written against.  "bf16" casts the eta einsum operands to bfloat16 with
+# f32 accumulation: the same one-bf16-pass trade the fused fit engine makes
+# for its warm-up Gramians (ops/fused.py — measured ~1e-3 relative there),
+# with a documented max-abs-error bound in PARITY.md.  Opt-in per scorer.
+SERVE_PRECISION_TIERS = ("default", "bf16")
+
+
+def resolve_serve_precision(precision) -> str | None:
+    """Normalize a serving ``precision=`` knob: ``None``/"default" mean the
+    bit-identical ambient-dtype tier (returned as None — kernels treat it
+    as "no cast"), "bf16" opts into the reduced-precision eta einsum."""
+    if precision is None or precision == "default":
+        return None
+    if precision == "bf16":
+        return "bf16"
+    raise ValueError(
+        f"serving precision must be one of {SERVE_PRECISION_TIERS} "
+        f"(or None), got {precision!r}")
+
+
 def effective_tol(tol: float, criterion: str, dtype) -> float:
     """The convergence threshold actually used: for the RELATIVE criterion
     it is floored at 8 ulp of the deviance dtype — below that the

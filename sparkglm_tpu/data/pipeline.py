@@ -29,6 +29,25 @@ Memory bound: at most ``prefetch`` produced items plus the one being
 consumed are alive, so a pipelined pass holds ≈ ``(prefetch + 1) ×
 chunk_bytes`` of host/device chunk data beyond the sequential baseline.
 
+Auto-degrade (``auto_degrade=True``, the default): overlap is not free —
+the producer's numpy staging competes with XLA's CPU compute for the same
+cores (and the GIL), and on a saturated host a pipelined pass can run
+*slower* than sequential (BENCH_r10 ``streaming_pipeline``: prefetch=2 at
+1.9× sequential wall, queue_wait ≈ the whole pass).  Concurrent-mode
+measurements cannot predict uncontended cost (both ``produce_s`` and
+``queue_wait_s`` inflate together under contention), so the pipeline
+A/B-tests itself: the first ``_PROBE_ITEMS`` items are consumed inline
+(sequential truth), then the producer thread takes over and the measured
+pipelined rate is compared against the probed sequential rate.  If
+pipelining is not at least ``1 - _DEGRADE_RATIO`` faster, the producer
+hands the live iterator back and the rest of the pass runs sequentially
+on the consumer thread (``PassStats.degraded`` is set; streaming passes
+surface it as a ``prefetch_degraded`` trace event).  Decisions are only
+taken once the probe has accumulated ``_PROBE_MIN_S`` of wall time, so
+sub-millisecond test streams keep fully deterministic event sequences.
+The worst case is bounded: a degraded pass pays at most the few-item
+pipelined probe over pure sequential.
+
 The pipeline is representation-agnostic: items are opaque, so structured
 chunks (``data/structured.py`` — a dense leaf plus per-factor level-index
 vectors) ride through exactly like dense matrices, and the determinism
@@ -46,7 +65,14 @@ from ..obs import trace as _obs_trace
 
 __all__ = ["PassStats", "prefetch_iter"]
 
-_ITEM, _ERR, _DONE = "item", "err", "done"
+_ITEM, _ERR, _DONE, _HAND = "item", "err", "done", "hand"
+
+# Auto-degrade tuning (module docstring): sequential-probe length, the
+# minimum probed wall time before any degrade decision is allowed, and the
+# ratio the pipelined rate must beat to keep the producer thread.
+_PROBE_ITEMS = 2
+_PROBE_MIN_S = 0.25
+_DEGRADE_RATIO = 0.95
 
 
 class PassStats:
@@ -58,10 +84,12 @@ class PassStats:
     ``waits``        number of queue gets that had to wait
     ``depth_max`` / ``depth_sum`` / ``items``
                      queue depth observed at each get (max / for mean)
+    ``degraded``     the pass handed the iterator back to the consumer
+                     thread because measured overlap didn't pay
     """
 
     __slots__ = ("produce_s", "queue_wait_s", "waits", "depth_max",
-                 "depth_sum", "items")
+                 "depth_sum", "items", "degraded")
 
     def __init__(self):
         self.produce_s = 0.0
@@ -70,13 +98,15 @@ class PassStats:
         self.depth_max = 0
         self.depth_sum = 0
         self.items = 0
+        self.degraded = False
 
     def depth_mean(self) -> float:
         return self.depth_sum / self.items if self.items else 0.0
 
 
 def prefetch_iter(make_iter: Callable[[], Iterator], prefetch: int,
-                  stats: PassStats | None = None) -> Iterator:
+                  stats: PassStats | None = None, *,
+                  auto_degrade: bool = True) -> Iterator:
     """Iterate ``make_iter()`` on a background thread, ``prefetch`` ahead.
 
     Yields the underlying iterator's items in order.  An exception raised
@@ -86,15 +116,48 @@ def prefetch_iter(make_iter: Callable[[], Iterator], prefetch: int,
     emitted on the producer thread are replayed in order on this thread
     (see module docstring).  Abandoning the iterator early (consumer
     exception, ``break``) stops and joins the producer.
+
+    ``auto_degrade=True`` consumes the first items inline as a sequential
+    probe and hands the iterator back to the consumer thread for the rest
+    of the pass when measured overlap doesn't beat the probed sequential
+    rate (module docstring; ``stats.degraded`` records the decision).
+    ``auto_degrade=False`` pipelines unconditionally from item 0.
     """
     if prefetch < 1:
         raise ValueError(f"prefetch must be >= 1, got {prefetch}")
-    return _prefetch_gen(make_iter, int(prefetch), stats)
+    return _prefetch_gen(make_iter, int(prefetch), stats,
+                         bool(auto_degrade))
 
 
-def _prefetch_gen(make_iter, prefetch, stats):
+def _prefetch_gen(make_iter, prefetch, stats, auto_degrade):
+    track = stats if stats is not None else PassStats()
+
+    # Sequential probe: inline consumption measures the uncontended
+    # per-item rate (produce + compute) that the pipelined phase must
+    # beat.  Probe errors raise inline — identical to sequential runs.
+    it0 = None
+    seq_rate = 0.0
+    monitor = False
+    if auto_degrade:
+        it0 = make_iter()
+        t_probe0 = time.perf_counter()
+        for _ in range(_PROBE_ITEMS):
+            t0 = time.perf_counter()
+            try:
+                item = next(it0)
+            except StopIteration:
+                return
+            finally:
+                track.produce_s += time.perf_counter() - t0
+            track.items += 1
+            yield item
+        probe_s = time.perf_counter() - t_probe0
+        seq_rate = probe_s / _PROBE_ITEMS
+        monitor = probe_s >= _PROBE_MIN_S
+
     q: queue.Queue = queue.Queue(maxsize=prefetch)
     stop = threading.Event()
+    degrade = threading.Event()
 
     def _put(entry) -> bool:
         while not stop.is_set():
@@ -105,9 +168,11 @@ def _prefetch_gen(make_iter, prefetch, stats):
                 continue
         return False
 
-    def produce():
-        it = None
+    def produce(it=it0):
         while True:
+            if degrade.is_set():
+                _put((_HAND, it, []))
+                return
             with _obs_trace.capture() as events:
                 t0 = time.perf_counter()
                 try:
@@ -121,8 +186,7 @@ def _prefetch_gen(make_iter, prefetch, stats):
                     _put((_ERR, e, events))
                     return
                 finally:
-                    if stats is not None:
-                        stats.produce_s += time.perf_counter() - t0
+                    track.produce_s += time.perf_counter() - t0
             if not _put((_ITEM, item, events)):
                 return  # consumer abandoned the stream
 
@@ -130,26 +194,57 @@ def _prefetch_gen(make_iter, prefetch, stats):
                          daemon=True)
     t.start()
     try:
+        t_pipe0 = time.perf_counter()
+        n_piped = 0
         while True:
+            if monitor and not degrade.is_set():
+                # consumer is back for the next item: everything since the
+                # measurement start (produce AND compute, overlapped) is
+                # on the clock.  The FIRST pipelined item is excluded —
+                # the producer starts with zero lead, so its cost equals
+                # sequential and would bias the decision toward degrade.
+                if n_piped == 1:
+                    t_pipe0 = time.perf_counter()
+                elif n_piped > 1:
+                    wall = time.perf_counter() - t_pipe0
+                    if wall > _DEGRADE_RATIO * seq_rate * (n_piped - 1):
+                        degrade.set()
             t0 = time.perf_counter()
             try:
                 tag, payload, events = q.get_nowait()
             except queue.Empty:
                 tag, payload, events = q.get()
-                if stats is not None:
-                    stats.queue_wait_s += time.perf_counter() - t0
-                    stats.waits += 1
-            if stats is not None:
-                depth = q.qsize()
-                stats.depth_max = max(stats.depth_max, depth)
-                stats.depth_sum += depth
-                stats.items += 1
+                track.queue_wait_s += time.perf_counter() - t0
+                track.waits += 1
+            depth = q.qsize()
+            track.depth_max = max(track.depth_max, depth)
+            track.depth_sum += depth
+            track.items += 1
             _obs_trace.replay(events)
             if tag is _DONE:
                 return
             if tag is _ERR:
                 raise payload
+            if tag is _HAND:
+                track.items -= 1  # hand-off marker, not an item
+                break
+            n_piped += 1
             yield payload
+        # Degraded: the producer handed its live iterator back; the rest
+        # of the pass runs sequentially on this thread (direct tracer
+        # emission, no capture/replay — same event order either way).
+        track.degraded = True
+        it_tail = payload
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it_tail)
+            except StopIteration:
+                return
+            finally:
+                track.produce_s += time.perf_counter() - t0
+            track.items += 1
+            yield item
     finally:
         stop.set()
         while True:  # unblock a producer parked on a full queue

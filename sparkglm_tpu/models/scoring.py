@@ -110,7 +110,8 @@ def score_kernel_cache_size() -> int:
 
 def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
                     link=None, type: str = "link", se_fit: bool = False,
-                    pad_to: int | None = None, donate: bool = False):
+                    pad_to: int | None = None, donate: bool = False,
+                    device=None):
     """Score ``X`` on device; returns host float64 ``fit`` or ``(fit, se)``.
 
     Args:
@@ -139,6 +140,12 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
         kernel output is row-local.
       donate: donate the (padded) input buffer to the executable where
         the backend supports aliasing — the serving steady state.
+      device: pin the (mesh=None) dispatch to ONE specific device — the
+        replicated-serving path (serve/async_engine.py) scores each
+        request batch on its replica's device.  All operands are committed
+        there, so each replica compiles its own executable (warm them per
+        replica); None keeps the default-device behaviour, which is the
+        executable family the host predict path shares.
     """
     from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
 
@@ -195,6 +202,14 @@ def predict_sharded(X, coefficients, *, mesh=None, offset=None, vcov=None,
         V = meshlib.replicate(
             np.nan_to_num(np.asarray(vcov, dtype)) if se_fit
             else np.zeros((1, 1), dtype), mesh)
+    elif device is not None:
+        Xd = jax.device_put(Xh, device)
+        od = jax.device_put(oh if oh is not None else np.zeros((1,), dtype),
+                            device)
+        beta = jax.device_put(np.nan_to_num(np.asarray(coefficients, dtype)),
+                              device)
+        V = jax.device_put(np.nan_to_num(np.asarray(vcov, dtype)) if se_fit
+                           else np.zeros((1, 1), dtype), device)
     else:
         Xd = jax.device_put(Xh) if structured else jnp.asarray(Xh)
         od = jnp.asarray(oh if oh is not None else np.zeros((1,), dtype))

@@ -107,9 +107,16 @@ def _pass_iter(make_iter, prefetch: int):
 def _emit_pipeline_events(tracer, stats, label: str, index: int) -> None:
     """One ``queue_wait`` + one ``prefetch_depth`` event per pipelined
     pass (deterministic count and position — right before ``pass_end`` —
-    with timing-valued fields, like the other per-pass aggregates)."""
+    with timing-valued fields, like the other per-pass aggregates).  A
+    pass that auto-degraded to sequential (data/pipeline.py: measured
+    overlap didn't pay) additionally emits ``prefetch_degraded`` first."""
     if tracer is None or stats is None:
         return
+    if getattr(stats, "degraded", False):
+        tracer.emit("prefetch_degraded", label=label, index=int(index),
+                    items=int(stats.items),
+                    produce_s=float(stats.produce_s),
+                    queue_wait_s=float(stats.queue_wait_s))
     tracer.emit("queue_wait", label=label, index=int(index),
                 seconds=float(stats.queue_wait_s), waits=int(stats.waits))
     tracer.emit("prefetch_depth", label=label, index=int(index),

@@ -22,6 +22,15 @@ structured event stream:
                                 pass (data/pipeline.py): total consumer
                                 time blocked on the producer, and the
                                 max/mean prefetch-queue depth observed
+  ``prefetch_degraded``         a pipelined pass handed its iterator back
+                                to the consumer thread because measured
+                                overlap did not beat the sequential probe
+                                (data/pipeline.py auto-degrade)
+  ``admission`` / ``queue_depth`` / ``batch``  async serving engine
+                                (serve/async_engine.py): an overload
+                                rejection, the queue depth at each batch
+                                formation, and one dispatched batch
+                                (rows/requests/tenants/replica/seconds)
   ``shard_start`` / ``shard_end`` / ``shard_lost``  elastic shard fits
                                 (elastic/scheduler.py): one worker's fit
                                 of one shard — lost means dropped from

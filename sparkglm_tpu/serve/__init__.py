@@ -17,20 +17,29 @@ against the fitted model in milliseconds, forever".  Three pieces:
     shared design signature, scored as mixed ``(tenant, x)`` batches in a
     single gather-score dispatch (with sticky A/B splits and shadow
     scoring in the same executable).
-  * :class:`~.batching.MicroBatcher` — bounded admission queue coalescing
-    concurrent requests into micro-batches under a latency budget
-    (``BatchPolicy``), with typed :class:`~..robust.retry.Overloaded`
-    backpressure and per-model p50/p99 latency + throughput metrics.
+  * :class:`~.async_engine.AsyncEngine` / :class:`~.async_engine.
+    ReplicatedScorer` — the scale-out pair: coefficient tables replicated
+    across the device mesh, fed by an asyncio continuous-batching
+    scheduler with per-tenant deficit-round-robin fairness, typed
+    :class:`~..robust.retry.Overloaded` backpressure, and an opt-in
+    reduced-precision tier (``precision="bf16"``).  Deploys/rollbacks
+    refresh replicas recompile-free (tables are runtime kernel args).
+  * :class:`~.batching.MicroBatcher` — the original micro-batching API,
+    now a thin compatibility shim over the engine (single tenant, single
+    replica): bounded admission coalescing requests under a latency
+    budget (``BatchPolicy``), same metrics, same contracts.
 
-Serving is numerics-NEUTRAL: a served prediction is bit-identical to
-``sg.predict`` on the same rows (PARITY.md; test-enforced across every
-padding bucket), because serving runs the same jitted kernel as offline
-scoring and every kernel output is row-local.
+Serving is numerics-NEUTRAL: a served prediction (default precision tier)
+is bit-identical to ``sg.predict`` on the same rows (PARITY.md;
+test-enforced across every padding bucket), because serving runs the same
+jitted kernel as offline scoring and every kernel output is row-local.
 """
 
+from .async_engine import AsyncEngine, EnginePolicy, ReplicatedScorer
 from .batching import BatchPolicy, MicroBatcher
 from .engine import FamilyScorer, Scorer, family_score_cache_size
 from .registry import ModelFamily, ModelRegistry
 
-__all__ = ["BatchPolicy", "FamilyScorer", "MicroBatcher", "ModelFamily",
-           "ModelRegistry", "Scorer", "family_score_cache_size"]
+__all__ = ["AsyncEngine", "BatchPolicy", "EnginePolicy", "FamilyScorer",
+           "MicroBatcher", "ModelFamily", "ModelRegistry",
+           "ReplicatedScorer", "Scorer", "family_score_cache_size"]

@@ -1,0 +1,863 @@
+"""Async replicated serving: continuous batching over a mesh-replicated
+scorer.
+
+``MicroBatcher`` (serve/batching.py) proved the serving contracts —
+coalescing is bit-neutral, backpressure is typed, errors deliver in order
+— but it is a synchronous single-device loop: one scoring thread, one
+device, one batch in flight.  This module is the scale-out half
+(ROADMAP.md "planet-scale serving"; the parallel-and-stream decomposition
+of arXiv 2111.00032 applied to the serve path: independent per-replica
+compute, cheap combine at the edge):
+
+:class:`ReplicatedScorer`
+    replicates a model's (or a whole :class:`~.registry.ModelFamily`'s)
+    coefficient tables onto every device of the mesh.  Tables are runtime
+    kernel ARGUMENTS (the PR-9 design), so replication, deploys and
+    rollbacks are all recompile-free: ``refresh()`` re-snapshots the
+    family when its generation counter moved and ``device_put``s the new
+    tables — same shapes, same executables, zero compiles.  Batches pack
+    into the same power-of-2 buckets as every other scorer, with donated
+    input buffers on backends that alias.  An opt-in reduced-precision
+    tier (``precision="bf16"``, config.resolve_serve_precision) trades a
+    documented max-abs-error bound (PARITY.md) for bf16 einsum operands;
+    the default tier stays bit-identical to host ``model.predict``.
+
+:class:`AsyncEngine`
+    an asyncio continuous-batching front end over that scorer.  Admission
+    is synchronous and typed — a full queue raises
+    :class:`~..robust.retry.Overloaded` exactly like ``MicroBatcher`` —
+    and admitted requests land in per-tenant FIFO queues.  A scheduler
+    coroutine forms a fresh batch the moment a replica frees up
+    (continuous batching: batch composition is decided at dispatch time,
+    not admission time), packing rows across tenants by DEFICIT ROUND-
+    ROBIN: each visit credits a tenant ``quantum`` rows and takes whole
+    requests while credit lasts, so a flooding tenant cannot starve a
+    light one — both make proportional progress at 2x capacity (test-
+    enforced).  Batches dispatch to free replicas through a thread pool
+    (one worker per replica), so every device scores concurrently.
+
+``MicroBatcher`` itself is now a thin compatibility shim over this engine
+(single tenant, single replica) — same API, same metric names, same
+behavioural contracts, one scheduler implementation.
+
+Observability: the engine feeds ``serve.<name>.latency_s`` /
+``rows_per_s`` / ``batches`` / ``batched_rows`` / ``overloaded`` (the
+MicroBatcher names) plus ``queue_depth`` and ``batch_rows`` histograms
+into its metrics registry, and emits ``admission`` (overload rejections),
+``queue_depth`` and ``batch`` trace events through the ambient tracer
+(obs/trace.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import jax
+import numpy as np
+
+from ..config import resolve_serve_precision
+from ..data.frame import as_columns
+from ..models.scoring import (donation_supported, predict_sharded,
+                              score_kernel_cache_size)
+from ..obs.trace import emit_ambient
+from ..robust.retry import Overloaded
+from .engine import (Scorer, _family_score_kernel,
+                     _family_score_kernel_donated, _next_bucket,
+                     family_score_cache_size)
+
+__all__ = ["AsyncEngine", "EnginePolicy", "ReplicatedScorer"]
+
+
+# ---------------------------------------------------------------------------
+# request coalescing helpers (moved here from batching.py; the shim re-uses
+# them through this module)
+# ---------------------------------------------------------------------------
+
+def _signature(data, offset) -> tuple:
+    """Only identically-shaped requests coalesce: same feature columns (or
+    same design width) and same explicit-offset-ness.  Model-side offset
+    recovery is per-column-name, hence covered by the column signature."""
+    if isinstance(data, np.ndarray):
+        return ("design", data.shape[1], offset is not None)
+    return ("cols",) + tuple(sorted(data)) + (offset is not None,)
+
+
+def _merge(batch):
+    """Concatenate member requests into one scoring call's input."""
+    first = batch[0]
+    if len(batch) == 1:
+        return first.data, first.offset
+    if isinstance(first.data, np.ndarray):
+        data = np.concatenate([r.data for r in batch], axis=0)
+    else:
+        data = {k: np.concatenate([np.asarray(r.data[k]) for r in batch])
+                for k in first.data}
+    off = (np.concatenate([np.asarray(r.offset, np.float64) for r in batch])
+           if first.offset is not None else None)
+    return data, off
+
+
+def _split(res, sizes):
+    """Slice a batch result back into per-request results (handles the
+    se_fit ``(fit, se)`` tuple shape)."""
+    edges = np.cumsum([0] + list(sizes))
+    if isinstance(res, tuple):
+        return [tuple(part[edges[i]:edges[i + 1]] for part in res)
+                for i in range(len(sizes))]
+    return [res[edges[i]:edges[i + 1]] for i in range(len(sizes))]
+
+
+# ---------------------------------------------------------------------------
+# ReplicatedScorer
+# ---------------------------------------------------------------------------
+
+class ReplicatedScorer:
+    """Coefficient tables replicated across the device mesh, one bucketed
+    executable family per replica.
+
+    ``target`` is either a :class:`~.registry.ModelFamily` (family mode:
+    mixed-tenant gather batches through the family kernel) or one fitted
+    model (model mode: the ``predict_sharded`` path — the executable
+    family host ``predict`` shares, which is what keeps default-tier
+    serving bit-identical to ``model.predict``).
+
+    Replication/refresh are recompile-free by construction: tables are
+    runtime kernel arguments, so ``refresh()`` after a family deploy or
+    rollback just ``device_put``s the new (T, p) snapshot to every
+    replica.  A changed tenant SET changes table shapes and honestly
+    recompiles (counted in ``compiles``).
+
+    A/B challenger and shadow tables are deliberately not replicated —
+    experiment traffic routes through :class:`~.engine.FamilyScorer`; the
+    replicated path serves the champion tier at maximum throughput.
+
+    Args:
+      target: a ``ModelFamily`` or a fitted model.
+      devices: the replica devices (default: every ``jax.devices()``).
+      type: "response" (GLM default) or "link".
+      se_fit: delta-method standard errors (model mode only).
+      min_bucket: smallest padding bucket (power-of-2 ladder).
+      precision: ``None``/"default" (bit-identical tier) or "bf16"
+        (reduced-precision eta; config.resolve_serve_precision).
+      donate: donate padded batch buffers on backends that alias.
+      metrics: ``obs.metrics.MetricsRegistry`` for per-scorer counters.
+      name: metric namespace; defaults to the family/model name.
+    """
+
+    def __init__(self, target, *, devices=None, type: str = "response",
+                 se_fit: bool = False, min_bucket: int = 8,
+                 precision: str | None = None, donate: bool = True,
+                 metrics=None, name: str | None = None):
+        if type not in ("link", "response"):
+            raise ValueError(
+                f"type must be 'link' or 'response', got {type!r}")
+        if min_bucket < 1:
+            raise ValueError(f"min_bucket must be >= 1, got {min_bucket}")
+        self.devices = (tuple(devices) if devices is not None
+                        else tuple(jax.devices()))
+        if not self.devices:
+            raise ValueError("need at least one replica device")
+        self.n_replicas = len(self.devices)
+        self.precision = resolve_serve_precision(precision)
+        self.type = type
+        self.min_bucket = int(min_bucket)
+        self.metrics = metrics
+        self._donate = bool(donate) and donation_supported()
+        self.family_mode = hasattr(target, "deployed_matrix")
+        self.compiles = 0
+        self.buckets = set()
+        self._warmed = set()        # (replica, bucket, flavor) fast paths
+        self._lock = threading.Lock()
+        if self.family_mode:
+            if se_fit:
+                raise ValueError(
+                    "se_fit is not supported for family serving (no "
+                    "per-tenant vcov table); serve a single model instead")
+            self.family = target
+            self.model = None
+            self.name = name if name is not None else target.name
+            self._link = target.link
+            self.generation = -1
+            self.refresh()
+        else:
+            self.family = None
+            self.model = target
+            if self.precision == "bf16" and se_fit:
+                raise ValueError("the bf16 tier has no se_fit variant")
+            # compose a Scorer for its design-construction contract (the
+            # sg.predict path: Terms transform + by-name offset recovery)
+            self._base = Scorer(target, type=type, se_fit=se_fit,
+                                donate=False, min_bucket=min_bucket)
+            self.name = name if name is not None else self._base.name
+            self.generation = 0
+            if self.precision == "bf16":
+                # bf16 model serving routes through the family kernel with
+                # a one-row table (tidx all zero)
+                B1 = np.nan_to_num(np.asarray(
+                    target.coefficients, np.float64))[None, :]
+                self._link = target.link if self._base.is_glm else None
+                self._tables = [jax.device_put(B1, d) for d in self.devices]
+
+    # -- family snapshot / refresh -------------------------------------------
+
+    def refresh(self) -> bool:
+        """Re-snapshot the family's deployed tables if its generation
+        moved since the last snapshot; ``device_put`` them to every
+        replica.  Same tenant set -> same shapes -> ZERO recompiles (the
+        engine calls this before every family batch).  Returns whether a
+        new snapshot was taken."""
+        if not self.family_mode:
+            return False
+        if self.family.generation() == self.generation:
+            return False
+        with self._lock:
+            gen = self.family.generation()
+            if gen == self.generation:
+                return False
+            tenants, B = self.family.deployed_matrix()
+            if getattr(self, "_B", None) is not None \
+                    and B.shape != self._B.shape:
+                self._warmed.clear()    # tenant set changed: new shapes
+            self.tenants = tenants
+            self._index = {t: i for i, t in enumerate(tenants)}
+            self._B = B
+            self._tables = [jax.device_put(B, d) for d in self.devices]
+            self.generation = gen
+        if self.metrics is not None:
+            self.metrics.counter(f"serve.{self.name}.refreshes").inc()
+        return True
+
+    def tenant_indices(self, tenants) -> np.ndarray:
+        """Resolve tenant labels to gather indices for the CURRENT
+        snapshot (the engine resolves at dispatch time, so a refresh
+        between admission and dispatch stays correct)."""
+        try:
+            return np.array([self._index[str(t)] for t in tenants],
+                            np.int32)
+        except KeyError as exc:
+            raise KeyError(
+                f"{exc.args[0]!r} is not a tenant of family "
+                f"{self.family.name!r}") from None
+
+    # -- scoring -------------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        if n < 1:
+            raise ValueError(f"request must have >= 1 row, got {n}")
+        return _next_bucket(n, self.min_bucket)
+
+    def _counted(self, key, size_fn, call):
+        """Run ``call``; on the first visit of (replica, bucket, flavor)
+        measure the executable-cache delta so ``compiles`` keeps the
+        steady-state-recompile contract per replica."""
+        if key in self._warmed:
+            return call()
+        with self._lock:
+            before = size_fn()
+            t0 = time.perf_counter()
+            out = call()
+            delta = size_fn() - before
+            if delta:
+                self.compiles += delta
+                emit_ambient("compile", target=f"serve:{self.name}",
+                             bucket=key[1],
+                             seconds=time.perf_counter() - t0)
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        f"serve.{self.name}.compiles").inc(delta)
+            self._warmed.add(key)
+        return out
+
+    def _family_call(self, Xp, tp, op, bucket, replica):
+        d = self.devices[replica]
+        kern = (_family_score_kernel_donated if self._donate
+                else _family_score_kernel)
+        B = self._tables[replica]
+        Xd = jax.device_put(Xp, d)
+        td = jax.device_put(tp, d)
+        ad = jax.device_put(np.zeros(bucket, bool), d)
+        od = jax.device_put(op, d)
+        fit, _ = kern(Xd, td, ad, B, B, B, od, link=self._link,
+                      type=self.type, shadow=False,
+                      precision=self.precision)
+        return fit
+
+    def score_family(self, tenants, X, *, offset=None, replica: int = 0):
+        """Score a mixed-tenant batch on one replica (family mode).
+
+        ``tenants``: per-row gather indices (np.int32, from
+        :meth:`tenant_indices`) or per-row tenant labels.  ``X``: (n, p)
+        design aligned to the family xnames.
+        """
+        if not self.family_mode:
+            raise RuntimeError(
+                "score_family() needs a ModelFamily target; this scorer "
+                "replicates a single model — use score()")
+        t0 = time.perf_counter()
+        X = np.asarray(X, np.float64)
+        if X.ndim != 2 or X.shape[1] != self._B.shape[1]:
+            raise ValueError(
+                f"design must be (n, {self._B.shape[1]}) aligned to the "
+                f"family columns; got shape {X.shape}")
+        n = X.shape[0]
+        tenants = np.asarray(tenants)
+        if tenants.shape[0] != n:
+            raise ValueError(
+                f"{tenants.shape[0]} tenant labels for {n} design rows")
+        tidx = (tenants.astype(np.int32)
+                if np.issubdtype(tenants.dtype, np.integer)
+                else self.tenant_indices(tenants))
+        off = (np.zeros(n) if offset is None
+               else np.asarray(offset, np.float64))
+        bucket = self.bucket_for(n)
+        pad = bucket - n
+        Xp = np.concatenate([X, np.zeros((pad, X.shape[1]))]) if pad else X
+        tp = np.concatenate([tidx, np.zeros(pad, np.int32)]) if pad else tidx
+        op = np.concatenate([off, np.zeros(pad)]) if pad else off
+        replica = int(replica) % self.n_replicas
+        fit = self._counted(
+            (replica, bucket, "family"), family_score_cache_size,
+            lambda: self._family_call(Xp, tp, op, bucket, replica))
+        out = np.asarray(fit)[:n]
+        self.buckets.add(bucket)
+        self._observe(n, time.perf_counter() - t0)
+        return out
+
+    def score(self, data, *, offset=None, replica: int = 0):
+        """Score one request on one replica (model mode) — default tier
+        results are bit-identical to ``model.predict`` (PARITY.md).
+
+        ``data``: dict of feature columns (training-``Terms`` transform,
+        fit-time by-name offset recovery) or an aligned (n, p) design.
+        """
+        if self.family_mode:
+            raise RuntimeError(
+                "score() needs a single-model target; this scorer "
+                "replicates a ModelFamily — use score_family()")
+        t0 = time.perf_counter()
+        X, offset = self._base._design(data, offset)
+        n = X.shape[0]
+        bucket = self.bucket_for(n)
+        replica = int(replica) % self.n_replicas
+        if self.precision == "bf16":
+            if not isinstance(X, np.ndarray):
+                raise ValueError(
+                    "the bf16 tier scores dense designs only; structured/"
+                    "sparse requests need the default precision tier")
+            X = np.asarray(X, np.float64)
+            off = (np.zeros(n) if offset is None
+                   else np.asarray(offset, np.float64))
+            pad = bucket - n
+            Xp = (np.concatenate([X, np.zeros((pad, X.shape[1]))])
+                  if pad else X)
+            tp = np.zeros(bucket, np.int32)
+            op = np.concatenate([off, np.zeros(pad)]) if pad else off
+            fit = self._counted(
+                (replica, bucket, "bf16"), family_score_cache_size,
+                lambda: self._family_call(Xp, tp, op, bucket, replica))
+            out = np.asarray(fit)[:n]
+        else:
+            out = self._counted(
+                (replica, bucket, offset is not None),
+                score_kernel_cache_size,
+                lambda: predict_sharded(
+                    X, self.model.coefficients, mesh=None, offset=offset,
+                    vcov=self._base._vcov, link=self._base._link,
+                    type=self.type if self._base.is_glm else "link",
+                    se_fit=self._base.se_fit, pad_to=bucket,
+                    donate=self._donate, device=self.devices[replica]))
+        self.buckets.add(bucket)
+        self._observe(n, time.perf_counter() - t0)
+        return out
+
+    def _observe(self, n, dt):
+        if self.metrics is not None:
+            self.metrics.counter(f"serve.{self.name}.requests").inc()
+            self.metrics.counter(f"serve.{self.name}.rows").inc(n)
+            self.metrics.histogram(f"serve.{self.name}.score_s").observe(dt)
+
+    def warmup(self, buckets=None) -> tuple[int, ...]:
+        """Pre-compile every (replica, bucket) executable — replicas
+        compile independently, so warmup cost scales with the mesh — then
+        reset ``compiles`` to 0: afterwards it reads "steady-state
+        recompiles since warmup", the number the scale-out bench asserts
+        is 0 across deploys and rollbacks."""
+        if buckets is None:
+            buckets, b = [], self.min_bucket
+            while b <= 1024:
+                buckets.append(b)
+                b <<= 1
+        done = []
+        for b in sorted(set(int(x) for x in buckets)):
+            for r in range(self.n_replicas):
+                if self.family_mode:
+                    p = self._B.shape[1]
+                    self._counted(
+                        (r, b, "family"), family_score_cache_size,
+                        lambda b=b, r=r: self._family_call(
+                            np.zeros((b, p)), np.zeros(b, np.int32),
+                            np.zeros(b), b, r))
+                elif self.precision == "bf16":
+                    p = self.model.n_params
+                    self._counted(
+                        (r, b, "bf16"), family_score_cache_size,
+                        lambda b=b, r=r: self._family_call(
+                            np.zeros((b, p)), np.zeros(b, np.int32),
+                            np.zeros(b), b, r))
+                else:
+                    p = self.model.n_params
+                    has_off = (getattr(self.model, "offset_col", None)
+                               is not None
+                               or getattr(self.model, "has_offset", False))
+                    off = np.zeros(1) if has_off else None
+                    self._counted(
+                        (r, b, has_off), score_kernel_cache_size,
+                        lambda b=b, r=r, off=off: predict_sharded(
+                            np.zeros((1, p)), self.model.coefficients,
+                            mesh=None, offset=off, vcov=self._base._vcov,
+                            link=self._base._link,
+                            type=self.type if self._base.is_glm else "link",
+                            se_fit=self._base.se_fit, pad_to=b,
+                            donate=self._donate,
+                            device=self.devices[r]))
+            self.buckets.add(b)
+            done.append(b)
+        self.compiles = 0
+        return tuple(done)
+
+
+# ---------------------------------------------------------------------------
+# AsyncEngine
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class EnginePolicy:
+    """Continuous-batching knobs.
+
+    ``max_batch``: row cap per dispatch (one kernel call); a single
+    request larger than this still runs, alone.  ``max_wait_ms``: how
+    long a freshly-admitted request may wait for company before a batch
+    MUST form (0 = dispatch the moment a replica frees up — continuous
+    batching proper; MicroBatcher compatibility maps ``max_delay_ms``
+    here).  ``max_queue``: admitted-request cap beyond which ``submit``
+    raises :class:`Overloaded`.  ``max_queue_rows``: optional admitted-ROW
+    cap (requests vary in size; this bounds memory).  ``quantum``: rows
+    credited per tenant per deficit-round-robin visit — the fairness
+    granularity."""
+
+    max_batch: int = 1024
+    max_wait_ms: float = 0.0
+    max_queue: int = 4096
+    max_queue_rows: int | None = None
+    quantum: int = 256
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_wait_ms < 0:
+            raise ValueError(
+                f"max_wait_ms must be >= 0, got {self.max_wait_ms}")
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.max_queue_rows is not None and self.max_queue_rows < 1:
+            raise ValueError(
+                f"max_queue_rows must be >= 1, got {self.max_queue_rows}")
+        if self.quantum < 1:
+            raise ValueError(f"quantum must be >= 1, got {self.quantum}")
+
+
+@dataclasses.dataclass
+class _Pending:
+    tenant: str
+    data: object          # (n, p) design (family) / design-or-columns (model)
+    offset: object
+    n: int
+    key: tuple            # coalescing signature
+    future: Future
+    t_submit: float
+
+
+_DEFAULT_TENANT = "_"
+
+
+class AsyncEngine:
+    """Asyncio continuous batching over a (replicated) scorer.
+
+    ``submit`` is thread-safe and synchronous: admission control runs in
+    the caller's thread (a full queue raises :class:`Overloaded` — typed,
+    transient, retryable) and returns a ``concurrent.futures.Future``.
+    ``asubmit`` is the awaitable twin for asyncio callers.  The scheduler
+    coroutine runs on a dedicated event-loop thread; batches form at
+    dispatch time under deficit round-robin and score on free replicas
+    through a one-worker-per-replica thread pool.
+
+    Works over a :class:`ReplicatedScorer` (family or model mode) or any
+    duck-typed scorer with ``score(data, *, offset=None)`` (one replica).
+
+    Use as a context manager or call ``close()``: pending requests drain
+    before the loop exits (MicroBatcher semantics).
+    """
+
+    def __init__(self, scorer, policy: EnginePolicy | None = None, *,
+                 metrics=None, name: str | None = None):
+        self.scorer = scorer
+        self.policy = policy if policy is not None else EnginePolicy()
+        self.metrics = (metrics if metrics is not None
+                        else getattr(scorer, "metrics", None))
+        self.name = name if name is not None else getattr(
+            scorer, "name", scorer.__class__.__name__)
+        self.family_mode = bool(getattr(scorer, "family_mode", False))
+        self.n_replicas = int(getattr(scorer, "n_replicas", 1))
+        self._routes_replica = isinstance(scorer, ReplicatedScorer)
+        self._lock = threading.Lock()
+        self._queues: dict[str, collections.deque] = {}
+        self._active: collections.deque[str] = collections.deque()
+        self._deficit: dict[str, int] = {}
+        self._queued_reqs = 0
+        self._queued_rows = 0
+        self._closed = False
+        self._inflight = 0            # loop-thread only
+        self._rows_done = 0           # worker threads, under _lock
+        self._t_first = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.n_replicas,
+            thread_name_prefix=f"serve-replica:{self.name}")
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run_loop, daemon=True,
+            name=f"async-engine:{self.name}")
+        self._thread.start()
+        self._started.wait()
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, data, *, tenant: str | None = None,
+               offset=None) -> Future:
+        """Admit one scoring request; returns its Future immediately.
+
+        Family mode: ``data`` is an (n, p) design aligned to the family
+        xnames and ``tenant`` is REQUIRED (one tenant per request — the
+        fairness unit; batches mix tenants).  Model mode: ``data`` is
+        column data or an aligned design, ``tenant`` is an optional
+        fairness key.
+
+        Raises :class:`Overloaded` when ``policy.max_queue`` requests (or
+        ``max_queue_rows`` rows) are already waiting, and ``RuntimeError``
+        after ``close()``.
+        """
+        if self.family_mode:
+            if tenant is None:
+                raise ValueError(
+                    "family serving needs tenant= on every request")
+            data = np.asarray(data, np.float64)
+            if data.ndim != 2:
+                raise ValueError(
+                    f"design requests must be 2-D, got shape {data.shape}")
+            n = data.shape[0]
+            key = ("family", data.shape[1], offset is not None)
+        else:
+            if isinstance(data, np.ndarray):
+                if data.ndim != 2:
+                    raise ValueError(
+                        f"design requests must be 2-D, got shape "
+                        f"{data.shape}")
+                n = data.shape[0]
+            else:
+                data = as_columns(data)
+                n = (len(np.asarray(next(iter(data.values()))))
+                     if data else 0)
+            key = _signature(data, offset)
+        if n < 1:
+            raise ValueError("request must have >= 1 row")
+        tenant = str(tenant) if tenant is not None else _DEFAULT_TENANT
+        req = _Pending(tenant=tenant, data=data, offset=offset, n=n,
+                       key=key, future=Future(),
+                       t_submit=time.perf_counter())
+        pol = self.policy
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(f"AsyncEngine {self.name!r} is closed")
+            if (self._queued_reqs >= pol.max_queue
+                    or (pol.max_queue_rows is not None
+                        and self._queued_rows + n > pol.max_queue_rows)):
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        f"serve.{self.name}.overloaded").inc()
+                emit_ambient("admission", engine=self.name, tenant=tenant,
+                             outcome="overloaded",
+                             queued_requests=self._queued_reqs,
+                             queued_rows=self._queued_rows)
+                raise Overloaded(
+                    f"serving queue for {self.name!r} is full "
+                    f"({self._queued_reqs} requests / {self._queued_rows} "
+                    "rows waiting); retry with backoff")
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = collections.deque()
+                self._active.append(tenant)
+                self._deficit.setdefault(tenant, 0)
+            q.append(req)
+            self._queued_reqs += 1
+            self._queued_rows += n
+        try:
+            self._loop.call_soon_threadsafe(self._notify)
+        except RuntimeError:
+            pass  # close() raced us; the drain loop already saw the request
+        return req.future
+
+    async def asubmit(self, data, *, tenant: str | None = None,
+                      offset=None):
+        """Awaitable ``submit`` for asyncio callers."""
+        return await asyncio.wrap_future(
+            self.submit(data, tenant=tenant, offset=offset))
+
+    def score(self, data, *, tenant: str | None = None, offset=None,
+              timeout: float | None = None):
+        """Blocking submit: the served result (or the served exception)."""
+        return self.submit(data, tenant=tenant,
+                           offset=offset).result(timeout)
+
+    def close(self) -> None:
+        """Drain pending requests, then stop the scheduler loop."""
+        with self._lock:
+            if self._closed:
+                if self._thread.is_alive():
+                    self._thread.join()
+                return
+            self._closed = True
+        self._loop.call_soon_threadsafe(self._notify)
+        self._thread.join()
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- scheduler (event-loop thread) ---------------------------------------
+
+    def _notify(self) -> None:
+        self._wake.set()
+
+    def _run_loop(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        self._wake = asyncio.Event()
+        self._free: asyncio.Queue = asyncio.Queue()
+        for r in range(self.n_replicas):
+            self._free.put_nowait(r)
+        self._started.set()
+        try:
+            self._loop.run_until_complete(self._scheduler())
+        finally:
+            self._loop.close()
+
+    async def _scheduler(self) -> None:
+        while True:
+            replica = await self._free.get()
+            while True:
+                action, val = self._next_action()
+                if action == "batch":
+                    self._inflight += 1
+                    asyncio.ensure_future(self._dispatch(replica, val))
+                    break
+                if action == "exit":
+                    return
+                self._wake.clear()
+                # re-check after clear: a submit between _next_action and
+                # clear() re-sets the event and we fall straight through
+                if action == "wait":
+                    try:
+                        await asyncio.wait_for(self._wake.wait(),
+                                               timeout=max(val, 1e-4))
+                    except asyncio.TimeoutError:
+                        pass
+                else:
+                    await self._wake.wait()
+
+    def _next_action(self):
+        """One scheduling decision: ('batch', payload) | ('wait', s) |
+        ('idle', None) | ('exit', None)."""
+        pol = self.policy
+        with self._lock:
+            if self._queued_reqs == 0:
+                if self._closed and self._inflight == 0:
+                    return "exit", None
+                return "idle", None
+            if not self._closed and pol.max_wait_ms > 0 \
+                    and self._queued_rows < pol.max_batch:
+                oldest = min(q[0].t_submit
+                             for q in self._queues.values() if q)
+                remaining = (oldest + pol.max_wait_ms / 1e3
+                             - time.perf_counter())
+                if remaining > 0:
+                    return "wait", remaining
+            batch = self._form_batch_locked()
+            if not batch:
+                return "idle", None   # defensive; force-take prevents this
+            return "batch", (batch, self._queued_reqs, self._queued_rows)
+
+    def _form_batch_locked(self):
+        """Deficit round-robin batch formation (caller holds the lock).
+
+        Each visited tenant earns ``quantum`` rows of credit and
+        contributes whole requests (per-tenant FIFO, never reordered)
+        while credit and batch row-room last; only same-signature
+        requests share a batch.  A tenant whose queue empties leaves the
+        rotation and forfeits its credit (classic DRR — no hoarding).
+        Rounds repeat until the batch fills or a full round adds nothing
+        — so a lone tenant still fills ``max_batch`` while contending
+        tenants split each batch ~proportionally.  If the FIRST round
+        yields nothing (every head over-credit or signature-incompatible),
+        the head of the longest-waiting tenant is force-taken so progress
+        is guaranteed.
+        """
+        pol = self.policy
+        batch, rows, key = [], 0, None
+        while rows < pol.max_batch:
+            progressed = False
+            for _ in range(len(self._active)):
+                t = self._active[0]
+                q = self._queues.get(t)
+                if not q:
+                    self._active.popleft()
+                    self._deficit.pop(t, None)
+                    self._queues.pop(t, None)
+                    continue
+                self._deficit[t] = self._deficit.get(t, 0) + pol.quantum
+                while q and rows < pol.max_batch:
+                    head = q[0]
+                    if key is not None and head.key != key:
+                        break
+                    if head.n > self._deficit[t]:
+                        break
+                    if batch and rows + head.n > pol.max_batch:
+                        break
+                    q.popleft()
+                    if key is None:
+                        key = head.key
+                    batch.append(head)
+                    rows += head.n
+                    progressed = True
+                    self._deficit[t] -= head.n
+                    self._queued_reqs -= 1
+                    self._queued_rows -= head.n
+                if not q:
+                    self._active.popleft()
+                    self._deficit.pop(t, None)
+                    self._queues.pop(t, None)
+                else:
+                    self._active.rotate(-1)
+                if rows >= pol.max_batch:
+                    break
+            if not progressed:
+                break
+        if not batch and self._queued_reqs:
+            # force-take the longest-waiting head: guarantees progress
+            # for requests larger than any accumulated quantum
+            t = min((t for t, q in self._queues.items() if q),
+                    key=lambda t: self._queues[t][0].t_submit)
+            q = self._queues[t]
+            head = q.popleft()
+            self._deficit[t] = 0
+            batch.append(head)
+            self._queued_reqs -= 1
+            self._queued_rows -= head.n
+            if not q:
+                if t in self._active:
+                    self._active.remove(t)
+                self._deficit.pop(t, None)
+                self._queues.pop(t, None)
+        return batch
+
+    async def _dispatch(self, replica, payload) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(
+                self._pool, self._run_batch, replica, payload)
+        finally:
+            self._inflight -= 1
+            self._free.put_nowait(replica)
+            self._wake.set()
+
+    # -- batch execution (replica worker threads) ----------------------------
+
+    def _run_batch(self, replica, payload) -> None:
+        batch, depth_reqs, depth_rows = payload
+        rows = sum(r.n for r in batch)
+        t0 = time.perf_counter()
+        try:
+            if self.family_mode:
+                self.scorer.refresh()
+                # resolve per request so an unknown tenant fails ITS
+                # future without poisoning the rest of the batch
+                idx, live = [], []
+                for r in batch:
+                    try:
+                        idx.append(int(
+                            self.scorer.tenant_indices([r.tenant])[0]))
+                        live.append(r)
+                    except KeyError as e:
+                        r.future.set_exception(e)
+                batch = live
+                if not batch:
+                    return
+                rows = sum(r.n for r in batch)
+                tidx = np.repeat(np.array(idx, np.int32),
+                                 [r.n for r in batch])
+                X = (np.concatenate([r.data for r in batch])
+                     if len(batch) > 1 else batch[0].data)
+                if batch[0].offset is not None:
+                    off = np.concatenate(
+                        [np.asarray(r.offset, np.float64) for r in batch])
+                else:
+                    off = None
+                res = self.scorer.score_family(tidx, X, offset=off,
+                                               replica=replica)
+            else:
+                data, off = _merge(batch)
+                if self._routes_replica:
+                    res = self.scorer.score(data, offset=off,
+                                            replica=replica)
+                else:
+                    res = self.scorer.score(data, offset=off)
+            parts = _split(res, [r.n for r in batch])
+        except BaseException as e:  # noqa: BLE001 — delivered, not swallowed
+            for r in batch:
+                r.future.set_exception(e)
+            return
+        now = time.perf_counter()
+        dt = now - t0
+        with self._lock:
+            if self._t_first is None:
+                self._t_first = now
+            self._rows_done += rows
+            done, t_first = self._rows_done, self._t_first
+        for r, part in zip(batch, parts):
+            r.future.set_result(part)
+            if self.metrics is not None:
+                self.metrics.histogram(
+                    f"serve.{self.name}.latency_s").observe(
+                        now - r.t_submit)
+        emit_ambient("queue_depth", engine=self.name,
+                     requests=depth_reqs, rows=depth_rows)
+        emit_ambient("batch", engine=self.name, rows=rows,
+                     requests=len(batch), replica=int(replica),
+                     tenants=len({r.tenant for r in batch}), seconds=dt)
+        if self.metrics is not None:
+            m = self.metrics
+            m.counter(f"serve.{self.name}.batches").inc()
+            m.counter(f"serve.{self.name}.batched_rows").inc(rows)
+            m.histogram(f"serve.{self.name}.batch_rows").observe(rows)
+            m.histogram(f"serve.{self.name}.queue_depth").observe(
+                depth_reqs)
+            elapsed = now - t_first
+            if elapsed > 0:
+                m.gauge(f"serve.{self.name}.rows_per_s").set(done / elapsed)

@@ -1,14 +1,16 @@
-"""Micro-batching with latency SLOs: coalesce concurrent scoring requests.
+"""Micro-batching with latency SLOs — now a thin shim over the async engine.
 
-One padded-bucket kernel call amortizes its dispatch overhead over every
-row in the batch, so serving throughput wants BIG calls while serving
-latency wants IMMEDIATE ones.  The :class:`MicroBatcher` sits between: a
-bounded admission queue feeds a single background scoring thread that
-coalesces compatible queued requests into one micro-batch, capped by
-``BatchPolicy.max_batch`` rows, waiting at most ``max_delay_ms`` past the
-first request's arrival — the classic latency/throughput knob pair.
+:class:`MicroBatcher` was the original serving front end: a bounded
+admission queue feeding ONE background scoring thread that coalesced
+compatible requests into padded-bucket kernel calls.  The continuous-
+batching engine (:mod:`.async_engine`) generalizes every part of that —
+per-tenant queues under deficit round-robin instead of one FIFO, a free-
+replica scheduler instead of one thread, batch formation at dispatch time
+instead of admission time — so the batcher is now a compatibility wrapper
+that maps its policy onto an :class:`~.async_engine.AsyncEngine` with one
+implicit tenant.  One scheduler implementation, two APIs.
 
-Correctness contracts (all test-enforced):
+The contracts callers (and tests) rely on are unchanged:
 
   * Coalescing is BIT-NEUTRAL: the training-``Terms`` transform and every
     kernel output are row-local, so scoring a concatenated batch and
@@ -16,34 +18,26 @@ Correctness contracts (all test-enforced):
     ``sg.predict``.  Only requests with the same column signature coalesce
     (same feature names, same offset-ness); mixed shapes just run in
     separate calls.
-  * In-order error propagation, the ``data/pipeline.py`` discipline: results
-    and failures are delivered to each request's future in admission order;
-    a failing micro-batch fails every member request (they shared the
-    call), later requests are unaffected.
+  * In-order error propagation: results and failures are delivered to
+    each request's future in admission order; a failing micro-batch fails
+    every member request (they shared the call), later requests are
+    unaffected.
   * Backpressure is TYPED: when the queue is full, ``submit`` raises
     :class:`~..robust.retry.Overloaded` — a ``TransientSourceError``
     subclass, so a client-side ``RetryPolicy`` classifies it transient and
     backs off, exactly like a flaky chunk source at fit time.
 
-Per-model SLO telemetry lands in ``obs.metrics``: a request-latency
-histogram (``serve.<name>.latency_s`` — submit to delivery, the number
-p50/p99 SLOs are written against), a throughput gauge
-(``serve.<name>.rows_per_s``), and counters for requests/rows/batches/
-overloads.
+Per-model SLO telemetry lands in ``obs.metrics`` under the same names as
+before (the engine emits them): a request-latency histogram
+(``serve.<name>.latency_s``), a throughput gauge
+(``serve.<name>.rows_per_s``), and counters for batches/rows/overloads.
 """
 
 from __future__ import annotations
 
-import collections
 import dataclasses
-import threading
-import time
-from concurrent.futures import Future
 
-import numpy as np
-
-from ..data.frame import as_columns
-from ..robust.retry import Overloaded
+from .async_engine import AsyncEngine, EnginePolicy
 
 __all__ = ["BatchPolicy", "MicroBatcher"]
 
@@ -72,57 +66,23 @@ class BatchPolicy:
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
 
-
-@dataclasses.dataclass
-class _Request:
-    data: object          # normalized columns dict, or an (n, p) design
-    offset: object        # explicit offset array or None
-    n: int
-    key: tuple            # coalescing signature
-    future: Future
-    t_submit: float
-
-
-def _signature(data, offset) -> tuple:
-    """Only identically-shaped requests coalesce: same feature columns (or
-    same design width) and same explicit-offset-ness.  Model-side offset
-    recovery is per-column-name, hence covered by the column signature."""
-    if isinstance(data, np.ndarray):
-        return ("design", data.shape[1], offset is not None)
-    return ("cols",) + tuple(sorted(data)) + (offset is not None,)
-
-
-def _merge(batch: list[_Request]):
-    """Concatenate member requests into one scoring call's input."""
-    first = batch[0]
-    if len(batch) == 1:
-        return first.data, first.offset
-    if isinstance(first.data, np.ndarray):
-        data = np.concatenate([r.data for r in batch], axis=0)
-    else:
-        data = {k: np.concatenate([np.asarray(r.data[k]) for r in batch])
-                for k in first.data}
-    off = (np.concatenate([np.asarray(r.offset, np.float64) for r in batch])
-           if first.offset is not None else None)
-    return data, off
-
-
-def _split(res, sizes: list[int]):
-    """Slice a batch result back into per-request results (handles the
-    se_fit ``(fit, se)`` tuple shape)."""
-    edges = np.cumsum([0] + sizes)
-    if isinstance(res, tuple):
-        return [tuple(part[edges[i]:edges[i + 1]] for part in res)
-                for i in range(len(sizes))]
-    return [res[edges[i]:edges[i + 1]] for i in range(len(sizes))]
+    def as_engine_policy(self) -> EnginePolicy:
+        """The equivalent continuous-batching policy: same row cap, same
+        hold-open window, same queue bound; fairness quantum is moot with
+        one implicit tenant."""
+        return EnginePolicy(max_batch=self.max_batch,
+                            max_wait_ms=self.max_delay_ms,
+                            max_queue=self.max_queue,
+                            quantum=self.max_batch)
 
 
 class MicroBatcher:
-    """Admission queue + single scoring thread over one :class:`Scorer`.
+    """Admission queue + micro-batch coalescing over one :class:`Scorer`
+    (an :class:`~.async_engine.AsyncEngine` with a single implicit tenant).
 
     ``submit`` returns a ``concurrent.futures.Future`` immediately;
     ``score`` is the blocking convenience.  Use as a context manager or
-    call ``close()`` — pending requests drain before the thread exits.
+    call ``close()`` — pending requests drain before the engine exits.
     """
 
     def __init__(self, scorer, policy: BatchPolicy | None = None, *,
@@ -131,63 +91,25 @@ class MicroBatcher:
         self.policy = policy if policy is not None else BatchPolicy()
         self.metrics = metrics if metrics is not None else scorer.metrics
         self.name = name if name is not None else scorer.name
-        self._q: collections.deque[_Request] = collections.deque()
-        self._lock = threading.Lock()
-        self._nonempty = threading.Condition(self._lock)
-        self._closed = False
-        self._rows_done = 0
-        self._t_first = None  # first delivery epoch, for the throughput gauge
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name=f"microbatch:{self.name}")
-        self._thread.start()
+        self._engine = AsyncEngine(scorer, self.policy.as_engine_policy(),
+                                   metrics=self.metrics, name=self.name)
 
-    # -- client side ---------------------------------------------------------
-
-    def submit(self, data, *, offset=None) -> Future:
+    def submit(self, data, *, offset=None):
         """Enqueue one scoring request; returns its Future.
 
         Raises :class:`Overloaded` (transient, retryable) when
         ``policy.max_queue`` requests are already waiting, and
         ``RuntimeError`` after ``close()``.
         """
-        if isinstance(data, np.ndarray):
-            if data.ndim != 2:
-                raise ValueError(
-                    f"design requests must be 2-D, got shape {data.shape}")
-            n = data.shape[0]
-        else:
-            data = as_columns(data)
-            n = len(np.asarray(next(iter(data.values())))) if data else 0
-        if n < 1:
-            raise ValueError("request must have >= 1 row")
-        req = _Request(data=data, offset=offset, n=n,
-                       key=_signature(data, offset), future=Future(),
-                       t_submit=time.perf_counter())
-        with self._nonempty:
-            if self._closed:
-                raise RuntimeError(f"MicroBatcher {self.name!r} is closed")
-            if len(self._q) >= self.policy.max_queue:
-                if self.metrics is not None:
-                    self.metrics.counter(
-                        f"serve.{self.name}.overloaded").inc()
-                raise Overloaded(
-                    f"serving queue for {self.name!r} is full "
-                    f"({self.policy.max_queue} requests waiting); retry "
-                    "with backoff")
-            self._q.append(req)
-            self._nonempty.notify()
-        return req.future
+        return self._engine.submit(data, offset=offset)
 
     def score(self, data, *, offset=None, timeout: float | None = None):
         """Blocking submit: the served result (or the served exception)."""
-        return self.submit(data, offset=offset).result(timeout)
+        return self._engine.score(data, offset=offset, timeout=timeout)
 
     def close(self) -> None:
-        """Drain pending requests, then stop the scoring thread."""
-        with self._nonempty:
-            self._closed = True
-            self._nonempty.notify_all()
-        self._thread.join()
+        """Drain pending requests, then stop the engine."""
+        self._engine.close()
 
     def __enter__(self):
         return self
@@ -195,64 +117,3 @@ class MicroBatcher:
     def __exit__(self, *exc):
         self.close()
         return False
-
-    # -- scoring thread ------------------------------------------------------
-
-    def _loop(self) -> None:
-        pol = self.policy
-        while True:
-            with self._nonempty:
-                while not self._q and not self._closed:
-                    self._nonempty.wait()
-                if not self._q:     # closed and drained
-                    return
-                first = self._q.popleft()
-                batch, rows = [first], first.n
-                deadline = first.t_submit + pol.max_delay_ms / 1e3
-                # coalesce: take compatible queued requests up to max_batch
-                # rows, waiting out the delay window while there is room;
-                # an incompatible head request ends the batch (order is
-                # preserved — we never skip past it)
-                while rows < pol.max_batch:
-                    if self._q:
-                        nxt = self._q[0]
-                        if (nxt.key != first.key
-                                or rows + nxt.n > pol.max_batch):
-                            break
-                        self._q.popleft()
-                        batch.append(nxt)
-                        rows += nxt.n
-                        continue
-                    remaining = deadline - time.perf_counter()
-                    if self._closed or remaining <= 0:
-                        break
-                    self._nonempty.wait(timeout=remaining)
-            self._run(batch, rows)
-
-    def _run(self, batch: list[_Request], rows: int) -> None:
-        try:
-            data, off = _merge(batch)
-            res = self.scorer.score(data, offset=off)
-            parts = _split(res, [r.n for r in batch])
-        except BaseException as e:  # noqa: BLE001 — delivered, not swallowed
-            # in-order failure delivery: every member shared the call
-            for r in batch:
-                r.future.set_exception(e)
-            return
-        now = time.perf_counter()
-        if self._t_first is None:
-            self._t_first = now
-        self._rows_done += rows
-        for r, part in zip(batch, parts):
-            r.future.set_result(part)
-            if self.metrics is not None:
-                self.metrics.histogram(
-                    f"serve.{self.name}.latency_s").observe(now - r.t_submit)
-        if self.metrics is not None:
-            self.metrics.counter(f"serve.{self.name}.batches").inc()
-            self.metrics.counter(
-                f"serve.{self.name}.batched_rows").inc(rows)
-            elapsed = now - self._t_first
-            if elapsed > 0:
-                self.metrics.gauge(f"serve.{self.name}.rows_per_s").set(
-                    self._rows_done / elapsed)

@@ -248,9 +248,8 @@ class Scorer:
 
 # -- family scoring: one dispatch for a mixed (tenant, x) batch ---------------
 
-@partial(jax.jit, static_argnames=("link", "type", "shadow"))
-def _family_score_kernel(X, tidx, arm, B, C, S, offset, *,
-                         link, type, shadow):
+def _family_score_fn(X, tidx, arm, B, C, S, offset, *,
+                     link, type, shadow, precision=None):
     """Gather-score a mixed-tenant request batch in one executable.
 
     ``B``/``C``/``S`` are stacked (T, p) coefficient tables (champion /
@@ -258,9 +257,22 @@ def _family_score_kernel(X, tidx, arm, B, C, S, offset, *,
     ``arm`` routes a row to the challenger table (A/B).  Every output is
     row-local, so bucket-padded trash rows are inert.  Tables are runtime
     ARGUMENTS — a family deploy/rollback swaps tables without recompiling.
+
+    ``precision="bf16"`` (config.resolve_serve_precision) casts the eta
+    einsum operands to bfloat16 with f32 accumulation — the opt-in
+    reduced-precision serving tier (serve/async_engine.py; error bound in
+    PARITY.md).  The default (None) einsum is untouched: that is the tier
+    whose results are asserted bit-identical to offline scoring.
     """
     rows = jnp.where(arm[:, None], C[tidx], B[tidx])
-    eta = jnp.einsum("np,np->n", X, rows) + offset
+
+    def eta_of(r):
+        if precision == "bf16":
+            e = jnp.einsum("np,np->n", X.astype(jnp.bfloat16),
+                           r.astype(jnp.bfloat16),
+                           preferred_element_type=jnp.float32)
+            return e.astype(X.dtype) + offset
+        return jnp.einsum("np,np->n", X, r) + offset
 
     def out(e):
         if type == "response" and link is not None:
@@ -269,15 +281,26 @@ def _family_score_kernel(X, tidx, arm, B, C, S, offset, *,
         return e
 
     if shadow:
-        eta_s = jnp.einsum("np,np->n", X, S[tidx]) + offset
-        return out(eta), out(eta_s)
-    return out(eta), None
+        return out(eta_of(rows)), out(eta_of(S[tidx]))
+    return out(eta_of(rows)), None
+
+
+_FAMILY_STATICS = ("link", "type", "shadow", "precision")
+_family_score_kernel = partial(
+    jax.jit, static_argnames=_FAMILY_STATICS)(_family_score_fn)
+# the replicated-serving steady-state variant: the padded batch buffer is
+# built fresh per dispatch, so XLA may alias it with the output on backends
+# that support donation (same HLO, same values — see models/scoring.py's
+# donated twin; CPU callers gate on donation_supported()).
+_family_score_kernel_donated = jax.jit(
+    _family_score_fn, static_argnames=_FAMILY_STATICS, donate_argnums=(0,))
 
 
 def family_score_cache_size() -> int:
-    """Executables held by the family scoring kernel (compile-contract
-    tests and bench.py count deltas of this)."""
-    return int(_family_score_kernel._cache_size())
+    """Executables held across both family-kernel variants (compile-
+    contract tests and bench.py count deltas of this)."""
+    return int(_family_score_kernel._cache_size()
+               + _family_score_kernel_donated._cache_size())
 
 
 class FamilyScorer:

@@ -198,6 +198,9 @@ class ModelFamily:
         self._lock = threading.RLock()
         self._entries: dict[str, _Entry] = {}
         self._scorers: dict[tuple, FamilyScorer] = {}
+        # replicated scorers are generation-FOLLOWING (refresh() re-snapshots
+        # recompile-free), so unlike _scorers they survive deploys
+        self._replicated: dict[tuple, object] = {}
         self._generation = 0
         self.name = str(name)
         self.metrics = metrics
@@ -387,6 +390,36 @@ class ModelFamily:
                 sc = FamilyScorer(self, metrics=metrics, **kwargs)
                 self._scorers[key] = sc
             return sc
+
+    def replicated_scorer(self, **kwargs):
+        """A :class:`~.async_engine.ReplicatedScorer` over this family,
+        cached per options only — NOT per generation: replicated scorers
+        follow deploys/rollbacks by ``refresh()`` (a recompile-free table
+        re-snapshot), so the same instance (and its warm per-replica
+        executables) serves across generations.  ``kwargs`` go to
+        :class:`ReplicatedScorer` (``devices=``, ``precision=``, ...)."""
+        from .async_engine import ReplicatedScorer
+        with self._lock:
+            metrics = kwargs.pop("metrics", self.metrics)
+            key = tuple(sorted((k, _freeze(v)) for k, v in kwargs.items()))
+            sc = self._replicated.get(key)
+        if sc is None:
+            # construct outside the lock: the first snapshot device_puts
+            # tables to every replica
+            sc = ReplicatedScorer(self, metrics=metrics, **kwargs)
+            with self._lock:
+                sc = self._replicated.setdefault(key, sc)
+        sc.refresh()
+        return sc
+
+    def async_engine(self, policy=None, **kwargs):
+        """A fresh :class:`~.async_engine.AsyncEngine` over this family's
+        :meth:`replicated_scorer` (``kwargs`` select/configure it).  The
+        caller owns the engine's lifecycle — use as a context manager or
+        ``close()`` it; the underlying scorer stays cached here."""
+        from .async_engine import AsyncEngine
+        return AsyncEngine(self.replicated_scorer(**kwargs), policy,
+                           metrics=self.metrics, name=self.name)
 
     # -- persistence ---------------------------------------------------------
 

@@ -54,6 +54,9 @@ def pytest_configure(config):
         "markers", "fleet: batched per-segment fleet fitting + model-"
         "family serving (`make fleet` selects these; still tier-1 by "
         "default)")
+    config.addinivalue_line(
+        "markers", "asyncio: the async replicated serving engine "
+        "(`make serve_async` selects these; still tier-1 by default)")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,330 @@
+"""Async replicated serving (sparkglm_tpu/serve/async_engine.py).
+
+The contracts under test:
+
+  * backpressure stays TYPED under synthetic overload (Overloaded is a
+    TransientSourceError a RetryPolicy classifies transient);
+  * per-tenant deficit round-robin: a tenant flooding at well over
+    capacity cannot starve a light tenant — the light tenant's requests
+    ride the first few batches;
+  * family deploy/rollback under live load are RECOMPILE-FREE (tables are
+    runtime kernel args; refresh() re-snapshots, same shapes, same
+    executables);
+  * the default precision tier serves scores f64 BIT-identical to
+    ``sg.predict`` (the engine is numerics-neutral, like every serving
+    layer before it), and the bf16 tier's eta error respects the
+    documented bound (PARITY.md).
+"""
+
+import asyncio
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.fleet import fit_many
+from sparkglm_tpu.obs.metrics import MetricsRegistry
+from sparkglm_tpu.robust import Overloaded, RetryPolicy, TransientSourceError
+from sparkglm_tpu.serve import (AsyncEngine, EnginePolicy, ModelFamily,
+                                ReplicatedScorer, family_score_cache_size)
+
+pytestmark = pytest.mark.asyncio
+
+
+def _segments(rng, sizes, p=3):
+    groups, Xr, yr = [], [], []
+    for g, size in enumerate(sizes):
+        X = np.column_stack([np.ones(size), rng.normal(size=(size, p - 1))])
+        beta = rng.normal(size=p) * (0.3 + 0.9 * g)
+        y = (rng.random(size) < 1 / (1 + np.exp(-(X @ beta)))).astype(float)
+        groups += [f"g{g}"] * size
+        Xr.append(X)
+        yr.append(y)
+    return np.array(groups), np.vstack(Xr), np.concatenate(yr)
+
+
+@pytest.fixture()
+def family(rng):
+    groups, X, y = _segments(rng, [200, 150, 180])
+    fleet = fit_many(y, X, groups=groups, family="binomial",
+                     has_intercept=True)
+    return fleet, ModelFamily.from_fleet(fleet, "churn")
+
+
+# ---------------------------------------------------------------------------
+# backpressure + policy validation
+# ---------------------------------------------------------------------------
+
+class _BlockingScorer:
+    """Duck scorer whose score() parks until released — makes the
+    queue-full path deterministic (single implicit replica)."""
+
+    metrics = None
+    name = "blocked"
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def score(self, data, *, offset=None):
+        self.entered.set()
+        assert self.release.wait(10)
+        return np.zeros(data.shape[0])
+
+
+def test_engine_overload_typed_and_transient():
+    bs = _BlockingScorer()
+    met = MetricsRegistry()
+    eng = AsyncEngine(bs, EnginePolicy(max_queue=2, max_wait_ms=0),
+                      metrics=met, name="blocked")
+    try:
+        first = eng.submit(np.zeros((1, 2)))    # replica takes it, parks
+        assert bs.entered.wait(10)
+        held = [eng.submit(np.zeros((1, 2))) for _ in range(2)]
+        with pytest.raises(Overloaded) as ei:
+            eng.submit(np.zeros((1, 2)))
+        assert isinstance(ei.value, TransientSourceError)
+        assert RetryPolicy().is_transient(ei.value)
+        assert met.snapshot()["counters"]["serve.blocked.overloaded"] == 1
+    finally:
+        bs.release.set()
+        eng.close()
+    for f in [first] + held:
+        assert f.result(10) is not None
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(np.zeros((1, 2)))
+
+
+def test_engine_row_cap_overload():
+    bs = _BlockingScorer()
+    eng = AsyncEngine(bs, EnginePolicy(max_queue=100, max_queue_rows=10,
+                                       max_wait_ms=0), name="blocked")
+    try:
+        first = eng.submit(np.zeros((1, 2)))
+        assert bs.entered.wait(10)
+        held = eng.submit(np.zeros((10, 2)))   # fills the row budget
+        with pytest.raises(Overloaded):
+            eng.submit(np.zeros((1, 2)))
+    finally:
+        bs.release.set()
+        eng.close()
+    assert first.result(10) is not None and held.result(10) is not None
+
+
+def test_engine_policy_validation():
+    with pytest.raises(ValueError, match="max_batch"):
+        EnginePolicy(max_batch=0)
+    with pytest.raises(ValueError, match="max_wait_ms"):
+        EnginePolicy(max_wait_ms=-1)
+    with pytest.raises(ValueError, match="max_queue"):
+        EnginePolicy(max_queue=0)
+    with pytest.raises(ValueError, match="max_queue_rows"):
+        EnginePolicy(max_queue_rows=0)
+    with pytest.raises(ValueError, match="quantum"):
+        EnginePolicy(quantum=0)
+
+
+# ---------------------------------------------------------------------------
+# fairness: deficit round-robin under a flooding tenant
+# ---------------------------------------------------------------------------
+
+class _StepFamilyScorer:
+    """Family-duck scorer that blocks each batch on a semaphore and records
+    per-tenant row counts — lets the test step batches one by one while
+    the admission queue holds everything."""
+
+    family_mode = True
+    n_replicas = 1
+    metrics = None
+    name = "step"
+
+    def __init__(self):
+        self.step = threading.Semaphore(0)
+        self.entered = threading.Event()
+        self.batches = []
+
+    def refresh(self):
+        return False
+
+    def tenant_indices(self, tenants):
+        return np.array([{"A": 0, "B": 1}[t] for t in tenants], np.int32)
+
+    def score_family(self, tidx, X, *, offset=None, replica=0):
+        self.entered.set()
+        assert self.step.acquire(timeout=10)
+        self.batches.append(np.bincount(tidx, minlength=2))
+        return np.zeros(len(tidx))
+
+
+def test_tenant_fairness_no_starvation():
+    """Tenant A floods 10x tenant B's traffic; DRR still serves B's whole
+    queue within the first few batches instead of after A drains."""
+    sc = _StepFamilyScorer()
+    eng = AsyncEngine(sc, EnginePolicy(max_batch=8, quantum=4,
+                                       max_queue=1000, max_wait_ms=0))
+    try:
+        plug = eng.submit(np.zeros((1, 2)), tenant="A")  # occupies replica
+        assert sc.entered.wait(10)
+        a = [eng.submit(np.zeros((2, 2)), tenant="A") for _ in range(40)]
+        b = [eng.submit(np.zeros((2, 2)), tenant="B") for _ in range(4)]
+        for _ in range(1 + 40 + 4):     # over-release; spare permits inert
+            sc.step.release()
+        for f in [plug] + a + b:
+            assert f.result(20) is not None
+    finally:
+        eng.close()
+    last_a = max(i for i, c in enumerate(sc.batches) if c[0])
+    last_b = max(i for i, c in enumerate(sc.batches) if c[1])
+    assert last_b < last_a, "flooded tenant finished before the light one"
+    assert last_b <= 3, f"light tenant starved until batch {last_b}"
+    total = np.sum(sc.batches, axis=0)
+    assert total[0] == 81 and total[1] == 8  # every row served exactly once
+
+
+def test_unknown_tenant_fails_alone(family):
+    _, fam = family
+    rsc = fam.replicated_scorer(type="link", devices=jax.devices()[:1])
+    with AsyncEngine(rsc, EnginePolicy(max_wait_ms=5)) as eng:
+        X = np.column_stack([np.ones(4), np.zeros((4, 2))])
+        good = eng.submit(X, tenant="g0")
+        bad = eng.submit(X, tenant="nope")
+        assert good.result(10) is not None
+        with pytest.raises(KeyError, match="nope"):
+            bad.result(10)
+    # family serving requires a tenant on every request
+    with AsyncEngine(rsc) as eng2:
+        with pytest.raises(ValueError, match="tenant"):
+            eng2.submit(np.zeros((4, 3)))
+
+
+# ---------------------------------------------------------------------------
+# deploy/rollback under live load: recompile-free
+# ---------------------------------------------------------------------------
+
+def test_family_deploy_rollback_mid_load_recompile_free(family, rng):
+    fleet, fam = family
+    rsc = fam.replicated_scorer(type="link", devices=jax.devices()[:2],
+                                min_bucket=8)
+    # cover every bucket a coalesced batch of the phase loads can land in
+    rsc.warmup(buckets=(8, 16, 32, 64, 128))
+    assert rsc.compiles == 0
+    base = family_score_cache_size()
+    X = np.column_stack([np.ones(5), rng.normal(size=(5, 2))])
+    with AsyncEngine(rsc, EnginePolicy(max_wait_ms=2)) as eng:
+        # phase 1: champion serves v1 on every tenant, both replicas busy
+        futs = [eng.submit(X, tenant=t) for t in ("g0", "g1", "g2") * 4]
+        for i, f in enumerate(futs):
+            np.testing.assert_allclose(
+                f.result(10), fleet.predict(X, ("g0", "g1", "g2")[i % 3]),
+                rtol=1e-12)
+        # deploy v2 for g0 (fleet[1] IS g1's model) while the engine is up
+        fam.register("g0", fleet[1], deploy=True)
+        f2 = eng.submit(X, tenant="g0")
+        np.testing.assert_allclose(f2.result(10), fleet.predict(X, "g1"),
+                                   rtol=1e-12)
+        # rollback restores v1, still mid-load
+        fam.rollback("g0")
+        f3 = eng.submit(X, tenant="g0")
+        np.testing.assert_allclose(f3.result(10), fleet.predict(X, "g0"),
+                                   rtol=1e-12)
+    assert family_score_cache_size() - base == 0, \
+        "deploy/rollback must not recompile (tables are runtime args)"
+    assert rsc.compiles == 0
+    # the family-side cache returns the SAME generation-following scorer
+    assert fam.replicated_scorer(type="link", devices=jax.devices()[:2],
+                                 min_bucket=8) is rsc
+
+
+# ---------------------------------------------------------------------------
+# precision tiers
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def poisson_offset_model(rng):
+    n = 600
+    x = rng.standard_normal(n)
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    lt = rng.uniform(0.1, 0.9, n)
+    y = rng.poisson(np.exp(0.4 + 0.5 * x + 0.6 * (g == "b") + lt))
+    d = {"y": y.astype(float), "x": x, "g": g, "lt": lt}
+    return sg.glm("y ~ x + g + offset(lt)", d, family="poisson"), d
+
+
+def _newdata(rng, d, size):
+    idx = rng.integers(0, len(next(iter(d.values()))), size)
+    return {k: np.asarray(v)[idx] for k, v in d.items()}
+
+
+def test_async_default_tier_bit_identical_to_predict(
+        poisson_offset_model, rng):
+    """f64 scores served through the async engine == sg.predict, bit for
+    bit — including the fit-time by-name offset recovery."""
+    m, d = poisson_offset_model
+    rsc = ReplicatedScorer(m, devices=[jax.devices()[0]], min_bucket=8)
+    rsc.warmup(buckets=(8, 16, 32, 64, 128))
+    with AsyncEngine(rsc, EnginePolicy(max_wait_ms=5)) as eng:
+        wants, futs = [], []
+        for i in range(12):
+            new = _newdata(rng, d, (i % 9) + 1)
+            wants.append(sg.predict(m, new))
+            futs.append(eng.submit(new))
+        for want, fut in zip(wants, futs):
+            np.testing.assert_array_equal(fut.result(10), want)
+    assert rsc.compiles == 0
+
+
+def test_bf16_tier_bounded_error(poisson_offset_model, rng):
+    """The opt-in bf16 tier: eta error within the documented PARITY bound
+    (~2^-7 of the row's absolute-sum inner product); the default tier is
+    untouched.  Both run the SAME bucketed executables shape-wise."""
+    m, d = poisson_offset_model
+    new = _newdata(rng, d, 50)
+    exact = ReplicatedScorer(m, devices=[jax.devices()[0]],
+                             type="link").score(new)
+    fast = ReplicatedScorer(m, devices=[jax.devices()[0]], type="link",
+                            precision="bf16").score(new)
+    X = np.asarray(sg.transform(new, m.terms), np.float64)
+    bound = 2.0 ** -6 * np.max(
+        np.abs(X) @ np.abs(np.nan_to_num(m.coefficients)))
+    err = np.max(np.abs(fast - exact))
+    assert err <= max(bound, 1e-12), (err, bound)
+    with pytest.raises(ValueError, match="precision"):
+        ReplicatedScorer(m, precision="fp8")
+
+
+# ---------------------------------------------------------------------------
+# asyncio front door
+# ---------------------------------------------------------------------------
+
+def test_asubmit_from_event_loop(poisson_offset_model, rng):
+    m, d = poisson_offset_model
+    rsc = ReplicatedScorer(m, devices=[jax.devices()[0]])
+    news = [_newdata(rng, d, 5) for _ in range(6)]
+    wants = [sg.predict(m, new) for new in news]
+
+    async def drive(eng):
+        return await asyncio.gather(
+            *[eng.asubmit(new) for new in news])
+
+    with AsyncEngine(rsc, EnginePolicy(max_wait_ms=5)) as eng:
+        got = asyncio.run(drive(eng))
+    for want, out in zip(wants, got):
+        np.testing.assert_array_equal(out, want)
+
+
+def test_blocking_score_and_latency_metrics(poisson_offset_model, rng):
+    m, d = poisson_offset_model
+    met = MetricsRegistry()
+    rsc = ReplicatedScorer(m, devices=[jax.devices()[0]], metrics=met,
+                           name="traffic")
+    with AsyncEngine(rsc, metrics=met, name="traffic") as eng:
+        new = _newdata(rng, d, 7)
+        np.testing.assert_array_equal(eng.score(new), sg.predict(m, new))
+    snap = met.snapshot()
+    assert snap["histograms"]["serve.traffic.latency_s"]["count"] == 1
+    assert snap["counters"]["serve.traffic.batches"] == 1
+    assert snap["counters"]["serve.traffic.batched_rows"] == 7
+    assert snap["histograms"]["serve.traffic.queue_depth"]["count"] == 1

@@ -391,3 +391,79 @@ def test_sequential_fit_has_no_pipeline_events(rng):
     kinds = set(ring.kinds())
     assert "queue_wait" not in kinds and "prefetch_depth" not in kinds
     assert m.fit_report()["overlap_ratio"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# auto-degrade: the pipeline A/B-tests itself against its sequential probe
+# ---------------------------------------------------------------------------
+
+def test_auto_degrade_when_overlap_does_not_pay():
+    """Produce-dominated stream with nothing to overlap (zero consumer
+    compute): pipelining cannot beat sequential, so after the probe the
+    producer hands its iterator back and the pass finishes sequentially."""
+    def make_iter():
+        for i in range(6):
+            time.sleep(0.15)
+            yield i
+
+    stats = pipeline.PassStats()
+    got = list(pipeline.prefetch_iter(make_iter, prefetch=2, stats=stats))
+    assert got == list(range(6))
+    assert stats.degraded
+    assert stats.items == 6
+    assert stats.produce_s > 0.8  # every item's production was timed
+
+
+def test_no_degrade_when_overlap_pays():
+    """Balanced produce/compute: the pipelined rate is ~2x sequential, so
+    the pass keeps its producer thread."""
+    def make_iter():
+        for i in range(6):
+            time.sleep(0.15)
+            yield i
+
+    stats = pipeline.PassStats()
+    got = []
+    for item in pipeline.prefetch_iter(make_iter, prefetch=2, stats=stats):
+        got.append(item)
+        time.sleep(0.15)  # consumer compute the producer can hide under
+    assert got == list(range(6))
+    assert not stats.degraded
+
+
+def test_auto_degrade_off_pipelines_unconditionally():
+    def make_iter():
+        for i in range(6):
+            time.sleep(0.12)
+            yield i
+
+    stats = pipeline.PassStats()
+    got = list(pipeline.prefetch_iter(make_iter, prefetch=2, stats=stats,
+                                      auto_degrade=False))
+    assert got == list(range(6))
+    assert not stats.degraded
+
+
+def test_fast_streams_never_degrade():
+    """Sub-_PROBE_MIN_S streams take no degrade decision (deterministic
+    event sequences for the comparison tests stay intact)."""
+    stats = pipeline.PassStats()
+    got = list(pipeline.prefetch_iter(lambda: iter(range(50)), prefetch=3,
+                                      stats=stats))
+    assert got == list(range(50))
+    assert not stats.degraded
+
+
+def test_degraded_pass_emits_prefetch_degraded_event():
+    """Streaming surfaces PassStats.degraded as a prefetch_degraded trace
+    event right before the queue_wait/prefetch_depth pair, and
+    fit_report()'s event_counts picks it up with no aggregate changes."""
+    ring, tracer = _ring_tracer()
+    stats = pipeline.PassStats()
+    stats.items, stats.produce_s, stats.degraded = 7, 1.25, True
+    streaming._emit_pipeline_events(tracer, stats, label="pass", index=0)
+    assert ring.kinds() == ["prefetch_degraded", "queue_wait",
+                            "prefetch_depth"]
+    ev = ring.events[0]
+    assert ev.fields["items"] == 7 and ev.fields["label"] == "pass"
+    assert tracer.report()["event_counts"]["prefetch_degraded"] == 1
