@@ -8,7 +8,7 @@ CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve serve_async \
-        categorical penalized elastic sketch fleet clean
+        categorical penalized elastic sketch fleet hotloop clean
 
 all: native
 
@@ -87,6 +87,17 @@ sketch:
 # the fleet_fit bench block (fleet vs K sequential solo fits s/model)
 fleet:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fleet
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# resident IRLS hot loop (sparkglm_tpu/ops/fused.py v2 + ops/autotune.py):
+# fused-v2 vs einsum f64 bit-identity of coefficients AND iteration counts,
+# the engine="auto" autotuner selection contract, the bf16-schedule bound —
+# plus the hotloop_mfu bench block (engine sweep einsum vs fused-v2 vs
+# fused-v2-bf16: marginal MFU on TPU, s/iter + coef parity on the CPU
+# fallback, iteration-count equality either way)
+hotloop:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fused.py \
+		tests/test_fused_v2_parity.py -q
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:

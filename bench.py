@@ -118,7 +118,7 @@ def main() -> None:
     # single source of truth for the round tag is the caller
     # (benchmarks/tpu_when_alive.sh exports ROUND); default matches its
     # current value so a bare `python bench.py` is still correctly stamped
-    detail["round"] = int(os.environ.get("ROUND", "5"))
+    detail["round"] = int(os.environ.get("ROUND", "12"))
 
     def make_data(nn):
         @jax.jit
@@ -144,16 +144,18 @@ def main() -> None:
 
         def run():
             if engine == "fused":
-                # the single-HBM-pass Pallas kernel (explicit engine since
-                # r5; auto reverted to einsum on the marginal record)
+                # the single-HBM-pass v2 kernel (solve-then-pass driver:
+                # deviance of the UPDATED beta measured inside the same
+                # pass, so its iteration trajectory matches einsum exactly)
                 out = _irls_fused_kernel(
                     *data, jnp.float32(tol), jnp.int32(max_iter),
                     jnp.float32(0.0), **kw)
             elif engine == "fused_bf16":
-                # the r4 mixed-precision schedule (config.bf16_warmup):
-                # bf16 master-copy passes to the 1e-4 switch tol, then f32
-                # warm-started to the fixed point — timed END TO END
-                # including the on-device bf16 cast
+                # the mixed-precision schedule (config.precision_schedule —
+                # the default TPU schedule since r12): bf16 master-copy
+                # passes to the 1e-4 switch tol, then f32 warm-started to
+                # the fixed point — timed END TO END including the
+                # on-device bf16 cast
                 Xb = _cast_bf16(data[0])
                 out1 = _irls_fused_kernel(
                     Xb, data[1], data[2], data[3],
@@ -178,8 +180,9 @@ def main() -> None:
         return min(times), times, out
 
     # ---- headline run: both engines; the winner is the smaller TOTAL
-    # time-to-convergence (the reported metric — the fused kernel's lagged
-    # deviance can cost one extra iteration, which s/iter would hide) -----
+    # time-to-convergence (the reported metric; since v2 every engine runs
+    # the same iteration count, so this now only ranks s/iter — kept as
+    # TOTAL so a regression in trajectory parity would show up here) -----
     data = make_data(n)
     engines = ("fused", "fused_bf16", "einsum") if on_tpu else ("einsum",)
     best = None
@@ -262,6 +265,52 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             detail["marginal_error"] = str(e)[:200]
             print(f"bench: marginal measurement failed: {e}", file=sys.stderr)
+
+    # ---- hotloop_mfu (r12): the v2 engine sweep ---------------------------
+    # einsum vs fused-v2 vs fused-v2-bf16 at the headline shape, one record.
+    # The v2 driver measures the deviance of every UPDATED beta inside its
+    # single pass, so the sweep also CHECKS the no-extra-iteration claim:
+    # fused must converge in exactly einsum's iteration count at the same
+    # tol (iteration_parity; the bf16 schedule may legitimately spend extra
+    # warm-up iterations — its combined count is recorded, not gated).  On
+    # TPU each engine carries its dispatch-cancelled marginal MFU
+    # (acceptance: fused >= 0.75 at the 10Mx1000 per-chip share, recorded
+    # under headline_share_10Mx1000); the CPU fallback has no honest MFU
+    # denominator (V5E_PEAK names TPU silicon) — it records s/iter and
+    # coefficient parity instead, so tier-1 still exercises the sweep.
+    try:
+        sweep: dict = {}
+        iters_seen: dict = {}
+        beta_ref = None
+        for eng in ("einsum", "fused", "fused_bf16"):
+            t_s, _, out_s = time_irls(data, engine=eng)
+            it_s = max(1, int(out_s["iters"]))
+            rec = dict(seconds=round(t_s, 4), iters=int(out_s["iters"]),
+                       s_per_iter=round(t_s / it_s, 5))
+            if on_tpu:
+                rec["marginal"] = marginal_record(
+                    data, eng, flops_iter, V5E_PEAK_BF16 * n_chips)
+            b_s = np.asarray(out_s["beta"])
+            if eng == "einsum":
+                beta_ref = b_s
+            else:
+                rec["coef_maxdiff_vs_einsum"] = float(
+                    np.max(np.abs(b_s - beta_ref)))
+            iters_seen[eng] = int(out_s["iters"])
+            sweep[eng] = rec
+        iter_parity = iters_seen.get("fused") == iters_seen.get("einsum")
+        detail["hotloop_mfu"] = dict(
+            n=n, p=p, engines=sweep,
+            iteration_parity=bool(iter_parity),
+            ok=bool(iter_parity
+                    and sweep["fused"].get("coef_maxdiff_vs_einsum",
+                                           float("inf")) < 1e-4),
+            note=("marginal MFU per engine" if on_tpu else
+                  "CPU fallback: s/iter + coefficient parity; MFU needs "
+                  "the TPU peak this host does not have"))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["hotloop_mfu"] = dict(ok=False, error=repr(e)[:300])
+        print(f"bench: hotloop_mfu sweep failed: {e}", file=sys.stderr)
 
     # ---- the 10M x 1000 x v5e-8 estimate: MEASURE the per-chip share ------
     # 10M rows over 8 chips is 1.25M rows/chip at p=1000 (5 GB f32 — fits
@@ -492,13 +541,16 @@ def main() -> None:
         tkw = dict(family="binomial", tol=1e-6, cache="none")
         sg.glm_fit_streaming(chunk_src_t, **tkw)  # warm compile
 
-        # de-flaked protocol: PAIRED (untraced, traced) runs back-to-back
-        # — host-load noise hits both halves of a pair alike — and the
-        # BEST of 3 per-pair overhead fractions as the verdict.  Genuine
-        # tracing overhead is systematic (it inflates every pair), while
-        # scheduler hiccups on a shared host are not, so one clean pair
-        # under 2% bounds the systematic cost; the median is reported
-        # alongside for the noise picture.
+        # de-flaked protocol (r11 -> r12): PAIRED (untraced, traced) runs
+        # back-to-back — host-load noise hits both halves of a pair alike.
+        # Genuine tracing overhead is systematic (it inflates every pair),
+        # so the BEST of 3 per-pair fractions bounds the systematic cost
+        # and keeps the tight 2% budget.  The MEDIAN is gated too, but
+        # against a wider documented 5% budget: on a shared host the
+        # median pair still carries scheduler hiccups (BENCH_r11 measured
+        # best 0.3% / median 3.1% on identical code), and a median blowing
+        # 5% across three pairs is no longer explicable as noise — it
+        # means tracing itself regressed.
         pairs, m_plain, m_traced = [], None, None
         ring = RingBufferSink()
         for _ in range(3):
@@ -519,7 +571,8 @@ def main() -> None:
             events=rep["events"], passes=rep["passes"],
             bit_identical=bool(np.array_equal(m_plain.coefficients,
                                               m_traced.coefficients)),
-            ok=bool(best < 0.02))
+            ok=bool(best < 0.02 and med < 0.05),
+            budget=dict(best=0.02, median=0.05))
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["trace_overhead"] = dict(error=repr(e)[:300])
 

@@ -47,20 +47,33 @@ class NumericConfig:
         unsharded feature axis (ops/tsqr.py), and streaming/out-of-core
         fits via the chunked TSQR (models/streaming.py::_streaming_csne).
         ``"off"`` never polishes (r02's warn-only behaviour).
-      bf16_warmup: mixed-precision IRLS schedule for the fused engine.
-        Early iterations only steer beta toward the fixed point — their
-        Gramians need no more accuracy than the step they produce — so the
-        warm-up phase streams a BFLOAT16 master copy of X (half the HBM
-        read per pass, the dominant cost at large n) until the relative
-        deviance change flattens below ``bf16_switch_tol``, then
+      precision_schedule: which precision schedule the resident fused
+        engine runs on TPU.  ``None`` (default) = AUTO: TPU fits that can
+        honour the schedule (fused engine, f32 data, relative criterion,
+        no checkpointing) run the bf16-warm-up + full-precision-polish
+        schedule described under ``bf16_warmup``; everything else —
+        including every CPU fit — runs plain ``"f32"``.  ``"f32"`` opts
+        out explicitly; ``"bf16"`` forces the schedule on (equivalent to
+        ``bf16_warmup=True``, including the cannot-honour warning).  The
+        v2 one-pass engine (ops/fused.py) made this the default worth
+        having: each iteration reads X exactly once, so the pass is
+        HBM-bound and a bf16 master copy halves the bytes of every
+        warm-up iteration (benchmarks/BF16_DECISION_r05.md carries the
+        v1-vs-v2 history; the r5 VPU-bound verdict that kept this opt-in
+        was a property of the retired two-touch driver).  Coefficient
+        error vs the plain schedule stays inside the documented ~5e-6
+        bound (PARITY.md r16) because the final iterations and all
+        reported statistics are full f32.
+      bf16_warmup: legacy explicit switch for the mixed-precision IRLS
+        schedule (pre-dates ``precision_schedule``; kept for
+        compatibility and for forcing the schedule on CPU-simulated
+        runs).  Early iterations only steer beta toward the fixed point —
+        their Gramians need no more accuracy than the step they produce —
+        so the warm-up phase streams a BFLOAT16 master copy of X (half
+        the HBM read per pass, the dominant cost at large n) until the
+        relative deviance change flattens below ``bf16_switch_tol``, then
         warm-starts float32 passes to the exact fixed point.  The FINAL
-        iterations (and everything reported) are full f32.  MEASURED on a
-        real v5e-class chip (benchmarks/BF16_DECISION_r05.md): the fused
-        pass is VPU/MXU-bound, not HBM-bound, so the schedule buys NO
-        speed there (0.90x end-to-end; coefficients ~8e-6 off the plain
-        engine at 2M x 512) — it stays opt-in as a MEMORY lever (a bf16
-        master copy halves the bytes a resident warm-up phase reads and
-        can hold), not a speed lever.
+        iterations (and everything reported) are full f32.
       bf16_switch_tol: relative |ddev| at which the warm-up hands over
         (default 1e-4 ~ the bf16 storage-rounding deviance floor).
       sketch_dim: sketch rows m for ``engine="sketch"`` (ops/sketch.py).
@@ -90,6 +103,7 @@ class NumericConfig:
     refine_steps: int = 1
     matmul_precision: str | None = None
     polish: str | None = None
+    precision_schedule: str | None = None
     bf16_warmup: bool = False
     bf16_switch_tol: float = 1e-4
     sketch_dim: int | None = None
@@ -108,6 +122,30 @@ DEFAULT = NumericConfig()
 # Large fits keep the fast bf16 default: their rounding noise averages down
 # with n and refine_steps/polish recover the solve digits.
 SMALL_PROBLEM_MAC_CAP = 1 << 31
+
+
+PRECISION_SCHEDULES = (None, "f32", "bf16")
+
+
+def resolve_precision_schedule(config: "NumericConfig",
+                               on_tpu: bool) -> str:
+    """The precision schedule a resident fused fit runs: "bf16" (warm-up
+    on a bfloat16 master copy, then full-precision polish) or "f32"
+    (plain).  AUTO (``precision_schedule=None``) promotes bf16 on TPU —
+    the v2 one-HBM-read pass is bandwidth-bound, so the warm-up's halved
+    bytes are pure speed there — and keeps CPU on "f32" (no HBM to save;
+    tier-1 bit-exactness untouched).  Callers still gate on eligibility
+    (fused engine, f32 data, relative criterion, no checkpointing);
+    ineligible fits silently run "f32" under AUTO and warn only when the
+    schedule was requested explicitly."""
+    ps = config.precision_schedule
+    if ps not in PRECISION_SCHEDULES:
+        raise ValueError(
+            f"precision_schedule must be one of {PRECISION_SCHEDULES}, "
+            f"got {ps!r}")
+    if ps is None:
+        return "bf16" if on_tpu else "f32"
+    return ps
 
 
 def resolve_matmul_precision(config: "NumericConfig", n: int, p: int,

@@ -41,14 +41,16 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..config import (DEFAULT, NumericConfig, effective_tol,
-                      resolve_matmul_precision)
+                      resolve_matmul_precision, resolve_precision_schedule)
 from ..families.families import Family, resolve
 from ..families.links import Link
 from ..obs import trace as _obs_trace
 from ..data.sparse import SparseDesign
 from ..data.structured import StructuredDesign
+from ..ops.autotune import choose_engine
 from ..ops.factor_gramian import design_colsum, design_gramian, design_matvec
-from ..ops.fused import fused_fisher_pass, fused_fisher_pass_ref
+from ..ops.fused import (_sanitize, fused_fisher_pass, fused_fisher_pass_ref,
+                         irls_weights)
 from ..ops.solve import (factor_parts, factor_singular, inv_from_parts,
                          min_pivot, solve_normal)
 from ..parallel import mesh as meshlib
@@ -76,11 +78,8 @@ def _dev_bad(dev_new, dev_old, slack=_HALF_SLACK):
             | (dev_new - dev_old > slack * (jnp.abs(dev_old) + 0.1)))
 
 
-def _sanitize(x, valid, fill=0.0):
-    """Padded (weight-0) rows can produce inf/nan in link space (e.g. the
-    gamma inverse link at eta=0); 0 * nan would poison the psum, so select
-    before reducing."""
-    return jnp.where(valid, jnp.nan_to_num(x, nan=fill, posinf=fill, neginf=fill), fill)
+# _sanitize lives in ops/fused.py (re-imported above): one canonical
+# guard-before-reduce expression shared by every Gramian driver.
 
 
 def _irls_core(
@@ -167,10 +166,12 @@ def _irls_core(
 
     def body(s):
         mu, eta = s["mu"], s["eta"]
-        g = link.deriv(mu)                       # ref: lPrime, GLM.scala:370
-        var = family.variance(mu)                # ref: GLM.scala:125-129
-        w = _sanitize(wt / jnp.maximum(var * g * g, 1e-30), valid)
-        z = _sanitize(eta - offset + (y - mu) * g, valid)  # ref: GLM.scala:371-373
+        # shared Fisher-scoring row recipe (ops/fused.py::irls_weights,
+        # ref: GLM.scala:359-395) — the fused twins and the streaming
+        # structured pass evaluate the same expression, which is what
+        # keeps every engine's f64 Gramian bit-identical
+        w, z = irls_weights(y, wt, offset, eta, mu, family=family,
+                            link=link, valid=valid)
         if solver == "qr":
             # TSQR + corrected seminormal solve: error ~eps*kappa(X), for
             # designs whose f32 GRAMIAN is noise-dominated (ops/tsqr.py)
@@ -579,31 +580,42 @@ def _irls_fused_kernel(
 ):
     """IRLS where each iteration's data touch is ONE fused pass over X
     (ops/fused.py): eta, mu, z, w, Gramian and deviance per row block, then a
-    psum over the data axis and a replicated solve.  The deviance measured in
-    a pass belongs to the *incoming* beta, so convergence lags the einsum
-    kernel by one half-step with identical |ddev| semantics.
+    psum over the data axis and a replicated solve.
 
-    ``warm`` starts the loop directly at ``beta0`` with NO hoisted init
-    pass: the first loop iteration's fused pass measures dev(beta0) and
-    produces the next update, so with ``dev_prev`` (the last deviance the
-    interrupted run measured) the first |ddev| continues its convergence
-    sequence exactly, one counted update per iteration — segmenting a fused
-    fit with ``checkpoint_every`` reproduces the unsegmented trajectory
-    bit-for-bit.  Without ``dev_prev`` (an external ``glm_fit(beta0=)``
-    resume, where only beta survived the crash) the baseline is _BIG: the
-    first |ddev| is "unknown", costing at most one verification step.
-    This is what lets ``checkpoint_every``/``beta0`` ride the fast engine
-    instead of demoting to einsum (VERDICT r3 #3).
+    v2 loop order — SOLVE then PASS.  The state carries the normal
+    equations ``(G, r) = (X'WX, X'Wz)`` evaluated at the current iterate
+    alongside its measured deviance; each trip solves them for the updated
+    beta and runs ONE fused pass *at the updated beta*, which returns its
+    deviance together with next trip's (G, r).  The measured deviance
+    therefore always belongs to the iterate the loop carries — the v1
+    half-step lag (deviance of the INCOMING beta, one un-measured trailing
+    iterate, one extra iteration per fit) is gone, and the deviance/solve
+    sequence is the einsum kernel's exactly: with the XLA twin's
+    einsum-op-identical pass (ops/fused.py::fused_fisher_pass_ref), f64
+    coefficients AND iteration counts match the einsum kernel bit-for-bit
+    (tests/test_fused_v2_parity.py).  Step halving runs as an INNER loop —
+    each halving re-passes the data at the midpoint, uncounted against
+    ``max_iter``, exactly like the einsum kernel's.  HBM traffic per fit:
+    (1 init + iters + halvings) reads of X, vs the einsum engine's
+    ~2 x iters (Gramian pass + eta/deviance pass).
+
+    ``warm`` starts at ``beta0`` with a hoisted init pass that measures
+    dev(beta0) and its normal equations — the einsum kernel's warm
+    baseline — so segmenting a fused fit with ``checkpoint_every``
+    reproduces the unsegmented trajectory bit-for-bit (the boundary
+    re-pass at the carried beta recomputes the identical values).
+    ``dev_prev`` is accepted for the segment-driver calling convention
+    and ignored: the init pass re-measures the baseline itself.
 
     A bfloat16 ``X`` runs the mixed-precision WARM-UP phase: the fused
     pass reads half the HBM bytes and upcasts in VMEM (ops/fused.py);
     beta, the solve, and every accumulator stay float32.
     """
+    del dev_prev  # v2: the warm init pass re-measures dev(beta0) itself
     acc = X.dtype if X.dtype == jnp.float64 else jnp.float32
     # beta/eta dtype: f32 even when X is stored bf16
     bdt = jnp.float32 if X.dtype == jnp.bfloat16 else X.dtype
     p = X.shape[1]
-    valid = wt > 0
     pass_fn = fused_fisher_pass if use_pallas else fused_fisher_pass_ref
 
     # the traced family scalar (negbin theta) enters the shard_map as an
@@ -642,55 +654,42 @@ def _irls_fused_kernel(
         fac_d = jnp.where(singular, fac_prev[1], fac_d)
         return beta, (fac_a, fac_d), singular, min_pivot(cho)
 
-    fac_init = (jnp.eye(p, dtype=acc), jnp.ones((p,), acc))
     if warm:
         # NaN entries (aliased coefficients from a checkpointed drop-path
-        # fit) contribute nothing, as in predict's reduced basis
-        # unknown-baseline sentinel must be FINITE: the relative criterion
-        # divides ddev by (|dev| + 0.1), and inf/inf = NaN would read as
-        # "converged" before the loop ever ran
+        # fit) contribute nothing, as in predict's reduced basis.  The
+        # init pass measures dev(beta0) — the einsum kernel's warm
+        # baseline — and produces beta0's normal equations for trip 1.
         beta_init = jnp.nan_to_num(beta0).astype(bdt)
-        dev0 = (jnp.asarray(jnp.finfo(acc).max / 2, acc) if dev_prev is None
-                else dev_prev.astype(acc))
-        state0 = dict(
-            it=jnp.zeros((), jnp.int32),
-            beta=beta_init,
-            # no previous iterate survived the crash; zeros (eta=offset) is
-            # the only safe retreat if beta0's very first pass diverges
-            beta_prev=jnp.zeros((p,), bdt),
-            dev=dev0,
-            ddev=jnp.asarray(_BIG, acc),
-            halvings=jnp.zeros((), jnp.int32),
-            fac_a=fac_init[0],
-            fac_d=fac_init[1],
-            singular=jnp.zeros((), jnp.bool_),
-            stalled=jnp.zeros((), jnp.bool_),
-            pivot=jnp.ones((), acc),
-            # warm mode captures the first in-loop Gramian for the
-            # singular='drop' host rank check (no hoisted pass to take
-            # it from); cold mode keeps it out of the carried state
-            XtWX0=jnp.zeros((p, p), acc),
-        )
+        G0, r0, dev0 = spmd_pass(False)(X, y, wt, offset, beta_init)
     else:
+        # cold start: the family-init pass needs no beta; its deviance is
+        # the init-mu baseline and its Gramian is trip 1's system — the
+        # same two values the einsum kernel's hoisted init + first body
+        # trip compute.
         beta_init = jnp.zeros((p,), bdt)
-        XtWX0, XtWz0, dev0 = spmd_pass(True)(X, y, wt, offset, beta_init)
-        beta1, fac0, sing0, piv0 = solve(XtWX0, XtWz0, beta_init, fac_init)
-        state0 = dict(
-            # counts deviance-measured updates, matching the einsum kernel's
-            # iteration numbering (the hoisted init solve is iteration 0)
-            it=jnp.zeros((), jnp.int32),
-            beta=beta1.astype(bdt),
-            beta_prev=beta_init,
-            dev=dev0.astype(acc),
-            ddev=jnp.asarray(_BIG, acc),
-            halvings=jnp.zeros((), jnp.int32),
-            fac_a=fac0[0],
-            fac_d=fac0[1],
-            singular=sing0,
-            stalled=jnp.zeros((), jnp.bool_),
-            pivot=piv0.astype(acc),
-        )
+        G0, r0, dev0 = spmd_pass(True)(X, y, wt, offset, beta_init)
     step = spmd_pass(False)
+    state0 = dict(
+        it=jnp.zeros((), jnp.int32),
+        beta=beta_init,
+        G=G0.astype(acc),
+        r=r0.astype(acc),
+        dev=dev0.astype(acc),
+        ddev=jnp.asarray(_BIG, acc),
+        fac_a=jnp.eye(p, dtype=acc),
+        fac_d=jnp.ones((p,), acc),
+        singular=jnp.zeros((), jnp.bool_),
+        stalled=jnp.zeros((), jnp.bool_),
+        pivot=jnp.ones((), acc),
+    )
+
+    # halving gate, matching the einsum kernel: the cold baseline is the
+    # family-init deviance (near-saturated, no beta produces it) — halving
+    # the first step against it would retract every fit toward beta=0; a
+    # warm baseline is dev(beta0), a real iterate, so halving may engage
+    # at once
+    def halve_ok(s):
+        return jnp.asarray(True) if warm else s["it"] > 0
 
     def not_converged(s):
         # callers pre-clamp the relative tol to the deviance dtype's
@@ -701,63 +700,56 @@ def _irls_fused_kernel(
         return (s["it"] < max_iter) & (d > tol) & ~s["singular"] & ~s["stalled"]
 
     def body(s):
-        XtWX, XtWz, dev = step(X, y, wt, offset, s["beta"])
-        dev = dev.astype(acc)
-        # lagged-deviance step-halving: the measured deviance belongs to
-        # the INCOMING beta, so a bad value convicts the step that produced
-        # s["beta"] — retract to the midpoint of s["beta_prev"] (the last
-        # iterate with a good measured deviance) and s["beta"], keep the
-        # old deviance baseline, and re-measure next trip.  A halving
-        # chain therefore spends loop iterations, counted against
-        # max_iter (the einsum kernel's inner halving loop does not).
-        # gated to trips with a REAL retreat target: from the second trip
-        # on, s["beta_prev"] is an iterate whose measured deviance is the
-        # carried baseline; on the first trip the baseline is the init-mu
-        # deviance (cold) or the segment sentinel (warm) and beta_prev is
-        # zeros — comparing/retreating there would stall healthy fits
-        bad = _dev_bad(dev, s["dev"]) & (s["it"] > 0)
-        can_halve = bad & (s["halvings"] < STEP_HALVINGS)
-        stalled = bad & (s["halvings"] >= STEP_HALVINGS)
-        beta_new, fac, singular, pivot = solve(XtWX, XtWz, s["beta"],
+        beta_new, fac, singular, pivot = solve(s["G"], s["r"], s["beta"],
                                                (s["fac_a"], s["fac_d"]))
+        beta_new = beta_new.astype(bdt)
+        G1, r1, dev1 = step(X, y, wt, offset, beta_new)
+
+        # inner step-halving (R glm.fit "step size truncated"): walk the
+        # update back toward s["beta"] while its measured deviance is
+        # non-finite or increasing.  Each halving is one fused pass at the
+        # midpoint — which also hands back the midpoint's (G, r), so the
+        # next trip's solve starts from the retracted iterate's system,
+        # exactly as the einsum kernel's inner loop leaves its state.
+        # Halvings are NOT counted against max_iter (einsum semantics;
+        # the v1 driver spent loop trips on them).
+        ok = halve_ok(s)
+
+        def h_cond(h):
+            return _dev_bad(h["dev"], s["dev"]) & ok & (h["k"] < STEP_HALVINGS)
+
+        def h_body(h):
+            b = (0.5 * (h["beta"] + s["beta"])).astype(bdt)
+            G2, r2, d2 = step(X, y, wt, offset, b)
+            return dict(k=h["k"] + 1, beta=b, G=G2.astype(acc),
+                        r=r2.astype(acc), dev=d2.astype(acc))
+
+        h = jax.lax.while_loop(h_cond, h_body, dict(
+            k=jnp.zeros((), jnp.int32), beta=beta_new,
+            G=G1.astype(acc), r=r1.astype(acc), dev=dev1.astype(acc)))
+        # still bad after K halvings (ungated trips never stall)
+        stalled = _dev_bad(h["dev"], s["dev"]) & ok
         if trace:
-            # it_base keeps numbering monotone across checkpoint segments;
-            # s["halvings"] is the count so far (this trip's update lands
-            # in the next event).  Same ambient-tracer callback as the
-            # einsum kernel — one formatting path.
+            # it_base keeps numbering monotone across checkpoint segments.
+            # Same ambient-tracer callback, same post-halving event payload
+            # as the einsum kernel — one formatting path, one event stream.
             jax.debug.callback(
                 _emit_iter_event,
                 s["it"] + 1 + (0 if it_base is None else it_base),
-                dev, jnp.abs(dev - s["dev"]), s["halvings"])
-        mid = (0.5 * (s["beta"].astype(jnp.float32)
-                      + s["beta_prev"].astype(jnp.float32))).astype(bdt)
-        # a retracted (or stalled) trip must not adopt the solve produced
-        # by the diverged pass: its Gramian/factor/singular flag are
-        # computed from garbage weights
-        keep = can_halve | stalled
-        out = dict(
+                h["dev"], jnp.abs(h["dev"] - s["dev"]), h["k"])
+        return dict(
             it=s["it"] + 1,
-            beta=jnp.where(stalled, s["beta_prev"],
-                           jnp.where(can_halve, mid, beta_new.astype(bdt))),
-            beta_prev=jnp.where(keep, s["beta_prev"], s["beta"]),
-            dev=jnp.where(keep, s["dev"], dev),
-            # inf, not |dev - base|, while halving: a retracted trip has
-            # made no measured progress and must not read as converged
-            ddev=jnp.where(bad, jnp.asarray(_BIG, acc),
-                           jnp.abs(dev - s["dev"])),
-            halvings=jnp.where(can_halve, s["halvings"] + 1,
-                               jnp.where(bad, s["halvings"],
-                                         jnp.zeros((), jnp.int32))),
-            fac_a=jnp.where(keep, s["fac_a"], fac[0]),
-            fac_d=jnp.where(keep, s["fac_d"], fac[1]),
-            singular=jnp.where(keep, s["singular"], singular),
+            beta=h["beta"],
+            G=h["G"],
+            r=h["r"],
+            dev=h["dev"],
+            ddev=jnp.abs(h["dev"] - s["dev"]),
+            fac_a=fac[0],
+            fac_d=fac[1],
+            singular=singular,
             stalled=stalled,
-            pivot=jnp.where(keep, s["pivot"], pivot.astype(acc)),
+            pivot=pivot.astype(acc),
         )
-        if warm:
-            out["XtWX0"] = jnp.where(s["it"] == 0, XtWX.astype(acc),
-                                     s["XtWX0"])
-        return out
 
     s = jax.lax.while_loop(not_converged, body, state0)
 
@@ -766,14 +758,16 @@ def _irls_fused_kernel(
     # (X'WX)^-1 from the carried factor, once (HOTLOOP_r03.md).
     cov_final = inv_from_parts(s["fac_a"], s["fac_d"], p, acc)
     beta_f = s["beta"]
-    eta = (X @ beta_f + offset).astype(bdt)
+    eta = (design_matvec(X, beta_f) + offset).astype(bdt)
     d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
     converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"] & ~s["stalled"]
 
+    # XtWX0 (the singular='drop' host rank check's Gramian) is the init
+    # pass's G in BOTH modes: loop-invariant, never carried
     return dict(beta=beta_f, cov_inv=cov_final, dev=s["dev"],
                 eta=eta, iters=s["it"], converged=converged,
                 singular=s["singular"], pivot=s["pivot"],
-                XtWX0=s["XtWX0"] if warm else XtWX0.astype(acc))
+                XtWX0=G0.astype(acc))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1058,6 +1052,17 @@ def _trace_kernel_calls(run_kernel, tracer, gramian_engine=None, extra=None):
     return wrapped
 
 
+def _autotune_extra(rec):
+    """compile/solve event stamp for an autotuned fit: the probe's verdict
+    and timings under an ``autotune_`` prefix.  The full record travels as
+    its own ``autotune`` event (fit_info["engine_autotune"]); the prefix
+    keeps these fields from shadowing the events' ``gramian_engine``."""
+    if rec is None:
+        return None
+    keys = ("engine", "probed", "cached", "einsum_s", "fused_s")
+    return {f"autotune_{k}": rec[k] for k in keys if k in rec}
+
+
 def _finalize_model(
     *, fam, lnk, beta, cov_inv, dev, pearson, loglik, wt_sum, n_ok,
     null_dev, iters, converged, n_obs, p, xnames, yname, has_intercept,
@@ -1168,10 +1173,18 @@ def _fit_global(
 
     on_tpu = jax.default_backend() == "tpu"
     model_par = mesh.shape.get(meshlib.MODEL_AXIS, 1) != 1
+    autotune_rec = None
     if engine == "auto":
-        # same policy as the resident path (r5 marginal record,
-        # HOTLOOP_r05.md): einsum wins on-device at every measured shape
-        engine = "einsum"
+        if model_par:
+            engine = "einsum"  # fused has no sharded-feature form
+        else:
+            # measured per (p-bucket, dtype, platform), cached process-wide
+            # (ops/autotune.py — the r5 hard-coded einsum default is retired)
+            autotune_rec = choose_engine(p, dtype,
+                                         precision=config.matmul_precision)
+            engine = autotune_rec["engine"]
+            if tracer is not None:
+                tracer.emit("autotune", **autotune_rec)
     if engine == "fused" and model_par:
         raise ValueError(
             "engine='fused' does not support a sharded feature axis")
@@ -1221,7 +1234,8 @@ def _fit_global(
             )
 
     if tracer is not None:
-        run_kernel = _trace_kernel_calls(run_kernel, tracer, engine)
+        run_kernel = _trace_kernel_calls(run_kernel, tracer, engine,
+                                         extra=_autotune_extra(autotune_rec))
     if beta0 is not None or on_iteration is not None or checkpoint_every:
         # segmented checkpointing: the multi-host recovery story — every
         # process persists beta in its on_iteration and a restarted job
@@ -1388,13 +1402,18 @@ def fit(
         dense arrays).  Opt-in — never auto-selected: no covariance
         (``vcov()``/``se_fit`` refuse), ``singular="error"`` only
         (README "Sketched solvers"; PARITY.md r13).
-      * ``"auto"`` — the einsum engine: measured on the real chip with
-        dispatch cost cancelled (r5, benchmarks/HOTLOOP_r05.md), XLA's
-        fused einsum pass runs 12.0 ms/iter at 2Mx512 (MFU 0.47) vs the
-        Pallas kernel's 14.1 AND converges one iteration sooner (no
-        half-step deviance lag).  The r03 measurements that briefly
-        pointed auto at the fused kernel were per-call tunnel timings —
-        retracted in r5.
+      * ``"auto"`` — MEASURED engine selection (ops/autotune.py): one
+        timed probe of the real per-iteration work per (p-bucket, dtype,
+        platform), cached process-wide, picks einsum or fused; designs
+        with no fused form (structured/sparse/feature-sharded) skip the
+        probe and run einsum.  The verdict plus probe timings land in the
+        fit's ``compile``/``solve`` trace events and
+        ``fit_info["engine_autotune"]``.  Since the v2 fused pass matches
+        the einsum iteration trajectory exactly (no half-step deviance
+        lag — the r5 objection that froze auto on einsum), the choice is
+        purely which engine moves the bytes faster, and timing noise in
+        the probe cannot change results: on CPU/f64 both engines are
+        bit-identical (tests/test_fused_v2_parity.py).
     """
     if criterion not in ("absolute", "relative"):
         raise ValueError(
@@ -1448,12 +1467,14 @@ def _fit_dispatch(
                 "global-array fits use the einsum or fused engine")
         if mesh is None:
             raise ValueError("pass the global mesh the arrays are sharded on")
-        if config.bf16_warmup:
+        if config.bf16_warmup or config.precision_schedule == "bf16":
+            # explicit requests only — the AUTO TPU default stays silent
+            # here, like every other path that cannot honour the schedule
             import warnings
             warnings.warn(
-                "bf16_warmup is not implemented on the global-array "
-                "multi-process path; running full-precision passes",
-                stacklevel=2)
+                "the bf16 precision schedule is not implemented on the "
+                "global-array multi-process path; running full-precision "
+                "passes", stacklevel=2)
         return _fit_global(X, y, weights, offset, fam, lnk, tol, max_iter,
                            criterion, xnames, yname, has_intercept, mesh,
                            verbose, config, beta0=beta0,
@@ -1524,19 +1545,25 @@ def _fit_dispatch(
         config = dataclasses.replace(config, matmul_precision=mmp)
     checkpointing = (beta0 is not None or on_iteration is not None
                      or checkpoint_every)
+    autotune_rec = None
     if engine == "auto":
-        # Measured r05 on the real chip with per-call dispatch cost
-        # CANCELLED (benchmarks/HOTLOOP_r05.md + bench_detail_latest
-        # marginal_*): the einsum engine's XLA-fused pass runs 12.0
-        # ms/iter at 2Mx512 (MFU 0.47) vs the Pallas fused kernel's 14.1,
-        # and converges one iteration sooner (its deviance is not lagged
-        # by a half-step).  The r03 numbers that flipped auto to fused
-        # (~16 vs ~26-40 ms/iter) were per-call timings carrying the
-        # tunnel's 30-65 ms dispatch RTT divided by different iteration
-        # counts — an artifact, retracted.  Auto is einsum everywhere;
-        # engine="fused" stays available explicitly (its bf16 master-copy
-        # warm-up remains the memory lever, BF16_DECISION_r05.md).
-        engine = "einsum"
+        # Measured at fit time, not hard-coded: the r5 einsum-everywhere
+        # default (HOTLOOP_r05.md) was a verdict on the v1 fused driver,
+        # whose lagged deviance cost an extra iteration; the v2 pass
+        # matches the einsum trajectory exactly, so the choice is a pure
+        # bandwidth/compute trade that moves with (p, dtype, platform).
+        # One timed probe per (p-bucket, dtype, platform), cached
+        # process-wide — ops/autotune.py holds the full r5 history.
+        if (is_structured or is_sparse or shard_features
+                or mesh.shape[meshlib.MODEL_AXIS] != 1):
+            # shapes with no fused form keep the einsum engine, no probe
+            engine = "einsum"
+        else:
+            autotune_rec = choose_engine(p, dtype,
+                                         precision=config.matmul_precision)
+            engine = autotune_rec["engine"]
+            if tracer is not None:
+                tracer.emit("autotune", **autotune_rec)
     if engine not in ("einsum", "fused", "qr", "sketch"):
         raise ValueError(
             f"engine must be 'auto', 'einsum', 'fused', 'qr' or 'sketch', "
@@ -1580,7 +1607,16 @@ def _fit_dispatch(
     g_engine = ("sketch" if engine == "sketch"
                 else "structured" if is_structured
                 else "sparse" if is_sparse else engine)
-    if config.bf16_warmup and not (
+    # precision schedule: AUTO promotes the bf16 warm-up on TPU (the v2
+    # one-pass engine is HBM-bound, so the warm-up's halved bytes are pure
+    # speed); explicit requests (bf16_warmup=True or
+    # precision_schedule="bf16") engage it anywhere eligible and WARN when
+    # the fit cannot honour it — an AUTO default must stay silent instead
+    bf16_explicit = (config.bf16_warmup
+                     or config.precision_schedule == "bf16")
+    bf16_schedule = (bf16_explicit or
+                     resolve_precision_schedule(config, on_tpu) == "bf16")
+    if bf16_explicit and not (
             engine == "fused" and dtype == np.float32
             and criterion == "relative" and not checkpointing):
         # the schedule exists only on the resident fused f32 relative-
@@ -1589,7 +1625,8 @@ def _fit_dispatch(
         # it (review r4)
         import warnings
         warnings.warn(
-            "bf16_warmup is set but this fit cannot honour it "
+            "the bf16 precision schedule was requested but this fit "
+            "cannot honour it "
             f"(engine={engine!r}, dtype={np.dtype(dtype).name}, "
             f"criterion={criterion!r}"
             + (", checkpointing" if checkpointing else "") +
@@ -1611,9 +1648,15 @@ def _fit_dispatch(
         polish_active = False
 
     block_rows = _fused_block_rows(p, config.matmul_precision)
-    if engine == "fused":
-        # the fused kernel streams whole blocks, so every shard's row count
-        # must divide into block_rows; extra rows carry wt=0 and stay inert
+    # the Mosaic kernel is float32 and streams WHOLE row blocks; float64
+    # (x64) and every CPU mesh run the XLA twin, which takes any row count
+    fused_pallas = on_tpu and p <= 1024 and dtype == np.float32
+    if engine == "fused" and fused_pallas:
+        # whole-block streaming: every shard's row count must divide into
+        # block_rows; extra rows carry wt=0 and stay inert.  The ref twin
+        # is NOT padded — shard_rows' device-multiple padding is enough —
+        # so its reduction shapes (and therefore its f64 sum bits) are the
+        # einsum engine's exactly (tests/test_fused_v2_parity.py)
         mult = block_rows * n_data
         n_pad = ((n + mult - 1) // mult) * mult
         if n_pad != n:
@@ -1641,8 +1684,7 @@ def _fit_dispatch(
                 family=fam, link=lnk, criterion=criterion,
                 refine_steps=config.refine_steps,
                 mesh=mesh, block_rows=block_rows,
-                # the Mosaic kernel is float32; float64 (x64) runs the XLA twin
-                use_pallas=on_tpu and p <= 1024 and dtype == np.float32,
+                use_pallas=fused_pallas,
                 trace=verbose or tracer is not None,
                 precision=config.matmul_precision,
                 beta0=jnp.asarray(beta_arr, dtype), warm=warm,
@@ -1651,16 +1693,18 @@ def _fit_dispatch(
                 fam_param=fam_param,
             )
         if tracer is not None:
-            run_kernel = _trace_kernel_calls(run_kernel, tracer,
-                                             g_engine)
+            run_kernel = _trace_kernel_calls(run_kernel, tracer, g_engine,
+                                             extra=_autotune_extra(
+                                                 autotune_rec))
         if checkpointing:
             out = _segmented_irls(run_kernel, p=p, dtype=dtype,
                                   max_iter=max_iter, beta0=beta0,
                                   on_iteration=on_iteration,
                                   checkpoint_every=checkpoint_every)
-        elif (config.bf16_warmup and dtype == np.float32
+        elif (bf16_schedule and dtype == np.float32
               and criterion == "relative"):
-            # Mixed-precision schedule (config.bf16_warmup): stream a bf16
+            # Mixed-precision schedule (config.precision_schedule — the
+            # TPU AUTO default — or the explicit bf16_warmup): stream a bf16
             # master copy of X (half the HBM bytes/pass) until the relative
             # |ddev| flattens below bf16_switch_tol, then warm-start f32
             # passes to the exact fixed point.  Deviance baselines are not
@@ -1677,7 +1721,7 @@ def _fit_dispatch(
                 family=fam, link=lnk, criterion=criterion,
                 refine_steps=config.refine_steps,
                 mesh=mesh, block_rows=block_rows,
-                use_pallas=on_tpu and p <= 1024,
+                use_pallas=fused_pallas,
                 trace=verbose or tracer is not None,
                 precision=config.matmul_precision,
                 fam_param=fam_param)
@@ -1752,8 +1796,9 @@ def _fit_dispatch(
                 fam_param=fam_param,
             )
         if tracer is not None:
-            run_kernel = _trace_kernel_calls(run_kernel, tracer,
-                                             g_engine)
+            run_kernel = _trace_kernel_calls(run_kernel, tracer, g_engine,
+                                             extra=_autotune_extra(
+                                                 autotune_rec))
         if checkpointing:
             out = _segmented_irls(run_kernel, p=p, dtype=dtype,
                                   max_iter=max_iter, beta0=beta0,

@@ -233,6 +233,9 @@ class FitTracer:
         # count, executables compiled, inert-model fraction per iteration
         self._fleet: dict | None = None
         self._models_converged = 0
+        # engine="auto": the autotuner's probe record (ops/autotune.py) —
+        # which engine the fit ran and why, auditable from fit_info
+        self._autotune: dict | None = None
 
     @staticmethod
     def _coerce_sink(s) -> Sink:
@@ -344,6 +347,8 @@ class FitTracer:
                 m.counter("elastic.shards_fitted").inc()
         elif ev.kind == "compile":
             self._compile_s += float(f.get("seconds", 0.0))
+        elif ev.kind == "autotune":
+            self._autotune = dict(f)
         elif ev.kind == "model_converged":
             self._models_converged += 1
             if m is not None:
@@ -431,6 +436,12 @@ class FitTracer:
                 "fleet": (dict(self._fleet,
                                models_converged=self._models_converged)
                           if self._fleet is not None else None),
+                # engine="auto" fits: the autotuner's record verbatim —
+                # chosen engine, probe timings (einsum_s/fused_s) when a
+                # probe ran, cache provenance; None when the engine was
+                # explicit or auto had no fused-capable shape
+                "engine_autotune": (dict(self._autotune)
+                                    if self._autotune is not None else None),
                 "queue_wait_s": self._queue_wait_s,
                 "prefetch_depth_max": self._prefetch_depth_max,
                 # fraction of the overlappable time actually hidden by the

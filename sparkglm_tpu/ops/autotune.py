@@ -1,0 +1,164 @@
+"""Measured engine selection for ``engine="auto"`` (the r12 autotuner).
+
+History: auto was flipped to einsum-everywhere in r5 when dispatch-cancelled
+marginals showed the v1 fused kernel losing at every measured shape AND
+burning one extra iteration on its half-step-lagged deviance
+(benchmarks/HOTLOOP_r05.md).  Both findings were properties of the v1
+driver, not of the fused structure: the v2 pass (ops/fused.py) matches the
+einsum iteration trajectory exactly and halves the per-iteration HBM
+traffic, so a hard-coded default is wrong in BOTH directions depending on
+shape and platform.  Auto is therefore *measured again, at fit time*: one
+timed probe per (p-bucket, dtype, platform), cached process-wide, decides
+einsum vs fused — and the probe record is surfaced in the fit's trace
+events and ``fit_info`` so the choice is auditable, never silent.
+
+What the probe times, at a small synthetic (n, p-bucket) slice of the
+real per-iteration work (gaussian/identity rows — engine choice is about
+the data-touch structure, not the link transcendentals):
+
+  * einsum: one ``weighted_gramian`` contraction PLUS one eta/deviance
+    matvec pass — the einsum kernel touches X twice per iteration.
+  * fused: ONE ``fused_fisher_pass`` (the Mosaic kernel on TPU f32, the
+    XLA twin elsewhere) — the v2 engine touches X once per iteration.
+
+Ties and near-ties go to einsum (the incumbent needs no block padding and
+no VMEM tuning); fused must win by a clear margin.  Tiny designs skip the
+probe entirely — they are latency-bound and the einsum path is simpler.
+
+Determinism note: the autotuner picks which ENGINE runs, never what it
+computes — the v2 XLA twin is op-identical to the einsum kernel, so on
+CPU/f64 the two choices produce bit-identical coefficients and iteration
+counts (tests/test_fused_v2_parity.py), and timing nondeterminism in the
+probe cannot leak into results.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["choose_engine", "p_bucket", "seed_cache", "clear_cache",
+           "AUTOTUNE_MIN_P"]
+
+# below this width a fit is dispatch/latency-bound: skip the probe, run
+# einsum (also keeps the probe out of the small R-parity golden fits)
+AUTOTUNE_MIN_P = 16
+# per-pass MAC budget for the probe shape: big enough to rank the engines,
+# small enough that a cache miss costs milliseconds of compute (compile
+# time dominates the one-off probe either way)
+_PROBE_MACS = 1 << 24
+_PROBE_REPS = 3
+# fused must beat einsum by > ~8% of a probe rep to win; anything closer
+# is noise and the incumbent keeps the shape
+_FUSED_MARGIN = 0.92
+
+# (p_bucket, dtype name, platform) -> probe record; process-wide, so a
+# fleet of same-shape fits probes once
+_CACHE: dict[tuple[int, str, str], dict] = {}
+
+
+def p_bucket(p: int) -> int:
+    """Power-of-two ceiling of ``p`` (floored at AUTOTUNE_MIN_P): the probe
+    cache key's width axis.  Engine crossover moves with p^2 (Gramian
+    flops) vs p (HBM rows), so one probe per octave is plenty."""
+    return 1 << max(AUTOTUNE_MIN_P.bit_length() - 1,
+                    int(max(1, p) - 1).bit_length())
+
+
+def clear_cache() -> None:
+    _CACHE.clear()
+
+
+def seed_cache(p: int, dtype, platform: str, record: dict) -> None:
+    """Install ``record`` for (p_bucket(p), dtype, platform) without
+    probing — the test hook for exercising auto's selection logic with a
+    known verdict, and an operator override for pinning a fleet's choice."""
+    _CACHE[(p_bucket(p), np.dtype(dtype).name, platform)] = dict(record)
+
+
+def _timed(fn, *args) -> float:
+    jax.block_until_ready(fn(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(_PROBE_REPS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _probe(pb: int, dtype: np.dtype, platform: str, precision) -> dict:
+    from functools import partial
+
+    from ..families.families import resolve as _resolve
+    from .fused import fused_block_rows, fused_fisher_pass, fused_fisher_pass_ref
+    from .gramian import weighted_gramian
+
+    fam, lnk = _resolve("gaussian", None)
+    on_tpu = platform == "tpu"
+    use_pallas = on_tpu and dtype == np.float32 and pb <= 1024
+    block = fused_block_rows(pb, precision, dtype)
+    n = max(_PROBE_MACS // (pb * pb), 2 * block if use_pallas else 256)
+    n = ((n + block - 1) // block) * block
+    jdt = jnp.dtype(dtype)
+    key = jax.random.PRNGKey(0)
+    kx, ky = jax.random.split(key)
+    X = jax.random.normal(kx, (n, pb), jdt)
+    y = jax.random.normal(ky, (n,), jdt)
+    wt = jnp.ones((n,), jdt)
+    off = jnp.zeros((n,), jdt)
+    beta = jnp.zeros((pb,), jdt)
+    acc = jdt if jdt == jnp.float64 else jnp.float32
+
+    @jax.jit
+    def einsum_iter(X, y, wt, off, beta):
+        # the einsum kernel's two data touches per iteration: the Gramian
+        # contraction over (w, z), then the eta/mu/deviance matvec pass
+        eta = (jnp.matmul(X, beta) + off).astype(X.dtype)
+        mu = lnk.inverse(eta)
+        g = lnk.deriv(mu)
+        w = wt / jnp.maximum(fam.variance(mu) * g * g, 1e-30)
+        z = eta - off + (y - mu) * g
+        G, r = weighted_gramian(X, z, w, accum_dtype=acc,
+                                precision=precision)
+        dev = jnp.sum(fam.dev_resids(y, mu, wt))
+        return G, r, dev
+
+    pass_fn = fused_fisher_pass if use_pallas else fused_fisher_pass_ref
+    fused_iter = jax.jit(partial(
+        pass_fn, family=fam, link=lnk, first=False, block_rows=block,
+        precision=precision))
+
+    einsum_s = _timed(einsum_iter, X, y, wt, off, beta)
+    fused_s = _timed(fused_iter, X, y, wt, off, beta)
+    engine = "fused" if fused_s < _FUSED_MARGIN * einsum_s else "einsum"
+    return dict(engine=engine, p_bucket=pb, dtype=dtype.name,
+                platform=platform, probed=True, n_probe=int(n),
+                einsum_s=float(einsum_s), fused_s=float(fused_s),
+                use_pallas=bool(use_pallas))
+
+
+def choose_engine(p: int, dtype, *, platform: str | None = None,
+                  precision=None) -> dict:
+    """The engine ``engine="auto"`` runs at width ``p``: a cached probe
+    record with at least ``{"engine", "p_bucket", "dtype", "platform",
+    "probed", "cached"}``; probed records add ``einsum_s`` / ``fused_s`` /
+    ``n_probe``.  The caller stamps the record into the fit's ``compile`` /
+    ``solve`` trace events and an ``autotune`` event (``fit_info``)."""
+    platform = platform or jax.default_backend()
+    dt = np.dtype(dtype)
+    pb = p_bucket(p)
+    key = (pb, dt.name, platform)
+    rec = _CACHE.get(key)
+    if rec is not None:
+        return dict(rec, cached=True)
+    if p < AUTOTUNE_MIN_P:
+        rec = dict(engine="einsum", p_bucket=pb, dtype=dt.name,
+                   platform=platform, probed=False,
+                   reason="latency-bound width; probe skipped")
+    else:
+        rec = _probe(pb, dt, platform, precision)
+    _CACHE[key] = rec
+    return dict(rec, cached=False)

@@ -177,12 +177,18 @@ def structured_fisher_pass(sd: StructuredDesign, y, wt, offset, beta, *,
                            precision=None, fam_param=None):
     """Structured twin of ``ops/fused.py::fused_fisher_pass_ref`` — one
     IRLS data pass returning ``(XtWX (p,p), XtWz (p,), dev ())`` with the
-    identical per-row math (``_step_math``) but the blockwise Gramian.
+    identical per-row recipe (``ops/fused.py::irls_weights``) but the
+    blockwise Gramian.
 
     Used by the streaming engine's chunk pass; the resident IRLS kernel
     reaches the same blocks through ``design_gramian`` inside its
-    while_loop instead.
+    while_loop instead — all three drivers share the one (w, z, dev)
+    expression, so their f64 row math is bit-identical.
     """
+    # function-level import: ops/fused.py imports design_gramian/
+    # design_matvec from this module at module scope, so the shared row
+    # recipe is pulled lazily to keep the import graph acyclic
+    from .fused import _sanitize, irls_weights
     family = family.with_param(fam_param)
     valid = wt > 0.0
     if first:
@@ -191,18 +197,9 @@ def structured_fisher_pass(sd: StructuredDesign, y, wt, offset, beta, *,
     else:
         eta = structured_matvec(sd, beta) + offset
         mu = jnp.where(valid, link.inverse(eta), 1.0)
-    g = link.deriv(mu)
-    var = family.variance(mu)
-    w_raw = wt / jnp.maximum(var * g * g, _TINY)
-    w = jnp.where(valid,
-                  jnp.nan_to_num(w_raw, nan=0.0, posinf=0.0, neginf=0.0), 0.0)
-    z_raw = eta - offset + (y - mu) * g
-    z = jnp.where(valid,
-                  jnp.nan_to_num(z_raw, nan=0.0, posinf=0.0, neginf=0.0), 0.0)
-    dev = jnp.sum(jnp.where(
-        valid,
-        jnp.nan_to_num(family.dev_resids(y, mu, wt),
-                       nan=0.0, posinf=0.0, neginf=0.0), 0.0))
+    w, z = irls_weights(y, wt, offset, eta, mu, family=family, link=link,
+                        valid=valid)
+    dev = jnp.sum(_sanitize(family.dev_resids(y, mu, wt), valid))
     acc = sd.dtype if sd.dtype == jnp.float64 else jnp.float32
     XtWX, XtWz = structured_gramian(sd, z, w, accum_dtype=acc,
                                     precision=precision)

@@ -1,4 +1,4 @@
-"""Single-HBM-pass fused Fisher-scoring step (Pallas TPU kernel + XLA twin).
+"""Single-HBM-pass fused Fisher-scoring step, v2 (Pallas TPU kernel + XLA twin).
 
 Per IRLS iteration the reference walks the data several times: one pass for
 z/w (``zwCreateBinomial``, /root/reference/src/main/scala/com/Alteryx/
@@ -18,26 +18,50 @@ needs::
     XtWz += (X*w)' z
     dev  += sum dev_resids(y, mu, wt)
 
-so per-iteration HBM traffic drops from ~4|X| to |X|.  The deviance returned
-is the deviance of the *incoming* beta (the convergence test then lags one
-half-step, which preserves the reference's |ddev| semantics).
+so per-iteration HBM traffic drops from ~4|X| to |X|.
 
-``fused_fisher_pass_ref`` is the identical computation in plain jnp — the
-CPU/test twin, and the shape oracle for the Pallas kernel.
+v2 semantics (the lagged-deviance fix): a pass evaluated at ``beta`` returns
+``(XtWX(beta), XtWz(beta), dev(beta))`` — the Gramian, the score RHS, *and
+the deviance of that same beta*.  The v2 driver (models/glm.py::
+``_irls_fused_kernel``) carries (G, r) in its loop state and orders each
+iteration SOLVE-then-PASS: solve the carried normal equations for the
+updated beta, then run one pass at the updated beta to measure its deviance
+and produce next iteration's Gramian.  That is exactly the einsum kernel's
+deviance sequence — the v1 driver measured the *incoming* beta instead,
+which cost one un-measured trailing iterate and an extra iteration at
+every golden case (VERDICT.md items 4-6).  One pass per iteration, one HBM
+read of X, no lag.
+
+``fused_fisher_pass_ref`` is the CPU/tier-1 twin.  As of v2 it is built
+from the SAME XLA ops the einsum engine uses (``design_matvec`` for eta,
+``design_gramian``/``weighted_gramian`` for the contraction, ``_sanitize``
+selects before every reduction), so at float64 the fused driver's
+coefficients and iteration counts are BIT-IDENTICAL to the einsum kernel's
+— that is what the tier-1 parity suite asserts (tests/test_fused_v2_parity).
+The Mosaic kernel keeps its VPU form for eta (a bf16-rounded MXU eta
+amplifies into ~1e-3 relative X'Wz error, measured r02); the two twins
+agree to f32 tolerance, and the interpret-mode harness pins that.
 
 Layout notes (Mosaic): per-row vectors are carried as (n, 1) columns —
 matvecs must keep the contracting dim last on the lhs and vector-like rhs,
-and (blk, 1) blocks keep every elementwise op 2-D.  Scalars accumulate into a
-(1, 1) VMEM block.
+and (blk, 1) blocks keep every elementwise op 2-D.  Scalars accumulate into
+a (1, 1) VMEM block.  Row blocks are DOUBLE-BUFFERED by the grid pipeline:
+Mosaic overlaps block i's DMA with block i-1's compute, which is what the
+block-sizing budget below reserves 2x the input window for.
 
 Gramian precision (measured on v5e, benchmarks/HOTLOOP_r03.md): the r02
 kernel hard-coded ``Precision.HIGHEST`` — 6 bf16 MXU passes — which made it
 3x slower than its own compute floor (43 ms vs 16 ms per pass at 2Mx512).
-``precision`` is now a parameter wired to ``config.resolve_matmul_precision``:
+``precision`` is a parameter wired to ``config.resolve_matmul_precision``:
 large-n fits run DEFAULT (one bf16-multiply pass, f32 accumulation — the
 same product rounding the einsum engine's default has), small-n R-parity
-fits keep HIGHEST.  eta and X'Wz stay f32 on the VPU at either setting
-(a bf16 eta amplifies into ~1e-3 relative X'Wz error — measured in r02).
+fits keep HIGHEST.  eta and X'Wz stay f32 on the VPU at either setting.
+
+bfloat16 master copy: passing a bf16 ``X`` halves the HBM bytes per pass —
+the dominant per-iteration cost at large n — and upcasts to f32 *in VMEM*;
+all elementwise math and both accumulators stay f32, so only the storage
+rounding (~2^-9 per entry) enters.  ``fused_block_rows`` sizes blocks by
+the storage itemsize, so the bf16 path also pipelines larger windows.
 """
 
 from __future__ import annotations
@@ -49,7 +73,42 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .factor_gramian import design_gramian, design_matvec
+
 _TINY = 1e-30
+
+
+def _sanitize(x, valid, fill=0.0):
+    """Padded (weight-0) rows can produce inf/nan in link space (e.g. the
+    gamma inverse link at eta=0); 0 * nan would poison the psum, so select
+    before reducing.  Canonical definition — the einsum kernel
+    (models/glm.py), the structured pass (ops/factor_gramian.py) and both
+    fused twins all route through this one expression, which is what makes
+    their f64 results bit-identical."""
+    return jnp.where(valid, jnp.nan_to_num(x, nan=fill, posinf=fill, neginf=fill), fill)
+
+
+def irls_weights(y, wt, offset, eta, mu, *, family, link, valid):
+    """Working weights and working response at (eta, mu) — the one
+    Fisher-scoring row recipe shared by every Gramian driver::
+
+        g = link'(mu);  V = family.variance(mu)
+        w = wt / max(V g^2, tiny)
+        z = eta - offset + (y - mu) g
+
+    (ref: GLM.scala:359-395).  Callers: the einsum kernel's chol and qr
+    branches (models/glm.py::_irls_core — the fleet engine vmaps the same
+    graph), :func:`fused_fisher_pass_ref` (solo fused fits on CPU and the
+    streaming dense chunk pass), and ``structured_fisher_pass``
+    (ops/factor_gramian.py — streaming structured chunks).  One expression,
+    one rounding behaviour: all three drivers produce the same (w, z) bits
+    from the same (eta, mu).
+    """
+    g = link.deriv(mu)
+    var = family.variance(mu)
+    w = _sanitize(wt / jnp.maximum(var * g * g, _TINY), valid)
+    z = _sanitize(eta - offset + (y - mu) * g, valid)
+    return w, z
 
 
 def resolve_kernel_precision(precision) -> jax.lax.Precision:
@@ -61,28 +120,40 @@ def resolve_kernel_precision(precision) -> jax.lax.Precision:
     return jax.lax.Precision.HIGHEST
 
 
-def fused_block_rows(p: int, precision=None) -> int:
+def fused_block_rows(p: int, precision=None, dtype=None) -> int:
     """Largest power-of-two row block fitting the kernel's VMEM budget
-    (~10 MB of the 16 MB/core).  DEFAULT precision holds the f32 block
-    (double-buffered input + Xw scratch = ~12 bytes/element) plus the
-    (p, p) f32 accumulator; HIGHEST additionally splits both dot operands
-    into 3 bf16 passes (~48 bytes/element, r02 formula — block 1024 at
-    p=512 OOMs scoped vmem, measured)."""
+    (~10 MB of the 16 MB/core), sized by the STORAGE itemsize of ``dtype``
+    (default f32).
+
+    Per-element accounting: the grid pipeline double-buffers the input
+    window at storage width (2 x itemsize); DEFAULT precision adds one f32
+    scratch for Xw (a bf16 X feeds the MXU directly under DEFAULT, so its
+    f32 upcast is transient, not resident) — 12 B/elem at f32, 8 B/elem at
+    bf16, which is why the bf16 master-copy path pipelines larger windows
+    as well as reading half the HBM bytes.  HIGHEST additionally splits
+    both dot operands into 3 bf16 passes (~48 B/elem, r02 formula — block
+    1024 at p=512 OOMs scoped vmem, measured).  The (p, p) f32 accumulator
+    stays resident either way."""
     budget = 10 * 1024 * 1024
-    per_elem = 48 if resolve_kernel_precision(precision) != jax.lax.Precision.DEFAULT else 12
+    itemsize = jnp.dtype(dtype).itemsize if dtype is not None else 4
+    if resolve_kernel_precision(precision) != jax.lax.Precision.DEFAULT:
+        per_elem = 48
+    else:
+        per_elem = 2 * itemsize + 4
     avail = budget - 4 * p * p  # the f32 Gramian accumulator stays resident
     b = max(128, avail // (per_elem * p)) if avail > 0 else 128
     return min(1024, 1 << (int(b).bit_length() - 1))
 
 
 def _step_math(X, y, wt, off, beta_row, *, family, link, first):
-    """Shared math for both twins: returns (Xw, z, w, dev_block_sum).
+    """Mosaic-kernel block math: returns (Xw, z, w, dev_block_sum).
 
     All of y/wt/off are (blk, 1); X is (blk, p); beta_row is (1, p).
     The eta matvec is a VPU f32 reduction, NOT an MXU matmul — Mosaic rounds
     f32 matmul operands towards bf16, and z = eta + (y-mu)*g amplifies that
     into ~1e-3 relative error in X'Wz (measured); the elementwise form stays
-    at f32 accuracy.
+    at f32 accuracy.  (The XLA twin uses the einsum engine's matmul eta
+    instead — see :func:`fused_fisher_pass_ref`.)
 
     A bfloat16 X (the warm-up phase of the mixed-precision IRLS schedule:
     half the HBM read per pass) is upcast to f32 here — all elementwise
@@ -98,16 +169,10 @@ def _step_math(X, y, wt, off, beta_row, *, family, link, first):
     else:
         eta = jnp.sum(X * beta_row, axis=1, keepdims=True) + off
         mu = jnp.where(valid, link.inverse(eta), 1.0)
-    g = link.deriv(mu)
-    var = family.variance(mu)
-    w_raw = wt / jnp.maximum(var * g * g, _TINY)
-    w = jnp.where(valid, jnp.nan_to_num(w_raw, nan=0.0, posinf=0.0, neginf=0.0), 0.0)
-    z_raw = eta - off + (y - mu) * g
-    z = jnp.where(valid, jnp.nan_to_num(z_raw, nan=0.0, posinf=0.0, neginf=0.0), 0.0)
-    dev = jnp.sum(jnp.where(
-        valid,
-        jnp.nan_to_num(family.dev_resids(y, mu, wt), nan=0.0, posinf=0.0, neginf=0.0),
-        0.0), keepdims=True).reshape(1, 1)
+    w, z = irls_weights(y, wt, off, eta, mu, family=family, link=link,
+                        valid=valid)
+    dev = jnp.sum(_sanitize(family.dev_resids(y, mu, wt), valid),
+                  keepdims=True).reshape(1, 1)
     return X * w, z, w, dev
 
 
@@ -151,12 +216,19 @@ def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
                       first: bool = False, block_rows: int = 512,
                       interpret: bool = False, precision=None,
                       fam_param=None):
-    """One fused IRLS data pass over a *local* (unsharded) row block.
+    """One fused IRLS data pass over a *local* (unsharded) row block,
+    evaluated AT ``beta``: returns the Gramian, the score RHS, and the
+    deviance all belonging to the same beta (v2 contract — the driver
+    calls this at the UPDATED beta each iteration, see module docstring).
 
     Args:
-      X: (n, p) float32, n divisible by ``block_rows`` (pad with wt=0 rows).
+      X: (n, p) float32 or bfloat16 (master-copy warm-up: half the HBM
+        bytes, f32 math in VMEM), n divisible by ``block_rows`` (pad with
+        wt=0 rows).
       y/wt/offset: (n,) per-row vectors; padding rows must have wt == 0.
-      beta: (p,) current coefficients (ignored when ``first``).
+      beta: (p,) coefficients to evaluate at (ignored when ``first``:
+        the family-init pass needs no beta and returns the init-mu
+        deviance, the cold-start baseline).
       fam_param: TRACED scalar family parameter (negbin theta) — rides the
         kernel as a (1, 1) SMEM operand, so glm.nb's whole theta search
         reuses ONE compiled kernel (the family hash excludes the value).
@@ -219,25 +291,37 @@ def fused_fisher_pass(X, y, wt, offset, beta, *, family, link,
 def fused_fisher_pass_ref(X, y, wt, offset, beta, *, family, link,
                           first: bool = False, block_rows: int = 512,
                           precision=None, fam_param=None):
-    """Plain-XLA twin of :func:`fused_fisher_pass` (identical math/signature);
-    used on CPU meshes and as the correctness oracle for the kernel.  The
-    Gramian precision default MIRRORS the Mosaic kernel (None -> DEFAULT for
-    f32) so the parity harnesses compare the same computation; float64
-    (which the kernel cannot run) always gets HIGHEST.  X'Wz stays HIGHEST
-    either way — it is one matvec, and the kernel keeps it f32 on the VPU."""
+    """Plain-XLA twin of :func:`fused_fisher_pass` (same signature and v2
+    at-``beta`` contract); the path every CPU mesh and the streaming dense
+    chunk pass run, and the correctness oracle for the Mosaic kernel.
+
+    Built from the einsum engine's EXACT ops — ``design_matvec`` for eta
+    (the ``etaCreate`` matmul, GLM.scala:321-332), :func:`irls_weights`
+    for (w, z), ``design_gramian`` for the contraction, ``_sanitize``
+    ahead of the deviance sum — with ``precision`` passed through raw
+    (None on CPU, where it is a no-op, exactly as models/glm.py::
+    ``_irls_core`` hands it down).  Consequence: a float64 fused-engine
+    fit solves the same normal equations from the same bits as the einsum
+    engine at every iteration, so coefficients AND iteration counts match
+    bit-identically (tests/test_fused_v2_parity.py).  ``block_rows`` is
+    accepted for signature parity and unused — XLA fuses the whole pass.
+    """
+    del block_rows
     n, p = X.shape
     family = family.with_param(fam_param)
-    yc, wc, oc = (a.reshape(n, 1) for a in (y, wt, offset))
-    Xw, z, _, dev = _step_math(X, yc, wc, oc, beta.reshape(1, p),
-                               family=family, link=link, first=first)
     if X.dtype == jnp.bfloat16:  # mirror the kernel: f32 math/accumulation
         X = X.astype(jnp.float32)
-    gram_prec = (jax.lax.Precision.HIGHEST if X.dtype == jnp.float64
-                 else resolve_kernel_precision(precision))
-    XtWX = jax.lax.dot_general(Xw, X, (((0,), (0,)), ((), ())),
-                               preferred_element_type=X.dtype,
-                               precision=gram_prec)
-    XtWz = jax.lax.dot_general(Xw, z, (((0,), (0,)), ((), ())),
-                               preferred_element_type=X.dtype,
-                               precision=jax.lax.Precision.HIGHEST)
-    return XtWX, XtWz[:, 0], dev[0, 0]
+    acc = X.dtype if X.dtype == jnp.float64 else jnp.float32
+    valid = wt > 0.0
+    if first:
+        mu = jnp.where(valid, family.init_mu(y, jnp.maximum(wt, _TINY)), 1.0)
+        eta = link.link(mu)
+    else:
+        eta = (design_matvec(X, beta) + offset).astype(X.dtype)
+        mu = jnp.where(valid, link.inverse(eta), 1.0).astype(X.dtype)
+    w, z = irls_weights(y, wt, offset, eta, mu, family=family, link=link,
+                        valid=valid)
+    XtWX, XtWz = design_gramian(X, z, w, accum_dtype=acc,
+                                precision=precision)
+    dev = jnp.sum(_sanitize(family.dev_resids(y, mu, wt), valid)).astype(acc)
+    return XtWX, XtWz, dev

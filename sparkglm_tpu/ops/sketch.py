@@ -137,8 +137,24 @@ def sparse_gramian(sp: SparseDesign, z, w, *, accum_dtype=jnp.float32,
             C.ravel(), num_segments=S + 1)[:S]
     else:
         G_sd = jnp.zeros((S, 0), acc)
-    joint = (C.astype(jnp.int64)[:, :, None] * (S + 1)
-             + C[:, None, :]).reshape(n * k * k)
+    # the joint index spans (S+1)^2 segments: int32 is exact up to
+    # S+1 = 46340 and is all this op ever needs below that — asking for
+    # int64 unconditionally was a silent int32 downcast plus a UserWarning
+    # per trace under disabled x64 (the BENCH_r11 CPU-fallback log spam).
+    # Past the int32 ceiling the index NEEDS x64; overflowing silently
+    # would scatter cross terms into wrong cells, so refuse loudly.
+    if (S + 1) * (S + 1) - 1 > np.iinfo(np.int32).max:
+        from ..config import x64_enabled
+        if not x64_enabled():
+            raise ValueError(
+                f"sparse_gramian's joint index needs ({S + 1})^2 segments, "
+                "beyond int32 — enable jax x64 or fit with "
+                "engine='sketch' (never materialises the sparse Gramian)")
+        idx_dt = jnp.int64
+    else:
+        idx_dt = jnp.int32
+    joint = (C.astype(idx_dt)[:, :, None] * (S + 1)
+             + C[:, None, :].astype(idx_dt)).reshape(n * k * k)
     prod = (Vw[:, :, None] * V[:, None, :]).astype(acc).reshape(n * k * k)
     G_ss = jax.ops.segment_sum(
         prod, joint, num_segments=(S + 1) * (S + 1)

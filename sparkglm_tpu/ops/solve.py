@@ -29,6 +29,24 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import cho_factor, cho_solve
 
+# optimization_barrier ships without a vmap batching rule (jax 0.4.x), but
+# the fleet engine vmaps the whole IRLS kernel (fleet/kernel.py) through
+# the barriers below.  The barrier is identity-shaped — batching it is
+# binding it on the batched operands with the batch dims untouched.
+def _register_barrier_batching():
+    from jax.interpreters import batching
+    try:
+        from jax._src.lax.lax import optimization_barrier_p as _prim
+    except ImportError:  # moved/renamed upstream: newer jax ships its own rule
+        return
+    if _prim not in batching.primitive_batchers:
+        def _rule(args, dims):
+            return _prim.bind(*args), dims
+        batching.primitive_batchers[_prim] = _rule
+
+
+_register_barrier_batching()
+
 
 def _prepare(XtWX, jitter):
     """Symmetrise, Jacobi-equilibrate, and jitter the Gramian.
@@ -52,7 +70,20 @@ def _prepare(XtWX, jitter):
 def solve_normal(XtWX, XtWz, *, jitter: float = 0.0, refine_steps: int = 1):
     """Solve ``(X'WX) beta = X'Wz``; returns ``(beta, factor)`` — pass the
     factor to :func:`inv_from_cho` / :func:`diag_inv_from_cho` for
-    covariance diagnostics."""
+    covariance diagnostics.
+
+    The barriers pin the solve as its own fusion region: every engine's
+    compiled program then contains this exact subgraph, so identical
+    ``(XtWX, XtWz)`` bits give identical beta bits no matter what produced
+    or consumes them.  Without them XLA fuses the refinement's small-p
+    matvec/elementwise ops INTO the surrounding loop body differently per
+    engine (FMA contraction choices), and the einsum and fused drivers
+    drift apart by a few ulps despite bit-identical normal equations —
+    which is the cross-engine contract tests/test_fused_v2_parity.py
+    holds.  Cost: nothing — the operands are p-sized, and the barrier
+    only constrains instruction scheduling, not the math.
+    """
+    XtWX, XtWz = jax.lax.optimization_barrier((XtWX, XtWz))
     A, As, dinv = _prepare(XtWX, jitter)
     cho = cho_factor(As)
     beta = dinv * cho_solve(cho, dinv * XtWz)
@@ -73,6 +104,7 @@ def solve_normal(XtWX, XtWz, *, jitter: float = 0.0, refine_steps: int = 1):
             beta = jnp.where(better, cand, beta)
             r = jnp.where(better, r_c, r)
             rn = jnp.where(better, rn_c, rn)
+    beta = jax.lax.optimization_barrier(beta)
     return beta, (cho, dinv)
 
 
