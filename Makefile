@@ -8,7 +8,7 @@ CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve serve_async \
-        categorical penalized elastic sketch fleet hotloop clean
+        categorical penalized elastic sketch fleet hotloop online clean
 
 all: native
 
@@ -98,6 +98,15 @@ fleet:
 hotloop:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fused.py \
 		tests/test_fused_v2_parity.py -q
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# online continuous learning (sparkglm_tpu/online): decayed-suffstat
+# closed-form refresh vs full-refit parity, drift-gated auto-deploy with
+# zero steady-state recompiles, regression auto-rollback, resume
+# bit-identity — plus the online_refresh bench block (chunks/s, refresh
+# latency, steady-state executable count == 0)
+online:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m online
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:

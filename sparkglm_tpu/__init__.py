@@ -16,7 +16,8 @@ Quick start::
 
 from .api import (TermsPrediction, confint_profile, glm, glm_fleet,
                   glm_from_csv, glm_from_json, glm_from_parquet, glm_nb, lm,
-                  lm_from_csv, lm_from_json, lm_from_parquet, predict, update)
+                  lm_from_csv, lm_from_json, lm_from_parquet, online_fleet,
+                  predict, update)
 from .fleet import FleetModel, fit_many, glm_fit_fleet
 from .data.json import read_json, scan_json_levels, scan_json_schema
 from .data.parquet import (read_parquet, scan_parquet_levels,
@@ -52,11 +53,12 @@ from .parallel import distributed
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
 from .penalized import ElasticNet, PathModel
 from .obs import FitTracer, JsonlSink, MetricsRegistry, RingBufferSink
+from .online import DriftGate, OnlineLoop, OnlineSuffStats
 from .serve import (AsyncEngine, BatchPolicy, EnginePolicy, FamilyScorer,
                     MicroBatcher, ModelFamily, ModelRegistry,
                     ReplicatedScorer, Scorer)
 from .utils import profiling
-from . import elastic, fleet, obs, robust, serve
+from . import elastic, fleet, obs, online, robust, serve
 
 __version__ = "0.1.0"
 
@@ -92,4 +94,5 @@ __all__ = [
     "AsyncEngine", "EnginePolicy", "ReplicatedScorer",
     "fleet", "fit_many", "glm_fit_fleet", "glm_fleet", "FleetModel",
     "ModelFamily", "FamilyScorer",
+    "online", "online_fleet", "OnlineLoop", "OnlineSuffStats", "DriftGate",
 ]

@@ -263,7 +263,8 @@ def _reject_fleet_args(*, engine="auto", penalty=None, design="dense",
         raise ValueError(
             "fleet fitting does not support beta0=/on_iteration=/"
             "checkpoint_every= (the fleet kernel runs all models to "
-            "convergence in one pass)")
+            "convergence in one pass) — to warm-start a refit pass "
+            "stacked (K, p) coefficients via start= instead")
 
 
 def lm(formula: str, data, *, weights=None, offset=None,
@@ -421,6 +422,7 @@ def glm_fleet(formula: str, data, *, groups, family="binomial", link=None,
               max_iter: int = 100, criterion: str = "relative",
               na_omit: bool = True, batch: str = "exact",
               bucket: int | None = None, sort: bool = True,
+              start=None,
               verbose: bool = False, trace=None, metrics=None,
               engine: str = "auto", penalty=None, design: str = "dense",
               mesh=None, beta0=None, on_iteration=None,
@@ -438,9 +440,11 @@ def glm_fleet(formula: str, data, *, groups, family="binomial", link=None,
     :class:`~sparkglm_tpu.fleet.FleetModel`; ``fleet["label"]`` is an
     ordinary GLMModel carrying this formula's terms for ``predict``.
 
-    ``batch``/``bucket`` tune the fleet kernel (see fleet/); solo-fit
+    ``batch``/``bucket`` tune the fleet kernel (see fleet/); ``start``
+    warm-starts every member from stacked (K, p) coefficients in group
+    order — the online refresh path (``sparkglm_tpu/online``).  Solo-fit
     scale-out options (``engine='sketch'/'elastic'``, ``penalty=``,
-    ``design='structured'``, ``mesh=``, warm-start/checkpoint hooks) do
+    ``design='structured'``, ``mesh=``, ``beta0=``/checkpoint hooks) do
     not apply and are rejected loudly.
     """
     _reject_fleet_args(engine=engine, penalty=penalty, design=design,
@@ -472,10 +476,64 @@ def glm_fleet(formula: str, data, *, groups, family="binomial", link=None,
         group_name=group_name, family=family, link=link, tol=tol,
         max_iter=max_iter, criterion=criterion, xnames=terms.xnames,
         yname=f.response, has_intercept=f.intercept, batch=batch,
-        bucket=bucket, verbose=verbose, trace=trace, metrics=metrics,
-        config=config)
+        bucket=bucket, start=start, verbose=verbose, trace=trace,
+        metrics=metrics, config=config)
     import dataclasses
     return dataclasses.replace(fleet, formula=str(f), terms=terms)
+
+
+def online_fleet(formula: str, data, *, groups, family="gaussian",
+                 link=None, name: str | None = None,
+                 weights=None, offset=None,
+                 rho: float = 0.99, window_rows: int = 128,
+                 drift_threshold: float = 0.25,
+                 reference_chunks: int = 4, window_chunks: int = 4,
+                 min_count: int = 8,
+                 deviance_tolerance: float = 0.05,
+                 rollback_tolerance: float | None = None,
+                 watch_chunks: int = 4, jitter: float = 0.0,
+                 tol: float = 1e-8, max_iter: int = 100,
+                 batch: str = "exact", bucket: int | None = None,
+                 trace=None, metrics=None,
+                 config: NumericConfig = DEFAULT):
+    """Seed a per-group GLM fleet from ``data`` and return an armed
+    :class:`~sparkglm_tpu.online.OnlineLoop` — the continuous-learning
+    front-end.
+
+    Runs :func:`glm_fleet` on the seed frame, wraps the result as a
+    served :class:`~sparkglm_tpu.serve.ModelFamily` (one tenant per
+    group, seed fit deployed as version 1), and builds the loop around
+    it: feed ``loop.step(tenants, X, y)`` chunks (or ``loop.run(source)``
+    over a streaming source) and drifted tenants are refreshed —
+    closed-form for gaussian/identity, warm fleet refits otherwise —
+    shadow-gated, auto-deployed and regression-watched.  Serve the SAME
+    family concurrently via ``loop.family.async_engine()``; deploys land
+    through the generation counter, recompile-free.
+
+    Chunks are design-level: ``X`` must carry the seed design's columns
+    (``loop.family`` validates width).  ``name`` labels the family
+    (defaults to the ``groups`` column name).  The loop knobs (``rho``,
+    ``window_rows``, drift/window thresholds, tolerances) are documented
+    on :class:`~sparkglm_tpu.online.OnlineLoop`.
+    """
+    from .online import OnlineLoop
+    from .serve import ModelFamily
+
+    fleet = glm_fleet(formula, data, groups=groups, family=family,
+                      link=link, weights=weights, offset=offset, tol=tol,
+                      max_iter=max_iter, batch=batch, bucket=bucket,
+                      trace=trace, metrics=metrics, config=config)
+    fam_name = name if name is not None else (
+        groups if isinstance(groups, str) else "fleet")
+    fam = ModelFamily.from_fleet(fleet, fam_name, metrics=metrics)
+    return OnlineLoop(
+        fam, rho=rho, window_rows=window_rows,
+        drift_threshold=drift_threshold,
+        reference_chunks=reference_chunks, window_chunks=window_chunks,
+        min_count=min_count, deviance_tolerance=deviance_tolerance,
+        rollback_tolerance=rollback_tolerance, watch_chunks=watch_chunks,
+        jitter=jitter, tol=tol, max_iter=max_iter, batch=batch,
+        trace=trace, metrics=metrics, config=config)
 
 
 def _stream_io(path, *, chunk_bytes, native, backend: str = "auto",

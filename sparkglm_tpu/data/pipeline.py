@@ -63,7 +63,7 @@ from typing import Callable, Iterator
 
 from ..obs import trace as _obs_trace
 
-__all__ = ["PassStats", "prefetch_iter"]
+__all__ = ["PassStats", "prefetch_iter", "tee_source"]
 
 _ITEM, _ERR, _DONE, _HAND = "item", "err", "done", "hand"
 
@@ -127,6 +127,84 @@ def prefetch_iter(make_iter: Callable[[], Iterator], prefetch: int,
         raise ValueError(f"prefetch must be >= 1, got {prefetch}")
     return _prefetch_gen(make_iter, int(prefetch), stats,
                          bool(auto_degrade))
+
+
+def tee_source(source: Callable[[], Iterator], n: int = 2, *,
+               max_lag: int = 64) -> tuple:
+    """Split one chunk source into ``n`` sources yielding the same chunks.
+
+    The underlying source is iterated ONCE (it may be a one-shot stream —
+    a socket, a live feed); each returned zero-arg callable replays every
+    chunk in order.  This is the chunk tee the online loop uses
+    (sparkglm_tpu/online/loop.py): one pass over live traffic feeds both
+    a streaming fit and the continuous-learning loop without re-reading.
+
+    Thunk chunks (the streaming source convention allows callables that
+    realize to ``(X, y, w, offset)``) are realized once, here, so branches
+    share one materialization instead of re-running the thunk per branch.
+
+    ``max_lag`` bounds how far apart the branches may drift: the fastest
+    branch buffers at most ``max_lag`` chunks the slowest has not consumed
+    yet, and raises rather than grow without bound.  Branches are single-
+    pass (each callable may be called once).
+    """
+    if n < 1:
+        raise ValueError(f"tee fan-out must be >= 1, got {n}")
+    if max_lag < 1:
+        raise ValueError(f"max_lag must be >= 1, got {max_lag}")
+    lock = threading.Lock()
+    state = {"it": None, "done": False, "err": None}
+    bufs = [[] for _ in range(n)]   # per-branch pending chunks
+    used = [False] * n
+
+    def _pull_locked():
+        """Advance the shared iterator by one chunk into every buffer."""
+        if state["err"] is not None:
+            raise state["err"]
+        if state["done"]:
+            return False
+        if state["it"] is None:
+            state["it"] = iter(source())
+        if any(len(b) >= max_lag for b in bufs):
+            raise RuntimeError(
+                f"tee branches drifted more than max_lag={max_lag} chunks "
+                "apart; consume them in closer lockstep or raise max_lag")
+        try:
+            item = next(state["it"])
+        except StopIteration:
+            state["done"] = True
+            state["it"] = None
+            return False
+        except BaseException as e:  # noqa: BLE001 — replayed per branch
+            state["err"] = e
+            state["it"] = None
+            raise
+        if callable(item):
+            item = item()
+        for b in bufs:
+            b.append(item)
+        return True
+
+    def _branch(i: int) -> Callable[[], Iterator]:
+        def make_iter():
+            with lock:
+                if used[i]:
+                    raise RuntimeError(
+                        "tee branches are single-pass; call tee_source "
+                        "again for another pass")
+                used[i] = True
+
+            def gen():
+                while True:
+                    with lock:
+                        if not bufs[i] and not _pull_locked():
+                            return
+                        item = bufs[i].pop(0)
+                    yield item
+            return gen()
+        return make_iter
+
+    return tuple(_branch(i) for i in range(n))
 
 
 def _prefetch_gen(make_iter, prefetch, stats, auto_degrade):

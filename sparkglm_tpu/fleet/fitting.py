@@ -81,6 +81,7 @@ def glm_fit_fleet(
     batch: str = "exact",
     bucket: int | None = None,
     min_bucket: int = MIN_BUCKET,
+    start=None,
     verbose: bool = False,
     trace=None,
     metrics=None,
@@ -94,6 +95,13 @@ def glm_fit_fleet(
     bit-identical to solo fits of the same row layout at f64;
     ``batch="vmap"`` batches iterations across models with masked updates
     (roundoff-level agreement, throughput mode).  See fleet/kernel.py.
+
+    ``start`` (R's ``start=``) warm-starts every member from a stacked
+    (K, p) coefficient init — the online refresh path
+    (``sparkglm_tpu/online``): a warm refit at a fixed ``bucket`` reuses
+    the warm executable, so steady-state refresh compiles nothing.  Warm
+    and cold fits share the same fixed point (the IRLS map's attractor);
+    only the iteration count differs.
 
     Singular members (rank-deficient weighted Gramian) do not raise as a
     solo fit would: they come back with NaN coefficients, converged=False
@@ -188,6 +196,17 @@ def glm_fit_fleet(
     wb[:K] = wt64.astype(dtype)
     ob[:K] = off64.astype(dtype)
 
+    warm = start is not None
+    bb = None
+    if warm:
+        start = np.asarray(start, np.float64)
+        if start.shape != (K, p):
+            raise ValueError(
+                f"start must be stacked (K, p) = ({K}, {p}) coefficients, "
+                f"got {start.shape}")
+        bb = np.zeros((B, p), dtype)
+        bb[:K] = start.astype(dtype)
+
     if tracer is not None:
         tracer.emit("fleet_start", models=K, bucket=B, n_rows=n, p=p,
                     family=fam.name, link=lnk.name, batch=batch,
@@ -202,7 +221,7 @@ def glm_fit_fleet(
         family=fam, link=lnk, criterion=criterion,
         refine_steps=config.refine_steps,
         precision=config.matmul_precision, batch=batch,
-        fam_param=fam_param)
+        fam_param=fam_param, beta0=bb, warm=warm)
     out = jax.tree.map(np.asarray, out)
     executables = fleet_kernel_cache_size() - n_exec0
 

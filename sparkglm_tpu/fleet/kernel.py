@@ -42,7 +42,8 @@ BATCH_MODES = ("exact", "vmap")
 
 
 @partial(jax.jit, static_argnames=("family", "link", "criterion",
-                                   "refine_steps", "precision", "batch"))
+                                   "refine_steps", "precision", "batch",
+                                   "warm"))
 def _irls_fleet_kernel(
     X, y, wt, offset,
     tol, max_iter, jitter,
@@ -52,23 +53,33 @@ def _irls_fleet_kernel(
     precision=None,
     batch: str = "exact",
     fam_param=None,
+    beta0=None,
+    warm: bool = False,
 ):
     """Run IRLS for a stacked fleet: X (K, n, p); y/wt/offset (K, n).
+
+    ``warm=True`` starts every member from its row of ``beta0`` (K, p)
+    instead of the family init — the online refresh path
+    (sparkglm_tpu/online): a warm fleet refit at a fixed bucket shares one
+    executable with every later refresh.  Trash models (all-zero weights)
+    pass a zero beta0 row and stay inert exactly as in the cold path.
 
     Returns the solo kernel's output dict with a leading (K,) axis on every
     leaf (beta (K, p), cov_inv (K, p, p), dev/iters/converged/singular/
     pivot (K,), eta (K, n), XtWX0 (K, p, p)).
     """
-    def one(Xk, yk, wk, ok):
+    def one(Xk, yk, wk, ok, bk=None):
         return _irls_core(
             Xk, yk, wk, ok, tol, max_iter, jitter,
             family=family, link=link, criterion=criterion,
             refine_steps=refine_steps, trace=False, precision=precision,
-            solver="chol", mesh=None, warm=False, fam_param=fam_param)
+            solver="chol", mesh=None, beta0=bk, warm=warm,
+            fam_param=fam_param)
 
+    ops = (X, y, wt, offset) + ((beta0,) if warm else ())
     if batch == "vmap":
-        return jax.vmap(one)(X, y, wt, offset)
-    return jax.lax.map(lambda ops: one(*ops), (X, y, wt, offset))
+        return jax.vmap(one)(*ops)
+    return jax.lax.map(lambda o: one(*o), ops)
 
 
 def fleet_kernel_cache_size() -> int:

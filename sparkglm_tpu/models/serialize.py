@@ -29,7 +29,12 @@ import numpy as np
 # ``np.savez`` writes fixed zip timestamps, so serialization is
 # byte-deterministic: indexing a deserialized fleet and saving the member
 # yields the SAME bytes as saving it before the round-trip.
-_FORMAT_VERSION = 4
+# v5: online continuous-learning state — an OnlineLoop artifact embeds the
+# whole ModelFamily (every version + deploy history, the v4 layout) PLUS
+# the loop's decayed sufficient statistics, retained-row rings, drift-gate
+# histograms and regression-watch state (``ol__`` key prefixes), so a
+# restarted loop resumes bit-identically (tests/test_online.py).
+_FORMAT_VERSION = 5
 
 
 def _split(model) -> tuple[dict, dict]:
@@ -52,8 +57,11 @@ def _split(model) -> tuple[dict, dict]:
 
 
 def save_model(model, path: str) -> None:
+    from ..online.loop import OnlineLoop
     from ..serve.registry import ModelFamily
 
+    if isinstance(model, OnlineLoop):
+        return _save_online(model, path)
     if isinstance(model, ModelFamily):
         return _save_family(model, path)
     arrays, meta = _split(model)
@@ -79,6 +87,29 @@ def _save_family(family, path: str) -> None:
                            cls=type(mdl).__name__, meta=mm))
     meta = dict(fam_meta, models=models, __class__="ModelFamily",
                 __format__=_FORMAT_VERSION,
+                schema_version=_FORMAT_VERSION)
+    header = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(path, __meta__=header, **arrays)
+
+
+def _save_online(loop, path: str) -> None:
+    """An OnlineLoop artifact: the v4 ModelFamily layout (``m{i}__``
+    member prefixes + deploy state) plus the loop's own arrays under
+    ``ol__`` prefixes and its JSON meta under ``online`` — one read
+    resumes serving AND learning bit-identically."""
+    members, fam_meta = loop.family._export()
+    arrays, models = {}, []
+    for i, (tenant, version, mdl) in enumerate(members):
+        a, mm = _split(mdl)
+        for k, v in a.items():
+            arrays[f"m{i}__{k}"] = v
+        models.append(dict(tenant=tenant, version=int(version),
+                           cls=type(mdl).__name__, meta=mm))
+    ol_arrays, ol_meta = loop._export()
+    for k, v in ol_arrays.items():
+        arrays[f"ol__{k}"] = v
+    meta = dict(fam_meta, models=models, online=ol_meta,
+                __class__="OnlineLoop", __format__=_FORMAT_VERSION,
                 schema_version=_FORMAT_VERSION)
     header = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
     np.savez(path, __meta__=header, **arrays)
@@ -115,6 +146,7 @@ def _build(cls, meta: dict, arrays: dict):
 
 def load_model(path: str):
     from ..fleet.model import FleetModel
+    from ..online.loop import OnlineLoop
     from ..serve.registry import ModelFamily
 
     with np.load(path if str(path).endswith(".npz") else str(path) + ".npz") as z:
@@ -124,7 +156,7 @@ def load_model(path: str):
     fmt = meta.pop("__format__", 1)
     schema = int(meta.pop("schema_version", fmt))
     classes = dict(_member_classes(), FleetModel=FleetModel,
-                   ModelFamily=ModelFamily)
+                   ModelFamily=ModelFamily, OnlineLoop=OnlineLoop)
     if cls_name not in classes:
         raise ValueError(
             f"{path!r} is not a sparkglm model artifact (header class "
@@ -147,7 +179,7 @@ def load_model(path: str):
             "(format v1): update()/drop1()/confint_profile cannot detect a "
             "fit-time weights= or m= argument on it — re-pass those "
             "explicitly if the original fit used them", stacklevel=2)
-    if cls_name == "ModelFamily":
+    if cls_name in ("ModelFamily", "OnlineLoop"):
         member_classes = _member_classes()
         members = []
         for i, rec in enumerate(meta.pop("models")):
@@ -157,5 +189,11 @@ def load_model(path: str):
                         if k.startswith(pre)}
             members.append((rec["tenant"], int(rec["version"]),
                             _build(mcls, dict(rec["meta"]), m_arrays)))
-        return ModelFamily._restore(members, meta)
+        if cls_name == "ModelFamily":
+            return ModelFamily._restore(members, meta)
+        online_meta = meta.pop("online")
+        family = ModelFamily._restore(members, meta)
+        ol_arrays = {k[4:]: v for k, v in arrays.items()
+                     if k.startswith("ol__")}
+        return OnlineLoop._restore(family, ol_arrays, online_meta)
     return _build(cls, meta, arrays)

@@ -20,7 +20,7 @@ import math
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry"]
+           "get_registry", "tv_distance"]
 
 
 class Counter:
@@ -99,6 +99,16 @@ class Histogram:
                 return float(min(max(est, self.min), self.max))
         return float(self.max)  # pragma: no cover - cum == count >= target
 
+    def distribution(self) -> dict[int, float]:
+        """Normalized bucket mass ``{k: P(obs in bucket k)}`` — the
+        log2-shape of the observed distribution, independent of count.
+        Drift gates (sparkglm_tpu/online/drift.py) compare a live
+        window's distribution against a frozen reference window's via
+        :func:`tv_distance`."""
+        if not self.count:
+            return {}
+        return {k: n / self.count for k, n in sorted(self.buckets.items())}
+
     def snapshot(self):
         return {
             "count": self.count,
@@ -111,6 +121,22 @@ class Histogram:
             "bucket_le": {f"2^{k}": n
                           for k, n in sorted(self.buckets.items())},
         }
+
+
+def tv_distance(a, b) -> float:
+    """Total-variation distance between two log2-bucket distributions —
+    ``0.5 * sum_k |P_a(k) - P_b(k)|`` in [0, 1].  Accepts
+    :class:`Histogram` instances or ``{bucket: mass}`` dicts (e.g. from
+    :meth:`Histogram.distribution`).  Two empty histograms are identical
+    (distance 0); empty vs non-empty is maximal (distance 1)."""
+    da = a.distribution() if isinstance(a, Histogram) else dict(a)
+    db = b.distribution() if isinstance(b, Histogram) else dict(b)
+    if not da and not db:
+        return 0.0
+    if not da or not db:
+        return 1.0
+    keys = set(da) | set(db)
+    return 0.5 * sum(abs(da.get(k, 0.0) - db.get(k, 0.0)) for k in keys)
 
 
 class MetricsRegistry:

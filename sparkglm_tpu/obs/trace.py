@@ -37,6 +37,18 @@ structured event stream:
                                 the combine after the retry budget
   ``combine`` / ``polish``      the elastic one-shot merge of shard
                                 results and the final polishing pass
+  ``chunk_ingested``            one online-loop chunk absorbed into the
+                                decayed sufficient statistics
+                                (sparkglm_tpu/online/loop.py)
+  ``drift_detected`` / ``refresh_start`` / ``refresh_end`` /
+  ``auto_deploy`` / ``auto_rollback``  online continuous learning
+                                (sparkglm_tpu/online): a drift gate firing
+                                (per-tenant TV distance vs the frozen
+                                reference window), one fleet refresh
+                                (closed-form or warm refit; executables
+                                compiled must be 0 in steady state), and
+                                the gated deploy / regression rollback
+                                decisions
 
 Events are ordered by a per-tracer monotone sequence number assigned under
 a lock, so two runs of the same deterministic fit produce the same
@@ -236,6 +248,10 @@ class FitTracer:
         # engine="auto": the autotuner's probe record (ops/autotune.py) —
         # which engine the fit ran and why, auditable from fit_info
         self._autotune: dict | None = None
+        # online continuous learning (sparkglm_tpu/online): refresh wall
+        # time and steady-state executable census
+        self._refresh_s = 0.0
+        self._refresh_executables = 0
 
     @staticmethod
     def _coerce_sink(s) -> Sink:
@@ -359,6 +375,15 @@ class FitTracer:
                 m.gauge("fleet.models").set(float(f.get("models", 0)))
                 m.gauge("fleet.executables").set(
                     float(f.get("executables", 0)))
+        elif ev.kind == "refresh_end":
+            self._refresh_s += float(f.get("seconds", 0.0))
+            self._refresh_executables += int(f.get("executables", 0))
+            if m is not None:
+                m.histogram("online.refresh_s").observe(
+                    float(f.get("seconds", 0.0)))
+        elif ev.kind in ("drift_detected", "auto_deploy", "auto_rollback"):
+            if m is not None:
+                m.counter(f"online.{ev.kind}").inc()
         elif ev.kind in ("solve", "span"):
             if f.get("device"):
                 self._device_s += float(f.get("seconds", 0.0))
@@ -442,6 +467,21 @@ class FitTracer:
                 # explicit or auto had no fused-capable shape
                 "engine_autotune": (dict(self._autotune)
                                     if self._autotune is not None else None),
+                # online-loop block (sparkglm_tpu/online): chunk/drift/
+                # refresh/deploy census — refresh_executables is the total
+                # executables compiled by refreshes (0 in steady state is
+                # the acceptance bar); None when no online loop ran
+                "online": ({
+                    "chunks": self._counts.get("chunk_ingested", 0),
+                    "drift_detected": self._counts.get("drift_detected", 0),
+                    "refreshes": self._counts.get("refresh_end", 0),
+                    "refresh_s": self._refresh_s,
+                    "refresh_executables": self._refresh_executables,
+                    "auto_deploys": self._counts.get("auto_deploy", 0),
+                    "auto_rollbacks": self._counts.get("auto_rollback", 0),
+                } if any(k in self._counts for k in (
+                    "chunk_ingested", "drift_detected", "refresh_end",
+                    "auto_deploy", "auto_rollback")) else None),
                 "queue_wait_s": self._queue_wait_s,
                 "prefetch_depth_max": self._prefetch_depth_max,
                 # fraction of the overlappable time actually hidden by the

@@ -1,0 +1,21 @@
+"""Online continuous learning: decayed sufficient statistics, drift
+gates, and the refresh/deploy/rollback loop (ROADMAP item 3; the
+split-then-combine treatment of PAPERS.md arXiv:2111.00032 with
+reweighting-based warm refits per arXiv:2406.02769).
+
+  suffstats.py  ``OnlineSuffStats`` — exponentially-decayed Gramian /
+                score accumulators; closed-form gaussian re-solve.
+  drift.py      ``DriftGate`` — frozen-reference vs rolling-window
+                log2-histogram drift detection over obs/ primitives.
+  loop.py       ``OnlineLoop`` — chunks -> suffstats -> gated refresh ->
+                ``ModelFamily.deploy()`` -> regression-gated rollback.
+
+Front-end: ``sparkglm_tpu.online_fleet(...)`` (api.py) seeds a fleet fit
+and returns a ready loop.
+"""
+
+from .drift import DriftGate
+from .loop import OnlineLoop
+from .suffstats import OnlineSuffStats
+
+__all__ = ["DriftGate", "OnlineLoop", "OnlineSuffStats"]

@@ -1,0 +1,191 @@
+"""Drift gates over the observability primitives (obs/metrics, obs/trace).
+
+A refreshed model is only worth deploying when the data moved; refitting
+every chunk wastes the whole point of sufficient-statistic serving.  The
+gate watches two per-tenant distributions, both as the log2 histograms
+``obs/metrics.Histogram`` already keeps (no stored samples, bounded
+state):
+
+  * score residuals — ``|y - mu|`` per row under the DEPLOYED model;
+  * deviance rate — chunk deviance / chunk weight mass, one observation
+    per chunk.
+
+The first ``reference_chunks`` chunks fill a reference window which is
+then FROZEN.  Live observations fill a rolling window; every
+``window_chunks`` chunks the window closes and each tenant's live
+distribution is compared against its frozen reference by total-variation
+distance (:func:`~sparkglm_tpu.obs.metrics.tv_distance` over the
+normalized log2 buckets).  Tenants whose worse metric exceeds
+``threshold`` are reported drifted, and one typed ``drift_detected``
+trace event (obs/trace.py) is emitted naming them.  After the loop
+deploys refreshed members it calls :meth:`rearm` — the reference
+re-freezes from fresh observations so the gate measures drift against
+the CURRENT champions, not against history.
+
+Everything here is deterministic: same chunks in, same events out —
+the e2e test asserts the exact event sequence.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..obs.metrics import Histogram, tv_distance
+
+__all__ = ["DriftGate"]
+
+_METRICS = ("score_resid", "dev_rate")
+
+
+def _hist_export(h: Histogram) -> dict:
+    return {
+        "count": h.count,
+        "total": h.total,
+        "min": None if h.count == 0 else h.min,
+        "max": None if h.count == 0 else h.max,
+        "buckets": {str(k): n for k, n in sorted(h.buckets.items())},
+    }
+
+
+def _hist_restore(d: dict) -> Histogram:
+    h = Histogram()
+    h.count = int(d["count"])
+    h.total = float(d["total"])
+    h.min = math.inf if d["min"] is None else float(d["min"])
+    h.max = -math.inf if d["max"] is None else float(d["max"])
+    h.buckets = {int(k): int(n) for k, n in d["buckets"].items()}
+    return h
+
+
+class DriftGate:
+    """Frozen-reference vs rolling-window drift detection (module doc).
+
+    Args:
+      labels: the fixed tenant order (matches the loop / suffstats).
+      threshold: TV distance in [0, 1] above which a tenant counts as
+        drifted (on either metric).
+      reference_chunks: chunks that fill the frozen reference window.
+      window_chunks: live-window length; the gate fires at window close.
+      min_count: minimum per-tenant observations in BOTH windows before a
+        comparison is trusted (tiny windows make TV noise, not signal).
+      tracer: an ``obs/trace.FitTracer`` (or None) for ``drift_detected``.
+    """
+
+    def __init__(self, labels, *, threshold: float = 0.25,
+                 reference_chunks: int = 4, window_chunks: int = 4,
+                 min_count: int = 8, tracer=None):
+        if not 0.0 < float(threshold) <= 1.0:
+            raise ValueError(
+                f"threshold must be in (0, 1], got {threshold}")
+        if reference_chunks < 1 or window_chunks < 1:
+            raise ValueError("reference_chunks and window_chunks must be "
+                             ">= 1")
+        self.labels = tuple(str(t) for t in labels)
+        self.threshold = float(threshold)
+        self.reference_chunks = int(reference_chunks)
+        self.window_chunks = int(window_chunks)
+        self.min_count = int(min_count)
+        self.tracer = tracer
+        self._ref_filled = 0     # chunks absorbed into the reference
+        self._live_filled = 0    # chunks in the current live window
+        self._ref = {t: {m: Histogram() for m in _METRICS}
+                     for t in self.labels}
+        self._live = {t: {m: Histogram() for m in _METRICS}
+                      for t in self.labels}
+
+    # -- observation ---------------------------------------------------------
+
+    @property
+    def reference_frozen(self) -> bool:
+        return self._ref_filled >= self.reference_chunks
+
+    def observe_chunk(self, per_tenant: dict) -> tuple[str, ...]:
+        """Absorb one chunk's statistics and advance the window clock.
+
+        ``per_tenant`` maps tenant label -> ``(abs_resid, dev, wt_sum)``
+        where ``abs_resid`` is the row vector of ``|y - mu|`` under the
+        deployed model and ``dev``/``wt_sum`` are the chunk's deviance
+        and weight mass for that tenant.  Returns the drifted tenants
+        (empty unless this chunk closes a live window that trips the
+        gate).
+        """
+        target = self._ref if not self.reference_frozen else self._live
+        for tenant, (resid, dev, wt_sum) in per_tenant.items():
+            hs = target[str(tenant)]
+            for v in np.asarray(resid, np.float64):
+                hs["score_resid"].observe(abs(float(v)))
+            if wt_sum > 0:
+                hs["dev_rate"].observe(float(dev) / float(wt_sum))
+        if not self.reference_frozen:
+            self._ref_filled += 1
+            return ()
+        self._live_filled += 1
+        if self._live_filled < self.window_chunks:
+            return ()
+        return self._close_window()
+
+    def _close_window(self) -> tuple[str, ...]:
+        drifted, tv_max = [], 0.0
+        for t in self.labels:
+            worst = 0.0
+            for m in _METRICS:
+                ref, live = self._ref[t][m], self._live[t][m]
+                if (ref.count < self.min_count
+                        or live.count < self.min_count):
+                    continue
+                worst = max(worst, tv_distance(ref, live))
+            tv_max = max(tv_max, worst)
+            if worst > self.threshold:
+                drifted.append(t)
+        # the live window always resets at close; the reference stays
+        # frozen until rearm()
+        self._live = {t: {m: Histogram() for m in _METRICS}
+                      for t in self.labels}
+        self._live_filled = 0
+        if drifted and self.tracer is not None:
+            self.tracer.emit("drift_detected", tenants=len(drifted),
+                             first=drifted[0], tv_max=round(tv_max, 6),
+                             threshold=self.threshold)
+        return tuple(drifted)
+
+    def rearm(self) -> None:
+        """Forget the frozen reference and refill it from the next
+        ``reference_chunks`` chunks — called after a deploy so drift is
+        measured against the new champions."""
+        self._ref = {t: {m: Histogram() for m in _METRICS}
+                     for t in self.labels}
+        self._live = {t: {m: Histogram() for m in _METRICS}
+                      for t in self.labels}
+        self._ref_filled = 0
+        self._live_filled = 0
+
+    # -- persistence (models/serialize.py v5) -------------------------------
+
+    def _export(self) -> dict:
+        return dict(
+            threshold=self.threshold,
+            reference_chunks=self.reference_chunks,
+            window_chunks=self.window_chunks,
+            min_count=self.min_count,
+            ref_filled=self._ref_filled,
+            live_filled=self._live_filled,
+            ref={t: {m: _hist_export(self._ref[t][m]) for m in _METRICS}
+                 for t in self.labels},
+            live={t: {m: _hist_export(self._live[t][m]) for m in _METRICS}
+                  for t in self.labels})
+
+    @classmethod
+    def _restore(cls, labels, state: dict, *, tracer=None) -> "DriftGate":
+        gate = cls(labels, threshold=state["threshold"],
+                   reference_chunks=state["reference_chunks"],
+                   window_chunks=state["window_chunks"],
+                   min_count=state["min_count"], tracer=tracer)
+        gate._ref_filled = int(state["ref_filled"])
+        gate._live_filled = int(state["live_filled"])
+        gate._ref = {t: {m: _hist_restore(state["ref"][t][m])
+                         for m in _METRICS} for t in gate.labels}
+        gate._live = {t: {m: _hist_restore(state["live"][t][m])
+                          for m in _METRICS} for t in gate.labels}
+        return gate

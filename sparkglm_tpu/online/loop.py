@@ -1,0 +1,497 @@
+"""The online continuous-learning loop: chunks in, deployments out.
+
+``OnlineLoop`` composes the pieces the previous PRs built into the
+ROADMAP's "keeps thousands of per-tenant GLMs fresh under live traffic"
+scenario:
+
+  source chunks -> decayed suffstats (suffstats.py)
+                -> drift gate (drift.py, obs/ primitives)
+                -> gated refresh: closed-form gaussian re-solve, or a
+                   warm-started fleet refit at the FIXED power-of-2
+                   bucket (fleet/fitting.py ``start=``) — steady-state
+                   refresh compiles NOTHING
+                -> challenger gating through the existing shadow-scoring
+                   A/B path (serve/engine.FamilyScorer)
+                -> ``ModelFamily.deploy()`` through the generation
+                   counter, so ``ReplicatedScorer.refresh()`` (and any
+                   ``AsyncEngine`` over it) picks the new champion up
+                   recompile-free
+                -> a post-deploy regression watch that auto-rolls-back
+
+Every decision is host float64 and deterministic: the same chunk stream
+produces the same trace-event sequence (``chunk_ingested`` /
+``drift_detected`` / ``refresh_start`` / ``refresh_end`` /
+``auto_deploy`` / ``auto_rollback``), which the e2e test asserts.
+
+Refresh semantics per family:
+
+  * gaussian/identity — the decayed Gramian IS the fit:
+    ``OnlineSuffStats.solve()`` returns the exact WLS coefficients of
+    the decayed-weight dataset in closed form.  No refit, no compile.
+  * everything else — IRLS reweights per iteration, so the loop retains
+    a fixed-size per-tenant ring of recent rows (``window_rows``) and
+    refreshes by a warm-started fleet refit over it: fixed (bucket,
+    window_rows, p) shapes + ``start=`` from the deployed table mean one
+    executable at the first refresh and zero afterwards.
+
+Challenger gating: refreshed coefficients register as STAGED versions;
+the existing FamilyScorer shadow path scores champion and challenger on
+the retained window in one dispatch, and a challenger deploys only if
+its held-out deviance does not regress beyond ``deviance_tolerance``.
+Deployed tenants enter a ``watch_chunks``-chunk regression watch: on
+each subsequent chunk the deployed model's deviance is compared against
+the prior version's on the same rows, and a regression beyond
+``rollback_tolerance`` triggers ``ModelFamily.rollback`` plus an
+``auto_rollback`` event — the guardrail the e2e test exercises with a
+seeded bad deploy.
+
+Persistence: ``loop.save(path)`` (models/serialize.py v5) stores the
+family (every version + deploy history), the suffstats, the row rings,
+the drift-gate histograms and the watch state in one artifact;
+``OnlineLoop.load(path)`` resumes bit-identically (test-enforced under
+``prefetch=2``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import warnings
+
+import numpy as np
+
+from ..config import DEFAULT, NumericConfig
+from ..data.groups import MIN_BUCKET, next_bucket
+from ..data.pipeline import prefetch_iter
+from ..models import hoststats
+from ..obs import trace as _obs_trace
+from .drift import DriftGate
+from .suffstats import OnlineSuffStats
+
+__all__ = ["OnlineLoop"]
+
+
+class OnlineLoop:
+    """Drive a :class:`~sparkglm_tpu.serve.ModelFamily` from live chunks
+    (module docstring).
+
+    Args:
+      family: the served ``ModelFamily`` (every tenant deployed); its
+        tenant order fixes the model axis everywhere here.
+      rho: per-chunk decay of the sufficient statistics, in (0, 1].
+      window_rows: per-tenant retained-row ring size (the warm-refit
+        training window and the challenger-gate holdout).
+      drift_threshold / reference_chunks / window_chunks / min_count:
+        :class:`~sparkglm_tpu.online.drift.DriftGate` knobs.
+      deviance_tolerance: max relative held-out deviance regression a
+        challenger may show and still deploy.
+      rollback_tolerance: max relative live regression vs the prior
+        version before auto-rollback (defaults to deviance_tolerance).
+      watch_chunks: post-deploy chunks the regression watch stays armed.
+      jitter: ridge added to the closed-form solve's Gramian.
+      tol / max_iter / batch: warm fleet-refit IRLS knobs.
+      trace / metrics: obs/ wiring; events always aggregate into
+        :meth:`report` even with no sink attached.
+    """
+
+    def __init__(self, family, *, rho: float = 0.99,
+                 window_rows: int = 128,
+                 drift_threshold: float = 0.25,
+                 reference_chunks: int = 4, window_chunks: int = 4,
+                 min_count: int = 8,
+                 deviance_tolerance: float = 0.05,
+                 rollback_tolerance: float | None = None,
+                 watch_chunks: int = 4,
+                 jitter: float = 0.0,
+                 tol: float = 1e-8, max_iter: int = 50,
+                 batch: str = "exact",
+                 trace=None, metrics=None,
+                 config: NumericConfig = DEFAULT):
+        if window_rows < 1:
+            raise ValueError(f"window_rows must be >= 1, got {window_rows}")
+        if deviance_tolerance < 0:
+            raise ValueError("deviance_tolerance must be >= 0")
+        if watch_chunks < 1:
+            raise ValueError(f"watch_chunks must be >= 1, got {watch_chunks}")
+        self.family = family
+        if family.family is None:
+            raise ValueError(
+                "the ModelFamily has no registered tenants yet; build it "
+                "from a seed fleet first (ModelFamily.from_fleet)")
+        tenants, B = family.deployed_matrix()
+        self.labels = tenants
+        self.K = len(tenants)
+        self.p = B.shape[1]
+        self._index = {t: k for k, t in enumerate(tenants)}
+        self.glm_family = family.family
+        self.link = family.link
+        self.is_closed_form = (self.glm_family == "gaussian"
+                               and self.link == "identity")
+        self.rho = float(rho)
+        self.window_rows = int(window_rows)
+        self.deviance_tolerance = float(deviance_tolerance)
+        self.rollback_tolerance = float(
+            deviance_tolerance if rollback_tolerance is None
+            else rollback_tolerance)
+        self.watch_chunks = int(watch_chunks)
+        self.jitter = float(jitter)
+        self.tol = float(tol)
+        self.max_iter = int(max_iter)
+        self.batch = batch
+        self.config = config
+        tr = _obs_trace.as_tracer(trace, metrics=metrics)
+        self.tracer = tr if tr is not None else _obs_trace.FitTracer()
+        self.suffstats = OnlineSuffStats.init(tenants, self.p, rho=self.rho)
+        self.gate = DriftGate(
+            tenants, threshold=drift_threshold,
+            reference_chunks=reference_chunks,
+            window_chunks=window_chunks, min_count=min_count,
+            tracer=self.tracer)
+        self.bucket = next_bucket(self.K, MIN_BUCKET)
+        W = self.window_rows
+        # per-tenant row rings; w == 0 marks unfilled slots (weight-0
+        # trash rows are inert in every fit/stat by the padding contract)
+        self._Xw = np.zeros((self.K, W, self.p))
+        self._yw = np.zeros((self.K, W))
+        self._ww = np.zeros((self.K, W))
+        self._ow = np.zeros((self.K, W))
+        self._pos = np.zeros(self.K, np.int64)
+        self._chunks = 0
+        self._refreshes = 0
+        # tenant -> {"prior": version, "left": chunks} regression watches
+        self._watch: dict[str, dict] = {}
+
+    # -- chunk ingestion -----------------------------------------------------
+
+    def step(self, tenants, X, y, *, weights=None, offset=None) -> dict:
+        """Absorb one chunk; returns a small summary dict
+        (``drifted``/``deployed``/``rolled_back`` tenant tuples)."""
+        X = np.asarray(X, np.float64)
+        y = np.asarray(y, np.float64)
+        if X.ndim != 2 or X.shape[1] != self.p:
+            raise ValueError(
+                f"chunk design must be (n, {self.p}), got {X.shape}")
+        n = X.shape[0]
+        w = (np.ones(n) if weights is None
+             else np.asarray(weights, np.float64))
+        off = (np.zeros(n) if offset is None
+               else np.asarray(offset, np.float64))
+        tenants = np.asarray(tenants)
+        try:
+            tidx = np.array([self._index[str(t)] for t in tenants],
+                            np.int64)
+        except KeyError as exc:
+            raise KeyError(
+                f"unknown tenant {exc.args[0]!r}; the online loop serves "
+                f"a fixed family of {self.K} tenants") from None
+        self._chunks += 1
+        present = sorted(set(int(k) for k in tidx))
+        self.tracer.emit("chunk_ingested", chunk=self._chunks, rows=n,
+                         tenants=len(present))
+
+        # 1. regression watch on the PRE-refresh champions
+        rolled = self._eval_watch(tidx, X, y, w, off)
+
+        # 2. drift statistics under the (possibly just rolled-back)
+        #    deployed table
+        _, B = self.family.deployed_matrix()
+        eta = np.einsum("np,np->n", X, B[tidx]) + off
+        mu = hoststats.link_inverse(self.link, eta)
+        per_tenant = {}
+        for k in present:
+            m = tidx == k
+            dr = hoststats.dev_resids(self.glm_family, y[m], mu[m], w[m])
+            per_tenant[self.labels[k]] = (
+                np.abs(y[m] - mu[m]), float(np.sum(dr)), float(w[m].sum()))
+        drifted = self.gate.observe_chunk(per_tenant)
+
+        # 3. decayed sufficient statistics + retained-row rings
+        self.suffstats.update(tenants, X, y, weights=w, offset=off)
+        self._retain(tidx, X, y, w, off)
+
+        deployed = self._refresh(drifted) if drifted else ()
+        return dict(chunk=self._chunks, drifted=drifted,
+                    deployed=deployed, rolled_back=rolled)
+
+    def run(self, source, *, prefetch: int | None = None,
+            max_chunks: int | None = None) -> dict:
+        """Drive :meth:`step` over a chunk source — a zero-arg callable
+        returning an iterator of ``(tenants, X, y[, weights[, offset]])``
+        tuples (or thunks realizing to one), the streaming-source
+        convention; ``data/pipeline.tee_source`` splits one live stream
+        between this loop and anything else.  ``prefetch`` pipelines
+        chunk production (data/pipeline.py — bit-identical by the
+        determinism contract there).  Returns :meth:`report`.
+        """
+        it = (source() if prefetch is None else
+              prefetch_iter(source, prefetch, auto_degrade=False))
+        with _obs_trace.ambient(self.tracer):
+            for i, item in enumerate(it):
+                if max_chunks is not None and i >= max_chunks:
+                    break
+                if callable(item):
+                    item = item()
+                self.step(*item[:3],
+                          weights=item[3] if len(item) > 3 else None,
+                          offset=item[4] if len(item) > 4 else None)
+        return self.report()
+
+    def _retain(self, tidx, X, y, w, off) -> None:
+        """Append chunk rows to each tenant's fixed-size ring (oldest
+        rows overwrite first; w == 0 marks never-filled slots)."""
+        W = self.window_rows
+        for k in sorted(set(int(t) for t in tidx)):
+            m = tidx == k
+            idx = (self._pos[k] + np.arange(int(m.sum()))) % W
+            self._Xw[k, idx] = X[m]
+            self._yw[k, idx] = y[m]
+            self._ww[k, idx] = w[m]
+            self._ow[k, idx] = off[m]
+            self._pos[k] = (self._pos[k] + int(m.sum())) % W
+
+    # -- refresh -------------------------------------------------------------
+
+    def _refresh(self, drifted) -> tuple:
+        """Recompute drifted members, gate them through shadow scoring,
+        deploy the survivors; returns the deployed tenants."""
+        mode = "closed_form" if self.is_closed_form else "warm_refit"
+        self.tracer.emit("refresh_start", mode=mode,
+                         tenants=len(drifted), chunk=self._chunks)
+        t0 = time.perf_counter()
+        from ..fleet.kernel import fleet_kernel_cache_size
+        n_exec0 = fleet_kernel_cache_size()
+        if self.is_closed_form:
+            beta = self.suffstats.solve(jitter=self.jitter)
+        else:
+            beta = self._warm_refit()
+        executables = fleet_kernel_cache_size() - n_exec0
+        self._refreshes += 1
+        self.tracer.emit("refresh_end", mode=mode, tenants=len(drifted),
+                         executables=int(executables), chunk=self._chunks,
+                         seconds=time.perf_counter() - t0)
+
+        # stage challengers for the drifted tenants (never auto-deploy:
+        # the shadow gate decides)
+        challengers: dict[str, int] = {}
+        for t in drifted:
+            b = beta[self._index[t]]
+            if not np.all(np.isfinite(b)):
+                continue  # no mass yet / singular — nothing to deploy
+            mdl = dataclasses.replace(self.family.model(t),
+                                      coefficients=np.asarray(b))
+            challengers[t] = self.family.register(t, mdl, deploy=False)
+        if not challengers:
+            return ()
+        accepted = self._gate_challengers(challengers)
+        deployed = []
+        for t in sorted(accepted, key=lambda t: self._index[t]):
+            prior = self.family.deployed_version(t)
+            self.family.deploy(t, challengers[t])
+            self._watch[t] = dict(prior=int(prior),
+                                  left=self.watch_chunks)
+            self.tracer.emit("auto_deploy", tenant=t,
+                             version=int(challengers[t]),
+                             prior=int(prior), chunk=self._chunks)
+            deployed.append(t)
+        if deployed:
+            # drift is now measured against the new champions
+            self.gate.rearm()
+        return tuple(deployed)
+
+    def _warm_refit(self) -> np.ndarray:
+        """One warm-started fleet refit over the retained rings at the
+        FIXED (bucket, window_rows, p) shapes — the steady-state
+        zero-compile path (``start=`` threads into the warm fleet
+        kernel; trash tenants/rows stay inert)."""
+        from ..fleet.fitting import glm_fit_fleet
+        _, B = self.family.deployed_matrix()
+        has_off = bool(np.any(self._ow[self._ww > 0])) if np.any(
+            self._ww > 0) else False
+        with warnings.catch_warnings():
+            # tenants with an unfilled ring are singular/non-converged by
+            # construction; their NaN rows are filtered above
+            warnings.simplefilter("ignore")
+            fleet = glm_fit_fleet(
+                self._Xw, self._yw, weights=self._ww,
+                offset=self._ow if has_off else None,
+                family=self.glm_family, link=self.link,
+                labels=self.labels, bucket=self.bucket, start=B,
+                tol=self.tol, max_iter=self.max_iter, batch=self.batch,
+                config=self.config)
+        return np.asarray(fleet.coefficients, np.float64)
+
+    def _gate_challengers(self, challengers: dict) -> list:
+        """Shadow-score champion vs challenger on the retained window
+        through the existing FamilyScorer A/B path; accept challengers
+        whose held-out deviance does not regress beyond tolerance."""
+        rows_t, rows_X, rows_y, rows_w, rows_o = [], [], [], [], []
+        for t in sorted(challengers, key=lambda t: self._index[t]):
+            k = self._index[t]
+            m = self._ww[k] > 0
+            if not np.any(m):
+                continue
+            rows_t.extend([t] * int(m.sum()))
+            rows_X.append(self._Xw[k, m])
+            rows_y.append(self._yw[k, m])
+            rows_w.append(self._ww[k, m])
+            rows_o.append(self._ow[k, m])
+        if not rows_t:
+            return []
+        X = np.concatenate(rows_X)
+        y = np.concatenate(rows_y)
+        w = np.concatenate(rows_w)
+        off = np.concatenate(rows_o)
+        sc = self.family.scorer(shadow=dict(challengers))
+        mu_champ, mu_chal = sc.score(
+            rows_t, X, offset=off if np.any(off) else None)
+        accepted = []
+        tl = np.asarray(rows_t, object)
+        tol = self.deviance_tolerance
+        for t in sorted(challengers, key=lambda t: self._index[t]):
+            m = tl == t
+            if not np.any(m):
+                continue
+            dev_champ = float(np.sum(hoststats.dev_resids(
+                self.glm_family, y[m], mu_champ[m], w[m])))
+            dev_chal = float(np.sum(hoststats.dev_resids(
+                self.glm_family, y[m], mu_chal[m], w[m])))
+            if np.isfinite(dev_chal) and (
+                    dev_chal <= dev_champ * (1.0 + tol) + 1e-12):
+                accepted.append(t)
+        return accepted
+
+    # -- regression watch / rollback ----------------------------------------
+
+    def _eval_watch(self, tidx, X, y, w, off) -> tuple:
+        """Compare each watched tenant's deployed model against its
+        prior version on this chunk's rows; roll back on regression."""
+        if not self._watch:
+            return ()
+        rolled = []
+        for t in sorted(self._watch, key=lambda t: self._index[t]):
+            k = self._index[t]
+            m = tidx == k
+            if not np.any(m):
+                continue
+            st = self._watch[t]
+            cur_v = self.family.deployed_version(t)
+            b_cur = np.asarray(self.family.model(t).coefficients)
+            b_prior = np.asarray(
+                self.family.model(t, st["prior"]).coefficients)
+            dev_cur = self._chunk_dev(b_cur, X[m], y[m], w[m], off[m])
+            dev_prior = self._chunk_dev(b_prior, X[m], y[m], w[m], off[m])
+            if (not np.isfinite(dev_cur)
+                    or dev_cur > dev_prior
+                    * (1.0 + self.rollback_tolerance) + 1e-12):
+                restored = self.family.rollback(t)
+                self.tracer.emit("auto_rollback", tenant=t,
+                                 from_version=int(cur_v),
+                                 to_version=int(restored),
+                                 chunk=self._chunks)
+                del self._watch[t]
+                rolled.append(t)
+                continue
+            st["left"] -= 1
+            if st["left"] <= 0:
+                del self._watch[t]
+        return tuple(rolled)
+
+    def _chunk_dev(self, beta, X, y, w, off) -> float:
+        eta = X @ beta + off
+        mu = hoststats.link_inverse(self.link, eta)
+        return float(np.sum(hoststats.dev_resids(self.glm_family, y, mu,
+                                                 w)))
+
+    # -- manual deploy hook --------------------------------------------------
+
+    def deploy(self, tenant: str, model, *, watch: bool = True) -> int:
+        """Register + deploy ``model`` for ``tenant`` outside the gate
+        (operator override / canary seeding).  ``watch=True`` arms the
+        same regression watch the gated path uses, so a bad manual
+        deploy auto-rolls-back — the e2e seeded-regression scenario."""
+        tenant = str(tenant)
+        prior = self.family.deployed_version(tenant)
+        version = self.family.register(tenant, model, deploy=True)
+        if watch and prior is not None:
+            self._watch[tenant] = dict(prior=int(prior),
+                                       left=self.watch_chunks)
+        return version
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self) -> dict:
+        """The tracer's aggregate report (its ``online`` block carries
+        the chunk/drift/refresh/deploy census)."""
+        return self.tracer.report()
+
+    # -- persistence (models/serialize.py v5) --------------------------------
+
+    def save(self, path) -> None:
+        from ..models.serialize import save_model
+        save_model(self, path)
+
+    @classmethod
+    def load(cls, path, *, trace=None, metrics=None) -> "OnlineLoop":
+        from ..models.serialize import load_model
+        loop = load_model(path)
+        if not isinstance(loop, cls):
+            raise ValueError(
+                f"{path!r} is not an OnlineLoop artifact "
+                f"(got {type(loop).__name__})")
+        if trace is not None or metrics is not None:
+            tr = _obs_trace.as_tracer(trace, metrics=metrics)
+            loop.tracer = tr if tr is not None else loop.tracer
+            loop.gate.tracer = loop.tracer
+        return loop
+
+    def _export(self) -> tuple[dict, dict]:
+        """Arrays + JSON-able meta for serialize.py (the family itself is
+        exported alongside by ``_save_online``)."""
+        ss_arrays, ss_meta = self.suffstats._export()
+        arrays = {f"ss__{k}": v for k, v in ss_arrays.items()}
+        arrays.update(win__X=self._Xw, win__y=self._yw, win__w=self._ww,
+                      win__off=self._ow, win__pos=self._pos)
+        meta = dict(
+            rho=self.rho, window_rows=self.window_rows,
+            drift_threshold=self.gate.threshold,
+            reference_chunks=self.gate.reference_chunks,
+            window_chunks=self.gate.window_chunks,
+            min_count=self.gate.min_count,
+            deviance_tolerance=self.deviance_tolerance,
+            rollback_tolerance=self.rollback_tolerance,
+            watch_chunks=self.watch_chunks, jitter=self.jitter,
+            tol=self.tol, max_iter=self.max_iter, batch=self.batch,
+            chunks=self._chunks, refreshes=self._refreshes,
+            suffstats=ss_meta, gate=self.gate._export(),
+            watch={t: dict(v) for t, v in sorted(self._watch.items())})
+        return arrays, meta
+
+    @classmethod
+    def _restore(cls, family, arrays: dict, meta: dict) -> "OnlineLoop":
+        loop = cls(
+            family, rho=meta["rho"], window_rows=meta["window_rows"],
+            drift_threshold=meta["drift_threshold"],
+            reference_chunks=meta["reference_chunks"],
+            window_chunks=meta["window_chunks"],
+            min_count=meta["min_count"],
+            deviance_tolerance=meta["deviance_tolerance"],
+            rollback_tolerance=meta["rollback_tolerance"],
+            watch_chunks=meta["watch_chunks"], jitter=meta["jitter"],
+            tol=meta["tol"], max_iter=meta["max_iter"],
+            batch=meta["batch"])
+        ss_arrays = {k[4:]: v for k, v in arrays.items()
+                     if k.startswith("ss__")}
+        loop.suffstats = OnlineSuffStats._restore(ss_arrays,
+                                                  meta["suffstats"])
+        loop._Xw = np.asarray(arrays["win__X"], np.float64)
+        loop._yw = np.asarray(arrays["win__y"], np.float64)
+        loop._ww = np.asarray(arrays["win__w"], np.float64)
+        loop._ow = np.asarray(arrays["win__off"], np.float64)
+        loop._pos = np.asarray(arrays["win__pos"], np.int64)
+        loop._chunks = int(meta["chunks"])
+        loop._refreshes = int(meta["refreshes"])
+        loop.gate = DriftGate._restore(loop.labels, meta["gate"],
+                                       tracer=loop.tracer)
+        loop._watch = {t: dict(prior=int(v["prior"]), left=int(v["left"]))
+                       for t, v in meta["watch"].items()}
+        return loop

@@ -1,0 +1,186 @@
+"""Exponentially-decayed sufficient statistics for online GLM refresh.
+
+The split-then-combine treatment of PAPERS.md arXiv:2111.00032 represents
+a weighted least-squares fit entirely by its Gramian ``G = X'WX`` and
+score ``r = X'Wy``: chunks contribute additively, so a model stays
+refreshable from O(K·p²) state no matter how many rows have flowed
+through.  :class:`OnlineSuffStats` adds the forgetting half: every chunk
+tick first decays ALL accumulated state by ``rho`` (one global clock, so
+a tenant absent from a chunk still forgets), then adds the chunk's
+per-tenant blocks in host float64 in the chunk's left-to-right row order
+— the same accumulation-order discipline the streaming fits keep
+(PARITY.md), which is what makes a serialized/resumed accumulator
+bit-identical to an uninterrupted one.
+
+After C chunks the state equals the sufficient statistics of the
+DECAYED-WEIGHT dataset: row i from chunk c carries weight
+``w_i * rho^(C - c)``.  For gaussian/identity members that is the whole
+fit — ``solve()`` returns the exact WLS coefficients of that dataset in
+closed form (tested to 1e-10 against a full refit), no IRLS, no compile.
+Non-gaussian families keep the same accumulators for drift statistics
+and weight mass, but refresh through a warm-started fleet refit instead
+(sparkglm_tpu/online/loop.py): IRLS reweights per iteration, so a single
+frozen Gramian cannot carry the fit (the reweighting analyses of
+PAPERS.md arXiv:2406.02769).
+
+The class is a registered JAX pytree (arrays are leaves) so state can
+ride through ``jax.tree`` utilities and device transfers, but every hot
+path here is deliberately host numpy: K small dense p×p solves are a
+poor fit for one XLA dispatch and a great fit for LAPACK.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["OnlineSuffStats"]
+
+
+@dataclasses.dataclass
+class OnlineSuffStats:
+    """Decayed per-tenant Gramian/score accumulators (see module doc).
+
+    ``labels`` fixes the tenant order (row k of every array); ``rho`` in
+    (0, 1] is the per-chunk decay (1.0 = never forget).  ``G`` (K, p, p),
+    ``r`` (K, p) and ``wsum`` (K,) are float64; ``chunks`` counts ticks.
+    """
+
+    labels: tuple
+    rho: float
+    G: np.ndarray
+    r: np.ndarray
+    wsum: np.ndarray
+    chunks: int = 0
+
+    @classmethod
+    def init(cls, labels, p: int, *, rho: float = 0.99) -> "OnlineSuffStats":
+        labels = tuple(str(t) for t in labels)
+        if not labels:
+            raise ValueError("need at least one tenant label")
+        if len(set(labels)) != len(labels):
+            raise ValueError("tenant labels must be unique")
+        if not 0.0 < float(rho) <= 1.0:
+            raise ValueError(f"decay rho must be in (0, 1], got {rho}")
+        K = len(labels)
+        return cls(labels=labels, rho=float(rho),
+                   G=np.zeros((K, p, p)), r=np.zeros((K, p)),
+                   wsum=np.zeros(K), chunks=0)
+
+    @property
+    def K(self) -> int:
+        return len(self.labels)
+
+    @property
+    def p(self) -> int:
+        return self.G.shape[-1]
+
+    def _index(self) -> dict:
+        return {t: k for k, t in enumerate(self.labels)}
+
+    def update(self, tenants, X, y, *, weights=None, offset=None) -> None:
+        """Absorb one chunk: decay EVERY tenant by ``rho``, then add each
+        tenant's ``X'WX`` / ``X'W(y - offset)`` block in row order.
+
+        ``tenants`` (n,) labels per row; ``X`` (n, p); ``y`` (n,).
+        Accumulation is host float64 regardless of input dtype.  Unknown
+        tenant labels raise — the tenant set is fixed at init (it sizes
+        the serving tables; an online system grows tenants by rebuilding
+        the family, not by silently widening state).
+        """
+        X = np.asarray(X, np.float64)
+        y = np.asarray(y, np.float64)
+        if X.ndim != 2 or X.shape[1] != self.p:
+            raise ValueError(
+                f"chunk design must be (n, {self.p}), got {X.shape}")
+        n = X.shape[0]
+        if y.shape != (n,):
+            raise ValueError(f"y must be ({n},), got {y.shape}")
+        w = (np.ones(n) if weights is None
+             else np.asarray(weights, np.float64))
+        yv = y if offset is None else y - np.asarray(offset, np.float64)
+        tenants = np.asarray(tenants)
+        if tenants.shape[0] != n:
+            raise ValueError(
+                f"{tenants.shape[0]} tenant labels for {n} rows")
+        idx = self._index()
+        try:
+            tidx = np.array([idx[str(t)] for t in tenants], np.int64)
+        except KeyError as exc:
+            raise KeyError(
+                f"unknown tenant {exc.args[0]!r}; online suffstats track "
+                f"a fixed tenant set of {self.K}") from None
+        # one global tick: every tenant forgets, present in the chunk or
+        # not — the decayed-weight dataset semantics above
+        if self.rho != 1.0:
+            self.G *= self.rho
+            self.r *= self.rho
+            self.wsum *= self.rho
+        # per-tenant blocks in first-appearance order; rows of one tenant
+        # accumulate left-to-right inside one einsum (fixed bracketing)
+        seen = []
+        for k in tidx:
+            if k not in seen:
+                seen.append(int(k))
+        for k in seen:
+            m = tidx == k
+            Xk, wk, yk = X[m], w[m], yv[m]
+            self.G[k] += np.einsum("np,n,nq->pq", Xk, wk, Xk)
+            self.r[k] += np.einsum("np,n->p", Xk, wk * yk)
+            self.wsum[k] += float(wk.sum())
+        self.chunks += 1
+
+    def solve(self, *, jitter: float = 0.0) -> np.ndarray:
+        """Closed-form WLS coefficients (K, p) of the decayed dataset —
+        the gaussian/identity refresh, no IRLS and no compile.  Tenants
+        with no (or fully-decayed) mass, or a singular Gramian, come back
+        as NaN rows; the loop skips deploying them."""
+        K, p = self.K, self.p
+        beta = np.full((K, p), np.nan)
+        eye = np.eye(p)
+        for k in range(K):
+            if self.wsum[k] <= 0.0:
+                continue
+            Gk = self.G[k] + jitter * eye if jitter else self.G[k]
+            try:
+                beta[k] = np.linalg.solve(Gk, self.r[k])
+            except np.linalg.LinAlgError:
+                pass
+        return beta
+
+    # -- persistence (models/serialize.py v5) -------------------------------
+
+    def _export(self) -> tuple[dict, dict]:
+        arrays = dict(G=self.G, r=self.r, wsum=self.wsum)
+        meta = dict(labels=list(self.labels), rho=self.rho,
+                    chunks=int(self.chunks))
+        return arrays, meta
+
+    @classmethod
+    def _restore(cls, arrays: dict, meta: dict) -> "OnlineSuffStats":
+        return cls(labels=tuple(meta["labels"]), rho=float(meta["rho"]),
+                   G=np.asarray(arrays["G"], np.float64),
+                   r=np.asarray(arrays["r"], np.float64),
+                   wsum=np.asarray(arrays["wsum"], np.float64),
+                   chunks=int(meta["chunks"]))
+
+
+def _flatten(ss: OnlineSuffStats):
+    return (ss.G, ss.r, ss.wsum), (ss.labels, ss.rho, ss.chunks)
+
+
+def _unflatten(aux, leaves) -> OnlineSuffStats:
+    labels, rho, chunks = aux
+    G, r, wsum = leaves
+    return OnlineSuffStats(labels=labels, rho=rho, G=G, r=r, wsum=wsum,
+                           chunks=chunks)
+
+
+try:  # register as a pytree; arrays are leaves, identity/decay are aux
+    import jax
+
+    jax.tree_util.register_pytree_node(OnlineSuffStats, _flatten,
+                                       _unflatten)
+except ImportError:  # pragma: no cover - jax is a hard dep in practice
+    pass

@@ -192,9 +192,26 @@ class ModelFamily:
     Persistence: ``family.save(path)`` / ``models/serialize.py`` round-trip
     the whole family — every registered version plus the deploy history —
     through the ``_export()``/``_restore()`` hooks.
+
+    ``history_cap`` bounds each tenant's deploy STACK (a continuously
+    redeploying online loop would otherwise grow it without limit —
+    sparkglm_tpu/online redeploys on every accepted refresh).  The default
+    keeps the most recent :data:`HISTORY_CAP` deployments per tenant —
+    more than any sane rollback chain — and ``history_cap=None`` opts back
+    in to the full unbounded history.  Registered versions themselves are
+    never dropped; only the rollback stack is trimmed.
     """
 
-    def __init__(self, name: str, *, metrics=None):
+    #: default per-tenant deploy-stack bound (``history_cap=None`` unbounds)
+    HISTORY_CAP = 64
+
+    def __init__(self, name: str, *, metrics=None,
+                 history_cap: int | None = HISTORY_CAP):
+        if history_cap is not None and int(history_cap) < 2:
+            raise ValueError(
+                f"history_cap must be >= 2 (rollback needs the prior "
+                f"deployment) or None for unbounded, got {history_cap!r}")
+        self.history_cap = None if history_cap is None else int(history_cap)
         self._lock = threading.RLock()
         self._entries: dict[str, _Entry] = {}
         self._scorers: dict[tuple, FamilyScorer] = {}
@@ -280,6 +297,8 @@ class ModelFamily:
     def _deploy_locked(self, tenant: str, e: _Entry, version: int) -> None:
         e.deployed = version
         e.history.append(version)
+        if self.history_cap is not None and len(e.history) > self.history_cap:
+            del e.history[:len(e.history) - self.history_cap]
         self._generation += 1
         self._scorers.clear()  # scorers pin a coefficient snapshot
         if self.metrics is not None:
@@ -439,6 +458,7 @@ class ModelFamily:
                     members.append((tenant, version, e.versions[version]))
             fam_meta = dict(
                 name=self.name,
+                history_cap=self.history_cap,
                 deployed={t: self._entries[t].deployed
                           for t in sorted(self._entries)},
                 history={t: list(self._entries[t].history)
@@ -448,7 +468,8 @@ class ModelFamily:
     @classmethod
     def _restore(cls, members, meta) -> "ModelFamily":
         """Serialization hook: rebuild from ``_export()`` output."""
-        fam = cls(meta["name"])
+        fam = cls(meta["name"],
+                  history_cap=meta.get("history_cap", cls.HISTORY_CAP))
         for tenant, version, model in members:
             fam._check_signature(tenant, model)
             e = fam._entries.setdefault(tenant, _Entry())

@@ -57,6 +57,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "asyncio: the async replicated serving engine "
         "(`make serve_async` selects these; still tier-1 by default)")
+    config.addinivalue_line(
+        "markers", "online: the continuous-learning subsystem — decayed "
+        "suffstats, drift gates, auto-deploy/rollback (`make online` "
+        "selects these; still tier-1 by default)")
 
 
 @pytest.fixture(scope="session")

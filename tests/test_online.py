@@ -1,0 +1,454 @@
+"""Online continuous learning (sparkglm_tpu/online).
+
+What must hold (ISSUE r13 / ROADMAP item 3):
+
+  * closed form == refit: the decayed-suffstat gaussian re-solve equals a
+    full fleet refit of the equivalent decayed-weight dataset to 1e-10;
+  * warm == cold: a fleet refit warm-started via ``start=`` reaches the
+    same f64 fixed point as a cold fit, and repeat warm refits at the
+    fixed bucket compile nothing;
+  * the e2e loop: a 64-tenant family served by an AsyncEngine while the
+    loop ingests drifting chunks — the drift gate fires, refreshed
+    members auto-deploy with ZERO steady-state recompiles, a seeded
+    regression auto-rolls-back, and the trace-event sequence is
+    deterministic;
+  * resume: an OnlineLoop serialized mid-stream and resumed under
+    ``prefetch=2`` is bit-identical to one that never stopped;
+  * the deploy-history bound and the chunk tee ride along.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.data.pipeline import tee_source
+from sparkglm_tpu.fleet import glm_fit_fleet
+from sparkglm_tpu.fleet.kernel import fleet_kernel_cache_size
+from sparkglm_tpu.obs import RingBufferSink
+from sparkglm_tpu.obs.metrics import Histogram, tv_distance
+from sparkglm_tpu.online import DriftGate, OnlineLoop, OnlineSuffStats
+from sparkglm_tpu.serve import (AsyncEngine, EnginePolicy, ModelFamily,
+                                family_score_cache_size)
+
+pytestmark = pytest.mark.online
+
+P = 3
+
+
+def _labels(K):
+    return tuple(f"t{i:02d}" for i in range(K))
+
+
+def _chunk(labels, beta, rows_per, seed, noise=0.05):
+    """One long-format chunk: ``rows_per`` gaussian rows per tenant."""
+    r = np.random.default_rng(seed)
+    ten, Xs, ys = [], [], []
+    for k, t in enumerate(labels):
+        X = r.normal(size=(rows_per, P))
+        ten.extend([t] * rows_per)
+        Xs.append(X)
+        ys.append(X @ beta[k] + noise * r.normal(size=rows_per))
+    return np.array(ten), np.concatenate(Xs), np.concatenate(ys)
+
+
+def _seed_family(labels, beta, name, n=64, seed=0):
+    r = np.random.default_rng(seed)
+    K = len(labels)
+    X = r.normal(size=(K, n, P))
+    y = np.stack([X[k] @ beta[k] + 0.05 * r.normal(size=n)
+                  for k in range(K)])
+    fleet = glm_fit_fleet(X, y, family="gaussian", link="identity",
+                          labels=labels)
+    return ModelFamily.from_fleet(fleet, name)
+
+
+# ---------------------------------------------------------------------------
+# sufficient statistics: closed form == decayed-weight full refit
+# ---------------------------------------------------------------------------
+
+def test_closed_form_solve_matches_decayed_refit():
+    labels = _labels(6)
+    rng = np.random.default_rng(3)
+    beta = rng.normal(size=(6, P))
+    rho = 0.7
+    ss = OnlineSuffStats.init(labels, P, rho=rho)
+    chunks = [_chunk(labels, beta + 0.3 * c, 24, seed=50 + c)
+              for c in range(5)]
+    for ten, X, y in chunks:
+        ss.update(ten, X, y)
+    # the equivalent static dataset: chunk c's rows carry weight
+    # rho^(C-1-c) — what C decay ticks leave behind
+    C = len(chunks)
+    ta = np.concatenate([c[0] for c in chunks])
+    Xa = np.concatenate([c[1] for c in chunks])
+    ya = np.concatenate([c[2] for c in chunks])
+    wa = np.concatenate([np.full(len(c[2]), rho ** (C - 1 - i))
+                         for i, c in enumerate(chunks)])
+    full = glm_fit_fleet(
+        np.stack([Xa[ta == t] for t in labels]),
+        np.stack([ya[ta == t] for t in labels]),
+        weights=np.stack([wa[ta == t] for t in labels]),
+        family="gaussian", link="identity", labels=labels)
+    np.testing.assert_allclose(ss.solve(),
+                               np.asarray(full.coefficients, np.float64),
+                               rtol=0, atol=1e-10)
+
+
+def test_suffstats_decay_offset_and_guards():
+    labels = _labels(3)
+    ss = OnlineSuffStats.init(labels, P, rho=0.5)
+    ten, X, y = _chunk(labels, np.zeros((3, P)), 8, seed=1)
+    off = np.full(len(y), 0.25)
+    ss.update(ten, X, y, offset=off)
+    ss2 = OnlineSuffStats.init(labels, P, rho=0.5)
+    ss2.update(ten, X, y - off)
+    np.testing.assert_array_equal(ss.r, ss2.r)
+    # a tenant absent from a chunk still forgets (one global clock)
+    w0 = ss.wsum.copy()
+    ss.update(ten[:8], X[:8], y[:8])  # only t00 present
+    assert np.all(ss.wsum[1:] == 0.5 * w0[1:])
+    with pytest.raises(KeyError, match="unknown tenant"):
+        ss.update(["nope"] * 4, X[:4], y[:4])
+    with pytest.raises(ValueError, match="rho"):
+        OnlineSuffStats.init(labels, P, rho=1.5)
+    # no-mass tenants come back NaN from solve, never garbage
+    fresh = OnlineSuffStats.init(labels, P)
+    assert np.all(np.isnan(fresh.solve()))
+
+
+# ---------------------------------------------------------------------------
+# warm-start legalization: warm == cold at the f64 fixed point
+# ---------------------------------------------------------------------------
+
+def test_fleet_warm_start_matches_cold_fixed_point():
+    labels = _labels(6)
+    rng = np.random.default_rng(7)
+    K, n = len(labels), 96
+    X = rng.normal(size=(K, n, P))
+    beta = rng.normal(scale=0.8, size=(K, P))
+    y = np.stack([(rng.uniform(size=n)
+                   < 1 / (1 + np.exp(-X[k] @ beta[k]))).astype(float)
+                  for k in range(K)])
+    kw = dict(family="binomial", link="logit", labels=labels, tol=1e-12)
+    cold = glm_fit_fleet(X, y, **kw)
+    b_cold = np.asarray(cold.coefficients, np.float64)
+    # warm from the cold solution: already at the fixed point
+    warm = glm_fit_fleet(X, y, start=b_cold, **kw)
+    np.testing.assert_allclose(np.asarray(warm.coefficients, np.float64),
+                               b_cold, rtol=0, atol=1e-9)
+    # warm from a perturbed start: converges to the SAME fixed point
+    warm2 = glm_fit_fleet(X, y, start=b_cold + 0.3, **kw)
+    np.testing.assert_allclose(np.asarray(warm2.coefficients, np.float64),
+                               b_cold, rtol=0, atol=1e-9)
+    # repeat warm refit at the same shapes compiles nothing
+    base = fleet_kernel_cache_size()
+    glm_fit_fleet(X, y, start=b_cold + 0.1, **kw)
+    assert fleet_kernel_cache_size() - base == 0
+    # shape validation stays loud
+    with pytest.raises(ValueError, match=r"stacked \(K, p\)"):
+        glm_fit_fleet(X, y, start=b_cold[:, :2], **kw)
+
+
+def test_api_fleet_beta0_redirects_to_start():
+    data = {"y": np.arange(8.0), "x": np.arange(8.0),
+            "g": np.repeat(["a", "b"], 4)}
+    with pytest.raises(ValueError, match="start="):
+        sg.glm_fleet("y ~ x", data, groups="g", family="gaussian",
+                     beta0=np.zeros(2))
+
+
+# ---------------------------------------------------------------------------
+# drift gate
+# ---------------------------------------------------------------------------
+
+def test_drift_gate_reference_freeze_fire_and_rearm():
+    ring = RingBufferSink(64)
+    from sparkglm_tpu.obs.trace import FitTracer
+    tracer = FitTracer(sinks=[ring])
+    gate = DriftGate(["a", "b"], threshold=0.5, reference_chunks=2,
+                     window_chunks=2, min_count=4, tracer=tracer)
+    r = np.random.default_rng(0)
+    small = lambda: (np.abs(0.05 * r.normal(size=16)), 0.1, 16.0)
+    big = lambda: (np.abs(5.0 + r.normal(size=16)), 50.0, 16.0)
+    for _ in range(2):           # reference fills, then freezes
+        assert gate.observe_chunk({"a": small(), "b": small()}) == ()
+    assert gate.reference_frozen
+    # stable live window: no fire
+    for _ in range(2):
+        out = gate.observe_chunk({"a": small(), "b": small()})
+    assert out == ()
+    # drifted live window: tenant b fires, a stays
+    gate.observe_chunk({"a": small(), "b": big()})
+    out = gate.observe_chunk({"a": small(), "b": big()})
+    assert out == ("b",)
+    assert [e.kind for e in ring.events].count("drift_detected") == 1
+    ev = [e for e in ring.events if e.kind == "drift_detected"][0]
+    assert ev.fields["first"] == "b" and ev.fields["tenants"] == 1
+    # rearm: reference refills before anything can fire again
+    gate.rearm()
+    assert not gate.reference_frozen
+    assert gate.observe_chunk({"a": big(), "b": big()}) == ()
+
+
+def test_tv_distance_histograms():
+    a, b = Histogram(), Histogram()
+    assert tv_distance(a, b) == 0.0          # both empty: no evidence
+    for v in (0.1, 0.2, 0.4):
+        a.observe(v)
+    assert tv_distance(a, b) == 1.0          # one empty: maximal
+    for v in (0.1, 0.2, 0.4):
+        b.observe(v)
+    assert tv_distance(a, b) == 0.0
+    b.observe(100.0)
+    assert 0.0 < tv_distance(a, b) < 1.0
+
+
+# ---------------------------------------------------------------------------
+# the e2e loop: served family + drifting chunks + seeded regression
+# ---------------------------------------------------------------------------
+
+def test_online_loop_e2e_64_tenants():
+    K = 64
+    labels = _labels(K)
+    rng = np.random.default_rng(11)
+    beta_a = rng.normal(size=(K, P))
+    beta_b = beta_a + 2.5
+    beta_c = beta_b - 5.0
+    fam = _seed_family(labels, beta_a, "e2e", seed=11)
+    ring = RingBufferSink(4096)
+    loop = OnlineLoop(fam, rho=0.4, window_rows=64, drift_threshold=0.6,
+                      reference_chunks=2, window_chunks=2, min_count=4,
+                      watch_chunks=2, trace=ring)
+
+    rsc = fam.replicated_scorer(devices=jax.devices()[:2], min_bucket=8)
+    rsc.warmup(buckets=(8,))
+    assert rsc.compiles == 0
+    Xq = rng.normal(size=(5, P))
+
+    def served(tenant):
+        with AsyncEngine(rsc, EnginePolicy(max_wait_ms=2)) as eng:
+            return eng.submit(Xq, tenant=tenant).result(30)
+
+    # phase 1: reference + stable traffic, then drift episode 1
+    for c in range(4):
+        out = loop.step(*_chunk(labels, beta_a, 16, seed=100 + c))
+        assert out["drifted"] == () and out["rolled_back"] == ()
+    np.testing.assert_allclose(served(labels[0]),
+                               Xq @ fam.deployed_matrix()[1][0], rtol=1e-12)
+    deployed1 = ()
+    for c in range(2):
+        out = loop.step(*_chunk(labels, beta_b, 16, seed=200 + c))
+        deployed1 = deployed1 or out["deployed"]
+    assert deployed1, "drift episode 1 never deployed"
+    v1 = {t: fam.deployed_version(t) for t in deployed1}
+    assert all(v > 1 for v in v1.values())
+    # the engine follows the deploy recompile-free, mid-flight
+    np.testing.assert_allclose(served(deployed1[0]),
+                               Xq @ fam.deployed_matrix()[1][
+                                   labels.index(deployed1[0])], rtol=1e-12)
+
+    # phase 2 is the steady state: everything below must compile NOTHING
+    kernel_base = fleet_kernel_cache_size()
+    score_base = family_score_cache_size()
+    compiles_base = rsc.compiles
+
+    # re-reference (post-rearm) + stable window, then drift episode 2
+    for c in range(4):
+        out = loop.step(*_chunk(labels, beta_b, 16, seed=300 + c))
+        assert out["drifted"] == ()
+    deployed2 = ()
+    for c in range(2):
+        out = loop.step(*_chunk(labels, beta_c, 16, seed=400 + c))
+        deployed2 = deployed2 or out["deployed"]
+    assert deployed2, "drift episode 2 never deployed"
+    np.testing.assert_allclose(served(deployed2[0]),
+                               Xq @ fam.deployed_matrix()[1][
+                                   labels.index(deployed2[0])], rtol=1e-12)
+    # let the episode-2 watch expire on healthy chunks
+    for c in range(2):
+        loop.step(*_chunk(labels, beta_c, 16, seed=500 + c))
+
+    # seeded regression: a manually deployed bad champion rolls back on
+    # the next chunk that shows it regressing
+    bad_t = labels[0]
+    good_v = fam.deployed_version(bad_t)
+    bad = dataclasses.replace(
+        fam.model(bad_t),
+        coefficients=np.asarray(fam.model(bad_t).coefficients) + 25.0)
+    loop.deploy(bad_t, bad)
+    out = loop.step(*_chunk(labels, beta_c, 16, seed=600))
+    assert out["rolled_back"] == (bad_t,)
+    assert fam.deployed_version(bad_t) == good_v
+
+    assert fleet_kernel_cache_size() - kernel_base == 0, \
+        "steady-state refresh must not compile"
+    assert family_score_cache_size() - score_base == 0, \
+        "steady-state scoring/gating must not compile"
+    assert rsc.compiles == compiles_base == 0
+
+    # deterministic trace-event sequence: collapse runs of equal kinds
+    online_kinds = ("chunk_ingested", "drift_detected", "refresh_start",
+                    "refresh_end", "auto_deploy", "auto_rollback")
+    seq = [e for e in ring.events if e.kind in online_kinds]
+    collapsed = [k for i, k in enumerate(e.kind for e in seq)
+                 if i == 0 or seq[i - 1].kind != k]
+    assert collapsed == [
+        "chunk_ingested", "drift_detected", "refresh_start", "refresh_end",
+        "auto_deploy",                                   # episode 1
+        "chunk_ingested", "drift_detected", "refresh_start", "refresh_end",
+        "auto_deploy",                                   # episode 2
+        "chunk_ingested", "auto_rollback",               # seeded regression
+    ]
+    refresh_ends = [e for e in seq if e.kind == "refresh_end"]
+    assert [e.fields["mode"] for e in refresh_ends] == ["closed_form"] * 2
+    assert refresh_ends[1].fields["executables"] == 0
+    rb = [e for e in seq if e.kind == "auto_rollback"]
+    assert len(rb) == 1 and rb[0].fields["tenant"] == bad_t
+    deploys = [e for e in seq if e.kind == "auto_deploy"]
+    assert {e.fields["tenant"] for e in deploys} >= set(deployed2)
+    rep = loop.report()["online"]
+    assert rep["drift_detected"] == 2 and rep["refreshes"] == 2
+    assert rep["auto_rollbacks"] == 1
+    assert rep["auto_deploys"] == len(deploys)
+
+
+# ---------------------------------------------------------------------------
+# persistence: mid-stream resume under prefetch=2 is bit-identical
+# ---------------------------------------------------------------------------
+
+def test_loop_resume_bit_identical_under_prefetch(tmp_path):
+    K = 8
+    labels = _labels(K)
+    rng = np.random.default_rng(23)
+    beta_a = rng.normal(size=(K, P))
+    beta_b = beta_a + 2.5
+
+    def make_loop(name):
+        fam = _seed_family(labels, beta_a, name, seed=23)
+        return OnlineLoop(fam, rho=0.4, window_rows=32,
+                          drift_threshold=0.45, reference_chunks=2,
+                          window_chunks=2, min_count=4, watch_chunks=2)
+
+    chunks = ([_chunk(labels, beta_a, 16, seed=700 + c) for c in range(4)]
+              + [_chunk(labels, beta_b, 16, seed=800 + c)
+                 for c in range(4)])
+
+    # the uninterrupted oracle
+    loop_full = make_loop("full")
+    for ch in chunks:
+        loop_full.step(*ch)
+
+    # interrupted twin: 4 chunks, serialize, resume, stream the rest
+    # through run(prefetch=2)
+    loop_a = make_loop("twin")
+    for ch in chunks[:4]:
+        loop_a.step(*ch)
+    path = str(tmp_path / "loop.npz")
+    loop_a.save(path)
+    loop_b = OnlineLoop.load(path)
+    loop_b.run(lambda: iter(chunks[4:]), prefetch=2)
+
+    assert loop_b.suffstats.G.tobytes() == loop_full.suffstats.G.tobytes()
+    assert loop_b.suffstats.r.tobytes() == loop_full.suffstats.r.tobytes()
+    assert (loop_b.suffstats.wsum.tobytes()
+            == loop_full.suffstats.wsum.tobytes())
+    for attr in ("_Xw", "_yw", "_ww", "_ow", "_pos"):
+        assert (getattr(loop_b, attr).tobytes()
+                == getattr(loop_full, attr).tobytes()), attr
+    assert loop_b.gate._export() == loop_full.gate._export()
+    assert loop_b._watch == loop_full._watch
+    tb, Bb = loop_b.family.deployed_matrix()
+    tf, Bf = loop_full.family.deployed_matrix()
+    assert tb == tf and Bb.tobytes() == Bf.tobytes()
+    assert ({t: loop_b.family.deployed_version(t) for t in labels}
+            == {t: loop_full.family.deployed_version(t) for t in labels})
+    # and the artifact itself is byte-deterministic across a round trip
+    p2 = str(tmp_path / "again.npz")
+    loop_b.save(p2)
+    OnlineLoop.load(p2).save(str(tmp_path / "thrice.npz"))
+    assert (open(p2, "rb").read()
+            == open(str(tmp_path / "thrice.npz"), "rb").read())
+
+
+# ---------------------------------------------------------------------------
+# satellites: history bound, chunk tee, front-end
+# ---------------------------------------------------------------------------
+
+def test_family_history_bound_and_unbounded_opt_in():
+    labels = _labels(2)
+    beta = np.zeros((2, P))
+    fam = _seed_family(labels, beta, "bound", seed=1)
+    capped = ModelFamily("capped", history_cap=4)
+    unbounded = ModelFamily("unbounded", history_cap=None)
+    mdl = fam.model(labels[0])
+    for f in (capped, unbounded):
+        f.register("a", mdl)
+        for _ in range(20):
+            f.register("a", mdl, deploy=True)
+    _, meta_c = capped._export()
+    _, meta_u = unbounded._export()
+    assert len(meta_c["history"]["a"]) == 4          # bounded
+    assert len(meta_u["history"]["a"]) == 21         # opt-in: everything
+    # rollback still works at the bound
+    capped.rollback("a")
+    with pytest.raises(ValueError, match="history_cap"):
+        ModelFamily("tiny", history_cap=1)
+    # the cap round-trips through serialization
+    members, meta = capped._export()
+    assert meta["history_cap"] == 4
+    restored = ModelFamily._restore(members, dict(meta))
+    assert restored.history_cap == 4
+
+
+def test_tee_source_splits_one_stream():
+    pulls = []
+
+    def source():
+        def it():
+            for i in range(5):
+                pulls.append(i)
+                yield (np.array([f"t{i}"]), np.ones((1, P)),
+                       np.array([float(i)]))
+        return it()
+
+    a, b = tee_source(source, 2)
+    ia, ib = a(), b()
+    for i in range(5):
+        ta, Xa, ya = next(ia)
+        tb, Xb, yb = next(ib)
+        assert ta[0] == tb[0] == f"t{i}"
+        np.testing.assert_array_equal(ya, yb)
+    assert pulls == [0, 1, 2, 3, 4]  # the underlying stream ran ONCE
+    with pytest.raises(StopIteration):
+        next(ia)
+    # a branch lagging past max_lag fails loudly instead of buffering
+    # without bound
+    c, d = tee_source(source, 2, max_lag=2)
+    ic = c()
+    next(ic), next(ic)
+    with pytest.raises(RuntimeError, match="max_lag"):
+        next(ic)
+
+
+def test_online_fleet_frontend(rng):
+    n = 240
+    g = np.repeat([f"g{i}" for i in range(6)], n // 6)
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = 1.0 + 2.0 * x1 - x2 + 0.05 * rng.normal(size=n)
+    loop = sg.online_fleet("y ~ x1 + x2", {"y": y, "x1": x1, "x2": x2,
+                                           "seg": g},
+                           groups="seg", family="gaussian", rho=0.5,
+                           window_rows=32, reference_chunks=2,
+                           window_chunks=2, min_count=4)
+    assert isinstance(loop, sg.OnlineLoop)
+    assert loop.is_closed_form and loop.K == 6 and loop.p == 3
+    X = np.column_stack([np.ones(12), rng.normal(size=(12, 2))])
+    out = loop.step(np.repeat(["g0", "g1"], 6), X,
+                    X @ [1.0, 2.0, -1.0])
+    assert out["chunk"] == 1
+    assert loop.report()["online"]["chunks"] == 1
+    # the family is the serving handle
+    assert loop.family.deployed_matrix()[1].shape == (6, 3)
