@@ -8,7 +8,8 @@ CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve serve_async \
-        categorical penalized elastic sketch fleet hotloop online clean
+        categorical penalized elastic sketch fleet hotloop online \
+        obsplane clean
 
 all: native
 
@@ -107,6 +108,16 @@ hotloop:
 # latency, steady-state executable count == 0)
 online:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m online
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# runtime observability plane (sparkglm_tpu/obs: trace/context/slo/export):
+# request-scoped span chains under seeded 64-tenant load, SLO flight
+# recorder (one record per violation/drift episode), ring determinism
+# under wraparound + concurrent writers, Prometheus/JSONL export — plus
+# the serving_trace_overhead bench block (full plane on vs off through
+# the shared paired-run gate; zero kernel-cache growth)
+obsplane:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obsplane
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:

@@ -52,7 +52,9 @@ from .elastic import glm_fit_elastic, lm_fit_elastic
 from .parallel import distributed
 from .parallel.mesh import make_mesh, shard_rows, single_device_mesh
 from .penalized import ElasticNet, PathModel
-from .obs import FitTracer, JsonlSink, MetricsRegistry, RingBufferSink
+from .obs import (FitTracer, FlightRecorder, JsonlSink, MetricsRegistry,
+                  RingBufferSink, SLOMonitor, SLOSpec, Telemetry,
+                  prometheus_text)
 from .online import DriftGate, OnlineLoop, OnlineSuffStats
 from .serve import (AsyncEngine, BatchPolicy, EnginePolicy, FamilyScorer,
                     MicroBatcher, ModelFamily, ModelRegistry,
@@ -90,6 +92,8 @@ __all__ = [
     "NumericConfig", "DEFAULT",
     "robust",
     "obs", "FitTracer", "MetricsRegistry", "JsonlSink", "RingBufferSink",
+    "Telemetry", "SLOSpec", "SLOMonitor", "FlightRecorder",
+    "prometheus_text",
     "serve", "ModelRegistry", "Scorer", "MicroBatcher", "BatchPolicy",
     "AsyncEngine", "EnginePolicy", "ReplicatedScorer",
     "fleet", "fit_many", "glm_fit_fleet", "glm_fleet", "FleetModel",

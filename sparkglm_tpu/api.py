@@ -494,7 +494,7 @@ def online_fleet(formula: str, data, *, groups, family="gaussian",
                  watch_chunks: int = 4, jitter: float = 0.0,
                  tol: float = 1e-8, max_iter: int = 100,
                  batch: str = "exact", bucket: int | None = None,
-                 trace=None, metrics=None,
+                 trace=None, metrics=None, telemetry=None,
                  config: NumericConfig = DEFAULT):
     """Seed a per-group GLM fleet from ``data`` and return an armed
     :class:`~sparkglm_tpu.online.OnlineLoop` — the continuous-learning
@@ -515,6 +515,13 @@ def online_fleet(formula: str, data, *, groups, family="gaussian",
     (defaults to the ``groups`` column name).  The loop knobs (``rho``,
     ``window_rows``, drift/window thresholds, tolerances) are documented
     on :class:`~sparkglm_tpu.online.OnlineLoop`.
+
+    ``telemetry=`` (an :class:`~sparkglm_tpu.obs.Telemetry`) attaches the
+    runtime observability plane: cycle events feed its flight-recorder
+    ring (a ``drift_detected`` or ``auto_rollback`` dumps a record), the
+    drift gauges land in its registry, and the same object can serve the
+    family's ``async_engine(telemetry=...)`` so serving and learning
+    correlate in one event stream.
     """
     from .online import OnlineLoop
     from .serve import ModelFamily
@@ -525,7 +532,10 @@ def online_fleet(formula: str, data, *, groups, family="gaussian",
                       trace=trace, metrics=metrics, config=config)
     fam_name = name if name is not None else (
         groups if isinstance(groups, str) else "fleet")
-    fam = ModelFamily.from_fleet(fleet, fam_name, metrics=metrics)
+    fam = ModelFamily.from_fleet(
+        fleet, fam_name,
+        metrics=(metrics if metrics is not None
+                 else telemetry.metrics if telemetry is not None else None))
     return OnlineLoop(
         fam, rho=rho, window_rows=window_rows,
         drift_threshold=drift_threshold,
@@ -533,7 +543,7 @@ def online_fleet(formula: str, data, *, groups, family="gaussian",
         min_count=min_count, deviance_tolerance=deviance_tolerance,
         rollback_tolerance=rollback_tolerance, watch_chunks=watch_chunks,
         jitter=jitter, tol=tol, max_iter=max_iter, batch=batch,
-        trace=trace, metrics=metrics, config=config)
+        trace=trace, metrics=metrics, telemetry=telemetry, config=config)
 
 
 def _stream_io(path, *, chunk_bytes, native, backend: str = "auto",

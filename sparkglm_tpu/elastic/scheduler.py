@@ -59,6 +59,7 @@ import numpy as np
 from ..config import DEFAULT, NumericConfig
 from ..data.shards import shard_source, surviving_source
 from ..models import streaming as _stream
+from ..obs import context as _obs_context
 from ..obs import trace as _obs_trace
 from ..robust.checkpoint import CheckpointManager
 from ..robust.faults import SimulatedPreemption
@@ -156,71 +157,83 @@ def _run_shards(chunks, num_shards, pool, ckpt_dir, policy, budget, tracer,
     lost: dict = {}
     empty: list = []
     shard_retries = 0
+    # each shard fit is a CHILD SPAN of the installed elastic-fit context
+    # (obs/context.py): its events — shard lifecycle plus everything the
+    # inner streaming fit emits — carry span=shard-K, parent_span=fit.
+    # Span ids are structural (the shard index), so two runs of the same
+    # workload produce identical correlation keys.
+    root = _obs_context.current()
     for k in range(num_shards):
-        sub = shard_source(chunks, k, num_shards)
-        path = os.path.join(ckpt_dir, f"shard-{k:04d}.npz")
-        paths[k] = path
-        worker = pool.assign(k)
-        tracer.emit("shard_start", shard=k, worker=worker)
-        t0 = time.perf_counter()
-        attempt = 0
+        ctx = root.child(f"shard-{k:04d}") if root is not None else None
+        with _obs_context.use(ctx):
+            sub = shard_source(chunks, k, num_shards)
+            path = os.path.join(ckpt_dir, f"shard-{k:04d}.npz")
+            paths[k] = path
+            worker = pool.assign(k)
+            tracer.emit("shard_start", shard=k, worker=worker)
+            t0 = time.perf_counter()
+            attempt = 0
 
-        def fail(reason, e):
-            lost[k] = f"{reason}: {e!r}"[:200]
-            tracer.emit("shard_lost", shard=k, worker=worker, reason=reason,
-                        error=repr(e)[:200])
+            def fail(reason, e):
+                lost[k] = f"{reason}: {e!r}"[:200]
+                tracer.emit("shard_lost", shard=k, worker=worker,
+                            reason=reason, error=repr(e)[:200])
 
-        while True:
-            try:
-                model = fit_one(sub, path)
-            except SimulatedPreemption as e:
-                # the worker is gone; the shard itself is fine — restart it
-                # from checkpoint on a surviving worker, budget permitting
-                pool.preempt(worker)
-                attempt += 1
-                if attempt > policy.max_retries or not _spend(budget, e):
-                    fail("preemption_budget", e)
+            while True:
+                try:
+                    model = fit_one(sub, path)
+                except SimulatedPreemption as e:
+                    # the worker is gone; the shard itself is fine —
+                    # restart it from checkpoint on a surviving worker,
+                    # budget permitting
+                    pool.preempt(worker)
+                    attempt += 1
+                    if attempt > policy.max_retries \
+                            or not _spend(budget, e):
+                        fail("preemption_budget", e)
+                        break
+                    worker = pool.assign(k)
+                    shard_retries += 1
+                    tracer.emit("retry", key=f"shard:{k}", scope="shard",
+                                attempt=attempt - 1, worker=worker,
+                                delay_s=0.0, error=repr(e)[:200])
+                    continue
+                except (FatalSourceError, RetryBudgetExhausted) as e:
+                    fail("fatal" if isinstance(e, FatalSourceError)
+                         else "retry_budget", e)
                     break
-                worker = pool.assign(k)
-                shard_retries += 1
-                tracer.emit("retry", key=f"shard:{k}", scope="shard",
-                            attempt=attempt - 1, worker=worker,
-                            delay_s=0.0, error=repr(e)[:200])
-                continue
-            except (FatalSourceError, RetryBudgetExhausted) as e:
-                fail("fatal" if isinstance(e, FatalSourceError)
-                     else "retry_budget", e)
-                break
-            except ValueError as e:
-                if str(e) == _EMPTY_MSG:
-                    # more shards than chunks: an empty shard is NOT lost —
-                    # it holds no rows, so the combine loses nothing
-                    empty.append(k)
+                except ValueError as e:
+                    if str(e) == _EMPTY_MSG:
+                        # more shards than chunks: an empty shard is NOT
+                        # lost — it holds no rows, so the combine loses
+                        # nothing
+                        empty.append(k)
+                        tracer.emit("shard_end", shard=k, worker=worker,
+                                    empty=True, attempts=attempt + 1,
+                                    seconds=time.perf_counter() - t0)
+                        break
+                    raise
+                except Exception as e:
+                    if not policy.is_transient(e):
+                        raise
+                    attempt += 1
+                    if attempt > policy.max_retries \
+                            or not _spend(budget, e):
+                        fail("transient_budget", e)
+                        break
+                    shard_retries += 1
+                    delay = policy.delay(attempt - 1, ("shard", k))
+                    tracer.emit("retry", key=f"shard:{k}", scope="shard",
+                                attempt=attempt - 1, worker=worker,
+                                delay_s=delay, error=repr(e)[:200])
+                    policy.sleep(delay)
+                    continue
+                else:
+                    fitted[k] = model
                     tracer.emit("shard_end", shard=k, worker=worker,
-                                empty=True, attempts=attempt + 1,
+                                empty=False, attempts=attempt + 1,
                                 seconds=time.perf_counter() - t0)
                     break
-                raise
-            except Exception as e:
-                if not policy.is_transient(e):
-                    raise
-                attempt += 1
-                if attempt > policy.max_retries or not _spend(budget, e):
-                    fail("transient_budget", e)
-                    break
-                shard_retries += 1
-                delay = policy.delay(attempt - 1, ("shard", k))
-                tracer.emit("retry", key=f"shard:{k}", scope="shard",
-                            attempt=attempt - 1, worker=worker,
-                            delay_s=delay, error=repr(e)[:200])
-                policy.sleep(delay)
-                continue
-            else:
-                fitted[k] = model
-                tracer.emit("shard_end", shard=k, worker=worker, empty=False,
-                            attempts=attempt + 1,
-                            seconds=time.perf_counter() - t0)
-                break
     return fitted, paths, lost, empty, shard_retries
 
 
@@ -312,7 +325,12 @@ def glm_fit_elastic(
                                          **fit_kw)
 
     try:
-        with _obs_trace.ambient(tracer):
+        # one elastic fit is one trace; shard fits become child spans of
+        # the "fit" root (obs/context.py — ids are deterministic: a fresh
+        # tracer's mint counter, the same on every seeded run)
+        with _obs_trace.ambient(tracer), _obs_context.use(
+                _obs_context.TraceContext(trace=tracer.mint("elastic"),
+                                          span="fit")):
             tracer.emit("fit_start", model="glm_elastic", family=fam.name,
                         link=lnk.name, workers=workers, shards=num_shards)
             fitted, paths, lost, empty, shard_retries = _run_shards(
@@ -399,7 +417,9 @@ def lm_fit_elastic(
                                         **fit_kw)
 
     try:
-        with _obs_trace.ambient(tracer):
+        with _obs_trace.ambient(tracer), _obs_context.use(
+                _obs_context.TraceContext(trace=tracer.mint("elastic"),
+                                          span="fit")):
             tracer.emit("fit_start", model="lm_elastic", workers=workers,
                         shards=num_shards)
             fitted, paths, lost, empty, shard_retries = _run_shards(

@@ -1,37 +1,64 @@
-"""Structured fit telemetry: trace events, metrics, device-aware timing.
+"""Structured fit telemetry: trace events, metrics, device-aware timing,
+and the runtime observability plane (tracing / SLOs / export).
 
 The observability substrate for every fit flavor (resident, streaming,
-multi-process) and the robustness layer:
+multi-process), the robustness layer, and the serving/online runtime:
 
   * :mod:`.trace` — :class:`FitTracer` emitting typed, deterministically
     ordered events (``iter``, ``pass_start``/``pass_end``, ``retry``,
     ``checkpoint_write``, ``resume``, ``compile``, ``solve``,
-    ``queue_wait``/``prefetch_depth`` from pipelined passes, …) to JSONL
-    / stderr / ring-buffer sinks.  Every fit entry point takes ``trace=``;
+    ``queue_wait``/``prefetch_depth`` from pipelined passes, the serving
+    request span chain ``request_start``..``request_end``, …) to JSONL /
+    stderr / ring-buffer sinks.  Every fit entry point takes ``trace=``;
     ``verbose=True`` is the stderr-sink preset.  :func:`trace.capture` /
     :func:`trace.replay` let the prefetch pipeline's producer thread
     divert its events and re-emit them in chunk order on the consumer,
     keeping pipelined event sequences identical to sequential ones.
+  * :mod:`.context` — thread-local :class:`TraceContext` correlating
+    events across subsystems: one trace id per served request / online
+    refresh cycle / elastic fit, with parent/child span structure.  Ids
+    are minted deterministically (:meth:`FitTracer.mint`), never random.
   * :mod:`.metrics` — process-local counters/gauges/histograms with
     ``snapshot()`` and JSON export; pass ``metrics=`` to any fit.
+    Instruments are individually thread-safe (the serving engine mutates
+    them from many threads).
   * :mod:`.timing` — spans that ``block_until_ready`` only at span edges
     (the compiled ``lax.while_loop`` is never perturbed) plus an opt-in
-    ``jax.profiler`` trace hook.
+    ``jax.profiler`` trace hook; ``sample_rate=`` dials edge syncs down
+    deterministically on serving hot paths.
+  * :mod:`.slo` — declarative per-tenant :class:`SLOSpec` objectives
+    evaluated on rolling histogram windows (:class:`SLOMonitor`), and the
+    :class:`FlightRecorder`: a bounded event ring atomically dumped as a
+    deterministic JSONL record when an SLO violation, drift detection,
+    rollback, or overload rejection fires.
+  * :mod:`.export` — :func:`prometheus_text` snapshots,
+    :class:`TelemetryExporter` JSONL time series, and the
+    :class:`Telemetry` facade that wires the whole plane into
+    ``AsyncEngine(telemetry=)`` and ``sg.online_fleet(telemetry=)``.
 
 Events are host-side: tracing never changes device code, so traced and
-untraced fits produce bit-identical coefficients (PARITY.md).  Fitted
-models carry the tracer's aggregate as ``model.fit_report()``.
+untraced fits — and traced and untraced SERVING — produce bit-identical
+results (PARITY.md).  Fitted models carry the tracer's aggregate as
+``model.fit_report()``.
 """
 
+from .context import TraceContext
+from .context import current as current_context
+from .context import use as use_context
+from .export import Telemetry, TelemetryExporter, prometheus_text
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       get_registry)
-from .timing import Span, profiler_trace, span
+from .slo import FlightRecorder, SLOMonitor, SLOSpec
+from .timing import (Span, profiler_trace, reset_span_sampling, span)
 from .trace import (FitTracer, JsonlSink, RingBufferSink, Sink, StderrSink,
                     TraceEvent, ambient, as_tracer, current_tracer)
 
 __all__ = [
     "TraceEvent", "Sink", "JsonlSink", "StderrSink", "RingBufferSink",
     "FitTracer", "as_tracer", "ambient", "current_tracer",
+    "TraceContext", "use_context", "current_context",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
-    "Span", "span", "profiler_trace",
+    "Span", "span", "profiler_trace", "reset_span_sampling",
+    "SLOSpec", "SLOMonitor", "FlightRecorder",
+    "Telemetry", "TelemetryExporter", "prometheus_text",
 ]

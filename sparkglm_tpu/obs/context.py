@@ -1,0 +1,87 @@
+"""Request-scoped trace context: correlate events across subsystems.
+
+PR 2's FitTracer answers "what happened during this fit"; the runtime
+plane needs "what happened to THIS request / THIS refresh cycle / THIS
+shard" when many units of work interleave through one tracer.  A
+:class:`TraceContext` is the correlation key: a ``trace`` id naming the
+unit of work plus an optional ``span``/``parent_span`` pair for
+parent/child structure (an elastic fit is the parent span of its shard
+fits; an online refresh cycle is one trace).
+
+The context is installed per THREAD (:class:`use` / :func:`current`) and
+:meth:`FitTracer.emit` merges its fields into every event emitted while
+it is active — explicit event fields always win, so a layer that threads
+ids by hand (the async engine's per-request ``trace=``) is never
+clobbered.  No context installed -> no extra fields -> the pre-existing
+event vocabulary is byte-identical, which is what keeps the PR-2..13
+determinism tests (full ``key()`` comparisons) green.
+
+Id minting is DETERMINISTIC, never random: ids come from a per-tracer
+counter (:meth:`FitTracer.mint`) or from structural state (chunk number,
+shard index, per-engine submission counter), so two seeded runs produce
+identical trace ids and the "same chunks in, same events out" contract
+extends to the correlation keys themselves.
+
+Thread-local (not the module-global ambient-tracer pattern): contexts
+describe one unit of work on one thread — the async engine's scheduler,
+replica workers and callers each carry their own — whereas the ambient
+TRACER is process-wide because fits never run concurrently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+__all__ = ["TraceContext", "use", "current"]
+
+_STATE = threading.local()
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One unit of work: ``trace`` id plus optional span structure.
+
+    ``fields()`` is what :meth:`FitTracer.emit` merges into events;
+    ``child(span)`` derives a sub-span whose ``parent_span`` is this
+    context's span (or the trace id itself at the root)."""
+
+    trace: str
+    span: str = ""
+    parent_span: str = ""
+
+    def fields(self) -> dict:
+        f = {"trace": self.trace}
+        if self.span:
+            f["span"] = self.span
+        if self.parent_span:
+            f["parent_span"] = self.parent_span
+        return f
+
+    def child(self, span: str) -> "TraceContext":
+        return TraceContext(self.trace, span=str(span),
+                            parent_span=self.span or self.trace)
+
+
+def current() -> TraceContext | None:
+    """The thread's installed context, or None."""
+    return getattr(_STATE, "ctx", None)
+
+
+class use:
+    """Install ``ctx`` as this thread's current context for the block
+    (nests: the previous context is restored on exit).  ``None`` is a
+    no-op installer, so call sites need no conditional."""
+
+    def __init__(self, ctx: TraceContext | None):
+        self.ctx = ctx
+        self._prev: TraceContext | None = None
+
+    def __enter__(self) -> TraceContext | None:
+        self._prev = getattr(_STATE, "ctx", None)
+        if self.ctx is not None:
+            _STATE.ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, *exc) -> None:
+        _STATE.ctx = self._prev

@@ -1,0 +1,260 @@
+"""Export plane: Prometheus text snapshots, JSONL time series, and the
+one-stop :class:`Telemetry` wiring.
+
+The metrics substrate (obs/metrics.py) is deliberately pull-only — just
+numbers behind locks.  This module is the part that makes them legible
+outside the process:
+
+  * :func:`prometheus_text` renders a :class:`MetricsRegistry` snapshot
+    in the Prometheus text exposition format (counters, gauges, and the
+    log2 histograms as cumulative ``le=2^k`` buckets + ``+Inf``), ready
+    to serve from any HTTP handler or dump to a textfile-collector path.
+  * :class:`TelemetryExporter` appends timestamped registry snapshots to
+    a JSONL file — ``export_now()`` for explicit capture points, or
+    ``start()`` for a daemon thread on a fixed period.
+  * :class:`Telemetry` assembles the whole runtime observability plane —
+    registry + tracer + ring buffer + :class:`~.slo.SLOMonitor` +
+    :class:`~.slo.FlightRecorder` + exporter — behind one object that
+    plugs into ``AsyncEngine(telemetry=)`` and
+    ``sg.online_fleet(telemetry=)``.
+
+Everything here is host-side file/string work: attaching a Telemetry
+never changes what runs on the accelerator (PARITY.md), and the serving
+bench gates the end-to-end overhead (bench.py serving_trace_overhead).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+import time
+
+from .metrics import MetricsRegistry
+from .slo import FlightRecorder, SLOMonitor, SLOSpec
+from .trace import FitTracer, RingBufferSink
+
+__all__ = ["prometheus_text", "TelemetryExporter", "Telemetry"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    n = _NAME_RE.sub("_", name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return n
+
+
+def _prom_value(v) -> str:
+    if v is None:
+        return "NaN"
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v) if v != int(v) else str(int(v))
+
+
+def prometheus_text(registry: MetricsRegistry) -> str:
+    """Render one registry snapshot in the Prometheus text exposition
+    format (version 0.0.4).  Counters/gauges map directly; each log2
+    histogram becomes cumulative ``_bucket{le="2^k"}`` series (le is the
+    numeric upper bound, 2.0**k) plus ``_sum``/``_count`` and ``+Inf``,
+    which is exactly the information the SLO engine's quantile estimator
+    uses — a Prometheus ``histogram_quantile`` over these buckets agrees
+    with :meth:`Histogram.quantile` to bucket resolution."""
+    snap = registry.snapshot()
+    lines: list[str] = []
+    for name, value in snap["counters"].items():
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_prom_value(value)}")
+    for name, value in snap["gauges"].items():
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_prom_value(value)}")
+    for name, h in snap["histograms"].items():
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        # bucket_le keys are "2^k" strings; emit in ascending k order
+        ks = sorted(int(key[2:]) for key in h["bucket_le"])
+        for k in ks:
+            cum += h["bucket_le"][f"2^{k}"]
+            lines.append(f'{n}_bucket{{le="{_prom_value(2.0 ** k)}"}} {cum}')
+        lines.append(f'{n}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{n}_sum {_prom_value(h['sum'])}")
+        lines.append(f"{n}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+class TelemetryExporter:
+    """Append timestamped registry snapshots to a JSONL time series.
+
+    One line per capture: ``{"t": <unix>, "metrics": <snapshot>}``.
+    ``export_now()`` captures explicitly; ``start()`` spawns a daemon
+    thread capturing every ``interval_s`` until ``stop()`` (idempotent,
+    and ``stop()`` flushes one final capture so short runs always leave
+    at least one sample).
+    """
+
+    def __init__(self, path: str | os.PathLike, registry: MetricsRegistry,
+                 *, interval_s: float = 10.0):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.path = os.fspath(path)
+        self.registry = registry
+        self.interval_s = float(interval_s)
+        self.exports = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def export_now(self) -> None:
+        line = json.dumps({"t": time.time(),
+                           "metrics": self.registry.snapshot()},
+                          sort_keys=True)
+        with self._lock:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+            self.exports += 1
+
+    def start(self) -> "TelemetryExporter":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.wait(self.interval_s):
+                self.export_now()
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="sparkglm-telemetry-export")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        t, self._thread = self._thread, None
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout=5.0)
+        self.export_now()  # final flush: short runs still get a sample
+
+
+class Telemetry:
+    """The assembled runtime observability plane.
+
+    One object wiring together everything a serving/online deployment
+    needs::
+
+        tel = Telemetry("obs_out", slos=[SLOSpec(p99_ms=50.0)])
+        eng = scorer.async_engine(policy, telemetry=tel)
+        ...
+        print(tel.prometheus())          # scrape snapshot
+        print(tel.flight_records)        # triggered JSONL dumps
+        tel.close()
+
+    Components (all reachable as attributes):
+
+      * ``metrics`` — a :class:`MetricsRegistry` (private by default so
+        concurrent deployments don't collide in the process-global one).
+      * ``tracer`` — a :class:`FitTracer` whose sinks are the event ring
+        (``ring``), the :class:`FlightRecorder` (``recorder``), the
+        :class:`SLOMonitor` (``monitor``, as its staleness listener),
+        plus any extra ``sinks=`` (JSONL path / Sink instances).
+      * ``exporter`` — a :class:`TelemetryExporter` appending to
+        ``<dir>/metrics.jsonl`` (started automatically when
+        ``export_interval_s`` is set; ``export_now()`` always works).
+
+    ``dir=None`` runs memory-only: no flight records on disk, no JSONL
+    export, but tracing/SLO evaluation fully live (tests, notebooks).
+    ``evaluate_slos()`` is cheap and rate-limited — the async engine
+    calls it after every batch completion.
+    """
+
+    def __init__(self, dir: str | os.PathLike | None = None, *,
+                 slos=(), window_s: float = 60.0,
+                 ring_capacity: int = 4096, flight_capacity: int = 2048,
+                 cooldown_s: float = 30.0, include_times: bool = False,
+                 export_interval_s: float | None = None,
+                 sinks=(), metrics: MetricsRegistry | None = None):
+        self.dir = os.fspath(dir) if dir is not None else None
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.ring = RingBufferSink(ring_capacity)
+        self.monitor = SLOMonitor(
+            [s if isinstance(s, SLOSpec) else SLOSpec(**s) for s in slos],
+            metrics=self.metrics, window_s=window_s)
+        self.recorder: FlightRecorder | None = None
+        self.exporter: TelemetryExporter | None = None
+        sink_list: list = [self.ring]
+        if self.dir is not None:
+            self.recorder = FlightRecorder(
+                os.path.join(self.dir, "flight"),
+                capacity=flight_capacity, cooldown_s=cooldown_s,
+                include_times=include_times, metrics=self.metrics)
+            sink_list.append(self.recorder)
+            self.exporter = TelemetryExporter(
+                os.path.join(self.dir, "metrics.jsonl"), self.metrics,
+                interval_s=(export_interval_s if export_interval_s
+                            else 10.0))
+        sink_list.append(self.monitor)
+        sink_list.extend(sinks)
+        self.tracer = FitTracer(sink_list, metrics=self.metrics)
+        self.monitor.tracer = self.tracer
+        if self.exporter is not None and export_interval_s:
+            self.exporter.start()
+
+    # -- wiring hooks the engines call --------------------------------------
+    def watch_engine(self, name: str) -> None:
+        """Bind SLO evaluation to engine ``name``'s metric namespace
+        (``AsyncEngine`` calls this on construction)."""
+        self.monitor.watch_engine(name)
+
+    def mint(self, prefix: str) -> str:
+        """Deterministic id from the tracer's counter (obs/context.py)."""
+        return self.tracer.mint(prefix)
+
+    def evaluate_slos(self, *, force: bool = False) -> list[dict]:
+        """One (rate-limited) SLO evaluation pass; returns new
+        violations.  Called by the engine after each batch."""
+        return self.monitor.evaluate(force=force)
+
+    # -- operator surface ---------------------------------------------------
+    @property
+    def flight_records(self) -> list[str]:
+        """Paths of flight records dumped so far (empty when memory-only)."""
+        return list(self.recorder.records) if self.recorder else []
+
+    def events(self):
+        """Recent events from the in-memory ring (newest-last)."""
+        return self.ring.events
+
+    def prometheus(self) -> str:
+        """Prometheus text-format snapshot of the registry."""
+        return prometheus_text(self.metrics)
+
+    def export_now(self) -> None:
+        """Append one metrics snapshot to ``<dir>/metrics.jsonl``."""
+        if self.exporter is not None:
+            self.exporter.export_now()
+
+    def report(self) -> dict:
+        """The tracer's aggregate report (fit_report schema)."""
+        return self.tracer.report()
+
+    def close(self) -> None:
+        if self.exporter is not None:
+            self.exporter.stop()
+        self.tracer.close()
+
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -11,6 +11,15 @@ Histograms keep count/sum/min/max plus power-of-two bucket counts
 (``bucket_le[k]`` counts observations <= 2^k seconds), enough for the
 IO-vs-compute pass-latency questions the streaming fits ask without
 storing samples.
+
+Instruments are individually THREAD-SAFE: the async serving engine
+mutates them from its caller threads, its scheduler loop thread and one
+worker thread per replica concurrently, and ``Counter.inc`` /
+``Histogram.observe`` are read-modify-write sequences that lose updates
+without a lock (a hammer test enforces exact counts).  Each instrument
+carries its own small lock rather than sharing the registry's, so hot
+serving counters never contend with instrument creation or snapshots of
+unrelated metrics.
 """
 
 from __future__ import annotations
@@ -24,22 +33,28 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
 
 
 class Counter:
-    """Monotone event count."""
+    """Monotone event count (thread-safe: ``+=`` on a shared int is a
+    read-modify-write that loses increments under the serving engine's
+    concurrent worker threads)."""
 
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self):
         self.value = 0
+        self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
     def snapshot(self):
         return self.value
 
 
 class Gauge:
-    """Last-set value (e.g. the current deviance)."""
+    """Last-set value (e.g. the current deviance).  A single-reference
+    store is atomic under the GIL, so no lock is needed — last writer
+    wins, which is the gauge contract."""
 
     __slots__ = ("value",)
 
@@ -54,9 +69,11 @@ class Gauge:
 
 
 class Histogram:
-    """count/sum/min/max plus log2 bucket counts; no stored samples."""
+    """count/sum/min/max plus log2 bucket counts; no stored samples.
+    ``observe``/``snapshot`` are thread-safe (multi-field updates must be
+    atomic or concurrent observers corrupt count vs bucket totals)."""
 
-    __slots__ = ("count", "total", "min", "max", "buckets")
+    __slots__ = ("count", "total", "min", "max", "buckets", "_lock")
 
     def __init__(self):
         self.count = 0
@@ -64,16 +81,25 @@ class Histogram:
         self.min = math.inf
         self.max = -math.inf
         self.buckets: dict[int, int] = {}
+        self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
         v = float(v)
-        self.count += 1
-        self.total += v
-        self.min = min(self.min, v)
-        self.max = max(self.max, v)
         # bucket k counts observations <= 2^k (k = ceil(log2 v), clamped)
         k = max(-30, math.ceil(math.log2(v))) if v > 0 else -30
-        self.buckets[k] = self.buckets.get(k, 0) + 1
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+            self.buckets[k] = self.buckets.get(k, 0) + 1
+
+    def _state(self) -> tuple:
+        """A consistent (count, total, min, max, buckets) copy — readers
+        must not interleave with a multi-field ``observe``."""
+        with self._lock:
+            return (self.count, self.total, self.min, self.max,
+                    dict(self.buckets))
 
     def quantile(self, q: float) -> float | None:
         """Estimate the ``q``-quantile from the log2 buckets (no stored
@@ -84,20 +110,9 @@ class Histogram:
         observed [min, max] — so q=0/q=1 return min/max exactly, and a
         one-bucket histogram stays inside its true range.  Serving SLOs
         (p50/p99) read this; ``snapshot()`` exports both."""
-        if not self.count:
-            return None
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile q must be in [0, 1], got {q}")
-        target = q * self.count
-        cum = 0
-        for k in sorted(self.buckets):
-            prev, cum = cum, cum + self.buckets[k]
-            if cum >= target:
-                frac = ((target - prev) / self.buckets[k]
-                        if self.buckets[k] else 0.0)
-                est = 2.0 ** (k - 1 + frac)
-                return float(min(max(est, self.min), self.max))
-        return float(self.max)  # pragma: no cover - cum == count >= target
+        return _bucket_quantile(q, *self._state())
 
     def distribution(self) -> dict[int, float]:
         """Normalized bucket mass ``{k: P(obs in bucket k)}`` — the
@@ -105,22 +120,40 @@ class Histogram:
         Drift gates (sparkglm_tpu/online/drift.py) compare a live
         window's distribution against a frozen reference window's via
         :func:`tv_distance`."""
-        if not self.count:
+        count, _, _, _, buckets = self._state()
+        if not count:
             return {}
-        return {k: n / self.count for k, n in sorted(self.buckets.items())}
+        return {k: n / count for k, n in sorted(buckets.items())}
 
     def snapshot(self):
+        count, total, mn, mx, buckets = self._state()
         return {
-            "count": self.count,
-            "sum": self.total,
-            "min": self.min if self.count else None,
-            "max": self.max if self.count else None,
-            "mean": self.total / self.count if self.count else None,
-            "p50": self.quantile(0.5),
-            "p99": self.quantile(0.99),
-            "bucket_le": {f"2^{k}": n
-                          for k, n in sorted(self.buckets.items())},
+            "count": count,
+            "sum": total,
+            "min": mn if count else None,
+            "max": mx if count else None,
+            "mean": total / count if count else None,
+            "p50": _bucket_quantile(0.5, count, total, mn, mx, buckets),
+            "p99": _bucket_quantile(0.99, count, total, mn, mx, buckets),
+            "bucket_le": {f"2^{k}": n for k, n in sorted(buckets.items())},
         }
+
+
+def _bucket_quantile(q, count, total, mn, mx, buckets) -> float | None:
+    """The quantile estimator over an already-copied histogram state
+    (see :meth:`Histogram.quantile` for the semantics)."""
+    del total
+    if not count:
+        return None
+    target = q * count
+    cum = 0
+    for k in sorted(buckets):
+        prev, cum = cum, cum + buckets[k]
+        if cum >= target:
+            frac = (target - prev) / buckets[k] if buckets[k] else 0.0
+            est = 2.0 ** (k - 1 + frac)
+            return float(min(max(est, mn), mx))
+    return float(mx)  # pragma: no cover - cum == count >= target
 
 
 def tv_distance(a, b) -> float:

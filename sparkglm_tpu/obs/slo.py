@@ -1,0 +1,365 @@
+"""Declarative SLOs and the flight recorder: triggered evidence capture.
+
+Two operator questions the metrics/trace substrate could not answer on
+its own:
+
+  * "is tenant X inside its latency/error/staleness budget RIGHT NOW?" —
+    :class:`SLOSpec` declares per-tenant (or aggregate) objectives and
+    :class:`SLOMonitor` evaluates them on a ROLLING WINDOW of the
+    existing log2 histograms (obs/metrics.py): each ``evaluate()`` call
+    snapshots instrument state, and the window is the bucket-count DELTA
+    against the snapshot one window back — no stored samples, same
+    bounded state as everything else in obs/.  Violations are a state
+    machine per (tenant, objective): ``slo_violation`` fires on the
+    ok->violating TRANSITION only (``slo_recovered`` on the way back),
+    so one violation episode is one event, not one per evaluation tick.
+
+  * "what happened in the 60 s before that page?" — :class:`FlightRecorder`
+    is a :class:`~sparkglm_tpu.obs.trace.Sink` keeping a bounded ring of
+    recent events; when a trigger event arrives (``slo_violation``,
+    ``drift_detected``, ``auto_rollback``, or an ``Overloaded`` admission
+    rejection) it atomically dumps the ring as one JSONL flight record
+    with the triggering event pinned in the header.  Because FitTracer
+    delivers events to sinks under its sequencing lock (obs/trace.py),
+    the ring is in seq order and the dump is deterministic and complete
+    for the last N events even with concurrent emitters — the property
+    the wraparound/concurrent-writer tests pin.  Records are
+    byte-deterministic under seeded load: wall-clock timestamps are
+    excluded unless ``include_times=True``.
+
+Neither class touches device code; SLO evaluation reads host counters
+and the recorder writes host files — the serving path's numerics and
+compile census are untouched (PARITY.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .metrics import Counter, Histogram, MetricsRegistry, _bucket_quantile
+from .trace import Sink, TraceEvent
+
+__all__ = ["SLOSpec", "SLOMonitor", "FlightRecorder"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """One tenant's (or the aggregate's) service-level objectives.
+
+    ``tenant=None`` reads the engine-wide instruments; a named tenant
+    reads the per-tenant latency histogram the engine maintains under
+    ``telemetry=``.  Objectives left ``None`` are not evaluated.
+
+    Args:
+      tenant: tenant label, or None for the aggregate.
+      p50_ms / p99_ms: windowed latency quantile budgets (milliseconds).
+      error_rate: max (errors + overload rejections) / admissions in the
+        window, in [0, 1].
+      staleness_s: max seconds since the online loop last absorbed a
+        chunk or finished a refresh (freshness of the served models).
+      min_count: observations required in the window before latency /
+        error objectives are trusted (tiny windows make noise).
+    """
+
+    tenant: str | None = None
+    p50_ms: float | None = None
+    p99_ms: float | None = None
+    error_rate: float | None = None
+    staleness_s: float | None = None
+    min_count: int = 1
+
+    def __post_init__(self):
+        for name in ("p50_ms", "p99_ms", "staleness_s"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be > 0, got {v}")
+        if self.error_rate is not None \
+                and not 0.0 <= self.error_rate <= 1.0:
+            raise ValueError(
+                f"error_rate must be in [0, 1], got {self.error_rate}")
+        if self.min_count < 1:
+            raise ValueError(f"min_count must be >= 1, got {self.min_count}")
+
+
+# staleness refreshers: any of these marks the served fleet "fresh now"
+_FRESH_KINDS = ("chunk_ingested", "refresh_end", "auto_deploy")
+
+
+class SLOMonitor(Sink):
+    """Evaluate :class:`SLOSpec` objectives on rolling histogram windows.
+
+    Doubles as a trace sink: it passively records the wall time of
+    freshness events (``chunk_ingested``/``refresh_end``/``auto_deploy``)
+    for the staleness objective.  The sink hook is lock-free (it runs
+    under the tracer's emit lock — see obs/trace.py — and must never
+    block on the evaluation lock).
+
+    ``evaluate()`` is safe to call from any thread and from every batch
+    completion: it rate-limits itself to one real evaluation per
+    ``min_eval_interval_s`` unless ``force=True``.
+    """
+
+    def __init__(self, specs=(), *, metrics: MetricsRegistry | None = None,
+                 tracer=None, window_s: float = 60.0,
+                 min_eval_interval_s: float = 0.25):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.specs = tuple(specs)
+        for s in self.specs:
+            if not isinstance(s, SLOSpec):
+                raise TypeError(f"specs must be SLOSpec instances, got "
+                                f"{type(s).__name__}")
+        self.metrics = metrics
+        self.tracer = tracer
+        self.window_s = float(window_s)
+        self.min_eval_interval_s = float(min_eval_interval_s)
+        self._engine: str | None = None
+        self._lock = threading.Lock()
+        # per-metric deques of (wall_t, state-copy) for window deltas
+        self._snaps: dict[str, deque] = {}
+        self._violating: set[tuple] = set()
+        self._last_eval = -float("inf")
+        self._last_fresh: float | None = None  # sink-hook write, atomic
+
+    # -- sink hook (runs under the tracer's emit lock; never blocks) --------
+    def emit(self, event: TraceEvent) -> None:
+        if event.kind in _FRESH_KINDS:
+            self._last_fresh = time.time()
+
+    # -- wiring -------------------------------------------------------------
+    def watch_engine(self, name: str) -> None:
+        """Bind the serving metric namespace (``serve.<name>.*``)."""
+        self._engine = str(name)
+
+    @property
+    def violating(self) -> tuple:
+        """Currently-violating (tenant, objective) pairs, sorted."""
+        with self._lock:
+            return tuple(sorted(self._violating,
+                                key=lambda k: (str(k[0]), k[1])))
+
+    # -- evaluation ---------------------------------------------------------
+    def evaluate(self, now: float | None = None, *,
+                 force: bool = False) -> list[dict]:
+        """One evaluation pass; returns NEW violations (transitions into
+        violation this call), each as a dict with tenant/objective/
+        observed/target.  Emits ``slo_violation``/``slo_recovered``
+        through the tracer on transitions."""
+        if not self.specs:
+            return []
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            if not force and now - self._last_eval \
+                    < self.min_eval_interval_s:
+                return []
+            self._last_eval = now
+            checks = []
+            for spec in self.specs:
+                checks.extend(self._check_spec_locked(spec, now))
+            fired, recovered = [], []
+            for key, violated, observed, target in checks:
+                if violated and key not in self._violating:
+                    self._violating.add(key)
+                    fired.append(dict(tenant=key[0], objective=key[1],
+                                      observed=observed, target=target))
+                elif not violated and key in self._violating:
+                    self._violating.discard(key)
+                    recovered.append(dict(tenant=key[0], objective=key[1],
+                                          observed=observed, target=target))
+        # emit OUTSIDE the evaluation lock: tracer.emit takes the tracer
+        # lock and runs this monitor's own sink hook under it
+        tr = self.tracer
+        if tr is not None:
+            for v in fired:
+                tr.emit("slo_violation", tenant=str(v["tenant"]),
+                        objective=v["objective"],
+                        observed=round(float(v["observed"]), 6),
+                        target=float(v["target"]))
+            for v in recovered:
+                tr.emit("slo_recovered", tenant=str(v["tenant"]),
+                        objective=v["objective"],
+                        observed=round(float(v["observed"]), 6),
+                        target=float(v["target"]))
+        return fired
+
+    # -- internals ----------------------------------------------------------
+    def _check_spec_locked(self, spec: SLOSpec, now: float) -> list:
+        out = []
+        tkey = spec.tenant if spec.tenant is not None else "*"
+        if (spec.p50_ms is not None or spec.p99_ms is not None) \
+                and self.metrics is not None and self._engine is not None:
+            name = (f"serve.{self._engine}.latency_s" if spec.tenant is None
+                    else f"serve.{self._engine}.tenant."
+                         f"{spec.tenant}.latency_s")
+            count, mn, mx, buckets = self._window_hist(name, now)
+            if count >= spec.min_count:
+                for q, target_ms, obj in ((0.5, spec.p50_ms, "p50_ms"),
+                                          (0.99, spec.p99_ms, "p99_ms")):
+                    if target_ms is None:
+                        continue
+                    est = _bucket_quantile(q, count, 0.0, mn, mx, buckets)
+                    obs_ms = float(est) * 1e3
+                    out.append(((tkey, obj), obs_ms > target_ms, obs_ms,
+                                target_ms))
+        if spec.error_rate is not None and self.metrics is not None \
+                and self._engine is not None:
+            base = f"serve.{self._engine}"
+            errs = (self._window_counter(f"{base}.errors", now)
+                    + self._window_counter(f"{base}.overloaded", now))
+            done = self._window_counter(f"{base}.requests_done", now)
+            total = errs + done
+            if total >= spec.min_count:
+                rate = errs / total
+                out.append(((tkey, "error_rate"), rate > spec.error_rate,
+                            rate, spec.error_rate))
+        if spec.staleness_s is not None and self._last_fresh is not None:
+            stale = now - self._last_fresh
+            out.append(((tkey, "staleness_s"), stale > spec.staleness_s,
+                        stale, spec.staleness_s))
+        return out
+
+    def _instrument(self, name: str):
+        reg = self.metrics
+        if reg is None:
+            return None
+        with reg._lock:
+            return reg._instruments.get(name)
+
+    def _baseline(self, name: str, now: float, state):
+        """Record ``state`` and return the newest snapshot at least one
+        window old (or the oldest available) as the delta baseline."""
+        dq = self._snaps.setdefault(name, deque())
+        base = None
+        for t, st in dq:
+            if t <= now - self.window_s:
+                base = st
+            else:
+                break
+        if base is None and dq:
+            base = dq[0][1]
+        dq.append((now, state))
+        # prune anything older than two windows: never needed again
+        while dq and dq[0][0] < now - 2 * self.window_s:
+            dq.popleft()
+        return base
+
+    def _window_hist(self, name: str, now: float):
+        inst = self._instrument(name)
+        if not isinstance(inst, Histogram):
+            return 0, 0.0, 0.0, {}
+        count, _, mn, mx, buckets = inst._state()
+        base = self._baseline(name, now, (count, buckets))
+        if base is None:
+            return count, mn, mx, buckets
+        bcount, bbuckets = base
+        dbuckets = {k: n - bbuckets.get(k, 0) for k, n in buckets.items()
+                    if n - bbuckets.get(k, 0) > 0}
+        # min/max are lifetime, not windowed — acceptable clamps for a
+        # bucket-resolution estimate
+        return count - bcount, mn, mx, dbuckets
+
+    def _window_counter(self, name: str, now: float) -> int:
+        inst = self._instrument(name)
+        if not isinstance(inst, Counter):
+            return 0
+        v = int(inst.value)
+        base = self._baseline(name, now, v)
+        return v if base is None else v - int(base)
+
+
+class FlightRecorder(Sink):
+    """Bounded ring of recent events, atomically dumped on triggers.
+
+    Attach to a :class:`~sparkglm_tpu.obs.trace.FitTracer` as a sink.
+    Every event lands in a ``capacity``-deep ring; when a trigger event
+    arrives — kind in ``triggers``, or an ``admission`` event with
+    ``outcome="overloaded"`` — the ring is written to
+    ``dir/flight-NNNN-<kind>.jsonl`` via a temp file + ``os.replace``
+    (atomic: a crashed dump never leaves a torn record).  Line 1 is a
+    header pinning the triggering event's seq/kind; each following line
+    is one event in seq order.  Wall-clock timestamps are excluded
+    unless ``include_times=True``, so records are byte-deterministic
+    under seeded load.
+
+    ``cooldown_s`` suppresses repeat dumps of the SAME trigger kind
+    within the window (an overload storm yields one record, not one per
+    rejected request); transition-style triggers (``slo_violation``,
+    ``drift_detected``) already fire once per episode.
+    """
+
+    DEFAULT_TRIGGERS = ("slo_violation", "drift_detected", "auto_rollback")
+
+    def __init__(self, dir: str | os.PathLike, *, capacity: int = 2048,
+                 triggers=None, overload_trigger: bool = True,
+                 cooldown_s: float = 30.0, include_times: bool = False,
+                 metrics: MetricsRegistry | None = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.dir = os.fspath(dir)
+        self.capacity = int(capacity)
+        self.triggers = tuple(self.DEFAULT_TRIGGERS if triggers is None
+                              else triggers)
+        self.overload_trigger = bool(overload_trigger)
+        self.cooldown_s = float(cooldown_s)
+        self.include_times = bool(include_times)
+        self.metrics = metrics
+        self.records: list[str] = []
+        self._ring: deque[TraceEvent] = deque(maxlen=self.capacity)
+        self._last_dump: dict[str, float] = {}
+
+    def _is_trigger(self, event: TraceEvent) -> bool:
+        if event.kind in self.triggers:
+            return True
+        return (self.overload_trigger and event.kind == "admission"
+                and event.fields.get("outcome") == "overloaded")
+
+    def emit(self, event: TraceEvent) -> None:
+        # runs under the tracer's emit lock (obs/trace.py): appends are
+        # seq-ordered and a dump is atomic w.r.t. concurrent emitters
+        self._ring.append(event)
+        if not self._is_trigger(event):
+            return
+        now = time.time()
+        last = self._last_dump.get(event.kind)
+        if last is not None and now - last < self.cooldown_s:
+            return
+        self._last_dump[event.kind] = now
+        self.dump(event)
+
+    def _event_line(self, ev: TraceEvent) -> str:
+        d = {"seq": ev.seq, "kind": ev.kind, **ev.fields}
+        if self.include_times:
+            d["t"] = ev.t
+        return json.dumps(d, sort_keys=True)
+
+    def dump(self, trigger: TraceEvent | None = None) -> str:
+        """Write one flight record from the current ring; returns the
+        path.  Called automatically on triggers; callable manually for
+        operator-initiated capture."""
+        os.makedirs(self.dir, exist_ok=True)
+        events = list(self._ring)
+        kind = trigger.kind if trigger is not None else "manual"
+        name = f"flight-{len(self.records):04d}-{kind}.jsonl"
+        path = os.path.join(self.dir, name)
+        header = {
+            "schema": "sparkglm.flight_record.v1",
+            "trigger_kind": kind,
+            "trigger_seq": trigger.seq if trigger is not None else None,
+            "events": len(events),
+            "capacity": self.capacity,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps(header, sort_keys=True) + "\n")
+            for ev in events:
+                f.write(self._event_line(ev) + "\n")
+        os.replace(tmp, path)
+        self.records.append(path)
+        if self.metrics is not None:
+            self.metrics.counter("obs.flight_records").inc()
+        return path

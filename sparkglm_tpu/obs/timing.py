@@ -19,16 +19,40 @@ event (name, seconds, device flag) into ``tracer`` — or the ambient
 tracer when none was given.  ``profiler=True`` additionally brackets the
 span in a ``jax.profiler.TraceAnnotation`` so it shows up on the XLA
 trace timeline (opt-in: annotations are free but nonzero).
+
+``sample_rate=`` dials device-syncing spans down on serving hot paths:
+at rate ``r`` only every ``round(1/r)``-th span of a given NAME runs the
+edge sync and emits (deterministic per-name counters, not random — the
+same seeded run samples the same spans), and the emitted event carries
+``sample_rate`` so consumers can upweight its contribution.  The default
+``1.0`` keeps today's every-span behavior exactly; ``0`` disables the
+span entirely (no sync, no event) while leaving the ``with`` block
+valid.  Unsampled spans skip the ``block_until_ready`` — the measurement
+cost — but never change what was enqueued, so the numerics-neutrality
+contract is unchanged.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 
 from . import trace as _trace
 
-__all__ = ["Span", "span", "sync", "profiler_trace"]
+__all__ = ["Span", "span", "sync", "profiler_trace",
+           "reset_span_sampling"]
+
+# per-name deterministic sampling counters (module-level so every Span of
+# one name shares a stride phase; reset_span_sampling() for tests)
+_SAMPLE_LOCK = threading.Lock()
+_SAMPLE_COUNTS: dict[str, int] = {}
+
+
+def reset_span_sampling() -> None:
+    """Reset the per-name sampling counters (test isolation)."""
+    with _SAMPLE_LOCK:
+        _SAMPLE_COUNTS.clear()
 
 
 def sync(tree) -> None:
@@ -49,11 +73,16 @@ class Span:
     the edges and emits one ``span`` event on exit."""
 
     def __init__(self, name: str, tracer=None, *, device: bool = False,
-                 profiler: bool = False):
+                 profiler: bool = False, sample_rate: float = 1.0):
+        if not 0.0 <= sample_rate <= 1.0:
+            raise ValueError(
+                f"sample_rate must be in [0, 1], got {sample_rate}")
         self.name = name
         self.tracer = tracer
         self.device = device
         self.profiler = profiler
+        self.sample_rate = float(sample_rate)
+        self.sampled = True
         self.seconds = 0.0
         self._watched: list = []
         self._ann = None
@@ -63,8 +92,20 @@ class Span:
         """Register outputs to ``block_until_ready`` at ``__exit__``."""
         self._watched.extend(trees)
 
+    def _decide_sampled(self) -> bool:
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        stride = max(1, round(1.0 / self.sample_rate))
+        with _SAMPLE_LOCK:
+            n = _SAMPLE_COUNTS.get(self.name, 0)
+            _SAMPLE_COUNTS[self.name] = n + 1
+        return n % stride == 0
+
     def __enter__(self) -> "Span":
-        if self.profiler:
+        self.sampled = self._decide_sampled()
+        if self.profiler and self.sampled:
             import jax
             self._ann = jax.profiler.TraceAnnotation(self.name)
             self._ann.__enter__()
@@ -72,6 +113,10 @@ class Span:
         return self
 
     def __exit__(self, *exc) -> None:
+        if not self.sampled:
+            # unsampled: no edge sync (the cost being dialed down), no
+            # event — the block's work itself is untouched
+            return
         if self._watched:
             sync(self._watched)
         self.seconds = time.perf_counter() - self._t0
@@ -82,14 +127,19 @@ class Span:
         tr = self.tracer if self.tracer is not None \
             else _trace.current_tracer()
         if tr is not None:
-            tr.emit("span", name=self.name, seconds=self.seconds,
-                    device=bool(self.device or self._watched))
+            f = dict(name=self.name, seconds=self.seconds,
+                     device=bool(self.device or self._watched))
+            if self.sample_rate < 1.0:
+                # consumers upweight: this event stands for ~1/rate spans
+                f["sample_rate"] = self.sample_rate
+            tr.emit("span", **f)
 
 
 def span(name: str, tracer=None, *, device: bool = False,
-         profiler: bool = False) -> Span:
+         profiler: bool = False, sample_rate: float = 1.0) -> Span:
     """Build a :class:`Span` (see module docstring for the contract)."""
-    return Span(name, tracer, device=device, profiler=profiler)
+    return Span(name, tracer, device=device, profiler=profiler,
+                sample_rate=sample_rate)
 
 
 @contextmanager

@@ -49,11 +49,41 @@ structured event stream:
                                 compiled must be 0 in steady state), and
                                 the gated deploy / regression rollback
                                 decisions
+  ``request_start`` / ``queued`` / ``batched`` / ``dispatched`` /
+  ``request_end``               one served request's span chain
+                                (serve/async_engine.py with telemetry=):
+                                admission mints a deterministic per-engine
+                                trace id that rides every stage — queue
+                                depth at enqueue, batch id at DRR batch
+                                formation, replica/bucket at dispatch, and
+                                queue_wait/seconds (plus outcome on error
+                                paths) at completion
+  ``scorer_kernel``             one FamilyScorer gather dispatch
+                                (serve/engine.py): rows/bucket/shadow —
+                                the kernel-stage hop of a request or
+                                refresh-cycle trace
+  ``slo_violation`` / ``slo_recovered``  the SLO engine (obs/slo.py)
+                                entering / leaving violation for one
+                                (tenant, objective) — emitted on state
+                                TRANSITIONS only, so one violation episode
+                                is one event (and one flight record)
 
 Events are ordered by a per-tracer monotone sequence number assigned under
 a lock, so two runs of the same deterministic fit produce the same
 (seq, kind, fields) sequence — wall-clock timestamps ride along but are
-excluded from :meth:`TraceEvent.key`, the comparison tests use.
+excluded from :meth:`TraceEvent.key`, the comparison tests use.  Sinks
+receive events UNDER that lock: sink order is seq order even with
+concurrent emitters (the async engine's callers, scheduler and replica
+workers all emit), which is what makes a ring-buffer dump — the flight
+recorder (obs/slo.py) — deterministic and complete for the last N events.
+A sink's ``emit`` must therefore never re-enter ``FitTracer.emit``.
+
+A thread-local :class:`~sparkglm_tpu.obs.context.TraceContext` (obs/
+context.py) merges its ``trace``/``span``/``parent_span`` fields into
+every event emitted while installed — explicit event fields win — so one
+refresh cycle, one elastic fit (parent) and its shard fits (children),
+or one served request correlate across subsystems without threading ids
+through every signature.
 
 Events are HOST-side: emitting them never changes what runs on the
 accelerator (the resident kernels route their in-loop line through
@@ -82,6 +112,8 @@ import threading
 import time
 from collections import deque
 from typing import IO
+
+from . import context as _context
 
 __all__ = [
     "TraceEvent", "Sink", "JsonlSink", "StderrSink", "RingBufferSink",
@@ -252,6 +284,10 @@ class FitTracer:
         # time and steady-state executable census
         self._refresh_s = 0.0
         self._refresh_executables = 0
+        # request-scoped serving plane (serve/async_engine.py telemetry=)
+        self._requests_served = 0
+        self._request_queue_wait_s = 0.0
+        self._minted = 0
 
     @staticmethod
     def _coerce_sink(s) -> Sink:
@@ -277,6 +313,11 @@ class FitTracer:
 
     # -- core -------------------------------------------------------------
     def emit(self, kind: str, **fields) -> TraceEvent | None:
+        ctx = _context.current()
+        if ctx is not None:
+            # thread-local trace context (obs/context.py): correlation
+            # fields ride every event; explicit fields win
+            fields = {**ctx.fields(), **fields}
         buf = getattr(_CAPTURE, "buf", None)
         if buf is not None:
             # pipeline producer thread: defer — the consumer replays these
@@ -289,9 +330,21 @@ class FitTracer:
                             fields)
             self._seq += 1
             self._aggregate(ev)
-        for s in self.sinks:
-            s.emit(ev)
+            # sinks under the lock: sink order == seq order even with
+            # concurrent emitters, so a ring dump is deterministic and
+            # complete for the last N events (flight-recorder contract).
+            # Sinks must not re-enter emit (module docstring).
+            for s in self.sinks:
+                s.emit(ev)
         return ev
+
+    def mint(self, prefix: str) -> str:
+        """A deterministic trace id from this tracer's own counter —
+        fresh tracer, same workload -> same ids (never random; see
+        obs/context.py)."""
+        with self._lock:
+            self._minted += 1
+            return f"{prefix}-{self._minted:06d}"
 
     def _aggregate(self, ev: TraceEvent) -> None:
         f = ev.fields
@@ -384,6 +437,12 @@ class FitTracer:
         elif ev.kind in ("drift_detected", "auto_deploy", "auto_rollback"):
             if m is not None:
                 m.counter(f"online.{ev.kind}").inc()
+        elif ev.kind == "request_end":
+            self._requests_served += 1
+            self._request_queue_wait_s += float(f.get("queue_wait", 0.0))
+        elif ev.kind == "slo_violation":
+            if m is not None:
+                m.counter("slo.violations").inc()
         elif ev.kind in ("solve", "span"):
             if f.get("device"):
                 self._device_s += float(f.get("seconds", 0.0))
@@ -482,6 +541,17 @@ class FitTracer:
                 } if any(k in self._counts for k in (
                     "chunk_ingested", "drift_detected", "refresh_end",
                     "auto_deploy", "auto_rollback")) else None),
+                # request-tracing block (serve/async_engine.py with
+                # telemetry=): completed-request census plus the summed
+                # admission->dispatch queue wait and SLO state changes;
+                # None when no request spans were emitted
+                "serving": ({
+                    "requests": self._requests_served,
+                    "batches": self._counts.get("batch", 0),
+                    "queue_wait_s": self._request_queue_wait_s,
+                    "slo_violations": self._counts.get("slo_violation", 0),
+                    "slo_recovered": self._counts.get("slo_recovered", 0),
+                } if self._requests_served else None),
                 "queue_wait_s": self._queue_wait_s,
                 "prefetch_depth_max": self._prefetch_depth_max,
                 # fraction of the overlappable time actually hidden by the

@@ -144,6 +144,13 @@ class DriftGate:
         self._live = {t: {m: Histogram() for m in _METRICS}
                       for t in self.labels}
         self._live_filled = 0
+        if self.tracer is not None and self.tracer.metrics is not None:
+            # exported gauge: the worst windowed TV distance at every
+            # window close, drifted or not — the dashboard's early-warning
+            # line under the threshold
+            m = self.tracer.metrics
+            m.gauge("online.drift.tv_max").set(tv_max)
+            m.gauge("online.drift.tenants_drifted").set(float(len(drifted)))
         if drifted and self.tracer is not None:
             self.tracer.emit("drift_detected", tenants=len(drifted),
                              first=drifted[0], tv_max=round(tv_max, 6),

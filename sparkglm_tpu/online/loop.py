@@ -64,6 +64,7 @@ from ..config import DEFAULT, NumericConfig
 from ..data.groups import MIN_BUCKET, next_bucket
 from ..data.pipeline import prefetch_iter
 from ..models import hoststats
+from ..obs import context as _obs_context
 from ..obs import trace as _obs_trace
 from .drift import DriftGate
 from .suffstats import OnlineSuffStats
@@ -92,6 +93,11 @@ class OnlineLoop:
       tol / max_iter / batch: warm fleet-refit IRLS knobs.
       trace / metrics: obs/ wiring; events always aggregate into
         :meth:`report` even with no sink attached.
+      telemetry: an :class:`~sparkglm_tpu.obs.export.Telemetry` — the
+        runtime observability plane: the loop emits into its tracer (so
+        cycle events land in the flight-recorder ring and the drift
+        trigger dumps records) and its registry (so drift gauges export).
+        Explicit ``trace=``/``metrics=`` win over the telemetry's.
     """
 
     def __init__(self, family, *, rho: float = 0.99,
@@ -105,7 +111,7 @@ class OnlineLoop:
                  jitter: float = 0.0,
                  tol: float = 1e-8, max_iter: int = 50,
                  batch: str = "exact",
-                 trace=None, metrics=None,
+                 trace=None, metrics=None, telemetry=None,
                  config: NumericConfig = DEFAULT):
         if window_rows < 1:
             raise ValueError(f"window_rows must be >= 1, got {window_rows}")
@@ -139,6 +145,12 @@ class OnlineLoop:
         self.max_iter = int(max_iter)
         self.batch = batch
         self.config = config
+        self.telemetry = telemetry
+        if telemetry is not None:
+            if trace is None:
+                trace = telemetry.tracer
+            if metrics is None:
+                metrics = telemetry.metrics
         tr = _obs_trace.as_tracer(trace, metrics=metrics)
         self.tracer = tr if tr is not None else _obs_trace.FitTracer()
         self.suffstats = OnlineSuffStats.init(tenants, self.p, rho=self.rho)
@@ -165,7 +177,24 @@ class OnlineLoop:
 
     def step(self, tenants, X, y, *, weights=None, offset=None) -> dict:
         """Absorb one chunk; returns a small summary dict
-        (``drifted``/``deployed``/``rolled_back`` tenant tuples)."""
+        (``drifted``/``deployed``/``rolled_back`` tenant tuples).
+
+        One chunk is ONE TRACE: every event the cycle emits — ingest,
+        watch/rollback, drift, refresh, shadow-gate ``scorer_kernel``,
+        deploy — carries a deterministic ``cycle-NNNNNN`` trace id (the
+        chunk counter), so a drift-triggered flight record reads as a
+        correlated story, not interleaved noise.  The tracer is also
+        installed ambient for the cycle so layers the loop calls into
+        (FamilyScorer, the fleet kernels) emit into the same trace even
+        when ``step`` is called directly rather than through :meth:`run`.
+        """
+        ctx = _obs_context.TraceContext(
+            trace=f"cycle-{self._chunks + 1:06d}", span="cycle")
+        with _obs_trace.ambient(self.tracer), _obs_context.use(ctx):
+            return self._step(tenants, X, y, weights=weights,
+                              offset=offset)
+
+    def _step(self, tenants, X, y, *, weights=None, offset=None) -> dict:
         X = np.asarray(X, np.float64)
         y = np.asarray(y, np.float64)
         if X.ndim != 2 or X.shape[1] != self.p:

@@ -46,6 +46,23 @@ MicroBatcher names) plus ``queue_depth`` and ``batch_rows`` histograms
 into its metrics registry, and emits ``admission`` (overload rejections),
 ``queue_depth`` and ``batch`` trace events through the ambient tracer
 (obs/trace.py).
+
+``telemetry=`` (an :class:`~..obs.export.Telemetry`) upgrades that to the
+full runtime plane: every admitted request mints a DETERMINISTIC trace id
+(per-engine submission counter — same seeded load, same ids) and emits a
+typed span chain ``request_start -> queued -> batched -> dispatched ->
+request_end`` carrying queue depth at enqueue, the DRR batch id, the
+replica/bucket at dispatch, and queue_wait/latency at completion.  The
+chain is seq-ordered PER REQUEST by construction: ``request_start`` and
+``queued`` are emitted under the admission lock (before the scheduler can
+see the request), ``batched`` on the scheduler thread before the dispatch
+task is created, and ``dispatched``/``request_end`` on the worker — so
+every chain is monotone in the tracer's sequence even though chains from
+different requests interleave.  Per-tenant latency histograms
+(``serve.<name>.tenant.<t>.latency_s``) feed the SLO engine, which is
+evaluated (rate-limited) after every batch completion.  All of it is
+host-side bookkeeping: traced serving is bit-identical to untraced and
+compiles nothing extra (the serving_trace_overhead bench gate).
 """
 
 from __future__ import annotations
@@ -480,6 +497,7 @@ class _Pending:
     key: tuple            # coalescing signature
     future: Future
     t_submit: float
+    trace: str = ""       # deterministic request trace id (telemetry mode)
 
 
 _DEFAULT_TENANT = "_"
@@ -504,13 +522,22 @@ class AsyncEngine:
     """
 
     def __init__(self, scorer, policy: EnginePolicy | None = None, *,
-                 metrics=None, name: str | None = None):
+                 metrics=None, name: str | None = None, telemetry=None):
         self.scorer = scorer
         self.policy = policy if policy is not None else EnginePolicy()
+        # explicit metrics= wins; then the telemetry registry (so SLO
+        # evaluation reads the engine's own instruments); then the scorer's
         self.metrics = (metrics if metrics is not None
+                        else telemetry.metrics if telemetry is not None
                         else getattr(scorer, "metrics", None))
         self.name = name if name is not None else getattr(
             scorer, "name", scorer.__class__.__name__)
+        self.telemetry = telemetry
+        self._tracer = telemetry.tracer if telemetry is not None else None
+        if telemetry is not None:
+            telemetry.watch_engine(self.name)
+        self._submitted = 0       # request trace ids (under _lock)
+        self._batches_formed = 0  # batch ids (under _lock)
         self.family_mode = bool(getattr(scorer, "family_mode", False))
         self.n_replicas = int(getattr(scorer, "n_replicas", 1))
         self._routes_replica = isinstance(scorer, ReplicatedScorer)
@@ -534,6 +561,14 @@ class AsyncEngine:
             name=f"async-engine:{self.name}")
         self._thread.start()
         self._started.wait()
+
+    def _emit(self, kind: str, **fields) -> None:
+        """Telemetry tracer when attached, else the ambient tracer — the
+        one emission path for every engine event."""
+        if self._tracer is not None:
+            self._tracer.emit(kind, **fields)
+        else:
+            emit_ambient(kind, **fields)
 
     # -- client side ---------------------------------------------------------
 
@@ -589,10 +624,10 @@ class AsyncEngine:
                 if self.metrics is not None:
                     self.metrics.counter(
                         f"serve.{self.name}.overloaded").inc()
-                emit_ambient("admission", engine=self.name, tenant=tenant,
-                             outcome="overloaded",
-                             queued_requests=self._queued_reqs,
-                             queued_rows=self._queued_rows)
+                self._emit("admission", engine=self.name, tenant=tenant,
+                           outcome="overloaded",
+                           queued_requests=self._queued_reqs,
+                           queued_rows=self._queued_rows)
                 raise Overloaded(
                     f"serving queue for {self.name!r} is full "
                     f"({self._queued_reqs} requests / {self._queued_rows} "
@@ -605,6 +640,19 @@ class AsyncEngine:
             q.append(req)
             self._queued_reqs += 1
             self._queued_rows += n
+            if self._tracer is not None:
+                # mint + emit UNDER the admission lock: the scheduler can
+                # only see this request after we release, so its `batched`
+                # event sequences strictly after these two — every
+                # request's span chain is monotone in tracer seq
+                self._submitted += 1
+                req.trace = f"req-{self.name}-{self._submitted:08d}"
+                self._tracer.emit("request_start", trace=req.trace,
+                                  engine=self.name, tenant=tenant,
+                                  rows=n)
+                self._tracer.emit("queued", trace=req.trace, tenant=tenant,
+                                  queued_requests=self._queued_reqs,
+                                  queued_rows=self._queued_rows)
         try:
             self._loop.call_soon_threadsafe(self._notify)
         except RuntimeError:
@@ -665,6 +713,15 @@ class AsyncEngine:
             while True:
                 action, val = self._next_action()
                 if action == "batch":
+                    if self._tracer is not None:
+                        # emitted BEFORE the dispatch task exists, so
+                        # `batched` sequences before the worker's
+                        # `dispatched` for every member request
+                        batch, _, _, batch_id = val
+                        for r in batch:
+                            self._tracer.emit("batched", trace=r.trace,
+                                              tenant=r.tenant,
+                                              batch=batch_id, rows=r.n)
                     self._inflight += 1
                     asyncio.ensure_future(self._dispatch(replica, val))
                     break
@@ -702,7 +759,11 @@ class AsyncEngine:
             batch = self._form_batch_locked()
             if not batch:
                 return "idle", None   # defensive; force-take prevents this
-            return "batch", (batch, self._queued_reqs, self._queued_rows)
+            self._batches_formed += 1
+            batch_id = (f"batch-{self.name}-{self._batches_formed:06d}"
+                        if self._tracer is not None else None)
+            return "batch", (batch, self._queued_reqs, self._queued_rows,
+                             batch_id)
 
     def _form_batch_locked(self):
         """Deficit round-robin batch formation (caller holds the lock).
@@ -790,8 +851,15 @@ class AsyncEngine:
     # -- batch execution (replica worker threads) ----------------------------
 
     def _run_batch(self, replica, payload) -> None:
-        batch, depth_reqs, depth_rows = payload
+        batch, depth_reqs, depth_rows, batch_id = payload
         rows = sum(r.n for r in batch)
+        bucket = (self.scorer.bucket_for(rows)
+                  if hasattr(self.scorer, "bucket_for") and rows else rows)
+        if self._tracer is not None:
+            for r in batch:
+                self._tracer.emit("dispatched", trace=r.trace,
+                                  tenant=r.tenant, batch=batch_id,
+                                  replica=int(replica), bucket=int(bucket))
         t0 = time.perf_counter()
         try:
             if self.family_mode:
@@ -806,6 +874,7 @@ class AsyncEngine:
                         live.append(r)
                     except KeyError as e:
                         r.future.set_exception(e)
+                        self._note_error(r, batch_id, replica, e)
                 batch = live
                 if not batch:
                     return
@@ -832,6 +901,9 @@ class AsyncEngine:
         except BaseException as e:  # noqa: BLE001 — delivered, not swallowed
             for r in batch:
                 r.future.set_exception(e)
+                self._note_error(r, batch_id, replica, e)
+            if self.telemetry is not None:
+                self.telemetry.evaluate_slos()
             return
         now = time.perf_counter()
         dt = now - t0
@@ -840,24 +912,59 @@ class AsyncEngine:
                 self._t_first = now
             self._rows_done += rows
             done, t_first = self._rows_done, self._t_first
+        if self._tracer is not None:
+            # the kernel hop of every member request's trace (batch-scoped:
+            # requests share the executable call)
+            self._tracer.emit("scorer_kernel", engine=self.name,
+                              batch=batch_id, replica=int(replica),
+                              bucket=int(bucket), rows=rows, seconds=dt)
         for r, part in zip(batch, parts):
             r.future.set_result(part)
             if self.metrics is not None:
                 self.metrics.histogram(
                     f"serve.{self.name}.latency_s").observe(
                         now - r.t_submit)
-        emit_ambient("queue_depth", engine=self.name,
-                     requests=depth_reqs, rows=depth_rows)
-        emit_ambient("batch", engine=self.name, rows=rows,
-                     requests=len(batch), replica=int(replica),
-                     tenants=len({r.tenant for r in batch}), seconds=dt)
+            if self._tracer is not None:
+                self._tracer.emit(
+                    "request_end", trace=r.trace, tenant=r.tenant,
+                    batch=batch_id, replica=int(replica),
+                    bucket=int(bucket), rows=r.n,
+                    queue_wait=t0 - r.t_submit, seconds=now - r.t_submit)
+                if self.metrics is not None:
+                    self.metrics.histogram(
+                        f"serve.{self.name}.tenant.{r.tenant}.latency_s"
+                    ).observe(now - r.t_submit)
+        self._emit("queue_depth", engine=self.name,
+                   requests=depth_reqs, rows=depth_rows)
+        f = dict(engine=self.name, rows=rows, requests=len(batch),
+                 replica=int(replica),
+                 tenants=len({r.tenant for r in batch}), seconds=dt)
+        if batch_id is not None:
+            f["batch"] = batch_id
+        self._emit("batch", **f)
         if self.metrics is not None:
             m = self.metrics
             m.counter(f"serve.{self.name}.batches").inc()
             m.counter(f"serve.{self.name}.batched_rows").inc(rows)
+            m.counter(f"serve.{self.name}.requests_done").inc(len(batch))
             m.histogram(f"serve.{self.name}.batch_rows").observe(rows)
             m.histogram(f"serve.{self.name}.queue_depth").observe(
                 depth_reqs)
             elapsed = now - t_first
             if elapsed > 0:
                 m.gauge(f"serve.{self.name}.rows_per_s").set(done / elapsed)
+        if self.telemetry is not None:
+            # rate-limited: one real evaluation per interval regardless of
+            # batch rate (obs/slo.py)
+            self.telemetry.evaluate_slos()
+
+    def _note_error(self, r, batch_id, replica, exc) -> None:
+        """Error-path bookkeeping for one failed request (its future is
+        already failed by the caller)."""
+        if self.metrics is not None:
+            self.metrics.counter(f"serve.{self.name}.errors").inc()
+        if self._tracer is not None:
+            self._tracer.emit("request_end", trace=r.trace, tenant=r.tenant,
+                              batch=batch_id, replica=int(replica),
+                              outcome="error", error=type(exc).__name__,
+                              seconds=time.perf_counter() - r.t_submit)

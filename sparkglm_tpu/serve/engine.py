@@ -476,6 +476,11 @@ class FamilyScorer:
                 self.metrics.counter(
                     f"serve.{self.name}.compiles").inc(compiled)
             self.metrics.histogram(f"serve.{self.name}.score_s").observe(dt)
+        # the kernel hop of whatever trace is ambient (an online refresh
+        # cycle's shadow gating, a notebook fit) — host-side, after the
+        # dispatch, so numerics and the executable census are untouched
+        emit_ambient("scorer_kernel", target=f"serve:{self.name}",
+                     rows=n, bucket=bucket, shadow=self._shadow is not None)
         return fit if sh is None else (fit, sh)
 
     def warmup(self, buckets=None) -> tuple[int, ...]:

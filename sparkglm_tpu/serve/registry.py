@@ -431,14 +431,20 @@ class ModelFamily:
         sc.refresh()
         return sc
 
-    def async_engine(self, policy=None, **kwargs):
+    def async_engine(self, policy=None, *, telemetry=None, **kwargs):
         """A fresh :class:`~.async_engine.AsyncEngine` over this family's
         :meth:`replicated_scorer` (``kwargs`` select/configure it).  The
         caller owns the engine's lifecycle — use as a context manager or
-        ``close()`` it; the underlying scorer stays cached here."""
+        ``close()`` it; the underlying scorer stays cached here.
+
+        ``telemetry=`` (an :class:`~..obs.export.Telemetry`) turns on the
+        request-scoped tracing / SLO / export plane; without it the
+        engine keeps the family's metrics registry only."""
         from .async_engine import AsyncEngine
         return AsyncEngine(self.replicated_scorer(**kwargs), policy,
-                           metrics=self.metrics, name=self.name)
+                           metrics=None if telemetry is not None
+                           else self.metrics,
+                           name=self.name, telemetry=telemetry)
 
     # -- persistence ---------------------------------------------------------
 

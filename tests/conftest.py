@@ -61,6 +61,10 @@ def pytest_configure(config):
         "markers", "online: the continuous-learning subsystem — decayed "
         "suffstats, drift gates, auto-deploy/rollback (`make online` "
         "selects these; still tier-1 by default)")
+    config.addinivalue_line(
+        "markers", "obsplane: the runtime observability plane — request-"
+        "scoped tracing, SLO flight recorder, telemetry export (`make "
+        "obsplane` selects these; still tier-1 by default)")
 
 
 @pytest.fixture(scope="session")
