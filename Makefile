@@ -9,7 +9,7 @@ SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve serve_async \
         categorical penalized elastic sketch fleet hotloop online \
-        obsplane clean
+        obsplane chaos clean
 
 all: native
 
@@ -118,6 +118,17 @@ online:
 # the shared paired-run gate; zero kernel-cache growth)
 obsplane:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obsplane
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# self-healing serving plane + crash-durable online learning (serve/health,
+# async_engine dispatch protection, online/journal): replica ejection/
+# recovery state machine, deadlines + hedged dispatch, kill-one-replica
+# bit-identity with zero recompiles, SIGKILL-resume of the online loop from
+# the write-ahead journal — plus the serving_fault_recovery bench block
+# (600-request load with one replica killed: zero lost requests, overhead
+# vs healthy, recompile count)
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m selfheal
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:

@@ -157,7 +157,7 @@ def main() -> None:
     # single source of truth for the round tag is the caller
     # (benchmarks/tpu_when_alive.sh exports ROUND); default matches its
     # current value so a bare `python bench.py` is still correctly stamped
-    detail["round"] = int(os.environ.get("ROUND", "14"))
+    detail["round"] = int(os.environ.get("ROUND", "15"))
 
     def make_data(nn):
         @jax.jit
@@ -890,6 +890,82 @@ def main() -> None:
             bit_identical=bit_identical)
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["serving_trace_overhead"] = dict(error=repr(e)[:300])
+
+    # ---- serving fault recovery (self-healing plane, r15) ------------------
+    # the serving_scaleout load RERUN against a 2-replica scorer with
+    # replica 0 dead from its first dispatch (seeded FaultPlan).  The
+    # health plane must absorb the kill: every failed dispatch re-routes
+    # to the survivor, replica 0 is ejected after eject_after failures,
+    # ZERO of the 600 in-flight requests are lost, the degraded results
+    # are BIT-identical to the healthy 2-replica run (replicas hold
+    # device_put copies of the same tables and run the same row-local
+    # kernel), and ejection/re-route causes zero recompiles and zero
+    # kernel-cache growth.  Overhead vs the healthy run is the price of
+    # the redispatches plus running on R-1 replicas.
+    try:
+        from sparkglm_tpu.robust import FaultPlan
+        from sparkglm_tpu.serve import HealthPolicy, family_score_cache_size
+
+        d0 = jax.devices()[0]
+        rsc15 = fam.replicated_scorer(type="link", devices=(d0, d0),
+                                      min_bucket=8, name="chaos")
+        rsc15.warmup()               # full ladder, both replicas
+        cache_before15 = family_score_cache_size()
+        compiles_before15 = rsc15.compiles
+        pol15 = EnginePolicy(max_batch=1024, max_wait_ms=0, max_queue=8192,
+                             quantum=256)
+        hp15 = HealthPolicy(eject_after=2, probe_cooldown_s=60.0)
+
+        def drive15(engine):
+            futs = [engine.submit(X, tenant=t)
+                    for X, t in zip(reqs, tenants)]
+            out, failed = [], 0
+            for f in futs:
+                try:
+                    out.append(f.result(120))
+                except Exception:  # noqa: BLE001 — count lost requests
+                    out.append(None)
+                    failed += 1
+            return out, failed
+
+        t0 = time.perf_counter()
+        with AsyncEngine(rsc15, pol15, name="chaos",
+                         health=hp15) as eng_h:
+            healthy_res, healthy_failed = drive15(eng_h)
+        wall_h = time.perf_counter() - t0
+
+        plan15 = FaultPlan(seed=15, replica_dead_from=((0, 0),))
+        t0 = time.perf_counter()
+        eng_f = AsyncEngine(rsc15, pol15, name="chaos", health=hp15,
+                            fault_plan=plan15)
+        with eng_f:
+            faulted_res, faulted_failed = drive15(eng_f)
+        wall_f = time.perf_counter() - t0
+
+        recompiles15 = rsc15.compiles - compiles_before15
+        cache_delta15 = family_score_cache_size() - cache_before15
+        bit_identical15 = bool(all(
+            a is not None and b is not None
+            and np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(healthy_res, faulted_res)))
+        detail["serving_fault_recovery"] = dict(
+            replicas=rsc15.n_replicas, requests=req_total,
+            rows=int(sum(sizes)),
+            healthy_wall_s=round(wall_h, 4),
+            faulted_wall_s=round(wall_f, 4),
+            overhead_frac=round(wall_f / wall_h - 1.0, 4),
+            lost_requests=int(healthy_failed + faulted_failed),
+            ejections=int(eng_f.health.ejections),
+            redispatches=int(eng_f._redispatches),
+            degraded_bit_identical=bit_identical15,
+            steady_state_recompiles=int(recompiles15),
+            kernel_cache_delta=int(cache_delta15),
+            ok=bool(healthy_failed == 0 and faulted_failed == 0
+                    and eng_f.health.ejections >= 1
+                    and bit_identical15
+                    and recompiles15 == 0 and cache_delta15 == 0))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["serving_fault_recovery"] = dict(error=repr(e)[:300])
 
     # ---- factor-aware Gramian engine (ops/factor_gramian.py) ---------------
     # one wide categorical: the dense path one-hot-expands the factor to

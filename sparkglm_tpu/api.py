@@ -495,6 +495,7 @@ def online_fleet(formula: str, data, *, groups, family="gaussian",
                  tol: float = 1e-8, max_iter: int = 100,
                  batch: str = "exact", bucket: int | None = None,
                  trace=None, metrics=None, telemetry=None,
+                 journal=None,
                  config: NumericConfig = DEFAULT):
     """Seed a per-group GLM fleet from ``data`` and return an armed
     :class:`~sparkglm_tpu.online.OnlineLoop` — the continuous-learning
@@ -522,6 +523,11 @@ def online_fleet(formula: str, data, *, groups, family="gaussian",
     drift gauges land in its registry, and the same object can serve the
     family's ``async_engine(telemetry=...)`` so serving and learning
     correlate in one event stream.
+
+    ``journal=`` (a directory path) arms the crash-durable write-ahead
+    journal: every chunk is journaled before it is applied and
+    ``OnlineLoop.resume(journal_dir)`` rebuilds the loop bit-identically
+    after a kill (online/journal.py).
     """
     from .online import OnlineLoop
     from .serve import ModelFamily
@@ -543,7 +549,8 @@ def online_fleet(formula: str, data, *, groups, family="gaussian",
         min_count=min_count, deviance_tolerance=deviance_tolerance,
         rollback_tolerance=rollback_tolerance, watch_chunks=watch_chunks,
         jitter=jitter, tol=tol, max_iter=max_iter, batch=batch,
-        trace=trace, metrics=metrics, telemetry=telemetry, config=config)
+        trace=trace, metrics=metrics, telemetry=telemetry,
+        journal=journal, config=config)
 
 
 def _stream_io(path, *, chunk_bytes, native, backend: str = "auto",

@@ -290,9 +290,16 @@ class FlightRecorder(Sink):
     within the window (an overload storm yields one record, not one per
     rejected request); transition-style triggers (``slo_violation``,
     ``drift_detected``) already fire once per episode.
+
+    The self-healing serving plane's episode transitions —
+    ``replica_ejected`` and ``auto_recovery`` (serve/health.py) — are
+    default triggers too: an ejection dumps the ring (the failing
+    dispatches that burned the breaker are IN it), and the recovery
+    dump brackets the episode from the other side.
     """
 
-    DEFAULT_TRIGGERS = ("slo_violation", "drift_detected", "auto_rollback")
+    DEFAULT_TRIGGERS = ("slo_violation", "drift_detected", "auto_rollback",
+                        "replica_ejected", "auto_recovery")
 
     def __init__(self, dir: str | os.PathLike, *, capacity: int = 2048,
                  triggers=None, overload_trigger: bool = True,

@@ -67,6 +67,37 @@ structured event stream:
                                 (tenant, objective) — emitted on state
                                 TRANSITIONS only, so one violation episode
                                 is one event (and one flight record)
+  ``replica_suspect`` / ``replica_ejected`` / ``replica_probe`` /
+  ``auto_recovery``             the self-healing serving plane
+                                (serve/health.py): a replica's first
+                                failure, its breaker opening (ejection —
+                                a flight-recorder trigger), the
+                                deterministic half-open probe admission,
+                                and the probe succeeding (recovery — also
+                                a trigger)
+  ``hedge_dispatch`` / ``redispatch`` / ``replica_hung``  dispatch
+                                protection (serve/async_engine.py): a
+                                batch speculatively re-sent to a second
+                                replica past the hedge budget, a failed
+                                batch re-routed to an untried replica,
+                                and a call abandoned past the watchdog
+                                deadline
+  ``replica_rewarm``            a recovering replica's bucket ladder
+                                re-driven through warmup before its probe
+                                batch scores (zero steady-state compiles
+                                across ejection/recovery, test-enforced)
+  ``deadline_shed``             a request dropped unserved — its
+                                ``deadline=`` expired in queue, or its
+                                caller timed out / cancelled it before
+                                dispatch (dead work shed at
+                                batch-formation time, never scored)
+  ``journal_append`` / ``journal_snapshot`` / ``journal_replay``  the
+                                online loop's crash-durable write-ahead
+                                journal (online/journal.py): one chunk
+                                journaled before application, one atomic
+                                full-state snapshot, and a resume
+                                replaying records to the exact chunk
+                                boundary
 
 Events are ordered by a per-tracer monotone sequence number assigned under
 a lock, so two runs of the same deterministic fit produce the same
@@ -437,6 +468,15 @@ class FitTracer:
         elif ev.kind in ("drift_detected", "auto_deploy", "auto_rollback"):
             if m is not None:
                 m.counter(f"online.{ev.kind}").inc()
+        elif ev.kind in ("replica_ejected", "auto_recovery",
+                         "hedge_dispatch", "redispatch", "replica_hung",
+                         "deadline_shed"):
+            if m is not None:
+                m.counter(f"health.{ev.kind}").inc()
+        elif ev.kind == "journal_append":
+            if m is not None:
+                m.counter("journal.appends").inc()
+                m.counter("journal.bytes").inc(int(f.get("nbytes", 0)))
         elif ev.kind == "request_end":
             self._requests_served += 1
             self._request_queue_wait_s += float(f.get("queue_wait", 0.0))
@@ -538,6 +578,13 @@ class FitTracer:
                     "refresh_executables": self._refresh_executables,
                     "auto_deploys": self._counts.get("auto_deploy", 0),
                     "auto_rollbacks": self._counts.get("auto_rollback", 0),
+                    # crash-durability census (online/journal.py)
+                    "journal_appends": self._counts.get(
+                        "journal_append", 0),
+                    "journal_snapshots": self._counts.get(
+                        "journal_snapshot", 0),
+                    "journal_replays": self._counts.get(
+                        "journal_replay", 0),
                 } if any(k in self._counts for k in (
                     "chunk_ingested", "drift_detected", "refresh_end",
                     "auto_deploy", "auto_rollback")) else None),
@@ -551,6 +598,17 @@ class FitTracer:
                     "queue_wait_s": self._request_queue_wait_s,
                     "slo_violations": self._counts.get("slo_violation", 0),
                     "slo_recovered": self._counts.get("slo_recovered", 0),
+                    # self-healing census (serve/health.py): ejection /
+                    # recovery episodes plus the dispatch-protection
+                    # actions taken — all 0 on a healthy run
+                    "replica_ejections": self._counts.get(
+                        "replica_ejected", 0),
+                    "replica_recoveries": self._counts.get(
+                        "auto_recovery", 0),
+                    "hedges": self._counts.get("hedge_dispatch", 0),
+                    "redispatches": self._counts.get("redispatch", 0),
+                    "replicas_hung": self._counts.get("replica_hung", 0),
+                    "deadline_shed": self._counts.get("deadline_shed", 0),
                 } if self._requests_served else None),
                 "queue_wait_s": self._queue_wait_s,
                 "prefetch_depth_max": self._prefetch_depth_max,

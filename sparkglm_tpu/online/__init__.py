@@ -9,13 +9,18 @@ reweighting-based warm refits per arXiv:2406.02769).
                 log2-histogram drift detection over obs/ primitives.
   loop.py       ``OnlineLoop`` — chunks -> suffstats -> gated refresh ->
                 ``ModelFamily.deploy()`` -> regression-gated rollback.
+  journal.py    ``OnlineJournal`` — write-ahead chunk journal + periodic
+                snapshots on robust/checkpoint.py's atomic write-rename;
+                ``OnlineLoop.resume`` replays to the exact chunk
+                boundary bit-identically after a kill.
 
 Front-end: ``sparkglm_tpu.online_fleet(...)`` (api.py) seeds a fleet fit
 and returns a ready loop.
 """
 
 from .drift import DriftGate
+from .journal import OnlineJournal
 from .loop import OnlineLoop
 from .suffstats import OnlineSuffStats
 
-__all__ = ["DriftGate", "OnlineLoop", "OnlineSuffStats"]
+__all__ = ["DriftGate", "OnlineJournal", "OnlineLoop", "OnlineSuffStats"]

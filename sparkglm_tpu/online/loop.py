@@ -50,6 +50,16 @@ family (every version + deploy history), the suffstats, the row rings,
 the drift-gate histograms and the watch state in one artifact;
 ``OnlineLoop.load(path)`` resumes bit-identically (test-enforced under
 ``prefetch=2``).
+
+Crash durability: construct with ``journal=`` (a directory path or
+:class:`~sparkglm_tpu.online.journal.OnlineJournal`) and every chunk's
+raw input is journaled atomically BEFORE it is applied, with periodic
+full-state snapshots; after a crash — including ``SIGKILL`` at any
+point — :meth:`OnlineLoop.resume` loads the latest snapshot and
+replays the surviving records through :meth:`step`, landing at the
+exact chunk boundary with bit-identical statistics and the same
+deploy/rollback decisions (journal.py module docstring argues why;
+test-enforced with a real kill).
 """
 
 from __future__ import annotations
@@ -98,6 +108,10 @@ class OnlineLoop:
         cycle events land in the flight-recorder ring and the drift
         trigger dumps records) and its registry (so drift gauges export).
         Explicit ``trace=``/``metrics=`` win over the telemetry's.
+      journal: a directory path or :class:`~sparkglm_tpu.online.journal.
+        OnlineJournal` — arms the write-ahead journal (module docstring:
+        crash durability).  An initial snapshot is written at attach
+        time so :meth:`resume` always finds a base.
     """
 
     def __init__(self, family, *, rho: float = 0.99,
@@ -112,6 +126,7 @@ class OnlineLoop:
                  tol: float = 1e-8, max_iter: int = 50,
                  batch: str = "exact",
                  trace=None, metrics=None, telemetry=None,
+                 journal=None,
                  config: NumericConfig = DEFAULT):
         if window_rows < 1:
             raise ValueError(f"window_rows must be >= 1, got {window_rows}")
@@ -172,6 +187,9 @@ class OnlineLoop:
         self._refreshes = 0
         # tenant -> {"prior": version, "left": chunks} regression watches
         self._watch: dict[str, dict] = {}
+        self.journal = None
+        if journal is not None:
+            self.attach_journal(journal)
 
     # -- chunk ingestion -----------------------------------------------------
 
@@ -191,8 +209,21 @@ class OnlineLoop:
         ctx = _obs_context.TraceContext(
             trace=f"cycle-{self._chunks + 1:06d}", span="cycle")
         with _obs_trace.ambient(self.tracer), _obs_context.use(ctx):
-            return self._step(tenants, X, y, weights=weights,
-                              offset=offset)
+            if self.journal is not None:
+                # write-ahead: the chunk's raw input is durable BEFORE
+                # any state mutates, so a kill mid-apply replays it
+                nbytes = self.journal.append(
+                    self._chunks + 1, tenants, X, y, weights, offset)
+                self.tracer.emit("journal_append",
+                                 chunk=self._chunks + 1,
+                                 rows=int(np.asarray(X).shape[0]),
+                                 nbytes=int(nbytes))
+            out = self._step(tenants, X, y, weights=weights,
+                             offset=offset)
+            if (self.journal is not None
+                    and self._chunks % self.journal.snapshot_every == 0):
+                self._snapshot()
+            return out
 
     def _step(self, tenants, X, y, *, weights=None, offset=None) -> dict:
         X = np.asarray(X, np.float64)
@@ -243,14 +274,17 @@ class OnlineLoop:
                     deployed=deployed, rolled_back=rolled)
 
     def run(self, source, *, prefetch: int | None = None,
-            max_chunks: int | None = None) -> dict:
+            max_chunks: int | None = None, fault_plan=None) -> dict:
         """Drive :meth:`step` over a chunk source — a zero-arg callable
         returning an iterator of ``(tenants, X, y[, weights[, offset]])``
         tuples (or thunks realizing to one), the streaming-source
         convention; ``data/pipeline.tee_source`` splits one live stream
         between this loop and anything else.  ``prefetch`` pipelines
         chunk production (data/pipeline.py — bit-identical by the
-        determinism contract there).  Returns :meth:`report`.
+        determinism contract there).  ``fault_plan`` (robust/faults.py)
+        fires its ``kill_chunk_at`` schedule at each chunk boundary —
+        the chaos test's process kill, exercised against the journal.
+        Returns :meth:`report`.
         """
         it = (source() if prefetch is None else
               prefetch_iter(source, prefetch, auto_degrade=False))
@@ -260,6 +294,10 @@ class OnlineLoop:
                     break
                 if callable(item):
                     item = item()
+                if fault_plan is not None:
+                    # absolute chunk ordinal about to be applied, so a
+                    # schedule means the same boundary across resumes
+                    fault_plan.on_online_chunk(self._chunks + 1)
                 self.step(*item[:3],
                           weights=item[3] if len(item) > 3 else None,
                           offset=item[4] if len(item) > 4 else None)
@@ -452,6 +490,56 @@ class OnlineLoop:
         """The tracer's aggregate report (its ``online`` block carries
         the chunk/drift/refresh/deploy census)."""
         return self.tracer.report()
+
+    # -- crash durability (online/journal.py) --------------------------------
+
+    def attach_journal(self, journal, *, snapshot: bool = True) -> None:
+        """Arm the write-ahead journal.  ``snapshot=True`` (default)
+        snapshots the CURRENT state immediately, so resume always finds
+        a base even if the process dies before the first cadence
+        snapshot."""
+        from .journal import OnlineJournal
+        if not isinstance(journal, OnlineJournal):
+            journal = OnlineJournal(journal)
+        self.journal = journal
+        if snapshot:
+            self._snapshot()
+
+    def _snapshot(self) -> None:
+        nbytes = self.journal.snapshot(self)
+        self.tracer.emit("journal_snapshot", chunk=self._chunks,
+                         nbytes=int(nbytes),
+                         suffstats_digest=self.suffstats.digest())
+
+    @classmethod
+    def resume(cls, journal, *, trace=None, metrics=None) -> "OnlineLoop":
+        """Rebuild a loop from its journal after a crash: load the
+        latest snapshot, replay every record past it through
+        :meth:`step` in chunk order, re-arm the journal.  The result is
+        bit-identical to the uninterrupted run at the same chunk
+        boundary (module docstring of journal.py; test-enforced under
+        ``SIGKILL``)."""
+        from .journal import OnlineJournal
+        if not isinstance(journal, OnlineJournal):
+            journal = OnlineJournal(journal)
+        snap = journal.latest_snapshot()
+        if snap is None:
+            raise FileNotFoundError(
+                f"no snapshot in journal directory {journal.directory!r}; "
+                "was the journal ever attached to a loop?")
+        chunk0, path = snap
+        loop = cls.load(path, trace=trace, metrics=metrics)
+        records = journal.records(after=loop._chunks)
+        for _idx, rpath in records:
+            tenants, X, y, w, off = journal.load_record(rpath)
+            loop.step(tenants, X, y, weights=w, offset=off)
+        loop.tracer.emit("journal_replay", snapshot_chunk=int(chunk0),
+                         replayed=len(records), chunk=loop._chunks,
+                         suffstats_digest=loop.suffstats.digest())
+        # re-arm; the attach snapshot absorbs the replayed records so
+        # the next crash replays only post-resume chunks
+        loop.attach_journal(journal)
+        return loop
 
     # -- persistence (models/serialize.py v5) --------------------------------
 

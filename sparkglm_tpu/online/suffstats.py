@@ -149,6 +149,19 @@ class OnlineSuffStats:
                 pass
         return beta
 
+    def digest(self) -> str:
+        """sha256 over the accumulator bytes (G, r, wsum, chunks) — the
+        integrity fingerprint the journal stamps on snapshots and the
+        crash-resume tests compare: equal digests mean bit-identical
+        statistics."""
+        import hashlib
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(self.G, np.float64).tobytes())
+        h.update(np.ascontiguousarray(self.r, np.float64).tobytes())
+        h.update(np.ascontiguousarray(self.wsum, np.float64).tobytes())
+        h.update(str(int(self.chunks)).encode())
+        return h.hexdigest()
+
     # -- persistence (models/serialize.py v5) -------------------------------
 
     def _export(self) -> tuple[dict, dict]:

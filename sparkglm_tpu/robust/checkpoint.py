@@ -41,6 +41,40 @@ def _emit(kind: str, **fields) -> None:
     emit_ambient(kind, **fields)
 
 
+def atomic_write_bytes(path: str | os.PathLike, data: bytes) -> None:
+    """Durably write ``data`` at ``path`` by temp-sibling + fsync +
+    ``os.replace`` — a crash mid-write leaves either the previous complete
+    file or the new complete file, never a torn one.  The write-rename
+    primitive under every durable artifact here: checkpoints, flight
+    records, and the online write-ahead journal (online/journal.py)."""
+    path = os.fspath(path)
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_savez(path: str | os.PathLike, **arrays) -> int:
+    """``np.savez`` through :func:`atomic_write_bytes`; returns the record
+    size in bytes (serialization happens in memory first — journal/
+    checkpoint records are tiny relative to the data they make durable)."""
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    atomic_write_bytes(path, buf.getvalue())
+    return buf.tell()
+
+
 def _fp_array(fingerprint) -> np.ndarray:
     """Fingerprint tuples may contain None for absent weight/offset corner
     samples (``streaming._fingerprint``); encode as NaN so the record is a
@@ -68,31 +102,16 @@ class CheckpointManager:
         for k in payload:
             if k in _RESERVED:
                 raise ValueError(f"payload key {k!r} is reserved")
-        buf = io.BytesIO()
-        np.savez(buf,
-                 format=np.int64(_FORMAT),
-                 kind=np.bytes_(kind.encode()),
-                 fingerprint=_fp_array(fingerprint),
-                 p=np.int64(p),
-                 **{k: np.asarray(v) for k, v in payload.items()})
-        d = os.path.dirname(self.path) or "."
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt-", suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(buf.getvalue())
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        nbytes = atomic_savez(
+            self.path,
+            format=np.int64(_FORMAT),
+            kind=np.bytes_(kind.encode()),
+            fingerprint=_fp_array(fingerprint),
+            p=np.int64(p),
+            **{k: np.asarray(v) for k, v in payload.items()})
         # emitted only after the atomic rename: the event means "this
         # checkpoint is durable", not "a write was attempted"
-        fields = {"path": self.path, "model": kind, "bytes": buf.tell()}
+        fields = {"path": self.path, "model": kind, "bytes": nbytes}
         if "iters" in payload:
             fields["iters"] = int(np.asarray(payload["iters"]))
         _emit("checkpoint_write", **fields)

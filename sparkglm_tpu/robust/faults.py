@@ -30,11 +30,16 @@ streaming GLM with and without injected transients and report the delta.
 from __future__ import annotations
 
 import dataclasses
+import os
+import signal
+import threading
+import time
 from typing import Callable, Sequence
 
 import numpy as np
 
-from .retry import FatalSourceError, TransientSourceError
+from .retry import (FatalSourceError, ReplicaUnavailable,
+                    TransientSourceError)
 
 
 class SimulatedPreemption(BaseException):
@@ -69,6 +74,29 @@ class FaultPlan:
     chunk index) and each pair additionally fires ONCE, so the schedule
     stays a finite set of kills even when coordinates recur after
     :meth:`reset`.
+
+    SERVING-TIME kinds are addressed by ``(replica, dispatch)`` — the
+    plan keeps one dispatch ordinal PER REPLICA (thread-safe: replica
+    workers touch concurrently), so a schedule names "replica 0's third
+    batch" no matter how batches interleave across replicas:
+
+      * ``replica_error_at`` — that dispatch raises
+        :class:`~.retry.ReplicaUnavailable` (fires once; the replica is
+        flaky but alive, a later probe succeeds);
+      * ``replica_dead_from`` — EVERY dispatch on that replica from the
+        given ordinal onward fails (a killed replica: probes keep
+        failing, the breaker stays open);
+      * ``replica_slow_at`` — the dispatch sleeps ``slow_s`` before
+        proceeding (straggler; the hedge budget fires, both calls
+        complete, first result wins);
+      * ``replica_hang_at`` — the dispatch sleeps ``hang_s`` (hung but
+        alive: the engine's watchdog deadline fires and abandons the
+        call; its late result is discarded by first-result-wins).
+
+    ``kill_chunk_at`` is the ONLINE-LOOP kill schedule: at those chunk
+    boundaries :meth:`on_online_chunk` SIGKILLs the current process — a
+    real, unhandleable death for exercising the write-ahead journal's
+    crash/resume path.  Only call it from an expendable subprocess.
     """
 
     transient_at: Sequence[int] = ()
@@ -77,6 +105,13 @@ class FaultPlan:
     preempt_chunk_at: Sequence[tuple] = ()
     p_transient: float = 0.0
     seed: int = 0
+    replica_error_at: Sequence[tuple] = ()
+    replica_dead_from: Sequence[tuple] = ()
+    replica_slow_at: Sequence[tuple] = ()
+    replica_hang_at: Sequence[tuple] = ()
+    slow_s: float = 0.25
+    hang_s: float = 30.0
+    kill_chunk_at: Sequence[int] = ()
 
     def __post_init__(self):
         self._touch = 0
@@ -84,6 +119,18 @@ class FaultPlan:
         self._fired = set()
         self._preempt_pairs = {tuple(int(v) for v in pc)
                                for pc in self.preempt_chunk_at}
+        self._err_pairs = {tuple(int(v) for v in rc)
+                           for rc in self.replica_error_at}
+        self._slow_pairs = {tuple(int(v) for v in rc)
+                            for rc in self.replica_slow_at}
+        self._hang_pairs = {tuple(int(v) for v in rc)
+                            for rc in self.replica_hang_at}
+        self._dead_from = {}
+        for r, k in self.replica_dead_from:
+            r, k = int(r), int(k)
+            self._dead_from[r] = min(k, self._dead_from.get(r, k))
+        self._dispatches = {}
+        self._lock = threading.Lock()
         self._rng = np.random.default_rng(self.seed)
         self.faults_fired = 0
 
@@ -108,6 +155,47 @@ class FaultPlan:
         if self.p_transient > 0.0 and self._rng.random() < self.p_transient:
             self.faults_fired += 1
             raise TransientSourceError(f"injected random transient at touch {t}")
+
+    def on_dispatch(self, replica: int) -> None:
+        """One replica-call touch: advance ``replica``'s dispatch ordinal
+        and fire whatever the serving schedule names at that coordinate.
+        Called from the engine's replica worker thread, BEFORE scoring, so
+        an injected failure looks exactly like a failing device call."""
+        replica = int(replica)
+        with self._lock:
+            k = self._dispatches.get(replica, 0)
+            self._dispatches[replica] = k + 1
+            key = (replica, k)
+            dead = (replica in self._dead_from
+                    and k >= self._dead_from[replica])
+            err = key in self._err_pairs and ("err", key) not in self._fired
+            if err:
+                self._fired.add(("err", key))
+            slow = key in self._slow_pairs and ("slow", key) not in self._fired
+            if slow:
+                self._fired.add(("slow", key))
+            hang = key in self._hang_pairs and ("hang", key) not in self._fired
+            if hang:
+                self._fired.add(("hang", key))
+            if dead or err or slow or hang:
+                self.faults_fired += 1
+        if hang:
+            time.sleep(self.hang_s)
+            return
+        if slow:
+            time.sleep(self.slow_s)
+            return
+        if dead or err:
+            raise ReplicaUnavailable(
+                f"injected replica failure: replica {replica}, dispatch {k}"
+                + (" (dead)" if dead else ""))
+
+    def on_online_chunk(self, chunk_idx: int) -> None:
+        """Fire a scheduled process kill at an online-loop chunk boundary.
+        SIGKILL — no cleanup, no exception, no atexit: the journal's
+        durability is all that survives.  Subprocess use only."""
+        if int(chunk_idx) in set(int(c) for c in self.kill_chunk_at):
+            os.kill(os.getpid(), signal.SIGKILL)
 
     def on_chunk_touch(self, pass_idx: int, chunk_idx: int) -> None:
         """Fire a scheduled worker kill at ``(pass_idx, chunk_idx)`` — once."""

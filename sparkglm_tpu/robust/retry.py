@@ -52,7 +52,39 @@ class Overloaded(TransientSourceError):
     Transient BY TYPE: backpressure clears as the micro-batcher drains, so
     a client-side :class:`RetryPolicy` retries it with backoff like any
     flaky-source failure — one classification scheme for fit-time and
-    serve-time faults."""
+    serve-time faults.
+
+    ``retry_after_s`` is a drain-rate hint: the admitting engine computes
+    it from its measured throughput (queued rows / rows-per-second served
+    so far), so a client that honors it backs off just long enough for the
+    queue to clear instead of guessing.  ``None`` when the engine has not
+    served anything yet (no rate to measure)."""
+
+    def __init__(self, message: str, *, retry_after_s: "float | None" = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(TimeoutError):
+    """A serving request's ``deadline=`` elapsed before it was dispatched,
+    or its caller abandoned it (``score(timeout=)`` / ``asubmit(timeout=)``).
+
+    The request is CANCELLED OUT OF THE QUEUE — it is never scored, so a
+    caller that already gave up does not burn replica time (dead-work
+    shedding happens at batch-formation time, sparkglm_tpu/serve/
+    async_engine.py).  A ``TimeoutError`` subtype so existing timeout
+    handling (``concurrent.futures`` raises ``TimeoutError`` from
+    ``future.result(timeout)``) catches it unchanged."""
+
+
+class ReplicaUnavailable(TransientSourceError):
+    """A replica call failed or exceeded its watchdog deadline (hung).
+
+    Typed circuit-breaker fuel (sparkglm_tpu/serve/health.py): consecutive
+    ``ReplicaUnavailable`` outcomes trip a replica's breaker open
+    (ejection); the engine re-dispatches the batch to a surviving replica,
+    so requests only ever see this when EVERY dispatch attempt failed.
+    Transient by type — the breaker's half-open probe decides recovery."""
 
 
 class RetryBudgetExhausted(RuntimeError):

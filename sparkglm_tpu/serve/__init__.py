@@ -33,13 +33,23 @@ Serving is numerics-NEUTRAL: a served prediction (default precision tier)
 is bit-identical to ``sg.predict`` on the same rows (PARITY.md;
 test-enforced across every padding bucket), because serving runs the same
 jitted kernel as offline scoring and every kernel output is row-local.
+
+Self-healing (:mod:`.health`): per-replica circuit breakers with
+deterministic half-open probing drive a healthy → suspect → ejected →
+probing → healthy state machine over the engine's replicas; failed or
+hung dispatches re-route to surviving replicas (R−1 serving stays
+bit-identical — same tables, same kernel), recovered replicas re-warm
+their bucket ladder before re-admission, and per-request deadlines shed
+dead work at batch-formation time (README "Failure semantics").
 """
 
 from .async_engine import AsyncEngine, EnginePolicy, ReplicatedScorer
 from .batching import BatchPolicy, MicroBatcher
 from .engine import FamilyScorer, Scorer, family_score_cache_size
+from .health import CircuitBreaker, HealthPolicy, ReplicaHealth
 from .registry import ModelFamily, ModelRegistry
 
-__all__ = ["AsyncEngine", "BatchPolicy", "EnginePolicy", "FamilyScorer",
-           "MicroBatcher", "ModelFamily", "ModelRegistry",
-           "ReplicatedScorer", "Scorer", "family_score_cache_size"]
+__all__ = ["AsyncEngine", "BatchPolicy", "CircuitBreaker", "EnginePolicy",
+           "FamilyScorer", "HealthPolicy", "MicroBatcher", "ModelFamily",
+           "ModelRegistry", "ReplicaHealth", "ReplicatedScorer", "Scorer",
+           "family_score_cache_size"]

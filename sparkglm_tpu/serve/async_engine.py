@@ -40,6 +40,26 @@ compute, cheap combine at the edge):
 (single tenant, single replica) — same API, same metric names, same
 behavioural contracts, one scheduler implementation.
 
+SELF-HEALING (serve/health.py): every replica carries a typed circuit
+breaker driven by dispatch outcomes — consecutive failures move it
+healthy → suspect → ejected, a deterministic half-open probe (after
+``HealthPolicy.probe_cooldown_s``) moves it ejected → probing → healthy,
+and a probing replica is RE-WARMED (its bucket ladder re-driven through
+the scorer's prepaid executables) before it takes traffic again, so
+recovery never causes a steady-state compile.  Dispatches are protected:
+a batch whose replica call fails (or exceeds the ``call_timeout_s``
+watchdog — the call is abandoned as hung, its late result discarded) is
+re-dispatched to a surviving replica, and an optional ``hedge_after_s``
+budget speculatively re-dispatches a slow batch to a second free replica
+with first-result-wins semantics.  The last non-ejected replica is never
+ejected: with R−1 (or 1) replicas the engine keeps serving bit-identically
+at reduced throughput (scoring is replica-independent — every replica
+holds the same ``device_put`` coefficient tables).  Requests accept a
+``deadline=``; expired requests are SHED at batch-formation time (typed
+:class:`~..robust.retry.DeadlineExceeded`) instead of burning replica
+time, and ``score(timeout=)`` / ``asubmit(timeout=)`` cancel abandoned
+requests out of the queue the same way.
+
 Observability: the engine feeds ``serve.<name>.latency_s`` /
 ``rows_per_s`` / ``batches`` / ``batched_rows`` / ``overloaded`` (the
 MicroBatcher names) plus ``queue_depth`` and ``batch_rows`` histograms
@@ -73,6 +93,7 @@ import dataclasses
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 
 import jax
 import numpy as np
@@ -82,12 +103,13 @@ from ..data.frame import as_columns
 from ..models.scoring import (donation_supported, predict_sharded,
                               score_kernel_cache_size)
 from ..obs.trace import emit_ambient
-from ..robust.retry import Overloaded
+from ..robust.retry import DeadlineExceeded, Overloaded, ReplicaUnavailable
 from .engine import (Scorer, _family_score_kernel,
                      _family_score_kernel_donated, _next_bucket,
                      family_score_cache_size)
+from .health import HealthPolicy, ReplicaHealth
 
-__all__ = ["AsyncEngine", "EnginePolicy", "ReplicatedScorer"]
+__all__ = ["AsyncEngine", "EnginePolicy", "HealthPolicy", "ReplicatedScorer"]
 
 
 # ---------------------------------------------------------------------------
@@ -398,6 +420,40 @@ class ReplicatedScorer:
             self.metrics.counter(f"serve.{self.name}.rows").inc(n)
             self.metrics.histogram(f"serve.{self.name}.score_s").observe(dt)
 
+    def _warm_one(self, r: int, b: int) -> None:
+        """Drive one (replica, bucket) executable through ``_counted`` —
+        the shared probe call under :meth:`warmup` and :meth:`rewarm`."""
+        if self.family_mode:
+            p = self._B.shape[1]
+            self._counted(
+                (r, b, "family"), family_score_cache_size,
+                lambda b=b, r=r: self._family_call(
+                    np.zeros((b, p)), np.zeros(b, np.int32),
+                    np.zeros(b), b, r))
+        elif self.precision == "bf16":
+            p = self.model.n_params
+            self._counted(
+                (r, b, "bf16"), family_score_cache_size,
+                lambda b=b, r=r: self._family_call(
+                    np.zeros((b, p)), np.zeros(b, np.int32),
+                    np.zeros(b), b, r))
+        else:
+            p = self.model.n_params
+            has_off = (getattr(self.model, "offset_col", None)
+                       is not None
+                       or getattr(self.model, "has_offset", False))
+            off = np.zeros(1) if has_off else None
+            self._counted(
+                (r, b, has_off), score_kernel_cache_size,
+                lambda b=b, r=r, off=off: predict_sharded(
+                    np.zeros((1, p)), self.model.coefficients,
+                    mesh=None, offset=off, vcov=self._base._vcov,
+                    link=self._base._link,
+                    type=self.type if self._base.is_glm else "link",
+                    se_fit=self._base.se_fit, pad_to=b,
+                    donate=self._donate,
+                    device=self.devices[r]))
+
     def warmup(self, buckets=None) -> tuple[int, ...]:
         """Pre-compile every (replica, bucket) executable — replicas
         compile independently, so warmup cost scales with the mesh — then
@@ -412,40 +468,32 @@ class ReplicatedScorer:
         done = []
         for b in sorted(set(int(x) for x in buckets)):
             for r in range(self.n_replicas):
-                if self.family_mode:
-                    p = self._B.shape[1]
-                    self._counted(
-                        (r, b, "family"), family_score_cache_size,
-                        lambda b=b, r=r: self._family_call(
-                            np.zeros((b, p)), np.zeros(b, np.int32),
-                            np.zeros(b), b, r))
-                elif self.precision == "bf16":
-                    p = self.model.n_params
-                    self._counted(
-                        (r, b, "bf16"), family_score_cache_size,
-                        lambda b=b, r=r: self._family_call(
-                            np.zeros((b, p)), np.zeros(b, np.int32),
-                            np.zeros(b), b, r))
-                else:
-                    p = self.model.n_params
-                    has_off = (getattr(self.model, "offset_col", None)
-                               is not None
-                               or getattr(self.model, "has_offset", False))
-                    off = np.zeros(1) if has_off else None
-                    self._counted(
-                        (r, b, has_off), score_kernel_cache_size,
-                        lambda b=b, r=r, off=off: predict_sharded(
-                            np.zeros((1, p)), self.model.coefficients,
-                            mesh=None, offset=off, vcov=self._base._vcov,
-                            link=self._base._link,
-                            type=self.type if self._base.is_glm else "link",
-                            se_fit=self._base.se_fit, pad_to=b,
-                            donate=self._donate,
-                            device=self.devices[r]))
+                self._warm_one(r, b)
             self.buckets.add(b)
             done.append(b)
         self.compiles = 0
         return tuple(done)
+
+    def rewarm(self, replica: int) -> dict:
+        """Prepay ONE replica's bucket ladder before it is re-admitted
+        after an ejection (serve/health.py recovery path): drive every
+        bucket this scorer has served — including buckets that first
+        appeared WHILE the replica was ejected — through the probe call.
+        Already-warm (replica, bucket) pairs cost one cached dispatch;
+        new pairs compile here, on the probe, instead of on the first
+        user batch after re-admission.  Returns the buckets driven and
+        the compile delta (0 in steady state — executables survive an
+        ejection because the jit cache is process-wide and the tables
+        stay ``device_put``; the recovery contract the chaos bench
+        asserts)."""
+        replica = int(replica) % self.n_replicas
+        before = self.compiles
+        driven = []
+        for b in sorted(self.buckets):
+            driven.append(int(b))
+            self._warm_one(replica, b)
+        return dict(buckets=len(driven),
+                    compiles=int(self.compiles - before))
 
 
 # ---------------------------------------------------------------------------
@@ -498,6 +546,7 @@ class _Pending:
     future: Future
     t_submit: float
     trace: str = ""       # deterministic request trace id (telemetry mode)
+    deadline: float = 0.0  # absolute perf_counter deadline; 0.0 = none
 
 
 _DEFAULT_TENANT = "_"
@@ -518,11 +567,20 @@ class AsyncEngine:
     duck-typed scorer with ``score(data, *, offset=None)`` (one replica).
 
     Use as a context manager or call ``close()``: pending requests drain
-    before the loop exits (MicroBatcher semantics).
+    before the loop exits (MicroBatcher semantics), and any request the
+    scheduler could not serve is failed — never orphaned.
+
+    ``health=`` (a :class:`~.health.HealthPolicy`) configures the
+    self-healing plane: per-replica circuit breakers, the watchdog
+    deadline, the hedged-dispatch budget.  The default policy keeps
+    ejection/probing on and watchdog/hedging off.  ``fault_plan=`` (a
+    :class:`~..robust.faults.FaultPlan`) injects seeded serving faults at
+    dispatch time — the chaos-test hook.
     """
 
     def __init__(self, scorer, policy: EnginePolicy | None = None, *,
-                 metrics=None, name: str | None = None, telemetry=None):
+                 metrics=None, name: str | None = None, telemetry=None,
+                 health: HealthPolicy | None = None, fault_plan=None):
         self.scorer = scorer
         self.policy = policy if policy is not None else EnginePolicy()
         # explicit metrics= wins; then the telemetry registry (so SLO
@@ -551,8 +609,21 @@ class AsyncEngine:
         self._inflight = 0            # loop-thread only
         self._rows_done = 0           # worker threads, under _lock
         self._t_first = None
+        self._shed = 0                # deadline-shed requests, under _lock
+        self._has_deadlines = False   # any queued req with deadline (lock)
+        self._abandoned = 0           # hung replica calls left running
+        self._abandoned_calls = set()  # their asyncio futures (loop thread)
+        self._hedges = 0              # loop-thread only
+        self._redispatches = 0        # loop-thread only
+        self._fault_plan = fault_plan
+        self.health = ReplicaHealth(self.n_replicas, health,
+                                    emit=self._emit)
+        # +2 slack workers: a watchdog-abandoned (hung) call keeps its
+        # worker until it returns; slack lets the recycled replica index
+        # take new work meanwhile.  Concurrency per replica is still 1 in
+        # the steady state — each index circulates once through _free.
         self._pool = ThreadPoolExecutor(
-            max_workers=self.n_replicas,
+            max_workers=self.n_replicas + 2,
             thread_name_prefix=f"serve-replica:{self.name}")
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()
@@ -573,7 +644,7 @@ class AsyncEngine:
     # -- client side ---------------------------------------------------------
 
     def submit(self, data, *, tenant: str | None = None,
-               offset=None) -> Future:
+               offset=None, deadline: float | None = None) -> Future:
         """Admit one scoring request; returns its Future immediately.
 
         Family mode: ``data`` is an (n, p) design aligned to the family
@@ -582,10 +653,23 @@ class AsyncEngine:
         column data or an aligned design, ``tenant`` is an optional
         fairness key.
 
+        ``deadline=`` (seconds from now): a request still queued when its
+        deadline passes is SHED at batch-formation time — its future
+        fails with :class:`~..robust.retry.DeadlineExceeded` and no
+        replica time is spent on it.  A request already dispatched when
+        the deadline passes completes normally (the deadline bounds
+        queue wait, not kernel time).
+
         Raises :class:`Overloaded` when ``policy.max_queue`` requests (or
-        ``max_queue_rows`` rows) are already waiting, and ``RuntimeError``
-        after ``close()``.
+        ``max_queue_rows`` rows) are already waiting — carrying a
+        ``retry_after_s`` drain-rate hint — and ``RuntimeError`` after
+        ``close()``.
         """
+        return self._admit(data, tenant=tenant, offset=offset,
+                           deadline=deadline).future
+
+    def _admit(self, data, *, tenant: str | None = None, offset=None,
+               deadline: float | None = None) -> _Pending:
         if self.family_mode:
             if tenant is None:
                 raise ValueError(
@@ -610,10 +694,13 @@ class AsyncEngine:
             key = _signature(data, offset)
         if n < 1:
             raise ValueError("request must have >= 1 row")
+        if deadline is not None and deadline <= 0:
+            raise ValueError(f"deadline must be positive, got {deadline}")
         tenant = str(tenant) if tenant is not None else _DEFAULT_TENANT
+        now = time.perf_counter()
         req = _Pending(tenant=tenant, data=data, offset=offset, n=n,
-                       key=key, future=Future(),
-                       t_submit=time.perf_counter())
+                       key=key, future=Future(), t_submit=now,
+                       deadline=(now + deadline) if deadline else 0.0)
         pol = self.policy
         with self._lock:
             if self._closed:
@@ -624,14 +711,25 @@ class AsyncEngine:
                 if self.metrics is not None:
                     self.metrics.counter(
                         f"serve.{self.name}.overloaded").inc()
+                retry_after = None
+                if self._t_first is not None:
+                    elapsed = now - self._t_first
+                    rate = self._rows_done / elapsed if elapsed > 0 else 0.0
+                    if rate > 0:
+                        # how long until the measured drain rate clears
+                        # what is queued ahead of a retry
+                        retry_after = min(
+                            max(self._queued_rows / rate, 1e-3), 60.0)
                 self._emit("admission", engine=self.name, tenant=tenant,
                            outcome="overloaded",
                            queued_requests=self._queued_reqs,
-                           queued_rows=self._queued_rows)
+                           queued_rows=self._queued_rows,
+                           retry_after_s=retry_after)
                 raise Overloaded(
                     f"serving queue for {self.name!r} is full "
                     f"({self._queued_reqs} requests / {self._queued_rows} "
-                    "rows waiting); retry with backoff")
+                    "rows waiting); retry with backoff",
+                    retry_after_s=retry_after)
             q = self._queues.get(tenant)
             if q is None:
                 q = self._queues[tenant] = collections.deque()
@@ -640,6 +738,8 @@ class AsyncEngine:
             q.append(req)
             self._queued_reqs += 1
             self._queued_rows += n
+            if req.deadline:
+                self._has_deadlines = True
             if self._tracer is not None:
                 # mint + emit UNDER the admission lock: the scheduler can
                 # only see this request after we release, so its `batched`
@@ -657,31 +757,146 @@ class AsyncEngine:
             self._loop.call_soon_threadsafe(self._notify)
         except RuntimeError:
             pass  # close() raced us; the drain loop already saw the request
-        return req.future
+        return req
 
     async def asubmit(self, data, *, tenant: str | None = None,
-                      offset=None):
-        """Awaitable ``submit`` for asyncio callers."""
-        return await asyncio.wrap_future(
-            self.submit(data, tenant=tenant, offset=offset))
+                      offset=None, deadline: float | None = None,
+                      timeout: float | None = None):
+        """Awaitable ``submit`` for asyncio callers.
+
+        ``timeout=`` bounds the whole wait AND cancels a still-queued
+        request out of the queue on expiry (it is never dispatched — no
+        dead-work leak), raising :class:`~..robust.retry.DeadlineExceeded`.
+        A request that is already mid-dispatch completes on the replica,
+        but its result is discarded and the timeout still raises."""
+        eff = deadline
+        if timeout is not None:
+            if timeout <= 0:
+                raise ValueError(f"timeout must be positive, got {timeout}")
+            eff = timeout if eff is None else min(eff, timeout)
+        req = self._admit(data, tenant=tenant, offset=offset, deadline=eff)
+        fut = asyncio.wrap_future(req.future)
+        if timeout is None:
+            return await fut
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, TimeoutError):
+            self._cancel_queued(req, reason="timeout")
+            raise DeadlineExceeded(
+                f"request to {self.name!r} timed out after {timeout}s and "
+                "was cancelled out of the queue") from None
 
     def score(self, data, *, tenant: str | None = None, offset=None,
-              timeout: float | None = None):
-        """Blocking submit: the served result (or the served exception)."""
-        return self.submit(data, tenant=tenant,
-                           offset=offset).result(timeout)
+              timeout: float | None = None, deadline: float | None = None):
+        """Blocking submit: the served result (or the served exception).
+
+        On ``timeout=`` expiry the request is cancelled out of the queue
+        (never dispatched) and :class:`~..robust.retry.DeadlineExceeded`
+        raises — a timed-out caller leaves no dead work behind."""
+        req = self._admit(data, tenant=tenant, offset=offset,
+                          deadline=deadline)
+        try:
+            return req.future.result(timeout)
+        except (TimeoutError, FuturesTimeout):
+            if req.future.done():
+                raise  # the SERVED outcome was DeadlineExceeded — re-raise
+            self._cancel_queued(req, reason="timeout")
+            raise DeadlineExceeded(
+                f"request to {self.name!r} timed out after {timeout}s and "
+                "was cancelled out of the queue") from None
+
+    def _cancel_queued(self, req: _Pending, *, reason: str) -> bool:
+        """Remove an abandoned request from its tenant queue (if it is
+        still there) and fail its future.  Returns whether THIS call
+        settled the request; False means it was already dispatched (its
+        in-flight result will be discarded by the abandoned future)."""
+        with self._lock:
+            q = self._queues.get(req.tenant)
+            removed = False
+            if q is not None:
+                try:
+                    q.remove(req)
+                    removed = True
+                except ValueError:
+                    pass
+            if removed:
+                self._queued_reqs -= 1
+                self._queued_rows -= req.n
+                self._shed += 1
+                if not q:
+                    if req.tenant in self._active:
+                        self._active.remove(req.tenant)
+                    self._deficit.pop(req.tenant, None)
+                    self._queues.pop(req.tenant, None)
+        if not removed:
+            return False
+        exc = DeadlineExceeded(
+            f"request to {self.name!r} abandoned while queued ({reason})")
+        settled = self._settle(req.future, exc=exc)
+        if settled:
+            self._shed_bookkeeping(req, reason)
+        return settled
+
+    def _shed_bookkeeping(self, req: _Pending, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(f"serve.{self.name}.shed").inc()
+        f = dict(engine=self.name, tenant=req.tenant, rows=req.n,
+                 reason=reason,
+                 waited_s=time.perf_counter() - req.t_submit)
+        if req.trace:
+            f["trace"] = req.trace
+        self._emit("deadline_shed", **f)
+
+    @staticmethod
+    def _settle(fut: Future, value=None, exc=None) -> bool:
+        """First-result-wins completion: hedged dispatches may both try
+        to finish a request; only one wins, the loser is discarded."""
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(value)
+            return True
+        except Exception:
+            return False  # already settled (hedge loser / cancelled)
 
     def close(self) -> None:
-        """Drain pending requests, then stop the scheduler loop."""
+        """Drain pending requests, then stop the scheduler loop.
+
+        Never orphans a future: requests the scheduler could not serve
+        (it died, or a replica call is permanently hung) are failed with
+        ``RuntimeError`` after the loop thread exits.  The worker pool is
+        joined only when no abandoned (hung) call is still running —
+        a hung replica call cannot block shutdown."""
         with self._lock:
             if self._closed:
                 if self._thread.is_alive():
                     self._thread.join()
                 return
             self._closed = True
-        self._loop.call_soon_threadsafe(self._notify)
+        try:
+            self._loop.call_soon_threadsafe(self._notify)
+        except RuntimeError:
+            pass  # loop already dead; the sweep below still runs
         self._thread.join()
-        self._pool.shutdown(wait=True)
+        with self._lock:
+            leftovers = []
+            for q in self._queues.values():
+                leftovers.extend(q)
+                q.clear()
+            self._queues.clear()
+            self._active.clear()
+            self._deficit.clear()
+            self._queued_reqs = 0
+            self._queued_rows = 0
+        if leftovers:
+            exc = RuntimeError(
+                f"AsyncEngine {self.name!r} closed before this request "
+                "could be dispatched")
+            for r in leftovers:
+                if self._settle(r.future, exc=exc):
+                    self._note_error(r, None, -1, exc)
+        self._pool.shutdown(wait=self._abandoned == 0)
 
     def __enter__(self):
         return self
@@ -708,42 +923,281 @@ class AsyncEngine:
             self._loop.close()
 
     async def _scheduler(self) -> None:
+        replica = None
         while True:
-            replica = await self._free.get()
+            if replica is None:
+                replica = await self._acquire()
+            action, val = self._next_action()
+            if action == "batch":
+                if self._tracer is not None:
+                    # emitted BEFORE the dispatch task exists, so
+                    # `batched` sequences before the worker's
+                    # `dispatched` for every member request
+                    batch, _, _, batch_id = val
+                    for r in batch:
+                        self._tracer.emit("batched", trace=r.trace,
+                                          tenant=r.tenant,
+                                          batch=batch_id, rows=r.n)
+                self._inflight += 1
+                asyncio.ensure_future(self._dispatch(replica, val))
+                replica = None
+                continue
+            if action == "exit":
+                return
+            # idle: release the held replica while we sleep, so hedges,
+            # re-dispatches and recovery probes can use it meanwhile
+            self._free.put_nowait(replica)
+            replica = None
+            self._wake.clear()
+            # no await between _next_action and clear(): _notify runs on
+            # this thread, so a wakeup cannot be lost in between
+            if action == "wait":
+                try:
+                    await asyncio.wait_for(self._wake.wait(),
+                                           timeout=max(val, 1e-4))
+                except asyncio.TimeoutError:
+                    pass
+            else:
+                await self._wake.wait()
+
+    async def _acquire(self):
+        """Next replica admissible for dispatch.  Ejected replicas coming
+        off the free queue are benched — re-offered by timer once their
+        breaker cooldown elapses (the deterministic half-open probe
+        schedule); :meth:`ReplicaHealth.admit` flips them to probing."""
+        while True:
+            r = await self._free.get()
+            if self.health.admit(r):
+                return r
+            delay = max(self.health.retry_delay(r), 1e-3)
+            self._loop.call_later(delay, self._free.put_nowait, r)
+
+    def _drain_free(self, *, exclude):
+        """Pop every immediately-free replica; return (usable, skipped):
+        the first admissible replica not in ``exclude`` (or None) and the
+        replicas to put back."""
+        skipped, got = [], None
+        while got is None:
+            try:
+                r = self._free.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if r in exclude or not self.health.admit(r):
+                skipped.append(r)
+            else:
+                got = r
+        return got, skipped
+
+    def _try_acquire_now(self, exclude):
+        """Non-blocking acquisition for hedged dispatch: an admissible
+        replica not yet tried for this batch, or None (no hedge — never
+        wait for one; the primary may still win)."""
+        got, skipped = self._drain_free(exclude=exclude)
+        for s in skipped:
+            self._free.put_nowait(s)
+        return got
+
+    async def _acquire_retry(self, tried):
+        """Blocking acquisition for re-dispatch after a replica failure:
+        wait for an admissible replica this batch has NOT been tried on.
+        Returns None when no such replica can exist (every replica
+        tried).  Skipped replicas are held out of circulation only while
+        we wait and always returned."""
+        if len(set(tried)) >= self.n_replicas:
+            return None
+        held = []
+        try:
             while True:
-                action, val = self._next_action()
-                if action == "batch":
-                    if self._tracer is not None:
-                        # emitted BEFORE the dispatch task exists, so
-                        # `batched` sequences before the worker's
-                        # `dispatched` for every member request
-                        batch, _, _, batch_id = val
-                        for r in batch:
-                            self._tracer.emit("batched", trace=r.trace,
-                                              tenant=r.tenant,
-                                              batch=batch_id, rows=r.n)
-                    self._inflight += 1
-                    asyncio.ensure_future(self._dispatch(replica, val))
-                    break
-                if action == "exit":
-                    return
-                self._wake.clear()
-                # re-check after clear: a submit between _next_action and
-                # clear() re-sets the event and we fall straight through
-                if action == "wait":
-                    try:
-                        await asyncio.wait_for(self._wake.wait(),
-                                               timeout=max(val, 1e-4))
-                    except asyncio.TimeoutError:
-                        pass
-                else:
-                    await self._wake.wait()
+                got, skipped = self._drain_free(exclude=tried)
+                held.extend(skipped)
+                if got is not None:
+                    return got
+                r = await self._free.get()
+                if r not in tried and self.health.admit(r):
+                    return r
+                held.append(r)
+        finally:
+            for s in held:
+                self._free.put_nowait(s)
+
+    def _call(self, loop, replica, payload):
+        """One replica call as an asyncio future.  The replica index
+        recirculates when ITS call finishes — not when the logical batch
+        completes — unless the call was abandoned by the watchdog (the
+        index was already recycled then)."""
+        fut = loop.run_in_executor(
+            self._pool, self._run_batch, replica, payload)
+
+        def _release(f):
+            try:
+                f.exception()       # consume; _protected handles outcomes
+            except BaseException:
+                pass
+            if f in self._abandoned_calls:
+                self._abandoned_calls.discard(f)
+                self._abandoned -= 1    # the hung call finally returned
+                return
+            self._free.put_nowait(replica)
+            self._wake.set()
+
+        fut.add_done_callback(_release)
+        return fut
+
+    async def _dispatch(self, replica, payload) -> None:
+        try:
+            await self._protected(replica, payload)
+        finally:
+            self._inflight -= 1
+            self._wake.set()
+
+    async def _protected(self, replica, payload) -> None:
+        """Run one batch with failure protection: watchdog abandonment of
+        hung calls, re-dispatch to a surviving replica on failure, hedged
+        speculative dispatch past the latency budget.  First result wins;
+        a batch's futures fail only when every attempt (bounded by
+        ``HealthPolicy.max_attempts``) is exhausted."""
+        batch, _, _, batch_id = payload
+        pol = self.health.policy
+        loop = asyncio.get_running_loop()
+        calls: dict = {}
+        tried: list = []
+        attempts = 0
+        last_exc = None
+
+        def launch(r):
+            nonlocal attempts
+            attempts += 1
+            tried.append(r)
+            calls[self._call(loop, r, payload)] = r
+
+        launch(replica)
+        start = loop.time()
+        hedged = False
+        watchdog = (start + pol.call_timeout_s
+                    if pol.call_timeout_s is not None else None)
+        while calls:
+            timeout = None
+            if (not hedged and pol.hedge_after_s is not None
+                    and attempts < pol.max_attempts
+                    and self.n_replicas > 1):
+                timeout = max(0.0, start + pol.hedge_after_s - loop.time())
+            if watchdog is not None:
+                rem = max(0.0, watchdog - loop.time())
+                timeout = rem if timeout is None else min(timeout, rem)
+            done, _ = await asyncio.wait(
+                set(calls), timeout=timeout,
+                return_when=asyncio.FIRST_COMPLETED)
+            if done:
+                success = False
+                for f in done:
+                    rep = calls.pop(f)
+                    exc = f.exception()
+                    if exc is None:
+                        self.health.on_success(rep)
+                        success = True
+                    else:
+                        last_exc = exc
+                        self.health.on_failure(rep, exc)
+                if success:
+                    return  # a still-pending hedge loses by first-wins
+                if calls:
+                    continue  # a hedge is still in flight — it may win
+                if attempts < pol.max_attempts:
+                    nxt = await self._acquire_retry(tried)
+                    if nxt is not None:
+                        self._redispatches += 1
+                        if self.metrics is not None:
+                            self.metrics.counter(
+                                f"serve.{self.name}.redispatches").inc()
+                        f = dict(engine=self.name, replica=int(nxt),
+                                 failed_replica=int(tried[-1]),
+                                 error=type(last_exc).__name__,
+                                 rows=sum(r.n for r in batch))
+                        if batch_id is not None:
+                            f["batch"] = batch_id
+                        self._emit("redispatch", **f)
+                        if watchdog is not None:
+                            watchdog = loop.time() + pol.call_timeout_s
+                        launch(nxt)
+                        continue
+                self._fail_batch(batch, last_exc, batch_id, tried[-1])
+                return
+            now = loop.time()
+            if watchdog is not None and now >= watchdog:
+                # every pending call is hung: abandon it (the worker keeps
+                # running; its late result is discarded by first-wins and
+                # its replica index was already recycled)
+                for f, rep in list(calls.items()):
+                    exc = ReplicaUnavailable(
+                        f"replica {rep} of {self.name!r} exceeded the "
+                        f"{pol.call_timeout_s}s watchdog deadline")
+                    last_exc = exc
+                    self.health.on_failure(rep, exc)
+                    self._abandoned_calls.add(f)
+                    self._abandoned += 1
+                    self._free.put_nowait(rep)
+                    self._wake.set()
+                    fl = dict(engine=self.name, replica=int(rep),
+                              deadline_s=pol.call_timeout_s)
+                    if batch_id is not None:
+                        fl["batch"] = batch_id
+                    self._emit("replica_hung", **fl)
+                calls.clear()
+                if attempts < pol.max_attempts:
+                    nxt = await self._acquire_retry(tried)
+                    if nxt is not None:
+                        self._redispatches += 1
+                        if self.metrics is not None:
+                            self.metrics.counter(
+                                f"serve.{self.name}.redispatches").inc()
+                        f = dict(engine=self.name, replica=int(nxt),
+                                 failed_replica=int(tried[-1]),
+                                 error="watchdog_timeout",
+                                 rows=sum(r.n for r in batch))
+                        if batch_id is not None:
+                            f["batch"] = batch_id
+                        self._emit("redispatch", **f)
+                        watchdog = loop.time() + pol.call_timeout_s
+                        launch(nxt)
+                        continue
+                self._fail_batch(batch, last_exc, batch_id, tried[-1])
+                return
+            if (not hedged and pol.hedge_after_s is not None
+                    and attempts < pol.max_attempts and self.n_replicas > 1
+                    and now >= start + pol.hedge_after_s):
+                hedged = True
+                nxt = self._try_acquire_now(tried)
+                if nxt is not None:
+                    self._hedges += 1
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            f"serve.{self.name}.hedges").inc()
+                    f = dict(engine=self.name, primary=int(replica),
+                             hedge=int(nxt), after_s=pol.hedge_after_s,
+                             rows=sum(r.n for r in batch))
+                    if batch_id is not None:
+                        f["batch"] = batch_id
+                    self._emit("hedge_dispatch", **f)
+                    launch(nxt)
+
+    def _fail_batch(self, batch, exc, batch_id, replica) -> None:
+        """Terminal failure: every attempt exhausted — deliver the last
+        error to each member future (first-wins guarded)."""
+        if exc is None:
+            exc = ReplicaUnavailable(
+                f"no replica of {self.name!r} could serve this batch")
+        for r in batch:
+            if self._settle(r.future, exc=exc):
+                self._note_error(r, batch_id, replica, exc)
+        if self.telemetry is not None:
+            self.telemetry.evaluate_slos()
 
     def _next_action(self):
         """One scheduling decision: ('batch', payload) | ('wait', s) |
         ('idle', None) | ('exit', None)."""
         pol = self.policy
         with self._lock:
+            self._shed_expired_locked()
             if self._queued_reqs == 0:
                 if self._closed and self._inflight == 0:
                     return "exit", None
@@ -764,6 +1218,41 @@ class AsyncEngine:
                         if self._tracer is not None else None)
             return "batch", (batch, self._queued_reqs, self._queued_rows,
                              batch_id)
+
+    def _shed_expired_locked(self) -> None:
+        """Dead-work shedding at batch-formation time (caller holds the
+        lock): drop every queued request whose deadline already passed,
+        failing its future with :class:`DeadlineExceeded` — a caller that
+        gave up never costs replica time.  O(queued) but skipped entirely
+        while no queued request carries a deadline."""
+        if not self._has_deadlines:
+            return
+        now = time.perf_counter()
+        shed, still = [], False
+        for t in list(self._queues):
+            q = self._queues[t]
+            expired = [r for r in q if r.deadline and now > r.deadline]
+            if expired:
+                kept = [r for r in q if not (r.deadline and now > r.deadline)]
+                q.clear()
+                q.extend(kept)
+                shed.extend(expired)
+            still = still or any(r.deadline for r in q)
+            if not q:
+                if t in self._active:
+                    self._active.remove(t)
+                self._deficit.pop(t, None)
+                self._queues.pop(t, None)
+        self._has_deadlines = still
+        for r in shed:
+            self._queued_reqs -= 1
+            self._queued_rows -= r.n
+            self._shed += 1
+            exc = DeadlineExceeded(
+                f"request to {self.name!r} exceeded its deadline after "
+                f"{now - r.t_submit:.3f}s in queue; shed before dispatch")
+            if self._settle(r.future, exc=exc):
+                self._shed_bookkeeping(r, "deadline")
 
     def _form_batch_locked(self):
         """Deficit round-robin batch formation (caller holds the lock).
@@ -838,16 +1327,6 @@ class AsyncEngine:
                 self._queues.pop(t, None)
         return batch
 
-    async def _dispatch(self, replica, payload) -> None:
-        loop = asyncio.get_running_loop()
-        try:
-            await loop.run_in_executor(
-                self._pool, self._run_batch, replica, payload)
-        finally:
-            self._inflight -= 1
-            self._free.put_nowait(replica)
-            self._wake.set()
-
     # -- batch execution (replica worker threads) ----------------------------
 
     def _run_batch(self, replica, payload) -> None:
@@ -861,52 +1340,59 @@ class AsyncEngine:
                                   tenant=r.tenant, batch=batch_id,
                                   replica=int(replica), bucket=int(bucket))
         t0 = time.perf_counter()
-        try:
-            if self.family_mode:
-                self.scorer.refresh()
-                # resolve per request so an unknown tenant fails ITS
-                # future without poisoning the rest of the batch
-                idx, live = [], []
-                for r in batch:
-                    try:
-                        idx.append(int(
-                            self.scorer.tenant_indices([r.tenant])[0]))
-                        live.append(r)
-                    except KeyError as e:
-                        r.future.set_exception(e)
-                        self._note_error(r, batch_id, replica, e)
-                batch = live
-                if not batch:
-                    return
-                rows = sum(r.n for r in batch)
-                tidx = np.repeat(np.array(idx, np.int32),
-                                 [r.n for r in batch])
-                X = (np.concatenate([r.data for r in batch])
-                     if len(batch) > 1 else batch[0].data)
-                if batch[0].offset is not None:
-                    off = np.concatenate(
-                        [np.asarray(r.offset, np.float64) for r in batch])
-                else:
-                    off = None
-                res = self.scorer.score_family(tidx, X, offset=off,
-                                               replica=replica)
-            else:
-                data, off = _merge(batch)
-                if self._routes_replica:
-                    res = self.scorer.score(data, offset=off,
-                                            replica=replica)
-                else:
-                    res = self.scorer.score(data, offset=off)
-            parts = _split(res, [r.n for r in batch])
-        except BaseException as e:  # noqa: BLE001 — delivered, not swallowed
+        # batch-level failures below (a scorer/device error, an injected
+        # fault, a failed re-warm) PROPAGATE through the executor future to
+        # the dispatch coordinator (_protected), which re-dispatches to a
+        # surviving replica or fails the futures once attempts exhaust —
+        # errors here no longer reach request futures directly
+        if self.health.take_rewarm(replica):
+            self._rewarm(replica)
+        if self._fault_plan is not None:
+            self._fault_plan.on_dispatch(replica)
+        if self.family_mode:
+            self.scorer.refresh()
+            # resolve per request so an unknown tenant fails ITS
+            # future without poisoning the rest of the batch
+            idx, live = [], []
             for r in batch:
-                r.future.set_exception(e)
-                self._note_error(r, batch_id, replica, e)
-            if self.telemetry is not None:
-                self.telemetry.evaluate_slos()
-            return
+                try:
+                    idx.append(int(
+                        self.scorer.tenant_indices([r.tenant])[0]))
+                    live.append(r)
+                except KeyError as e:
+                    if self._settle(r.future, exc=e):
+                        self._note_error(r, batch_id, replica, e)
+            batch = live
+            if not batch:
+                return
+            rows = sum(r.n for r in batch)
+            tidx = np.repeat(np.array(idx, np.int32),
+                             [r.n for r in batch])
+            X = (np.concatenate([r.data for r in batch])
+                 if len(batch) > 1 else batch[0].data)
+            if batch[0].offset is not None:
+                off = np.concatenate(
+                    [np.asarray(r.offset, np.float64) for r in batch])
+            else:
+                off = None
+            res = self.scorer.score_family(tidx, X, offset=off,
+                                           replica=replica)
+        else:
+            data, off = _merge(batch)
+            if self._routes_replica:
+                res = self.scorer.score(data, offset=off,
+                                        replica=replica)
+            else:
+                res = self.scorer.score(data, offset=off)
+        parts = _split(res, [r.n for r in batch])
         now = time.perf_counter()
         dt = now - t0
+        # first-result-wins: under hedging two replicas may finish the
+        # same batch; only the requests THIS call settles get bookkeeping
+        won = [(r, part) for r, part in zip(batch, parts)
+               if self._settle(r.future, part)]
+        if not won:
+            return  # hedge loser — the other replica delivered everything
         with self._lock:
             if self._t_first is None:
                 self._t_first = now
@@ -918,8 +1404,7 @@ class AsyncEngine:
             self._tracer.emit("scorer_kernel", engine=self.name,
                               batch=batch_id, replica=int(replica),
                               bucket=int(bucket), rows=rows, seconds=dt)
-        for r, part in zip(batch, parts):
-            r.future.set_result(part)
+        for r, _part in won:
             if self.metrics is not None:
                 self.metrics.histogram(
                     f"serve.{self.name}.latency_s").observe(
@@ -936,9 +1421,9 @@ class AsyncEngine:
                     ).observe(now - r.t_submit)
         self._emit("queue_depth", engine=self.name,
                    requests=depth_reqs, rows=depth_rows)
-        f = dict(engine=self.name, rows=rows, requests=len(batch),
+        f = dict(engine=self.name, rows=rows, requests=len(won),
                  replica=int(replica),
-                 tenants=len({r.tenant for r in batch}), seconds=dt)
+                 tenants=len({r.tenant for r, _ in won}), seconds=dt)
         if batch_id is not None:
             f["batch"] = batch_id
         self._emit("batch", **f)
@@ -946,7 +1431,7 @@ class AsyncEngine:
             m = self.metrics
             m.counter(f"serve.{self.name}.batches").inc()
             m.counter(f"serve.{self.name}.batched_rows").inc(rows)
-            m.counter(f"serve.{self.name}.requests_done").inc(len(batch))
+            m.counter(f"serve.{self.name}.requests_done").inc(len(won))
             m.histogram(f"serve.{self.name}.batch_rows").observe(rows)
             m.histogram(f"serve.{self.name}.queue_depth").observe(
                 depth_reqs)
@@ -957,6 +1442,19 @@ class AsyncEngine:
             # rate-limited: one real evaluation per interval regardless of
             # batch rate (obs/slo.py)
             self.telemetry.evaluate_slos()
+
+    def _rewarm(self, replica) -> None:
+        """Prepay a recovering replica's bucket ladder before its probe
+        batch scores (scorers without ``rewarm`` skip — duck scorers have
+        no bucketed executables to warm)."""
+        fn = getattr(self.scorer, "rewarm", None)
+        if fn is None:
+            return
+        t0 = time.perf_counter()
+        info = fn(replica)
+        self._emit("replica_rewarm", engine=self.name, replica=int(replica),
+                   seconds=time.perf_counter() - t0,
+                   **(info if isinstance(info, dict) else {}))
 
     def _note_error(self, r, batch_id, replica, exc) -> None:
         """Error-path bookkeeping for one failed request (its future is

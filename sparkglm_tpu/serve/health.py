@@ -1,0 +1,304 @@
+"""Per-replica health tracking for the self-healing serving plane.
+
+A replicated engine (``AsyncEngine`` over a ``ReplicatedScorer``) routes
+batches to whichever replica is free; without health tracking a hung or
+failing replica keeps receiving its share of traffic and poisons every
+request routed to it.  This module supplies the two pieces the engine
+composes:
+
+  * :class:`CircuitBreaker` — the classic typed breaker, one per replica:
+    ``closed`` (traffic flows) → ``open`` after ``failure_threshold``
+    consecutive failures (no traffic for ``cooldown_s``) → ``half_open``
+    (exactly one probe call admitted) → ``closed`` again after
+    ``probe_successes`` successful probes, or back to ``open`` with a
+    fresh cooldown on a failed probe.  Probing is DETERMINISTIC: the
+    transition to half-open happens on the first admission attempt after
+    the cooldown elapses — no randomized probe scheduling — and because
+    each replica index circulates at most once through the engine's free
+    queue, at most one probe is ever in flight per replica by
+    construction.
+
+  * :class:`ReplicaHealth` — the engine-facing state machine over one
+    breaker per replica, named in serving terms::
+
+        healthy ──failure──▶ suspect ──failures──▶ ejected
+           ▲                                          │ cooldown
+           └────────── auto_recovery ◀── probing ◀────┘
+
+    ``healthy``/``suspect`` map to a closed breaker (zero / nonzero
+    consecutive failures), ``ejected`` to open, ``probing`` to half-open.
+    Every transition emits a typed trace event (``replica_suspect``,
+    ``replica_ejected``, ``replica_probe``, ``auto_recovery``) through the
+    engine's emit hook; ``replica_ejected`` and ``auto_recovery`` are
+    flight-recorder triggers (obs/slo.py), so an ejection episode dumps
+    the event ring exactly like an SLO violation does.
+
+GRACEFUL DEGRADATION INVARIANT: the LAST non-ejected replica is never
+ejected, no matter how it fails — with R−1 (or even 0) healthy replicas
+the engine must keep serving at reduced throughput rather than strand the
+queue.  This is safe for correctness because scoring is replica-
+independent (every replica holds a ``device_put`` copy of the same
+coefficient tables and runs the same row-local kernel — see PARITY), so
+which replica serves a batch never changes the bytes of the answer.
+
+RE-WARM INVARIANT: a replica recovering through half-open sets a
+``needs_rewarm`` flag that the engine's worker thread consumes
+(:meth:`ReplicaHealth.take_rewarm`) BEFORE the probe batch is scored —
+the replica's bucket ladder is re-driven through the scorer's warmup
+(prepaid executables, see ``ReplicatedScorer.rewarm``), so recovery never
+causes a steady-state compile.
+
+:class:`HealthPolicy` bundles the knobs, including the two latency
+budgets the engine's dispatch protection uses: ``call_timeout_s`` (the
+watchdog deadline on each replica call — exceeded means the call is
+abandoned as hung and the batch re-dispatched) and ``hedge_after_s`` (the
+budget after which the SAME batch is speculatively re-dispatched to a
+second free replica, first result wins).  Both default to ``None`` (off):
+hedging and watchdogs are opt-in because they can double work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["HealthPolicy", "CircuitBreaker", "ReplicaHealth"]
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthPolicy:
+    """Knobs for the per-replica health machinery.
+
+    ``eject_after`` consecutive failures open a replica's breaker
+    (ejection); after ``probe_cooldown_s`` it is probed half-open, and
+    ``probe_successes`` clean probes re-admit it.  ``call_timeout_s`` is
+    the per-call watchdog deadline (None = no watchdog);
+    ``hedge_after_s`` the hedged-dispatch latency budget (None = no
+    hedging).  ``max_attempts`` bounds scoring attempts per batch across
+    re-dispatches and hedges — the guarantee "a batch is scored at most
+    N times" that keeps tail amplification bounded.
+    """
+
+    eject_after: int = 3
+    probe_cooldown_s: float = 0.25
+    probe_successes: int = 1
+    call_timeout_s: Optional[float] = None
+    hedge_after_s: Optional[float] = None
+    max_attempts: int = 2
+
+    def __post_init__(self):
+        if self.eject_after < 1:
+            raise ValueError(f"eject_after must be >= 1, got {self.eject_after}")
+        if self.probe_cooldown_s < 0:
+            raise ValueError(
+                f"probe_cooldown_s must be >= 0, got {self.probe_cooldown_s}")
+        if self.probe_successes < 1:
+            raise ValueError(
+                f"probe_successes must be >= 1, got {self.probe_successes}")
+        if self.call_timeout_s is not None and self.call_timeout_s <= 0:
+            raise ValueError(
+                f"call_timeout_s must be positive, got {self.call_timeout_s}")
+        if self.hedge_after_s is not None and self.hedge_after_s <= 0:
+            raise ValueError(
+                f"hedge_after_s must be positive, got {self.hedge_after_s}")
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+        if (self.call_timeout_s is not None and self.hedge_after_s is not None
+                and self.hedge_after_s >= self.call_timeout_s):
+            raise ValueError(
+                "hedge_after_s must be below call_timeout_s (a hedge that "
+                "fires after the watchdog already declared the call hung "
+                "would never run)")
+
+
+class CircuitBreaker:
+    """closed → open → half_open → closed, driven by call outcomes.
+
+    Not thread-safe on its own — :class:`ReplicaHealth` serializes access;
+    standalone users must too.  The clock is injectable so tests drive
+    cooldowns deterministically without sleeping.
+    """
+
+    def __init__(self, *, failure_threshold: int = 3, cooldown_s: float = 0.25,
+                 probe_successes: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.probe_successes = int(probe_successes)
+        self._clock = clock
+        self.state = "closed"
+        self.consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_ok = 0
+
+    def record_success(self) -> str:
+        if self.state == "half_open":
+            self._probe_ok += 1
+            if self._probe_ok >= self.probe_successes:
+                self.state = "closed"
+                self.consecutive_failures = 0
+        else:
+            self.consecutive_failures = 0
+        return self.state
+
+    def record_failure(self, *, allow_open: bool = True) -> str:
+        """``allow_open=False`` is the last-replica guard: failures are
+        counted but the breaker refuses to open (ejecting the only
+        remaining replica would strand the queue entirely)."""
+        self.consecutive_failures += 1
+        if self.state == "half_open":
+            # a failed probe re-opens immediately with a fresh cooldown
+            self.state = "open" if allow_open else "closed"
+            self._opened_at = self._clock()
+            self._probe_ok = 0
+        elif (self.state == "closed" and allow_open
+                and self.consecutive_failures >= self.failure_threshold):
+            self.state = "open"
+            self._opened_at = self._clock()
+            self._probe_ok = 0
+        return self.state
+
+    def remaining_cooldown(self, now: Optional[float] = None) -> float:
+        if self.state != "open":
+            return 0.0
+        now = self._clock() if now is None else now
+        return max(0.0, self.cooldown_s - (now - self._opened_at))
+
+    def try_probe(self, now: Optional[float] = None) -> bool:
+        """Deterministic half-open admission: the first attempt after the
+        cooldown elapses flips open → half_open and is admitted; earlier
+        attempts are refused.  Closed/half-open states always admit."""
+        if self.state == "closed" or self.state == "half_open":
+            return True
+        if self.remaining_cooldown(now) > 0.0:
+            return False
+        self.state = "half_open"
+        self._probe_ok = 0
+        return True
+
+
+_STATE_NAME = {"closed": "healthy", "open": "ejected", "half_open": "probing"}
+
+
+class ReplicaHealth:
+    """Health state for ``n_replicas`` replicas of one engine.
+
+    ``emit`` is the engine's trace hook (``kind, **fields``); transitions
+    emit through it.  Thread-safe: the engine's event-loop thread drives
+    admissions/outcomes while worker threads consume re-warm flags.
+    """
+
+    def __init__(self, n_replicas: int, policy: Optional[HealthPolicy] = None,
+                 *, emit: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.policy = policy if policy is not None else HealthPolicy()
+        self.n_replicas = int(n_replicas)
+        self._emit = emit or (lambda kind, **fields: None)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._breakers = [
+            CircuitBreaker(failure_threshold=self.policy.eject_after,
+                           cooldown_s=self.policy.probe_cooldown_s,
+                           probe_successes=self.policy.probe_successes,
+                           clock=clock)
+            for _ in range(self.n_replicas)]
+        self._needs_rewarm = [False] * self.n_replicas
+        self.ejections = 0
+        self.recoveries = 0
+
+    # -- queries -------------------------------------------------------------
+
+    @staticmethod
+    def _name(b: CircuitBreaker) -> str:
+        name = _STATE_NAME[b.state]
+        if name == "healthy" and b.consecutive_failures > 0:
+            name = "suspect"
+        return name
+
+    def state(self, replica: int) -> str:
+        with self._lock:
+            return self._name(self._breakers[replica])
+
+    def states(self) -> dict:
+        with self._lock:
+            return {r: self._name(b) for r, b in enumerate(self._breakers)}
+
+    def available(self) -> int:
+        """Replicas currently admissible for dispatch (not ejected)."""
+        with self._lock:
+            return sum(1 for b in self._breakers if b.state != "open")
+
+    # -- engine hooks --------------------------------------------------------
+
+    def admit(self, replica: int) -> bool:
+        """May this replica take a batch right now?  Flips ejected →
+        probing (once, deterministically) when its cooldown has elapsed;
+        the probing replica is flagged for re-warm before it scores."""
+        with self._lock:
+            b = self._breakers[replica]
+            was_open = b.state == "open"
+            ok = b.try_probe(self._clock())
+            if ok and was_open:
+                self._needs_rewarm[replica] = True
+                self._emit("replica_probe", replica=int(replica))
+            return ok
+
+    def retry_delay(self, replica: int) -> float:
+        """How long an ejected replica stays benched before the engine
+        should offer it for admission again."""
+        with self._lock:
+            return self._breakers[replica].remaining_cooldown(self._clock())
+
+    def on_success(self, replica: int) -> None:
+        with self._lock:
+            b = self._breakers[replica]
+            was = b.state
+            b.record_success()
+            recovered = was == "half_open" and b.state == "closed"
+            if recovered:
+                self.recoveries += 1
+                self._needs_rewarm[replica] = False
+        if recovered:
+            self._emit("auto_recovery", replica=int(replica),
+                       probes=self.policy.probe_successes)
+
+    def on_failure(self, replica: int, exc: BaseException) -> None:
+        with self._lock:
+            b = self._breakers[replica]
+            was = b.state
+            # never eject the last admissible replica: R−1 … 1 replicas
+            # keep serving bit-identically at reduced throughput
+            others = sum(1 for i, ob in enumerate(self._breakers)
+                         if i != replica and ob.state != "open")
+            now_state = b.record_failure(allow_open=others > 0)
+            fails = b.consecutive_failures
+            ejected = now_state == "open" and was != "open"
+            suspect = (now_state == "closed" and fails == 1
+                       and was == "closed")
+            if ejected:
+                self.ejections += 1
+                self._needs_rewarm[replica] = False
+        err = type(exc).__name__
+        if suspect:
+            self._emit("replica_suspect", replica=int(replica),
+                       failures=fails, error=err)
+        if ejected:
+            self._emit("replica_ejected", replica=int(replica),
+                       failures=fails, error=err,
+                       probe_failed=was == "half_open",
+                       cooldown_s=self.policy.probe_cooldown_s)
+
+    def take_rewarm(self, replica: int) -> bool:
+        """Consume the re-warm flag (set on ejected → probing).  Called by
+        the worker thread that owns the probe batch, before scoring."""
+        with self._lock:
+            flag = self._needs_rewarm[replica]
+            self._needs_rewarm[replica] = False
+            return flag

@@ -431,7 +431,8 @@ class ModelFamily:
         sc.refresh()
         return sc
 
-    def async_engine(self, policy=None, *, telemetry=None, **kwargs):
+    def async_engine(self, policy=None, *, telemetry=None, health=None,
+                     fault_plan=None, **kwargs):
         """A fresh :class:`~.async_engine.AsyncEngine` over this family's
         :meth:`replicated_scorer` (``kwargs`` select/configure it).  The
         caller owns the engine's lifecycle — use as a context manager or
@@ -439,12 +440,16 @@ class ModelFamily:
 
         ``telemetry=`` (an :class:`~..obs.export.Telemetry`) turns on the
         request-scoped tracing / SLO / export plane; without it the
-        engine keeps the family's metrics registry only."""
+        engine keeps the family's metrics registry only.  ``health=`` (a
+        :class:`~.health.HealthPolicy`) configures the self-healing
+        plane — watchdog deadline, hedge budget, breaker thresholds;
+        ``fault_plan=`` injects seeded serving faults (chaos testing)."""
         from .async_engine import AsyncEngine
         return AsyncEngine(self.replicated_scorer(**kwargs), policy,
                            metrics=None if telemetry is not None
                            else self.metrics,
-                           name=self.name, telemetry=telemetry)
+                           name=self.name, telemetry=telemetry,
+                           health=health, fault_plan=fault_plan)
 
     # -- persistence ---------------------------------------------------------
 

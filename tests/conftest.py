@@ -65,6 +65,11 @@ def pytest_configure(config):
         "markers", "obsplane: the runtime observability plane — request-"
         "scoped tracing, SLO flight recorder, telemetry export (`make "
         "obsplane` selects these; still tier-1 by default)")
+    config.addinivalue_line(
+        "markers", "selfheal: the self-healing serving plane + crash-"
+        "durable online journal — replica health, deadlines, hedging, "
+        "WAL resume (`make chaos` selects these; still tier-1 by "
+        "default)")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,773 @@
+"""Self-healing serving plane + crash-durable online learning.
+
+The contracts under test (ISSUE r15):
+
+  * the replica state machine: healthy -> suspect -> ejected -> probing
+    -> healthy, driven by dispatch outcomes through per-replica circuit
+    breakers with deterministic half-open probing (fake-clock unit
+    tests, no sleeps);
+  * graceful degradation: the LAST admissible replica is never ejected,
+    and killing one of two replicas loses ZERO in-flight requests — the
+    survivor serves f64 bit-identical results with zero recompiles
+    across ejection, probing, re-warm and recovery;
+  * dispatch protection: hedged re-dispatch past the latency budget
+    (first result wins, loser discarded), watchdog abandonment of hung
+    calls, re-dispatch to untried replicas only;
+  * dead-work shedding: per-request ``deadline=`` sheds expired queued
+    work at batch-formation time, and a timed-out ``score``/``asubmit``
+    caller cancels its request OUT of the queue (never dispatched);
+  * ``Overloaded.retry_after_s`` carries a measured drain-rate hint and
+    ``close()`` drains without orphaning futures;
+  * the flight recorder triggers on ``replica_ejected``/``auto_recovery``
+    with one record per episode;
+  * the online loop's write-ahead journal: a loop killed between (or
+    inside) chunks resumes at the exact chunk boundary with bit-identical
+    suffstats, rings, drift state and deploy decisions — including under
+    a real ``SIGKILL``.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from sparkglm_tpu import obs
+from sparkglm_tpu.fleet import fit_many
+from sparkglm_tpu.obs.metrics import MetricsRegistry
+from sparkglm_tpu.obs.slo import FlightRecorder
+from sparkglm_tpu.obs.trace import FitTracer, RingBufferSink
+from sparkglm_tpu.online import OnlineJournal, OnlineLoop
+from sparkglm_tpu.robust import (DeadlineExceeded, FaultPlan, Overloaded,
+                                 ReplicaUnavailable)
+from sparkglm_tpu.serve import (AsyncEngine, CircuitBreaker, EnginePolicy,
+                                HealthPolicy, ModelFamily, ReplicaHealth,
+                                family_score_cache_size)
+
+pytestmark = pytest.mark.selfheal
+
+P = 3
+
+
+class _Clock:
+    """Injectable monotone clock for breaker tests — no sleeps."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# policy + breaker unit tests (fake clock, no engine)
+# ---------------------------------------------------------------------------
+
+def test_health_policy_validation():
+    with pytest.raises(ValueError, match="eject_after"):
+        HealthPolicy(eject_after=0)
+    with pytest.raises(ValueError, match="probe_cooldown_s"):
+        HealthPolicy(probe_cooldown_s=-1)
+    with pytest.raises(ValueError, match="probe_successes"):
+        HealthPolicy(probe_successes=0)
+    with pytest.raises(ValueError, match="call_timeout_s"):
+        HealthPolicy(call_timeout_s=0)
+    with pytest.raises(ValueError, match="hedge_after_s"):
+        HealthPolicy(hedge_after_s=-0.5)
+    with pytest.raises(ValueError, match="max_attempts"):
+        HealthPolicy(max_attempts=0)
+    # a hedge firing after the watchdog declared the call hung is dead
+    with pytest.raises(ValueError, match="hedge_after_s must be below"):
+        HealthPolicy(call_timeout_s=1.0, hedge_after_s=1.0)
+
+
+def test_breaker_state_machine_deterministic():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=3, cooldown_s=0.25,
+                       probe_successes=2, clock=clk)
+    assert b.state == "closed" and b.try_probe()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed" and b.consecutive_failures == 2
+    b.record_success()                       # success resets the streak
+    assert b.consecutive_failures == 0
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open"
+    assert not b.try_probe(), "no probe before the cooldown elapses"
+    assert b.remaining_cooldown() == pytest.approx(0.25)
+    clk.t = 0.2
+    assert not b.try_probe()
+    clk.t = 0.25                             # deterministic flip point
+    assert b.try_probe() and b.state == "half_open"
+    assert b.try_probe(), "half-open keeps admitting (engine gates 1-max)"
+    b.record_success()
+    assert b.state == "half_open", "needs probe_successes=2 clean probes"
+    b.record_success()
+    assert b.state == "closed" and b.consecutive_failures == 0
+
+
+def test_breaker_failed_probe_reopens_with_fresh_cooldown():
+    clk = _Clock()
+    b = CircuitBreaker(failure_threshold=1, cooldown_s=1.0, clock=clk)
+    b.record_failure()
+    assert b.state == "open"
+    clk.t = 1.0
+    assert b.try_probe() and b.state == "half_open"
+    b.record_failure()
+    assert b.state == "open"
+    assert b.remaining_cooldown() == pytest.approx(1.0), "fresh cooldown"
+    # the last-replica guard refuses to open even on a failed probe
+    clk.t = 2.0
+    assert b.try_probe()
+    b.record_failure(allow_open=False)
+    assert b.state == "closed"
+
+
+def test_replica_health_transitions_events_and_rewarm():
+    clk = _Clock()
+    events = []
+    h = ReplicaHealth(2, HealthPolicy(eject_after=2, probe_cooldown_s=0.5),
+                      emit=lambda kind, **f: events.append((kind, f)),
+                      clock=clk)
+    boom = ReplicaUnavailable("boom")
+    assert h.states() == {0: "healthy", 1: "healthy"}
+    h.on_failure(0, boom)
+    assert h.state(0) == "suspect"
+    h.on_failure(0, boom)
+    assert h.state(0) == "ejected" and h.ejections == 1
+    assert h.available() == 1
+    assert not h.admit(0), "benched during cooldown"
+    assert h.retry_delay(0) == pytest.approx(0.5)
+    clk.t = 0.5
+    assert h.admit(0) and h.state(0) == "probing"
+    assert h.take_rewarm(0), "ejected -> probing flags a re-warm"
+    assert not h.take_rewarm(0), "flag is consumed atomically"
+    h.on_success(0)
+    assert h.state(0) == "healthy" and h.recoveries == 1
+    kinds = [k for k, _ in events]
+    assert kinds == ["replica_suspect", "replica_ejected", "replica_probe",
+                     "auto_recovery"]
+    eject = dict(events[1][1])
+    assert eject["replica"] == 0 and eject["failures"] == 2
+    assert eject["error"] == "ReplicaUnavailable"
+
+
+def test_last_replica_never_ejected():
+    clk = _Clock()
+    h = ReplicaHealth(2, HealthPolicy(eject_after=1), clock=clk)
+    boom = ReplicaUnavailable("boom")
+    h.on_failure(0, boom)
+    assert h.state(0) == "ejected"
+    for _ in range(20):
+        h.on_failure(1, boom)
+    assert h.state(1) == "suspect", \
+        "the last admissible replica must keep serving"
+    assert h.available() == 1 and h.ejections == 1
+    # once replica 0 recovers, replica 1 becomes ejectable again
+    clk.t = 10.0
+    assert h.admit(0)
+    h.on_success(0)
+    h.on_failure(1, boom)
+    assert h.state(1) == "ejected"
+
+
+# ---------------------------------------------------------------------------
+# engine-level protection over duck scorers (no jax in the hot path)
+# ---------------------------------------------------------------------------
+
+class _GateScorer:
+    """Duck scorer whose calls park on per-call events; ``n_replicas``
+    is claimed so the engine runs the multi-replica dispatch plane."""
+
+    metrics = None
+    name = "gate"
+    n_replicas = 1
+
+    def __init__(self, n_replicas=1):
+        self.n_replicas = n_replicas
+        self.calls = 0
+        self.release = threading.Event()
+        self.entered = threading.Event()
+        self.block_first = False
+        self._lock = threading.Lock()
+
+    def score(self, data, *, offset=None):
+        with self._lock:
+            self.calls += 1
+            mine = self.calls
+        self.entered.set()
+        if self.block_first and mine == 1:
+            assert self.release.wait(30)
+        return np.full(data.shape[0], float(mine))
+
+
+def test_deadline_sheds_expired_queued_work():
+    sc = _GateScorer()
+    sc.block_first = True
+    met = MetricsRegistry()
+    eng = AsyncEngine(sc, EnginePolicy(max_wait_ms=0), metrics=met,
+                      name="gate")
+    try:
+        plug = eng.submit(np.zeros((1, 2)))          # parks the replica
+        assert sc.entered.wait(10)
+        doomed = eng.submit(np.zeros((2, 2)), deadline=0.05)
+        keeper = eng.submit(np.zeros((1, 2)))        # no deadline
+        time.sleep(0.15)                             # deadline passes queued
+        sc.release.set()
+        assert keeper.result(10) is not None
+        with pytest.raises(DeadlineExceeded, match="shed before dispatch"):
+            doomed.result(10)
+        assert plug.result(10) is not None
+    finally:
+        sc.release.set()
+        eng.close()
+    assert sc.calls == 2, "the shed request must never reach the scorer"
+    assert met.snapshot()["counters"]["serve.gate.shed"] == 1
+    with pytest.raises(ValueError, match="deadline"):
+        eng2 = AsyncEngine(_GateScorer())
+        try:
+            eng2.submit(np.zeros((1, 2)), deadline=0.0)
+        finally:
+            eng2.close()
+
+
+def test_score_timeout_cancels_queued_request():
+    """Satellite 2: a timed-out blocking caller leaves no dead work —
+    the request is removed from the queue and never dispatched."""
+    sc = _GateScorer()
+    sc.block_first = True
+    met = MetricsRegistry()
+    eng = AsyncEngine(sc, EnginePolicy(max_wait_ms=0), metrics=met,
+                      name="gate")
+    try:
+        plug = eng.submit(np.zeros((1, 2)))
+        assert sc.entered.wait(10)
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExceeded, match="cancelled out of"):
+            eng.score(np.zeros((4, 2)), timeout=0.1)
+        assert time.perf_counter() - t0 < 5.0
+        sc.release.set()
+        assert plug.result(10) is not None
+        # a later request still flows (queue state stayed consistent)
+        assert eng.score(np.zeros((1, 2)), timeout=10) is not None
+    finally:
+        sc.release.set()
+        eng.close()
+    assert sc.calls == 2, "the cancelled request must never be dispatched"
+    assert met.snapshot()["counters"]["serve.gate.shed"] == 1
+
+
+def test_asubmit_timeout_cancels_queued_request():
+    sc = _GateScorer()
+    sc.block_first = True
+    eng = AsyncEngine(sc, EnginePolicy(max_wait_ms=0), name="gate")
+
+    async def _go():
+        plug = asyncio.ensure_future(eng.asubmit(np.zeros((1, 2))))
+        await asyncio.sleep(0)
+        assert sc.entered.wait(10)
+        with pytest.raises(DeadlineExceeded):
+            await eng.asubmit(np.zeros((2, 2)), timeout=0.1)
+        sc.release.set()
+        assert (await plug) is not None
+
+    try:
+        asyncio.run(_go())
+    finally:
+        sc.release.set()
+        eng.close()
+    assert sc.calls == 1
+
+
+def test_overloaded_carries_drain_rate_hint():
+    """Satellite 1: after the engine has measured throughput, an
+    overload rejection tells the caller WHEN to retry."""
+    sc = _GateScorer()
+    eng = AsyncEngine(sc, EnginePolicy(max_queue=2, max_wait_ms=0),
+                      name="gate")
+    try:
+        # establish a drain rate with served requests
+        for _ in range(3):
+            assert eng.score(np.zeros((8, 2)), timeout=10) is not None
+        sc.block_first = True
+        sc.calls = 0                      # re-arm: next call parks
+        sc.entered.clear()
+        plug = eng.submit(np.zeros((1, 2)))
+        assert sc.entered.wait(10)
+        held = [eng.submit(np.zeros((64, 2))) for _ in range(2)]
+        with pytest.raises(Overloaded) as ei:
+            eng.submit(np.zeros((1, 2)))
+        assert ei.value.retry_after_s is not None
+        assert 0 < ei.value.retry_after_s <= 60.0
+    finally:
+        sc.release.set()
+        eng.close()
+    for f in [plug] + held:
+        assert f.result(10) is not None
+    # without a measured rate the hint is honestly absent
+    assert Overloaded("x").retry_after_s is None
+
+
+def test_close_drains_queue_without_orphaning():
+    """Satellite 1: context-manager close serves (or typed-fails) every
+    admitted future — none left pending forever."""
+    sc = _GateScorer()
+    futs = []
+    with AsyncEngine(sc, EnginePolicy(max_wait_ms=0), name="gate") as eng:
+        futs = [eng.submit(np.zeros((2, 2))) for _ in range(6)]
+    for f in futs:
+        assert f.done(), "close() must settle every admitted future"
+        assert f.result() is not None
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(np.zeros((1, 2)))
+
+
+def test_hedged_dispatch_first_result_wins():
+    sc = _GateScorer(n_replicas=2)
+    sc.block_first = True                     # call 1 parks; call 2 fast
+    met = MetricsRegistry()
+    eng = AsyncEngine(sc, EnginePolicy(max_wait_ms=0), metrics=met,
+                      name="gate",
+                      health=HealthPolicy(hedge_after_s=0.05))
+    try:
+        f = eng.submit(np.zeros((3, 2)))
+        res = f.result(10)
+        # call 1 parks; the hedge (call 2) returns first and must win
+        np.testing.assert_array_equal(res, np.full(3, 2.0))
+        sc.release.set()                      # let the loser finish
+        time.sleep(0.1)
+    finally:
+        sc.release.set()
+        eng.close()
+    assert sc.calls == 2, "exactly one hedge was launched"
+    snap = met.snapshot()["counters"]
+    assert snap["serve.gate.hedges"] == 1
+    # the loser contributed no throughput bookkeeping (first-wins)
+    assert snap["serve.gate.requests_done"] == 1
+    assert snap["serve.gate.batches"] == 1
+
+
+def test_watchdog_abandons_hung_replica_and_redispatches():
+    sc = _GateScorer(n_replicas=2)
+    sc.block_first = True                     # call 1 hangs past watchdog
+    met = MetricsRegistry()
+    ring = RingBufferSink(256)
+    tracer = FitTracer([ring])
+    eng = AsyncEngine(sc, EnginePolicy(max_wait_ms=0), metrics=met,
+                      name="gate",
+                      health=HealthPolicy(call_timeout_s=0.2))
+    try:
+        from sparkglm_tpu.obs.trace import ambient
+        with ambient(tracer):
+            f = eng.submit(np.zeros((2, 2)))
+            res = f.result(10)
+        np.testing.assert_array_equal(res, np.full(2, 2.0))
+        # which replica drew the hung first call depends on scheduler
+        # queue order; exactly one of them must now be suspect
+        states = sorted(eng.health.states().values())
+        assert states == ["healthy", "suspect"]
+    finally:
+        sc.release.set()
+        eng.close()
+    assert met.snapshot()["counters"]["serve.gate.redispatches"] == 1
+    kinds = [e.kind for e in ring.events]
+    assert "replica_hung" in kinds and "redispatch" in kinds
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: kill one of two replicas under load (real scorer, real jax)
+# ---------------------------------------------------------------------------
+
+def _gaussian_family(rng, name):
+    groups, Xr, yr = [], [], []
+    for g in range(3):
+        n = 120
+        X = np.column_stack([np.ones(n), rng.normal(size=(n, P - 1))])
+        beta = rng.normal(size=P) * (0.5 + 0.3 * g)
+        groups += [f"g{g}"] * n
+        Xr.append(X)
+        yr.append(X @ beta + 0.05 * rng.normal(size=n))
+    fleet = fit_many(np.concatenate(yr), np.vstack(Xr),
+                     groups=np.array(groups), family="gaussian",
+                     has_intercept=True)
+    return fleet, ModelFamily.from_fleet(fleet, name)
+
+
+def _serve_all(eng, X, tenants, n):
+    futs = [eng.submit(X, tenant=tenants[i % len(tenants)])
+            for i in range(n)]
+    return [f.result(30) for f in futs]
+
+
+def test_kill_one_replica_loses_nothing_bit_identical(rng, tmp_path):
+    """The tentpole acceptance: one of two replicas dies mid-load —
+    zero in-flight requests fail, the survivor's results are f64
+    bit-identical to a healthy run, ejection triggers a flight record,
+    and NOTHING recompiles across ejection/probing/re-warm."""
+    fleet, fam = _gaussian_family(rng, "chaos")
+    tenants = ("g0", "g1", "g2")
+    X = np.column_stack([np.ones(4), rng.normal(size=(4, P - 1))])
+    devices = jax.devices()[:2]
+    mk = dict(type="response", devices=devices, min_bucket=8)
+    pol = EnginePolicy(max_batch=64, max_wait_ms=1)
+
+    # healthy oracle run
+    rsc_h = fam.replicated_scorer(**mk)
+    rsc_h.warmup(buckets=(8, 16, 32, 64))
+    with AsyncEngine(rsc_h, pol, name="healthy") as eng:
+        healthy = _serve_all(eng, X, tenants, 60)
+
+    # chaos run: replica 0 dead from its first dispatch
+    plan = FaultPlan(seed=7, replica_dead_from=((0, 0),))
+    tel = obs.Telemetry(str(tmp_path), slos=[])
+    rsc = fam.replicated_scorer(**mk)
+    assert rsc is rsc_h, "family caches the scorer per options"
+    base = family_score_cache_size()
+    with AsyncEngine(rsc, pol, name="chaos",
+                     telemetry=tel, fault_plan=plan,
+                     health=HealthPolicy(eject_after=2,
+                                         probe_cooldown_s=0.2)) as eng:
+        wounded = _serve_all(eng, X, tenants, 60)
+        states = eng.health.states()
+        ejections = eng.health.ejections
+    tel.close()
+
+    # zero lost requests, bit-identical to the healthy run
+    assert len(wounded) == 60
+    for a, b in zip(healthy, wounded):
+        assert np.asarray(a).dtype == np.float64
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), \
+            "R-1 serving must be bit-identical"
+    # replica 0 may sit in "probing" if a cooldown elapsed right at the
+    # end (the probe would fail again) — never admissible-and-healthy
+    assert ejections >= 1 and states[0] in ("ejected", "probing")
+    assert states[1] == "healthy", "the survivor stays admissible"
+    # recovery/ejection never compiles: warmup prepaid every bucket
+    assert rsc.compiles == 0
+    assert family_score_cache_size() - base == 0
+    # the observability plane saw the episode
+    rep = tel.report()["serving"]
+    assert rep["replica_ejections"] >= 1
+    assert rep["redispatches"] >= 1
+    assert rep["requests"] == 60, "every chaos request completed a span"
+    assert any("replica_ejected" in r for r in tel.flight_records), \
+        "an ejection must dump a flight record"
+
+
+def test_transient_replica_recovers_rewarmed_zero_compiles(rng):
+    """Ejection -> cooldown -> deterministic probe -> re-warm ->
+    auto_recovery, with the kernel cache untouched end to end."""
+    fleet, fam = _gaussian_family(rng, "recov")
+    X = np.column_stack([np.ones(4), rng.normal(size=(4, P - 1))])
+    devices = jax.devices()[:2]
+    rsc = fam.replicated_scorer(type="response", devices=devices,
+                                min_bucket=8)
+    rsc.warmup(buckets=(8, 16, 32, 64))
+    # two injected failures on replica 0, healthy afterwards
+    plan = FaultPlan(seed=3, replica_error_at=((0, 0), (0, 1)))
+    ring = RingBufferSink(2048)
+    tracer = FitTracer([ring])
+    from sparkglm_tpu.obs.trace import ambient
+    base = family_score_cache_size()
+    with AsyncEngine(rsc, EnginePolicy(max_batch=64, max_wait_ms=1),
+                     name="recov", fault_plan=plan,
+                     health=HealthPolicy(eject_after=2,
+                                         probe_cooldown_s=0.1)) as eng:
+        with ambient(tracer):
+            _serve_all(eng, X, ("g0", "g1", "g2"), 20)
+            deadline = time.perf_counter() + 20
+            while (eng.health.recoveries == 0
+                   and time.perf_counter() < deadline):
+                _serve_all(eng, X, ("g0", "g1", "g2"), 6)
+                time.sleep(0.05)
+        assert eng.health.recoveries >= 1
+        assert eng.health.state(0) == "healthy"
+    kinds = [e.kind for e in ring.events]
+    assert "replica_ejected" in kinds
+    assert "replica_probe" in kinds
+    assert "replica_rewarm" in kinds
+    assert "auto_recovery" in kinds
+    assert kinds.index("replica_rewarm") > kinds.index("replica_probe")
+    rewarm = next(e for e in ring.events if e.kind == "replica_rewarm")
+    assert rewarm.fields["compiles"] == 0, "re-warm must be prepaid"
+    assert rsc.compiles == 0
+    assert family_score_cache_size() - base == 0
+
+
+# ---------------------------------------------------------------------------
+# fault plan: serving-time kinds
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_serving_schedules_are_seeded_and_typed():
+    plan = FaultPlan(seed=1, replica_error_at=((0, 1),),
+                     replica_dead_from=((1, 2),), replica_slow_at=((0, 2),),
+                     slow_s=0.01)
+    plan.on_dispatch(0)                       # (0, 0): clean
+    with pytest.raises(ReplicaUnavailable, match="replica 0, dispatch 1"):
+        plan.on_dispatch(0)                   # (0, 1): injected error
+    t0 = time.perf_counter()
+    plan.on_dispatch(0)                       # (0, 2): slow straggler
+    assert time.perf_counter() - t0 >= 0.01
+    plan.on_dispatch(0)                       # errors fire once
+    plan.on_dispatch(1)
+    plan.on_dispatch(1)                       # (1, 0..1): clean
+    for _ in range(3):                        # (1, 2...): dead forever
+        with pytest.raises(ReplicaUnavailable, match="dead"):
+            plan.on_dispatch(1)
+    plan.on_online_chunk(5)                   # empty kill schedule: no-op
+
+
+def test_run_forwards_fault_plan_to_chunk_boundaries(rng):
+    class _Recorder:
+        calls = ()
+
+        def __init__(self):
+            self.calls = []
+
+        def on_online_chunk(self, idx):
+            self.calls.append(idx)
+
+    loop = _tiny_loop(rng)
+    chunks = [_tiny_chunk(rng, s) for s in range(3)]
+    plan = _Recorder()
+    loop.run(lambda: iter(chunks), fault_plan=plan)
+    assert plan.calls == [1, 2, 3], "absolute chunk ordinals, pre-apply"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ejection/recovery triggers (satellite 3)
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_triggers_on_ejection_and_recovery(tmp_path):
+    rec = FlightRecorder(str(tmp_path), capacity=16, cooldown_s=0.0)
+    tr = FitTracer([rec])
+    tr.emit("batch", rows=4)
+    tr.emit("replica_ejected", replica=0, failures=3,
+            error="ReplicaUnavailable")
+    assert len(rec.records) == 1 and "replica_ejected" in rec.records[0]
+    tr.emit("auto_recovery", replica=0, probes=1)
+    assert len(rec.records) == 2 and "auto_recovery" in rec.records[1]
+    body = [json.loads(ln)
+            for ln in open(rec.records[0]).read().splitlines()[1:]]
+    assert [e["kind"] for e in body] == ["batch", "replica_ejected"], \
+        "the ring holds the dispatches that burned the breaker"
+
+
+def test_flight_recorder_one_record_per_ejection_episode(tmp_path):
+    """Cooldown semantics match slo_violation: an ejection storm dumps
+    once per kind per window, not once per event."""
+    rec = FlightRecorder(str(tmp_path), capacity=8, cooldown_s=1e6)
+    tr = FitTracer([rec])
+    tr.emit("replica_ejected", replica=0)
+    tr.emit("replica_ejected", replica=1)     # inside cooldown: suppressed
+    assert len(rec.records) == 1
+    tr.emit("auto_recovery", replica=0)       # different kind: dumps
+    assert len(rec.records) == 2
+    tr.emit("auto_recovery", replica=1)
+    assert len(rec.records) == 2
+
+
+# ---------------------------------------------------------------------------
+# crash-durable online learning: the write-ahead journal
+# ---------------------------------------------------------------------------
+
+def _tiny_labels():
+    return tuple(f"t{i:02d}" for i in range(4))
+
+
+def _tiny_beta():
+    return np.random.default_rng(11).normal(size=(4, P))
+
+
+def _tiny_chunk(rng_or_seed, s, shift=0.0):
+    r = np.random.default_rng(1000 + s)
+    labels, beta = _tiny_labels(), _tiny_beta()
+    ten, Xs, ys = [], [], []
+    for k, t in enumerate(labels):
+        X = r.normal(size=(12, P))
+        ten.extend([t] * 12)
+        Xs.append(X)
+        ys.append(X @ (beta[k] + shift) + 0.05 * r.normal(size=12))
+    return np.array(ten), np.concatenate(Xs), np.concatenate(ys)
+
+
+def _tiny_loop(rng, journal=None, **kw):
+    labels, beta = _tiny_labels(), _tiny_beta()
+    r = np.random.default_rng(0)
+    X = r.normal(size=(4, 48, P))
+    y = np.stack([X[k] @ beta[k] + 0.05 * r.normal(size=48)
+                  for k in range(4)])
+    from sparkglm_tpu.fleet import glm_fit_fleet
+    fleet = glm_fit_fleet(X, y, family="gaussian", link="identity",
+                          labels=labels)
+    fam = ModelFamily.from_fleet(fleet, "j")
+    return OnlineLoop(fam, rho=0.9, window_rows=24, journal=journal, **kw)
+
+
+def _loop_fingerprint(loop):
+    t, B = loop.family.deployed_matrix()
+    versions = {x: loop.family.deployed_version(x) for x in t}
+    return dict(
+        chunks=loop._chunks,
+        suffstats=loop.suffstats.digest(),
+        rings=[getattr(loop, a).tobytes().hex()[:32]
+               for a in ("_Xw", "_yw", "_ww", "_ow", "_pos")],
+        gate=json.dumps(loop.gate._export(), sort_keys=True),
+        watch=json.dumps(loop._watch, sort_keys=True),
+        deployed=B.tobytes().hex()[:64], versions=versions)
+
+
+def test_journal_write_ahead_then_snapshot_prunes(rng, tmp_path):
+    d = str(tmp_path / "j")
+    loop = _tiny_loop(rng, journal=OnlineJournal(d, snapshot_every=3))
+    # attach wrote the base snapshot before any chunk
+    assert loop.journal.latest_snapshot()[0] == 0
+    for s in range(4):
+        loop.step(*_tiny_chunk(rng, s, shift=0.2 * s))
+    files = sorted(os.listdir(d))
+    # snapshot at chunk 3 pruned records 1..3 and the chunk-0 snapshot;
+    # chunk 4's write-ahead record survives
+    assert files == ["chunk-000004.npz", "snapshot-000003.npz"]
+    ten, X, y, w, off = OnlineJournal.load_record(
+        os.path.join(d, "chunk-000004.npz"))
+    assert X.shape == (48, P) and w.shape == (48,) and len(ten) == 48
+    rep = loop.report()["online"]
+    assert rep["journal_appends"] == 4
+    assert rep["journal_snapshots"] == 2      # attach + chunk 3
+
+
+def test_journal_resume_is_bit_identical_to_uninterrupted(rng, tmp_path):
+    chunks = [_tiny_chunk(rng, s, shift=0.15 * s) for s in range(9)]
+    healthy = _tiny_loop(rng)
+    for c in chunks:
+        healthy.step(*c)
+
+    d = str(tmp_path / "j")
+    doomed = _tiny_loop(rng, journal=OnlineJournal(d, snapshot_every=4))
+    for c in chunks[:6]:
+        doomed.step(*c)
+    del doomed                               # "crash" between chunks 6 and 7
+
+    resumed = OnlineLoop.resume(OnlineJournal(d, snapshot_every=4))
+    assert resumed._chunks == 6, "resume lands at the exact chunk boundary"
+    for c in chunks[6:]:
+        resumed.step(*c)
+    assert _loop_fingerprint(resumed) == _loop_fingerprint(healthy), \
+        "post-crash resume must be bit-identical (suffstats, rings, " \
+        "gate, watches, deploy decisions)"
+
+
+def test_journal_resume_without_snapshot_is_typed(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no snapshot"):
+        OnlineLoop.resume(str(tmp_path / "empty"))
+
+
+_KILL_SCRIPT = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from sparkglm_tpu.fleet import glm_fit_fleet
+from sparkglm_tpu.serve import ModelFamily
+from sparkglm_tpu.online import OnlineJournal, OnlineLoop
+from sparkglm_tpu.robust import FaultPlan
+
+P = 3
+labels = tuple(f"t{i:02d}" for i in range(4))
+beta = np.random.default_rng(11).normal(size=(4, P))
+
+def chunk(s):
+    r = np.random.default_rng(1000 + s)
+    ten, Xs, ys = [], [], []
+    for k, t in enumerate(labels):
+        X = r.normal(size=(12, P))
+        ten.extend([t] * 12)
+        Xs.append(X)
+        ys.append(X @ (beta[k] + 0.15 * s) + 0.05 * r.normal(size=12))
+    return np.array(ten), np.concatenate(Xs), np.concatenate(ys)
+
+def seed_loop(journal=None):
+    r = np.random.default_rng(0)
+    X = r.normal(size=(4, 48, P))
+    y = np.stack([X[k] @ beta[k] + 0.05 * r.normal(size=48)
+                  for k in range(4)])
+    fleet = glm_fit_fleet(X, y, family="gaussian", link="identity",
+                          labels=labels)
+    return OnlineLoop(ModelFamily.from_fleet(fleet, "j"), rho=0.9,
+                      window_rows=24, journal=journal)
+
+def fingerprint(loop):
+    t, B = loop.family.deployed_matrix()
+    return dict(chunks=loop._chunks, suffstats=loop.suffstats.digest(),
+                deployed=B.tobytes().hex(),
+                versions={x: loop.family.deployed_version(x) for x in t},
+                gate=json.dumps(loop.gate._export(), sort_keys=True))
+
+mode, jdir, out = sys.argv[1], sys.argv[2], sys.argv[3]
+N = 8
+chunks = [chunk(s) for s in range(N)]
+if mode == "healthy":
+    loop = seed_loop()
+    for c in chunks:
+        loop.step(*c)
+elif mode == "killed":
+    loop = seed_loop(journal=OnlineJournal(jdir, snapshot_every=3))
+    # SIGKILL fires at the chunk-5 boundary, BEFORE chunk 5 applies
+    loop.run(lambda: iter(chunks), fault_plan=FaultPlan(
+        seed=0, kill_chunk_at=(5,)))
+    raise SystemExit("unreachable: the kill must fire")
+elif mode == "resume":
+    loop = OnlineLoop.resume(OnlineJournal(jdir, snapshot_every=3))
+    assert loop._chunks == 4, f"expected chunk boundary 4, got {loop._chunks}"
+    for c in chunks[loop._chunks:]:
+        loop.step(*c)
+else:
+    raise SystemExit(f"bad mode {mode}")
+with open(out, "w") as f:
+    json.dump(fingerprint(loop), f, sort_keys=True)
+"""
+
+
+def test_online_loop_survives_sigkill_bit_identical(tmp_path):
+    """The ISSUE's kill test, with a REAL ``SIGKILL``: journal a run,
+    kill -9 the process between chunks, resume in a fresh process, and
+    reproduce the healthy run's statistics and deploy decisions
+    bit-for-bit."""
+    script = tmp_path / "kill_child.py"
+    script.write_text(_KILL_SCRIPT)
+    jdir = str(tmp_path / "journal")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run(mode, out):
+        return subprocess.run(
+            [sys.executable, str(script), mode, jdir, str(out)],
+            env=env, capture_output=True, text=True, timeout=300)
+
+    h = run("healthy", tmp_path / "healthy.json")
+    assert h.returncode == 0, h.stderr[-2000:]
+
+    k = run("killed", tmp_path / "killed.json")
+    assert k.returncode == -signal.SIGKILL, \
+        f"expected SIGKILL, got rc={k.returncode}: {k.stderr[-2000:]}"
+    assert not (tmp_path / "killed.json").exists()
+    assert any(f.startswith("snapshot-") for f in os.listdir(jdir))
+
+    r = run("resume", tmp_path / "resumed.json")
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    healthy = json.loads((tmp_path / "healthy.json").read_text())
+    resumed = json.loads((tmp_path / "resumed.json").read_text())
+    assert resumed == healthy, \
+        "resume after SIGKILL must reproduce the healthy run bit-for-bit"
