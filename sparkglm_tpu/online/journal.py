@@ -36,7 +36,10 @@ The WAL ordering ("journal, then apply") means a kill at ANY point —
 mid-append, between append and apply, mid-apply, mid-snapshot — loses
 nothing: a torn append never becomes a file, an applied-but-unsnapshot
 chunk is replayed from its record, a torn snapshot leaves the previous
-snapshot + records covering the gap.
+snapshot + records covering the gap.  The converse invariant also holds:
+a chunk ``step()`` REJECTS before mutating state (bad shapes, unknown
+tenant) has its record withdrawn (:meth:`OnlineJournal.withdraw`), so
+resume never replays input the live run refused.
 """
 
 from __future__ import annotations
@@ -81,6 +84,7 @@ class OnlineJournal:
         os.makedirs(self.directory, exist_ok=True)
         self.appends = 0
         self.snapshots = 0
+        self.withdrawals = 0
 
     # -- paths ---------------------------------------------------------------
 
@@ -127,6 +131,15 @@ class OnlineJournal:
                               tenants=tn, X=X, y=y, w=w, off=off)
         self.appends += 1
         return nbytes
+
+    def withdraw(self, chunk: int) -> None:
+        """Remove the record of a chunk that was journaled but never
+        applied (``step`` rejected its input before any state mutated),
+        restoring the WAL invariant that a surviving record is always
+        input the live run absorbed — resume must never replay a chunk
+        the healthy run refused."""
+        self._unlink(self._rec_path(int(chunk)))
+        self.withdrawals += 1
 
     @staticmethod
     def load_record(path) -> tuple:

@@ -209,17 +209,28 @@ class OnlineLoop:
         ctx = _obs_context.TraceContext(
             trace=f"cycle-{self._chunks + 1:06d}", span="cycle")
         with _obs_trace.ambient(self.tracer), _obs_context.use(ctx):
+            chunk = self._chunks + 1
             if self.journal is not None:
                 # write-ahead: the chunk's raw input is durable BEFORE
                 # any state mutates, so a kill mid-apply replays it
                 nbytes = self.journal.append(
-                    self._chunks + 1, tenants, X, y, weights, offset)
-                self.tracer.emit("journal_append",
-                                 chunk=self._chunks + 1,
+                    chunk, tenants, X, y, weights, offset)
+                self.tracer.emit("journal_append", chunk=chunk,
                                  rows=int(np.asarray(X).shape[0]),
                                  nbytes=int(nbytes))
-            out = self._step(tenants, X, y, weights=weights,
-                             offset=offset)
+            try:
+                out = self._step(tenants, X, y, weights=weights,
+                                 offset=offset)
+            except BaseException:
+                # _step rejected the chunk before any state mutated
+                # (bad shapes, unknown tenant): withdraw its record so
+                # resume() never replays input the live run refused.  If
+                # the chunk counter DID advance the record stays —
+                # replaying it from the last snapshot reconstructs the
+                # fully-applied state a torn in-memory apply cannot.
+                if self.journal is not None and self._chunks < chunk:
+                    self.journal.withdraw(chunk)
+                raise
             if (self.journal is not None
                     and self._chunks % self.journal.snapshot_every == 0):
                 self._snapshot()

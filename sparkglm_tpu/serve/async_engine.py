@@ -612,18 +612,27 @@ class AsyncEngine:
         self._shed = 0                # deadline-shed requests, under _lock
         self._has_deadlines = False   # any queued req with deadline (lock)
         self._abandoned = 0           # hung replica calls left running
-        self._abandoned_calls = set()  # their asyncio futures (loop thread)
+        # future -> replica index to recycle when the hung call returns,
+        # or None if the index was recycled at abandonment (loop thread)
+        self._abandoned_calls: dict = {}
+        self._abandoned_recycled = 0  # calls with a recycled index (loop)
         self._hedges = 0              # loop-thread only
         self._redispatches = 0        # loop-thread only
         self._fault_plan = fault_plan
         self.health = ReplicaHealth(self.n_replicas, health,
                                     emit=self._emit)
-        # +2 slack workers: a watchdog-abandoned (hung) call keeps its
+        # Slack workers: a watchdog-abandoned (hung) call keeps its
         # worker until it returns; slack lets the recycled replica index
         # take new work meanwhile.  Concurrency per replica is still 1 in
         # the steady state — each index circulates once through _free.
+        # At most _abandon_slack abandoned calls get their index recycled
+        # immediately; past that bound the hung call HOLDS its index
+        # until it returns (released in _call), so occupied workers never
+        # exceed n_replicas + slack and re-dispatches never queue behind
+        # hung workers.
+        self._abandon_slack = self.n_replicas + 2
         self._pool = ThreadPoolExecutor(
-            max_workers=self.n_replicas + 2,
+            max_workers=self.n_replicas + self._abandon_slack,
             thread_name_prefix=f"serve-replica:{self.name}")
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()
@@ -1001,21 +1010,33 @@ class AsyncEngine:
         """Blocking acquisition for re-dispatch after a replica failure:
         wait for an admissible replica this batch has NOT been tried on.
         Returns None when no such replica can exist (every replica
-        tried).  Skipped replicas are held out of circulation only while
-        we wait and always returned."""
+        tried).  Already-tried replicas are held out of circulation only
+        while we wait and always returned; an untried replica that fails
+        admission (mid-cooldown) is re-offered by timer exactly as
+        :meth:`_acquire` does — holding it here would leave the free
+        queue empty with no pending wakeup and deadlock this wait."""
         if len(set(tried)) >= self.n_replicas:
             return None
+
+        def bench(r):
+            self._loop.call_later(max(self.health.retry_delay(r), 1e-3),
+                                  self._free.put_nowait, r)
+
         held = []
         try:
             while True:
                 got, skipped = self._drain_free(exclude=tried)
-                held.extend(skipped)
+                for s in skipped:
+                    (held.append if s in tried else bench)(s)
                 if got is not None:
                     return got
                 r = await self._free.get()
-                if r not in tried and self.health.admit(r):
+                if r in tried:
+                    held.append(r)
+                elif self.health.admit(r):
                     return r
-                held.append(r)
+                else:
+                    bench(r)
         finally:
             for s in held:
                 self._free.put_nowait(s)
@@ -1034,9 +1055,12 @@ class AsyncEngine:
             except BaseException:
                 pass
             if f in self._abandoned_calls:
-                self._abandoned_calls.discard(f)
+                rep = self._abandoned_calls.pop(f)
                 self._abandoned -= 1    # the hung call finally returned
-                return
+                if rep is None:         # index was recycled at abandonment
+                    self._abandoned_recycled -= 1
+                    return
+                # index was held past the abandonment bound — release now
             self._free.put_nowait(replica)
             self._wake.set()
 
@@ -1060,6 +1084,7 @@ class AsyncEngine:
         pol = self.health.policy
         loop = asyncio.get_running_loop()
         calls: dict = {}
+        deadlines: dict = {}   # per-CALL watchdog: launch + call_timeout_s
         tried: list = []
         attempts = 0
         last_exc = None
@@ -1068,21 +1093,35 @@ class AsyncEngine:
             nonlocal attempts
             attempts += 1
             tried.append(r)
-            calls[self._call(loop, r, payload)] = r
+            f = self._call(loop, r, payload)
+            calls[f] = r
+            if pol.call_timeout_s is not None:
+                deadlines[f] = loop.time() + pol.call_timeout_s
+
+        def redispatch(error):
+            self._redispatches += 1
+            if self.metrics is not None:
+                self.metrics.counter(
+                    f"serve.{self.name}.redispatches").inc()
+            f = dict(engine=self.name, replica=int(nxt),
+                     failed_replica=int(tried[-1]), error=error,
+                     rows=sum(r.n for r in batch))
+            if batch_id is not None:
+                f["batch"] = batch_id
+            self._emit("redispatch", **f)
+            launch(nxt)
 
         launch(replica)
         start = loop.time()
         hedged = False
-        watchdog = (start + pol.call_timeout_s
-                    if pol.call_timeout_s is not None else None)
         while calls:
             timeout = None
             if (not hedged and pol.hedge_after_s is not None
                     and attempts < pol.max_attempts
                     and self.n_replicas > 1):
                 timeout = max(0.0, start + pol.hedge_after_s - loop.time())
-            if watchdog is not None:
-                rem = max(0.0, watchdog - loop.time())
+            if deadlines:
+                rem = max(0.0, min(deadlines.values()) - loop.time())
                 timeout = rem if timeout is None else min(timeout, rem)
             done, _ = await asyncio.wait(
                 set(calls), timeout=timeout,
@@ -1091,6 +1130,7 @@ class AsyncEngine:
                 success = False
                 for f in done:
                     rep = calls.pop(f)
+                    deadlines.pop(f, None)
                     exc = f.exception()
                     if exc is None:
                         self.health.on_success(rep)
@@ -1105,60 +1145,49 @@ class AsyncEngine:
                 if attempts < pol.max_attempts:
                     nxt = await self._acquire_retry(tried)
                     if nxt is not None:
-                        self._redispatches += 1
-                        if self.metrics is not None:
-                            self.metrics.counter(
-                                f"serve.{self.name}.redispatches").inc()
-                        f = dict(engine=self.name, replica=int(nxt),
-                                 failed_replica=int(tried[-1]),
-                                 error=type(last_exc).__name__,
-                                 rows=sum(r.n for r in batch))
-                        if batch_id is not None:
-                            f["batch"] = batch_id
-                        self._emit("redispatch", **f)
-                        if watchdog is not None:
-                            watchdog = loop.time() + pol.call_timeout_s
-                        launch(nxt)
+                        redispatch(type(last_exc).__name__)
                         continue
                 self._fail_batch(batch, last_exc, batch_id, tried[-1])
                 return
             now = loop.time()
-            if watchdog is not None and now >= watchdog:
-                # every pending call is hung: abandon it (the worker keeps
-                # running; its late result is discarded by first-wins and
-                # its replica index was already recycled)
-                for f, rep in list(calls.items()):
+            expired = [f for f, dl in deadlines.items() if now >= dl]
+            if expired:
+                # each call is judged against ITS OWN deadline — a hedge
+                # launched at start+hedge_after_s gets a full
+                # call_timeout_s of runtime, not the primary's leftovers.
+                # Abandon the hung call (the worker keeps running; its
+                # late result is discarded by first-wins).  Its replica
+                # index is recycled immediately while no more than
+                # _abandon_slack abandoned calls are running — past that
+                # the index stays held until the call returns, so new
+                # dispatches cannot queue behind hung workers.
+                for f in expired:
+                    rep = calls.pop(f)
+                    del deadlines[f]
                     exc = ReplicaUnavailable(
                         f"replica {rep} of {self.name!r} exceeded the "
                         f"{pol.call_timeout_s}s watchdog deadline")
                     last_exc = exc
                     self.health.on_failure(rep, exc)
-                    self._abandoned_calls.add(f)
+                    recycle = self._abandoned_recycled < self._abandon_slack
+                    self._abandoned_calls[f] = None if recycle else rep
                     self._abandoned += 1
-                    self._free.put_nowait(rep)
-                    self._wake.set()
+                    if recycle:
+                        self._abandoned_recycled += 1
+                        self._free.put_nowait(rep)
+                        self._wake.set()
                     fl = dict(engine=self.name, replica=int(rep),
-                              deadline_s=pol.call_timeout_s)
+                              deadline_s=pol.call_timeout_s,
+                              index_held=not recycle)
                     if batch_id is not None:
                         fl["batch"] = batch_id
                     self._emit("replica_hung", **fl)
-                calls.clear()
+                if calls:
+                    continue  # a hedge with a later deadline may still win
                 if attempts < pol.max_attempts:
                     nxt = await self._acquire_retry(tried)
                     if nxt is not None:
-                        self._redispatches += 1
-                        if self.metrics is not None:
-                            self.metrics.counter(
-                                f"serve.{self.name}.redispatches").inc()
-                        f = dict(engine=self.name, replica=int(nxt),
-                                 failed_replica=int(tried[-1]),
-                                 error="watchdog_timeout",
-                                 rows=sum(r.n for r in batch))
-                        if batch_id is not None:
-                            f["batch"] = batch_id
-                        self._emit("redispatch", **f)
-                        watchdog = loop.time() + pol.call_timeout_s
-                        launch(nxt)
+                        redispatch("watchdog_timeout")
                         continue
                 self._fail_batch(batch, last_exc, batch_id, tried[-1])
                 return

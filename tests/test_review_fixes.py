@@ -148,3 +148,182 @@ def test_null_deviance_with_offset(mesh1, rng):
     # oracle: intercept-only fit with the offset
     _, null_dev_ref, _, _ = irls_np(np.ones((n, 1)), y, "poisson", "log", offset=off)
     np.testing.assert_allclose(m.null_deviance, null_dev_ref, rtol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# r15 review findings: serving-plane dispatch protection + WAL ordering
+# ---------------------------------------------------------------------------
+
+import asyncio
+import threading
+import time
+
+from sparkglm_tpu.obs.metrics import MetricsRegistry
+from sparkglm_tpu.online import OnlineJournal, OnlineLoop
+from sparkglm_tpu.robust import ReplicaUnavailable
+from sparkglm_tpu.serve import AsyncEngine, EnginePolicy, HealthPolicy
+
+
+class _ParkScorer:
+    """Duck scorer: calls in ``park`` (by call number) block on the
+    shared release event; calls in ``slow`` sleep ``slow_s`` first."""
+
+    metrics = None
+    name = "park"
+
+    def __init__(self, n_replicas=2, park=(), slow=(), slow_s=0.0):
+        self.n_replicas = n_replicas
+        self.park = set(park)
+        self.slow = set(slow)
+        self.slow_s = slow_s
+        self.calls = 0
+        self.release = threading.Event()
+        self._lock = threading.Lock()
+
+    def score(self, data, *, offset=None):
+        with self._lock:
+            self.calls += 1
+            mine = self.calls
+        if mine in self.park:
+            assert self.release.wait(30)
+        elif mine in self.slow:
+            time.sleep(self.slow_s)
+        return np.full(data.shape[0], float(mine))
+
+
+def test_acquire_retry_reoffers_mid_cooldown_replica():
+    """Review high: _acquire_retry must not hold an untried mid-cooldown
+    replica forever — it is re-offered by timer, so a re-dispatch whose
+    only untried replica is ejected waits out the cooldown and probes it
+    instead of deadlocking the scheduler."""
+    sc = _ParkScorer(n_replicas=2)
+    eng = AsyncEngine(sc, EnginePolicy(max_wait_ms=0), name="park",
+                      health=HealthPolicy(eject_after=1,
+                                          probe_cooldown_s=0.3))
+    try:
+        # eject replica 0 (replica 1 healthy, so the breaker may open)
+        eng.health.on_failure(0, RuntimeError("boom"))
+        assert eng.health.state(0) == "ejected"
+
+        async def drive():
+            # simulate the moment right after replica 1 failed a batch:
+            # the free queue holds only the ejected replica 0
+            while True:
+                try:
+                    eng._free.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+            eng._free.put_nowait(0)
+            return await eng._acquire_retry([1])
+
+        t0 = time.perf_counter()
+        got = asyncio.run_coroutine_threadsafe(drive(), eng._loop).result(10)
+        waited = time.perf_counter() - t0
+        assert got == 0, "the probing replica must be acquired"
+        assert waited < 5.0
+        assert eng.health.state(0) == "probing"
+
+        async def restore():
+            eng._free.put_nowait(0)
+            eng._free.put_nowait(1)
+
+        asyncio.run_coroutine_threadsafe(restore(), eng._loop).result(10)
+    finally:
+        eng.close()
+
+
+def test_hedge_gets_its_own_watchdog_deadline():
+    """Review medium: a hedge launched at start+hedge_after_s gets a
+    full call_timeout_s of runtime — it is not abandoned at the
+    PRIMARY's deadline, and a slow-but-healthy hedge replica is not
+    charged a spurious watchdog failure."""
+    sc = _ParkScorer(n_replicas=2, park={1}, slow={2}, slow_s=1.0)
+    met = MetricsRegistry()
+    eng = AsyncEngine(sc, EnginePolicy(max_wait_ms=0), metrics=met,
+                      name="park",
+                      health=HealthPolicy(call_timeout_s=1.2,
+                                          hedge_after_s=0.3))
+    try:
+        f = eng.submit(np.zeros((2, 2)))
+        # primary (call 1) hangs; hedge (call 2) runs 1.0s — past the
+        # primary's deadline-anchored leftover (1.2 - 0.3 = 0.9s) but
+        # inside its own 1.2s budget, so it must win
+        res = f.result(10)
+        np.testing.assert_array_equal(res, np.full(2, 2.0))
+        states = sorted(eng.health.states().values())
+        assert states == ["healthy", "suspect"], \
+            "only the hung primary is charged a watchdog failure"
+    finally:
+        sc.release.set()
+        eng.close()
+    snap = met.snapshot()["counters"]
+    assert snap["serve.park.hedges"] == 1
+    assert snap.get("serve.park.redispatches", 0) == 0
+    assert sc.calls == 2
+
+
+def test_abandoned_calls_beyond_slack_hold_their_index():
+    """Review low: the worker pool has n_replicas + slack workers; once
+    ``slack`` abandoned calls are running, the next abandonment HOLDS
+    its replica index until the hung call returns, so dispatches queue
+    on the index (visible, bounded) instead of on an exhausted pool."""
+    sc = _ParkScorer(n_replicas=1, park={1, 2, 3, 4})
+    eng = AsyncEngine(sc, EnginePolicy(max_wait_ms=0), name="park",
+                      health=HealthPolicy(call_timeout_s=0.15,
+                                          eject_after=100))
+    assert eng._abandon_slack == 3
+    assert eng._pool._max_workers == 4
+    doomed = []
+    try:
+        # sequential: each request hangs alone (no batching) and is
+        # abandoned before the next is admitted
+        for k in range(1, 5):
+            doomed.append(eng.submit(np.zeros((1, 2))))
+            deadline = time.time() + 20
+            while eng._abandoned < k and time.time() < deadline:
+                time.sleep(0.02)
+        assert eng._abandoned == 4
+        assert eng._abandoned_recycled == 3, \
+            "the 4th abandonment is past the slack bound"
+        for f in doomed:
+            with pytest.raises(ReplicaUnavailable):
+                f.result(10)
+        # the single replica index is held by the 4th hung call: new
+        # work stays queued rather than dispatching into a full pool
+        late = eng.submit(np.zeros((1, 2)))
+        time.sleep(0.3)
+        assert not late.done()
+        sc.release.set()                  # hung calls return, index freed
+        np.testing.assert_array_equal(late.result(10), np.full(1, 5.0))
+        deadline = time.time() + 10
+        while eng._abandoned > 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert eng._abandoned == 0 and eng._abandoned_recycled == 0
+    finally:
+        sc.release.set()
+        eng.close()
+
+
+def test_journal_withdraws_record_for_rejected_chunk(rng, tmp_path):
+    """Review low: a chunk step() rejects before mutating state must not
+    leave a WAL record — resume would replay input the live run never
+    absorbed."""
+    from test_selfheal import _tiny_chunk, _tiny_loop
+
+    d = str(tmp_path / "j")
+    loop = _tiny_loop(rng, journal=OnlineJournal(d, snapshot_every=100))
+    loop.step(*_tiny_chunk(rng, 0))
+    ten, X, y = _tiny_chunk(rng, 1)
+    bad = np.array(["nope"] * len(ten))
+    with pytest.raises(KeyError, match="unknown tenant"):
+        loop.step(bad, X, y)
+    assert loop._chunks == 1
+    assert loop.journal.withdrawals == 1
+    recs = [c for c, _ in loop.journal.records()]
+    assert recs == [1], "the rejected chunk's record must be withdrawn"
+    # the next good chunk reuses the chunk number cleanly
+    loop.step(ten, X, y)
+    assert [c for c, _ in loop.journal.records()] == [1, 2]
+    resumed = OnlineLoop.resume(OnlineJournal(d, snapshot_every=100))
+    assert resumed._chunks == 2
+    assert resumed.suffstats.digest() == loop.suffstats.digest()
