@@ -9,7 +9,7 @@ SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve serve_async \
         categorical penalized elastic sketch fleet hotloop online \
-        obsplane chaos clean
+        obsplane chaos elastic_tenancy clean
 
 all: native
 
@@ -129,6 +129,17 @@ obsplane:
 # vs healthy, recompile count)
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m selfheal
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# elastic tenancy under fire (serve/growth, serve/pool, online/sharding):
+# bucket-crossing family growth under live traffic (zero lost requests,
+# zero steady-state recompiles, byte-identical old-tenant scoring),
+# engine-death resubmit in the multi-engine pool, SIGKILL-resume of the
+# sharded online plane (per-shard WALs, combined digest bit-identical to
+# the unsharded control), growth-boundary serialization round-trip —
+# plus the tenant_growth_chaos bench block
+elastic_tenancy:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m tenancy
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:

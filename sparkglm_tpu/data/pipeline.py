@@ -36,17 +36,26 @@ cores (and the GIL), and on a saturated host a pipelined pass can run
 1.9× sequential wall, queue_wait ≈ the whole pass).  Concurrent-mode
 measurements cannot predict uncontended cost (both ``produce_s`` and
 ``queue_wait_s`` inflate together under contention), so the pipeline
-A/B-tests itself: the first ``_PROBE_ITEMS`` items are consumed inline
-(sequential truth), then the producer thread takes over and the measured
-pipelined rate is compared against the probed sequential rate.  If
-pipelining is not at least ``1 - _DEGRADE_RATIO`` faster, the producer
-hands the live iterator back and the rest of the pass runs sequentially
-on the consumer thread (``PassStats.degraded`` is set; streaming passes
-surface it as a ``prefetch_degraded`` trace event).  Decisions are only
-taken once the probe has accumulated ``_PROBE_MIN_S`` of wall time, so
-sub-millisecond test streams keep fully deterministic event sequences.
-The worst case is bounded: a degraded pass pays at most the few-item
-pipelined probe over pure sequential.
+A/B-tests itself CONTINUOUSLY, not once: the first ``_PROBE_ITEMS``
+items are consumed inline (sequential truth), then the producer thread
+takes over and the measured pipelined rate is compared against the
+probed sequential rate on every item.  If pipelining is not at least
+``1 - _DEGRADE_RATIO`` faster, the producer hands the live iterator
+back and the pass continues sequentially on the consumer thread
+(``PassStats.degraded`` is set; streaming passes surface it as a
+``prefetch_degraded`` trace event).  A degrade is a per-pass DECISION,
+not a one-way door: the degraded phase keeps re-measuring the
+sequential rate over a rolling window, and after ``_RESTORE_ITEMS``
+sequential items the controller re-tries pipelining against the FRESH
+sequential truth (``PassStats.restores``) — a transient host saturation
+(another fit's burst, a GC storm) no longer condemns the whole pass to
+sequential.  Each failed restore doubles the next re-try window
+(exponential backoff), so thrash overhead is logarithmic in pass
+length.  Decisions are only taken once the probe has accumulated
+``_PROBE_MIN_S`` of wall time, so sub-millisecond test streams keep
+fully deterministic event sequences.  The worst case stays bounded: a
+degraded pass pays the few-item pipelined probe plus O(log items)
+backed-off restore trials over pure sequential.
 
 The pipeline is representation-agnostic: items are opaque, so structured
 chunks (``data/structured.py`` — a dense leaf plus per-factor level-index
@@ -73,6 +82,11 @@ _ITEM, _ERR, _DONE, _HAND = "item", "err", "done", "hand"
 _PROBE_ITEMS = 2
 _PROBE_MIN_S = 0.25
 _DEGRADE_RATIO = 0.95
+# Continuous-controller tuning: sequential items consumed in a degraded
+# phase before pipelining is re-tried (doubled per failed restore), and
+# the rolling window re-measuring the sequential rate during that phase.
+_RESTORE_ITEMS = 8
+_SEQ_WINDOW = 8
 
 
 class PassStats:
@@ -84,12 +98,14 @@ class PassStats:
     ``waits``        number of queue gets that had to wait
     ``depth_max`` / ``depth_sum`` / ``items``
                      queue depth observed at each get (max / for mean)
-    ``degraded``     the pass handed the iterator back to the consumer
-                     thread because measured overlap didn't pay
+    ``degraded``     the pass ran sequentially for at least one phase
+                     because measured overlap didn't pay
+    ``degrades``     pipelined -> sequential hand-backs this pass
+    ``restores``     sequential -> pipelined re-promotions this pass
     """
 
     __slots__ = ("produce_s", "queue_wait_s", "waits", "depth_max",
-                 "depth_sum", "items", "degraded")
+                 "depth_sum", "items", "degraded", "degrades", "restores")
 
     def __init__(self):
         self.produce_s = 0.0
@@ -99,6 +115,8 @@ class PassStats:
         self.depth_sum = 0
         self.items = 0
         self.degraded = False
+        self.degrades = 0
+        self.restores = 0
 
     def depth_mean(self) -> float:
         return self.depth_sum / self.items if self.items else 0.0
@@ -213,16 +231,16 @@ def _prefetch_gen(make_iter, prefetch, stats, auto_degrade):
     # Sequential probe: inline consumption measures the uncontended
     # per-item rate (produce + compute) that the pipelined phase must
     # beat.  Probe errors raise inline — identical to sequential runs.
-    it0 = None
+    live_it = None
     seq_rate = 0.0
     monitor = False
     if auto_degrade:
-        it0 = make_iter()
+        live_it = make_iter()
         t_probe0 = time.perf_counter()
         for _ in range(_PROBE_ITEMS):
             t0 = time.perf_counter()
             try:
-                item = next(it0)
+                item = next(live_it)
             except StopIteration:
                 return
             finally:
@@ -233,101 +251,144 @@ def _prefetch_gen(make_iter, prefetch, stats, auto_degrade):
         seq_rate = probe_s / _PROBE_ITEMS
         monitor = probe_s >= _PROBE_MIN_S
 
-    q: queue.Queue = queue.Queue(maxsize=prefetch)
-    stop = threading.Event()
-    degrade = threading.Event()
+    # One pipelined phase's machinery; the controller below may run
+    # several (degrade tears one down, restore starts a fresh one over
+    # the SAME live iterator — items stay in order by construction).
+    phase = {"q": None, "stop": None, "thread": None}
 
-    def _put(entry) -> bool:
-        while not stop.is_set():
-            try:
-                q.put(entry, timeout=0.05)
-                return True
-            except queue.Full:
-                continue
-        return False
+    def _start(it_live):
+        q: queue.Queue = queue.Queue(maxsize=prefetch)
+        stop = threading.Event()
+        degrade = threading.Event()
 
-    def produce(it=it0):
-        while True:
-            if degrade.is_set():
-                _put((_HAND, it, []))
-                return
-            with _obs_trace.capture() as events:
-                t0 = time.perf_counter()
+        def _put(entry) -> bool:
+            while not stop.is_set():
                 try:
-                    if it is None:
-                        it = make_iter()
-                    item = next(it)
-                except StopIteration:
-                    _put((_DONE, None, events))
-                    return
-                except BaseException as e:  # noqa: BLE001 — re-raised in order
-                    _put((_ERR, e, events))
-                    return
-                finally:
-                    track.produce_s += time.perf_counter() - t0
-            if not _put((_ITEM, item, events)):
-                return  # consumer abandoned the stream
+                    q.put(entry, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
-    t = threading.Thread(target=produce, name="sparkglm-prefetch",
-                         daemon=True)
-    t.start()
-    try:
-        t_pipe0 = time.perf_counter()
-        n_piped = 0
-        while True:
-            if monitor and not degrade.is_set():
-                # consumer is back for the next item: everything since the
-                # measurement start (produce AND compute, overlapped) is
-                # on the clock.  The FIRST pipelined item is excluded —
-                # the producer starts with zero lead, so its cost equals
-                # sequential and would bias the decision toward degrade.
-                if n_piped == 1:
-                    t_pipe0 = time.perf_counter()
-                elif n_piped > 1:
-                    wall = time.perf_counter() - t_pipe0
-                    if wall > _DEGRADE_RATIO * seq_rate * (n_piped - 1):
-                        degrade.set()
-            t0 = time.perf_counter()
-            try:
-                tag, payload, events = q.get_nowait()
-            except queue.Empty:
-                tag, payload, events = q.get()
-                track.queue_wait_s += time.perf_counter() - t0
-                track.waits += 1
-            depth = q.qsize()
-            track.depth_max = max(track.depth_max, depth)
-            track.depth_sum += depth
-            track.items += 1
-            _obs_trace.replay(events)
-            if tag is _DONE:
-                return
-            if tag is _ERR:
-                raise payload
-            if tag is _HAND:
-                track.items -= 1  # hand-off marker, not an item
-                break
-            n_piped += 1
-            yield payload
-        # Degraded: the producer handed its live iterator back; the rest
-        # of the pass runs sequentially on this thread (direct tracer
-        # emission, no capture/replay — same event order either way).
-        track.degraded = True
-        it_tail = payload
-        while True:
-            t0 = time.perf_counter()
-            try:
-                item = next(it_tail)
-            except StopIteration:
-                return
-            finally:
-                track.produce_s += time.perf_counter() - t0
-            track.items += 1
-            yield item
-    finally:
-        stop.set()
+        def produce(it=it_live):
+            while True:
+                if degrade.is_set():
+                    _put((_HAND, it, []))
+                    return
+                with _obs_trace.capture() as events:
+                    t0 = time.perf_counter()
+                    try:
+                        if it is None:
+                            it = make_iter()
+                        item = next(it)
+                    except StopIteration:
+                        _put((_DONE, None, events))
+                        return
+                    except BaseException as e:  # noqa: BLE001 — re-raised in order
+                        _put((_ERR, e, events))
+                        return
+                    finally:
+                        track.produce_s += time.perf_counter() - t0
+                if not _put((_ITEM, item, events)):
+                    return  # consumer abandoned the stream
+
+        t = threading.Thread(target=produce, name="sparkglm-prefetch",
+                             daemon=True)
+        t.start()
+        phase.update(q=q, stop=stop, thread=t)
+        return q, degrade
+
+    def _teardown():
+        if phase["thread"] is None:
+            return
+        phase["stop"].set()
         while True:  # unblock a producer parked on a full queue
             try:
-                q.get_nowait()
+                phase["q"].get_nowait()
             except queue.Empty:
                 break
-        t.join(timeout=5.0)
+        phase["thread"].join(timeout=5.0)
+        phase.update(q=None, stop=None, thread=None)
+
+    try:
+        while True:
+            # -- pipelined phase --------------------------------------------
+            q, degrade = _start(live_it)
+            t_pipe0 = time.perf_counter()
+            n_piped = 0
+            while True:
+                if monitor and not degrade.is_set():
+                    # consumer is back for the next item: everything since
+                    # the measurement start (produce AND compute,
+                    # overlapped) is on the clock.  The FIRST pipelined
+                    # item is excluded — the producer starts with zero
+                    # lead, so its cost equals sequential and would bias
+                    # the decision toward degrade.
+                    if n_piped == 1:
+                        t_pipe0 = time.perf_counter()
+                    elif n_piped > 1:
+                        wall = time.perf_counter() - t_pipe0
+                        if wall > _DEGRADE_RATIO * seq_rate * (n_piped - 1):
+                            degrade.set()
+                t0 = time.perf_counter()
+                try:
+                    tag, payload, events = q.get_nowait()
+                except queue.Empty:
+                    tag, payload, events = q.get()
+                    track.queue_wait_s += time.perf_counter() - t0
+                    track.waits += 1
+                depth = q.qsize()
+                track.depth_max = max(track.depth_max, depth)
+                track.depth_sum += depth
+                track.items += 1
+                _obs_trace.replay(events)
+                if tag is _DONE:
+                    return
+                if tag is _ERR:
+                    raise payload
+                if tag is _HAND:
+                    track.items -= 1  # hand-off marker, not an item
+                    break
+                n_piped += 1
+                yield payload
+            # producer exited by handing back its live iterator; its
+            # thread is done — retire this phase's machinery
+            phase["thread"].join(timeout=5.0)
+            phase.update(q=None, stop=None, thread=None)
+            live_it = payload
+            track.degraded = True
+            track.degrades += 1
+
+            # -- degraded (sequential) phase --------------------------------
+            # Runs on this thread (direct tracer emission, no capture/
+            # replay — same event order either way) while re-measuring
+            # the CURRENT sequential rate over a rolling window; after
+            # the backed-off restore budget, pipelining gets another
+            # trial against that fresh truth.
+            restore_after = _RESTORE_ITEMS * (2 ** (track.degrades - 1))
+            recent: list = []
+            n_seq = 0
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(live_it)
+                except StopIteration:
+                    return
+                finally:
+                    dt = time.perf_counter() - t0
+                    track.produce_s += dt
+                track.items += 1
+                t_comp0 = time.perf_counter()
+                yield item
+                # produce + downstream compute = the true sequential
+                # per-item cost the next pipelined trial must beat
+                recent.append(dt + (time.perf_counter() - t_comp0))
+                if len(recent) > _SEQ_WINDOW:
+                    recent.pop(0)
+                n_seq += 1
+                if monitor and n_seq >= restore_after:
+                    seq_rate = sum(recent) / len(recent)
+                    track.restores += 1
+                    break  # back to a pipelined trial
+    finally:
+        _teardown()

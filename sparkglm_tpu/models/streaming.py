@@ -116,7 +116,9 @@ def _emit_pipeline_events(tracer, stats, label: str, index: int) -> None:
         tracer.emit("prefetch_degraded", label=label, index=int(index),
                     items=int(stats.items),
                     produce_s=float(stats.produce_s),
-                    queue_wait_s=float(stats.queue_wait_s))
+                    queue_wait_s=float(stats.queue_wait_s),
+                    degrades=int(getattr(stats, "degrades", 1)),
+                    restores=int(getattr(stats, "restores", 0)))
     tracer.emit("queue_wait", label=label, index=int(index),
                 seconds=float(stats.queue_wait_s), waits=int(stats.waits))
     tracer.emit("prefetch_depth", label=label, index=int(index),

@@ -13,6 +13,10 @@ reweighting-based warm refits per arXiv:2406.02769).
                 snapshots on robust/checkpoint.py's atomic write-rename;
                 ``OnlineLoop.resume`` replays to the exact chunk
                 boundary bit-identically after a kill.
+  sharding.py   ``ShardedOnlineLoop`` — one loop writer per tenant
+                shard, each with its own journal; shard statistics
+                combine information-weighted (elastic/combine.py) into
+                state bit-identical to the unsharded loop.
 
 Front-end: ``sparkglm_tpu.online_fleet(...)`` (api.py) seeds a fleet fit
 and returns a ready loop.
@@ -21,6 +25,8 @@ and returns a ready loop.
 from .drift import DriftGate
 from .journal import OnlineJournal
 from .loop import OnlineLoop
+from .sharding import ShardedOnlineLoop, shard_of
 from .suffstats import OnlineSuffStats
 
-__all__ = ["DriftGate", "OnlineJournal", "OnlineLoop", "OnlineSuffStats"]
+__all__ = ["DriftGate", "OnlineJournal", "OnlineLoop", "OnlineSuffStats",
+           "ShardedOnlineLoop", "shard_of"]

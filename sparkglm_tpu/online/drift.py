@@ -157,6 +157,25 @@ class DriftGate:
                              threshold=self.threshold)
         return tuple(drifted)
 
+    def grow(self, new_labels) -> None:
+        """Extend the gate to a grown tenant set in place (the
+        serve/growth.py migration): existing tenants keep their frozen
+        reference and live histograms untouched; new tenants start with
+        empty windows and begin accumulating on the next chunk.  The
+        shared window clocks carry over, so the gate keeps firing on the
+        same chunk boundaries as an ungrown run."""
+        new_labels = tuple(str(t) for t in new_labels)
+        missing = sorted(set(self.labels) - set(new_labels))
+        if missing:
+            raise ValueError(
+                f"growth cannot drop tenants: {missing[:4]}"
+                f"{'...' if len(missing) > 4 else ''}")
+        for t in new_labels:
+            if t not in self._ref:
+                self._ref[t] = {m: Histogram() for m in _METRICS}
+                self._live[t] = {m: Histogram() for m in _METRICS}
+        self.labels = new_labels
+
     def rearm(self) -> None:
         """Forget the frozen reference and refill it from the next
         ``reference_chunks`` chunks — called after a deploy so drift is

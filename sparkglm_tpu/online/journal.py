@@ -47,6 +47,7 @@ from __future__ import annotations
 import io
 import os
 import re
+import threading
 from typing import Optional
 
 import numpy as np
@@ -85,6 +86,13 @@ class OnlineJournal:
         self.appends = 0
         self.snapshots = 0
         self.withdrawals = 0
+        # one writer lock over append/withdraw/snapshot: the snapshot's
+        # prune scan must never interleave with an in-flight append —
+        # a record that lands mid-scan could otherwise be observed (and
+        # unlinked) before the state it journals is snapshotted.  Single-
+        # writer loops never contend; sharded/threaded drivers
+        # (online/sharding.py) stay safe by construction.
+        self._lock = threading.Lock()
 
     # -- paths ---------------------------------------------------------------
 
@@ -127,9 +135,10 @@ class OnlineJournal:
         off = (np.zeros(n) if offset is None
                else np.asarray(offset, np.float64))
         tn = np.asarray([str(t) for t in np.asarray(tenants)])
-        nbytes = atomic_savez(self._rec_path(int(chunk)),
-                              tenants=tn, X=X, y=y, w=w, off=off)
-        self.appends += 1
+        with self._lock:
+            nbytes = atomic_savez(self._rec_path(int(chunk)),
+                                  tenants=tn, X=X, y=y, w=w, off=off)
+            self.appends += 1
         return nbytes
 
     def withdraw(self, chunk: int) -> None:
@@ -138,8 +147,9 @@ class OnlineJournal:
         restoring the WAL invariant that a surviving record is always
         input the live run absorbed — resume must never replay a chunk
         the healthy run refused."""
-        self._unlink(self._rec_path(int(chunk)))
-        self.withdrawals += 1
+        with self._lock:
+            self._unlink(self._rec_path(int(chunk)))
+            self.withdrawals += 1
 
     @staticmethod
     def load_record(path) -> tuple:
@@ -156,15 +166,23 @@ class OnlineJournal:
         buf = io.BytesIO()
         save_model(loop, buf)
         data = buf.getvalue()
-        atomic_write_bytes(self._snap_path(chunk), data)
-        self.snapshots += 1
-        if self.prune:
-            for c, p in self._scan(_REC_RE):
-                if c <= chunk:
-                    self._unlink(p)
-            for c, p in self._scan(_SNAP_RE):
-                if c < chunk:
-                    self._unlink(p)
+        with self._lock:
+            # under the writer lock: no append can land between the
+            # prune scan and its unlinks, so the only records ever
+            # removed are those the snapshot just made redundant
+            atomic_write_bytes(self._snap_path(chunk), data)
+            self.snapshots += 1
+            if self.prune:
+                for c, p in self._scan(_REC_RE):
+                    # compaction-safety invariant: only records the
+                    # snapshot covers (c <= its chunk) are ever removed;
+                    # anything newer survives every prune (test-enforced
+                    # under a concurrent append/snapshot hammer)
+                    if c <= chunk:
+                        self._unlink(p)
+                for c, p in self._scan(_SNAP_RE):
+                    if c < chunk:
+                        self._unlink(p)
         return len(data)
 
     @staticmethod

@@ -480,6 +480,80 @@ class OnlineLoop:
         return float(np.sum(hoststats.dev_resids(self.glm_family, y, mu,
                                                  w)))
 
+    # -- tenant growth (serve/growth.py) -------------------------------------
+
+    def grow(self, models: dict) -> dict:
+        """Grow the tenant set without rebuilding the loop: register and
+        deploy each ``{tenant: model}`` in the family (their version 1 —
+        growth deploys, there is no prior champion to stage against) and
+        migrate EVERY piece of loop state to the new sorted tenant
+        order in one step:
+
+          * suffstats — :meth:`OnlineSuffStats.grow`: surviving rows are
+            byte-copied, new tenants start at zero mass;
+          * drift gate — :meth:`DriftGate.grow`: histograms carry over,
+            window clocks untouched;
+          * retained-row rings and ring positions — permuted to the new
+            order (copied, never recomputed);
+          * ``bucket`` — re-derived from the grown K, so the next warm
+            refit runs at the grown fleet bucket (serving-side warm of
+            the matching table shapes is the caller's job:
+            ``ReplicatedScorer.prewarm_tenant_axis`` BEFORE calling
+            this — serve/growth.py sequences the two).
+
+        Family registration and loop migration are one atomic step from
+        the loop's point of view: ``step()`` must never see the family's
+        sorted tenant order disagree with its own index (rows would
+        score against the wrong coefficients).  With a journal attached
+        the grown state snapshots immediately — growth mutates state
+        outside the per-chunk WAL stream, so it must be durable before
+        the next record lands (a kill between registration and snapshot
+        resumes to the clean pre-growth state).  Returns
+        ``{added, tenants, bucket}``.
+        """
+        new = {str(t): m for t, m in models.items()}
+        dup = sorted(set(new) & set(self.labels))
+        if dup:
+            raise ValueError(
+                f"tenants already in the family: {dup[:4]}"
+                f"{'...' if len(dup) > 4 else ''}")
+        if not new:
+            return dict(added=(), tenants=self.K, bucket=self.bucket)
+        for t in sorted(new):
+            self.family.register(t, new[t])  # v1 auto-deploys
+        tenants, _B = self.family.deployed_matrix()
+        old_index = self._index
+        self.labels = tenants
+        self.K = len(tenants)
+        self._index = {t: k for k, t in enumerate(tenants)}
+        old_bucket, self.bucket = self.bucket, next_bucket(self.K,
+                                                           MIN_BUCKET)
+        self.suffstats = self.suffstats.grow(tenants)
+        self.gate.grow(tenants)
+        W = self.window_rows
+        Xw = np.zeros((self.K, W, self.p))
+        yw = np.zeros((self.K, W))
+        ww = np.zeros((self.K, W))
+        ow = np.zeros((self.K, W))
+        pos = np.zeros(self.K, np.int64)
+        for t, j in old_index.items():
+            k = self._index[t]
+            Xw[k] = self._Xw[j]
+            yw[k] = self._yw[j]
+            ww[k] = self._ww[j]
+            ow[k] = self._ow[j]
+            pos[k] = self._pos[j]
+        self._Xw, self._yw, self._ww, self._ow, self._pos = (
+            Xw, yw, ww, ow, pos)
+        self.tracer.emit("family_grow", added=len(new), tenants=self.K,
+                         bucket_before=int(old_bucket),
+                         bucket_after=int(self.bucket),
+                         chunk=self._chunks)
+        if self.journal is not None:
+            self._snapshot()
+        return dict(added=tuple(sorted(new)), tenants=self.K,
+                    bucket=self.bucket)
+
     # -- manual deploy hook --------------------------------------------------
 
     def deploy(self, tenant: str, model, *, watch: bool = True) -> int:

@@ -1,0 +1,332 @@
+"""Sharded continuous learning: one :class:`OnlineLoop` writer per
+tenant shard, combined information-weighted.
+
+One loop over a large fleet serializes every chunk through one writer
+and one journal — a single slow disk or one crash stalls learning for
+every tenant.  :class:`ShardedOnlineLoop` partitions the tenant axis
+into ``n_shards`` disjoint shards, each a full :class:`OnlineLoop` over
+its own sub-:class:`ModelFamily` with its OWN write-ahead journal
+(``shard-00/``, ``shard-01/``, ... under one root).  Rows route to
+shards by a stable hash of the tenant label, so the assignment survives
+growth and resumes; every shard steps on every chunk (possibly with
+zero rows), which keeps the one-global-decay-clock semantics of the
+unsharded loop — the combined statistics are BIT-IDENTICAL to an
+unsharded loop fed the same chunks (test-enforced).
+
+Combination follows elastic/combine.py's information weighting
+(PAPERS.md arXiv:2111.00032): each shard's per-tenant Gramian IS its
+information matrix, so
+
+  ``beta_comb = (sum_s G_s)^{-1} sum_s G_s beta_s``
+
+via :func:`~sparkglm_tpu.elastic.combine.combine_glm` — for the
+disjoint partition each tenant has one contributing shard and the
+combine degenerates to that shard's solve, but the formula (and
+:meth:`combined_suffstats`'s additive merge) stays exact under
+replicated assignments too.
+
+Crash durability is per shard: SIGKILL takes the process, but each
+shard's journal replays independently — :meth:`resume` rebuilds every
+shard loop bit-for-bit (journal.py's contract) and the combined digest
+equals the uninterrupted run's.  Deploys and rollbacks a shard's gate
+decides sync back into the MASTER family immediately, so the serving
+plane (one family, N engines — serve/pool.py) never sees shard
+boundaries.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+
+import numpy as np
+
+from .loop import OnlineLoop
+from .suffstats import OnlineSuffStats
+
+__all__ = ["ShardedOnlineLoop", "shard_of"]
+
+
+def shard_of(tenant: str, n_shards: int) -> int:
+    """Stable tenant -> shard assignment: crc32 of the label, mod the
+    shard count.  Pure function of the label (no registration order, no
+    RNG), so growth and resume land every tenant on the same shard."""
+    return zlib.crc32(str(tenant).encode()) % int(n_shards)
+
+
+class ShardedOnlineLoop:
+    """Partition an online-learning plane over tenant shards (module
+    doc).
+
+    Args:
+      family: the MASTER served :class:`ModelFamily` (every tenant
+        deployed).  Shard sub-families are built from its deployed
+        members; gate decisions sync back into it.
+      n_shards: number of shard writers (>= 1).
+      journal: optional journal ROOT — a directory under which each
+        shard arms its own ``OnlineJournal`` at ``shard-NN/``.
+      trace / metrics / telemetry: obs/ wiring, shared by every shard
+        loop (events carry the shard in their ``chunk`` trace ids).
+      **loop_kwargs: forwarded to every shard's :class:`OnlineLoop`
+        (rho, window_rows, drift/gate knobs, ...).
+    """
+
+    def __init__(self, family, n_shards: int, *, journal=None,
+                 trace=None, metrics=None, telemetry=None,
+                 **loop_kwargs):
+        if int(n_shards) < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        self.family = family
+        self.n_shards = int(n_shards)
+        tenants = family.tenants()
+        if not tenants:
+            raise ValueError(
+                "the ModelFamily has no registered tenants yet; build it "
+                "from a seed fleet first (ModelFamily.from_fleet)")
+        empty = [s for s in range(self.n_shards)
+                 if not any(shard_of(t, self.n_shards) == s
+                            for t in tenants)]
+        if empty:
+            raise ValueError(
+                f"shards {empty} would start with no tenants "
+                f"({len(tenants)} tenants over {n_shards} shards); use "
+                f"fewer shards or more tenants")
+        self.loops: list[OnlineLoop] = []
+        for s in range(self.n_shards):
+            sub = self._sub_family(s, [t for t in tenants
+                                       if shard_of(t, self.n_shards) == s])
+            self.loops.append(OnlineLoop(
+                sub, trace=trace, metrics=metrics, telemetry=telemetry,
+                **loop_kwargs))
+        self._chunks = 0
+        if journal is not None:
+            self.attach_journal(journal)
+
+    def _sub_family(self, s: int, tenants):
+        from ..serve.registry import ModelFamily
+        sub = ModelFamily(f"{self.family.name}-shard{s:02d}")
+        for t in tenants:
+            sub.register(t, self.family.model(t))  # deployed member, v1
+        return sub
+
+    # -- routing -------------------------------------------------------------
+
+    def shard_of(self, tenant: str) -> int:
+        return shard_of(tenant, self.n_shards)
+
+    @property
+    def labels(self) -> tuple:
+        return self.family.tenants()
+
+    # -- chunk ingestion ------------------------------------------------------
+
+    def step(self, tenants, X, y, *, weights=None, offset=None) -> dict:
+        """Route one chunk's rows to their shards and step EVERY shard
+        (zero-row slices included: the decay/window clocks of all shards
+        advance together, preserving the unsharded loop's one-global-
+        clock semantics).  Shard deploys/rollbacks sync into the master
+        family before returning.  Returns the merged summary dict."""
+        X = np.asarray(X, np.float64)
+        y = np.asarray(y, np.float64)
+        n = X.shape[0] if X.ndim == 2 else 0
+        w = None if weights is None else np.asarray(weights, np.float64)
+        off = None if offset is None else np.asarray(offset, np.float64)
+        labels = np.asarray(tenants)
+        sidx = np.array([shard_of(t, self.n_shards) for t in labels],
+                        np.int64) if n else np.zeros(0, np.int64)
+        self._chunks += 1
+        drifted, deployed, rolled = [], [], []
+        for s, loop in enumerate(self.loops):
+            m = sidx == s
+            out = loop.step(
+                labels[m], X[m], y[m],
+                weights=None if w is None else w[m],
+                offset=None if off is None else off[m])
+            drifted.extend(out["drifted"])
+            deployed.extend(out["deployed"])
+            rolled.extend(out["rolled_back"])
+            self._sync_master(loop, out)
+        return dict(chunk=self._chunks, drifted=tuple(sorted(drifted)),
+                    deployed=tuple(sorted(deployed)),
+                    rolled_back=tuple(sorted(rolled)))
+
+    def _sync_master(self, loop: OnlineLoop, out: dict) -> None:
+        """Publish a shard's gate decisions to the master family: a
+        deployed refresh registers + deploys the shard's new champion
+        (one generation bump -> every serving scorer re-snapshots,
+        recompile-free); a rollback rolls the master back too."""
+        for t in out["deployed"]:
+            self.family.register(t, loop.family.model(t), deploy=True)
+        for t in out["rolled_back"]:
+            self.family.rollback(t)
+
+    def run(self, source, *, max_chunks: int | None = None,
+            fault_plan=None) -> dict:
+        """Drive :meth:`step` over a chunk source (the streaming-source
+        convention of :meth:`OnlineLoop.run`).  ``fault_plan`` fires its
+        ``kill_chunk_at`` schedule at each chunk boundary — the chaos
+        test SIGKILLs the whole process mid-stream and resumes every
+        shard from its own journal."""
+        it = source()
+        for i, item in enumerate(it):
+            if max_chunks is not None and i >= max_chunks:
+                break
+            if callable(item):
+                item = item()
+            if fault_plan is not None:
+                fault_plan.on_online_chunk(self._chunks + 1)
+            self.step(*item[:3],
+                      weights=item[3] if len(item) > 3 else None,
+                      offset=item[4] if len(item) > 4 else None)
+        return dict(chunks=self._chunks,
+                    shards=[lp.report().get("online", {})
+                            for lp in self.loops])
+
+    # -- growth (serve/growth.py) ---------------------------------------------
+
+    def grow(self, models: dict) -> dict:
+        """Grow the tenant set: each new tenant routes to its hash shard
+        (an existing shard — the stable assignment never reshuffles old
+        tenants) and migrates that shard's loop state via
+        :meth:`OnlineLoop.grow`; the master family registers the same
+        members so serving and learning stay one tenant set."""
+        new = {str(t): m for t, m in models.items()}
+        dup = sorted(set(new) & set(self.family.tenants()))
+        if dup:
+            raise ValueError(
+                f"tenants already in the family: {dup[:4]}"
+                f"{'...' if len(dup) > 4 else ''}")
+        per_shard: dict[int, dict] = {}
+        for t in sorted(new):
+            per_shard.setdefault(shard_of(t, self.n_shards), {})[t] = new[t]
+        for s, sub in sorted(per_shard.items()):
+            self.loops[s].grow(sub)
+        for t in sorted(new):
+            self.family.register(t, new[t])  # v1 auto-deploys
+        return dict(added=tuple(sorted(new)),
+                    tenants=len(self.family.tenants()),
+                    shards={s: tuple(sorted(sub))
+                            for s, sub in sorted(per_shard.items())})
+
+    # -- combination (elastic/combine.py semantics) ---------------------------
+
+    def combined_suffstats(self) -> OnlineSuffStats:
+        """Merge every shard's decayed statistics into one accumulator
+        over the union tenant set (sorted — the master family's order).
+        Rows are SUMMED per label across shards: for the disjoint
+        partition that is a byte-copy from the owning shard; under
+        replicated assignments it is the exact additive combine (the
+        Gramians are the informations).  The global chunk clock is the
+        shared step count."""
+        labels = tuple(sorted({t for lp in self.loops
+                               for t in lp.suffstats.labels}))
+        p = self.loops[0].p
+        rho = self.loops[0].rho
+        out = OnlineSuffStats.init(labels, p, rho=rho)
+        idx = {t: k for k, t in enumerate(labels)}
+        for lp in self.loops:
+            ss = lp.suffstats
+            for j, t in enumerate(ss.labels):
+                k = idx[t]
+                out.G[k] += ss.G[j]
+                out.r[k] += ss.r[j]
+                out.wsum[k] += ss.wsum[j]
+        out.chunks = max(lp.suffstats.chunks for lp in self.loops)
+        return out
+
+    def combined_solve(self, *, jitter: float = 0.0) -> tuple:
+        """Information-weighted combined coefficients
+        ``(labels, (K, p) beta)`` via
+        :func:`~sparkglm_tpu.elastic.combine.combine_glm` per tenant:
+        ``(sum_s G_s)^{-1} sum_s G_s beta_s`` over the shards holding
+        that tenant.  Massless tenants come back NaN (the loop's
+        skip-deploy convention)."""
+        from ..elastic.combine import combine_glm
+        labels = tuple(sorted({t for lp in self.loops
+                               for t in lp.suffstats.labels}))
+        p = self.loops[0].p
+        beta = np.full((len(labels), p), np.nan)
+        shard_beta = [lp.suffstats.solve(jitter=jitter)
+                      for lp in self.loops]
+        for k, t in enumerate(labels):
+            infos, betas = [], []
+            for s, lp in enumerate(self.loops):
+                ss = lp.suffstats
+                if t not in ss.labels:
+                    continue
+                j = ss.labels.index(t)
+                if ss.wsum[j] <= 0.0 or not np.all(
+                        np.isfinite(shard_beta[s][j])):
+                    continue
+                infos.append(ss.G[j])
+                betas.append(shard_beta[s][j])
+            if infos:
+                beta[k] = combine_glm(infos, betas, jitter=jitter)
+        return labels, beta
+
+    def digest(self) -> str:
+        """sha256 of the COMBINED accumulator — what the chaos test
+        compares across kill/resume against an uninterrupted control."""
+        return self.combined_suffstats().digest()
+
+    def shard_digests(self) -> tuple:
+        return tuple(lp.suffstats.digest() for lp in self.loops)
+
+    # -- crash durability -----------------------------------------------------
+
+    def attach_journal(self, root, *, snapshot: bool = True) -> None:
+        """Arm one write-ahead journal PER SHARD under ``root``
+        (``shard-00/``, ``shard-01/``, ...) — independent writers, so
+        one shard's fsync stall or torn chunk never blocks or corrupts
+        another's stream."""
+        self.journal_root = os.fspath(root)
+        for s, loop in enumerate(self.loops):
+            loop.attach_journal(self._shard_dir(self.journal_root, s),
+                                snapshot=snapshot)
+
+    @staticmethod
+    def _shard_dir(root: str, s: int) -> str:
+        return os.path.join(os.fspath(root), f"shard-{s:02d}")
+
+    @classmethod
+    def resume(cls, root, *, trace=None, metrics=None,
+               family=None) -> "ShardedOnlineLoop":
+        """Rebuild after a crash: every ``shard-NN/`` journal under
+        ``root`` replays independently through :meth:`OnlineLoop.resume`
+        (each bit-identical to its uninterrupted shard), then the master
+        family is reassembled from the shard families' deployed members
+        (or updated in place when the serving-plane ``family`` is
+        passed).  The combined digest equals the uninterrupted run's at
+        the same chunk boundary."""
+        root = os.fspath(root)
+        dirs = sorted(d for d in os.listdir(root)
+                      if d.startswith("shard-")
+                      and os.path.isdir(os.path.join(root, d)))
+        if not dirs:
+            raise FileNotFoundError(
+                f"no shard-NN journal directories under {root!r}")
+        loops = [OnlineLoop.resume(os.path.join(root, d), trace=trace,
+                                   metrics=metrics) for d in dirs]
+        obj = cls.__new__(cls)
+        obj.n_shards = len(loops)
+        obj.loops = loops
+        obj._chunks = max(lp._chunks for lp in loops)
+        obj.journal_root = root
+        if family is None:
+            from ..serve.registry import ModelFamily
+            base = loops[0].family
+            family = ModelFamily(base.name.rsplit("-shard", 1)[0])
+            for lp in loops:
+                for t in lp.family.tenants():
+                    family.register(t, lp.family.model(t))
+        else:
+            for lp in loops:
+                for t in lp.family.tenants():
+                    dv = lp.family.deployed_version(t)
+                    if t not in family.tenants():
+                        family.register(t, lp.family.model(t, dv))
+                    else:
+                        family.register(t, lp.family.model(t, dv),
+                                        deploy=True)
+        obj.family = family
+        return obj

@@ -85,9 +85,10 @@ class OnlineSuffStats:
 
         ``tenants`` (n,) labels per row; ``X`` (n, p); ``y`` (n,).
         Accumulation is host float64 regardless of input dtype.  Unknown
-        tenant labels raise — the tenant set is fixed at init (it sizes
-        the serving tables; an online system grows tenants by rebuilding
-        the family, not by silently widening state).
+        tenant labels raise — the tenant set is fixed between explicit
+        :meth:`grow` migrations (it sizes the serving tables, so
+        widening must be a deliberate, warmable event, never a silent
+        side effect of one chunk).
         """
         X = np.asarray(X, np.float64)
         y = np.asarray(y, np.float64)
@@ -148,6 +149,40 @@ class OnlineSuffStats:
             except np.linalg.LinAlgError:
                 pass
         return beta
+
+    def grow(self, new_labels) -> "OnlineSuffStats":
+        """Migrate to a grown tenant set (serve/growth.py; the tentpole
+        answer to "an online system grows tenants by rebuilding the
+        family"): returns a NEW accumulator ordered by ``new_labels``
+        where every existing tenant's ``G``/``r``/``wsum`` row is COPIED
+        — the bytes are moved, never recomputed, so each surviving
+        tenant's block is bit-identical to before the migration — and
+        every new tenant starts at zero mass (exactly the state it would
+        have had if it had been present, absent from every chunk, since
+        init; decay of zero is zero).  The global chunk clock carries
+        over.  Growth may reorder rows (the family sorts tenants) but
+        never drop one."""
+        new_labels = tuple(str(t) for t in new_labels)
+        if len(set(new_labels)) != len(new_labels):
+            raise ValueError("tenant labels must be unique")
+        missing = sorted(set(self.labels) - set(new_labels))
+        if missing:
+            raise ValueError(
+                f"growth cannot drop tenants (have accumulated state): "
+                f"{missing[:4]}{'...' if len(missing) > 4 else ''}")
+        K, p = len(new_labels), self.p
+        G = np.zeros((K, p, p))
+        r = np.zeros((K, p))
+        wsum = np.zeros(K)
+        old = self._index()
+        for k, t in enumerate(new_labels):
+            j = old.get(t)
+            if j is not None:
+                G[k] = self.G[j]
+                r[k] = self.r[j]
+                wsum[k] = self.wsum[j]
+        return OnlineSuffStats(labels=new_labels, rho=self.rho, G=G, r=r,
+                               wsum=wsum, chunks=self.chunks)
 
     def digest(self) -> str:
         """sha256 over the accumulator bytes (G, r, wsum, chunks) — the

@@ -97,6 +97,18 @@ class FaultPlan:
     boundaries :meth:`on_online_chunk` SIGKILLs the current process — a
     real, unhandleable death for exercising the write-ahead journal's
     crash/resume path.  Only call it from an expendable subprocess.
+
+    ENGINE-TIER kinds are addressed by ``(engine, submit)`` — one submit
+    ordinal PER ENGINE, mirroring the per-replica dispatch ordinals one
+    level up (serve/pool.py's multi-engine tier):
+
+      * ``engine_error_at`` — that submission raises
+        :class:`~.retry.ReplicaUnavailable` (fires once; the engine
+        hiccuped, the pool re-routes and a later probe readmits);
+      * ``engine_dead_from`` — EVERY submission to that engine from the
+        given ordinal onward fails (a crashed engine process: the pool's
+        health breaker ejects it and its traffic re-routes to the
+        survivors with zero lost requests).
     """
 
     transient_at: Sequence[int] = ()
@@ -112,6 +124,8 @@ class FaultPlan:
     slow_s: float = 0.25
     hang_s: float = 30.0
     kill_chunk_at: Sequence[int] = ()
+    engine_error_at: Sequence[tuple] = ()
+    engine_dead_from: Sequence[tuple] = ()
 
     def __post_init__(self):
         self._touch = 0
@@ -130,6 +144,13 @@ class FaultPlan:
             r, k = int(r), int(k)
             self._dead_from[r] = min(k, self._dead_from.get(r, k))
         self._dispatches = {}
+        self._eng_err_pairs = {tuple(int(v) for v in ec)
+                               for ec in self.engine_error_at}
+        self._eng_dead_from = {}
+        for e, k in self.engine_dead_from:
+            e, k = int(e), int(k)
+            self._eng_dead_from[e] = min(k, self._eng_dead_from.get(e, k))
+        self._eng_submits = {}
         self._lock = threading.Lock()
         self._rng = np.random.default_rng(self.seed)
         self.faults_fired = 0
@@ -188,6 +209,30 @@ class FaultPlan:
         if dead or err:
             raise ReplicaUnavailable(
                 f"injected replica failure: replica {replica}, dispatch {k}"
+                + (" (dead)" if dead else ""))
+
+    def on_engine_submit(self, engine: int) -> None:
+        """One engine-tier submission touch: advance ``engine``'s submit
+        ordinal and fire whatever the engine schedule names there.
+        Called by the pool's dispatch path BEFORE handing the request to
+        the engine, so an injected failure looks exactly like a dead or
+        flaky engine process refusing work."""
+        engine = int(engine)
+        with self._lock:
+            k = self._eng_submits.get(engine, 0)
+            self._eng_submits[engine] = k + 1
+            key = (engine, k)
+            dead = (engine in self._eng_dead_from
+                    and k >= self._eng_dead_from[engine])
+            err = (key in self._eng_err_pairs
+                   and ("eng_err", key) not in self._fired)
+            if err:
+                self._fired.add(("eng_err", key))
+            if dead or err:
+                self.faults_fired += 1
+        if dead or err:
+            raise ReplicaUnavailable(
+                f"injected engine failure: engine {engine}, submit {k}"
                 + (" (dead)" if dead else ""))
 
     def on_online_chunk(self, chunk_idx: int) -> None:

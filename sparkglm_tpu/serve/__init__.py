@@ -41,15 +41,30 @@ hung dispatches re-route to surviving replicas (R−1 serving stays
 bit-identical — same tables, same kernel), recovered replicas re-warm
 their bucket ladder before re-admission, and per-request deadlines shed
 dead work at batch-formation time (README "Failure semantics").
+
+Elastic tenancy (:mod:`.growth`, :mod:`.pool`): coefficient tables pad
+to a power-of-2 TENANT bucket, so registering tenants within the bucket
+is recompile-free by shape-invariance; :class:`~.growth.FamilyGrowth`
+sequences bucket-crossing growth as warm-then-swap (prewarm the next
+bucket's executables off the hot path, then one generation bump) so the
+serving path never recompiles and never drops a request.
+:class:`~.pool.EnginePool` runs N engines over one family with
+engine-level health routing and :class:`~.pool.FamilyStore`
+generation-stamped cross-process publication (README "Scaling the
+tenant axis").
 """
 
 from .async_engine import AsyncEngine, EnginePolicy, ReplicatedScorer
 from .batching import BatchPolicy, MicroBatcher
-from .engine import FamilyScorer, Scorer, family_score_cache_size
+from .engine import (FamilyScorer, Scorer, family_score_cache_size,
+                     pad_tenant_table, tenant_bucket)
+from .growth import FamilyGrowth
 from .health import CircuitBreaker, HealthPolicy, ReplicaHealth
+from .pool import EnginePool, FamilyStore
 from .registry import ModelFamily, ModelRegistry
 
 __all__ = ["AsyncEngine", "BatchPolicy", "CircuitBreaker", "EnginePolicy",
-           "FamilyScorer", "HealthPolicy", "MicroBatcher", "ModelFamily",
-           "ModelRegistry", "ReplicaHealth", "ReplicatedScorer", "Scorer",
-           "family_score_cache_size"]
+           "EnginePool", "FamilyGrowth", "FamilyScorer", "FamilyStore",
+           "HealthPolicy", "MicroBatcher", "ModelFamily", "ModelRegistry",
+           "ReplicaHealth", "ReplicatedScorer", "Scorer",
+           "family_score_cache_size", "pad_tenant_table", "tenant_bucket"]

@@ -106,7 +106,8 @@ from ..obs.trace import emit_ambient
 from ..robust.retry import DeadlineExceeded, Overloaded, ReplicaUnavailable
 from .engine import (Scorer, _family_score_kernel,
                      _family_score_kernel_donated, _next_bucket,
-                     family_score_cache_size)
+                     family_score_cache_size, pad_tenant_table,
+                     tenant_bucket)
 from .health import HealthPolicy, ReplicaHealth
 
 __all__ = ["AsyncEngine", "EnginePolicy", "HealthPolicy", "ReplicatedScorer"]
@@ -168,8 +169,12 @@ class ReplicatedScorer:
     Replication/refresh are recompile-free by construction: tables are
     runtime kernel arguments, so ``refresh()`` after a family deploy or
     rollback just ``device_put``s the new (T, p) snapshot to every
-    replica.  A changed tenant SET changes table shapes and honestly
-    recompiles (counted in ``compiles``).
+    replica.  Tables are padded to the power-of-2 TENANT bucket
+    (``engine.pad_tenant_table``), so growing the tenant set within the
+    bucket is shape-invariant and recompile-free too; growth that
+    crosses a bucket changes shapes and honestly recompiles (counted in
+    ``compiles``) unless the next bucket was prewarmed first
+    (:meth:`prewarm_tenant_axis` — the serve/growth.py warm phase).
 
     A/B challenger and shadow tables are deliberately not replicated —
     experiment traffic routes through :class:`~.engine.FamilyScorer`; the
@@ -259,9 +264,15 @@ class ReplicatedScorer:
             if gen == self.generation:
                 return False
             tenants, B = self.family.deployed_matrix()
+            # tenant-axis bucket padding (engine.pad_tenant_table): the
+            # compiled executable keys on the TABLE shape, so padding to
+            # the tenant bucket makes growth within the bucket
+            # shape-invariant — refresh() after such a growth re-uses
+            # every warm executable, zero recompiles
+            B = pad_tenant_table(B)
             if getattr(self, "_B", None) is not None \
                     and B.shape != self._B.shape:
-                self._warmed.clear()    # tenant set changed: new shapes
+                self._warmed.clear()    # tenant BUCKET crossed: new shapes
             self.tenants = tenants
             self._index = {t: i for i, t in enumerate(tenants)}
             self._B = B
@@ -312,11 +323,11 @@ class ReplicatedScorer:
             self._warmed.add(key)
         return out
 
-    def _family_call(self, Xp, tp, op, bucket, replica):
+    def _family_call(self, Xp, tp, op, bucket, replica, table=None):
         d = self.devices[replica]
         kern = (_family_score_kernel_donated if self._donate
                 else _family_score_kernel)
-        B = self._tables[replica]
+        B = self._tables[replica] if table is None else table
         Xd = jax.device_put(Xp, d)
         td = jax.device_put(tp, d)
         ad = jax.device_put(np.zeros(bucket, bool), d)
@@ -473,6 +484,41 @@ class ReplicatedScorer:
             done.append(b)
         self.compiles = 0
         return tuple(done)
+
+    def prewarm_tenant_axis(self, n_tenants: int, *, buckets=None) -> dict:
+        """Background-compile the family executables for the tenant
+        bucket ``n_tenants`` will land in, BEFORE the family grows
+        (serve/growth.py's warm phase).  Drives the family kernel with a
+        zero coefficient table of ``tenant_bucket(n_tenants)`` rows over
+        every (replica, request-bucket) this scorer serves, so when the
+        swap crosses the bucket boundary the post-swap :meth:`refresh`
+        finds every executable already in the process-wide jit cache —
+        the hot path pays zero compiles.  Compiles are reported HERE,
+        never added to ``compiles`` (the steady-state counter): growth
+        warming is off the serving path by construction.  No-op when
+        ``n_tenants`` stays within the current table bucket."""
+        if not self.family_mode:
+            raise RuntimeError(
+                "tenant-axis prewarm needs a ModelFamily target")
+        tb = tenant_bucket(int(n_tenants))
+        p = self._B.shape[1]
+        if tb <= self._B.shape[0]:
+            return dict(table_rows=int(self._B.shape[0]), buckets=0,
+                        compiles=0, seconds=0.0)
+        bks = sorted(set(int(b) for b in (
+            self.buckets if buckets is None else buckets)))
+        if not bks:
+            bks = [self.min_bucket]
+        before = family_score_cache_size()
+        t0 = time.perf_counter()
+        for r in range(self.n_replicas):
+            table = jax.device_put(np.zeros((tb, p)), self.devices[r])
+            for b in bks:
+                self._family_call(np.zeros((b, p)), np.zeros(b, np.int32),
+                                  np.zeros(b), b, r, table=table)
+        return dict(table_rows=tb, buckets=len(bks),
+                    compiles=int(family_score_cache_size() - before),
+                    seconds=time.perf_counter() - t0)
 
     def rewarm(self, replica: int) -> dict:
         """Prepay ONE replica's bucket ladder before it is re-admitted

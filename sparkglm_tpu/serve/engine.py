@@ -59,6 +59,34 @@ def _next_bucket(n: int, floor: int) -> int:
     return b
 
 
+#: power-of-2 floor for the TENANT axis of family coefficient tables.
+#: Every (T, p) table is zero-padded to ``tenant_bucket(T)`` rows before
+#: it reaches the family kernel, so the compiled table shape is a
+#: function of the tenant BUCKET, not the tenant count: registering new
+#: tenants within the current bucket is shape-invariant and therefore
+#: recompile-free, and crossing a bucket is an explicit, warmable event
+#: (serve/growth.py).  Padded rows are inert — gather indices only ever
+#: name real tenants, the same trash-row contract the request axis uses.
+TENANT_BUCKET_FLOOR = 8
+
+
+def tenant_bucket(n_tenants: int, floor: int = TENANT_BUCKET_FLOOR) -> int:
+    """The power-of-2 tenant-axis bucket ``n_tenants`` pads to."""
+    return _next_bucket(int(n_tenants), floor)
+
+
+def pad_tenant_table(B: np.ndarray,
+                     floor: int = TENANT_BUCKET_FLOOR) -> np.ndarray:
+    """Zero-pad a (T, p) coefficient table to the tenant bucket (see
+    :data:`TENANT_BUCKET_FLOOR`).  Returns ``B`` itself when T is
+    already a bucket boundary."""
+    T = int(B.shape[0])
+    tb = tenant_bucket(T, floor)
+    if tb == T:
+        return B
+    return np.concatenate([B, np.zeros((tb - T, B.shape[1]))])
+
+
 class Scorer:
     """Pre-compiled bucketed scoring for ONE model (one (signature, bucket)
     executable per padding bucket; see module docstring).
@@ -361,6 +389,13 @@ class FamilyScorer:
         self._C = self._override_table(self._challenger)
         self._shadow = dict(shadow) if shadow else None
         self._S = self._override_table(self._shadow)
+        # tenant-axis bucket padding: table shapes key the compiled
+        # executable, so padding to the tenant bucket makes every scorer
+        # over <= bucket tenants share one executable family — tenant
+        # growth within the bucket never recompiles (module helper doc)
+        self._B = pad_tenant_table(self._B)
+        self._C = pad_tenant_table(self._C)
+        self._S = pad_tenant_table(self._S)
         self.compiles = 0
         self.buckets = set()
         self._lock = threading.Lock()

@@ -1,0 +1,136 @@
+"""Zero-downtime tenant-axis growth for a served :class:`ModelFamily`.
+
+The serving kernel (serve/engine.py ``_family_score_kernel``) keys its
+compiled executables on the SHAPES of the coefficient tables, and the
+tables are sized by the tenant count — so naively registering a tenant
+that crosses the power-of-2 tenant bucket would recompile every replica
+on the next hot-path call, exactly the jank a multi-tenant fleet cannot
+afford under live traffic.  :class:`FamilyGrowth` sequences growth so
+the hot path never pays:
+
+  1. **warm** — compile the next tenant-bucket's executables into the
+     process-wide jit cache via
+     :meth:`ReplicatedScorer.prewarm_tenant_axis` on every scorer that
+     serves the family (explicitly attached ones plus the family's own
+     ``replicated_scorer()`` cache).  Traffic keeps flowing on the old
+     tables the whole time; prewarm compiles run on zero-filled decoys.
+  2. **swap** — register + deploy the new tenants.  With an
+     :class:`OnlineLoop` attached this routes through
+     :meth:`OnlineLoop.grow`, which migrates suffstats, drift windows
+     and retained-row rings by label in the same step (and snapshots if
+     a journal is attached); without a loop it registers directly into
+     the family.  Either way the family's generation counter bumps, so
+     every generation-following scorer (``AsyncEngine.refresh``,
+     ``FamilyScorer`` cache) picks up the grown tables on its next
+     batch — and because step 1 already compiled those shapes, the
+     pickup is a cache HIT, measured as ``compiles == 0`` by the
+     steady-state counters the chaos test asserts on.
+
+Within-bucket growth (tenant count stays under the current power-of-2
+bucket) needs no warm at all: the padded table shapes do not change, so
+step 1 is a no-op and the swap is free by construction.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .engine import tenant_bucket
+
+__all__ = ["FamilyGrowth"]
+
+
+class FamilyGrowth:
+    """Warm-then-swap growth coordinator (module doc).
+
+    Args:
+      family: the :class:`ModelFamily` to grow.
+      scorers: extra :class:`ReplicatedScorer` instances serving this
+        family that are not in the family's own ``replicated_scorer()``
+        cache (e.g. per-engine scorers built by serve/pool.py).  The
+        cache's scorers are always discovered automatically.
+      loop: an :class:`OnlineLoop` over the same family, or None.  When
+        given, the swap routes through :meth:`OnlineLoop.grow` so the
+        learning plane migrates in the same step as the serving plane.
+      tracer: an ``obs/trace.FitTracer`` (or None) for the
+        ``growth_start`` / ``growth_warm`` / ``growth_end`` events.
+    """
+
+    def __init__(self, family, *, scorers=(), loop=None, tracer=None):
+        if loop is not None and loop.family is not family:
+            raise ValueError("loop must wrap the same ModelFamily")
+        self.family = family
+        self.scorers = tuple(scorers)
+        self.loop = loop
+        self.tracer = tracer
+
+    def _emit(self, event: str, **fields) -> None:
+        if self.tracer is not None:
+            self.tracer.emit(event, **fields)
+
+    def _all_scorers(self) -> tuple:
+        seen, out = set(), []
+        for sc in (*self.scorers, *self.family._replicated.values()):
+            if id(sc) not in seen:
+                seen.add(id(sc))
+                out.append(sc)
+        return tuple(out)
+
+    def grow(self, models: dict) -> dict:
+        """Grow the family by ``{tenant: model}`` with zero downtime.
+
+        Returns a report dict: ``added`` (sorted new tenants),
+        ``tenants`` (total after), ``crossed`` (whether the tenant
+        bucket grew), ``table_rows`` (padded tenant rows after),
+        ``prewarm`` (per-scorer ``prewarm_tenant_axis`` reports —
+        compiles here are the price paid OFF the hot path),
+        ``warm_s`` / ``swap_s`` / ``total_s`` wall times.
+        """
+        new = {str(t): m for t, m in models.items()}
+        if not new:
+            raise ValueError("no tenants to grow by")
+        dup = sorted(set(new) & set(self.family.tenants()))
+        if dup:
+            raise ValueError(
+                f"tenants already in the family: {dup[:4]}"
+                f"{'...' if len(dup) > 4 else ''}")
+        before = len(self.family)
+        target = before + len(new)
+        crossed = tenant_bucket(target) > tenant_bucket(before)
+        t0 = time.perf_counter()
+        self._emit("growth_start", adding=len(new), tenants=before,
+                   crossed=crossed)
+
+        # 1. warm: compile next-bucket executables while traffic flows on
+        # the old tables.  Within-bucket growth skips straight to swap.
+        prewarm = []
+        if crossed:
+            for sc in self._all_scorers():
+                rep = sc.prewarm_tenant_axis(target)
+                prewarm.append(rep)
+                self._emit("growth_warm", table_rows=rep["table_rows"],
+                           buckets=rep["buckets"],
+                           compiles=rep["compiles"],
+                           seconds=round(rep["seconds"], 6))
+        warm_s = time.perf_counter() - t0
+
+        # 2. swap: one registration step; the generation bump publishes
+        # the grown tables to every generation-following scorer.
+        t1 = time.perf_counter()
+        if self.loop is not None:
+            self.loop.grow(new)
+        else:
+            for t in sorted(new):
+                self.family.register(t, new[t])  # v1 auto-deploys
+        swap_s = time.perf_counter() - t1
+
+        report = dict(
+            added=tuple(sorted(new)), tenants=len(self.family),
+            crossed=crossed, table_rows=tenant_bucket(len(self.family)),
+            prewarm=tuple(prewarm), warm_s=warm_s, swap_s=swap_s,
+            total_s=time.perf_counter() - t0)
+        self._emit("growth_end", tenants=report["tenants"],
+                   crossed=crossed,
+                   prewarm_compiles=sum(r["compiles"] for r in prewarm),
+                   total_s=round(report["total_s"], 6))
+        return report

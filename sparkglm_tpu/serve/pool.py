@@ -1,0 +1,324 @@
+"""Multi-engine serving tier: N :class:`AsyncEngine` instances over one
+published :class:`ModelFamily`, engine-level health, zero lost requests.
+
+One engine is one event loop, one scheduler, one process's worth of blast
+radius.  :class:`EnginePool` runs several engines side by side — each
+over its OWN :class:`ReplicatedScorer` (private device tables, private
+executable warm state) — and routes requests across them through the
+same circuit-breaker state machine the engines use per replica
+(serve/health.py, one level up): a dead engine is ejected after
+``eject_after`` consecutive submission failures, its traffic re-routes
+to the survivors, and because every engine serves the same
+generation-synced family at the same padded tenant bucket, re-routing
+never recompiles anything.
+
+Cross-process family sync is a file: :class:`FamilyStore` publishes the
+serialized family (models/serialize.py v5 — byte-deterministic) next to
+a GENERATION stamp, blob first, stamp second, both atomic renames
+(robust/checkpoint.py), so a poller that sees generation g can always
+load a blob of at least generation g.  :meth:`EnginePool.sync` polls the
+stamp — a cheap stat-and-read — and on movement loads the blob once and
+re-registers the changed members into the pool's family; every engine's
+scorer then re-snapshots recompile-free on its next batch (the
+``refresh()``-per-batch hook growth and deploys already ride).
+
+Loss accounting is the contract the chaos test enforces: ``submit``
+either returns a Future that RESOLVES (value or typed error) or raises
+:class:`Overloaded` synchronously — a request accepted by the pool is
+never dropped when an engine dies mid-queue, because a submission
+failure on one engine falls through to the next admissible engine in
+the same call, and a future failed by a dying engine's drain is retried
+once on a survivor by the pool's resubmit hook.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ..robust.checkpoint import atomic_write_bytes
+from ..robust.retry import Overloaded, ReplicaUnavailable
+from .async_engine import AsyncEngine, ReplicatedScorer
+from .health import ReplicaHealth
+
+__all__ = ["FamilyStore", "EnginePool"]
+
+_BLOB = "family.npz"
+_STAMP = "GENERATION"
+
+
+class FamilyStore:
+    """Single-writer published-family directory (module doc).
+
+    The WRITER (the learning plane / growth coordinator) calls
+    :meth:`publish` after deploys; READERS (engine pools, possibly in
+    other processes) poll :meth:`generation` and :meth:`load`.  Ordering
+    contract: the blob rename lands BEFORE the stamp rename, so the
+    stamp never advertises a generation the blob does not carry.
+    """
+
+    def __init__(self, directory):
+        self.directory = os.fspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    @property
+    def blob_path(self) -> str:
+        return os.path.join(self.directory, _BLOB)
+
+    def publish(self, family) -> int:
+        """Serialize ``family`` and publish it; returns the generation
+        stamped.  Byte-deterministic: same family state, same blob."""
+        import io
+        from ..models.serialize import save_model
+        gen = family.generation()
+        buf = io.BytesIO()
+        save_model(family, buf)
+        atomic_write_bytes(self.blob_path, buf.getvalue())
+        atomic_write_bytes(os.path.join(self.directory, _STAMP),
+                           f"{gen}\n".encode())
+        return gen
+
+    def generation(self) -> int | None:
+        """The published generation, or None before the first publish —
+        a cheap poll (one small read, no deserialization)."""
+        try:
+            with open(os.path.join(self.directory, _STAMP), "rb") as f:
+                return int(f.read().strip())
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def load(self):
+        """Deserialize the published family (generation included — the
+        registry persists its counter)."""
+        from ..models.serialize import load_model
+        return load_model(self.blob_path)
+
+
+class EnginePool:
+    """N async engines over one family, health-routed (module doc).
+
+    Args:
+      family: the served :class:`ModelFamily`, or a :class:`FamilyStore`
+        to load it from (and poll via :meth:`sync`).
+      n_engines: engines to run (>= 1); each gets a private
+        :class:`ReplicatedScorer` over ``devices`` (default: all).
+      policy: per-engine :class:`EnginePolicy`.
+      health: engine-level :class:`HealthPolicy` (breaker thresholds);
+        each engine also keeps its own per-replica health plane.
+      fault_plan: a :class:`~..robust.faults.FaultPlan` whose
+        ``on_engine_submit`` hook fires on every routed submission — the
+        chaos test's dead-engine injection.
+      engine_fault_plans: optional ``{engine_index: FaultPlan}`` handed
+        to the named engines themselves (replica-level faults INSIDE an
+        engine — the mid-flight-death chaos scenario: an engine whose
+        replicas all die fails its queued futures with
+        ``ReplicaUnavailable`` and the pool resubmits them on a
+        survivor).
+      engine_health: per-replica :class:`HealthPolicy` forwarded to each
+        engine (e.g. a small ``max_attempts`` so a fully-dead engine
+        fails futures out fast instead of retrying forever).
+      telemetry / metrics: obs/ wiring shared by the engines.
+      store: optional :class:`FamilyStore` to poll (implied when
+        ``family`` IS a store).
+    """
+
+    def __init__(self, family, n_engines: int = 2, *, policy=None,
+                 devices=None, precision=None, health=None,
+                 fault_plan=None, engine_fault_plans=None,
+                 engine_health=None, telemetry=None, metrics=None,
+                 store=None, name: str | None = None):
+        if int(n_engines) < 1:
+            raise ValueError(f"n_engines must be >= 1, got {n_engines}")
+        if isinstance(family, FamilyStore):
+            store = family
+            family = store.load()
+        self.family = family
+        self.store = store
+        self.name = name if name is not None else f"{family.name}-pool"
+        self.n_engines = int(n_engines)
+        self._fault_plan = fault_plan
+        self._lock = threading.Lock()
+        self._rr = 0                       # round-robin cursor
+        self.resubmits = 0                 # futures retried on a survivor
+        self.lost = 0                      # futures no engine could take
+        self._synced_generation = family.generation()
+        self.scorers = [
+            ReplicatedScorer(family, devices=devices, precision=precision,
+                             name=f"{self.name}-e{i}")
+            for i in range(self.n_engines)]
+        plans = engine_fault_plans or {}
+        self.engines = [
+            AsyncEngine(self.scorers[i], policy,
+                        name=f"{self.name}-e{i}", telemetry=telemetry,
+                        metrics=metrics, health=engine_health,
+                        fault_plan=plans.get(i))
+            for i in range(self.n_engines)]
+        self.health = ReplicaHealth(
+            self.n_engines, health,
+            emit=self.engines[0]._emit)
+
+    # -- routing --------------------------------------------------------------
+
+    def _order(self) -> list:
+        """Round-robin engine order starting at the rotating cursor —
+        every candidate appears once, so a submission can fall through
+        every admissible engine before giving up."""
+        with self._lock:
+            start = self._rr
+            self._rr = (self._rr + 1) % self.n_engines
+        return [(start + i) % self.n_engines
+                for i in range(self.n_engines)]
+
+    def submit(self, data, *, tenant: str | None = None, offset=None,
+               deadline: float | None = None):
+        """Route one request to a healthy engine; returns its Future.
+
+        Falls through engines on submission failure (injected fault,
+        closed engine, full queue): the request is only lost if EVERY
+        engine refuses, and that surfaces synchronously as the last
+        refusal — an accepted Future always resolves.  A future failed
+        later by a dying engine's drain is resubmitted once on a
+        survivor (``_resubmit``), keeping the zero-lost-requests
+        contract under mid-flight engine death.
+        """
+        last_exc: Exception | None = None
+        for i in self._order():
+            if not self.health.admit(i):
+                continue
+            try:
+                if self._fault_plan is not None:
+                    self._fault_plan.on_engine_submit(i)
+                inner = self.engines[i].submit(
+                    data, tenant=tenant, offset=offset, deadline=deadline)
+            except (ReplicaUnavailable, RuntimeError, Overloaded) as exc:
+                self.health.on_failure(i, exc)
+                last_exc = exc
+                continue
+            self.health.on_success(i)
+            outer = _RoutedFuture.wrap(
+                self, inner, i, data, tenant, offset, deadline)
+            return outer
+        with self._lock:
+            self.lost += 1
+        raise last_exc if last_exc is not None else Overloaded(
+            f"no admissible engine in pool {self.name!r}")
+
+    def _resubmit(self, outer, exc, engine, data, tenant, offset,
+                  deadline) -> bool:
+        """One survivor retry for a future failed by engine death
+        (RuntimeError from a closing engine / ReplicaUnavailable).
+        Returns whether the request was re-routed."""
+        self.health.on_failure(engine, exc)
+        for i in self._order():
+            if i == engine or not self.health.admit(i):
+                continue
+            try:
+                if self._fault_plan is not None:
+                    self._fault_plan.on_engine_submit(i)
+                inner = self.engines[i].submit(
+                    data, tenant=tenant, offset=offset, deadline=deadline)
+            except (ReplicaUnavailable, RuntimeError, Overloaded) as e2:
+                self.health.on_failure(i, e2)
+                continue
+            self.health.on_success(i)
+            with self._lock:
+                self.resubmits += 1
+            _RoutedFuture.chain(self, outer, inner, i, data, tenant,
+                                offset, deadline)
+            return True
+        with self._lock:
+            self.lost += 1
+        return False
+
+    # -- family sync ----------------------------------------------------------
+
+    def sync(self) -> bool:
+        """Poll the store's generation stamp; on movement load the blob
+        and fold the changed members into the pool's family (register +
+        deploy).  Every engine's scorer re-snapshots on its next batch —
+        recompile-free while the tenant bucket holds, and recompile-free
+        across bucket growth too when the publisher prewarmed
+        (serve/growth.py).  Returns whether anything changed."""
+        if self.store is None:
+            raise RuntimeError(f"pool {self.name!r} has no FamilyStore")
+        gen = self.store.generation()
+        if gen is None or gen == self._synced_generation:
+            return False
+        fresh = self.store.load()
+        for t in fresh.tenants():
+            dv = fresh.deployed_version(t)
+            if t not in self.family.tenants():
+                self.family.register(t, fresh.model(t, dv))
+            elif not np.array_equal(
+                    np.asarray(fresh.model(t, dv).coefficients),
+                    np.asarray(self.family.model(t).coefficients)):
+                self.family.register(t, fresh.model(t, dv), deploy=True)
+        self._synced_generation = gen
+        return True
+
+    def prewarm_tenant_axis(self, n_tenants: int) -> tuple:
+        """Warm every engine's scorer for a coming bucket crossing
+        (serve/growth.py calls this through the growth coordinator when
+        the pool's scorers are attached)."""
+        return tuple(sc.prewarm_tenant_axis(n_tenants)
+                     for sc in self.scorers)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def stats(self) -> dict:
+        return dict(
+            engines=self.n_engines,
+            states=self.health.states(),
+            ejections=self.health.ejections,
+            recoveries=self.health.recoveries,
+            resubmits=self.resubmits,
+            lost=self.lost,
+            compiles=[sc.compiles for sc in self.scorers],
+            engine_health=[e.health.states() for e in self.engines])
+
+    def close(self) -> None:
+        for e in self.engines:
+            e.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class _RoutedFuture:
+    """Glue for the resubmit hook: an OUTER future the caller holds,
+    chained to whatever INNER engine future currently backs it.  A
+    terminal inner failure that looks like engine death re-routes once;
+    every other outcome propagates."""
+
+    _FATAL = (ReplicaUnavailable, RuntimeError)
+
+    @classmethod
+    def wrap(cls, pool, inner, engine, data, tenant, offset, deadline):
+        from concurrent.futures import Future
+        outer = Future()
+        cls.chain(pool, outer, inner, engine, data, tenant, offset,
+                  deadline)
+        return outer
+
+    @classmethod
+    def chain(cls, pool, outer, inner, engine, data, tenant, offset,
+              deadline) -> None:
+        def done(f):
+            exc = f.exception()
+            if exc is None:
+                if not outer.cancelled():
+                    outer.set_result(f.result())
+                return
+            if isinstance(exc, cls._FATAL) and not outer.cancelled():
+                if pool._resubmit(outer, exc, engine, data, tenant,
+                                  offset, deadline):
+                    return
+            if not outer.cancelled():
+                outer.set_exception(exc)
+        inner.add_done_callback(done)

@@ -470,6 +470,7 @@ class ModelFamily:
             fam_meta = dict(
                 name=self.name,
                 history_cap=self.history_cap,
+                generation=self._generation,
                 deployed={t: self._entries[t].deployed
                           for t in sorted(self._entries)},
                 history={t: list(self._entries[t].history)
@@ -492,6 +493,13 @@ class ModelFamily:
                 e.history = [int(v)
                              for v in (meta.get("history") or {})
                              .get(tenant, [] if dep is None else [dep])]
+        # the generation counter round-trips (artifacts older than v5's
+        # growth support carry none — they restore at 0, a fresh line of
+        # generations): serving tiers that poll a serialized family
+        # (serve/pool.FamilyStore) compare generations across processes,
+        # so a restored family must report the generation it was
+        # published at, not restart its own clock
+        fam._generation = int(meta.get("generation", 0))
         return fam
 
 

@@ -70,6 +70,10 @@ def pytest_configure(config):
         "durable online journal — replica health, deadlines, hedging, "
         "WAL resume (`make chaos` selects these; still tier-1 by "
         "default)")
+    config.addinivalue_line(
+        "markers", "tenancy: elastic tenancy under fire — zero-downtime "
+        "family growth, sharded online learning, the multi-engine pool "
+        "(`make elastic_tenancy` selects these; still tier-1 by default)")
 
 
 @pytest.fixture(scope="session")

@@ -374,6 +374,79 @@ def test_loop_resume_bit_identical_under_prefetch(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# journal compaction safety: snapshot-prune vs in-flight append
+# ---------------------------------------------------------------------------
+
+def test_journal_snapshot_prune_never_drops_inflight_append(tmp_path):
+    """Hammer concurrent append/snapshot on one journal: the snapshot's
+    prune scan must never unlink a record newer than the snapshot's
+    chunk, no matter how the two writers interleave — after every
+    snapshot the journal still covers [snap+1 .. newest] gap-free, so
+    resume never loses an applied-but-unsnapshotted chunk."""
+    import threading
+
+    from sparkglm_tpu.online import OnlineJournal
+
+    labels = _labels(4)
+    fam = _seed_family(labels, np.zeros((4, P)), "race", n=16, seed=5)
+    loop = OnlineLoop(fam, window_rows=8)
+    j = OnlineJournal(tmp_path / "wal", snapshot_every=1)
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(4, P))
+    y = np.zeros(4)
+    tenants = np.array([labels[0]] * 4)
+
+    appended = []               # append order == chunk order (one writer)
+    errors = []
+    stop = threading.Event()
+
+    def writer():
+        try:
+            c = 0
+            while not stop.is_set() and c < 400:
+                c += 1
+                j.append(c, tenants, X, y)
+                appended.append(c)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+        finally:
+            stop.set()
+
+    def snapshotter():
+        try:
+            while not stop.is_set():
+                if not appended:
+                    continue
+                snap_c = appended[-1]
+                loop._chunks = snap_c
+                j.snapshot(loop)
+                # the invariant under fire: everything newer than the
+                # snapshot survived the prune that just ran
+                newest = appended[-1]
+                have = {c for c, _ in j.records(after=snap_c)}
+                missing = set(range(snap_c + 1, newest + 1)) - have
+                assert not missing, (snap_c, newest, missing)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+            stop.set()
+
+    ts = [threading.Thread(target=writer),
+          threading.Thread(target=snapshotter)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert j.snapshots > 3  # the hammer genuinely interleaved
+    # terminal state: latest snapshot + surviving records cover the
+    # stream gap-free up to the newest append
+    snap_c, _ = j.latest_snapshot()
+    recs = [c for c, _ in j.records(after=snap_c)]
+    assert recs == list(range(snap_c + 1, appended[-1] + 1))
+
+
+# ---------------------------------------------------------------------------
 # satellites: history bound, chunk tee, front-end
 # ---------------------------------------------------------------------------
 

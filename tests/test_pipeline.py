@@ -454,6 +454,43 @@ def test_fast_streams_never_degrade():
     assert not stats.degraded
 
 
+def test_degrade_then_restore_when_overlap_pays_again():
+    """Continuous controller: a produce-dominated head degrades the pass,
+    but once consumer compute appears the rolling sequential window
+    re-prices the trade-off and pipelining is restored mid-pass."""
+    def make_iter():
+        for i in range(20):
+            time.sleep(0.15)
+            yield i
+
+    stats = pipeline.PassStats()
+    got = []
+    for item in pipeline.prefetch_iter(make_iter, prefetch=2, stats=stats):
+        got.append(item)
+        if item >= 4:
+            time.sleep(0.15)  # compute returns: overlap pays again
+    assert got == list(range(20))
+    assert stats.degraded and stats.degrades >= 1
+    assert stats.restores >= 1
+
+
+def test_failed_restore_backs_off_exponentially():
+    """A stream where overlap NEVER pays re-degrades right after each
+    restore trial, and each failed restore doubles the sequential window
+    before the next trial — the controller's thrash bound.  Degrades can
+    exceed restores by at most one (the currently-open degraded phase)."""
+    def make_iter():
+        for i in range(18):
+            time.sleep(0.14)
+            yield i
+
+    stats = pipeline.PassStats()
+    got = list(pipeline.prefetch_iter(make_iter, prefetch=2, stats=stats))
+    assert got == list(range(18))
+    assert stats.degrades >= 2 and stats.restores >= 1
+    assert stats.degrades <= stats.restores + 1
+
+
 def test_degraded_pass_emits_prefetch_degraded_event():
     """Streaming surfaces PassStats.degraded as a prefetch_degraded trace
     event right before the queue_wait/prefetch_depth pair, and

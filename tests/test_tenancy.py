@@ -1,0 +1,441 @@
+"""Elastic tenancy under fire (ISSUE r16, ROADMAP item 2).
+
+The three-legged elasticity plane, chaos-tested:
+
+  * zero-downtime growth — a bucket-crossing tenant registration under
+    LIVE traffic: the warm-then-swap coordinator (serve/growth.py)
+    compiles the next tenant bucket off the hot path, so post-growth
+    serving pays zero recompiles, drops zero requests, and scores the
+    old tenants byte-identically;
+  * sharded continuous learning — ``ShardedOnlineLoop`` statistics
+    combine bit-identically to an unsharded control, and a REAL SIGKILL
+    mid-chunk resumes every shard from its own WAL into the same bytes;
+  * multi-engine serving — a pool engine dying mid-load (all its
+    replicas fail) has its queued futures resubmitted on the survivor:
+    every accepted request resolves, zero lost.
+
+The ``ModelFamily`` growth-boundary serialization round-trip (deploy
+history, generation counter, sticky A/B splits) rides along.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkglm_tpu.fleet import glm_fit_fleet
+from sparkglm_tpu.online import OnlineLoop, ShardedOnlineLoop, shard_of
+from sparkglm_tpu.robust import FaultPlan
+from sparkglm_tpu.serve import (EnginePolicy, EnginePool, FamilyGrowth,
+                                FamilyScorer, HealthPolicy, ModelFamily,
+                                family_score_cache_size, tenant_bucket)
+
+pytestmark = pytest.mark.tenancy
+
+P = 3
+
+
+def _labels(K, prefix="t"):
+    return tuple(f"{prefix}{i:02d}" for i in range(K))
+
+
+def _fit_fleet(labels, beta, n=48, seed=0):
+    r = np.random.default_rng(seed)
+    K = len(labels)
+    X = r.normal(size=(K, n, P))
+    y = np.stack([X[k] @ beta[k] + 0.05 * r.normal(size=n)
+                  for k in range(K)])
+    return glm_fit_fleet(X, y, family="gaussian", link="identity",
+                         labels=labels)
+
+
+def _seed_family(labels, beta, name, n=48, seed=0):
+    return ModelFamily.from_fleet(_fit_fleet(labels, beta, n=n, seed=seed),
+                                  name)
+
+
+def _chunk(labels, beta, rows_per, seed, noise=0.05):
+    r = np.random.default_rng(seed)
+    ten, Xs, ys = [], [], []
+    for k, t in enumerate(labels):
+        X = r.normal(size=(rows_per, P))
+        ten.extend([t] * rows_per)
+        Xs.append(X)
+        ys.append(X @ beta[k] + noise * r.normal(size=rows_per))
+    return np.array(ten), np.concatenate(Xs), np.concatenate(ys)
+
+
+# ---------------------------------------------------------------------------
+# satellite: serialization round-trip across a bucket-growth boundary
+# ---------------------------------------------------------------------------
+
+def test_family_roundtrip_across_growth_boundary(tmp_path):
+    """Grow a family across the power-of-2 tenant bucket, mutate its
+    deploy history, then serialize: deploy history, generation counter
+    and sticky A/B assignments all survive the round trip byte-for-byte,
+    and the artifact itself is byte-deterministic."""
+    rng = np.random.default_rng(3)
+    labels = _labels(7)
+    beta = rng.normal(size=(11, P))
+    fleet = _fit_fleet(labels, beta[:7], seed=3)
+    fam = ModelFamily.from_fleet(fleet, "boundary")
+    # history: a v2 deploy and a rollback before the boundary
+    fam.register(labels[0], fleet[1], deploy=True)
+    fam.register(labels[1], fleet[2], deploy=True)
+    fam.rollback(labels[1])
+    assert tenant_bucket(len(fam)) == 8
+
+    new_labels = _labels(4, prefix="u")
+    new_fleet = _fit_fleet(new_labels, beta[7:], seed=4)
+    FamilyGrowth(fam).grow({t: new_fleet[k]
+                            for k, t in enumerate(new_labels)})
+    assert len(fam) == 11 and tenant_bucket(len(fam)) == 16
+    # and more history AFTER the boundary
+    fam.register(new_labels[0], new_fleet[1], deploy=True)
+    gen = fam.generation()
+    assert gen > 0
+
+    path = str(tmp_path / "grown.npz")
+    fam.save(path)
+    back = ModelFamily  # loaded via the serialize front-end
+    from sparkglm_tpu.models.serialize import load_model
+    fam2 = load_model(path)
+    assert isinstance(fam2, back)
+
+    # generation counter and the FULL deploy state round-trip
+    assert fam2.generation() == gen
+    m1, meta1 = fam._export()
+    m2, meta2 = fam2._export()
+    assert meta1 == meta2  # name, deployed, history, generation
+    assert [(t, v) for t, v, _ in m1] == [(t, v) for t, v, _ in m2]
+    for (_, _, a), (_, _, b) in zip(m1, m2):
+        assert (np.asarray(a.coefficients).tobytes()
+                == np.asarray(b.coefficients).tobytes())
+    t_a, B_a = fam.deployed_matrix()
+    t_b, B_b = fam2.deployed_matrix()
+    assert t_a == t_b and B_a.tobytes() == B_b.tobytes()
+
+    # sticky A/B splits: same challenger config over the restored family
+    # routes every key to the same arm and serves identical bytes
+    ch = {labels[0]: 1, new_labels[0]: 1}
+    keys = np.array([f"user-{i}" for i in range(64)])
+    tq = np.array(([labels[0], new_labels[0], labels[3], new_labels[2]]
+                   * 16))
+    Xq = rng.normal(size=(64, P))
+    s1 = FamilyScorer(fam, challenger=ch, ab_fraction=0.37)
+    s2 = FamilyScorer(fam2, challenger=ch, ab_fraction=0.37)
+    assert (s1.assignments(tq, keys).tobytes()
+            == s2.assignments(tq, keys).tobytes())
+    assert (np.asarray(s1.score(tq, Xq, keys=keys)).tobytes()
+            == np.asarray(s2.score(tq, Xq, keys=keys)).tobytes())
+
+    # byte-deterministic artifact: save(load(save(x))) == save(x)
+    p2 = str(tmp_path / "again.npz")
+    fam2.save(p2)
+    assert open(path, "rb").read() == open(p2, "rb").read()
+
+
+# ---------------------------------------------------------------------------
+# chaos leg a: bucket growth during live traffic
+# ---------------------------------------------------------------------------
+
+def test_growth_under_live_traffic_zero_lost_zero_recompiles():
+    """Cross the tenant bucket while a traffic thread hammers the pool:
+    every submitted request resolves (zero lost), the post-growth hot
+    path compiles NOTHING (kernel_cache_delta == 0 — the warm phase
+    prepaid it), and old-tenant scoring is byte-identical across the
+    swap."""
+    import jax
+    rng = np.random.default_rng(7)
+    labels = _labels(6)
+    beta = rng.normal(size=(10, P))
+    fam = _seed_family(labels, beta[:6], "live-grow", seed=7)
+    new_labels = _labels(4, prefix="u")
+    new_fleet = _fit_fleet(new_labels, beta[6:], seed=8)
+
+    Xq = rng.normal(size=(16, P))
+    tq0 = labels[0]
+    pool = EnginePool(fam, 2, policy=EnginePolicy(max_batch=64),
+                      devices=jax.devices()[:2])
+    try:
+        # steady state: both engines warm at batch bucket 16
+        for _ in range(4):
+            pool.submit(Xq, tenant=tq0).result(timeout=60)
+        out_before = np.asarray(pool.submit(Xq, tenant=tq0)
+                                .result(timeout=60))
+        compiles_before = [sc.compiles for sc in pool.scorers]
+
+        stop = threading.Event()
+        futs, submit_errors = [], []
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                try:
+                    futs.append(pool.submit(Xq, tenant=labels[i % 6]))
+                except BaseException as e:  # noqa: BLE001 — surfaced below
+                    submit_errors.append(e)
+                    return
+                i += 1
+                time.sleep(0.002)
+
+        t = threading.Thread(target=traffic)
+        t.start()
+        try:
+            growth = FamilyGrowth(fam, scorers=pool.scorers)
+            rep = growth.grow({t_: new_fleet[k]
+                               for k, t_ in enumerate(new_labels)})
+            time.sleep(0.1)  # post-swap traffic on the grown tables
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert not submit_errors, submit_errors
+        assert rep["crossed"] and rep["tenants"] == 10
+        assert sum(r["compiles"] for r in rep["prewarm"]) >= 0
+
+        # zero lost: every accepted future resolves with a finite value
+        for f in futs:
+            assert np.all(np.isfinite(np.asarray(f.result(timeout=60))))
+        assert pool.stats()["lost"] == 0
+        assert len(futs) > 10  # traffic genuinely overlapped the growth
+
+        # zero steady-state recompiles, measured TWO ways: the scorer
+        # counters and the process-wide kernel cache
+        cache_after_growth = family_score_cache_size()
+        out_after = np.asarray(pool.submit(Xq, tenant=tq0)
+                               .result(timeout=60))
+        out_new = np.asarray(pool.submit(Xq, tenant=new_labels[0])
+                             .result(timeout=60))
+        assert [sc.compiles for sc in pool.scorers] == compiles_before
+        assert family_score_cache_size() - cache_after_growth == 0
+
+        # bit-identical old-tenant scoring across the swap, correct new
+        assert out_before.tobytes() == out_after.tobytes()
+        exp = Xq @ np.asarray(fam.model(new_labels[0]).coefficients,
+                              np.float64)
+        np.testing.assert_allclose(out_new, exp, rtol=0, atol=1e-6)
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos leg c: an engine dies mid-load, its queue resubmits, zero lost
+# ---------------------------------------------------------------------------
+
+def test_engine_death_mid_load_reroutes_zero_lost():
+    """Kill engine 0 mid-flight (every replica dead after its first
+    dispatch): futures already queued there fail inside the engine and
+    the pool resubmits each on the survivor — all requests resolve
+    correctly, zero lost, and the pool's breaker records the failures."""
+    import jax
+    rng = np.random.default_rng(11)
+    labels = _labels(8)
+    beta = rng.normal(size=(8, P))
+    fam = _seed_family(labels, beta, "eng-death", seed=11)
+
+    dying = FaultPlan(seed=0, replica_dead_from=((0, 1), (1, 1)))
+    pool = EnginePool(
+        fam, 2, policy=EnginePolicy(max_batch=8),
+        devices=jax.devices()[:2],
+        engine_fault_plans={0: dying},
+        # fail fast INSIDE the dying engine (no in-engine retry ladder)
+        # so its queued futures surface to the pool's resubmit hook; the
+        # pool-level breaker keeps the ejection sticky for the assert
+        engine_health=HealthPolicy(eject_after=1, probe_cooldown_s=0.05,
+                                   max_attempts=1),
+        health=HealthPolicy(eject_after=3, probe_cooldown_s=60.0))
+    try:
+        reqs = []
+        for i in range(60):
+            t = labels[i % 8]
+            Xr = rng.normal(size=(4, P))
+            reqs.append((t, Xr, pool.submit(Xr, tenant=t)))
+        for t, Xr, f in reqs:
+            out = np.asarray(f.result(timeout=120))
+            exp = Xr @ np.asarray(fam.model(t).coefficients, np.float64)
+            np.testing.assert_allclose(out, exp, rtol=0, atol=1e-6)
+        st = pool.stats()
+        assert st["lost"] == 0
+        assert st["resubmits"] > 0  # the mid-flight queue re-routed
+        assert dying.faults_fired > 0
+        assert st["states"][0] == "ejected"  # the breaker saw the death
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos leg b: SIGKILL a sharded writer mid-chunk, resume bit-identical
+# ---------------------------------------------------------------------------
+
+_SHARD_KILL_SCRIPT = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from sparkglm_tpu.fleet import glm_fit_fleet
+from sparkglm_tpu.serve import ModelFamily
+from sparkglm_tpu.online import OnlineLoop, ShardedOnlineLoop
+from sparkglm_tpu.robust import FaultPlan
+
+P = 3
+labels = tuple(f"t{i:02d}" for i in range(8))  # crc32 splits 4/4 over 2
+beta = np.random.default_rng(11).normal(size=(8, P))
+KW = dict(rho=0.9, window_rows=24, reference_chunks=2, window_chunks=2)
+
+def chunk(s):
+    r = np.random.default_rng(1000 + s)
+    ten, Xs, ys = [], [], []
+    for k, t in enumerate(labels):
+        X = r.normal(size=(12, P))
+        ten.extend([t] * 12)
+        Xs.append(X)
+        ys.append(X @ (beta[k] + 0.15 * s) + 0.05 * r.normal(size=12))
+    return np.array(ten), np.concatenate(Xs), np.concatenate(ys)
+
+def seed_family(name):
+    r = np.random.default_rng(0)
+    X = r.normal(size=(8, 48, P))
+    y = np.stack([X[k] @ beta[k] + 0.05 * r.normal(size=48)
+                  for k in range(8)])
+    fleet = glm_fit_fleet(X, y, family="gaussian", link="identity",
+                          labels=labels)
+    return ModelFamily.from_fleet(fleet, name)
+
+def fingerprint(s):
+    t, B = s.family.deployed_matrix()
+    # per-SHARD versions: the WAL contract replays each shard family
+    # bit-for-bit.  The reassembled MASTER's version counters restart
+    # (it is rebuilt from shard champions), but its deployed bytes are
+    # asserted identical via `deployed`.
+    return dict(chunks=s._chunks, combined=s.digest(),
+                shards=list(s.shard_digests()),
+                deployed=B.tobytes().hex(),
+                versions=[{x: lp.family.deployed_version(x)
+                           for x in lp.family.tenants()}
+                          for lp in s.loops])
+
+mode, root, out = sys.argv[1], sys.argv[2], sys.argv[3]
+N = 8
+chunks = [chunk(s) for s in range(N)]
+if mode == "healthy":
+    s = ShardedOnlineLoop(seed_family("s"), 2, **KW)
+    u = OnlineLoop(seed_family("u"), **KW)
+    for c in chunks:
+        s.step(*c)
+        u.step(*c)
+    fp = fingerprint(s)
+    # the sharded plane's combined statistics ARE the unsharded loop's
+    fp["unsharded_combined_equal"] = bool(
+        s.digest() == u.suffstats.digest())
+elif mode == "killed":
+    s = ShardedOnlineLoop(seed_family("s"), 2, journal=root, **KW)
+    # SIGKILL fires at the chunk-5 boundary: both shard WALs have 4
+    # applied chunks, the 5th never lands anywhere
+    s.run(lambda: iter(chunks), fault_plan=FaultPlan(
+        seed=0, kill_chunk_at=(5,)))
+    raise SystemExit("unreachable: the kill must fire")
+elif mode == "resume":
+    s = ShardedOnlineLoop.resume(root)
+    assert s._chunks == 4, f"expected chunk boundary 4, got {s._chunks}"
+    for c in chunks[s._chunks:]:
+        s.step(*c)
+    fp = fingerprint(s)
+else:
+    raise SystemExit(f"bad mode {mode}")
+with open(out, "w") as f:
+    json.dump(fp, f, sort_keys=True)
+"""
+
+
+def test_shard_writer_sigkill_resume_bit_identical(tmp_path):
+    """A REAL ``kill -9`` takes the sharded learning plane down
+    mid-stream; every shard resumes from its own WAL and the finished
+    run's combined digest, per-shard digests and deploy decisions equal
+    the uninterrupted sharded run's — which itself matches the unsharded
+    control bit-for-bit."""
+    script = tmp_path / "shard_kill_child.py"
+    script.write_text(_SHARD_KILL_SCRIPT)
+    root = str(tmp_path / "wal-root")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run(mode, out):
+        return subprocess.run(
+            [sys.executable, str(script), mode, root, str(out)],
+            env=env, capture_output=True, text=True, timeout=300)
+
+    h = run("healthy", tmp_path / "healthy.json")
+    assert h.returncode == 0, h.stderr[-2000:]
+
+    k = run("killed", tmp_path / "killed.json")
+    assert k.returncode == -signal.SIGKILL, \
+        f"expected SIGKILL, got rc={k.returncode}: {k.stderr[-2000:]}"
+    assert not (tmp_path / "killed.json").exists()
+    # each shard has its own WAL directory with a snapshot base
+    shard_dirs = sorted(d for d in os.listdir(root)
+                        if d.startswith("shard-"))
+    assert len(shard_dirs) == 2
+    for d in shard_dirs:
+        assert any(f.startswith("snapshot-")
+                   for f in os.listdir(os.path.join(root, d))), d
+
+    r = run("resume", tmp_path / "resumed.json")
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    healthy = json.loads((tmp_path / "healthy.json").read_text())
+    resumed = json.loads((tmp_path / "resumed.json").read_text())
+    assert healthy.pop("unsharded_combined_equal") is True
+    assert resumed == healthy, \
+        "shard resume after SIGKILL must reproduce the healthy run"
+
+
+# ---------------------------------------------------------------------------
+# sharded-vs-unsharded bit-identity and growth routing, in-process
+# ---------------------------------------------------------------------------
+
+def test_sharded_loop_combines_bit_identical_and_grows():
+    """The sharded plane's combined suffstats equal the unsharded
+    control's bytes at every chunk boundary, the information-weighted
+    combined solve equals the unsharded solve, and growth routes new
+    tenants to their stable hash shards."""
+    rng = np.random.default_rng(5)
+    labels = _labels(8)
+    beta = rng.normal(size=(10, P))
+    fam_u = _seed_family(labels, beta[:8], "ctrl", seed=5)
+    fam_s = _seed_family(labels, beta[:8], "shrd", seed=5)
+    kw = dict(reference_chunks=2, window_chunks=2)
+    u = OnlineLoop(fam_u, **kw)
+    s = ShardedOnlineLoop(fam_s, 2, **kw)
+    for c in range(5):
+        ten, Xc, yc = _chunk(labels, beta[:8], 8, seed=100 + c)
+        u.step(ten, Xc, yc)
+        s.step(ten, Xc, yc)
+        assert s.digest() == u.suffstats.digest(), f"chunk {c}"
+    lab, bc = s.combined_solve(jitter=0.0)
+    assert lab == labels
+    np.testing.assert_allclose(bc, u.suffstats.solve(), rtol=0, atol=1e-12)
+
+    new_labels = _labels(2, prefix="u")
+    new_fleet = _fit_fleet(new_labels, beta[8:], seed=6)
+    rep = s.grow({t: new_fleet[k] for k, t in enumerate(new_labels)})
+    assert rep["tenants"] == 10
+    for t in new_labels:
+        assert t in s.loops[shard_of(t, 2)].labels
+        assert t in s.family.tenants()
+    # post-growth chunks keep stepping (the grown shard migrated its
+    # rings and gate; old tenants' accumulated mass is untouched)
+    all_labels = labels + new_labels
+    ten, Xc, yc = _chunk(all_labels, beta, 6, seed=300)
+    out = s.step(ten, Xc, yc)
+    assert out["chunk"] == 6
+    comb = s.combined_suffstats()
+    assert comb.labels == tuple(sorted(all_labels))
