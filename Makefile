@@ -9,7 +9,7 @@ SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve serve_async \
         categorical penalized elastic sketch fleet hotloop online \
-        obsplane chaos elastic_tenancy clean
+        obsplane chaos elastic_tenancy observatory clean
 
 all: native
 
@@ -141,6 +141,17 @@ chaos:
 elastic_tenancy:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m tenancy
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# performance & capacity observatory (obs/profile, obs/aggregate,
+# obs/history): cost-model MFU/bandwidth gauges, memory + compile
+# ledgers, cross-process spool merge with real OS subprocesses,
+# longitudinal bench-regression gate over BENCH_r*.json — plus the
+# capacity_observatory bench block (paired overhead gate, zero
+# steady-state compiles during serving) and the history report
+observatory:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_observatory.py -q
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+	python -m sparkglm_tpu.obs.history .
 
 clean:
 	rm -f $(SO)

@@ -187,7 +187,7 @@ def main() -> None:
     # single source of truth for the round tag is the caller
     # (benchmarks/tpu_when_alive.sh exports ROUND); default matches its
     # current value so a bare `python bench.py` is still correctly stamped
-    detail["round"] = int(os.environ.get("ROUND", "16"))
+    detail["round"] = int(os.environ.get("ROUND", "17"))
 
     def make_data(nn):
         @jax.jit
@@ -920,6 +920,93 @@ def main() -> None:
             bit_identical=bit_identical)
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["serving_trace_overhead"] = dict(error=repr(e)[:300])
+
+    # ---- capacity observatory (cost-model/ledger plane, r17) ---------------
+    # the serving_scaleout load RERUN with the full capacity observatory
+    # on — analytic cost-model MFU / bandwidth gauges priced from the
+    # kernel events the engine already emits, the memory ledger, and the
+    # compile ledger armed in steady-state mode.  The paired gate prices
+    # the observatory's MARGINAL cost: telemetry-with-profile vs the
+    # identical telemetry with profile=False, so both halves pay the
+    # (already separately gated) runtime-tracing cost and the delta is
+    # exactly what this plane adds — host-side arithmetic over events
+    # that are emitted either way.  Bit-identity is still checked
+    # against a BARE engine, plus the CI guard this block exists for:
+    # the shapes are warmed BEFORE mark_steady(), so ANY compile the
+    # ledger records during the measured serving phase fails the block.
+    try:
+        import tempfile
+
+        from sparkglm_tpu.obs import SLOSpec, Telemetry
+        from sparkglm_tpu.serve import family_score_cache_size
+
+        pol17 = EnginePolicy(max_batch=1024, max_wait_ms=0, max_queue=8192,
+                             quantum=256)
+
+        def drive17(engine):
+            futs = [engine.submit(X, tenant=t)
+                    for X, t in zip(reqs, tenants)]
+            return [f.result(120) for f in futs]
+
+        with tempfile.TemporaryDirectory() as obs_td:
+            slos17 = [SLOSpec(p99_ms=60_000.0, error_rate=0.5)]
+            tel_base = Telemetry(os.path.join(obs_td, "base"), slos=slos17,
+                                 export_interval_s=0.5, profile=False)
+            tel = Telemetry(os.path.join(obs_td, "obs"), slos=slos17,
+                            export_interval_s=0.5)
+            # bare reference run: shape warmup + the bit-identity anchor
+            with AsyncEngine(rsc, pol17, name="observatory") as eng:
+                bare_res = drive17(eng)
+            tel.sample_memory("warm")
+            tel.mark_steady()
+            cache_before17 = family_score_cache_size()
+            compiles_before17 = rsc.compiles
+
+            def run_base17():
+                with AsyncEngine(rsc, pol17, name="observatory",
+                                 telemetry=tel_base) as eng:
+                    return drive17(eng)
+
+            def run_traced17():
+                with AsyncEngine(rsc, pol17, name="observatory",
+                                 telemetry=tel) as eng:
+                    return drive17(eng)
+
+            gate, base_res, traced_res = paired_overhead_gate(
+                run_base17, run_traced17)
+            cache_delta17 = family_score_cache_size() - cache_before17
+            recompiles17 = rsc.compiles - compiles_before17
+            steady_compiles = int(tel.compile_ledger.steady_state_compiles)
+            tel.sample_memory("serving")
+            prom = tel.prometheus()
+            prof = tel.profiler.report()
+            tel.close()
+            tel_base.close()
+        bit_identical = bool(all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(bare_res, traced_res)))
+        needles = ("profile_mfu_scorer", "memory_live_bytes",
+                   "compile_ledger_steady_state_compiles")
+        gauges_present = bool(all(n in prom for n in needles))
+        scorer_prof = prof["flavors"].get("scorer", {})
+        gate["ok"] = bool(gate["ok"] and cache_delta17 == 0
+                          and recompiles17 == 0 and bit_identical
+                          and steady_compiles == 0 and gauges_present)
+        detail["capacity_observatory"] = dict(
+            **gate,
+            requests=req_total, rows=int(sum(sizes)),
+            bit_identical=bit_identical,
+            kernel_cache_delta=int(cache_delta17),
+            steady_state_recompiles=int(recompiles17),
+            steady_state_compiles=steady_compiles,
+            gauges_present=gauges_present,
+            platform=str(prof["platform"]),
+            scorer_calls=int(scorer_prof.get("calls", 0)),
+            scorer_mfu_avg=float(scorer_prof.get("mfu_avg", 0.0)),
+            scorer_gflops=round(
+                float(scorer_prof.get("flops", 0.0)) / 1e9, 3))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["capacity_observatory"] = dict(error=repr(e)[:300])
 
     # ---- serving fault recovery (self-healing plane, r15) ------------------
     # the serving_scaleout load RERUN against a 2-replica scorer with

@@ -216,14 +216,30 @@ def glm_fit_fleet(
     mi = jnp.asarray(max_iter, jnp.int32)
     jit_ = jnp.asarray(config.jitter, dtype)
     n_exec0 = fleet_kernel_cache_size()
-    out = _irls_fleet_kernel(
-        Xb, yb, wb, ob, tol_dev, mi, jit_,
-        family=fam, link=lnk, criterion=criterion,
-        refine_steps=config.refine_steps,
-        precision=config.matmul_precision, batch=batch,
-        fam_param=fam_param, beta0=bb, warm=warm)
+    from ..obs import timing as _obs_timing
+    with _obs_timing.span("fleet_kernel", tracer, device=True) as _sp:
+        out = _irls_fleet_kernel(
+            Xb, yb, wb, ob, tol_dev, mi, jit_,
+            family=fam, link=lnk, criterion=criterion,
+            refine_steps=config.refine_steps,
+            precision=config.matmul_precision, batch=batch,
+            fam_param=fam_param, beta0=bb, warm=warm)
+        _sp.watch(out)
     out = jax.tree.map(np.asarray, out)
     executables = fleet_kernel_cache_size() - n_exec0
+    if tracer is not None:
+        # one priced solve per fleet pass: the BUCKET's padded shapes are
+        # what the device actually computed (trash models included), so
+        # the capacity observatory prices B x n x p, not K x n x p
+        if executables:
+            tracer.emit("compile", target="fleet_kernel",
+                        seconds=_sp.seconds, gramian_engine="fleet",
+                        models=B, rows=n, cols=p)
+        tracer.emit("solve", target="fleet_kernel",
+                    iters=int(np.asarray(out["iters"][:K]).max()) if K
+                    else 0,
+                    seconds=_sp.seconds, gramian_engine="fleet",
+                    models=B, rows=n, cols=p)
 
     singular = out["singular"][:K].astype(bool)
     if singular.any():

@@ -1020,7 +1020,8 @@ def _emit_iter_event(i, dev, ddev, halvings) -> None:
               f"\tddev {float(ddev):.3g}", file=sys.stderr)
 
 
-def _trace_kernel_calls(run_kernel, tracer, gramian_engine=None, extra=None):
+def _trace_kernel_calls(run_kernel, tracer, gramian_engine=None, extra=None,
+                        rows=None, cols=None):
     """Wrap an engine closure so every compiled segment runs inside a
     device-aware span (obs/timing.py): blocking happens at the span edge
     only — the caller reads these outputs immediately anyway, so the
@@ -1029,12 +1030,19 @@ def _trace_kernel_calls(run_kernel, tracer, gramian_engine=None, extra=None):
     ``solve`` with the segment's iteration count.  ``gramian_engine``
     stamps both events with which X'WX assembly ran (einsum | fused |
     structured | sparse | sketch | qr); ``extra`` adds engine-specific
-    fields (the sketch engine's m and refinement count)."""
+    fields (the sketch engine's m and refinement count).  ``rows``/
+    ``cols`` stamp the design shape so the capacity observatory
+    (obs/profile.py) can price each solve with its analytic cost model —
+    host-side ints only, never touching what runs on the device."""
     from ..obs import timing as _obs_timing
     state = {"calls": 0}
     extra = dict(extra or {})
     if gramian_engine is not None:
         extra["gramian_engine"] = gramian_engine
+    if rows is not None:
+        extra["rows"] = int(rows)
+    if cols is not None:
+        extra["cols"] = int(cols)
 
     def wrapped(seg_iters, beta_arr, warm, it_base=0, dev_prev=None):
         with _obs_timing.span("irls_segment", tracer, device=True) as sp:
@@ -1235,7 +1243,8 @@ def _fit_global(
 
     if tracer is not None:
         run_kernel = _trace_kernel_calls(run_kernel, tracer, engine,
-                                         extra=_autotune_extra(autotune_rec))
+                                         extra=_autotune_extra(autotune_rec),
+                                         rows=n_global, cols=p)
     if beta0 is not None or on_iteration is not None or checkpoint_every:
         # segmented checkpointing: the multi-host recovery story — every
         # process persists beta in its on_iteration and a restarted job
@@ -1695,7 +1704,8 @@ def _fit_dispatch(
         if tracer is not None:
             run_kernel = _trace_kernel_calls(run_kernel, tracer, g_engine,
                                              extra=_autotune_extra(
-                                                 autotune_rec))
+                                                 autotune_rec),
+                                             rows=n, cols=p)
         if checkpointing:
             out = _segmented_irls(run_kernel, p=p, dtype=dtype,
                                   max_iter=max_iter, beta0=beta0,
@@ -1769,7 +1779,8 @@ def _fit_dispatch(
             run_kernel = _trace_kernel_calls(
                 run_kernel, tracer, g_engine,
                 extra={"sketch_dim": m_run,
-                       "sketch_refine": int(config.sketch_refine)})
+                       "sketch_refine": int(config.sketch_refine)},
+                rows=n, cols=p)
         if checkpointing:
             out = _segmented_irls(run_kernel, p=p, dtype=dtype,
                                   max_iter=max_iter, beta0=beta0,
@@ -1798,7 +1809,8 @@ def _fit_dispatch(
         if tracer is not None:
             run_kernel = _trace_kernel_calls(run_kernel, tracer, g_engine,
                                              extra=_autotune_extra(
-                                                 autotune_rec))
+                                                 autotune_rec),
+                                             rows=n, cols=p)
         if checkpointing:
             out = _segmented_irls(run_kernel, p=p, dtype=dtype,
                                   max_iter=max_iter, beta0=beta0,

@@ -522,7 +522,8 @@ def fit(
                 else "structured" if is_structured else "einsum")
     if _tr is not None:
         _tr.emit("solve", target="lm_kernel", p=int(p), seconds=sp.seconds,
-                 gramian_engine=g_engine)
+                 gramian_engine=g_engine, rows=int(n), cols=int(p),
+                 iters=1)
     out = jax.tree.map(np.asarray, out)
 
     if singular == "drop":

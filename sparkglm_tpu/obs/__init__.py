@@ -35,6 +35,20 @@ multi-process), the robustness layer, and the serving/online runtime:
     :class:`TelemetryExporter` JSONL time series, and the
     :class:`Telemetry` facade that wires the whole plane into
     ``AsyncEngine(telemetry=)`` and ``sg.online_fleet(telemetry=)``.
+  * :mod:`.profile` — the capacity observatory's cost models:
+    analytic FLOP/byte pricing of solve/scorer events into live
+    ``profile.mfu.*`` / ``profile.bandwidth_frac.*`` gauges
+    (:class:`Profiler`), device-memory accounting
+    (:class:`MemoryLedger`), and the :class:`CompileLedger` that keeps
+    ``compile_ledger.steady_state_compiles`` at zero after
+    :meth:`Telemetry.mark_steady`.
+  * :mod:`.aggregate` — per-process telemetry spools
+    (:class:`ProcessSpool`, via ``Telemetry(spool=root)``) and
+    :func:`merge_spools` combining them into one seq-coherent stream
+    with cross-process metric rollups.
+  * :mod:`.history` — longitudinal bench regression tracking over
+    ``BENCH_r*.json`` rounds (:func:`bench_history`, also
+    ``make observatory``).
 
 Events are host-side: tracing never changes device code, so traced and
 untraced fits — and traced and untraced SERVING — produce bit-identical
@@ -42,10 +56,14 @@ results (PARITY.md).  Fitted models carry the tracer's aggregate as
 ``model.fit_report()``.
 """
 
+from .aggregate import ProcessSpool, merge_spools, rollup_snapshots
 from .context import TraceContext
 from .context import current as current_context
 from .context import use as use_context
 from .export import Telemetry, TelemetryExporter, prometheus_text
+from .history import bench_history, regression_gate, render_report
+from .profile import (CompileLedger, CostModel, MemoryLedger, Profiler,
+                      device_memory_stats, kernel_bytes, kernel_flops)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       get_registry)
 from .slo import FlightRecorder, SLOMonitor, SLOSpec
@@ -61,4 +79,8 @@ __all__ = [
     "Span", "span", "profiler_trace", "reset_span_sampling",
     "SLOSpec", "SLOMonitor", "FlightRecorder",
     "Telemetry", "TelemetryExporter", "prometheus_text",
+    "CostModel", "Profiler", "MemoryLedger", "CompileLedger",
+    "kernel_flops", "kernel_bytes", "device_memory_stats",
+    "ProcessSpool", "merge_spools", "rollup_snapshots",
+    "bench_history", "regression_gate", "render_report",
 ]

@@ -1,0 +1,199 @@
+"""Capacity observatory, part 2: cross-process telemetry spools and merge.
+
+ROADMAP item 2 pushes the serving/learning plane across the PROCESS
+boundary; the observability plane has to get there first or the first
+multi-process deployment goes dark.  This module is the telemetry
+analogue of the parallel-and-stream combine (PAPERS.md arXiv
+2111.00032): each process appends to its OWN spool file — no shared
+memory, no cross-process locks — and a master merges the spools into
+one coherent stream after (or during) the run.
+
+  * :class:`ProcessSpool` — a :class:`~.export.TelemetryExporter` whose
+    JSONL lines additionally carry the process/shard label (``proc``)
+    and a per-spool monotone ``seq``.  One file per process under a
+    shared root dir; concurrent processes never write the same file, so
+    there is no interleaving to corrupt.
+  * :func:`read_spool` / :func:`merge_spools` — load every spool under
+    a root, verify per-process seq coherence (strictly increasing,
+    contiguous from 0 — a torn or interleaved write surfaces as a parse
+    error or a seq gap, never as silent corruption), produce one merged
+    stream ordered by ``(t, proc, seq)`` (which preserves each
+    process's own order exactly), and roll the final snapshots up into
+    one registry-shaped dict: counters sum across processes, log2
+    histograms merge bucket-wise, gauges take the latest writer.
+
+Wired into the plane via ``Telemetry(spool=root, spool_label=...)`` —
+:class:`~.serve.pool.EnginePool` workers, sharded online loops
+(:class:`~.online.sharding.ShardedOnlineLoop`), and growth controllers
+all spool through their Telemetry the same way they already export.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+
+from .export import TelemetryExporter
+from .metrics import MetricsRegistry, _bucket_quantile
+
+__all__ = ["ProcessSpool", "read_spool", "merge_spools", "rollup_snapshots"]
+
+
+class ProcessSpool(TelemetryExporter):
+    """A per-process telemetry spool: ``<root>/<label>.jsonl``.
+
+    Same schema as :class:`~.export.TelemetryExporter` (``t`` +
+    ``metrics`` snapshot per line) plus ``proc`` (the process/shard
+    label, default ``proc-<pid>``) and ``seq`` (per-spool monotone line
+    number from 0) — the fields the merge needs to prove coherence.
+    """
+
+    def __init__(self, root: str | os.PathLike, registry: MetricsRegistry,
+                 *, label: str | None = None, interval_s: float = 10.0):
+        self.label = str(label) if label else f"proc-{os.getpid()}"
+        if "/" in self.label or "\0" in self.label:
+            raise ValueError(f"spool label must be a filename-safe string, "
+                             f"got {self.label!r}")
+        self.root = os.fspath(root)
+        super().__init__(os.path.join(self.root, f"{self.label}.jsonl"),
+                         registry, interval_s=interval_s)
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+
+    def export_now(self) -> None:
+        with self._seq_lock:
+            seq = self._seq
+            self._seq += 1
+        line = json.dumps({"t": time.time(), "proc": self.label,
+                           "seq": seq,
+                           "metrics": self.registry.snapshot()},
+                          sort_keys=True)
+        with self._lock:
+            os.makedirs(self.root, exist_ok=True)
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+            self.exports += 1
+
+
+def read_spool(path: str | os.PathLike) -> list[dict]:
+    """Load one spool; raises ``ValueError`` on a corrupt line (torn
+    write / interleaving), naming the file and line number."""
+    out = []
+    with open(path, "r", encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise ValueError(
+                    f"corrupt spool line {path}:{i + 1}: {exc}") from None
+            if "metrics" not in rec:
+                raise ValueError(
+                    f"spool line {path}:{i + 1} has no metrics snapshot")
+            out.append(rec)
+    return out
+
+
+def _merge_histograms(snaps: list[dict]) -> dict:
+    """Bucket-wise merge of log2 histogram snapshots (same shape as
+    :meth:`~.metrics.Histogram.snapshot`)."""
+    count = sum(int(h.get("count", 0)) for h in snaps)
+    total = sum(float(h.get("sum", 0.0)) for h in snaps)
+    mins = [h["min"] for h in snaps if h.get("min") is not None]
+    maxs = [h["max"] for h in snaps if h.get("max") is not None]
+    buckets: dict[int, int] = {}
+    for h in snaps:
+        for key, n in (h.get("bucket_le") or {}).items():
+            k = int(key[2:])  # "2^k"
+            buckets[k] = buckets.get(k, 0) + int(n)
+    mn = min(mins) if mins else None
+    mx = max(maxs) if maxs else None
+    q = (lambda p: _bucket_quantile(p, count, total, mn, mx, buckets)) \
+        if count else (lambda p: None)
+    return {
+        "count": count, "sum": total, "min": mn, "max": mx,
+        "mean": total / count if count else None,
+        "p50": q(0.5), "p99": q(0.99),
+        "bucket_le": {f"2^{k}": n for k, n in sorted(buckets.items())},
+    }
+
+
+def rollup_snapshots(snapshots: dict[str, dict]) -> dict:
+    """Combine each process's FINAL snapshot into one registry-shaped
+    dict: counters sum, histograms merge bucket-wise, gauges keep a
+    per-process view plus the cross-process max (``last`` semantics
+    have no cross-process total).  ``snapshots`` maps proc label ->
+    snapshot dict."""
+    counters: dict[str, float] = {}
+    gauges: dict[str, dict] = {}
+    hists: dict[str, list] = {}
+    for proc in sorted(snapshots):
+        snap = snapshots[proc]
+        for name, v in (snap.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + v
+        for name, v in (snap.get("gauges") or {}).items():
+            gauges.setdefault(name, {})[proc] = v
+        for name, h in (snap.get("histograms") or {}).items():
+            hists.setdefault(name, []).append(h)
+    return {
+        "counters": counters,
+        "gauges": {name: {"by_proc": per,
+                          "max": max((v for v in per.values()
+                                      if v is not None), default=None)}
+                   for name, per in gauges.items()},
+        "histograms": {name: _merge_histograms(snaps)
+                       for name, snaps in hists.items()},
+    }
+
+
+def merge_spools(root: str | os.PathLike) -> dict:
+    """Merge every ``*.jsonl`` spool under ``root``.
+
+    Returns::
+
+        {"processes": {label: {"lines", "t_first", "t_last"}},
+         "stream":    [...],      # all lines, (t, proc, seq)-ordered
+         "rollup":    {...},      # rollup_snapshots of final snapshots
+         "seq_coherent": bool,    # every spool contiguous from 0
+         "errors":    [...]}      # coherence violations, if any
+
+    Ordering by ``(t, proc, seq)`` preserves each process's own line
+    order exactly (t is non-decreasing within a spool and seq breaks
+    ties), so the merged stream is seq-coherent per process by
+    construction once the per-spool check passes.
+    """
+    spools: dict[str, list[dict]] = {}
+    errors: list[str] = []
+    for path in sorted(glob.glob(os.path.join(os.fspath(root), "*.jsonl"))):
+        for rec in read_spool(path):
+            label = str(rec.get("proc",
+                                os.path.splitext(os.path.basename(path))[0]))
+            spools.setdefault(label, []).append(rec)
+    for label, recs in sorted(spools.items()):
+        seqs = [int(r.get("seq", -1)) for r in recs]
+        if seqs != list(range(len(seqs))):
+            errors.append(
+                f"spool {label!r}: seq sequence {seqs[:20]} is not "
+                f"contiguous from 0 — torn write or lost line")
+    stream = sorted(
+        (r for recs in spools.values() for r in recs),
+        key=lambda r: (r.get("t", 0.0), str(r.get("proc", "")),
+                       int(r.get("seq", 0))))
+    finals = {label: recs[-1]["metrics"]
+              for label, recs in spools.items() if recs}
+    return {
+        "processes": {
+            label: {"lines": len(recs),
+                    "t_first": recs[0].get("t"),
+                    "t_last": recs[-1].get("t")}
+            for label, recs in sorted(spools.items())},
+        "stream": stream,
+        "rollup": rollup_snapshots(finals),
+        "seq_coherent": not errors,
+        "errors": errors,
+    }

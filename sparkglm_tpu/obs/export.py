@@ -33,12 +33,14 @@ import threading
 import time
 
 from .metrics import MetricsRegistry
+from .profile import CompileLedger, MemoryLedger, Profiler
 from .slo import FlightRecorder, SLOMonitor, SLOSpec
 from .trace import FitTracer, RingBufferSink
 
 __all__ = ["prometheus_text", "TelemetryExporter", "Telemetry"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_RE = re.compile(r"^(?P<base>[^{]+)\{(?P<labels>.*)\}$", re.DOTALL)
 
 
 def _prom_name(name: str) -> str:
@@ -46,6 +48,33 @@ def _prom_name(name: str) -> str:
     if n and n[0].isdigit():
         n = "_" + n
     return n
+
+
+def _prom_escape_label(v: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, and
+    newline must be escaped; everything else passes through."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_series(name: str) -> tuple[str, str]:
+    """Split a registry metric name into (prom base name, label suffix).
+
+    Names may carry label syntax — ``profile.mfu{flavor=einsum}`` —
+    rendered as ``profile_mfu{flavor="einsum"}`` with values properly
+    escaped.  Plain names (no ``{...}``) render label-free exactly as
+    before.  Label values may contain anything except an unescaped
+    comma (the pair separator)."""
+    m = _LABEL_RE.match(name)
+    if not m:
+        return _prom_name(name), ""
+    pairs = []
+    for part in m.group("labels").split(","):
+        if not part.strip():
+            continue
+        k, _, v = part.partition("=")
+        pairs.append(f'{_prom_name(k.strip())}="{_prom_escape_label(v)}"')
+    return _prom_name(m.group("base")), "{" + ",".join(pairs) + "}"
 
 
 def _prom_value(v) -> str:
@@ -67,26 +96,35 @@ def prometheus_text(registry: MetricsRegistry) -> str:
     with :meth:`Histogram.quantile` to bucket resolution."""
     snap = registry.snapshot()
     lines: list[str] = []
+    typed: set[str] = set()  # one TYPE line per metric family
+
+    def _type(n: str, kind: str) -> None:
+        if n not in typed:
+            typed.add(n)
+            lines.append(f"# TYPE {n} {kind}")
+
     for name, value in snap["counters"].items():
-        n = _prom_name(name)
-        lines.append(f"# TYPE {n} counter")
-        lines.append(f"{n} {_prom_value(value)}")
+        n, lab = _prom_series(name)
+        _type(n, "counter")
+        lines.append(f"{n}{lab} {_prom_value(value)}")
     for name, value in snap["gauges"].items():
-        n = _prom_name(name)
-        lines.append(f"# TYPE {n} gauge")
-        lines.append(f"{n} {_prom_value(value)}")
+        n, lab = _prom_series(name)
+        _type(n, "gauge")
+        lines.append(f"{n}{lab} {_prom_value(value)}")
     for name, h in snap["histograms"].items():
-        n = _prom_name(name)
-        lines.append(f"# TYPE {n} histogram")
+        n, lab = _prom_series(name)
+        _type(n, "histogram")
+        inner = lab[1:-1] + "," if lab else ""
         cum = 0
         # bucket_le keys are "2^k" strings; emit in ascending k order
         ks = sorted(int(key[2:]) for key in h["bucket_le"])
         for k in ks:
             cum += h["bucket_le"][f"2^{k}"]
-            lines.append(f'{n}_bucket{{le="{_prom_value(2.0 ** k)}"}} {cum}')
-        lines.append(f'{n}_bucket{{le="+Inf"}} {h["count"]}')
-        lines.append(f"{n}_sum {_prom_value(h['sum'])}")
-        lines.append(f"{n}_count {h['count']}")
+            lines.append(f'{n}_bucket{{{inner}le="{_prom_value(2.0 ** k)}"}}'
+                         f" {cum}")
+        lines.append(f'{n}_bucket{{{inner}le="+Inf"}} {h["count"]}')
+        lines.append(f"{n}_sum{lab} {_prom_value(h['sum'])}")
+        lines.append(f"{n}_count{lab} {h['count']}")
     return "\n".join(lines) + "\n"
 
 
@@ -183,7 +221,10 @@ class Telemetry:
                  ring_capacity: int = 4096, flight_capacity: int = 2048,
                  cooldown_s: float = 30.0, include_times: bool = False,
                  export_interval_s: float | None = None,
-                 sinks=(), metrics: MetricsRegistry | None = None):
+                 sinks=(), metrics: MetricsRegistry | None = None,
+                 profile: bool = True,
+                 spool: str | os.PathLike | None = None,
+                 spool_label: str | None = None):
         self.dir = os.fspath(dir) if dir is not None else None
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.ring = RingBufferSink(ring_capacity)
@@ -192,6 +233,9 @@ class Telemetry:
             metrics=self.metrics, window_s=window_s)
         self.recorder: FlightRecorder | None = None
         self.exporter: TelemetryExporter | None = None
+        self.profiler: Profiler | None = None
+        self.compile_ledger: CompileLedger | None = None
+        self.memory: MemoryLedger | None = None
         sink_list: list = [self.ring]
         if self.dir is not None:
             self.recorder = FlightRecorder(
@@ -203,6 +247,20 @@ class Telemetry:
                 os.path.join(self.dir, "metrics.jsonl"), self.metrics,
                 interval_s=(export_interval_s if export_interval_s
                             else 10.0))
+        if spool is not None:
+            # Per-process spool replaces the plain exporter: same JSONL
+            # schema plus proc/seq fields so merge_spools can prove
+            # cross-process coherence (obs/aggregate.py).
+            from .aggregate import ProcessSpool  # avoid import cycle
+            self.exporter = ProcessSpool(
+                spool, self.metrics, label=spool_label,
+                interval_s=(export_interval_s if export_interval_s
+                            else 10.0))
+        if profile:
+            self.profiler = Profiler(self.metrics)
+            self.compile_ledger = CompileLedger(self.metrics)
+            self.memory = MemoryLedger(self.metrics)
+            sink_list.extend([self.profiler, self.compile_ledger])
         sink_list.append(self.monitor)
         sink_list.extend(sinks)
         self.tracer = FitTracer(sink_list, metrics=self.metrics)
@@ -224,6 +282,19 @@ class Telemetry:
         """One (rate-limited) SLO evaluation pass; returns new
         violations.  Called by the engine after each batch."""
         return self.monitor.evaluate(force=force)
+
+    def mark_steady(self) -> None:
+        """Declare warmup over: any further compile event is a
+        steady-state recompile and flips the
+        ``compile_ledger.steady_state_compiles`` gauge off zero
+        (bench.py's capacity_observatory block fails on that)."""
+        if self.compile_ledger is not None:
+            self.compile_ledger.mark_steady()
+
+    def sample_memory(self, label: str | None = None) -> dict:
+        """One device-memory sample into the ``memory.*`` gauges
+        (no-op returning ``{}`` when ``profile=False``)."""
+        return self.memory.sample(label) if self.memory is not None else {}
 
     # -- operator surface ---------------------------------------------------
     @property

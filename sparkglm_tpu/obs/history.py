@@ -1,0 +1,342 @@
+"""Capacity observatory, part 3: longitudinal bench regression tracking.
+
+Every growth round leaves a ``BENCH_r<N>.json`` behind — the driver's
+wrapped capture at the repo root (``{n, cmd, rc, tail, parsed}`` with
+``tail`` holding the last few KB of the bench's JSON detail) and, when
+the round committed it, the full detail dict under ``benchmarks/``.
+Nothing reads them across rounds: a block can rot 20% per round and
+nobody notices until a headline falls over.  This module closes that
+loop:
+
+  * :func:`load_rounds` — one loader over BOTH formats.  Full detail
+    dicts load directly; driver-wrapped files are mined with a
+    balanced-brace scan of the (start-truncated) ``tail``, tolerantly —
+    a block cut off by the truncation simply doesn't contribute.
+  * :data:`BLOCKS` — the per-block headline metric and its direction
+    (the same metrics bench.py gates within one round).
+  * :func:`regression_gate` — the r16-style noise-robust gate applied
+    ACROSS rounds: a block regresses only when the latest value loses a
+    one-sided sign test against its whole history (p <= alpha, which
+    needs >= 3 prior rounds) AND the adverse move clears both a floor
+    threshold and the worst round-to-round fluctuation already present
+    in the history.  Single noisy rounds and long-standing wobble stay
+    quiet; a genuine cliff is flagged with the evidence attached.
+  * :func:`bench_history` / :func:`render_report` — the assembled
+    report, also reachable as ``python -m sparkglm_tpu.obs.history``
+    and ``make observatory``.
+
+``ok`` flags that flip from True to False are reported as warnings, not
+regressions — an ok-flip is usually an environment change (CPU fallback
+noise floor) and the within-round gate already failed loudly.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+import statistics
+
+__all__ = ["BLOCKS", "load_rounds", "extract_block", "regression_gate",
+           "bench_history", "render_report"]
+
+# A regression must beat the history's own worst adverse step by this
+# margin — a latest round exactly as noisy as its history is noise,
+# not a trend (regression_gate condition 3).
+_NOISE_MARGIN = 1.25
+
+# Each block's headline metric, whether lower or higher is better, and
+# whether the metric is an additive fraction (overheads/speedup fracs —
+# compared by absolute delta, since relative change near zero is
+# meaningless) or a plain value (seconds, rows/s, speedup ratios —
+# compared by relative change).
+BLOCKS: dict[str, dict] = {
+    "headline": {"metric": "seconds", "direction": "lower", "kind": "value"},
+    "fault_recovery": {"metric": "overhead_frac", "direction": "lower",
+                       "kind": "frac"},
+    "elastic_recovery": {"metric": "recovery_overhead_frac",
+                         "direction": "lower", "kind": "frac"},
+    "trace_overhead": {"metric": "overhead_frac", "direction": "lower",
+                       "kind": "frac"},
+    "streaming_pipeline": {"metric": "speedup_frac", "direction": "higher",
+                           "kind": "frac"},
+    "serving_latency": {"metric": "rows_per_s", "direction": "higher",
+                        "kind": "value"},
+    "serving_scaleout": {"metric": "rows_per_s", "direction": "higher",
+                         "kind": "value"},
+    "serving_trace_overhead": {"metric": "overhead_frac",
+                               "direction": "lower", "kind": "frac"},
+    "serving_fault_recovery": {"metric": "overhead_frac",
+                               "direction": "lower", "kind": "frac"},
+    "categorical_gramian": {"metric": "speedup_s_per_iter",
+                            "direction": "higher", "kind": "value"},
+    "regularization_path": {"metric": "speedup_vs_refits",
+                            "direction": "higher", "kind": "value"},
+    "sketch_solve": {"metric": "speedup_s_per_iter", "direction": "higher",
+                     "kind": "value"},
+    "fleet_fit": {"metric": "speedup_s_per_model", "direction": "higher",
+                  "kind": "value"},
+    "online_refresh": {"metric": "chunks_per_s_sustained",
+                       "direction": "higher", "kind": "value"},
+    "capacity_observatory": {"metric": "overhead_frac", "direction": "lower",
+                             "kind": "frac"},
+    # ok-flag-only blocks: tracked for flips, no scalar trajectory.
+    "hotloop_mfu": {"metric": None, "direction": "lower", "kind": "flag"},
+    "tenant_growth_chaos": {"metric": None, "direction": "lower",
+                            "kind": "flag"},
+}
+
+_ROUND_RE = re.compile(r"BENCH_r0*(\d+)\.json$")
+
+
+def extract_block(text: str, name: str) -> dict | None:
+    """Pull ``"name": {...}`` out of raw (possibly truncated) bench
+    output with a string-aware balanced-brace walk.  Returns None when
+    the block is absent or cut off by the driver's tail truncation."""
+    m = re.search(r'"%s"\s*:\s*\{' % re.escape(name), text)
+    if not m:
+        return None
+    start = m.end() - 1
+    depth, i, in_str, esc = 0, start, False, False
+    while i < len(text):
+        c = text[i]
+        if in_str:
+            if esc:
+                esc = False
+            elif c == "\\":
+                esc = True
+            elif c == '"':
+                in_str = False
+        elif c == '"':
+            in_str = True
+        elif c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                try:
+                    return json.loads(text[start:i + 1])
+                except json.JSONDecodeError:
+                    return None
+        i += 1
+    return None  # truncated mid-block
+
+
+def _round_of(path: str) -> int | None:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def load_rounds(repo_root: str | os.PathLike = ".") -> dict[int, dict]:
+    """Load every ``BENCH_r*.json`` under ``repo_root`` (driver-wrapped)
+    and ``repo_root/benchmarks/`` (full detail) into
+    ``{round: {block: block_dict}}``.  The full detail wins when a round
+    appears in both places (the tail is a lossy copy of it)."""
+    root = os.fspath(repo_root)
+    rounds: dict[int, dict] = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        r = _round_of(path)
+        if r is None:
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                wrapped = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        tail = wrapped.get("tail") or ""
+        blocks: dict[str, dict] = {}
+        for name in BLOCKS:
+            b = extract_block(tail, name)
+            if b is not None:
+                blocks[name] = b
+        if blocks:
+            rounds.setdefault(r, {}).update(blocks)
+    for path in sorted(glob.glob(os.path.join(root, "benchmarks",
+                                              "BENCH_r*.json"))):
+        r = _round_of(path)
+        if r is None:
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                detail = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(detail, dict):
+            continue
+        r = int(detail.get("round", r))
+        blocks = {name: detail[name] for name in BLOCKS
+                  if isinstance(detail.get(name), dict)}
+        rounds.setdefault(r, {}).update(blocks)  # detail overrides tail
+    return rounds
+
+
+def _sign_test_p(wins: int, n: int) -> float:
+    """One-sided binomial tail P(X >= wins | n, 1/2) — the probability
+    that pure coin-flip noise makes the latest round look at least this
+    bad against its history."""
+    if n <= 0:
+        return 1.0
+    return sum(math.comb(n, j) for j in range(wins, n + 1)) / 2.0 ** n
+
+
+def regression_gate(history: list[float], latest: float, *,
+                    direction: str = "lower", kind: str = "value",
+                    alpha: float = 0.15, min_abs: float = 0.05,
+                    min_rel: float = 0.10) -> dict:
+    """The cross-round analogue of bench.py's paired_overhead_gate.
+
+    ``history`` is the metric's value at each prior round (oldest
+    first); ``latest`` is the candidate round.  Adverse movement is
+    measured against the HISTORY MEDIAN — absolute delta for ``frac``
+    metrics, relative for ``value`` metrics — and the flag fires only
+    when all three hold:
+
+      1. sign test: latest is adverse vs enough individual history
+         points that P(coin flips) <= ``alpha`` (with < 3 points the
+         minimum attainable p is 0.25, so the gate structurally cannot
+         fire — by design: two rounds are not a trend);
+      2. the adverse move exceeds the floor (``min_abs`` for fracs,
+         ``min_rel`` relative for values);
+      3. the adverse move exceeds the noise floor WITH MARGIN — 1.25x
+         the worst adverse round-to-round step already present inside
+         the history, so a metric that has always wobbled +/-20% needs
+         a move meaningfully beyond 20% to alarm.  Without the margin
+         the gate is flaky by construction: a host exactly as noisy as
+         its own history trips it by fractions of a percent.
+    """
+    sign = 1.0 if direction == "lower" else -1.0
+    n = len(history)
+    out = {"n_history": n, "latest": latest, "direction": direction,
+           "kind": kind, "regressed": False, "p": None, "adverse": None,
+           "threshold": None, "noise_floor": None}
+    if n < 2 or latest is None:
+        out["note"] = "insufficient history (need >= 2 rounds)"
+        return out
+    med = statistics.median(history)
+
+    def adverse_delta(new: float, old: float) -> float:
+        d = sign * (new - old)
+        if kind == "frac":
+            return d
+        return d / abs(old) if old else math.inf if d > 0 else 0.0
+
+    adverse = adverse_delta(latest, med)
+    wins = sum(1 for h in history if adverse_delta(latest, h) > 0)
+    p = _sign_test_p(wins, n)
+    steps = [adverse_delta(history[i + 1], history[i])
+             for i in range(n - 1)]
+    noise_floor = max([s for s in steps if s > 0], default=0.0)
+    floor = min_abs if kind == "frac" else min_rel
+    out.update(p=round(p, 4), adverse=round(adverse, 4),
+               threshold=floor, noise_floor=round(noise_floor, 4),
+               wins=wins,
+               regressed=bool(p <= alpha and adverse > floor
+                              and adverse > _NOISE_MARGIN * noise_floor))
+    return out
+
+
+def bench_history(repo_root: str | os.PathLike = ".", *,
+                  rounds: dict[int, dict] | None = None,
+                  alpha: float = 0.15) -> dict:
+    """Assemble the longitudinal report: per-block metric trajectory,
+    regression verdicts for the newest round, and ok-flag flips.  Pass
+    ``rounds`` directly (same shape as :func:`load_rounds`) to analyze
+    synthetic data in tests."""
+    if rounds is None:
+        rounds = load_rounds(repo_root)
+    order = sorted(rounds)
+    report: dict = {"rounds": order, "blocks": {}, "regressions": [],
+                    "ok_flips": []}
+    for name, spec in BLOCKS.items():
+        metric = spec["metric"]
+        traj, oks = [], []
+        for r in order:
+            b = rounds[r].get(name)
+            if not isinstance(b, dict):
+                continue
+            if "ok" in b:
+                oks.append((r, bool(b["ok"])))
+            if metric is not None and isinstance(b.get(metric),
+                                                 (int, float)):
+                traj.append((r, float(b[metric])))
+        if not traj and not oks:
+            continue
+        entry: dict = {"metric": metric, "direction": spec["direction"],
+                       "trajectory": [{"round": r, "value": v}
+                                      for r, v in traj]}
+        if len(traj) >= 2:
+            *hist, (r_last, v_last) = traj
+            gate = regression_gate([v for _, v in hist], v_last,
+                                   direction=spec["direction"],
+                                   kind=spec["kind"], alpha=alpha)
+            gate["round"] = r_last
+            entry["gate"] = gate
+            if gate["regressed"]:
+                report["regressions"].append(name)
+        if len(oks) >= 2 and oks[-1][1] is False and any(
+                ok for _, ok in oks[:-1]):
+            flip = {"block": name, "round": oks[-1][0],
+                    "last_ok_round": max(r for r, ok in oks[:-1] if ok)}
+            entry["ok_flip"] = flip
+            report["ok_flips"].append(flip)
+        report["blocks"][name] = entry
+    report["ok"] = not report["regressions"]
+    return report
+
+
+def render_report(report: dict) -> str:
+    """Human-readable ``bench_history`` table."""
+    lines = ["bench_history: rounds %s" %
+             (", ".join(f"r{r}" for r in report["rounds"]) or "(none)")]
+    for name, entry in sorted(report["blocks"].items()):
+        traj = entry.get("trajectory", [])
+        if traj:
+            path = " -> ".join(f"r{p['round']}:{p['value']:g}"
+                               for p in traj)
+            lines.append(f"  {name}.{entry['metric']} "
+                         f"[{entry['direction']} better]  {path}")
+        gate = entry.get("gate")
+        if gate:
+            if gate["regressed"]:
+                lines.append(
+                    f"    REGRESSION at r{gate['round']}: adverse "
+                    f"{gate['adverse']:+g} > max(floor {gate['threshold']:g},"
+                    f" {_NOISE_MARGIN:g}x noise {gate['noise_floor']:g}), "
+                    f"sign-test p={gate['p']:g}")
+            elif gate.get("note"):
+                lines.append(f"    ({gate['note']})")
+        flip = entry.get("ok_flip")
+        if flip:
+            lines.append(f"    warning: ok flipped False at "
+                         f"r{flip['round']} (last ok r"
+                         f"{flip['last_ok_round']})")
+    lines.append("regressions: %s" %
+                 (", ".join(report["regressions"]) or "none"))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m sparkglm_tpu.obs.history",
+        description="Longitudinal bench regression report over "
+                    "BENCH_r*.json rounds.")
+    ap.add_argument("root", nargs="?", default=".",
+                    help="repo root holding BENCH_r*.json + benchmarks/")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report dict as JSON")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any block regressed")
+    ns = ap.parse_args(argv)
+    report = bench_history(ns.root)
+    if ns.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(render_report(report), end="")
+    return 1 if (ns.strict and report["regressions"]) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,443 @@
+"""Capacity observatory, part 1: analytic cost models, MFU/bandwidth
+gauges, device-memory accounting, and the compile ledger.
+
+ROADMAP item 3 wants every performance claim (MFU, zero steady-state
+recompiles, memory headroom) to be a continuously exported signal rather
+than a one-off bench assertion.  This module turns the trace plane's
+existing ``solve`` / ``scorer_kernel`` / ``compile`` events — now stamped
+with design shapes (rows/cols/iters) by their emitters — into live
+gauges behind the :class:`~.export.Telemetry` facade:
+
+  * :func:`kernel_flops` / :func:`kernel_bytes` — the analytic FLOP and
+    HBM-byte cost model per kernel flavor: ``einsum`` (two passes over X
+    per IRLS iteration), ``fused`` (one pass), ``qr`` (householder),
+    ``structured`` / ``sparse`` (dense-block einsum approximation),
+    ``sketch`` (countsketch + sketched Gramian + refinement matvecs),
+    ``fleet`` (bucket-padded stacked einsum) and ``scorer`` (the serving
+    gather-matvec dispatch).
+  * :class:`CostModel` — platform peak table dividing modeled work by
+    measured span seconds into ``mfu`` and ``bandwidth_frac``.  TPU peaks
+    are the v5e datasheet numbers bench.py already uses; CPU peaks are
+    nominal yardsticks — on the CPU fallback the gauges are
+    relative-to-ourselves trend lines, not absolute utilization claims.
+  * :class:`Profiler` — a trace :class:`~.trace.Sink` pricing each
+    priced event and exporting ``profile.mfu.<flavor>`` /
+    ``profile.bandwidth_frac.<flavor>`` gauges plus cumulative
+    ``profile.flops.<flavor>`` / ``profile.bytes.<flavor>`` counters.
+  * :class:`MemoryLedger` — live-array bytes and peak per fit/engine via
+    ``device.memory_stats()`` where the backend provides it (TPU/GPU),
+    host-side ``jax.live_arrays()`` accounting otherwise.
+  * :class:`CompileLedger` — attributes every ``compile`` event to a
+    ``(subsystem, bucket, flavor)`` key and exports the
+    ``compile_ledger.steady_state_compiles`` gauge: after
+    :meth:`CompileLedger.mark_steady` the gauge must stay 0, which makes
+    the zero-steady-state-recompile contract a continuously scraped
+    signal (bench.py ``capacity_observatory`` fails on any violation).
+
+Everything here is host-side arithmetic over already-emitted events:
+attaching a Profiler/ledger never adds device ops or syncs beyond the
+span edges the emitters already had (PARITY.md numerics neutrality).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from .trace import Sink, TraceEvent
+
+__all__ = [
+    "kernel_flops", "kernel_bytes", "CostModel", "Profiler",
+    "device_memory_stats", "MemoryLedger", "CompileLedger",
+    "PEAKS",
+]
+
+# (peak FLOP/s, peak HBM bytes/s) per platform.  TPU: the v5e bf16
+# datasheet peak bench.py's hotloop_mfu block uses (197 TFLOP/s bf16 —
+# f32 runs at ~1/4 of it) and ~819 GB/s HBM.  CPU: nominal one-socket
+# yardsticks (1e11 FLOP/s, 5e10 B/s) so the CPU-fallback gauges are
+# stable trend lines across rounds rather than absolute claims.
+PEAKS: dict[str, tuple[float, float]] = {
+    "tpu": (197e12, 819e9),
+    "gpu": (9.89e13, 2.04e12),
+    "cpu": (1e11, 5e10),
+}
+_F32_FLOPS_DERATE = 0.25  # TPU MXU: f32 peak is ~1/4 the bf16 peak
+
+
+def kernel_flops(flavor: str, *, rows: int, cols: int, iters: int = 1,
+                 models: int = 1, sketch_dim: int | None = None,
+                 sketch_refine: int = 0) -> float:
+    """Modeled FLOPs for one traced kernel call.
+
+    The model counts the dominant dense terms only (FMA = 2 FLOPs):
+    Gramian assembly ``n*p*(p+1)`` (symmetric X'WX), two matvecs
+    ``4*n*p`` (eta and X'Wz), ~8 elementwise link/weight ops per row,
+    and a ``p^3/3`` Cholesky per iteration.  Flavor adjustments:
+    ``qr`` uses the householder count ``2*n*p^2``; ``sketch`` assembles
+    the Gramian on the ``m``-row sketch plus ``sketch_refine``
+    iterative-refinement matvecs; ``fleet`` multiplies by the padded
+    model bucket; ``scorer`` is a single gather-matvec (rows here is the
+    padded dispatch bucket).  Estimates, not truth — good to the factor
+    the MFU gauge needs to say "HBM-bound" vs "idle".
+    """
+    n, p, it = float(rows), float(cols), max(1, int(iters))
+    if flavor == "scorer":
+        # gather + matvec + link: table row gather is free-ish, the
+        # matvec dominates
+        return 2.0 * n * p + 8.0 * n
+    chol = p ** 3 / 3.0
+    if flavor == "qr":
+        per_iter = 2.0 * n * p * p + 4.0 * n * p + 8.0 * n
+    elif flavor == "sketch":
+        m = float(sketch_dim) if sketch_dim else min(n, 4.0 * p)
+        per_iter = (2.0 * n * p                   # countsketch S·X
+                    + m * p * (p + 1.0)           # sketched Gramian
+                    + 4.0 * n * p                 # eta + X'Wz on real rows
+                    + 4.0 * n * p * max(0, int(sketch_refine))
+                    + 8.0 * n + chol)
+    else:
+        # einsum / fused / structured / sparse / fleet / lm: exact dense
+        # Gramian each iteration (structured/sparse overstate the factor
+        # columns — documented approximation)
+        per_iter = n * p * (p + 1.0) + 4.0 * n * p + 8.0 * n + chol
+    total = per_iter * it
+    if flavor == "fleet":
+        total *= max(1, int(models))
+    return total
+
+
+def kernel_bytes(flavor: str, *, rows: int, cols: int, iters: int = 1,
+                 models: int = 1, dtype_bytes: int = 4,
+                 sketch_refine: int = 0) -> float:
+    """Modeled HBM traffic for one traced kernel call.
+
+    X dominates: ``einsum`` streams it twice per iteration (Gramian pass
+    + eta pass), ``fused`` once (the v2 one-pass contract), ``qr`` twice,
+    ``sketch`` once plus once per refinement step.  Vectors add ~6 row
+    reads/writes.  ``scorer`` touches the padded batch once plus its
+    output."""
+    n, p, it = float(rows), float(cols), max(1, int(iters))
+    b = float(dtype_bytes)
+    if flavor == "scorer":
+        return (n * p + 2.0 * n) * b
+    x_passes = {"fused": 1.0, "sketch": 1.0 + max(0, int(sketch_refine)),
+                }.get(flavor, 2.0)
+    per_iter = (x_passes * n * p + 6.0 * n) * b
+    total = per_iter * it
+    if flavor == "fleet":
+        total *= max(1, int(models))
+    return total
+
+
+class CostModel:
+    """Divide modeled work by measured seconds against platform peaks.
+
+    ``platform=None`` resolves ``jax.default_backend()`` lazily (so
+    constructing one never imports jax eagerly); explicit
+    ``peak_flops``/``peak_bytes_s`` override the table for calibrated
+    hosts."""
+
+    def __init__(self, platform: str | None = None, *,
+                 peak_flops: float | None = None,
+                 peak_bytes_s: float | None = None,
+                 dtype_bytes: int = 4):
+        self._platform = platform
+        self._peak_flops = peak_flops
+        self._peak_bytes_s = peak_bytes_s
+        self.dtype_bytes = int(dtype_bytes)
+
+    @property
+    def platform(self) -> str:
+        if self._platform is None:
+            import jax
+            self._platform = jax.default_backend()
+        return self._platform
+
+    @property
+    def peak_flops(self) -> float:
+        if self._peak_flops is None:
+            flops, _ = PEAKS.get(self.platform, PEAKS["cpu"])
+            if self.platform == "tpu" and self.dtype_bytes >= 4:
+                flops *= _F32_FLOPS_DERATE
+            self._peak_flops = flops
+        return self._peak_flops
+
+    @property
+    def peak_bytes_s(self) -> float:
+        if self._peak_bytes_s is None:
+            self._peak_bytes_s = PEAKS.get(self.platform, PEAKS["cpu"])[1]
+        return self._peak_bytes_s
+
+    def mfu(self, flops: float, seconds: float) -> float:
+        return flops / (seconds * self.peak_flops) if seconds > 0 else 0.0
+
+    def bandwidth_frac(self, nbytes: float, seconds: float) -> float:
+        return (nbytes / (seconds * self.peak_bytes_s)
+                if seconds > 0 else 0.0)
+
+
+def _solve_flavor(fields: dict) -> str | None:
+    g = fields.get("gramian_engine")
+    if g in ("einsum", "fused", "qr", "structured", "sparse", "sketch",
+             "fleet"):
+        return g
+    return None
+
+
+class Profiler(Sink):
+    """Price each shape-stamped kernel event into MFU/bandwidth gauges.
+
+    Attached as a tracer sink by :class:`~.export.Telemetry`; consumes
+    ``solve`` events (IRLS segments, LM solves, fleet passes — flavor
+    from ``gramian_engine``) and ``scorer_kernel`` events (serving
+    dispatches), each carrying rows/cols/seconds.  Events without shape
+    stamps or wall time are skipped silently — old emitters stay valid.
+
+    Runs under the tracer's emit lock like every sink, so its own state
+    needs no extra locking; it never re-enters ``FitTracer.emit``.
+    """
+
+    def __init__(self, metrics=None, *, cost_model: CostModel | None = None):
+        self.metrics = metrics
+        self.cost = cost_model if cost_model is not None else CostModel()
+        # flavor -> {calls, flops, bytes, seconds, mfu, bandwidth_frac}
+        self.flavors: dict[str, dict] = {}
+
+    def emit(self, event: TraceEvent) -> None:
+        f = event.fields
+        if event.kind == "solve":
+            flavor = _solve_flavor(f)
+        elif event.kind == "scorer_kernel":
+            flavor = "scorer"
+        else:
+            return
+        if flavor is None:
+            return
+        seconds = f.get("seconds")
+        # scorer dispatches compute the full padded bucket, not just the
+        # live rows — price what the device actually did
+        rows = f.get("bucket") if flavor == "scorer" else f.get("rows")
+        if rows is None:
+            rows = f.get("rows")
+        cols = f.get("cols")
+        if not seconds or not rows or not cols:
+            return
+        kw = dict(rows=int(rows), cols=int(cols),
+                  iters=int(f.get("iters", 1) or 1),
+                  models=int(f.get("models", 1) or 1))
+        flops = kernel_flops(flavor, **kw,
+                             sketch_dim=f.get("sketch_dim"),
+                             sketch_refine=int(f.get("sketch_refine", 0)))
+        nbytes = kernel_bytes(flavor, **kw,
+                              dtype_bytes=self.cost.dtype_bytes,
+                              sketch_refine=int(f.get("sketch_refine", 0)))
+        mfu = self.cost.mfu(flops, float(seconds))
+        bw = self.cost.bandwidth_frac(nbytes, float(seconds))
+        agg = self.flavors.setdefault(flavor, {
+            "calls": 0, "flops": 0.0, "bytes": 0.0, "seconds": 0.0,
+            "mfu": 0.0, "bandwidth_frac": 0.0})
+        agg["calls"] += 1
+        agg["flops"] += flops
+        agg["bytes"] += nbytes
+        agg["seconds"] += float(seconds)
+        agg["mfu"] = mfu
+        agg["bandwidth_frac"] = bw
+        m = self.metrics
+        if m is not None:
+            m.gauge(f"profile.mfu.{flavor}").set(mfu)
+            m.gauge(f"profile.bandwidth_frac.{flavor}").set(bw)
+            m.gauge("profile.mfu.last").set(mfu)
+            m.counter(f"profile.flops.{flavor}").inc(int(flops))
+            m.counter(f"profile.bytes.{flavor}").inc(int(nbytes))
+            m.histogram(f"profile.solve_s.{flavor}").observe(float(seconds))
+
+    def report(self) -> dict:
+        """Aggregate census: per-flavor totals plus the lifetime-average
+        utilization (total modeled work / total measured seconds)."""
+        out = {}
+        for flavor, agg in sorted(self.flavors.items()):
+            out[flavor] = dict(
+                agg,
+                mfu_avg=self.cost.mfu(agg["flops"], agg["seconds"]),
+                bandwidth_frac_avg=self.cost.bandwidth_frac(
+                    agg["bytes"], agg["seconds"]))
+        return {"platform": self.cost.platform,
+                "peak_flops": self.cost.peak_flops,
+                "peak_bytes_s": self.cost.peak_bytes_s,
+                "flavors": out}
+
+
+# -- device memory accounting -------------------------------------------------
+
+def device_memory_stats(device=None) -> dict:
+    """Current device-memory occupancy.
+
+    Prefers the backend allocator's ``device.memory_stats()`` (TPU/GPU:
+    true ``bytes_in_use`` / ``peak_bytes_in_use``); the CPU backend
+    reports none, so the fallback sums ``jax.live_arrays()`` nbytes —
+    live committed buffers as the host sees them, with no allocator
+    peak (the ledger tracks its own running max across samples)."""
+    import jax
+    if device is None:
+        device = jax.devices()[0]
+    stats = None
+    with contextlib.suppress(Exception):
+        stats = device.memory_stats()
+    if stats and "bytes_in_use" in stats:
+        return {"bytes_in_use": int(stats["bytes_in_use"]),
+                "peak_bytes": int(stats.get("peak_bytes_in_use", 0)),
+                "source": "device"}
+    live = 0
+    with contextlib.suppress(Exception):
+        live = sum(int(a.nbytes) for a in jax.live_arrays())
+    return {"bytes_in_use": live, "peak_bytes": 0, "source": "host"}
+
+
+class MemoryLedger:
+    """Sampled live/peak device-memory gauges.
+
+    ``sample()`` at any capture point (the Telemetry facade exposes it;
+    the bench calls it per phase); ``scope(label)`` brackets one fit or
+    engine lifetime and exports its delta and in-scope peak.  Sampling
+    reads allocator counters / live-array metadata only — it never
+    allocates on or syncs the device."""
+
+    def __init__(self, metrics=None):
+        self.metrics = metrics
+        self.samples = 0
+        self.peak_bytes = 0
+        self._lock = threading.Lock()
+
+    def sample(self, label: str | None = None) -> dict:
+        s = device_memory_stats()
+        with self._lock:
+            self.samples += 1
+            self.peak_bytes = max(self.peak_bytes, s["bytes_in_use"],
+                                  s["peak_bytes"])
+            peak = self.peak_bytes
+        m = self.metrics
+        if m is not None:
+            m.gauge("memory.live_bytes").set(s["bytes_in_use"])
+            m.gauge("memory.peak_bytes").set(peak)
+            if label:
+                m.gauge(f"memory.{label}.live_bytes").set(s["bytes_in_use"])
+        return dict(s, peak_bytes=peak)
+
+    @contextlib.contextmanager
+    def scope(self, label: str):
+        """Bracket one fit/engine: exports ``memory.<label>.delta_bytes``
+        (live growth across the scope) and ``memory.<label>.peak_bytes``
+        (the ledger peak observed inside it)."""
+        before = self.sample(label)
+        try:
+            yield self
+        finally:
+            after = self.sample(label)
+            if self.metrics is not None:
+                self.metrics.gauge(f"memory.{label}.delta_bytes").set(
+                    after["bytes_in_use"] - before["bytes_in_use"])
+                self.metrics.gauge(f"memory.{label}.peak_bytes").set(
+                    after["peak_bytes"])
+
+
+# -- compile ledger -----------------------------------------------------------
+
+# explicit target -> subsystem attribution; serve:* is prefix-matched
+_SUBSYSTEMS = {
+    "irls_kernel": "models",
+    "lm_kernel": "models",
+    "fleet_kernel": "fleet",
+    "lm_gramian": "streaming",
+    "glm_gramian": "streaming",
+    "irls_stream": "streaming",
+    "gram_path": "penalized",
+    "path_kernel": "penalized",
+}
+
+
+def _attribute(fields: dict) -> tuple[str, str, str]:
+    target = str(fields.get("target", "?"))
+    if target.startswith("serve:"):
+        subsystem = "serve"
+    else:
+        subsystem = _SUBSYSTEMS.get(
+            target, "streaming" if "gramian" in target or "stream" in target
+            else "penalized" if "path" in target else "other")
+    bucket = fields.get("bucket")
+    bucket = str(int(bucket)) if bucket is not None else "-"
+    flavor = str(fields.get("flavor") or fields.get("gramian_engine")
+                 or target)
+    return subsystem, bucket, flavor
+
+
+class CompileLedger(Sink):
+    """Attribute every ``compile`` event to ``(subsystem, bucket,
+    flavor)`` and export steady-state-recompile-freedom as a gauge.
+
+    Lifecycle: everything compiled before :meth:`mark_steady` is warmup
+    (bucket ladders, first fits).  ``mark_steady()`` zeroes the
+    ``compile_ledger.steady_state_compiles`` gauge; any compile after it
+    increments the gauge and is kept verbatim in ``steady_events`` —
+    bench.py's ``capacity_observatory`` block fails if either is
+    non-zero after the serving phase, turning the per-bench assertion
+    into a contract any scrape can check."""
+
+    def __init__(self, metrics=None):
+        self.metrics = metrics
+        self.entries: dict[tuple[str, str, str], dict] = {}
+        self.phase = "warmup"
+        self.steady_events: list[dict] = []
+        self._lock = threading.Lock()
+        if metrics is not None:
+            metrics.gauge("compile_ledger.steady_state_compiles").set(0)
+
+    def emit(self, event: TraceEvent) -> None:
+        if event.kind != "compile":
+            return
+        key = _attribute(event.fields)
+        seconds = float(event.fields.get("seconds", 0.0) or 0.0)
+        with self._lock:
+            e = self.entries.setdefault(
+                key, {"count": 0, "seconds": 0.0, "steady_count": 0})
+            e["count"] += 1
+            e["seconds"] += seconds
+            steady = self.phase == "steady"
+            if steady:
+                e["steady_count"] += 1
+                self.steady_events.append(
+                    {"subsystem": key[0], "bucket": key[1],
+                     "flavor": key[2], **event.fields})
+            n_steady = len(self.steady_events)
+        m = self.metrics
+        if m is not None:
+            m.counter("compile_ledger.compiles").inc()
+            m.histogram("compile_ledger.compile_s").observe(
+                max(seconds, 1e-9))
+            if steady:
+                m.gauge("compile_ledger.steady_state_compiles").set(n_steady)
+
+    def mark_steady(self) -> None:
+        """Warmup is over: from here every compile is a contract
+        violation (exported live via the steady-state gauge)."""
+        with self._lock:
+            self.phase = "steady"
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "compile_ledger.steady_state_compiles").set(
+                    len(self.steady_events))
+
+    @property
+    def steady_state_compiles(self) -> int:
+        return len(self.steady_events)
+
+    def report(self) -> dict:
+        with self._lock:
+            entries = [
+                {"subsystem": s, "bucket": b, "flavor": fl, **dict(e)}
+                for (s, b, fl), e in sorted(self.entries.items())]
+            return {"phase": self.phase,
+                    "compiles": sum(e["count"] for e in entries),
+                    "steady_state_compiles": len(self.steady_events),
+                    "steady_events": list(self.steady_events),
+                    "entries": entries}

@@ -126,7 +126,7 @@ class OnlineLoop:
                  tol: float = 1e-8, max_iter: int = 50,
                  batch: str = "exact",
                  trace=None, metrics=None, telemetry=None,
-                 journal=None,
+                 journal=None, shard_label: str | None = None,
                  config: NumericConfig = DEFAULT):
         if window_rows < 1:
             raise ValueError(f"window_rows must be >= 1, got {window_rows}")
@@ -160,6 +160,10 @@ class OnlineLoop:
         self.max_iter = int(max_iter)
         self.batch = batch
         self.config = config
+        # trace-id prefix for sharded deployments: shard "shard-01"
+        # emits cycle ids "shard-01-cycle-000001" so per-shard streams
+        # stay distinguishable after cross-process aggregation
+        self.shard_label = shard_label
         self.telemetry = telemetry
         if telemetry is not None:
             if trace is None:
@@ -206,8 +210,10 @@ class OnlineLoop:
         (FamilyScorer, the fleet kernels) emit into the same trace even
         when ``step`` is called directly rather than through :meth:`run`.
         """
+        label = getattr(self, "shard_label", None)
         ctx = _obs_context.TraceContext(
-            trace=f"cycle-{self._chunks + 1:06d}", span="cycle")
+            trace=f"{label + '-' if label else ''}"
+                  f"cycle-{self._chunks + 1:06d}", span="cycle")
         with _obs_trace.ambient(self.tracer), _obs_context.use(ctx):
             chunk = self._chunks + 1
             if self.journal is not None:

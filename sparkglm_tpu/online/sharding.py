@@ -97,7 +97,7 @@ class ShardedOnlineLoop:
                                        if shard_of(t, self.n_shards) == s])
             self.loops.append(OnlineLoop(
                 sub, trace=trace, metrics=metrics, telemetry=telemetry,
-                **loop_kwargs))
+                shard_label=f"shard-{s:02d}", **loop_kwargs))
         self._chunks = 0
         if journal is not None:
             self.attach_journal(journal)
@@ -305,8 +305,12 @@ class ShardedOnlineLoop:
         if not dirs:
             raise FileNotFoundError(
                 f"no shard-NN journal directories under {root!r}")
-        loops = [OnlineLoop.resume(os.path.join(root, d), trace=trace,
-                                   metrics=metrics) for d in dirs]
+        loops = []
+        for d in dirs:
+            lp = OnlineLoop.resume(os.path.join(root, d), trace=trace,
+                                   metrics=metrics)
+            lp.shard_label = d  # "shard-NN": labelled cycle traces resume
+            loops.append(lp)
         obj = cls.__new__(cls)
         obj.n_shards = len(loops)
         obj.loops = loops

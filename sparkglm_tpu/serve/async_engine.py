@@ -315,7 +315,7 @@ class ReplicatedScorer:
             if delta:
                 self.compiles += delta
                 emit_ambient("compile", target=f"serve:{self.name}",
-                             bucket=key[1],
+                             bucket=key[1], flavor=key[2],
                              seconds=time.perf_counter() - t0)
                 if self.metrics is not None:
                     self.metrics.counter(
@@ -695,6 +695,18 @@ class AsyncEngine:
             self._tracer.emit(kind, **fields)
         else:
             emit_ambient(kind, **fields)
+
+    def _scorer_cols(self) -> int | None:
+        """The coefficient-table width p, stamped on ``scorer_kernel``
+        events so the capacity observatory (obs/profile.py) can price a
+        dispatch as a ``bucket x p`` gather-matvec.  Host-side metadata
+        only."""
+        B = getattr(self.scorer, "_B", None)
+        if B is not None:
+            return int(B.shape[1])
+        m = getattr(self.scorer, "model", None)
+        coef = getattr(m, "coefficients", None)
+        return int(len(coef)) if coef is not None else None
 
     # -- client side ---------------------------------------------------------
 
@@ -1478,7 +1490,8 @@ class AsyncEngine:
             # requests share the executable call)
             self._tracer.emit("scorer_kernel", engine=self.name,
                               batch=batch_id, replica=int(replica),
-                              bucket=int(bucket), rows=rows, seconds=dt)
+                              bucket=int(bucket), rows=rows,
+                              cols=self._scorer_cols(), seconds=dt)
         for r, _part in won:
             if self.metrics is not None:
                 self.metrics.histogram(

@@ -515,7 +515,8 @@ class FamilyScorer:
         # cycle's shadow gating, a notebook fit) — host-side, after the
         # dispatch, so numerics and the executable census are untouched
         emit_ambient("scorer_kernel", target=f"serve:{self.name}",
-                     rows=n, bucket=bucket, shadow=self._shadow is not None)
+                     rows=n, cols=int(self._B.shape[1]), bucket=bucket,
+                     seconds=dt, shadow=self._shadow is not None)
         return fit if sh is None else (fit, sh)
 
     def warmup(self, buckets=None) -> tuple[int, ...]:

@@ -53,15 +53,22 @@ class FamilyGrowth:
         given, the swap routes through :meth:`OnlineLoop.grow` so the
         learning plane migrates in the same step as the serving plane.
       tracer: an ``obs/trace.FitTracer`` (or None) for the
-        ``growth_start`` / ``growth_warm`` / ``growth_end`` events.
+        ``growth_start`` / ``growth_warm`` / ``growth_end`` /
+        ``growth`` events.
+      telemetry: a :class:`~..obs.Telemetry` — shorthand for
+        ``tracer=telemetry.tracer`` (an explicit ``tracer=`` wins).
     """
 
-    def __init__(self, family, *, scorers=(), loop=None, tracer=None):
+    def __init__(self, family, *, scorers=(), loop=None, tracer=None,
+                 telemetry=None):
         if loop is not None and loop.family is not family:
             raise ValueError("loop must wrap the same ModelFamily")
         self.family = family
         self.scorers = tuple(scorers)
         self.loop = loop
+        self.telemetry = telemetry
+        if tracer is None and telemetry is not None:
+            tracer = telemetry.tracer
         self.tracer = tracer
 
     def _emit(self, event: str, **fields) -> None:
@@ -133,4 +140,11 @@ class FamilyGrowth:
                    crossed=crossed,
                    prewarm_compiles=sum(r["compiles"] for r in prewarm),
                    total_s=round(report["total_s"], 6))
+        # one consolidated event for dashboards/aggregation: the whole
+        # episode's phase timings on a single line
+        self._emit("growth", added=len(new), tenants=report["tenants"],
+                   crossed=crossed, warm_s=round(warm_s, 6),
+                   swap_s=round(swap_s, 6),
+                   total_s=round(report["total_s"], 6),
+                   prewarm_compiles=sum(r["compiles"] for r in prewarm))
         return report

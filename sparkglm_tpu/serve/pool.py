@@ -136,6 +136,7 @@ class EnginePool:
             family = store.load()
         self.family = family
         self.store = store
+        self.telemetry = telemetry
         self.name = name if name is not None else f"{family.name}-pool"
         self.n_engines = int(n_engines)
         self._fault_plan = fault_plan
@@ -157,7 +158,20 @@ class EnginePool:
             for i in range(self.n_engines)]
         self.health = ReplicaHealth(
             self.n_engines, health,
-            emit=self.engines[0]._emit)
+            emit=self._health_emit)
+
+    # -- telemetry ------------------------------------------------------------
+
+    def _emit(self, kind: str, **fields) -> None:
+        """Pool-level events ride the same tracer the engines use (the
+        telemetry's when attached, ambient otherwise)."""
+        self.engines[0]._emit(kind, pool=self.name, **fields)
+
+    def _health_emit(self, kind: str, **fields) -> None:
+        """Engine-level health transitions keep their replica_* kinds
+        (so flight-recorder triggers still fire) but are tagged with the
+        pool scope — ``replica`` in these events is an ENGINE index."""
+        self._emit(kind, scope="engine", **fields)
 
     # -- routing --------------------------------------------------------------
 
@@ -202,6 +216,7 @@ class EnginePool:
             return outer
         with self._lock:
             self.lost += 1
+        self._emit("pool_lost", tenant=tenant, where="submit")
         raise last_exc if last_exc is not None else Overloaded(
             f"no admissible engine in pool {self.name!r}")
 
@@ -225,11 +240,16 @@ class EnginePool:
             self.health.on_success(i)
             with self._lock:
                 self.resubmits += 1
+            self._emit("pool_resubmit", from_engine=int(engine),
+                       to_engine=int(i), tenant=tenant,
+                       error=type(exc).__name__)
             _RoutedFuture.chain(self, outer, inner, i, data, tenant,
                                 offset, deadline)
             return True
         with self._lock:
             self.lost += 1
+        self._emit("pool_lost", tenant=tenant, where="resubmit",
+                   from_engine=int(engine))
         return False
 
     # -- family sync ----------------------------------------------------------

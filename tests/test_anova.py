@@ -423,7 +423,10 @@ def test_anova_single_sequential_order_matters(pois_data):
     m2 = sg.glm("y ~ grp + x", pois_data, family="poisson")
     t1 = sg.anova(m1, pois_data)
     t2 = sg.anova(m2, pois_data)
-    np.testing.assert_allclose(t1.rows[-1][3], t2.rows[-1][3], rtol=1e-9)
+    # the two residual deviances come from IRLS runs over differently
+    # ordered designs, so they agree to solver tolerance, not exactly
+    # (measured ~3e-9 relative on some BLAS builds)
+    np.testing.assert_allclose(t1.rows[-1][3], t2.rows[-1][3], rtol=1e-8)
     assert t1.row_names[1] == "x" and t2.row_names[1] == "grp"
     # deviance rows sum to the same total drop
     np.testing.assert_allclose(

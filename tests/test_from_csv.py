@@ -141,8 +141,11 @@ def test_lm_from_csv_matches_in_memory(csv_data, mesh8):
     m_csv = sg.lm_from_csv("y ~ x + grp", path, weights="w",
                            chunk_bytes=16 << 10, mesh=mesh8)
     m_mem = sg.lm("y ~ x + grp", data, weights="w", mesh=mesh8)
+    # resident (single f32 reduction) vs streaming (f32 chunk passes, f64
+    # host accumulation) differ by f32 accumulation order: ~1e-5, as in
+    # the GLM parity test above
     np.testing.assert_allclose(m_csv.coefficients, m_mem.coefficients,
-                               rtol=1e-6, atol=1e-9)
+                               rtol=1e-5, atol=1e-8)
     np.testing.assert_allclose(m_csv.r_squared, m_mem.r_squared, rtol=1e-6)
     np.testing.assert_allclose(m_csv.std_errors, m_mem.std_errors, rtol=1e-5)
 
@@ -156,8 +159,9 @@ def test_lm_from_csv_offset_matches_in_memory(csv_data, mesh8):
                            chunk_bytes=16 << 10, mesh=mesh8)
     m_mem = sg.lm("y ~ x + grp", data, weights="w", offset="lt", mesh=mesh8)
     assert m_csv.has_offset and m_csv.offset_col == "lt"
+    # same f32 accumulation-order bound as the no-offset parity test
     np.testing.assert_allclose(m_csv.coefficients, m_mem.coefficients,
-                               rtol=1e-6, atol=1e-9)
+                               rtol=1e-5, atol=1e-8)
     np.testing.assert_allclose(m_csv.sse, m_mem.sse, rtol=1e-6)
     np.testing.assert_allclose(m_csv.sst, m_mem.sst, rtol=1e-6)
     np.testing.assert_allclose(m_csv.r_squared, m_mem.r_squared, rtol=1e-6)

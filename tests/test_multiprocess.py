@@ -20,16 +20,41 @@ import socket
 import subprocess
 import sys
 
+import jax
 import numpy as np
 import pytest
+
+# Every test here joins real OS processes through jax.distributed and runs
+# cross-process collectives on the CPU backend.  jaxlib < 0.5 raises
+# "Multiprocess computations aren't implemented on the CPU backend" at the
+# first psum/allgather — the CPU collectives runtime (gloo) ships with
+# jax/jaxlib >= 0.5.  Skip, naming the missing dependency, rather than
+# failing on a capability the installed jaxlib does not have.
+_JAX_VER = tuple(int(x) for x in jax.__version__.split(".")[:2])
+pytestmark = pytest.mark.skipif(
+    _JAX_VER < (0, 5),
+    reason="cross-process CPU collectives need jax/jaxlib >= 0.5 (gloo CPU "
+           f"collectives); installed jax {jax.__version__} raises "
+           "'Multiprocess computations aren't implemented on the CPU "
+           "backend'")
 
 _WORKER = r"""
 import json, sys
 port, pid, csv_path, out_path, nproc = sys.argv[1:6]
 nproc = int(nproc)
+import os, re
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; set the XLA flag before
+    # backend init, overriding any device count inherited from the parent
+    # test process (conftest.py forces 8 there)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (flags
+                               + " --xla_force_host_platform_device_count=2")
 jax.config.update("jax_enable_x64", True)
 import numpy as np
 import sparkglm_tpu as sg
@@ -191,9 +216,19 @@ _STREAM_WORKER = r"""
 import json, sys
 port, pid, csv_path, out_path, nproc = sys.argv[1:6]
 nproc = int(nproc)
+import os, re
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; set the XLA flag before
+    # backend init, overriding any device count inherited from the parent
+    # test process (conftest.py forces 8 there)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (flags
+                               + " --xla_force_host_platform_device_count=2")
 jax.config.update("jax_enable_x64", True)
 import numpy as np
 import sparkglm_tpu as sg
@@ -320,9 +355,19 @@ _RECOVERY_WORKER = r"""
 import json, os, sys
 port, pid, csv_path, out_path, nproc, phase, ckpt_path, engine = sys.argv[1:9]
 nproc = int(nproc)
+import os, re
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; set the XLA flag before
+    # backend init, overriding any device count inherited from the parent
+    # test process (conftest.py forces 8 there)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (flags
+                               + " --xla_force_host_platform_device_count=2")
 jax.config.update("jax_enable_x64", True)
 import numpy as np
 import sparkglm_tpu as sg
@@ -445,9 +490,19 @@ _POLISH_WORKER = r"""
 import json, sys
 port, pid, out_path, nproc = sys.argv[1:5]
 nproc = int(nproc)
+import os, re
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; set the XLA flag before
+    # backend init, overriding any device count inherited from the parent
+    # test process (conftest.py forces 8 there)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (flags
+                               + " --xla_force_host_platform_device_count=2")
 jax.config.update("jax_enable_x64", True)
 import numpy as np
 import sparkglm_tpu as sg

