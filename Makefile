@@ -9,7 +9,7 @@ SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve serve_async \
         categorical penalized elastic sketch fleet hotloop online \
-        obsplane chaos elastic_tenancy observatory clean
+        obsplane chaos elastic_tenancy observatory ingest clean
 
 all: native
 
@@ -152,6 +152,17 @@ observatory:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_observatory.py -q
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 	python -m sparkglm_tpu.obs.history .
+
+# process-parallel sharded ingest (sparkglm_tpu/data/ingest.py + the
+# multi-file _stream_io front-ends): bit-identical coefficients across
+# ingest_workers ∈ {0,1,4}, resume fingerprinting on sharded sources,
+# column pruning to design-referenced variables, worker-death reread —
+# plus the streaming_pipeline + ingest_throughput bench blocks
+# (sequential vs thread-prefetch vs process-ingest, delivered bandwidth)
+ingest:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_ingest.py \
+		tests/test_pipeline.py -q
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:
 	rm -f $(SO)

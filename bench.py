@@ -187,7 +187,7 @@ def main() -> None:
     # single source of truth for the round tag is the caller
     # (benchmarks/tpu_when_alive.sh exports ROUND); default matches its
     # current value so a bare `python bench.py` is still correctly stamped
-    detail["round"] = int(os.environ.get("ROUND", "17"))
+    detail["round"] = int(os.environ.get("ROUND", "18"))
 
     def make_data(nn):
         @jax.jit
@@ -626,22 +626,25 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["trace_overhead"] = dict(error=repr(e)[:300])
 
-    # ---- pipelined streaming engine (sparkglm_tpu/data/pipeline.py) --------
+    # ---- pipelined streaming engine (data/pipeline.py + data/ingest.py) ----
     # lm fit over disk-backed binary chunks behind a simulated remote fetch
-    # (the per-chunk sleep stands in for an object-store GET / NFS read —
-    # blocking latency the producer thread genuinely overlaps with the
-    # Gramian compute).  prefetch=2 should land >= 20% under the sequential
-    # wall time, bit-identically.  Local page-cache sources won't show this
-    # on a CPU host: XLA's chunk pass and numpy staging contend for the
-    # same cores, so overlap only pays when the producer BLOCKS.
+    # (the per-chunk sleep stands in for an object-store GET / NFS read).
+    # r18: the producer is a ShardedSource, and the gated tier is the
+    # PROCESS one (ingest_workers=4) — blocking fetches overlap across OS
+    # worker processes regardless of GIL or core contention, so the gate is
+    # deterministic and no longer rides the thread tier's one-shot GIL
+    # probe (the old flaky ok).  The thread tier is still reported for
+    # comparison; under the process producer the auto-degrade controller
+    # is a no-op by construction (models/streaming.py::_pass_iter).
     try:
         import tempfile
 
         import sparkglm_tpu as sg
+        from sparkglm_tpu.data.ingest import ShardedSource
         from sparkglm_tpu.obs import FitTracer
 
         np_rng = np.random.default_rng(31)
-        rows_c, ps, n_chunks, fetch_s = 100_000, 192, 12, 0.08
+        rows_c, ps, n_chunks, fetch_s = 25_000, 96, 12, 0.08
         bts = np_rng.standard_normal(ps).astype(np.float32)
         with tempfile.TemporaryDirectory() as td:
             paths = []
@@ -652,46 +655,149 @@ def main() -> None:
                 paths.append(os.path.join(td, f"chunk{i:02d}.npy"))
                 np.save(paths[-1], np.column_stack([yc, Xc]))
 
-            def source():  # runs on the producer thread when pipelined
-                for pth in paths:
-                    time.sleep(fetch_s)  # simulated remote chunk fetch
-                    blk = np.load(pth)
-                    yield (blk[:, 1:], blk[:, 0], None, None)
+            def read_chunk(i):
+                time.sleep(fetch_s)  # simulated remote chunk fetch
+                blk = np.load(paths[i])
+                return (blk[:, 1:], blk[:, 0], None, None)
 
-            sg.lm_fit_streaming(source)  # warm compile
+            src = ShardedSource(n_chunks, read_chunk, label="bench_pipe")
+            sg.lm_fit_streaming(src)  # warm compile
 
-            def timed(**kw):
+            def timed(chunks, **kw):
                 t0 = time.perf_counter()
-                m = sg.lm_fit_streaming(source, **kw)
+                m = sg.lm_fit_streaming(chunks, **kw)
                 return time.perf_counter() - t0, m
 
-            t_seq, m_seq = timed()
-            t_pipe, m_pipe = timed(prefetch=2, trace=FitTracer([]))
-            rep = m_pipe.fit_report()
+            t_seq, m_seq = timed(src)
+            t_thread, m_thread = timed(src, prefetch=2, trace=FitTracer([]))
+            t_proc, m_proc = timed(src.with_workers(4), trace=FitTracer([]))
+            rep = m_proc.fit_report()
             degraded_passes = rep["event_counts"].get("prefetch_degraded", 0)
-            # ok on either side of the auto-degrade decision
-            # (data/pipeline.py): genuine overlap must land >=20% under
-            # sequential, while a pass the pipeline degraded back to
-            # sequential (measured overlap didn't pay on this host) may
-            # cost at most the few-item pipelined probe (~25% bound)
+            bit = bool(
+                np.array_equal(m_seq.coefficients, m_proc.coefficients)
+                and np.array_equal(m_seq.coefficients, m_thread.coefficients)
+                and np.array_equal(m_seq.std_errors, m_proc.std_errors)
+                and m_seq.sse == m_proc.sse)
             detail["streaming_pipeline"] = dict(
                 n=rows_c * n_chunks, p=ps,
                 simulated_fetch_latency_s=fetch_s,
                 chunks_per_pass=rep["chunks"] // rep["passes"],
-                sequential_s=round(t_seq, 4), prefetch2_s=round(t_pipe, 4),
-                speedup_frac=round(1.0 - t_pipe / t_seq, 4),
-                overlap_ratio=round(rep["overlap_ratio"], 4),
-                queue_wait_s=round(rep["queue_wait_s"], 4),
+                sequential_s=round(t_seq, 4),
+                thread_prefetch2_s=round(t_thread, 4),
+                process_ingest4_s=round(t_proc, 4),
+                speedup_frac=round(1.0 - t_proc / t_seq, 4),
+                ingest=rep.get("ingest"),
                 degraded_passes=int(degraded_passes),
-                bit_identical=bool(
-                    np.array_equal(m_seq.coefficients, m_pipe.coefficients)
-                    and np.array_equal(m_seq.std_errors, m_pipe.std_errors)
-                    and m_seq.sse == m_pipe.sse),
-                ok=bool(t_pipe <= 0.8 * t_seq
-                        or (degraded_passes > 0
-                            and t_pipe <= 1.25 * t_seq)))
+                bit_identical=bit,
+                ok=bool(bit and degraded_passes == 0
+                        and t_proc <= 0.8 * t_seq))
     except Exception as e:  # noqa: BLE001 — keep the bench line alive
         detail["streaming_pipeline"] = dict(error=repr(e)[:300])
+
+    # ---- process-parallel ingest throughput (sparkglm_tpu/data/ingest.py) --
+    # raw source drain over a >=4-file parquet dataset behind a simulated
+    # object-store GET: sequential vs thread-prefetch vs process-ingest in
+    # one block.  The thread tier can only run ONE blocked read ahead; the
+    # process tier overlaps fetches 4-wide, so it must clear 1.5x the
+    # sequential drain even on a single-core host.  On a multi-core TPU
+    # host the parse itself also parallelizes — the recorded tpu_target.
+    # Bit-identity across ingest_workers in {0, 1, 4} is asserted through
+    # the real lm_from_parquet front-end, with zero new kernel compiles
+    # (same chunk shapes -> same executables at any worker count).
+    try:
+        import tempfile
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import sparkglm_tpu as sg
+        from sparkglm_tpu.api import _stream_io
+        from sparkglm_tpu.data.ingest import ShardedSource
+        from sparkglm_tpu.data.pipeline import prefetch_iter
+        from sparkglm_tpu.obs import FitTracer
+
+        np_rng = np.random.default_rng(43)
+        fetch_s, n_files = 0.05, 4
+        with tempfile.TemporaryDirectory() as td:
+            fpaths = []
+            for j in range(n_files):
+                nf = 3000 + 500 * j
+                tbl = pa.table({
+                    "y": np_rng.standard_normal(nf),
+                    "a": np_rng.standard_normal(nf),
+                    "b": np_rng.standard_normal(nf)})
+                fpaths.append(os.path.join(td, f"part{j}.parquet"))
+                pq.write_table(tbl, fpaths[-1], row_group_size=700)
+
+            _, num_chunks, read = _stream_io(
+                fpaths, chunk_bytes=1 << 15, native=None,
+                backend="parquet", levels=False)
+            used = ["y", "a", "b"]
+
+            def read_chunk(i):
+                time.sleep(fetch_s)  # simulated object-store GET
+                cols = read(i, used)
+                return tuple(np.asarray(cols[c]) for c in used)
+
+            src = ShardedSource(num_chunks, read_chunk,
+                                label="bench_ingest")
+            src4 = src.with_workers(4)
+
+            def drain(it):
+                t0 = time.perf_counter()
+                rows = 0
+                for item in it:
+                    if callable(item):
+                        item = item()
+                    rows += int(item[0].shape[0])
+                return time.perf_counter() - t0, rows
+
+            t_seq, rows_total = drain(src())
+            t_thread, _ = drain(prefetch_iter(src, 2, auto_degrade=False))
+            t_proc, _ = drain(src4())
+            st = dict(src4.last_stats)
+
+            # bit-identity + compile-freedom through the real front-end
+            m0 = sg.lm_from_parquet("y ~ a + b", fpaths,
+                                    chunk_bytes=1 << 15)  # warm + baseline
+            tr1, tr4 = FitTracer([]), FitTracer([])
+            m1 = sg.lm_from_parquet("y ~ a + b", fpaths,
+                                    chunk_bytes=1 << 15,
+                                    ingest_workers=1, trace=tr1)
+            m4 = sg.lm_from_parquet("y ~ a + b", fpaths,
+                                    chunk_bytes=1 << 15,
+                                    ingest_workers=4, trace=tr4)
+            bit = bool(np.array_equal(m0.coefficients, m1.coefficients)
+                       and np.array_equal(m0.coefficients, m4.coefficients)
+                       and np.array_equal(m0.std_errors, m4.std_errors))
+            cache_delta = int(
+                tr1.report()["event_counts"].get("compile", 0)
+                + tr4.report()["event_counts"].get("compile", 0))
+
+            speedup = t_seq / t_proc if t_proc > 0 else 0.0
+            detail["ingest_throughput"] = dict(
+                files=n_files, chunks=num_chunks, rows=rows_total,
+                simulated_fetch_latency_s=fetch_s,
+                sequential_s=round(t_seq, 4),
+                thread_prefetch2_s=round(t_thread, 4),
+                process_ingest4_s=round(t_proc, 4),
+                process_speedup=round(speedup, 3),
+                delivered_bandwidth_mb_s=round(
+                    st["bytes"] / st["wall_s"] / 1e6, 3)
+                if st.get("wall_s") else None,
+                queue_wait_s=round(st.get("wait_s", 0.0), 4),
+                workers=st.get("workers"),
+                bit_identical_workers_0_1_4=bit,
+                kernel_cache_delta=cache_delta,
+                tpu_target=dict(
+                    process_speedup=2.5,
+                    note="multi-core TPU host: the parquet parse itself "
+                         "parallelizes across ingest workers; this "
+                         "single-core CPU fallback measures blocking-"
+                         "fetch overlap only"),
+                ok=bool(speedup >= 1.5 and bit and cache_delta == 0))
+    except Exception as e:  # noqa: BLE001 — keep the bench line alive
+        detail["ingest_throughput"] = dict(error=repr(e)[:300])
 
     # ---- online serving latency (sparkglm_tpu/serve) -----------------------
     # warm the bucket ladder, then sustained mixed-size load through the

@@ -426,10 +426,16 @@ def glm_fleet(formula: str, data, *, groups, family="binomial", link=None,
               verbose: bool = False, trace=None, metrics=None,
               engine: str = "auto", penalty=None, design: str = "dense",
               mesh=None, beta0=None, on_iteration=None,
-              checkpoint_every: int = 0,
+              checkpoint_every: int = 0, ingest_workers: int = 0,
               config: NumericConfig = DEFAULT):
     """One GLM per group of a long-format frame, fitted as a FLEET — a
     single compiled kernel call for every model (fleet/fitting.py).
+
+    ``data`` may also be a file path (CSV/Parquet/NDJSON) or a list of
+    same-schema paths: only the columns the formula + ``groups`` touch
+    are read, with ``ingest_workers=N`` fanning the chunk reads across N
+    OS processes (``data/ingest.py``; deterministic reassembly — the
+    resident frame is identical at any worker count).
 
     ``groups`` is the segmentation key: a column name in ``data`` or an
     (n,) array aligned with its rows.  The design is built ONCE on the
@@ -450,6 +456,14 @@ def glm_fleet(formula: str, data, *, groups, family="binomial", link=None,
     _reject_fleet_args(engine=engine, penalty=penalty, design=design,
                        mesh=mesh, beta0=beta0, on_iteration=on_iteration,
                        checkpoint_every=checkpoint_every)
+    if _all_paths(data):
+        data = _ingest_table(formula, data,
+                             extra_names=(groups, weights, offset),
+                             ingest_workers=int(ingest_workers))
+    elif int(ingest_workers) > 0:
+        raise ValueError(
+            "ingest_workers= applies when data is a file path (or list "
+            "of paths); got resident data")
     f, X, y, terms, cols, keep = _design(formula, data, na_omit=na_omit,
                                          dtype=np.dtype(config.dtype),
                                          extra_cols=(weights, offset),
@@ -495,7 +509,7 @@ def online_fleet(formula: str, data, *, groups, family="gaussian",
                  tol: float = 1e-8, max_iter: int = 100,
                  batch: str = "exact", bucket: int | None = None,
                  trace=None, metrics=None, telemetry=None,
-                 journal=None,
+                 journal=None, ingest_workers: int = 0,
                  config: NumericConfig = DEFAULT):
     """Seed a per-group GLM fleet from ``data`` and return an armed
     :class:`~sparkglm_tpu.online.OnlineLoop` — the continuous-learning
@@ -535,7 +549,8 @@ def online_fleet(formula: str, data, *, groups, family="gaussian",
     fleet = glm_fleet(formula, data, groups=groups, family=family,
                       link=link, weights=weights, offset=offset, tol=tol,
                       max_iter=max_iter, batch=batch, bucket=bucket,
-                      trace=trace, metrics=metrics, config=config)
+                      trace=trace, metrics=metrics,
+                      ingest_workers=ingest_workers, config=config)
     fam_name = name if name is not None else (
         groups if isinstance(groups, str) else "fleet")
     fam = ModelFamily.from_fleet(
@@ -564,9 +579,19 @@ def _stream_io(path, *, chunk_bytes, native, backend: str = "auto",
     row-group bands (data/parquet.py), .json/.jsonl/.ndjson stream
     newline-aligned NDJSON byte ranges (data/json.py — the reference's own
     fixture format, testData.scala:10-15), everything else newline-aligned
-    CSV byte ranges (data/io.py)."""
+    CSV byte ranges (data/io.py).
+
+    A LIST/TUPLE of paths streams the files as one dataset: per-file
+    scans merge (factor levels union-sorted so every file codes
+    consistently), chunk indices concatenate file-by-file, and
+    ``read(i)`` dispatches to the owning file — the multi-file sharding
+    a ``ShardedSource`` fans across ingest workers."""
     import os
 
+    if isinstance(path, (list, tuple)):
+        return _stream_io_multi(path, chunk_bytes=chunk_bytes,
+                                native=native, backend=backend,
+                                levels=levels)
     if backend not in ("auto", "csv", "json", "parquet"):
         raise ValueError(
             f"backend must be 'auto', 'csv', 'json' or 'parquet', "
@@ -611,6 +636,7 @@ def _stream_io(path, *, chunk_bytes, native, backend: str = "auto",
             return json_io.read_json(path, shard_index=i,
                                      num_shards=num_chunks, schema=sub,
                                      native=native)
+        read.columns = list(schema)
         return lv, num_chunks, read
     if backend == "parquet":
         from .data import parquet as pq_io
@@ -637,7 +663,100 @@ def _stream_io(path, *, chunk_bytes, native, backend: str = "auto",
             return csv_io.read_csv(path, shard_index=i,
                                    num_shards=num_chunks,
                                    schema=schema, native=native)
+    # the schema scan already named every column: callers can resolve a
+    # formula against ``read.columns`` without materializing a chunk
+    read.columns = list(schema)
     return lv, num_chunks, read
+
+
+def _stream_io_multi(paths, *, chunk_bytes, native, backend, levels):
+    """Multi-file twin of :func:`_stream_io`: one global chunk plan over
+    several files of the same schema.  Chunk ``i`` belongs to the file
+    whose cumulative chunk range contains it, so the global chunk order
+    is file order × within-file order — deterministic, re-iterable, and
+    shardable by index (data/ingest.py)."""
+    if not paths:
+        raise ValueError("need at least one path to stream from")
+    subs = [_stream_io(p, chunk_bytes=chunk_bytes, native=native,
+                       backend=backend, levels=levels) for p in paths]
+    merged = None
+    if levels:
+        # union-sorted per column: every file codes its factors against
+        # the GLOBAL level set, like the single-file global level scan
+        pooled: dict = {}
+        for lv, _, _ in subs:
+            for col, vals in (lv or {}).items():
+                pooled.setdefault(col, set()).update(vals)
+        merged = {c: sorted(s) for c, s in pooled.items()}
+    counts = [nc for _, nc, _ in subs]
+    starts = [sum(counts[:j]) for j in range(len(counts))]
+    readers = [r for _, _, r in subs]
+
+    def read(i, columns=None):
+        i = int(i)
+        if not 0 <= i < sum(counts):
+            raise IndexError(
+                f"chunk {i} out of range [0, {sum(counts)})")
+        for j in range(len(counts) - 1, -1, -1):
+            if i >= starts[j]:
+                return readers[j](i - starts[j], columns)
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    cols0 = getattr(readers[0], "columns", None)
+    if cols0 is not None:
+        read.columns = list(cols0)
+    return merged, sum(counts), read
+
+
+def _data_bytes(path) -> int:
+    import os as _os
+    paths = path if isinstance(path, (list, tuple)) else [path]
+    return sum(_os.path.getsize(p) for p in paths)
+
+
+def _all_paths(data) -> bool:
+    return (_is_path(data)
+            or (isinstance(data, (list, tuple)) and len(data) > 0
+                and all(_is_path(p) for p in data)))
+
+
+def _ingest_table(formula, path, *, extra_names=(), ingest_workers=0,
+                  chunk_bytes: int = 256 << 20, backend: str = "auto"):
+    """Load ONLY the columns a formula (plus ``extra_names``) touches
+    from file(s) into one resident column dict — the fleet front-ends'
+    long-format ingestion.  Chunk reads fan across ``ingest_workers`` OS
+    processes (``data/ingest.py``); reassembly is deterministic chunk
+    order, so the concatenated columns are identical at any worker
+    count."""
+    from .data.ingest import ShardedSource
+
+    f = parse_formula(formula)
+    _, num_chunks, read = _stream_io(path, chunk_bytes=chunk_bytes,
+                                     native=None, backend=backend,
+                                     levels=False)
+    names = getattr(read, "columns", None)
+    if names is None:
+        names = list(read(0))
+    predictors = f.resolve_predictors(list(names))
+    used = _used_columns(f, predictors, extra_names)
+    missing = [c for c in used if c not in names]
+    if missing:
+        raise KeyError(
+            f"column {missing[0]!r} not found in file columns "
+            f"{list(names)}")
+
+    def read_cols(i):
+        cols = read(i, used)
+        return tuple(np.asarray(cols[c]) for c in used)
+
+    src = ShardedSource(num_chunks, read_cols,
+                        workers=int(ingest_workers), label="table_ingest")
+    parts: list[list] = [[] for _ in used]
+    for item in src():
+        vals = item() if callable(item) else item
+        for buf, v in zip(parts, vals):
+            buf.append(v)
+    return {c: np.concatenate(buf) for c, buf in zip(used, parts)}
 
 
 def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
@@ -665,8 +784,15 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
     levels, num_chunks, _read_chunk = _stream_io(
         path, chunk_bytes=chunk_bytes, native=native, backend=backend)
 
-    chunk0 = _read_chunk(0)
-    predictors = f.resolve_predictors(list(chunk0))
+    # the formula resolves against the SCHEMA scan's column names when the
+    # reader exposes them, so even the chunk-0 probe below prunes its read:
+    # a 200-column Parquet file with a 5-column formula never materializes
+    # the other 195 (the pruning contract tests/test_ingest.py pins)
+    names = getattr(_read_chunk, "columns", None)
+    chunk0 = None if names is not None else _read_chunk(0)
+    if names is None:
+        names = list(chunk0)
+    predictors = f.resolve_predictors(list(names))
     # BEFORE build_terms (which would fit a basis from chunk0 alone):
     # poly()/bs()/ns() learn their bases from the FULL column (orthogonal
     # coefficients / knot quantiles), which a streaming fit never holds
@@ -680,15 +806,17 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
             "from-CSV streaming fits would silently fit a basis from the "
             "first chunk only — precompute the basis columns, or load the "
             "data and fit resident")
-    terms = build_terms(chunk0, predictors, intercept=f.intercept,
-                        levels=levels, no_intercept_coding="full_k_first")
-    structured = design == "auto" and wants_structured(terms)
     used = _used_columns(f, predictors, named_cols.values())
-    missing = [c for c in used if c not in chunk0]
+    missing = [c for c in used if c not in names]
     if missing:
         raise KeyError(
             f"formula column {missing[0]!r} not found in CSV columns "
-            f"{list(chunk0)}")
+            f"{list(names)}")
+    if chunk0 is None:
+        chunk0 = _read_chunk(0, used)
+    terms = build_terms(chunk0, predictors, intercept=f.intercept,
+                        levels=levels, no_intercept_coding="full_k_first")
+    structured = design == "auto" and wants_structured(terms)
     # factor response: success level from the GLOBAL level scan — a chunk
     # holding only one response level must still code consistently
     resp_levels = None
@@ -770,7 +898,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
                  backend: str = "auto", retry=None, checkpoint=None,
                  resume=False, penalty=None, trace=None, metrics=None,
                  prefetch: int = 0, engine: str = "auto",
-                 workers: int | None = None,
+                 workers: int | None = None, ingest_workers: int = 0,
                  config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
     """Fit a GLM by formula straight from a CSV too big to load.
 
@@ -778,6 +906,16 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
     thread parses the next byte ranges while the device computes the
     current chunk (``data/pipeline.py``; host memory bound ≈
     ``prefetch x chunk_bytes``).  Bit-identical to the sequential default.
+
+    ``ingest_workers=N`` (N >= 1) moves chunk parsing into N OS worker
+    *processes* (``data/ingest.py``) — the parse itself parallelises
+    across cores instead of timeslicing one GIL, with chunks handed back
+    through shared-memory rings in deterministic chunk order, so
+    accumulation stays bit-identical at any worker count.  ``path`` may
+    also be a LIST of files sharing a schema: the files stream as one
+    dataset (factor levels union across files) and shard naturally
+    across the ingest workers.  Composes with ``prefetch=`` (the thread
+    tier keeps the device-transfer overlap; the process tier feeds it).
 
     The end-to-end out-of-memory path: one global schema scan + one factor
     -level scan (``data/io.py``, C++ loader when built), then the file
@@ -814,23 +952,27 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
     """
     from .models import streaming
 
-    import os as _os
-
     f, terms, num_chunks, extract = _csv_stream_design(
         formula, path, named_cols={"weights": weights, "offset": offset},
         na_omit=na_omit, dtype=np.dtype(config.dtype),
         chunk_bytes=chunk_bytes, native=native, backend=backend)
-    # chunks past the HBM budget re-stream every IRLS pass: the parsed-chunk
-    # disk tier turns those re-parses into memory-mapped loads
-    extract, parse_cleanup = _parse_cache_wrap(
-        extract, parse_cache, _os.path.getsize(path))
+    if int(ingest_workers) > 0:
+        # the disk cache is OFF under process ingest: forked readers would
+        # race its writes, and parallel re-parse is the point of the tier
+        parse_cleanup = lambda: None  # noqa: E731
+    else:
+        # chunks past the HBM budget re-stream every IRLS pass: the
+        # parsed-chunk disk tier turns those re-parses into memory-mapped
+        # loads
+        extract, parse_cleanup = _parse_cache_wrap(
+            extract, parse_cache, _data_bytes(path))
 
-    def source():
-        # lazy thunks: when the streaming cache holds a chunk, skipping it
-        # costs nothing — no byte-range parse, no transform
-        # (models/streaming.py::_materialize)
-        for i in range(num_chunks):
-            yield lambda i=i: extract(i)
+    from .data.ingest import ShardedSource
+    # workers=0 yields the same lazy thunks the old generator did: when
+    # the streaming cache holds a chunk, skipping it costs nothing — no
+    # byte-range parse, no transform (models/streaming.py::_materialize)
+    source = ShardedSource(num_chunks, extract,
+                           workers=int(ingest_workers), label="glm_from_csv")
 
     yname = (f"cbind({f.response}, {f.response2})"
              if f.response2 is not None else f.response)
@@ -903,11 +1045,15 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
                 backend: str = "auto", retry=None, checkpoint=None,
                 resume=False, penalty=None, trace=None, metrics=None,
                 prefetch: int = 0, engine: str = "auto",
-                workers: int | None = None,
+                workers: int | None = None, ingest_workers: int = 0,
                 config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
     """OLS/WLS by formula straight from a CSV too big to load (two
     streaming passes: Gramian accumulation, then the exact host-f64
     residual pass; see :func:`glm_from_csv`).
+
+    ``ingest_workers=N`` parses chunks in N OS worker processes with
+    deterministic reassembly, and ``path`` may be a list of same-schema
+    files — see :func:`glm_from_csv`.
 
     ``weights``/``offset`` must be column names; ``offset()`` formula
     terms follow R's ``lm`` semantics like the resident :func:`lm`
@@ -924,21 +1070,24 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
         raise ValueError(
             "cbind() responses are for binomial glm(); lm() fits a single "
             "numeric response")
-    import os as _os
 
     f, terms, num_chunks, extract = _csv_stream_design(
         formula, path, named_cols={"weights": weights, "offset": offset},
         na_omit=na_omit, dtype=np.dtype(config.dtype),
         chunk_bytes=chunk_bytes, native=native, backend=backend)
-    # lm streams twice (Gramian pass + exact residual pass; three with an
-    # offset + intercept): later passes load memory-mapped parsed chunks
-    # instead of re-parsing
-    extract, parse_cleanup = _parse_cache_wrap(
-        extract, parse_cache, _os.path.getsize(path))
+    if int(ingest_workers) > 0:
+        # disk cache off under process ingest (see glm_from_csv)
+        parse_cleanup = lambda: None  # noqa: E731
+    else:
+        # lm streams twice (Gramian pass + exact residual pass; three with
+        # an offset + intercept): later passes load memory-mapped parsed
+        # chunks instead of re-parsing
+        extract, parse_cleanup = _parse_cache_wrap(
+            extract, parse_cache, _data_bytes(path))
 
-    def source():
-        for i in range(num_chunks):
-            yield lambda i=i: extract(i)
+    from .data.ingest import ShardedSource
+    source = ShardedSource(num_chunks, extract,
+                           workers=int(ingest_workers), label="lm_from_csv")
 
     if engine == "sketch":
         raise ValueError(

@@ -1,0 +1,474 @@
+"""Process-parallel sharded ingest: the one source abstraction every
+consumer drinks from.
+
+Every scale axis in this repo — streaming fits, elastic shards, fleets,
+the online loop — is fed by a "chunk source": a zero-arg callable whose
+iterator yields ``(X, y, w, offset)`` tuples or thunks realizing them
+(``models/streaming.py`` contract).  Until now the only way to overlap
+chunk production with compute was the thread-based ``prefetch_iter``,
+which BENCH_r15 showed LOSING to sequential on compute-bound passes: the
+producer thread's numpy/parse work fights the jitted pass for the GIL
+and the same cores.
+
+:class:`ShardedSource` is the process-parallel replacement.  It holds an
+indexed read plan (one entry per chunk — a file, a parquet row-group
+band, a byte range; opaque to this module) plus a ``read_chunk`` callable
+and fans the reads across N OS worker processes:
+
+* **Deterministic reassembly.**  Chunk ``seq`` is statically assigned to
+  worker ``seq % workers``; the consumer demands chunks in global ``seq``
+  order regardless of which worker finishes first.  The yielded sequence
+  is therefore IDENTICAL at any worker count, so the f64 left-to-right
+  Gramian accumulation downstream is bit-identical for
+  ``workers ∈ {0, 1, N}`` (PARITY.md).
+
+* **Shared-memory ring handoff.**  Each worker owns a
+  ``multiprocessing.shared_memory`` segment of ``ring_slots`` fixed-size
+  slots (sized from its first parsed chunk, like ``_bucket_pad``'s
+  first-chunk bucket) and a semaphore counting free slots.  Workers parse
+  and copy arrays into the next slot; the consumer wraps zero-copy numpy
+  views of the slot, then materializes OWNED copies before releasing the
+  slot — callers (the device cache's fingerprints, ``resume=`` probing,
+  the parse cache) hold chunk references far beyond the next ring lap,
+  so handing out live views would let slot reuse corrupt them.  The copy
+  is one memcpy; the parse work is what the workers parallelize.  Chunks
+  that don't fit a slot (or aren't flat array tuples — e.g. a
+  ``StructuredDesign`` leaf) fall back to pickling through the metadata
+  queue: slower, still parallel, still in-order.
+
+* **Single-process fallback.**  ``workers=0`` yields lazy thunks in plan
+  order — byte-for-byte the semantics (laziness, chunk order, failure
+  points) of the sequential sources it replaces, so the cached-prefix
+  skip economics of the device cache are untouched.
+
+* **Worker death is survivable.**  The consumer detects a dead worker
+  (queue starved + process gone), spends one unit of a typed retry
+  budget (:class:`~..robust.retry.RetryPolicy` /
+  ``RetryBudgetExhausted`` with an :class:`IngestWorkerLost` cause), and
+  re-reads the lost worker's remaining chunks inline, in order — the
+  yielded sequence, and therefore the fit, stays bit-identical.
+  ``robust/faults.py`` schedules deterministic worker kills via
+  ``FaultPlan(ingest_worker_dead_at=[(worker, k)])``.
+
+Workers are ``fork``-context children that run ONLY the ``read_chunk``
+callable (numpy/pyarrow/C-loader parsing) — never JAX — the standard
+data-loader discipline for forking from an XLA-initialized process.  On
+platforms without ``fork`` the source degrades to the sequential path.
+
+Observability: the consumer emits one ``ingest_read`` trace event per
+chunk (worker, rows, bytes, worker-measured parse seconds, transport)
+and a per-pass ``ingest_pass`` summary + ``queue_wait`` event;
+``obs/profile.py`` prices ``ingest_pass`` into the
+``profile.ingest.bandwidth_bytes_s`` gauge (delivered bytes over the
+pass wall clock) next to ``profile.mfu.*``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue as _queue
+import time
+import uuid
+from typing import Callable, Iterable, Sequence
+
+import numpy as np
+
+from ..obs import trace as _obs_trace
+from ..robust.retry import RetryPolicy, TransientSourceError
+
+__all__ = ["ShardedSource", "IngestWorkerLost"]
+
+
+class IngestWorkerLost(TransientSourceError):
+    """An ingest worker process died before delivering its chunk.
+
+    Transient BY TYPE: the consumer re-reads the lost worker's remaining
+    chunks inline, spending one retry-budget unit per death — a genuinely
+    dying host exhausts the budget and fails fast with this as the
+    ``RetryBudgetExhausted`` cause."""
+
+
+def _flatten(chunk):
+    """Split a chunk into shm-transportable arrays plus a reassembly spec.
+
+    Returns ``(arrays, spec)`` where ``spec[i]`` is ``"arr"`` (next array
+    in order) or ``("val", literal)`` for None/number slots, or
+    ``(None, None)`` when the chunk isn't a flat array tuple (structured
+    designs, dicts) and must ride the pickle queue instead."""
+    if not isinstance(chunk, (tuple, list)):
+        return None, None
+    arrays, spec = [], []
+    for item in chunk:
+        if isinstance(item, np.ndarray) and not item.dtype.hasobject:
+            arrays.append(np.ascontiguousarray(item))
+            spec.append("arr")
+        elif item is None or isinstance(item, (bool, int, float)):
+            spec.append(("val", item))
+        else:
+            return None, None
+    return arrays, spec
+
+
+def _unflatten(spec, arrays):
+    out, k = [], 0
+    for s in spec:
+        if s == "arr":
+            out.append(arrays[k])
+            k += 1
+        else:
+            out.append(s[1])
+    return tuple(out)
+
+
+def _chunk_rows(chunk) -> int:
+    """Best-effort row count of a chunk (y's length for the streaming
+    tuple convention; first array otherwise)."""
+    if isinstance(chunk, (tuple, list)):
+        for item in (*chunk[1:2], *chunk[:1], *chunk[2:]):
+            shape = getattr(item, "shape", None)
+            if shape:
+                return int(shape[0])
+    return 0
+
+
+def _chunk_nbytes(chunk) -> int:
+    if isinstance(chunk, (tuple, list)):
+        return int(sum(getattr(a, "nbytes", 0) for a in chunk))
+    return int(getattr(chunk, "nbytes", 0))
+
+
+def _safe_exc(e: BaseException) -> BaseException:
+    """An exception safe to send through an mp.Queue (whose feeder thread
+    pickles asynchronously — an unpicklable payload would vanish)."""
+    try:
+        pickle.loads(pickle.dumps(e))
+        return e
+    except Exception:
+        return RuntimeError(f"unpicklable {type(e).__name__}: {e!r}")
+
+
+class _WorkerState:
+    """Consumer-side handle on one worker: process, queue, free-slot
+    semaphore, attached shm (after its ``shm_open``), liveness."""
+
+    __slots__ = ("proc", "q", "sem", "name", "shm", "slot_bytes", "dead")
+
+    def __init__(self, proc, q, sem, name):
+        self.proc, self.q, self.sem, self.name = proc, q, sem, name
+        self.shm = None
+        self.slot_bytes = 0
+        self.dead = False
+
+    def attach(self, slot_bytes) -> None:
+        """Map the worker's ring and immediately unlink its name: both
+        sides keep their mappings, nothing can leak the segment, and the
+        resource tracker's create-time registration is balanced here
+        rather than at teardown."""
+        from multiprocessing import shared_memory as _shmod
+        self.shm = _shmod.SharedMemory(name=self.name)
+        self.slot_bytes = int(slot_bytes)
+        try:
+            self.shm.unlink()
+        except OSError:
+            pass
+
+
+class ShardedSource:
+    """An indexed, optionally process-parallel chunk source.
+
+    ``plan`` is an int (→ ``range(n)``) or a sequence of opaque chunk ids;
+    ``read_chunk(plan[i])`` parses one chunk.  The instance is a zero-arg
+    callable satisfying the streaming source contract: ``workers=0``
+    yields thunks in plan order (current sequential semantics);
+    ``workers>=1`` yields materialized chunks reassembled into the same
+    order from ``workers`` fork-context reader processes.
+
+    ``subset(positions)`` narrows the plan (the elastic scheduler's
+    round-robin sharding); ``with_workers(n)`` rebinds the worker count
+    (how ``ingest_workers=`` threads through the drivers).  Both preserve
+    ``read_chunk`` identity, so fingerprint/resume contracts hold.
+    """
+
+    def __init__(self, plan, read_chunk: Callable, *, workers: int = 0,
+                 ring_slots: int = 2, label: str = "ingest",
+                 fault_plan=None, retry: RetryPolicy | None = None):
+        if isinstance(plan, (int, np.integer)):
+            plan = range(int(plan))
+        self._plan = list(plan)
+        self._read = read_chunk
+        self.workers = int(workers)
+        if self.workers < 0:
+            raise ValueError(f"workers must be >= 0, got {workers}")
+        self.ring_slots = max(1, int(ring_slots))
+        self.label = str(label)
+        self.fault_plan = fault_plan
+        self.retry = retry
+        self.last_stats: dict = {}
+
+    # -- source contract ----------------------------------------------------
+
+    @property
+    def process_parallel(self) -> bool:
+        """True when iteration spawns reader processes (streaming drivers
+        key producer policy off this: degrade controller retired, eager
+        device-put lookahead enabled)."""
+        return self.workers >= 1
+
+    def __len__(self) -> int:
+        return len(self._plan)
+
+    def __call__(self):
+        if self.workers < 1 or len(self._plan) == 0:
+            return self._sequential()
+        try:
+            import multiprocessing
+            ctx = multiprocessing.get_context("fork")
+        except (ImportError, ValueError):  # no fork (e.g. not POSIX)
+            return self._sequential()
+        return self._parallel(ctx)
+
+    # -- derivation ---------------------------------------------------------
+
+    def _clone(self, plan, workers):
+        return ShardedSource(plan, self._read, workers=workers,
+                             ring_slots=self.ring_slots, label=self.label,
+                             fault_plan=self.fault_plan, retry=self.retry)
+
+    def with_workers(self, workers: int) -> "ShardedSource":
+        """The same plan and reader at a different worker count."""
+        return self._clone(self._plan, int(workers))
+
+    def subset(self, positions: Iterable[int]) -> "ShardedSource":
+        """The sub-plan at the given positions, in the given order —
+        shard selection without iterating (or parsing) the rest."""
+        return self._clone([self._plan[int(i)] for i in positions],
+                           self.workers)
+
+    # -- sequential fallback ------------------------------------------------
+
+    def _sequential(self):
+        for cid in self._plan:
+            yield (lambda cid=cid: self._read(cid))
+
+    # -- worker process -----------------------------------------------------
+
+    def _worker_main(self, w: int, n_workers: int, q, sem,
+                     ring_name: str) -> None:
+        # Forked child: drop the inherited ambient tracer so reader-level
+        # events (data/io.py `read`, retries) don't double-emit through
+        # inherited sinks; the consumer emits the ingest events.
+        _obs_trace._AMBIENT = None
+        shm = None
+        slot = 0
+        try:
+            my = range(w, len(self._plan), n_workers)
+            for k, seq in enumerate(my):
+                if self.fault_plan is not None:
+                    self.fault_plan.on_ingest_read(w, k)
+                t0 = time.perf_counter()
+                try:
+                    chunk = self._read(self._plan[seq])
+                except BaseException as e:  # noqa: BLE001 — re-raised at seq
+                    q.put(("err", seq, _safe_exc(e)))
+                    return
+                read_s = time.perf_counter() - t0
+                rows, nbytes = _chunk_rows(chunk), _chunk_nbytes(chunk)
+                arrays, spec = _flatten(chunk)
+                need = sum(a.nbytes for a in arrays) if arrays else 0
+                if shm is None and arrays is not None:
+                    # Fixed-size ring sized from the first chunk with the
+                    # same headroom logic as _bucket_pad's first-chunk
+                    # bucket; later oversized chunks ride the queue.
+                    from multiprocessing import shared_memory as _shmod
+                    slot_bytes = max(4096, 2 * need)
+                    shm = _shmod.SharedMemory(
+                        name=ring_name, create=True,
+                        size=self.ring_slots * slot_bytes)
+                    q.put(("shm_open", slot_bytes))
+                if arrays is None or shm is None or need > slot_bytes:
+                    q.put(("raw", seq, chunk, read_s, rows, nbytes))
+                    continue
+                sem.acquire()  # a free slot (consumer released it)
+                base = slot * slot_bytes
+                metas, off = [], 0
+                for a in arrays:
+                    view = np.ndarray(a.shape, a.dtype, buffer=shm.buf,
+                                      offset=base + off)
+                    view[...] = a
+                    metas.append((off, a.shape, a.dtype.str))
+                    off += a.nbytes
+                q.put(("shm", seq, slot, metas, spec, read_s, rows, nbytes))
+                slot = (slot + 1) % self.ring_slots
+            q.put(("done", w))
+        finally:
+            if shm is not None:
+                shm.close()
+
+    # -- consumer -----------------------------------------------------------
+
+    def _next_msg(self, st: _WorkerState):
+        """The worker's next data message, or None if it died first.
+        Handles ``shm_open`` attachment in-line; returns the wait time
+        spent blocked alongside the message."""
+        waited = 0.0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                msg = st.q.get(timeout=0.05)
+            except _queue.Empty:
+                waited += time.perf_counter() - t0
+                if st.proc.is_alive():
+                    continue
+                try:  # drain what a dying worker managed to flush
+                    msg = st.q.get(timeout=0.2)
+                except _queue.Empty:
+                    return None, waited
+            else:
+                waited += time.perf_counter() - t0
+            if msg[0] == "shm_open":
+                st.attach(msg[1])
+                continue
+            if msg[0] == "done":
+                return None, waited  # finished without our chunk: dead-equiv
+            return msg, waited
+
+    def _parallel(self, ctx):
+        n = len(self._plan)
+        n_workers = min(self.workers, n)
+        policy = self.retry if self.retry is not None else RetryPolicy()
+        budget = policy.new_budget()
+        states = []
+        stats = dict(reads=0, rows=0, bytes=0, read_s=0.0, wait_s=0.0,
+                     wall_s=0.0, inline_rereads=0, workers_died=0,
+                     workers=n_workers)
+        self.last_stats = stats
+        t0 = time.perf_counter()  # wall includes spawn: delivered bandwidth
+        try:
+            # Start the resource tracker BEFORE forking so children inherit
+            # it: a child-spawned tracker would unlink rings at child exit,
+            # racing the consumer's attach.
+            from multiprocessing import resource_tracker
+            resource_tracker.ensure_running()
+        except Exception:
+            pass
+        try:
+            import warnings
+            for w in range(n_workers):
+                q = ctx.Queue()
+                sem = ctx.Semaphore(self.ring_slots)
+                # The consumer names the ring so teardown can clean it
+                # even when a dying worker's announcement never flushed.
+                name = f"sparkglm_{os.getpid()}_{uuid.uuid4().hex[:8]}_{w}"
+                proc = ctx.Process(target=self._worker_main,
+                                   args=(w, n_workers, q, sem, name),
+                                   daemon=True)
+                with warnings.catch_warnings():
+                    # JAX warns on any fork from a multithreaded process;
+                    # the children here run only numpy/pyarrow parsing and
+                    # never touch JAX (module docstring) — the data-loader
+                    # fork discipline the warning cannot see.
+                    warnings.filterwarnings(
+                        "ignore", message=r"os\.fork\(\) was called",
+                        category=RuntimeWarning)
+                    proc.start()
+                states.append(_WorkerState(proc, q, sem, name))
+
+            for seq in range(n):
+                st = states[seq % n_workers]
+                if st.dead:
+                    chunk, read_s, transport = self._reread(seq)
+                else:
+                    msg, waited = self._next_msg(st)
+                    stats["wait_s"] += waited
+                    if msg is None:
+                        stats["workers_died"] += 1
+                        _obs_trace.emit_ambient(
+                            "ingest_worker_dead", worker=seq % n_workers,
+                            index=seq, label=self.label)
+                        budget.spend(IngestWorkerLost(
+                            f"ingest worker {seq % n_workers} died before "
+                            f"chunk {seq} ({self.label})"))
+                        st.dead = True
+                        chunk, read_s, transport = self._reread(seq)
+                    elif msg[0] == "err":
+                        raise msg[2]
+                    elif msg[0] == "raw":
+                        _, _, chunk, read_s, rows, nbytes = msg
+                        transport = "queue"
+                    else:  # "shm"
+                        _, _, slot, metas, spec, read_s, rows, nbytes = msg
+                        base = slot * st.slot_bytes
+                        arrays = [np.ndarray(shape, np.dtype(dt),
+                                             buffer=st.shm.buf,
+                                             offset=base + off).copy()
+                                  for off, shape, dt in metas]
+                        st.sem.release()  # slot free for the worker's ring
+                        chunk = _unflatten(spec, arrays)
+                        transport = "shm"
+                if transport in ("inline", "reread"):
+                    rows, nbytes = _chunk_rows(chunk), _chunk_nbytes(chunk)
+                stats["reads"] += 1
+                stats["rows"] += rows
+                stats["bytes"] += nbytes
+                stats["read_s"] += read_s
+                _obs_trace.emit_ambient(
+                    "ingest_read", index=seq, worker=seq % n_workers,
+                    rows=rows, bytes=nbytes, seconds=read_s,
+                    transport=transport, label=self.label)
+                yield chunk
+            stats["wall_s"] = time.perf_counter() - t0
+            _obs_trace.emit_ambient(
+                "ingest_pass", label=self.label, workers=n_workers,
+                reads=stats["reads"], rows=stats["rows"],
+                bytes=stats["bytes"], read_s=stats["read_s"],
+                wall_s=stats["wall_s"],
+                queue_wait_s=stats["wait_s"],
+                rereads=stats["inline_rereads"],
+                workers_died=stats["workers_died"])
+            if stats["wait_s"] > 0.0:
+                _obs_trace.emit_ambient(
+                    "queue_wait", seconds=stats["wait_s"],
+                    waits=stats["reads"], label=self.label)
+        finally:
+            self._teardown(states)
+
+    def _reread(self, seq: int):
+        """Inline recovery read of a dead worker's chunk — same reader,
+        same plan entry, so the yielded bytes match what the worker would
+        have produced."""
+        t0 = time.perf_counter()
+        chunk = self._read(self._plan[seq])
+        self.last_stats["inline_rereads"] += 1
+        return chunk, time.perf_counter() - t0, "reread"
+
+    @staticmethod
+    def _teardown(states) -> None:
+        for st in states:
+            try:
+                if st.proc.is_alive():
+                    st.proc.terminate()
+                st.proc.join(timeout=2.0)
+            except Exception:
+                pass
+            if st.shm is None:
+                # Ring created but never attached (abandoned pass, or a
+                # worker that died before its announcement flushed):
+                # clean it by the name the consumer assigned.
+                try:
+                    from multiprocessing import shared_memory as _shmod
+                    orphan = _shmod.SharedMemory(name=st.name)
+                    orphan.unlink()
+                    orphan.close()
+                except Exception:
+                    pass
+            try:
+                st.q.cancel_join_thread()
+                st.q.close()
+            except Exception:
+                pass
+            if st.shm is not None:
+                try:
+                    st.shm.close()
+                except Exception:
+                    pass

@@ -61,6 +61,17 @@ The pipeline is representation-agnostic: items are opaque, so structured
 chunks (``data/structured.py`` — a dense leaf plus per-factor level-index
 vectors) ride through exactly like dense matrices, and the determinism
 contract above applies unchanged to the segment-sum streaming passes.
+
+Two-tier producer (r18): this thread-based tier is ONE of two ways a
+streaming pass overlaps production with compute.  The process-parallel
+tier (``data/ingest.py``'s ``ShardedSource``, ``ingest_workers=N``)
+moves the parse work into OS worker processes entirely — when it is
+active the streaming drivers pass ``auto_degrade=False`` here (there is
+no GIL contention left for the degrade controller to detect; its probe
+was the flaky part of the r15 ``streaming_pipeline`` gate) and use
+:func:`lookahead_iter` instead of a producer thread when ``prefetch``
+is off, so the next chunk's async ``device_put`` still overlaps the
+current chunk's compute.
 """
 
 from __future__ import annotations
@@ -72,7 +83,7 @@ from typing import Callable, Iterator
 
 from ..obs import trace as _obs_trace
 
-__all__ = ["PassStats", "prefetch_iter", "tee_source"]
+__all__ = ["PassStats", "lookahead_iter", "prefetch_iter", "tee_source"]
 
 _ITEM, _ERR, _DONE, _HAND = "item", "err", "done", "hand"
 
@@ -145,6 +156,48 @@ def prefetch_iter(make_iter: Callable[[], Iterator], prefetch: int,
         raise ValueError(f"prefetch must be >= 1, got {prefetch}")
     return _prefetch_gen(make_iter, int(prefetch), stats,
                          bool(auto_degrade))
+
+
+def lookahead_iter(it: Iterator, depth: int = 1) -> Iterator:
+    """Same-thread eager lookahead: hold ``depth`` produced items ahead of
+    the consumer — the double-buffered ``jax.device_put`` of the process-
+    parallel ingest path (data/ingest.py).
+
+    When chunk production ends in a ``device_put`` (the streaming fits'
+    ``device_chunks``/``staged_chunks`` producers), pulling item ``k+1``
+    before yielding item ``k`` DISPATCHES the next chunk's async H2D copy
+    before the consumer launches chunk ``k``'s jitted pass, so the copy
+    overlaps the Fisher/Gramian compute — no thread, no GIL contention,
+    no queue.  Only worth it when production itself is cheap on this
+    thread (parse already happened in worker processes and device_put is
+    asynchronous); for thread-prefetch (``prefetch>=2``) the bounded
+    queue already provides the overlap, and for sequential in-process
+    sources an eager pull would just move blocking parse work earlier.
+
+    Items are yielded strictly in order; a production error surfaces at
+    most ``depth`` items early (the process-ingest contract — the
+    sequential fallback keeps exact failure positions).  Closing the
+    iterator closes the underlying one (worker teardown propagates).
+    """
+    if depth < 1:
+        raise ValueError(f"lookahead depth must be >= 1, got {depth}")
+    buf: list = []
+    it = iter(it)
+    try:
+        done = False
+        while True:
+            while not done and len(buf) <= depth:
+                try:
+                    buf.append(next(it))
+                except StopIteration:
+                    done = True
+            if not buf:
+                return
+            yield buf.pop(0)
+    finally:
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()
 
 
 def tee_source(source: Callable[[], Iterator], n: int = 2, *,

@@ -18,6 +18,12 @@ Laziness is preserved: the wrappers re-yield the source's items without
 touching them, so thunks belonging to OTHER shards are never materialized
 — selecting one shard out of S costs S× iteration but only 1/S of the
 parse/IO work for lazy sources like the from-CSV byte-range reader.
+
+Index-addressable sources (``data/ingest.py``'s ``ShardedSource``, or
+anything exposing ``subset(positions)``) take a fast path: the shard is
+a real sub-source over just its own chunk indices, so a process-parallel
+source keeps its worker fan-out per shard instead of degrading to
+enumerate-and-skip.
 """
 
 from __future__ import annotations
@@ -48,6 +54,8 @@ def shard_source(chunks: Callable, shard: int, num_shards: int) -> Callable:
     if not 0 <= shard < num_shards:
         raise ValueError(
             f"shard must be in [0, {num_shards}), got {shard}")
+    if hasattr(chunks, "subset") and hasattr(chunks, "__len__"):
+        return chunks.subset(range(shard, len(chunks), num_shards))
 
     def gen():
         for i, raw in enumerate(chunks()):
@@ -73,6 +81,9 @@ def surviving_source(chunks: Callable, survivors: Iterable[int],
     if bad:
         raise ValueError(
             f"surviving shards {sorted(bad)} out of range [0, {num_shards})")
+    if hasattr(chunks, "subset") and hasattr(chunks, "__len__"):
+        return chunks.subset(
+            [i for i in range(len(chunks)) if i % num_shards in keep])
 
     def gen():
         for i, raw in enumerate(chunks()):

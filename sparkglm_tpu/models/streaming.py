@@ -94,14 +94,41 @@ def _check_prefetch(prefetch) -> int:
     return prefetch
 
 
-def _pass_iter(make_iter, prefetch: int):
+def _pass_iter(make_iter, prefetch: int, process_parallel: bool = False):
     """A pass's chunk stream: pipelined through a bounded producer thread
     when ``prefetch >= 2``, plain in-thread iteration otherwise.  Returns
-    ``(iterator, PassStats | None)``."""
+    ``(iterator, PassStats | None)``.
+
+    ``process_parallel=True`` (the source is a ``data/ingest.py``
+    ``ShardedSource`` with workers) switches producer policy: the
+    GIL-sensitive auto-degrade controller is retired to a no-op (parse
+    work happens in other PROCESSES, so the contention its probe
+    A/B-tests for cannot occur — and its one-shot probe was the flaky
+    part of the r15 ``streaming_pipeline`` gate), and without a prefetch
+    thread the pass still gets H2D/compute overlap from the eager
+    one-chunk ``lookahead_iter`` (double-buffered ``device_put``)."""
     if prefetch >= 2:
         stats = _pipeline.PassStats()
-        return _pipeline.prefetch_iter(make_iter, prefetch, stats=stats), stats
+        return _pipeline.prefetch_iter(
+            make_iter, prefetch, stats=stats,
+            auto_degrade=not process_parallel), stats
+    if process_parallel:
+        return _pipeline.lookahead_iter(make_iter()), None
     return make_iter(), None
+
+
+def _source_workers(source, ingest_workers):
+    """Apply an ``ingest_workers=`` override to a sharded source and
+    report whether the result is process-parallel.  Returns
+    ``(source, process_parallel)``."""
+    if ingest_workers is not None:
+        if not hasattr(source, "with_workers"):
+            raise ValueError(
+                "ingest_workers= requires a ShardedSource chunk source "
+                "(sparkglm_tpu.data.ingest) — this source has no "
+                "with_workers()")
+        source = source.with_workers(int(ingest_workers))
+    return source, bool(getattr(source, "process_parallel", False))
 
 
 def _emit_pipeline_events(tracer, stats, label: str, index: int) -> None:
@@ -307,7 +334,27 @@ def _source_first_chunk(chunks):
     ``chunks'`` hands the drawn chunk straight to the next pass: its FIRST
     invocation replays the materialized chunk 0 and then continues the
     still-open iterator, so the fingerprint probe no longer costs a second
-    parse of chunk 0 (later invocations re-open the source as usual)."""
+    parse of chunk 0 (later invocations re-open the source as usual).
+
+    A process-parallel source (``data/ingest.py``) is probed INLINE via
+    ``subset([0])`` at workers=0 instead: holding a live worker fleet
+    open across checkpoint validation would leak N processes whenever
+    validation raises, and spawning the fleet to draw one chunk costs
+    more than the one sequential parse it saves.  The source is returned
+    unchanged — the first pass re-reads chunk 0 through the workers,
+    where the parse is amortized across the fleet anyway."""
+    if (getattr(chunks, "process_parallel", False)
+            and hasattr(chunks, "subset")):
+        probe = chunks.with_workers(0).subset([0])
+        first = next(iter(probe()), None)
+        if first is None:
+            raise ValueError("source yielded no chunks")
+        Xc0, yc0, wc0, oc0 = _materialize(first)
+        fp = ((int(Xc0.shape[0]), int(Xc0.shape[1]))
+              if _is_device_chunk(Xc0)
+              else _fingerprint(Xc0, yc0, wc0, oc0))
+        return (fp, int(Xc0.shape[1]),
+                isinstance(Xc0, StructuredDesign), chunks)
     it = iter(chunks())
     first = next(it, None)
     if first is None:
@@ -1002,6 +1049,7 @@ def lm_fit_streaming(
     trace=None,
     metrics=None,
     prefetch: int = 0,
+    ingest_workers: int | None = None,
     config: NumericConfig = DEFAULT,
 ) -> LMModel:
     """OLS/WLS in ONE streaming pass (host-f64 accumulation + solve).
@@ -1044,7 +1092,8 @@ def lm_fit_streaming(
     kw = dict(chunk_rows=chunk_rows, xnames=xnames, yname=yname,
               has_intercept=has_intercept, mesh=mesh, retry=retry,
               checkpoint=checkpoint, resume=resume, config=config,
-              prefetch=prefetch, tracer=tracer)
+              prefetch=prefetch, ingest_workers=ingest_workers,
+              tracer=tracer)
     if tracer is None:
         return _lm_fit_streaming_impl(source, **kw)
     with _obs_trace.ambient(tracer):
@@ -1067,6 +1116,7 @@ def _lm_fit_streaming_impl(
     resume,
     config,
     prefetch,
+    ingest_workers,
     tracer,
 ) -> LMModel:
     """Body of :func:`lm_fit_streaming` with the tracer already resolved."""
@@ -1075,6 +1125,7 @@ def _lm_fit_streaming_impl(
     nproc = jax.process_count()
     mesh = _streaming_mesh(mesh)
     chunks = _as_source(source, chunk_rows)
+    chunks, proc_par = _source_workers(chunks, ingest_workers)
     if retry is not None:
         from ..robust.retry import retrying_source
         chunks = retrying_source(chunks, retry)
@@ -1179,7 +1230,7 @@ def _lm_fit_streaming_impl(
     pstats = None
     try:
         if _ck_state is None:
-            chunk_iter, pstats = _pass_iter(staged_chunks, prefetch)
+            chunk_iter, pstats = _pass_iter(staged_chunks, prefetch, proc_par)
             pending = None  # chunk k's in-flight device results + moments
 
             def drain(ent):
@@ -1317,7 +1368,8 @@ def _lm_fit_streaming_impl(
     if tracer is not None:
         tracer.pass_start("residuals", 2)
     err = None
-    res_iter, res_stats = _pass_iter(lambda: _iter_chunks(chunks), prefetch)
+    res_iter, res_stats = _pass_iter(lambda: _iter_chunks(chunks), prefetch,
+                                     proc_par)
     try:
         for Xc, yc, wc, oc in res_iter:
             xb = _chunk_xbeta(Xc, beta)
@@ -1381,7 +1433,7 @@ def _lm_fit_streaming_impl(
             mss = 0.0
             err = None
             mss_iter, _mss_stats = _pass_iter(lambda: _iter_chunks(chunks),
-                                              prefetch)
+                                              prefetch, proc_par)
             try:
                 for Xc, yc, wc, oc in mss_iter:
                     xb = _chunk_xbeta(Xc, beta)
@@ -1463,6 +1515,7 @@ def glm_fit_streaming(
     trace=None,
     metrics=None,
     prefetch: int = 0,
+    ingest_workers: int | None = None,
     engine: str = "auto",
     config: NumericConfig = DEFAULT,
     _null_model: bool = False,
@@ -1546,7 +1599,8 @@ def glm_fit_streaming(
               verbose=verbose, beta0=beta0, on_iteration=on_iteration,
               cache=cache, cache_budget_bytes=cache_budget_bytes,
               retry=retry, checkpoint=checkpoint, resume=resume,
-              prefetch=prefetch, engine=engine, config=config,
+              prefetch=prefetch, ingest_workers=ingest_workers,
+              engine=engine, config=config,
               _null_model=_null_model, tracer=tracer)
     if tracer is None:
         return _glm_fit_streaming_impl(source, **kw)
@@ -1563,8 +1617,8 @@ def glm_fit_streaming(
 def _glm_fit_streaming_impl(
     source, *, family, link, tol, max_iter, criterion, chunk_rows, xnames,
     yname, has_intercept, mesh, verbose, beta0, on_iteration, cache,
-    cache_budget_bytes, retry, checkpoint, resume, prefetch, engine, config,
-    _null_model, tracer,
+    cache_budget_bytes, retry, checkpoint, resume, prefetch, ingest_workers,
+    engine, config, _null_model, tracer,
 ) -> GLMModel:
     """Body of :func:`glm_fit_streaming` with the tracer already resolved."""
     _check_polish(config)
@@ -1582,6 +1636,7 @@ def _glm_fit_streaming_impl(
     nproc = jax.process_count()
     mesh = _streaming_mesh(mesh)
     chunks = _as_source(source, chunk_rows)
+    chunks, proc_par = _source_workers(chunks, ingest_workers)
     if retry is not None:
         from ..robust.retry import retrying_source
         chunks = retrying_source(chunks, retry)
@@ -1704,7 +1759,7 @@ def _glm_fit_streaming_impl(
         # prefetch>=2: device_chunks (parse + validation + H2D staging)
         # runs on the pipeline's producer thread, its tracer events
         # replayed here in chunk order; sequential otherwise
-        chunk_iter, pstats = _pass_iter(device_chunks, prefetch)
+        chunk_iter, pstats = _pass_iter(device_chunks, prefetch, proc_par)
         for dX, dy, dw, do, n_true in chunk_iter:
             count += n_true
             nchunks += 1
@@ -2035,7 +2090,7 @@ def _glm_fit_streaming_impl(
     stats = None
     err = None
     stats_iter, stats_pstats = _pass_iter(lambda: _iter_chunks(chunks),
-                                          prefetch)
+                                          prefetch, proc_par)
     try:
         for Xc, yc, wc, oc in stats_iter:
             xb = _chunk_xbeta(Xc, beta)
@@ -2094,7 +2149,7 @@ def _glm_fit_streaming_impl(
         null_dev = 0.0
         err = None
         nd_iter, _nd_stats = _pass_iter(lambda: _iter_chunks(chunks),
-                                        prefetch)
+                                        prefetch, proc_par)
         try:
             for Xc, yc, wc, oc in nd_iter:
                 yc, wc, oc = _host_chunk(yc, wc, oc)

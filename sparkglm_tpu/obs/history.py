@@ -1,9 +1,12 @@
 """Capacity observatory, part 3: longitudinal bench regression tracking.
 
 Every growth round leaves a ``BENCH_r<N>.json`` behind — the driver's
-wrapped capture at the repo root (``{n, cmd, rc, tail, parsed}`` with
-``tail`` holding the last few KB of the bench's JSON detail) and, when
-the round committed it, the full detail dict under ``benchmarks/``.
+wrapped capture (``{n, cmd, rc, tail, parsed}`` with ``tail`` holding
+the last few KB of the bench's JSON detail) and, when the round
+committed it, the full detail dict.  Both now live under
+``benchmarks/`` (r18 moved the historical root-level captures there);
+the loader tells the formats apart by content, so either may appear in
+either place.
 Nothing reads them across rounds: a block can rot 20% per round and
 nobody notices until a headline falls over.  This module closes that
 loop:
@@ -62,6 +65,11 @@ BLOCKS: dict[str, dict] = {
                        "kind": "frac"},
     "streaming_pipeline": {"metric": "speedup_frac", "direction": "higher",
                            "kind": "frac"},
+    # r18 process-parallel ingest (data/ingest.py): wall-clock ratio of
+    # the sequential producer to the 4-worker process producer on the
+    # same multi-file source
+    "ingest_throughput": {"metric": "process_speedup",
+                          "direction": "higher", "kind": "value"},
     "serving_latency": {"metric": "rows_per_s", "direction": "higher",
                         "kind": "value"},
     "serving_scaleout": {"metric": "rows_per_s", "direction": "higher",
@@ -130,21 +138,38 @@ def _round_of(path: str) -> int | None:
 
 
 def load_rounds(repo_root: str | os.PathLike = ".") -> dict[int, dict]:
-    """Load every ``BENCH_r*.json`` under ``repo_root`` (driver-wrapped)
-    and ``repo_root/benchmarks/`` (full detail) into
-    ``{round: {block: block_dict}}``.  The full detail wins when a round
-    appears in both places (the tail is a lossy copy of it)."""
+    """Load every ``BENCH_r*.json`` under ``repo_root`` and
+    ``repo_root/benchmarks/`` into ``{round: {block: block_dict}}``.
+
+    The two FORMATS are detected by content, not location (r18: the
+    historical root-level driver captures live under ``benchmarks/``
+    too): a dict carrying ``tail`` + ``rc`` is a driver-wrapped capture
+    and is mined from its truncated tail; anything else is a full detail
+    dict and loads directly.  Full detail wins when a round appears in
+    both forms (the tail is a lossy copy of it)."""
     root = os.fspath(repo_root)
-    rounds: dict[int, dict] = {}
-    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+    paths = (sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+             + sorted(glob.glob(os.path.join(root, "benchmarks",
+                                             "BENCH_r*.json"))))
+    wrapped_files: list[tuple[int, dict]] = []
+    detail_files: list[tuple[int, dict]] = []
+    for path in paths:
         r = _round_of(path)
         if r is None:
             continue
         try:
             with open(path, "r", encoding="utf-8") as f:
-                wrapped = json.load(f)
+                data = json.load(f)
         except (OSError, json.JSONDecodeError):
             continue
+        if not isinstance(data, dict):
+            continue
+        if "tail" in data and "rc" in data:
+            wrapped_files.append((r, data))
+        else:
+            detail_files.append((int(data.get("round", r)), data))
+    rounds: dict[int, dict] = {}
+    for r, wrapped in wrapped_files:
         tail = wrapped.get("tail") or ""
         blocks: dict[str, dict] = {}
         for name in BLOCKS:
@@ -153,19 +178,7 @@ def load_rounds(repo_root: str | os.PathLike = ".") -> dict[int, dict]:
                 blocks[name] = b
         if blocks:
             rounds.setdefault(r, {}).update(blocks)
-    for path in sorted(glob.glob(os.path.join(root, "benchmarks",
-                                              "BENCH_r*.json"))):
-        r = _round_of(path)
-        if r is None:
-            continue
-        try:
-            with open(path, "r", encoding="utf-8") as f:
-                detail = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            continue
-        if not isinstance(detail, dict):
-            continue
-        r = int(detail.get("round", r))
+    for r, detail in detail_files:
         blocks = {name: detail[name] for name in BLOCKS
                   if isinstance(detail.get(name), dict)}
         rounds.setdefault(r, {}).update(blocks)  # detail overrides tail

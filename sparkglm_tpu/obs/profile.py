@@ -24,6 +24,12 @@ gauges behind the :class:`~.export.Telemetry` facade:
     priced event and exporting ``profile.mfu.<flavor>`` /
     ``profile.bandwidth_frac.<flavor>`` gauges plus cumulative
     ``profile.flops.<flavor>`` / ``profile.bytes.<flavor>`` counters.
+    It also prices the process-parallel ingest plane's ``ingest_pass``
+    events (data/ingest.py) into ``profile.ingest.bandwidth_bytes_s``
+    (delivered bytes over the pass wall clock) and
+    ``profile.ingest.parallelism`` (worker parse-seconds per wall
+    second) — the host side of the compute/ingest overlap, next to the
+    device gauges it feeds.
   * :class:`MemoryLedger` — live-array bytes and peak per fit/engine via
     ``device.memory_stats()`` where the backend provides it (TPU/GPU),
     host-side ``jax.live_arrays()`` accounting otherwise.
@@ -203,6 +209,37 @@ class Profiler(Sink):
         self.cost = cost_model if cost_model is not None else CostModel()
         # flavor -> {calls, flops, bytes, seconds, mfu, bandwidth_frac}
         self.flavors: dict[str, dict] = {}
+        # process-parallel ingest plane (data/ingest.py ingest_pass
+        # events): delivered bytes over the pass wall clock, next to the
+        # device gauges — the two sides of the overlap story
+        self.ingest = {"passes": 0, "reads": 0, "rows": 0, "bytes": 0.0,
+                       "read_s": 0.0, "wall_s": 0.0,
+                       "bandwidth_bytes_s": 0.0, "parallelism": 0.0}
+
+    def _price_ingest(self, f: dict) -> None:
+        agg = self.ingest
+        wall = float(f.get("wall_s", 0.0) or 0.0)
+        nbytes = float(f.get("bytes", 0) or 0)
+        read_s = float(f.get("read_s", 0.0) or 0.0)
+        agg["passes"] += 1
+        agg["reads"] += int(f.get("reads", 0) or 0)
+        agg["rows"] += int(f.get("rows", 0) or 0)
+        agg["bytes"] += nbytes
+        agg["read_s"] += read_s
+        agg["wall_s"] += wall
+        bw = nbytes / wall if wall > 0 else 0.0
+        # worker-seconds of parsing per wall second: the overlap won
+        par = read_s / wall if wall > 0 else 0.0
+        agg["bandwidth_bytes_s"] = bw
+        agg["parallelism"] = par
+        m = self.metrics
+        if m is not None:
+            m.gauge("profile.ingest.bandwidth_bytes_s").set(bw)
+            m.gauge("profile.ingest.parallelism").set(par)
+            m.counter("profile.ingest.bytes").inc(int(nbytes))
+            m.counter("profile.ingest.rows").inc(int(f.get("rows", 0) or 0))
+            m.histogram("profile.ingest.pass_wall_s").observe(
+                max(wall, 1e-9))
 
     def emit(self, event: TraceEvent) -> None:
         f = event.fields
@@ -210,6 +247,9 @@ class Profiler(Sink):
             flavor = _solve_flavor(f)
         elif event.kind == "scorer_kernel":
             flavor = "scorer"
+        elif event.kind == "ingest_pass":
+            self._price_ingest(f)
+            return
         else:
             return
         if flavor is None:
@@ -265,7 +305,13 @@ class Profiler(Sink):
         return {"platform": self.cost.platform,
                 "peak_flops": self.cost.peak_flops,
                 "peak_bytes_s": self.cost.peak_bytes_s,
-                "flavors": out}
+                "flavors": out,
+                "ingest": (dict(self.ingest,
+                                bandwidth_bytes_s_avg=(
+                                    self.ingest["bytes"]
+                                    / self.ingest["wall_s"]
+                                    if self.ingest["wall_s"] > 0 else 0.0))
+                           if self.ingest["passes"] else None)}
 
 
 # -- device memory accounting -------------------------------------------------

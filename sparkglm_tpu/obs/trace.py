@@ -98,6 +98,19 @@ structured event stream:
                                 full-state snapshot, and a resume
                                 replaying records to the exact chunk
                                 boundary
+  ``ingest_read`` / ``ingest_pass``  the process-parallel ingest plane
+                                (data/ingest.py): one chunk handed to the
+                                consumer (worker id, rows/bytes, the
+                                WORKER-measured read seconds, and the
+                                transport it rode — shm ring / pickle
+                                queue / inline reread), and one source
+                                pass's totals (parallel read seconds vs
+                                consumer queue-wait — the overlap won)
+  ``ingest_worker_dead``        an ingest worker process died mid-pass;
+                                the consumer re-reads its remaining
+                                chunks inline under the typed retry
+                                budget (robust/retry.py), so the pass
+                                survives bit-identically
 
 Events are ordered by a per-tracer monotone sequence number assigned under
 a lock, so two runs of the same deterministic fit produce the same
@@ -294,6 +307,13 @@ class FitTracer:
         self._reads = 0
         self._read_bytes = 0
         self._read_s = 0.0
+        self._ingest_reads = 0
+        self._ingest_rows = 0
+        self._ingest_bytes = 0
+        self._ingest_read_s = 0.0
+        self._ingest_rereads = 0
+        self._ingest_workers_died = 0
+        self._ingest_workers_max = 0
         self._retries = 0
         self._chunks_skipped = 0
         self._checkpoint_writes = 0
@@ -422,6 +442,26 @@ class FitTracer:
             if m is not None:
                 m.histogram("read.seconds").observe(
                     float(f.get("seconds", 0.0)))
+        elif ev.kind == "ingest_read":
+            self._ingest_reads += 1
+            self._ingest_rows += int(f.get("rows", 0))
+            self._ingest_bytes += int(f.get("bytes", 0))
+            self._ingest_read_s += float(f.get("seconds", 0.0))
+            if f.get("transport") == "reread":
+                self._ingest_rereads += 1
+            if m is not None:
+                m.histogram("ingest.read_s").observe(
+                    float(f.get("seconds", 0.0)))
+        elif ev.kind == "ingest_pass":
+            self._ingest_workers_max = max(self._ingest_workers_max,
+                                           int(f.get("workers", 0)))
+            if m is not None:
+                m.histogram("ingest.pass_read_s").observe(
+                    float(f.get("read_s", 0.0)))
+        elif ev.kind == "ingest_worker_dead":
+            self._ingest_workers_died += 1
+            if m is not None:
+                m.counter("ingest.workers_died").inc()
         elif ev.kind == "retry":
             self._retries += 1
             self._chunks_skipped += int(f.get("skipped", 0))
@@ -534,6 +574,19 @@ class FitTracer:
                 "read_s": self._read_s,
                 "retries": self._retries,
                 "chunks_skipped": self._chunks_skipped,
+                # process-parallel ingest census (data/ingest.py): chunk
+                # reads measured INSIDE the workers — read_s summed over
+                # workers can exceed the pass wall time, which is exactly
+                # the parallelism won; None when no sharded source ran
+                "ingest": ({
+                    "reads": self._ingest_reads,
+                    "rows": self._ingest_rows,
+                    "bytes": self._ingest_bytes,
+                    "read_s": self._ingest_read_s,
+                    "rereads": self._ingest_rereads,
+                    "workers": self._ingest_workers_max,
+                    "workers_died": self._ingest_workers_died,
+                } if self._ingest_reads else None),
                 "budget_exhausted": self._counts.get("budget_exhausted", 0),
                 "checkpoint_writes": self._checkpoint_writes,
                 "resumes": self._resumes,

@@ -291,6 +291,7 @@ class OnlineLoop:
                     deployed=deployed, rolled_back=rolled)
 
     def run(self, source, *, prefetch: int | None = None,
+            ingest_workers: int | None = None,
             max_chunks: int | None = None, fault_plan=None) -> dict:
         """Drive :meth:`step` over a chunk source — a zero-arg callable
         returning an iterator of ``(tenants, X, y[, weights[, offset]])``
@@ -301,8 +302,17 @@ class OnlineLoop:
         determinism contract there).  ``fault_plan`` (robust/faults.py)
         fires its ``kill_chunk_at`` schedule at each chunk boundary —
         the chaos test's process kill, exercised against the journal.
-        Returns :meth:`report`.
+        ``ingest_workers=N`` fans chunk production across N OS worker
+        processes when the source supports it (``data/ingest.py``
+        ``ShardedSource``; deterministic chunk order, so every decision
+        the loop makes is unchanged).  Returns :meth:`report`.
         """
+        if ingest_workers is not None:
+            if not hasattr(source, "with_workers"):
+                raise ValueError(
+                    "ingest_workers= needs an index-addressable source "
+                    "(data/ingest.ShardedSource); got a plain callable")
+            source = source.with_workers(int(ingest_workers))
         it = (source() if prefetch is None else
               prefetch_iter(source, prefetch, auto_degrade=False))
         with _obs_trace.ambient(self.tracer):

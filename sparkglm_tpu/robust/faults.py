@@ -98,6 +98,15 @@ class FaultPlan:
     real, unhandleable death for exercising the write-ahead journal's
     crash/resume path.  Only call it from an expendable subprocess.
 
+    ``ingest_worker_dead_at`` is the INGEST-WORKER kill schedule:
+    ``(worker, k)`` pairs meaning "ingest worker ``worker`` dies
+    (``os._exit``, no cleanup — a SIGKILL/OOM stand-in) just before its
+    ``k``-th assigned read".  :meth:`on_ingest_read` runs INSIDE the
+    forked reader process (``data/ingest.py``), so the death is a real
+    process death: the consumer's queue starves, its liveness check
+    fires, and the re-read recovery path runs exactly as it would in
+    production.  Safe by construction — only the expendable worker dies.
+
     ENGINE-TIER kinds are addressed by ``(engine, submit)`` — one submit
     ordinal PER ENGINE, mirroring the per-replica dispatch ordinals one
     level up (serve/pool.py's multi-engine tier):
@@ -126,6 +135,7 @@ class FaultPlan:
     kill_chunk_at: Sequence[int] = ()
     engine_error_at: Sequence[tuple] = ()
     engine_dead_from: Sequence[tuple] = ()
+    ingest_worker_dead_at: Sequence[tuple] = ()
 
     def __post_init__(self):
         self._touch = 0
@@ -151,6 +161,8 @@ class FaultPlan:
             e, k = int(e), int(k)
             self._eng_dead_from[e] = min(k, self._eng_dead_from.get(e, k))
         self._eng_submits = {}
+        self._ingest_dead_pairs = {tuple(int(v) for v in wc)
+                                   for wc in self.ingest_worker_dead_at}
         self._lock = threading.Lock()
         self._rng = np.random.default_rng(self.seed)
         self.faults_fired = 0
@@ -241,6 +253,16 @@ class FaultPlan:
         durability is all that survives.  Subprocess use only."""
         if int(chunk_idx) in set(int(c) for c in self.kill_chunk_at):
             os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_ingest_read(self, worker: int, k: int) -> None:
+        """Die hard if ingest worker ``worker``'s ``k``-th read is
+        scheduled.  ``os._exit`` — no exception, no finally blocks, no
+        queue flush: the consumer must detect the death from the outside,
+        like a real OOM-killed parse worker.  Runs in the forked worker
+        (the plan object is a fork-time copy; no once-firing bookkeeping
+        is needed because the process does not survive to re-fire)."""
+        if (int(worker), int(k)) in self._ingest_dead_pairs:
+            os._exit(17)
 
     def on_chunk_touch(self, pass_idx: int, chunk_idx: int) -> None:
         """Fire a scheduled worker kill at ``(pass_idx, chunk_idx)`` — once."""

@@ -74,6 +74,11 @@ def pytest_configure(config):
         "markers", "tenancy: elastic tenancy under fire — zero-downtime "
         "family growth, sharded online learning, the multi-engine pool "
         "(`make elastic_tenancy` selects these; still tier-1 by default)")
+    config.addinivalue_line(
+        "markers", "ingest: the process-parallel sharded ingest plane — "
+        "worker-count bit-identity, column pruning, sharded-source "
+        "resume, reader-death re-reads (`make ingest` selects these; "
+        "still tier-1 by default)")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,298 @@
+"""Process-parallel sharded ingest (sparkglm_tpu/data/ingest.py + the
+multi-file ``_stream_io`` front-ends): the data plane's contract is that
+parallelism is INVISIBLE in the results — coefficients, std errors and
+deviance are bit-identical at any ``ingest_workers`` count because chunks
+reassemble in deterministic plan order and f64 accumulation order never
+changes.  Also pinned here: column pruning to design-referenced variables
+(a 200-column file with a 5-column formula reads 6 columns), resume
+fingerprinting on process-parallel sources, and the worker-death re-read
+path (a killed reader costs one typed retry, not the fit)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.data.ingest import ShardedSource
+from sparkglm_tpu.data.model_matrix import wants_structured
+from sparkglm_tpu.obs import FitTracer
+from sparkglm_tpu.robust import FaultPlan, RetryPolicy, SimulatedPreemption
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+pytestmark = pytest.mark.ingest
+
+NOSLEEP = RetryPolicy(sleep=lambda s: None)
+
+
+def _write_parquet(path, cols, row_group_size=500):
+    table = pa.table({k: list(v) for k, v in cols.items()})
+    pq.write_table(table, str(path), row_group_size=row_group_size)
+
+
+def _coef_identical(a, b):
+    np.testing.assert_array_equal(np.asarray(a.coefficients),
+                                  np.asarray(b.coefficients))
+    np.testing.assert_array_equal(np.asarray(a.std_errors),
+                                  np.asarray(b.std_errors))
+
+
+# ---------------------------------------------------------------------------
+# ShardedSource unit contracts
+
+
+def test_sharded_source_contract(rng):
+    """Plan order, subset/with_workers derivation, and the two iteration
+    modes: workers=0 yields lazy thunks, workers>=1 yields materialized
+    chunks — both in identical global order."""
+    def read(i):
+        return (np.full(3, float(i)),)
+
+    src = ShardedSource(5, read, label="t")
+    assert len(src) == 5 and not src.process_parallel
+    out = list(src())
+    assert all(callable(t) for t in out)  # sequential tier stays lazy
+    assert [t()[0][0] for t in out] == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+    sub = src.subset([4, 1])
+    assert len(sub) == 2
+    assert [t()[0][0] for t in sub()] == [4.0, 1.0]
+
+    src2 = src.with_workers(2)
+    assert src2 is not src and src2.process_parallel and len(src2) == 5
+    items = list(src2())
+    assert all(not callable(it) for it in items)  # materialized
+    assert [it[0][0] for it in items] == [0.0, 1.0, 2.0, 3.0, 4.0]
+    st = src2.last_stats
+    assert st["workers"] == 2 and st["reads"] == 5
+    assert st["workers_died"] == 0 and st["inline_rereads"] == 0
+    assert st["rows"] == 15 and st["wall_s"] > 0.0
+
+
+def test_ingest_workers_needs_sharded_source(rng):
+    """A plain generator source cannot re-shard: the override is a typed
+    error, not a silent sequential fallback."""
+    X = rng.normal(size=(64, 3))
+    y = rng.normal(size=64)
+
+    def gen():
+        yield (X, y, None, None)
+
+    with pytest.raises(ValueError, match="ShardedSource"):
+        sg.lm_fit_streaming(gen, ingest_workers=2)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity across worker counts, single- and multi-file
+
+
+@pytest.fixture()
+def pq_files(tmp_path, rng):
+    """Four parquet files of one schema — the multi-file ingest plan."""
+    paths, frames = [], []
+    for j in range(4):
+        n = 700 + 100 * j
+        x = np.round(rng.normal(size=n), 6)
+        g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+        lam = np.exp(0.4 + 0.5 * x - 0.3 * (g == "b"))
+        y = rng.poisson(lam).astype(float)
+        cols = {"y": y, "x": x, "g": g}
+        p = tmp_path / f"part{j}.parquet"
+        _write_parquet(p, cols, row_group_size=256)
+        paths.append(str(p))
+        frames.append(cols)
+    pooled = {c: np.concatenate([f[c] for f in frames]) for c in frames[0]}
+    return paths, pooled
+
+
+def test_bit_identity_workers_0_1_4_multi_file(pq_files):
+    """The acceptance contract: ingest_workers ∈ {0, 1, 4} over a 4-file
+    parquet plan produce byte-identical fits (reassembly is deterministic
+    global chunk order; f64 accumulation order never changes)."""
+    paths, pooled = pq_files
+    kw = dict(family="poisson", chunk_bytes=1 << 14, retry=NOSLEEP)
+    m0 = sg.glm_from_parquet("y ~ x + g", paths, ingest_workers=0, **kw)
+    m1 = sg.glm_from_parquet("y ~ x + g", paths, ingest_workers=1, **kw)
+    m4 = sg.glm_from_parquet("y ~ x + g", paths, ingest_workers=4, **kw)
+    _coef_identical(m0, m1)
+    _coef_identical(m0, m4)
+    assert m0.deviance == m1.deviance == m4.deviance
+    assert m0.iterations == m1.iterations == m4.iterations
+    # sanity against the resident oracle (different accumulation path, so
+    # close, not bit-equal)
+    mr = sg.glm("y ~ x + g", data=pooled, family="poisson")
+    np.testing.assert_allclose(m0.coefficients, mr.coefficients,
+                               rtol=0, atol=1e-6)
+
+
+def test_multi_file_csv_union_levels(tmp_path, rng):
+    """Per-file level scans merge union-sorted: a factor level present in
+    only ONE file still codes consistently everywhere, and the multi-file
+    fit matches the resident fit on the concatenation."""
+    def mk(path, glevels, n=600):
+        x = np.round(rng.normal(size=n), 6)
+        g = np.array(glevels)[rng.integers(0, len(glevels), n)]
+        y = np.round(1.0 + 0.5 * x + 0.7 * (g == "b") + 0.1
+                     * rng.normal(size=n), 6)
+        path.write_text("y,x,g\n" + "\n".join(
+            f"{yi:.10g},{xi:.10g},{gi}" for yi, xi, gi in zip(y, x, g))
+            + "\n")
+        return {"y": y, "x": x, "g": g}
+
+    fa = mk(tmp_path / "a.csv", ["a", "b"])
+    fb = mk(tmp_path / "b.csv", ["b", "c"])  # "c" exists only here
+    paths = [str(tmp_path / "a.csv"), str(tmp_path / "b.csv")]
+    pooled = {c: np.concatenate([fa[c], fb[c]]) for c in fa}
+
+    m0 = sg.lm_from_csv("y ~ x + g", paths, chunk_bytes=8_000)
+    m2 = sg.lm_from_csv("y ~ x + g", paths, chunk_bytes=8_000,
+                        ingest_workers=2)
+    _coef_identical(m0, m2)
+    assert m0.xnames == ("intercept", "x", "g_b", "g_c")
+    mr = sg.lm("y ~ x + g", data=pooled)
+    np.testing.assert_allclose(m0.coefficients, mr.coefficients,
+                               rtol=0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# resume fingerprinting on process-parallel sources
+
+
+def test_resume_sharded_structured_prefetch(tmp_path, rng):
+    """The r18 regression: ingest_workers=4 × prefetch=2 × a structured
+    (wide-factor) design, preempted mid-fit and resumed.  The resume
+    fingerprint probes the source INLINE (workers=0 subset of chunk 0) —
+    no reader fleet spawns just to validate a checkpoint — and the
+    resumed fit is bit-identical to the unbroken one."""
+    n = 4000
+    x = np.round(rng.normal(size=n), 6)
+    g = np.array([f"s{k:02d}" for k in range(40)])[rng.integers(0, 40, n)]
+    eta = 0.3 + 0.8 * x
+    y = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(float)
+    p = tmp_path / "wide.parquet"
+    _write_parquet(p, {"y": y, "x": x, "g": g}, row_group_size=500)
+
+    kw = dict(family="binomial", tol=1e-10, chunk_bytes=1 << 14,
+              ingest_workers=4, prefetch=2, retry=NOSLEEP)
+    full = sg.glm_from_parquet("y ~ x + g", str(p), **kw)
+    assert wants_structured(full.terms)  # 40 levels → structured design
+
+    ckpt = tmp_path / "fit.ckpt"
+
+    def preempt(it, beta, dev):
+        if it >= 2:
+            raise SimulatedPreemption("killed")
+
+    with pytest.raises(SimulatedPreemption):
+        sg.glm_from_parquet("y ~ x + g", str(p), checkpoint=ckpt,
+                            on_iteration=preempt, **kw)
+    m = sg.glm_from_parquet("y ~ x + g", str(p), checkpoint=ckpt,
+                            resume=True, **kw)
+    _coef_identical(m, full)
+    assert m.deviance == full.deviance
+    assert m.iterations == full.iterations
+
+
+# ---------------------------------------------------------------------------
+# column pruning
+
+
+def test_column_pruning_200_col_parquet(tmp_path, rng):
+    """A 200-column file with a 5-predictor formula reads exactly the 6
+    referenced columns — every read, including the chunk-0 schema probe —
+    and the pruned fit is bit-identical across worker counts."""
+    from sparkglm_tpu.data import parquet as pq_io
+
+    n = 2000
+    cols = {"y": rng.poisson(2.0, n).astype(float)}
+    for j in range(199):
+        cols[f"c{j}"] = np.round(rng.normal(size=n), 6)
+    p = tmp_path / "wide200.parquet"
+    _write_parquet(p, cols, row_group_size=500)
+
+    formula = "y ~ c0 + c1 + c2 + c3 + c4"
+    used = {"y", "c0", "c1", "c2", "c3", "c4"}
+
+    seen = []
+    orig = pq_io.read_parquet
+
+    def spy(path, **kw):
+        seen.append(kw.get("columns"))
+        return orig(path, **kw)
+
+    pq_io.read_parquet = spy
+    try:
+        m0 = sg.lm_from_parquet(formula, str(p), chunk_bytes=1 << 14)
+    finally:
+        pq_io.read_parquet = orig
+    assert seen, "no reads recorded"
+    for c in seen:
+        assert c is not None and set(c) == used, \
+            f"unpruned read: {None if c is None else sorted(c)[:8]}"
+
+    # the parallel tier re-parses the same pruned plan in workers — same
+    # bytes, same answer (children are forked, so the spy cannot observe
+    # them; bit-identity is the cross-tier proof)
+    m4 = sg.lm_from_parquet(formula, str(p), chunk_bytes=1 << 14,
+                            ingest_workers=4)
+    _coef_identical(m0, m4)
+
+
+# ---------------------------------------------------------------------------
+# worker death mid-pass
+
+
+def test_ingest_worker_death_reread(rng):
+    """Kill one reader process mid-pass (os._exit inside the fork — a real
+    OOM stand-in): the consumer detects the starved queue, spends one
+    typed retry, re-reads the lost shard's chunks in-order inline, and the
+    fit is bit-identical to the undisturbed one."""
+    n, p = 3000, 4
+    X = rng.normal(size=(n, p))
+    X[:, 0] = 1.0
+    y = X @ (rng.normal(size=p) / 2) + 0.1 * rng.normal(size=n)
+    rows = 500
+    n_chunks = n // rows
+
+    def read(i):
+        lo = i * rows
+        return (X[lo:lo + rows], y[lo:lo + rows], None, None)
+
+    base = sg.lm_fit_streaming(ShardedSource(n_chunks, read, label="kill"))
+
+    # worker 0 dies just before its 2nd assigned read (global seq 2)
+    plan = FaultPlan(ingest_worker_dead_at=((0, 1),))
+    src = ShardedSource(n_chunks, read, workers=2, label="kill",
+                        fault_plan=plan, retry=NOSLEEP)
+    tr = FitTracer([])
+    m = sg.lm_fit_streaming(src, trace=tr)
+    _coef_identical(m, base)
+
+    st = src.last_stats
+    assert st["workers_died"] >= 1
+    assert st["inline_rereads"] >= 1
+    assert st["reads"] == n_chunks  # every chunk delivered exactly once
+    rep = tr.report()["ingest"]
+    assert rep["workers_died"] >= 1 and rep["rereads"] >= 1
+    # the tracer accumulates across the fit's passes (LM makes more than
+    # one); each pass delivers the full plan exactly once
+    assert rep["reads"] % n_chunks == 0 and rep["reads"] >= n_chunks
+
+
+def test_ingest_worker_death_budget_exhaustion(rng):
+    """Worker deaths are TYPED transients: a retry budget of zero turns
+    the death into the policy's escalation, not a hang or a wrong
+    answer."""
+    from sparkglm_tpu.robust import RetryBudgetExhausted
+
+    def read(i):
+        return (np.full((8, 2), float(i)), np.zeros(8), None, None)
+
+    plan = FaultPlan(ingest_worker_dead_at=((0, 0),))
+    src = ShardedSource(4, read, workers=2, label="kill0",
+                        fault_plan=plan,
+                        retry=RetryPolicy(budget=0, sleep=lambda s: None))
+    with pytest.raises(RetryBudgetExhausted):
+        list(src())

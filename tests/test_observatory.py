@@ -364,11 +364,11 @@ def test_bench_history_reports_ok_flips_as_warnings_only():
     assert report["regressions"] == [] and report["ok"]
 
 
-def test_blocks_registry_matches_r16_detail():
-    with open(os.path.join(REPO, "benchmarks", "BENCH_r16.json")) as f:
+def test_blocks_registry_matches_r18_detail():
+    with open(os.path.join(REPO, "benchmarks", "BENCH_r18.json")) as f:
         detail = json.load(f)
     for name, spec in BLOCKS.items():
-        if name == "capacity_observatory" or spec["metric"] is None:
+        if spec["metric"] is None:
             continue
         assert name in detail, name
         assert spec["metric"] in detail[name], (name, spec["metric"])
