@@ -9,7 +9,7 @@ SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve serve_async \
         categorical penalized elastic sketch fleet hotloop online \
-        obsplane chaos elastic_tenancy observatory ingest clean
+        obsplane chaos elastic_tenancy observatory ingest robustreg clean
 
 all: native
 
@@ -162,6 +162,15 @@ observatory:
 ingest:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_ingest.py \
 		tests/test_pipeline.py -q
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# robust & private fitting (sparkglm_tpu/robustreg): quantile/Huber/l1/linf
+# pseudo-families through IRLS, the batched tau path, DP Gramians with the
+# zCDP accountant, privacy=None bit-identity, fleet/online composition —
+# plus the quantile_tau_path + dp_overhead bench blocks.  DISTINCT from
+# `robust` above (the fault-tolerance suite).
+robustreg:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_robustreg.py -q
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 clean:

@@ -17,7 +17,7 @@ Quick start::
 from .api import (TermsPrediction, confint_profile, glm, glm_fleet,
                   glm_from_csv, glm_from_json, glm_from_parquet, glm_nb, lm,
                   lm_from_csv, lm_from_json, lm_from_parquet, online_fleet,
-                  predict, update)
+                  predict, quantreg, update)
 from .fleet import FleetModel, fit_many, glm_fit_fleet
 from .data.json import read_json, scan_json_levels, scan_json_schema
 from .data.parquet import (read_parquet, scan_parquet_levels,
@@ -56,11 +56,13 @@ from .obs import (FitTracer, FlightRecorder, JsonlSink, MetricsRegistry,
                   RingBufferSink, SLOMonitor, SLOSpec, Telemetry,
                   prometheus_text)
 from .online import DriftGate, OnlineLoop, OnlineSuffStats
+from .robustreg import (DPSpec, Smoothing, TauPath, ZCDPAccountant,
+                        quantile_tau_path)
 from .serve import (AsyncEngine, BatchPolicy, EnginePolicy, FamilyScorer,
                     MicroBatcher, ModelFamily, ModelRegistry,
                     ReplicatedScorer, Scorer)
 from .utils import profiling
-from . import elastic, fleet, obs, online, robust, serve
+from . import elastic, fleet, obs, online, robust, robustreg, serve
 
 __version__ = "0.1.0"
 
@@ -99,4 +101,6 @@ __all__ = [
     "fleet", "fit_many", "glm_fit_fleet", "glm_fleet", "FleetModel",
     "ModelFamily", "FamilyScorer",
     "online", "online_fleet", "OnlineLoop", "OnlineSuffStats", "DriftGate",
+    "robustreg", "quantreg", "quantile_tau_path", "TauPath",
+    "Smoothing", "DPSpec", "ZCDPAccountant",
 ]

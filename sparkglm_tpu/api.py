@@ -162,12 +162,15 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=(),
 
 def _reject_penalty_args(*, mesh=None, engine="auto", beta0=None,
                          on_iteration=None, checkpoint_every=0,
-                         checkpoint=None, resume=False, prefetch=0):
+                         prefetch=0):
     """Penalized paths run their own compiled solvers; the options that
     parameterize the unpenalized IRLS/solve machinery have no meaning
     there.  Refuse them loudly rather than silently ignoring them.
     (``retry=`` is NOT rejected: the penalized streaming drivers honor it
-    on every chunk pass.)"""
+    on every chunk pass.  ``checkpoint=``/``resume=`` are NOT rejected
+    either: the drivers checkpoint at lambda-path boundaries — after each
+    grid point for GLM paths, after the single Gramian data pass for
+    gaussian paths — and resume bit-identically; see penalized/stream.py.)"""
     if mesh is not None:
         raise ValueError("penalty= does not support mesh= (sharded "
                          "penalized fits are not implemented yet)")
@@ -184,12 +187,6 @@ def _reject_penalty_args(*, mesh=None, engine="auto", beta0=None,
     if beta0 is not None or on_iteration is not None or checkpoint_every:
         raise ValueError("penalty= does not support beta0=/on_iteration=/"
                          "checkpoint_every= (the path warm-starts itself)")
-    if checkpoint is not None or resume:
-        raise ValueError(
-            "penalty= does not support checkpoint=/resume=: lambda-path "
-            "state has no checkpoint format yet, so an interrupted path "
-            "re-runs from scratch — drop checkpoint=/resume= (retry= IS "
-            "supported and re-reads failed chunks in place)")
     if prefetch:
         raise ValueError("penalty= does not support prefetch= yet (path "
                          "passes stream sequentially)")
@@ -418,6 +415,7 @@ def glm(formula: str, data, *, family="binomial", link=None, weights=None,
 
 
 def glm_fleet(formula: str, data, *, groups, family="binomial", link=None,
+              tau=None, smoothing=None,
               weights=None, offset=None, tol: float = 1e-8,
               max_iter: int = 100, criterion: str = "relative",
               na_omit: bool = True, batch: str = "exact",
@@ -452,10 +450,33 @@ def glm_fleet(formula: str, data, *, groups, family="binomial", link=None,
     scale-out options (``engine='sketch'/'elastic'``, ``penalty=``,
     ``design='structured'``, ``mesh=``, ``beta0=``/checkpoint hooks) do
     not apply and are rejected loudly.
+
+    ``family="quantile", tau=0.99`` fits one conditional-quantile model
+    per tenant in the same batched kernel call — the per-tenant p99
+    pattern (robustreg/; ``smoothing=`` overrides the epsilon schedule).
+    Any robust pseudo-family spec (``"quantile(0.9)"``, ``"huber"``,
+    ``"l1"``) also works directly as ``family=``.
     """
     _reject_fleet_args(engine=engine, penalty=penalty, design=design,
                        mesh=mesh, beta0=beta0, on_iteration=on_iteration,
                        checkpoint_every=checkpoint_every)
+    if tau is not None or smoothing is not None:
+        if not (isinstance(family, str)
+                and family.split("(")[0] in ("quantile", "huber",
+                                             "l1", "linf")):
+            raise ValueError(
+                "tau=/smoothing= parameterize a robust pseudo-family; "
+                f"pass family='quantile' (or 'huber'/'l1'/'linf'), got "
+                f"family={family!r}")
+        from .robustreg.pseudo import quantile_family, robust_family
+        if tau is not None:
+            if family != "quantile":
+                raise ValueError(
+                    "tau= only applies to family='quantile' (unparenthesized"
+                    " — tau is given once, not twice)")
+            family = quantile_family(float(tau), smoothing=smoothing)
+        else:
+            family = robust_family(family, smoothing=smoothing)
     if _all_paths(data):
         data = _ingest_table(formula, data,
                              extra_names=(groups, weights, offset),
@@ -888,6 +909,49 @@ def _csv_stream_design(formula, path, *, named_cols, na_omit, dtype,
     return f, terms, num_chunks, extract
 
 
+def quantreg(formula: str, data, *, tau=0.5, weights=None, offset=None,
+             smoothing=None, tol: float = 1e-8, max_iter: int = 100,
+             criterion: str = "relative", na_omit: bool = True,
+             mesh=None, singular: str = "drop", verbose: bool = False,
+             trace=None, metrics=None, config: NumericConfig = DEFAULT):
+    """Quantile regression by formula — ``quantreg::rq``'s role, run as
+    eps-smoothed IRLS (``robustreg/pseudo.py``; arXiv 1902.06391 style).
+
+    A SCALAR ``tau`` fits one model through :func:`glm` with the
+    ``quantile(tau)`` pseudo-family and returns a ``GLMModel`` (identity
+    link; ``deviance`` is the exact check loss ``2 sum wt rho_tau(r)``;
+    pseudo-SEs — see PARITY.md "Robust pseudo-families").  A SEQUENCE of
+    taus fits the whole path on ONE shared design via the batched
+    simultaneous-tau kernel (``robustreg/taupath.py``) and returns a
+    :class:`~sparkglm_tpu.robustreg.TauPath` — every tau advances through
+    the same per-pass data sweep, which is where the >=3x win over
+    independent cold fits comes from (benchmarks: ``quantile_tau_path``).
+
+    ``smoothing=Smoothing(eps0, factor, eps_min)`` overrides the
+    eps-schedule; coefficients of the smoothed optimum differ from the
+    exact (non-smooth) quantile solution by O(eps_min) in well-separated
+    designs (documented tolerance in PARITY.md)."""
+    from .robustreg.pseudo import quantile_family
+    from .robustreg.taupath import quantile_tau_path
+    if np.ndim(tau) == 0:
+        fam = quantile_family(float(tau), smoothing)
+        return glm(formula, data, family=fam, weights=weights,
+                   offset=offset, tol=tol, max_iter=max_iter,
+                   criterion=criterion, na_omit=na_omit, mesh=mesh,
+                   singular=singular, verbose=verbose, trace=trace,
+                   metrics=metrics, config=config)
+    if mesh is not None or singular != "drop":
+        raise ValueError(
+            "the tau-path driver supports mesh=None and singular='drop' "
+            "only (one shared dense design, batched solve); fit taus "
+            "one at a time for other settings")
+    return quantile_tau_path(
+        formula, data, tau, weights=weights, offset=offset,
+        smoothing=smoothing, tol=tol, max_iter=max_iter,
+        criterion=criterion, na_omit=na_omit, trace=trace,
+        metrics=metrics, verbose=verbose, config=config)
+
+
 def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
                  weights=None, offset=None, tol: float = 1e-8,
                  max_iter: int = 100, criterion: str = "relative",
@@ -896,7 +960,8 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
                  verbose: bool = False,
                  beta0=None, on_iteration=None, native: bool | None = None,
                  backend: str = "auto", retry=None, checkpoint=None,
-                 resume=False, penalty=None, trace=None, metrics=None,
+                 resume=False, penalty=None, privacy=None, trace=None,
+                 metrics=None,
                  prefetch: int = 0, engine: str = "auto",
                  workers: int | None = None, ingest_workers: int = 0,
                  config: NumericConfig = DEFAULT) -> glm_mod.GLMModel:
@@ -980,6 +1045,12 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
         raise ValueError(
             f"glm_from_csv supports engine='auto', 'elastic' or 'sketch', "
             f"got {engine!r}")
+    if privacy is not None and (engine != "auto" or workers is not None
+                                or penalty is not None):
+        raise ValueError(
+            "privacy= runs on the exact single-controller streaming "
+            "driver only (chunks are the clipping boundary); drop "
+            "engine=/workers=/penalty=")
     if engine == "elastic" or workers is not None:
         _reject_elastic_args(penalty=penalty, beta0=beta0,
                              on_iteration=on_iteration, resume=resume,
@@ -1005,7 +1076,6 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
     if penalty is not None:
         _reject_penalty_args(mesh=mesh, engine=engine, beta0=beta0,
                              on_iteration=on_iteration,
-                             checkpoint=checkpoint, resume=resume,
                              prefetch=prefetch)
         from .penalized import stream as _pen_stream
         import dataclasses
@@ -1014,6 +1084,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
                 source, family=family, link=link, penalty=penalty,
                 xnames=terms.xnames, yname=yname,
                 has_intercept=f.intercept, verbose=verbose, retry=retry,
+                checkpoint=checkpoint, resume=resume,
                 trace=trace, metrics=metrics, config=config)
         finally:
             parse_cleanup()
@@ -1029,6 +1100,7 @@ def glm_from_csv(formula: str, path: str, *, family="binomial", link=None,
             verbose=verbose, beta0=beta0, on_iteration=on_iteration,
             retry=retry, checkpoint=checkpoint, resume=resume,
             engine=("sketch" if engine == "sketch" else "auto"),
+            privacy=privacy,
             trace=trace, metrics=metrics, prefetch=prefetch, config=config)
     finally:
         parse_cleanup()
@@ -1043,7 +1115,8 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
                 na_omit: bool = True, chunk_bytes: int = 256 << 20,
                 mesh=None, native: bool | None = None, parse_cache="auto",
                 backend: str = "auto", retry=None, checkpoint=None,
-                resume=False, penalty=None, trace=None, metrics=None,
+                resume=False, penalty=None, privacy=None, trace=None,
+                metrics=None,
                 prefetch: int = 0, engine: str = "auto",
                 workers: int | None = None, ingest_workers: int = 0,
                 config: NumericConfig = DEFAULT) -> lm_mod.LMModel:
@@ -1099,6 +1172,12 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
         raise ValueError(
             f"lm_from_csv supports engine='auto' or engine='elastic', "
             f"got {engine!r}")
+    if privacy is not None and (engine != "auto" or workers is not None
+                                or penalty is not None):
+        raise ValueError(
+            "privacy= runs on the exact single-controller streaming "
+            "driver only (chunks are the clipping boundary); drop "
+            "engine=/workers=/penalty=")
     if engine == "elastic" or workers is not None:
         _reject_elastic_args(penalty=penalty, resume=resume)
         from .elastic import lm_fit_elastic
@@ -1117,14 +1196,14 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
             offset_col=_offset_col_value(f, offset),
             has_weights=weights is not None)
     if penalty is not None:
-        _reject_penalty_args(mesh=mesh, checkpoint=checkpoint,
-                             resume=resume, prefetch=prefetch)
+        _reject_penalty_args(mesh=mesh, prefetch=prefetch)
         from .penalized import stream as _pen_stream
         import dataclasses
         try:
             pm = _pen_stream.lm_path_streaming(
                 source, penalty=penalty, xnames=terms.xnames,
                 yname=f.response, has_intercept=f.intercept, retry=retry,
+                checkpoint=checkpoint, resume=resume,
                 trace=trace, metrics=metrics, config=config)
         finally:
             parse_cleanup()
@@ -1136,8 +1215,8 @@ def lm_from_csv(formula: str, path: str, *, weights=None, offset=None,
         model = streaming.lm_fit_streaming(
             source, xnames=terms.xnames, yname=f.response,
             has_intercept=f.intercept, mesh=mesh, retry=retry,
-            checkpoint=checkpoint, resume=resume, trace=trace,
-            metrics=metrics, prefetch=prefetch, config=config)
+            checkpoint=checkpoint, resume=resume, privacy=privacy,
+            trace=trace, metrics=metrics, prefetch=prefetch, config=config)
     finally:
         parse_cleanup()
     import dataclasses

@@ -68,8 +68,18 @@ class Family:
     # numeric family parameter (NB theta): the device callables then take
     # it as their LAST argument, and it flows through the IRLS kernels as
     # a TRACED operand — so glm.nb's theta search reuses ONE compiled
-    # kernel across every theta value instead of retracing per round
-    param: float | None = None
+    # kernel across every theta value instead of retracing per round.
+    # robustreg pseudo-families carry a LENGTH-2 param (shape, eps): the
+    # smoothing eps shrinks across host passes without recompiling.
+    param: object | None = None
+    # robust(y, mu, wt, param) -> per-row multiplicative weight on W (the
+    # reweighting rule that turns gaussian IRLS into quantile/Huber/l1
+    # pseudo-likelihood fitting, arXiv 1902.06391).  ``wt`` is the prior
+    # weight vector — the linf rule needs it to mask padding rows out of
+    # its row-GLOBAL softmax.  None for every genuine exponential family —
+    # ops/fused.py::irls_weights applies it only when present, so existing
+    # jaxprs are untouched.
+    robust: Callable | None = None
 
     def __post_init__(self):
         if self.aic is None:
@@ -84,7 +94,7 @@ class Family:
     # (e.g. every negative_binomial(theta)) hash equal.
     def _static_key(self):
         return (self.variance, self.dev_resids, self.init_mu,
-                self.dispersion_fixed, self.param is None)
+                self.dispersion_fixed, self.param is None, self.robust)
 
     def __hash__(self):
         return hash(self._static_key())
@@ -118,7 +128,9 @@ class Family:
         return _types.SimpleNamespace(
             variance=lambda mu: self.variance(mu, param),
             dev_resids=lambda y, mu, wt: self.dev_resids(y, mu, wt, param),
-            init_mu=lambda y, wt: self.init_mu(y, wt, param))
+            init_mu=lambda y, wt: self.init_mu(y, wt, param),
+            robust=(None if self.robust is None
+                    else lambda y, mu, wt: self.robust(y, mu, wt, param)))
 
 
 # ----------------------------------------------------------------------------
@@ -355,12 +367,18 @@ def get_family(family: str | Family) -> Family:
     th = nb_theta(name)
     if th is not None:
         return negative_binomial(th)
+    if name.split("(")[0] in ("quantile", "huber", "l1", "linf"):
+        # robust pseudo-families (sparkglm_tpu/robustreg) — lazy import to
+        # keep families free of a robustreg dependency cycle
+        from ..robustreg.pseudo import robust_family
+        return robust_family(name)
     try:
         return FAMILIES[name]
     except KeyError:
         raise ValueError(
             f"unknown family {family!r}; available: "
-            f"{sorted(FAMILIES) + ['quasi(<variance>)']}") from None
+            f"{sorted(FAMILIES) + ['quasi(<variance>)']}, robust: "
+            "'quantile(<tau>)', 'huber[(k)]', 'l1', 'linf'") from None
 
 
 def resolve(family: str | Family, link: str | Link | None) -> tuple[Family, Link]:

@@ -115,10 +115,29 @@ def _irls_core(
     # parametric families (NB theta): the param is a TRACED operand — the
     # static key excludes its value, so e.g. glm.nb's theta search shares
     # one compiled kernel (families/families.py::Family.with_param)
-    family = family.with_param(fam_param)
+    fam0 = family
+    it0 = jnp.zeros((), jnp.int32) if it_base is None else it_base
+    # robust pseudo-families (sparkglm_tpu/robustreg): the smoothing eps
+    # shrinks EACH IRLS PASS inside the compiled loop (arXiv 1902.06391's
+    # warm-started schedule, fused into one while_loop).  Param layout
+    # [shape, eps0, factor, eps_min] is entirely traced, so every
+    # (tau, schedule) value shares one executable; `robust` rides the
+    # static key, so genuine families keep their exact jaxpr.
+    robust_sched = getattr(fam0, "robust", None) is not None
 
-    def dev_of(mu):
-        return jnp.sum(_sanitize(family.dev_resids(y, mu, wt), valid))
+    def fam_at(it):
+        if not robust_sched:
+            return fam0.with_param(fam_param)
+        eps_t = jnp.maximum(
+            fam_param[1] * fam_param[2] ** jnp.asarray(it, fam_param.dtype),
+            fam_param[3])
+        return fam0.with_param(fam_param.at[1].set(eps_t))
+
+    family = fam_at(it0)
+
+    def dev_of(mu, fam_b=None):
+        fb = family if fam_b is None else fam_b
+        return jnp.sum(_sanitize(fb.dev_resids(y, mu, wt), valid))
 
     if warm:
         # NaN entries (aliased coefficients from a checkpointed drop-path
@@ -156,13 +175,23 @@ def _irls_core(
         XtWX0=jnp.zeros((p, p), acc),
     )
 
+    def eps_done(it):
+        # True once the robust smoothing schedule has reached eps_min at
+        # iteration index ``it`` — a fit must not declare convergence while
+        # the loss it is converging TO is still moving
+        return (fam_param[1] * fam_param[2] ** jnp.asarray(
+            it, fam_param.dtype)) <= fam_param[3]
+
     def not_converged(s):
         # callers pre-clamp the relative tol to the deviance dtype's
         # resolution (config.effective_tol)
         d = s["ddev"]
         if criterion == "relative":
             d = d / (jnp.abs(s["dev"]) + 0.1)
-        return (s["it"] < max_iter) & (d > tol) & ~s["singular"] & ~s["stalled"]
+        conv = d <= tol
+        if robust_sched:
+            conv = conv & eps_done(s["it"] - 1 + it0)
+        return (s["it"] < max_iter) & ~conv & ~s["singular"] & ~s["stalled"]
 
     def body(s):
         mu, eta = s["mu"], s["eta"]
@@ -170,7 +199,8 @@ def _irls_core(
         # ref: GLM.scala:359-395) — the fused twins and the streaming
         # structured pass evaluate the same expression, which is what
         # keeps every engine's f64 Gramian bit-identical
-        w, z = irls_weights(y, wt, offset, eta, mu, family=family,
+        fam_t = fam_at(s["it"] + it0) if robust_sched else family
+        w, z = irls_weights(y, wt, offset, eta, mu, family=fam_t,
                             link=link, valid=valid)
         if solver == "qr":
             # TSQR + corrected seminormal solve: error ~eps*kappa(X), for
@@ -196,7 +226,7 @@ def _irls_core(
         fac_d = jnp.where(singular, s["fac_d"], fac_d)
         eta_new = (design_matvec(X, beta) + offset).astype(X.dtype)  # ref: etaCreate :321-332
         mu_new = jnp.where(valid, link.inverse(eta_new), 1.0).astype(X.dtype)  # ref: muCreate :334-355
-        dev_new = dev_of(mu_new).astype(acc)
+        dev_new = dev_of(mu_new, fam_t).astype(acc)
 
         # step-halving recovery: walk beta back toward the previous iterate
         # while the step's deviance is non-finite or increasing (R glm.fit
@@ -209,6 +239,13 @@ def _irls_core(
         # would halve every fit toward beta=0 (glm2 gates the same way);
         # a warm start's dev0 is dev(beta0) and halving may engage at once
         halve_ok = jnp.asarray(True) if warm else s["it"] > 0
+        if robust_sched:
+            # while the smoothing eps is still shrinking, the deviance
+            # baseline moves between iterations (the linf softmax deviance
+            # RISES as eps decays), so the ascent guard engages only once
+            # the schedule bottomed out at eps_min for BOTH endpoints of
+            # the comparison (previous iteration's eps included)
+            halve_ok = halve_ok & eps_done(s["it"] - 1 + it0)
 
         def h_cond(h):
             return (_dev_bad(h["dev"], s["dev"]) & halve_ok
@@ -219,7 +256,7 @@ def _irls_core(
             e = (design_matvec(X, b) + offset).astype(X.dtype)
             m = jnp.where(valid, link.inverse(e), 1.0).astype(X.dtype)
             return dict(k=h["k"] + 1, beta=b, eta=e, mu=m,
-                        dev=dev_of(m).astype(acc))
+                        dev=dev_of(m, fam_t).astype(acc))
 
         h = jax.lax.while_loop(h_cond, h_body, dict(
             k=jnp.zeros((), jnp.int32), beta=beta.astype(X.dtype),
@@ -268,6 +305,8 @@ def _irls_core(
         cov_final = inv_from_parts(s["fac_a"], s["fac_d"], p, acc)
     d_final = s["ddev"] / (jnp.abs(s["dev"]) + 0.1) if criterion == "relative" else s["ddev"]
     converged = (d_final <= tol) & (s["it"] > 0) & ~s["singular"] & ~s["stalled"]
+    if robust_sched:
+        converged = converged & eps_done(s["it"] - 1 + it0)
 
     return dict(beta=s["beta"], cov_inv=cov_final, dev=s["dev"],
                 eta=s["eta"], iters=s["it"], converged=converged,
@@ -1564,8 +1603,11 @@ def _fit_dispatch(
         # One timed probe per (p-bucket, dtype, platform), cached
         # process-wide — ops/autotune.py holds the full r5 history.
         if (is_structured or is_sparse or shard_features
-                or mesh.shape[meshlib.MODEL_AXIS] != 1):
+                or mesh.shape[meshlib.MODEL_AXIS] != 1
+                or fam.robust is not None):
             # shapes with no fused form keep the einsum engine, no probe
+            # (robust pseudo-families included: the fused kernel threads a
+            # SCALAR fam_param, not the robust 4-vector schedule)
             engine = "einsum"
         else:
             autotune_rec = choose_engine(p, dtype,
@@ -1577,6 +1619,12 @@ def _fit_dispatch(
         raise ValueError(
             f"engine must be 'auto', 'einsum', 'fused', 'qr' or 'sketch', "
             f"got {engine!r}")
+    if fam.robust is not None and engine in ("fused", "sketch"):
+        raise ValueError(
+            f"engine={engine!r} does not support robust pseudo-families "
+            f"({fam.name!r}) — the fused kernel threads a scalar family "
+            "parameter and the sketch engine has no robust form; use "
+            "engine='einsum' (the auto default here) or 'qr'")
     if engine in ("fused", "qr", "sketch") and (
             shard_features or mesh.shape[meshlib.MODEL_AXIS] != 1):
         raise ValueError(
@@ -1877,7 +1925,10 @@ def _fit_dispatch(
         polish_active=polish_active, polish_cfg=config.polish,
         can_polish=not shard_features
         and mesh.shape[meshlib.MODEL_AXIS] == 1 and not is_structured
-        and not is_sparse and engine != "sketch")
+        and not is_sparse and engine != "sketch"
+        # the CSNE polish would re-solve at the eps0 weights, not the
+        # schedule's final eps_min — robust fits skip it
+        and fam.robust is None)
     if polish_active:
         # TSQR + corrected seminormal equations at the final weights
         # (ops/tsqr.py): error ~eps*kappa instead of ~eps*kappa^2 (measured
@@ -1906,7 +1957,7 @@ def _fit_dispatch(
     hs = hoststats.glm_stats(fam.name, lnk.name, y64, eta, wt64)
     dev = hs["dev"]
     hoststats.warn_separation(hs["n_boundary"])
-    if has_intercept and has_offset:
+    if has_intercept and has_offset and fam.robust is None:
         # R semantics: with an offset, the null model is an intercept-only
         # GLM honouring the offset — run the same kernel on a ones design.
         ones_d = meshlib.shard_rows(np.ones((int(yd.shape[0]), 1), dtype), mesh)

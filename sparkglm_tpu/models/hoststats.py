@@ -132,10 +132,49 @@ def _nb_theta(family: str) -> float | None:
     return nb_theta(family)
 
 
+def _robust_spec(family: str):
+    """(kind, shape) for a robustreg pseudo-family name, else None —
+    routed through the one parser in robustreg/pseudo.py."""
+    from ..robustreg.pseudo import robust_spec
+    return robust_spec(family)
+
+
+def _robust_dev_resids(spec, y, mu, wt) -> np.ndarray:
+    """Per-row contributions of the EXACT (eps-free) robust loss — the
+    deviance a robust fit reports, free of the smoothing the in-loop
+    convergence objective carries (PARITY.md documents the tolerance
+    between the two).  Convention: 2 * wt * rho(r); for linf the rows
+    tied at the max share the max itself (their sum IS max|r|)."""
+    kind, shape = spec
+    y = np.asarray(y, np.float64)
+    mu = np.asarray(mu, np.float64)
+    wt = np.asarray(wt, np.float64)
+    r = y - mu
+    a = np.abs(r)
+    if kind == "quantile":
+        q = np.where(r >= 0, shape, 1.0 - shape)
+        return 2.0 * wt * q * a
+    if kind == "huber":
+        rho = np.where(a <= shape, 0.5 * a * a, shape * a - 0.5 * shape ** 2)
+        return 2.0 * wt * rho
+    if kind == "l1":
+        return 2.0 * wt * a
+    # linf: the reported deviance is max|r| over weighted rows, spread
+    # across the argmax rows so _mask_sum recovers it exactly
+    valid = wt > 0
+    if not valid.any():
+        return np.zeros_like(y)
+    mx = float(np.max(a[valid]))
+    hits = valid & (a == mx)
+    return np.where(hits, mx / max(1, int(hits.sum())), 0.0)
+
+
 def variance(family: str, mu: np.ndarray) -> np.ndarray:
     th = _nb_theta(family)
     if th is not None:
         return mu + mu * mu / th
+    if _robust_spec(family) is not None:
+        return np.ones_like(mu)
     f = _base(family)
     if f == "gaussian":
         return np.ones_like(mu)
@@ -152,6 +191,9 @@ def variance(family: str, mu: np.ndarray) -> np.ndarray:
 
 def dev_resids(family: str, y, mu, wt) -> np.ndarray:
     """Per-row deviance contributions, R ``family()$dev.resids`` semantics."""
+    rspec = _robust_spec(family)
+    if rspec is not None:
+        return _robust_dev_resids(rspec, y, mu, wt)
     f = _base(family)
     y = np.asarray(y, np.float64)
     mu = np.asarray(mu, np.float64)
@@ -192,8 +234,9 @@ def ll_chunk_stat(family: str, y, mu, wt) -> float:
     Zero-weight rows are excluded (R drops them from the likelihood too).
     Quasi families define no likelihood (ll_finalize returns NaN) — skip
     the per-row work instead of computing a stat that gets discarded.
+    Robust pseudo-families likewise (their "likelihood" is a loss).
     """
-    if family.startswith("quasi"):
+    if family.startswith("quasi") or _robust_spec(family) is not None:
         return 0.0
     f = _base(family)
     y = np.asarray(y, np.float64)
@@ -232,8 +275,9 @@ def ll_finalize(family: str, stat: float, dev: float, wt_sum: float,
 
     Quasi families have no likelihood — R's ``logLik`` returns NA there
     (as does AIC); reporting the base family's number would claim a
-    likelihood the model does not define."""
-    if family.startswith("quasi"):
+    likelihood the model does not define.  Robust pseudo-families report
+    NaN for the same reason."""
+    if family.startswith("quasi") or _robust_spec(family) is not None:
         return float("nan")
     if _nb_theta(family) is not None:
         return float(stat)  # the NB chunk stat is the exact log-pmf sum
@@ -315,7 +359,11 @@ def glm_chunk_stats(family: str, link: str, y, eta, wt) -> dict:
 def null_dev_chunk(family: str, link: str, y, wt, offset,
                    mu_const: float | None = None) -> float:
     """One chunk's null-deviance contribution: constant ``mu_const`` (the
-    global weighted mean, intercept models) or mu = linkinv(offset)."""
+    global weighted mean, intercept models) or mu = linkinv(offset).
+    Robust pseudo-families report NaN (their null model would be an
+    intercept-only robust fit, a computation not a formula)."""
+    if _robust_spec(family) is not None:
+        return float("nan")
     y = np.asarray(y, np.float64)
     wt = np.asarray(wt, np.float64)
     valid = wt > 0
@@ -352,7 +400,10 @@ def null_deviance(family: str, link: str, y, wt, offset,
       * intercept + offset: caller fits an intercept-only GLM honouring the
         offset and passes its linear predictor as ``eta_null``
       * no intercept: mu = linkinv(offset) per row
+    Robust pseudo-families report NaN (see :func:`null_dev_chunk`).
     """
+    if _robust_spec(family) is not None:
+        return float("nan")
     y = np.asarray(y, np.float64)
     wt = np.asarray(wt, np.float64)
     valid = wt > 0

@@ -645,6 +645,44 @@ def _lm_chunk_pass(Xc, yc, wc):
 
 
 # ---------------------------------------------------------------------------
+# differentially private chunk passes (robustreg/privacy.py): same Gramian
+# triples, but every row is norm-clipped BEFORE accumulation so each pass's
+# release has bounded sensitivity.  Separate jitted functions — the plain
+# passes' jaxprs are untouched, keeping privacy=None fits bit-identical.
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("family", "link", "first"))
+def _glm_dp_chunk_pass(Xc, yc, wc, oc, beta, clip, *, family: Family,
+                       link: Link, first: bool, fam_param=None):
+    """DP twin of the exact GLM chunk pass: the frozen IRLS state (w, z)
+    at ``beta``, then per-row clipping of the augmented ``sqrt(w)[x, z]``
+    norm at ``clip`` before the Gramian — the chunk boundary IS the
+    clipping boundary.  The deviance slot is withheld (0.0): the exact
+    chunk deviance is a data-dependent statistic outside the released
+    (X'WX, X'Wz) pair, and DP fits never consume it (no early stop)."""
+    from ..robustreg.privacy import dp_clip_weights
+    family = family.with_param(fam_param)
+    acc = Xc.dtype if Xc.dtype == jnp.float64 else jnp.float32
+    w, z, _, _, _ = _glm_irls_state(Xc, yc, wc, oc, beta, family=family,
+                                    link=link, first=first)
+    wclip = dp_clip_weights(Xc, z, w, clip)
+    XtWX, XtWz = design_gramian(Xc, z, wclip, accum_dtype=acc)
+    return XtWX, XtWz, jnp.zeros((), acc)
+
+
+@jax.jit
+def _lm_dp_chunk_pass(Xc, yc, wc, clip):
+    """DP twin of the LM Gramian pass (``yc`` is already the
+    offset-subtracted working response, so the clipped augmented row is
+    exactly ``sqrt(w)[x, y - offset]``)."""
+    from ..robustreg.privacy import dp_clip_weights
+    acc = Xc.dtype if Xc.dtype == jnp.float64 else jnp.float32
+    wclip = dp_clip_weights(Xc, yc, wc, clip)
+    XtWX, XtWy = design_gramian(Xc, yc, wclip, accum_dtype=acc)
+    return dict(XtWX=XtWX, XtWy=XtWy)
+
+
+# ---------------------------------------------------------------------------
 # multi-host composition: per-process chunk sources + cross-process sums
 # ---------------------------------------------------------------------------
 # Out-of-core and multi-host COMPOSE (VERDICT r2 missing #2): each process
@@ -1050,6 +1088,7 @@ def lm_fit_streaming(
     metrics=None,
     prefetch: int = 0,
     ingest_workers: int | None = None,
+    privacy=None,
     config: NumericConfig = DEFAULT,
 ) -> LMModel:
     """OLS/WLS in ONE streaming pass (host-f64 accumulation + solve).
@@ -1093,14 +1132,16 @@ def lm_fit_streaming(
               has_intercept=has_intercept, mesh=mesh, retry=retry,
               checkpoint=checkpoint, resume=resume, config=config,
               prefetch=prefetch, ingest_workers=ingest_workers,
-              tracer=tracer)
+              privacy=privacy, tracer=tracer)
     if tracer is None:
         return _lm_fit_streaming_impl(source, **kw)
     with _obs_trace.ambient(tracer):
         tracer.emit("fit_start", model="lm_streaming")
         model = _lm_fit_streaming_impl(source, **kw)
         tracer.emit("fit_end", model="lm_streaming")
-    return dataclasses.replace(model, fit_info=tracer.report())
+    # merge, not overwrite: a DP impl stamps fit_info["privacy"] itself
+    return dataclasses.replace(
+        model, fit_info={**tracer.report(), **(model.fit_info or {})})
 
 
 def _lm_fit_streaming_impl(
@@ -1117,12 +1158,30 @@ def _lm_fit_streaming_impl(
     config,
     prefetch,
     ingest_workers,
+    privacy,
     tracer,
 ) -> LMModel:
     """Body of :func:`lm_fit_streaming` with the tracer already resolved."""
     _check_polish(config)
     prefetch = _check_prefetch(prefetch)
     nproc = jax.process_count()
+    dp = None
+    if privacy is not None:
+        from ..robustreg.privacy import DPSpec, calibrate_sigma
+        if not isinstance(privacy, DPSpec):
+            raise TypeError(
+                f"privacy= must be a robustreg.DPSpec or None, got "
+                f"{type(privacy).__name__}")
+        if nproc > 1:
+            raise ValueError(
+                "privacy= is single-process only (per-process noise draws "
+                "would compose across the allsum)")
+        if checkpoint is not None or resume:
+            raise ValueError(
+                "privacy= cannot combine with checkpoint/resume: the "
+                "single-release schedule must run uninterrupted for the "
+                "stated (epsilon, delta)")
+        dp = calibrate_sigma(privacy, 1)  # one pass, one release
     mesh = _streaming_mesh(mesh)
     chunks = _as_source(source, chunk_rows)
     chunks, proc_par = _source_workers(chunks, ingest_workers)
@@ -1252,11 +1311,23 @@ def _lm_fit_streaming_impl(
                 # strictly in chunk order).  sequential (prefetch<2):
                 # harvest eagerly — one chunk in flight, simplest to debug
                 t_c = time.perf_counter()
-                fut = _traced_call(_lm_chunk_pass, tracer, "lm_gramian",
-                                   Xd, yd, wd,
-                                   engine=("structured"
-                                           if isinstance(Xd, StructuredDesign)
-                                           else "einsum"))
+                if dp is not None:
+                    if isinstance(Xd, (StructuredDesign, SparseDesign)):
+                        raise ValueError(
+                            "privacy= requires dense row chunks (per-row "
+                            "norm clipping materializes each row); expand "
+                            "structured/sparse designs before streaming "
+                            "under DP")
+                    fut = _traced_call(_lm_dp_chunk_pass, tracer,
+                                       "lm_gramian:dp", Xd, yd, wd,
+                                       dp["clip"], engine="einsum")
+                else:
+                    fut = _traced_call(_lm_chunk_pass, tracer, "lm_gramian",
+                                       Xd, yd, wd,
+                                       engine=("structured"
+                                               if isinstance(
+                                                   Xd, StructuredDesign)
+                                               else "einsum"))
                 pass_compute += time.perf_counter() - t_c
                 if pending is not None:
                     drain(pending)
@@ -1320,6 +1391,33 @@ def _lm_fit_streaming_impl(
         has_intercept = (
             any(nm.lower() in ("intercept", "(intercept)") for nm in xnames)
             or bool(ones_mask.any()))
+
+    if dp is not None:
+        # release 0 (the only one): noise the accumulated pair before the
+        # solve, then stop — the residual/statistics passes read the raw
+        # data outside the release, so every data-dependent scalar is NaN
+        from ..robustreg.privacy import dp_noise_pair
+        acc["XtWX"], acc["XtWy"] = dp_noise_pair(
+            acc["XtWX"], acc["XtWy"], dp["sigma"], dp["seed"], 0)
+        if tracer is not None:
+            tracer.emit("dp_noise", release=0, sigma=float(dp["sigma"]),
+                        clip=float(dp["clip"]),
+                        rho_per_release=float(dp["rho_per_release"]))
+        beta, _cho, _pivot = _solve64(acc["XtWX"], acc["XtWy"],
+                                      config.jitter)
+        nan = float("nan")
+        df_model = p - (1 if has_intercept else 0)
+        return LMModel(
+            coefficients=beta, std_errors=np.full((p,), np.nan),
+            xnames=xnames, yname=yname, n_obs=n, n_params=p,
+            df_model=df_model, df_resid=int(acc["n_ok"]) - p,
+            sse=nan, sst=nan, r_squared=nan, adj_r_squared=nan,
+            sigma=nan, f_statistic=nan,
+            has_intercept=bool(has_intercept),
+            n_shards=mesh.shape[meshlib.DATA_AXIS], cov_unscaled=None,
+            has_offset=bool(saw_offset), has_weights=bool(saw_weights),
+            weights_vary=False, resid_quantiles=None,
+            gramian_engine="einsum", fit_info={"privacy": dp})
 
     t_s = time.perf_counter()
     beta, cho, pivot = _solve64(acc["XtWX"], acc["XtWy"], config.jitter)
@@ -1517,6 +1615,7 @@ def glm_fit_streaming(
     prefetch: int = 0,
     ingest_workers: int | None = None,
     engine: str = "auto",
+    privacy=None,
     config: NumericConfig = DEFAULT,
     _null_model: bool = False,
 ) -> GLMModel:
@@ -1600,7 +1699,7 @@ def glm_fit_streaming(
               cache=cache, cache_budget_bytes=cache_budget_bytes,
               retry=retry, checkpoint=checkpoint, resume=resume,
               prefetch=prefetch, ingest_workers=ingest_workers,
-              engine=engine, config=config,
+              engine=engine, privacy=privacy, config=config,
               _null_model=_null_model, tracer=tracer)
     if tracer is None:
         return _glm_fit_streaming_impl(source, **kw)
@@ -1611,14 +1710,17 @@ def glm_fit_streaming(
         tracer.emit("fit_end", iterations=int(model.iterations),
                     deviance=float(model.deviance),
                     converged=bool(model.converged))
-    return dataclasses.replace(model, fit_info=tracer.report())
+    # the impl stamps fit_info itself for DP fits (the privacy record);
+    # merge rather than overwrite — the tracer aggregate keeps its keys
+    return dataclasses.replace(
+        model, fit_info={**tracer.report(), **(model.fit_info or {})})
 
 
 def _glm_fit_streaming_impl(
     source, *, family, link, tol, max_iter, criterion, chunk_rows, xnames,
     yname, has_intercept, mesh, verbose, beta0, on_iteration, cache,
     cache_budget_bytes, retry, checkpoint, resume, prefetch, ingest_workers,
-    engine, config, _null_model, tracer,
+    engine, privacy, config, _null_model, tracer,
 ) -> GLMModel:
     """Body of :func:`glm_fit_streaming` with the tracer already resolved."""
     _check_polish(config)
@@ -1634,6 +1736,49 @@ def _glm_fit_streaming_impl(
     prefetch = _check_prefetch(prefetch)
     fam, lnk = resolve(family, link)
     nproc = jax.process_count()
+    robust = fam.robust is not None
+    if robust and fam.name == "linf":
+        raise ValueError(
+            "family='linf' cannot stream: its softmax weight is row-GLOBAL "
+            "(every residual enters the normalization), so per-chunk passes "
+            "cannot evaluate it — use the resident fit (sg.glm) or a fleet")
+    if robust and sketch_run:
+        raise ValueError(
+            "robust pseudo-families are not supported by engine='sketch' "
+            "(the sketched Gramian has no robust reweighting hook); use the "
+            "exact engine")
+    dp = None
+    if privacy is not None:
+        from ..robustreg.privacy import DPSpec, calibrate_sigma
+        if not isinstance(privacy, DPSpec):
+            raise TypeError(
+                f"privacy= must be a robustreg.DPSpec or None, got "
+                f"{type(privacy).__name__}")
+        if robust:
+            raise ValueError(
+                "privacy= cannot combine with robust pseudo-families: the "
+                "eps-smoothing schedule's data-dependent trajectory has no "
+                "DP accounting here — fit a genuine family under DP, or a "
+                "robust family without privacy")
+        if sketch_run:
+            raise ValueError(
+                "privacy= requires the exact streaming engine (the sketch "
+                "release's sensitivity is not the clipped Gramian's)")
+        if nproc > 1:
+            raise ValueError(
+                "privacy= is single-process only (per-process noise draws "
+                "would compose across the allsum)")
+        if checkpoint is not None or resume:
+            raise ValueError(
+                "privacy= cannot combine with checkpoint/resume: the "
+                "release schedule is fixed at 1 + max_iter passes and must "
+                "run uninterrupted for the stated (epsilon, delta)")
+        if _null_model:
+            raise ValueError("internal: DP fits never recurse a null model")
+        # fixed schedule: init (or warm-start) pass + every budgeted IRLS
+        # pass releases once — a data-dependent stopping time is itself a
+        # release, so the budget covers max_iter and the loop never breaks
+        dp = calibrate_sigma(privacy, 1 + int(max_iter))
     mesh = _streaming_mesh(mesh)
     chunks = _as_source(source, chunk_rows)
     chunks, proc_par = _source_workers(chunks, ingest_workers)
@@ -1641,6 +1786,45 @@ def _glm_fit_streaming_impl(
         from ..robust.retry import retrying_source
         chunks = retrying_source(chunks, retry)
     ckpt, resume_ck, _ck_state = _resolve_resume(checkpoint, resume, nproc)
+
+    # robust pseudo-families: the eps-smoothing schedule advances once per
+    # HOST pass (the streaming analogue of the resident kernel's in-loop
+    # shrink, models/glm.py::_irls_core).  The cell is read by the default
+    # chunk_call and set before every global_pass; its values are plain
+    # python floats — traced 0-d operands — so shrinking eps never
+    # recompiles the chunk executable.  Non-robust families keep the
+    # constant fam.param_operand(), bit-identical to before.
+    fam_param_cell = [fam.param_operand()]
+
+    def _set_robust_pass(t):
+        if robust:
+            shape, eps0, factor, eps_min = fam.param
+            fam_param_cell[0] = (shape, max(eps0 * factor ** t, eps_min),
+                                 factor, eps_min)
+
+    def _robust_at_floor(t):
+        """True once pass ``t`` ran at eps_min — convergence is only
+        declared when BOTH compared deviances belong to the floor loss."""
+        if not robust:
+            return True
+        _, eps0, factor, eps_min = fam.param
+        return eps0 * factor ** t <= eps_min
+
+    def _dp_call(first):
+        """chunk_call for DP passes: the clipped-Gramian twin of the
+        default `_glm_chunk_pass` dispatch (dense rows only — row-norm
+        clipping needs the materialized row)."""
+        def call(dX, dy, dw, do, b, k):
+            if isinstance(dX, (StructuredDesign, SparseDesign)):
+                raise ValueError(
+                    "privacy= requires dense row chunks (per-row norm "
+                    "clipping materializes each row); expand structured/"
+                    "sparse designs before streaming under DP")
+            return _traced_call(_glm_dp_chunk_pass, tracer, "glm_pass:dp",
+                                dX, dy, dw, do, b, dp["clip"],
+                                engine="einsum", family=fam, link=lnk,
+                                first=first, fam_param=fam.param_operand())
+        return call
 
     n_total = 0
     saw_offset = False
@@ -1786,7 +1970,7 @@ def _glm_fit_streaming_impl(
                                            if isinstance(dX, StructuredDesign)
                                            else "einsum"),
                                    family=fam, link=lnk, first=first,
-                                   fam_param=fam.param_operand())
+                                   fam_param=fam_param_cell[0])
             if pending is not None:
                 drain(pending)
             pending = fut
@@ -1964,11 +2148,27 @@ def _glm_fit_streaming_impl(
         p = beta.shape[0]
     elif beta0 is not None:
         # warm start (resume from a checkpointed beta): the first pass is a
-        # regular IRLS pass at beta0 instead of the family-init pass
-        XtWX, XtWz, dev_prev = global_pass(np.asarray(beta0, np.float64), False)
+        # regular IRLS pass at beta0 instead of the family-init pass.
+        # Robust warm starts RESTART the eps schedule at t=0 (the beta0
+        # producer's schedule position is unknowable here).
+        _set_robust_pass(0)
+        XtWX, XtWz, dev_prev = global_pass(
+            np.asarray(beta0, np.float64), False,
+            chunk_call=_dp_call(False) if dp is not None else None)
     else:
         # init pass from family starting values (first=True ignores beta)
-        XtWX, XtWz, dev_prev = global_pass(None, True)
+        _set_robust_pass(0)
+        XtWX, XtWz, dev_prev = global_pass(
+            None, True, chunk_call=_dp_call(True) if dp is not None else None)
+    if dp is not None:
+        # release 0: the init/warm Gramian pair leaves the clipped
+        # accumulator with its calibrated Gaussian noise BEFORE the solve
+        from ..robustreg.privacy import dp_noise_pair
+        XtWX, XtWz = dp_noise_pair(XtWX, XtWz, dp["sigma"], dp["seed"], 0)
+        if tracer is not None:
+            tracer.emit("dp_noise", release=0, sigma=float(dp["sigma"]),
+                        clip=float(dp["clip"]),
+                        rho_per_release=float(dp["rho_per_release"]))
     if _ck_state is None and not sketch_run:
         p = XtWX.shape[0]
         t_s = time.perf_counter()
@@ -1994,7 +2194,21 @@ def _glm_fit_streaming_impl(
             # incoming beta, so the lagged convergence is identical
             beta_new, dev, cho, pivot = sketch_update(beta, False, it + 1)
         else:
-            XtWX, XtWz, dev = global_pass(beta, False)
+            # pass t = it + 1 (init/warm was t = 0): a managed resume at
+            # it0 > 0 picks the schedule up exactly where it stopped
+            _set_robust_pass(it + 1)
+            XtWX, XtWz, dev = global_pass(
+                beta, False,
+                chunk_call=_dp_call(False) if dp is not None else None)
+            if dp is not None:
+                from ..robustreg.privacy import dp_noise_pair
+                XtWX, XtWz = dp_noise_pair(XtWX, XtWz, dp["sigma"],
+                                           dp["seed"], it + 1)
+                if tracer is not None:
+                    tracer.emit("dp_noise", release=it + 1,
+                                sigma=float(dp["sigma"]),
+                                clip=float(dp["clip"]),
+                                rho_per_release=float(dp["rho_per_release"]))
         if tol_eff is None:
             tol_eff = effective_tol(tol, criterion, dtype)
         ddev = abs(dev - dev_prev)
@@ -2025,7 +2239,12 @@ def _glm_fit_streaming_impl(
                       iters=iters, beta=beta, dev=dev)
         if on_iteration is not None:
             on_iteration(iters, beta.copy(), dev)  # checkpoint hook
-        if crit <= tol_eff:
+        # DP fits NEVER stop on the deviance (a data-dependent stopping
+        # time is an unaccounted release) — they run the full budgeted
+        # schedule.  Robust fits additionally require the eps schedule at
+        # its floor: both compared deviances must belong to the eps_min
+        # loss (pass t = it ran at eps0*factor^it).
+        if dp is None and crit <= tol_eff and _robust_at_floor(it):
             converged = True
             break
     if xnames is None:
@@ -2049,8 +2268,12 @@ def _glm_fit_streaming_impl(
     ccache.open = False
     # no CSNE for sketch fits: the chunked TSQR factors dense row blocks,
     # and the sketched trajectory's conditioning probe is the sketched
-    # Gramian's — an approximation the polish policy was not written for
-    if not _null_model and not sketch_run and _sync_polish_decision(
+    # Gramian's — an approximation the polish policy was not written for.
+    # Nor for robust fits (_chunk_zw rebuilds GENUINE-family weights, not
+    # the robust rule's) or DP fits (the polish would be an unaccounted
+    # exact release).
+    if not _null_model and not sketch_run and fam.robust is None \
+            and dp is None and _sync_polish_decision(
             _resolve_streaming_polish(pivot, dtype, config,
                                       structured=saw_structured), nproc):
         # chunked TSQR + CSNE at the converged beta — the streaming
@@ -2066,7 +2289,7 @@ def _glm_fit_streaming_impl(
                 "CSNE polish skipped: the TSQR rank probe found the design "
                 "numerically rank-deficient — returning the unpolished "
                 "solution; coefficients may lose digits", stacklevel=2)
-    if not converged and not _null_model:
+    if not converged and not _null_model and dp is None:
         import warnings
         clamp_note = (f" (effective threshold {tol_eff:g} — the requested "
                       "tol is below the deviance dtype's resolution)"
@@ -2075,6 +2298,35 @@ def _glm_fit_streaming_impl(
             f"streaming IRLS did not converge in {iters} iterations "
             f"(criterion {criterion!r}, tol={tol:g}{clamp_note}); estimates "
             "may be unreliable — raise max_iter or loosen tol", stacklevel=2)
+
+    if dp is not None:
+        # DP fits end here: the exact host-f64 stats/null-deviance passes
+        # read the raw data outside the released Gramian pairs, so every
+        # data-dependent scalar reports NaN.  converged is False by
+        # construction (the fixed schedule never breaks); n (row count)
+        # and p are treated as public metadata.  Standard errors are NaN
+        # too — the noisy Gramian's inverse is not a covariance.
+        if xnames is None:
+            xnames = tuple(f"x{i}" for i in range(p))
+        xnames = tuple(xnames)
+        if has_intercept is None:
+            has_intercept = (
+                any(nm.lower() in ("intercept", "(intercept)")
+                    for nm in xnames) or bool(ones_mask.any()))
+        n = n_rows_global if n_rows_global is not None else n_total
+        return GLMModel(
+            coefficients=beta, std_errors=np.full((p,), np.nan),
+            xnames=xnames, yname=yname, family=fam.name, link=lnk.name,
+            deviance=float("nan"), null_deviance=float("nan"),
+            pearson_chi2=float("nan"), loglik=float("nan"),
+            aic=float("nan"), dispersion=float("nan"),
+            df_residual=n - p,
+            df_null=n - (1 if has_intercept else 0), iterations=iters,
+            converged=False, n_obs=n, n_params=p,
+            dispersion_fixed=bool(fam.dispersion_fixed),
+            n_shards=mesh.shape[meshlib.DATA_AXIS], tol=tol,
+            has_intercept=bool(has_intercept), has_offset=bool(saw_offset),
+            gramian_engine="einsum", fit_info={"privacy": dp})
 
     # ---- final stats pass at the converged beta: HOST float64 -------------
     # (models/hoststats.py docstring: device-f32 transcendentals are too
@@ -2128,7 +2380,10 @@ def _glm_fit_streaming_impl(
     # mu = linkinv(offset) for no-intercept models.  X never re-enters.
     if _null_model:
         null_dev = np.nan  # the caller only wants .deviance
-    elif has_intercept and saw_offset:
+    elif has_intercept and saw_offset and fam.robust is None:
+        # genuine families only: a robust family's null deviance is NaN by
+        # contract (hoststats.null_dev_chunk), so it takes the else-branch
+        # below instead of paying this intercept-only streaming refit
         def ones_source():
             for Xc, yc, wc, oc in _iter_chunks(chunks):
                 if _is_device_chunk(Xc):

@@ -90,6 +90,13 @@ BLOCKS: dict[str, dict] = {
                        "direction": "higher", "kind": "value"},
     "capacity_observatory": {"metric": "overhead_frac", "direction": "lower",
                              "kind": "frac"},
+    # r19 robust & private fitting (robustreg/): batched 8-tau path vs 8
+    # cold fits on a shared design, and the clip+noise DP streaming pass
+    # vs the plain pass
+    "quantile_tau_path": {"metric": "speedup_vs_cold",
+                          "direction": "higher", "kind": "value"},
+    "dp_overhead": {"metric": "overhead_frac", "direction": "lower",
+                    "kind": "frac"},
     # ok-flag-only blocks: tracked for flips, no scalar trajectory.
     "hotloop_mfu": {"metric": None, "direction": "lower", "kind": "flag"},
     "tenant_growth_chaos": {"metric": None, "direction": "lower",

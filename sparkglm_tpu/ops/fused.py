@@ -107,6 +107,14 @@ def irls_weights(y, wt, offset, eta, mu, *, family, link, valid):
     g = link.deriv(mu)
     var = family.variance(mu)
     w = _sanitize(wt / jnp.maximum(var * g * g, _TINY), valid)
+    # robust pseudo-families (sparkglm_tpu/robustreg) multiply in their
+    # reweighting rule here — the single hook that turns every Gramian
+    # driver into an IRLS solver for smoothed quantile/Huber/l1 losses.
+    # getattr returns None for all genuine families, leaving their jaxpr
+    # (and therefore their compiled bits) untouched.
+    rw = getattr(family, "robust", None)
+    if rw is not None:
+        w = w * _sanitize(rw(y, mu, wt), valid)
     z = _sanitize(eta - offset + (y - mu) * g, valid)
     return w, z
 

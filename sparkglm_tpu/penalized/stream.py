@@ -209,8 +209,27 @@ def _prepare(penalty, xnames, has_intercept):
     return xnames, icol, pfv
 
 
+def _resolve_path_ckpt(source, checkpoint, resume):
+    """Shared ``checkpoint=``/``resume=`` plumbing for the path drivers:
+    ``(ckpt, resume_ck, state, fingerprint, source')`` via the streaming
+    engine's resolver + first-chunk identity probe.  The probe only runs
+    when durability is actually requested — the plain path is untouched."""
+    from ..models.streaming import _resolve_resume, _source_first_chunk
+
+    ckpt, resume_ck, state = _resolve_resume(checkpoint, resume, 1)
+    src_fp = None
+    if ckpt is not None or state is not None:
+        src_fp, _, _, source = _source_first_chunk(source)
+    return ckpt, resume_ck, state, src_fp, source
+
+
+def _ckpt_str(state, key):
+    return bytes(np.asarray(state[key])).decode()
+
+
 def lm_path_streaming(source, *, penalty, xnames, yname="y",
                       has_intercept=None, verbose=False, retry=None,
+                      checkpoint=None, resume=False,
                       trace=None, metrics=None, config=None):
     """Gaussian/identity lambda path from a chunk source in ONE data pass
     (module docstring).  ``source()`` yields ``(X, y, w, off)`` tuples or
@@ -218,7 +237,17 @@ def lm_path_streaming(source, *, penalty, xnames, yname="y",
 
     ``retry=`` (a ``robust.RetryPolicy``) wraps the source so every chunk
     pass absorbs transient read failures in place, each pass under its own
-    fresh budget (``robust/retry.py::retrying_source``)."""
+    fresh budget (``robust/retry.py::retrying_source``).
+
+    ``checkpoint=`` (path or ``robust.CheckpointManager``) makes the
+    expensive part durable: the gaussian path streams data exactly ONCE
+    (the Gramian accumulation — everything after is p x p work), so the
+    lambda-path boundary to checkpoint at IS the end of that pass.
+    ``resume=True`` (or ``resume=path``) restores the accumulated moments
+    after fingerprint validation and re-runs the compiled path kernel
+    without touching the data; with the same ``penalty=`` spec the resumed
+    model is bit-for-bit the uninterrupted one (the kernel consumes only
+    the checkpointed host-f64 totals)."""
     from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
 
     if config is None:
@@ -226,6 +255,8 @@ def lm_path_streaming(source, *, penalty, xnames, yname="y",
     if retry is not None:
         from ..robust.retry import retrying_source
         source = retrying_source(source, retry)
+    ckpt, resume_ck, state, src_fp, source = _resolve_path_ckpt(
+        source, checkpoint, resume)
     xnames, icol, pfv = _prepare(penalty, xnames, has_intercept)
     p = len(xnames)
     dtype = np.float64 if x64_enabled() else np.float32
@@ -252,14 +283,27 @@ def lm_path_streaming(source, *, penalty, xnames, yname="y",
             tracer.emit("fit_start", model="penalized_path_streaming",
                         family="gaussian", link="identity",
                         alpha=float(penalty.alpha))
-        totals, chunks, rows = _stream_pass(source, "penalized_gramian",
-                                            tracer, bucket, dtype, per_chunk)
+        if state is not None:
+            resume_ck.validate(state, kind="lm_path", fingerprint=src_fp, p=p)
+            totals = {k: np.asarray(state[k], np.float64)
+                      for k in ("A", "b", "s1", "yty", "wsum", "n_ok")}
+            rows = int(state["rows"])
+            engine[0] = _ckpt_str(state, "engine")
+        else:
+            totals, chunks, rows = _stream_pass(
+                source, "penalized_gramian", tracer, bucket, dtype, per_chunk)
         if rows == 0:
             raise ValueError("chunk source produced no rows")
         wsum = float(totals["wsum"])
         if wsum <= 0:
             raise ValueError("weights sum to zero; nothing to fit")
         n_ok = int(totals["n_ok"])
+        if ckpt is not None and state is None:
+            ckpt.save(kind="lm_path", fingerprint=src_fp, p=p,
+                      A=totals["A"], b=totals["b"], s1=totals["s1"],
+                      yty=totals["yty"], n_ok=totals["n_ok"],
+                      wsum=totals["wsum"], rows=rows,
+                      engine=np.bytes_(engine[0].encode()))
         A = totals["A"] / wsum
         b = totals["b"] / wsum
         s1 = totals["s1"] / wsum
@@ -299,12 +343,23 @@ def lm_path_streaming(source, *, penalty, xnames, yname="y",
 
 def glm_path_streaming(source, *, family="binomial", link=None, penalty,
                        xnames, yname="y", has_intercept=None, verbose=False,
-                       retry=None, trace=None, metrics=None, config=None):
+                       retry=None, checkpoint=None, resume=False,
+                       trace=None, metrics=None, config=None):
     """General-family lambda path from a chunk source: host lambda/IRLS
     loops over a fixed set of compiled chunk-pass flavors plus the
     lambda-traced CD solve kernel (module docstring).  ``retry=`` wraps the
     source exactly as in :func:`lm_path_streaming` — every pass of the
-    lambda/IRLS loops absorbs transient chunk failures in place."""
+    lambda/IRLS loops absorbs transient chunk failures in place.
+
+    ``checkpoint=`` saves the path trajectory at every LAMBDA BOUNDARY —
+    the natural durability grain: each grid point costs O(IRLS iterations)
+    full data passes, and between grid points the whole state is tiny host
+    vectors (active-set memory, warm-start beta, strong-rule gradient,
+    accumulated per-lambda results).  ``resume=`` validates the source
+    fingerprint plus family/link/alpha and continues the lambda loop from
+    the first unfitted grid point; passes are deterministic given the
+    source, so with the same ``penalty=`` spec the resumed path is
+    bit-for-bit the uninterrupted one."""
     from ..config import DEFAULT, resolve_matmul_precision, x64_enabled
     from ..families.families import resolve as _resolve
     from ..models.streaming import _traced_call
@@ -316,10 +371,13 @@ def glm_path_streaming(source, *, family="binomial", link=None, penalty,
         return lm_path_streaming(
             source, penalty=penalty, xnames=xnames, yname=yname,
             has_intercept=has_intercept, verbose=verbose, retry=retry,
+            checkpoint=checkpoint, resume=resume,
             trace=trace, metrics=metrics, config=config)
     if retry is not None:
         from ..robust.retry import retrying_source
         source = retrying_source(source, retry)
+    ckpt, resume_ck, state, src_fp, source = _resolve_path_ckpt(
+        source, checkpoint, resume)
     xnames, icol, pfv = _prepare(penalty, xnames, has_intercept)
     p = len(xnames)
     dtype = np.float64 if x64_enabled() else np.float32
@@ -347,72 +405,114 @@ def glm_path_streaming(source, *, family="binomial", link=None, penalty,
             tracer.emit("fit_start", model="penalized_path_streaming",
                         family=fam.name, link=lnk.name,
                         alpha=float(penalty.alpha))
-        # pass 1: standardization stats (first/second weighted moments)
-        totals, chunks, rows = _stream_pass(
-            source, "penalized_stats", tracer, bucket, dtype,
-            lambda Xc, yc, wc, oc: counted(
-                _stats_chunk_kernel, "penalized_stats", Xc, yc, wc, oc,
-                precision=mmp))
-        if rows == 0:
-            raise ValueError("chunk source produced no rows")
-        wsum = float(totals["wsum"])
-        if wsum <= 0:
-            raise ValueError("weights sum to zero; nothing to fit")
-        n_ok = int(totals["n_ok"])
         pen = pfv > 0.0
-        sd = _sd_from_moments(np.diag(totals["A"]) / wsum,
-                              totals["s1"] / wsum, pen,
-                              penalty.standardize, p)
-        isd = 1.0 / sd
-
-        # pass 2..k: intercept-only null IRLS (scalar chunk partials)
-        def null_pass(b0, first):
-            tot, _, _ = _stream_pass(
-                source, "penalized_null", tracer, bucket, dtype,
-                lambda Xc, yc, wc, oc: counted(
-                    _null_chunk_kernel,
-                    "penalized_null_first" if first else "penalized_null",
-                    yc, wc, oc, np.asarray(b0, dtype), fam_param,
-                    first=first, **fam_kw))
-            return (float(tot["sw"]), float(tot["swz"]), float(tot["dev"]))
-
-        b0 = 0.0
-        if icol is not None:
-            sw, swz, dev_prev = null_pass(0.0, True)
-            for it in range(_NULL_MAX_ITER):
-                b0 = swz / max(sw, _TINY)
-                sw, swz, dev = null_pass(b0, False)
-                if abs(dev - dev_prev) <= _NULL_TOL * (abs(dev) + 0.1):
-                    dev_prev = dev
-                    break
-                dev_prev = dev
-            null_dev = dev_prev
+        if state is not None:
+            # resume at a lambda boundary: validate identity, restore the
+            # tiny host trajectory, skip the stats/null/grad passes
+            resume_ck.validate(state, kind="glm_path",
+                               fingerprint=src_fp, p=p)
+            if (_ckpt_str(state, "family") != fam.name
+                    or _ckpt_str(state, "link") != lnk.name
+                    or float(state["alpha"]) != float(penalty.alpha)):
+                raise ValueError(
+                    f"checkpoint {resume_ck.path!r} was written by a "
+                    f"{_ckpt_str(state, 'family')}/{_ckpt_str(state, 'link')}"
+                    f" path at alpha={float(state['alpha'])}; resuming a "
+                    f"{fam.name}/{lnk.name} path at "
+                    f"alpha={float(penalty.alpha)} from it would corrupt "
+                    f"the trajectory — delete the checkpoint (or drop "
+                    f"resume=) to start over")
+            engine[0] = _ckpt_str(state, "engine")
+            rows = int(state["rows"])
+            n_ok = int(state["n_ok"])
+            wsum = float(state["wsum"])
+            sd = np.asarray(state["sd"], np.float64)
+            isd = 1.0 / sd
+            b0 = float(state["b0"])
+            null_dev = float(state["null_dev"])
+            lams = np.asarray(state["lams"], np.float64)
+            g = np.asarray(state["g"], np.float64)
+            lam_prev = float(state["lam_prev"])
+            ever = np.asarray(state["ever"], bool).copy()
+            beta_std = np.asarray(state["beta_std"], np.float64).copy()
+            k0 = int(state["k"])
+            betas = list(np.asarray(state["betas"], np.float64))
+            dfs = [int(v) for v in state["dfs"]]
+            devs = [float(v) for v in state["devs"]]
+            its = [int(v) for v in state["its"]]
+            sws = [int(v) for v in state["sws"]]
+            convs = [bool(v) for v in state["convs"]]
+            kkts = [bool(v) for v in state["kkts"]]
         else:
-            _, _, null_dev = null_pass(0.0, False)
+            # pass 1: standardization stats (first/second weighted moments)
+            totals, chunks, rows = _stream_pass(
+                source, "penalized_stats", tracer, bucket, dtype,
+                lambda Xc, yc, wc, oc: counted(
+                    _stats_chunk_kernel, "penalized_stats", Xc, yc, wc, oc,
+                    precision=mmp))
+            if rows == 0:
+                raise ValueError("chunk source produced no rows")
+            wsum = float(totals["wsum"])
+            if wsum <= 0:
+                raise ValueError("weights sum to zero; nothing to fit")
+            n_ok = int(totals["n_ok"])
+            sd = _sd_from_moments(np.diag(totals["A"]) / wsum,
+                                  totals["s1"] / wsum, pen,
+                                  penalty.standardize, p)
+            isd = 1.0 / sd
 
-        # lambda_max gradient at the null solution
-        gtot, _, _ = _stream_pass(
-            source, "penalized_grad", tracer, bucket, dtype,
-            lambda Xc, yc, wc, oc: counted(
-                _grad_chunk_kernel, "penalized_grad", Xc, yc, wc, oc,
-                np.asarray(b0, dtype), fam_param, **fam_kw))
-        g = (gtot["u"] - b0 * gtot["v"]) * isd / wsum
-        al = max(float(penalty.alpha), _ALPHA_FLOOR)
-        lam_max = float(np.max(np.where(
-            pen, np.abs(g) / (al * np.maximum(pfv, _TINY)), 0.0)))
-        lam_max = max(lam_max, _TINY)
-        lams = _grid_from(lam_max, penalty, rows,
-                          p - (1 if icol is not None else 0))
+            # pass 2..k: intercept-only null IRLS (scalar chunk partials)
+            def null_pass(b0, first):
+                tot, _, _ = _stream_pass(
+                    source, "penalized_null", tracer, bucket, dtype,
+                    lambda Xc, yc, wc, oc: counted(
+                        _null_chunk_kernel,
+                        "penalized_null_first" if first else "penalized_null",
+                        yc, wc, oc, np.asarray(b0, dtype), fam_param,
+                        first=first, **fam_kw))
+                return (float(tot["sw"]), float(tot["swz"]),
+                        float(tot["dev"]))
+
+            b0 = 0.0
+            if icol is not None:
+                sw, swz, dev_prev = null_pass(0.0, True)
+                for it in range(_NULL_MAX_ITER):
+                    b0 = swz / max(sw, _TINY)
+                    sw, swz, dev = null_pass(b0, False)
+                    if abs(dev - dev_prev) <= _NULL_TOL * (abs(dev) + 0.1):
+                        dev_prev = dev
+                        break
+                    dev_prev = dev
+                null_dev = dev_prev
+            else:
+                _, _, null_dev = null_pass(0.0, False)
+
+            # lambda_max gradient at the null solution
+            gtot, _, _ = _stream_pass(
+                source, "penalized_grad", tracer, bucket, dtype,
+                lambda Xc, yc, wc, oc: counted(
+                    _grad_chunk_kernel, "penalized_grad", Xc, yc, wc, oc,
+                    np.asarray(b0, dtype), fam_param, **fam_kw))
+            g = (gtot["u"] - b0 * gtot["v"]) * isd / wsum
+            al = max(float(penalty.alpha), _ALPHA_FLOOR)
+            lam_max = float(np.max(np.where(
+                pen, np.abs(g) / (al * np.maximum(pfv, _TINY)), 0.0)))
+            lam_max = max(lam_max, _TINY)
+            lams = _grid_from(lam_max, penalty, rows,
+                              p - (1 if icol is not None else 0))
+
+            ever = np.zeros(p, bool)
+            beta_std = np.zeros(p)
+            if icol is not None:
+                beta_std[icol] = b0
+            lam_prev = lam_max
+            k0 = 0
+            betas, dfs, devs = [], [], []
+            its, sws, convs, kkts = [], [], [], []
 
         # the path: host lambda loop, host IRLS loop, compiled passes
         alpha = float(penalty.alpha)
         free = ~pen
-        ever = np.zeros(p, bool)
-        beta_std = np.zeros(p)
-        if icol is not None:
-            beta_std[icol] = b0
-        lam_prev = lam_max
-        betas, dfs, devs, its, sws, convs, kkts = [], [], [], [], [], [], []
 
         def fisher(beta_orig):
             tot, _, _ = _stream_pass(
@@ -424,8 +524,8 @@ def glm_path_streaming(source, *, family="binomial", link=None, penalty,
             bs = (tot["b"] / wsum) * isd
             return As, bs, float(tot["dev"])
 
-        for k, lam in enumerate(lams):
-            lam = float(lam)
+        for k in range(k0, len(lams)):
+            lam = float(lams[k])
             strong = pen & (np.abs(g)
                             >= alpha * pfv * (2.0 * lam - lam_prev) - 1e-12)
             mask = free | ever | strong
@@ -479,6 +579,18 @@ def glm_path_streaming(source, *, family="binomial", link=None, penalty,
                             sweeps=sweeps_total)
                 tracer.emit("solve", target="path_lambda", index=k,
                             iters=it_total)
+            if ckpt is not None:
+                ckpt.save(kind="glm_path", fingerprint=src_fp, p=p,
+                          family=np.bytes_(fam.name.encode()),
+                          link=np.bytes_(lnk.name.encode()),
+                          alpha=float(penalty.alpha),
+                          engine=np.bytes_(engine[0].encode()),
+                          k=k + 1, rows=rows, n_ok=n_ok, wsum=wsum,
+                          sd=sd, b0=b0, null_dev=null_dev,
+                          lams=np.asarray(lams), g=g, lam_prev=lam_prev,
+                          ever=ever, beta_std=beta_std,
+                          betas=np.asarray(betas), dfs=dfs, devs=devs,
+                          its=its, sws=sws, convs=convs, kkts=kkts)
 
         out = dict(lambdas=np.asarray(lams), beta=np.asarray(betas),
                    dev=np.asarray(devs), null_dev=null_dev,

@@ -25,14 +25,15 @@ from .checkpoint import (CheckpointManager, as_checkpoint, atomic_savez,
                          atomic_write_bytes)
 from .faults import FaultPlan, SimulatedPreemption, faulty_reader, faulty_source
 from .retry import (DeadlineExceeded, FatalSourceError, Overloaded,
-                    ReplicaUnavailable, RetryBudgetExhausted, RetryPolicy,
-                    TransientSourceError, call_with_retry, retrying_source)
+                    ReplicaUnavailable, RetryBudgetExhausted, RetryingSource,
+                    RetryPolicy, TransientSourceError, call_with_retry,
+                    retrying_source)
 
 __all__ = [
     "TransientSourceError", "FatalSourceError", "Overloaded",
     "DeadlineExceeded", "ReplicaUnavailable",
     "RetryBudgetExhausted",
-    "RetryPolicy", "call_with_retry", "retrying_source",
+    "RetryPolicy", "RetryingSource", "call_with_retry", "retrying_source",
     "CheckpointManager", "as_checkpoint",
     "atomic_write_bytes", "atomic_savez",
     "FaultPlan", "SimulatedPreemption", "faulty_source", "faulty_reader",

@@ -217,7 +217,52 @@ def retrying_source(chunks: Callable, policy: RetryPolicy) -> Callable:
     (the same one the device cache's cached-prefix skip enforces via
     ``_fingerprint``): a retried pass must yield the same chunks in the
     same order.
+
+    A source exposing the sharded fast-path surface (``subset()`` /
+    ``with_workers()`` / ``__len__`` — :class:`~sparkglm_tpu.data.ingest.
+    ShardedSource`) comes back as a :class:`RetryingSource` that FORWARDS
+    that surface: narrowing/rebinding produce retry-wrapped sources again,
+    so the elastic scheduler's ``subset`` sharding, ``ingest_workers=``
+    rebinding, and the process-parallel checkpoint probe keep their fast
+    paths under retry instead of silently degrading to full scan-and-skip.
     """
+    if (hasattr(chunks, "subset") and hasattr(chunks, "with_workers")
+            and hasattr(chunks, "__len__")):
+        return RetryingSource(chunks, policy)
+    return _retry_gen(chunks, policy)
+
+
+class RetryingSource:
+    """A retry-wrapped sharded chunk source: calling it streams one pass
+    under the policy's budget (see :func:`retrying_source`), while the
+    sharded-source narrowing surface passes through — each forwarded call
+    re-wraps its result, so retry survives ``subset``/``with_workers``
+    chains (the wrapper previously erased them)."""
+
+    def __init__(self, inner, policy: RetryPolicy):
+        self.inner = inner
+        self.policy = policy
+        self._gen = _retry_gen(inner, policy)
+
+    def __call__(self):
+        return self._gen()
+
+    def __len__(self):
+        return len(self.inner)
+
+    @property
+    def process_parallel(self) -> bool:
+        return bool(getattr(self.inner, "process_parallel", False))
+
+    def subset(self, positions) -> "RetryingSource":
+        return RetryingSource(self.inner.subset(positions), self.policy)
+
+    def with_workers(self, workers: int) -> "RetryingSource":
+        return RetryingSource(self.inner.with_workers(workers), self.policy)
+
+
+def _retry_gen(chunks: Callable, policy: RetryPolicy) -> Callable:
+    """The per-pass retry generator factory behind :func:`retrying_source`."""
 
     def gen():
         budget = policy.new_budget()
@@ -269,3 +314,11 @@ def retrying_source(chunks: Callable, policy: RetryPolicy) -> Callable:
             k += 1
 
     return gen
+
+
+__all__ = [
+    "TransientSourceError", "FatalSourceError", "Overloaded",
+    "DeadlineExceeded", "ReplicaUnavailable", "RetryBudgetExhausted",
+    "RetryPolicy", "RetryBudget", "RetryingSource", "call_with_retry",
+    "retrying_source",
+]

@@ -1,0 +1,34 @@
+"""Robust / quantile regression + differentially private fitting.
+
+Two workload classes over the existing IRLS machinery (ROADMAP item 4):
+
+  * **Robust pseudo-families** (:mod:`.pseudo`) — ``quantile(tau)``,
+    ``huber(k)``, ``l1``, ``linf`` as reweighting rules on the shared
+    Fisher-scoring row recipe (ops/fused.py::irls_weights), with an
+    epsilon-smoothing schedule that shrinks each IRLS pass inside the
+    compiled while_loop (arXiv 1902.06391).  They ride the ordinary
+    ``family=`` argument everywhere: ``sg.glm``, ``glm_from_csv``
+    streaming, ``glm_fleet`` (per-tenant p99 models in one batched
+    pass), and the online loop.
+  * **Tau-path driver** (:mod:`.taupath`) — the whole tau grid advances
+    SIMULTANEOUSLY through one batched IRLS loop on ONE shared design
+    (every pass is one fused data sweep for all taus), returning a
+    :class:`TauPath`.
+  * **Privacy layer** (:mod:`.privacy`) — ``DPSpec(epsilon, delta,
+    clip)``: per-chunk row clipping + calibrated Gaussian noise on the
+    streamed Gramian/score with a zCDP-composed (ε, δ) accountant
+    (arXiv 1605.07511).  ``privacy=None`` stays bit-identical to the
+    plain streaming path.
+"""
+
+from .privacy import DPSpec, ZCDPAccountant
+from .pseudo import (HUBER_K_DEFAULT, Smoothing, huber_family, l1_family,
+                     linf_family, quantile_family, robust_family,
+                     robust_spec)
+from .taupath import TauPath, quantile_tau_path
+
+__all__ = [
+    "Smoothing", "HUBER_K_DEFAULT", "quantile_family", "huber_family",
+    "l1_family", "linf_family", "robust_family", "robust_spec",
+    "quantile_tau_path", "TauPath", "DPSpec", "ZCDPAccountant",
+]

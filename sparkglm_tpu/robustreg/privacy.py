@@ -1,0 +1,155 @@
+"""Differentially private Gramian releases for the streaming drivers.
+
+Mechanism (the classic DP-IRLS / DP-OLS recipe, zCDP-composed following
+arXiv 1605.07511): each streaming pass releases the accumulated
+``(X'WX, X'Wz)`` once.  Before accumulation every row is clipped — the
+augmented row ``u_i = sqrt(w_i) * [x_i, z_i]`` is scaled so
+``||u_i|| <= clip`` (equivalently ``w_i`` is scaled by
+``min(1, clip/||u_i||)^2``, which clips the Gramian, the score, AND the
+working response coherently) — so one row's add/remove changes the
+released rank-one term ``u_i u_i'`` by at most ``clip^2`` in Frobenius
+norm.  The release then gets symmetric Gaussian noise of scale
+``sigma = clip^2 * sqrt(k / (2 rho))`` for ``k`` total releases, i.e.
+each release is ``(rho/k)``-zCDP and the whole fit ``rho``-zCDP, which
+converts to ``(epsilon, delta)``-DP via
+
+    epsilon(rho, delta) = rho + 2 sqrt(rho ln(1/delta)).
+
+Calibration inverts that conversion exactly:
+``rho = (sqrt(L + eps) - sqrt(L))^2`` with ``L = ln(1/delta)``.
+
+The release schedule is FIXED at ``1 + max_iter`` passes (the init pass
+plus every budgeted IRLS pass): a data-dependent stopping time is itself
+a release, so DP fits never early-stop and never run the exact
+post-fit statistics passes (deviance/AIC/null deviance report NaN).
+``privacy=None`` takes none of these code paths — the plain chunk
+kernels' jaxprs are untouched, so results stay bit-identical.
+
+Noise is drawn host-side from a deterministic ``(seed, release)``
+counter stream, so a DP fit is reproducible given its ``DPSpec``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["DPSpec", "ZCDPAccountant", "calibrate_sigma", "dp_clip_weights",
+           "dp_noise_pair"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DPSpec:
+    """A differential-privacy budget for one streaming fit.
+
+    ``epsilon``/``delta`` are the TOTAL (eps, delta)-DP guarantee over
+    the whole fit (every pass composed, zCDP accounting); ``clip`` is
+    the row clipping norm in the augmented ``sqrt(w)[x, z]`` space —
+    response units, so scale it like ``~sqrt(p) * typical |x|``.
+    ``seed`` makes the noise stream reproducible."""
+    epsilon: float
+    delta: float
+    clip: float
+    seed: int = 0
+
+    def __post_init__(self):
+        if not self.epsilon > 0:
+            raise ValueError(f"epsilon must be positive, got {self.epsilon!r}")
+        if not 0 < self.delta < 1:
+            raise ValueError(f"delta must be in (0, 1), got {self.delta!r}")
+        if not self.clip > 0:
+            raise ValueError(f"clip must be positive, got {self.clip!r}")
+
+
+class ZCDPAccountant:
+    """zero-Concentrated DP composition ledger (arXiv 1605.07511).
+
+    zCDP composes ADDITIVELY: k releases of rho/k each are rho-zCDP
+    total, with the tight Gaussian-mechanism conversion to (eps, delta).
+    The accountant tracks spent rho and converts on demand."""
+
+    def __init__(self, delta: float):
+        if not 0 < delta < 1:
+            raise ValueError(f"delta must be in (0, 1), got {delta!r}")
+        self.delta = float(delta)
+        self.rho = 0.0
+        self.releases = 0
+
+    @staticmethod
+    def epsilon_of(rho: float, delta: float) -> float:
+        """(eps, delta) cost of ``rho``-zCDP: rho + 2 sqrt(rho ln(1/delta))."""
+        if rho < 0:
+            raise ValueError(f"rho must be non-negative, got {rho!r}")
+        return rho + 2.0 * math.sqrt(rho * math.log(1.0 / delta))
+
+    @staticmethod
+    def rho_for(epsilon: float, delta: float) -> float:
+        """Largest rho whose (eps, delta) conversion fits the budget —
+        the EXACT inverse of :meth:`epsilon_of` (quadratic in sqrt(rho)):
+        rho = (sqrt(L + eps) - sqrt(L))^2, L = ln(1/delta)."""
+        if not epsilon > 0:
+            raise ValueError(f"epsilon must be positive, got {epsilon!r}")
+        L = math.log(1.0 / delta)
+        return (math.sqrt(L + epsilon) - math.sqrt(L)) ** 2
+
+    def spend(self, rho: float) -> None:
+        if rho < 0:
+            raise ValueError(f"rho must be non-negative, got {rho!r}")
+        self.rho += float(rho)
+        self.releases += 1
+
+    def epsilon(self) -> float:
+        """Total (eps, self.delta)-DP spent so far."""
+        return self.epsilon_of(self.rho, self.delta)
+
+
+def calibrate_sigma(spec: DPSpec, releases: int) -> dict:
+    """Noise scale for ``releases`` equal Gaussian releases of Frobenius
+    sensitivity ``clip^2`` under ``spec``'s total budget.
+
+    Per release: rho_1 = Delta^2 / (2 sigma^2) with Delta = clip^2, so
+    ``sigma = clip^2 * sqrt(releases / (2 rho))``.  Returns the full
+    calibration record that lands in ``fit_info["privacy"]``."""
+    if releases < 1:
+        raise ValueError(f"releases must be >= 1, got {releases!r}")
+    rho = ZCDPAccountant.rho_for(spec.epsilon, spec.delta)
+    sigma = spec.clip ** 2 * math.sqrt(releases / (2.0 * rho))
+    return dict(mechanism="gaussian-zcdp", epsilon=float(spec.epsilon),
+                delta=float(spec.delta), clip=float(spec.clip),
+                seed=int(spec.seed), releases=int(releases),
+                rho=float(rho), rho_per_release=float(rho / releases),
+                sigma=float(sigma),
+                # the conversion round-trips: what the spent rho costs
+                epsilon_spent=float(ZCDPAccountant.epsilon_of(
+                    rho, spec.delta)))
+
+
+def dp_clip_weights(Xc, zc, wc, clip):
+    """Per-row clipped weights: ``w * min(1, clip/||u||)^2`` for the
+    augmented row ``u = sqrt(w)[x, z]`` — a jnp expression the streaming
+    DP chunk passes fold into their Gramian, leaving the plain passes'
+    jaxprs untouched.  Rows with ``w = 0`` (padding) stay 0."""
+    rn2 = jnp.sum(Xc * Xc, axis=1) + zc * zc       # ||[x, z]||^2
+    u2 = wc * rn2                                  # ||u||^2
+    c = jnp.minimum(1.0, clip / jnp.sqrt(jnp.maximum(u2, 1e-30)))
+    return wc * c * c
+
+
+def dp_noise_pair(XtWX: np.ndarray, XtWz: np.ndarray, sigma: float,
+                  seed: int, release: int):
+    """Add one release's symmetric Gaussian noise, host-side f64.
+
+    The (p x p) block gets iid N(0, sigma^2) on the upper triangle
+    mirrored below (the release must stay symmetric for the Cholesky
+    solve); the score gets iid N(0, sigma^2).  The ``(seed, release)``
+    counter stream makes refits reproducible."""
+    rng = np.random.default_rng(np.random.SeedSequence(
+        entropy=int(seed) & ((1 << 63) - 1), spawn_key=(int(release),)))
+    p = XtWX.shape[0]
+    Z = rng.normal(0.0, sigma, size=(p, p))
+    Zs = np.triu(Z) + np.triu(Z, 1).T
+    zv = rng.normal(0.0, sigma, size=XtWz.shape)
+    return XtWX + Zs, XtWz + zv

@@ -1,0 +1,281 @@
+"""Robust pseudo-families: quantile / Huber / l1 / linf as IRLS reweighting.
+
+The whole engine is one observation (arXiv 1902.06391): minimizing a
+loss ``sum_i wt_i rho(y_i - mu_i)`` by iteratively reweighted least
+squares needs only the multiplicative weight ``m(r) = psi(r)/r`` (psi =
+rho') applied on top of the gaussian Fisher weight.  With the smoothed
+absolute value ``|r|_eps = sqrt(r^2 + eps^2)``:
+
+  ==========  ================================  =========================
+  family      rho_eps(r)                        m(r) = psi/r
+  ==========  ================================  =========================
+  quantile    q(r) |r|_eps,  q = tau / (1-tau)  q(r) / |r|_eps
+  l1          |r|_eps                           1 / |r|_eps
+  huber       |r|_eps^2/2 or k|r|_eps - k^2/2   min(1, k / |r|_eps)
+  linf        softmax-weighted mean of |r|_eps  softmax_i / |r|_eps
+  ==========  ================================  =========================
+
+Each family carries ``param = (shape, eps, factor, eps_min)`` as a
+TRACED 4-vector; ``models/glm._irls_core`` shrinks ``eps`` each IRLS
+pass (``eps_t = max(eps0 * factor^t, eps_min)``) INSIDE its compiled
+while_loop, and the streaming driver shrinks it per host pass.  The
+``robust`` callable sits in the Family static key, so every (tau, k,
+schedule) value shares one executable per rule.
+
+Reported semantics (documented in PARITY.md): ``deviance`` is the EXACT
+(eps-free) robust loss ``2 sum wt rho(r)`` recomputed in host f64
+(``linf``: the max |r| itself); loglik/AIC/null deviance are NaN;
+std_errors come from the final smoothed working Gramian (pseudo-SEs —
+not the asymptotic sandwich).  ``huber(k)`` takes an ABSOLUTE k in
+response units (MASS::rlm re-estimates scale each iteration; match it
+by passing ``k = 1.345 * sigma_hat``).
+
+The ``linf`` softmax is row-GLOBAL (it needs every residual), so linf
+fits are resident/fleet only — the streaming driver refuses it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..families.families import Family
+
+__all__ = ["Smoothing", "HUBER_K_DEFAULT", "quantile_family",
+           "huber_family", "l1_family", "linf_family", "robust_family",
+           "robust_spec", "SMOOTHING_DEFAULT"]
+
+# MASS::rlm's default Huber tuning constant (for unit scale)
+HUBER_K_DEFAULT = 1.345
+
+_TINY = 1e-30
+
+
+@dataclasses.dataclass(frozen=True)
+class Smoothing:
+    """The eps-smoothing schedule: start at ``eps0`` (ABSOLUTE, in
+    response units), multiply by ``factor`` each IRLS pass, floor at
+    ``eps_min`` — convergence is only declared once the floor is
+    reached, so the reported solution always belongs to the eps_min
+    loss.  The defaults walk 0.1 -> 1e-6 in 17 passes."""
+    eps0: float = 0.1
+    factor: float = 0.5
+    eps_min: float = 1e-6
+
+    def __post_init__(self):
+        if not (self.eps0 > 0 and 0 < self.factor < 1
+                and 0 < self.eps_min <= self.eps0):
+            raise ValueError(
+                "Smoothing needs eps0 > 0, 0 < factor < 1, "
+                f"0 < eps_min <= eps0; got {self!r}")
+
+
+SMOOTHING_DEFAULT = Smoothing()
+
+
+def _abs_eps(r, eps):
+    return jnp.sqrt(r * r + eps * eps)
+
+
+# ---- reweighting rules m(r) = psi(r)/r --------------------------------------
+# Module-level (never closures): Family hashes by these callables, so all
+# quantile families share one compiled kernel regardless of tau/schedule.
+
+def _quantile_robust(y, mu, wt, param):
+    r = y - mu
+    q = jnp.where(r >= 0, param[0], 1.0 - param[0])
+    return q / _abs_eps(r, param[1])
+
+
+def _l1_robust(y, mu, wt, param):
+    return 1.0 / _abs_eps(y - mu, param[1])
+
+
+def _huber_robust(y, mu, wt, param):
+    a = _abs_eps(y - mu, param[1])
+    return jnp.minimum(1.0, param[0] / jnp.maximum(a, _TINY))
+
+
+def _masked_softmax(a, valid):
+    a = jnp.where(valid, a, -jnp.inf)
+    e = jnp.where(valid, jnp.exp(a - jnp.max(a)), 0.0)
+    return e / jnp.maximum(jnp.sum(e), _TINY)
+
+
+def _linf_temp(a, valid, param):
+    # RELATIVE temperature T = eps * max|r|_eps: an absolute temperature
+    # hardens the softmax onto ONE row as soon as residuals dwarf eps
+    # (rank-1 weighted Gramian -> singular solve); scaling by the current
+    # max keeps the weight spread over every row within ~eps of the max —
+    # which near the optimum is the Chebyshev equioscillation set (p+1
+    # rows), exactly the support minimax IRLS needs
+    amax = jnp.max(jnp.where(valid, a, 0.0))
+    return param[1] * jnp.maximum(amax, _TINY)
+
+
+# uniform weight-mass floor mixed into the linf softmax: mid-descent one
+# residual can lead the pack by enough that every other row's softmax
+# weight underflows, leaving a rank-1 weighted Gramian.  The floor is a
+# 0.1% L1 admixture to the minimax objective (documented in PARITY.md).
+_LINF_FLOOR = 1e-3
+
+
+def _linf_mix(a, valid, param):
+    # softmax + uniform floor, jointly normalized — the per-row mass the
+    # rule and the smoothed deviance BOTH use (consistent objective, so
+    # the post-schedule ascent guard never fights the weights)
+    sm = _masked_softmax(a / _linf_temp(a, valid, param), valid)
+    nv = jnp.maximum(jnp.sum(valid), 1).astype(a.dtype)
+    return (sm + jnp.where(valid, _LINF_FLOOR / nv, 0.0)) / (1.0 + _LINF_FLOOR)
+
+
+def _linf_robust(y, mu, wt, param):
+    # smoothed Chebyshev: d/dr [T * logsumexp(|r|/T)] concentrates the
+    # weight on the max-residual rows.  Row-GLOBAL, hence the wt>0
+    # mask (padding rows must not enter the normalization) — under the
+    # fleet vmap the reduction stays per-model.  IRLS solves are
+    # invariant to a uniform weight scale, so the normalization constant
+    # itself never moves beta.
+    a = _abs_eps(y - mu, param[1])
+    valid = wt > 0
+    return _linf_mix(a, valid, param) / jnp.maximum(a, _TINY)
+
+
+# ---- smoothed deviances (the in-loop convergence objective) -----------------
+
+def _quantile_dev(y, mu, wt, param):
+    r = y - mu
+    q = jnp.where(r >= 0, param[0], 1.0 - param[0])
+    return 2.0 * wt * q * _abs_eps(r, param[1])
+
+
+def _l1_dev(y, mu, wt, param):
+    return 2.0 * wt * _abs_eps(y - mu, param[1])
+
+
+def _huber_dev(y, mu, wt, param):
+    a = _abs_eps(y - mu, param[1])
+    k = param[0]
+    rho = jnp.where(a <= k, 0.5 * a * a, k * a - 0.5 * k * k)
+    return 2.0 * wt * rho
+
+
+def _linf_dev(y, mu, wt, param):
+    # per-row terms summing to the softmax-weighted MEAN of |r|_eps — a
+    # smooth lower approximation of max|r| that sharpens as eps decays.
+    # wt scales the logits mask only: linf is a max, not a weighted sum.
+    a = _abs_eps(y - mu, param[1])
+    valid = wt > 0
+    return _linf_mix(a, valid, param) * a
+
+
+def _robust_variance(mu, param):
+    return jnp.ones_like(mu)
+
+
+def _robust_init_mu(y, wt, param):
+    # mu0 = y: the first pass sees r = 0 everywhere, so every rule
+    # degenerates to a CONSTANT weight — i.e. the first solve is plain
+    # OLS, the natural robust warm start
+    return y
+
+
+def _nan_aic(dev, ll, n, p, wt_sum):
+    return float("nan")
+
+
+def _make(name, shape, robust, dev, smoothing):
+    s = smoothing if smoothing is not None else SMOOTHING_DEFAULT
+    return Family(
+        name=name,
+        variance=_robust_variance,
+        dev_resids=dev,
+        init_mu=_robust_init_mu,
+        default_link="identity",
+        # dispersion := 1, so std_errors are sqrt(diag((X'WX)^-1)) at the
+        # final smoothed weights — pseudo-SEs, documented in PARITY.md
+        dispersion_fixed=True,
+        aic=_nan_aic,
+        param=(float(shape), float(s.eps0), float(s.factor),
+               float(s.eps_min)),
+        robust=robust,
+    )
+
+
+def quantile_family(tau: float, smoothing: Smoothing | None = None) -> Family:
+    """Pseudo-family minimizing the tau-quantile check loss."""
+    tau = float(tau)
+    if not 0.0 < tau < 1.0:
+        raise ValueError(f"tau must be in (0, 1), got {tau!r}")
+    return _make(f"quantile({tau:.10g})", tau, _quantile_robust,
+                 _quantile_dev, smoothing)
+
+
+def huber_family(k: float = HUBER_K_DEFAULT,
+                 smoothing: Smoothing | None = None) -> Family:
+    """Huber-loss pseudo-family with ABSOLUTE threshold ``k`` (response
+    units).  MASS::rlm's k=1.345 assumes unit scale — pass
+    ``k = 1.345 * sigma_hat`` for its semantics."""
+    k = float(k)
+    if not k > 0:
+        raise ValueError(f"huber k must be positive, got {k!r}")
+    return _make(f"huber({k:.10g})", k, _huber_robust, _huber_dev, smoothing)
+
+
+def l1_family(smoothing: Smoothing | None = None) -> Family:
+    """Least-absolute-deviations pseudo-family (= quantile(0.5) up to a
+    uniform weight scale, which IRLS solves are invariant to)."""
+    return _make("l1", 0.0, _l1_robust, _l1_dev, smoothing)
+
+
+# linf floors its RELATIVE temperature at 1e-3 (not 1e-6): the softmax
+# support must keep >= p rows at non-underflowing weight, and near the
+# optimum the equioscillation set sits within ~eps_min of the max
+LINF_SMOOTHING_DEFAULT = Smoothing(eps0=0.5, factor=0.5, eps_min=1e-3)
+
+
+def linf_family(smoothing: Smoothing | None = None) -> Family:
+    """Smoothed Chebyshev (minimax) pseudo-family.  Resident/fleet only:
+    the softmax weight is row-global, so streaming chunks cannot
+    evaluate it.  The smoothing eps here is a RELATIVE temperature
+    (scaled by the running max residual — see ``_linf_temp``), with its
+    own default schedule ``LINF_SMOOTHING_DEFAULT``."""
+    return _make("linf", 0.0, _linf_robust, _linf_dev,
+                 smoothing if smoothing is not None
+                 else LINF_SMOOTHING_DEFAULT)
+
+
+def robust_spec(name: str):
+    """Parse a robust family NAME into ``(kind, shape)`` — the single
+    parser for the formats the constructors above emit (get_family and
+    models/hoststats.py both route through here).  None for non-robust
+    names."""
+    if name.startswith("quantile(") and name.endswith(")"):
+        return "quantile", float(name[len("quantile("):-1])
+    if name == "huber":
+        return "huber", HUBER_K_DEFAULT
+    if name.startswith("huber(") and name.endswith(")"):
+        return "huber", float(name[len("huber("):-1])
+    if name in ("l1", "linf"):
+        return name, 0.0
+    return None
+
+
+def robust_family(name: str, smoothing: Smoothing | None = None) -> Family:
+    """Construct the robust family a name string denotes (the
+    ``family="quantile(0.9)"`` / ``family="huber"`` entry used by
+    ``families.get_family``)."""
+    spec = robust_spec(name)
+    if spec is None:
+        raise ValueError(
+            f"not a robust family name: {name!r} (expected 'quantile(<tau>)',"
+            " 'huber', 'huber(<k>)', 'l1' or 'linf')")
+    kind, shape = spec
+    if kind == "quantile":
+        return quantile_family(shape, smoothing)
+    if kind == "huber":
+        return huber_family(shape, smoothing)
+    if kind == "l1":
+        return l1_family(smoothing)
+    return linf_family(smoothing)

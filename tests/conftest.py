@@ -79,6 +79,11 @@ def pytest_configure(config):
         "worker-count bit-identity, column pruning, sharded-source "
         "resume, reader-death re-reads (`make ingest` selects these; "
         "still tier-1 by default)")
+    config.addinivalue_line(
+        "markers", "robustreg: robust/quantile pseudo-families, the "
+        "batched tau path, and differentially private Gramians (`make "
+        "robustreg` selects these; still tier-1 by default — distinct "
+        "from `robust`, the fault-tolerance suite)")
 
 
 @pytest.fixture(scope="session")

@@ -683,6 +683,7 @@ def main():
     cases["formula_cases"] = fcases
     cases["penalized_cases"] = penalized_cases()
     cases["sparse_cases"] = sparse_cases()
+    cases["robust_cases"] = robust_cases()
 
     out = os.path.join(HERE, "r_golden.json")
     with open(out, "w") as f:
@@ -821,6 +822,133 @@ def sparse_cases():
                        "S the densified sparse block")}
 
 
+# ---------------------------------------------------------------------------
+# robust/quantile oracle (independent of sparkglm_tpu)
+# ---------------------------------------------------------------------------
+
+def _quantile_lp(X, y, tau):
+    """EXACT quantile regression via the primal LP (scipy HiGHS):
+
+        min  tau 1'u + (1-tau) 1'v   s.t.  X b + u - v = y,  u, v >= 0
+
+    — a genuinely independent oracle: no IRLS, no smoothing, no shared
+    code with the epsilon-smoothed pseudo-family under test.  Returns
+    ``(beta, objective)`` with the objective the exact check loss
+    ``sum rho_tau(y - X beta)``."""
+    from scipy.optimize import linprog
+
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.float64)
+    n, p = X.shape
+    # variables: [b (free, split b+ - b-), u, v]
+    c = np.concatenate([np.zeros(2 * p), np.full(n, tau),
+                        np.full(n, 1.0 - tau)])
+    A_eq = np.hstack([X, -X, np.eye(n), -np.eye(n)])
+    res = linprog(c, A_eq=A_eq, b_eq=y, bounds=[(0, None)] * (2 * p + 2 * n),
+                  method="highs")
+    if not res.success:  # pragma: no cover - fixture generation guard
+        raise RuntimeError(f"quantile LP failed: {res.message}")
+    beta = res.x[:p] - res.x[p:2 * p]
+    r = y - X @ beta
+    obj = float(np.sum(np.where(r >= 0, tau * r, (tau - 1.0) * r)))
+    return beta, obj
+
+
+def _huber_irls(X, y, k, tol=1e-13, max_iter=500):
+    """Huber M-estimate at an ABSOLUTE threshold ``k`` (response units):
+    exact-weight IRLS ``w = min(1, k/|r|)`` on host f64 — independent of
+    the library's epsilon-smoothed rule, and convex, so both must land on
+    the same optimum.
+
+    NOTE this is NOT MASS::rlm's default: rlm rescales ``k`` by a robust
+    scale estimate (MAD/Huber proposal 2) re-estimated every iteration,
+    so its tuning constant is in sigma units.  The library's ``huber(k)``
+    pseudo-family deliberately takes ``k`` in RESPONSE units (no scale
+    estimation inside the compiled loop) — to reproduce an rlm fit, pass
+    ``k = 1.345 * sigma_hat`` yourself (PARITY.md)."""
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.float64)
+    beta = np.linalg.lstsq(X, y, rcond=None)[0]
+    for _ in range(max_iter):
+        r = y - X @ beta
+        a = np.abs(r)
+        w = np.where(a <= k, 1.0, k / np.maximum(a, 1e-300))
+        Xw = X * w[:, None]
+        new = np.linalg.solve(Xw.T @ X, Xw.T @ y)
+        if np.max(np.abs(new - beta)) <= tol * (1.0 + np.max(np.abs(beta))):
+            beta = new
+            break
+        beta = new
+    r = y - X @ beta
+    a = np.abs(r)
+    obj = float(np.sum(np.where(a <= k, 0.5 * r * r, k * a - 0.5 * k * k)))
+    return beta, obj
+
+
+def robust_cases():
+    """Quantile/Huber golden fits (host-f64, implementation-independent).
+    A fresh seeded stream like :func:`penalized_cases`, spliceable
+    standalone (``python gen_golden.py --splice-robust``) so the existing
+    cases stay byte-identical.
+
+    Two error regimes — symmetric gaussian and right-skewed (centered
+    exponential), where the tau levels genuinely separate — with tau in
+    {0.5, 0.9, 0.99} (the per-tenant p99 target) and Huber at the
+    classical 1.345 plus a wider 2.0.  Each entry stores the exact
+    minimizer AND the exact objective: the epsilon-smoothed fits under
+    test are compared on BOTH (coefficients within the documented
+    smoothing tolerance, objective within a near-optimality margin that
+    is robust to flat directions in the check loss)."""
+    prng = np.random.default_rng(20260807)
+    rcases = {}
+    n = 600
+    x1 = prng.standard_normal(n)
+    x2 = prng.uniform(-1.0, 1.0, n)
+    X = np.column_stack([np.ones(n), x1, x2])
+    errs = {
+        "gaussian": prng.standard_normal(n),
+        "skewed": prng.exponential(1.0, n) - 1.0,
+    }
+    for label, e in errs.items():
+        y = 1.0 + 0.8 * x1 - 0.5 * x2 + e
+        quant = {}
+        for tau in (0.5, 0.9, 0.99):
+            beta, obj = _quantile_lp(X, y, tau)
+            quant[f"tau_{tau:g}"] = dict(tau=tau,
+                                         coefficients=beta.tolist(),
+                                         objective=obj)
+        hub = {}
+        for k in (1.345, 2.0):
+            beta, obj = _huber_irls(X, y, k)
+            hub[f"k_{k:g}"] = dict(k=k, coefficients=beta.tolist(),
+                                   objective=obj)
+        rcases[f"robust_{label}"] = dict(
+            data=dict(y=y.tolist(), x1=x1.tolist(), x2=x2.tolist()),
+            formula="y ~ x1 + x2",
+            xnames=["intercept", "x1", "x2"],
+            quantile=quant, huber=hub,
+            provenance="synthetic; exact-LP quantile (scipy HiGHS primal) "
+                       "and exact-weight Huber IRLS, both host f64 and "
+                       "independent of the smoothed pseudo-families; R "
+                       "cross-check: quantreg::rq(y ~ x1 + x2, tau) and "
+                       "MASS::rlm(y ~ x1 + x2, k = <k>, scale.est = "
+                       "'fixed', scale = 1)")
+    return rcases
+
+
+def splice_robust():
+    """Rewrite ONLY the robust_cases key of the committed r_golden.json
+    (same byte-stability rationale as :func:`splice_penalized`)."""
+    out = os.path.join(HERE, "r_golden.json")
+    with open(out) as f:
+        cases = json.load(f)
+    cases["robust_cases"] = robust_cases()
+    with open(out, "w") as f:
+        json.dump(cases, f, indent=1)
+    print(f"spliced robust_cases "
+          f"({len(cases['robust_cases'])} cases) into {out}")
+
+
 def splice_sparse():
     """Rewrite ONLY the sparse_cases key of the committed r_golden.json
     (same byte-stability rationale as :func:`splice_penalized`)."""
@@ -853,5 +981,7 @@ if __name__ == "__main__":
         splice_penalized()
     elif "--splice-sparse" in sys.argv:
         splice_sparse()
+    elif "--splice-robust" in sys.argv:
+        splice_robust()
     else:
         main()

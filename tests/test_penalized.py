@@ -413,16 +413,28 @@ def test_streaming_rejects_unsupported(tmp_path):
     with pytest.raises(ValueError, match="prefetch"):
         sg.glm_from_csv(FORMULA6, path, family="binomial", penalty=pen,
                         prefetch=2)
+    # resume=True still needs a checkpoint= target to resume FROM
     with pytest.raises(ValueError, match="resume"):
         sg.lm_from_csv(FORMULA6, path, penalty=pen, resume=True)
-    # no path checkpoint format exists yet: checkpoint= is refused loudly
-    # rather than silently ignored ...
-    with pytest.raises(ValueError, match="checkpoint"):
-        sg.glm_from_csv(FORMULA6, path, family="binomial", penalty=pen,
-                        checkpoint=str(tmp_path / "c.npz"))
-    with pytest.raises(ValueError, match="checkpoint"):
-        sg.lm_from_csv(FORMULA6, path, penalty=pen,
-                       checkpoint=str(tmp_path / "c.npz"))
+
+
+def test_streaming_path_checkpoints(tmp_path):
+    """checkpoint= is LEGAL on the penalized streaming drivers: the GLM
+    path saves at every lambda boundary, the gaussian path after its one
+    Gramian data pass, and resume= reproduces the uninterrupted fit
+    bit-for-bit (the deep parity tests live in test_robustreg.py)."""
+    data = _sim(21)
+    pen = ElasticNet(alpha=0.6, n_lambda=6)
+    path = _write_csv(tmp_path, data)
+    ck = os.path.join(tmp_path, "path.npz")
+    full = sg.glm_from_csv(FORMULA6, path, family="binomial", penalty=pen,
+                           checkpoint=ck, config=F64)
+    assert os.path.exists(ck)
+    again = sg.glm_from_csv(FORMULA6, path, family="binomial", penalty=pen,
+                            checkpoint=ck, resume=True, config=F64)
+    np.testing.assert_array_equal(again.coefficients, full.coefficients)
+    np.testing.assert_array_equal(again.deviance, full.deviance)
+    np.testing.assert_array_equal(again.lambdas, full.lambdas)
 
 
 def test_streaming_path_honors_retry(tmp_path):

@@ -66,13 +66,14 @@ def _design(case):
     return X, y, kw
 
 
-# formula_cases / penalized_cases / sparse_cases are nested case GROUPS
-# with their own suites (test_r_golden_formula.py / test_penalized.py /
-# test_sketch.py), not flat cases
+# formula_cases / penalized_cases / sparse_cases / robust_cases are nested
+# case GROUPS with their own suites (test_r_golden_formula.py /
+# test_penalized.py / test_sketch.py / test_robustreg.py), not flat cases
 @pytest.mark.parametrize("name", sorted(k for k in GOLDEN
                                         if k not in ("formula_cases",
                                                      "penalized_cases",
-                                                     "sparse_cases")))
+                                                     "sparse_cases",
+                                                     "robust_cases")))
 def test_r_golden(name):
     case = GOLDEN[name]
     X, y, kw = _design(case)

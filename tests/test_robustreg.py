@@ -1,0 +1,590 @@
+"""Robust & private fitting (sparkglm_tpu/robustreg) — `make robustreg`.
+
+Four contract groups:
+
+  * ORACLE PARITY — ``sg.quantreg`` / ``family="huber(k)"`` against the
+    exact f64 oracles spliced into ``tests/fixtures/r_golden.json``
+    (``gen_golden.py --splice-robust``): an exact-LP quantile solve
+    (scipy HiGHS primal) and an exact-weight Huber IRLS, both genuinely
+    independent of the smoothed pseudo-families.  Coefficients agree
+    within the documented smoothing tolerance (PARITY.md "Robust
+    pseudo-families"); the sharper check is NEAR-OPTIMALITY — our
+    beta's exact loss sits within a hair of the oracle optimum, which
+    is robust to the flat directions extreme taus create.
+  * TAU PATH — the batched simultaneous-tau driver matches solo fits
+    and the oracle on the same grid; the ``TauPath`` surface.
+  * PRIVACY — the zCDP accountant's exact conversions, the calibration
+    record, ``privacy=None`` bit-identity, the fixed release schedule
+    (``1 + max_iter`` GLM / 1 LM ``dp_noise`` events), NaN statistics,
+    seeded reproducibility, and every composition refusal.
+  * COMPOSITION — streaming-vs-resident robust parity, fleet-vs-solo
+    quantile parity, the OnlineLoop driving a quantile fleet through a
+    gated deploy cycle, RetryingSource forwarding the sharded-source
+    surface, and mid-path checkpoint/resume bit-identity for the
+    penalized streaming drivers.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu.config import NumericConfig
+from sparkglm_tpu.obs import FitTracer, RingBufferSink
+from sparkglm_tpu.robustreg import (DPSpec, HUBER_K_DEFAULT, Smoothing,
+                                    TauPath, ZCDPAccountant, huber_family,
+                                    linf_family, quantile_family,
+                                    robust_family, robust_spec)
+from sparkglm_tpu.robustreg.privacy import calibrate_sigma
+
+pytestmark = pytest.mark.robustreg
+
+F64 = NumericConfig(dtype="float64")
+FIX = os.path.join(os.path.dirname(__file__), "fixtures", "r_golden.json")
+
+
+def _golden():
+    with open(FIX) as fh:
+        return json.load(fh)["robust_cases"]
+
+
+def _case_design(case):
+    d = {k: np.asarray(v, np.float64) for k, v in case["data"].items()}
+    X = np.column_stack([np.ones(len(d["y"])), d["x1"], d["x2"]])
+    return d, X, d["y"]
+
+
+def _check_loss(X, y, b, tau):
+    r = y - X @ b
+    return float(np.sum(np.where(r >= 0, tau * r, (tau - 1.0) * r)))
+
+
+def _huber_loss(X, y, b, k):
+    a = np.abs(y - X @ b)
+    return float(np.sum(np.where(a <= k, 0.5 * a * a, k * a - 0.5 * k * k)))
+
+
+# ---- oracle parity ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("cname", ["robust_gaussian", "robust_skewed"])
+def test_quantreg_matches_lp_oracle(cname):
+    """Solo quantile fits vs the exact-LP oracle: near-optimal exact
+    check loss (<= 1e-4 relative) and coefficient agreement within the
+    smoothing tolerance.  The reported deviance is 2x the exact
+    (eps-free) check loss by contract."""
+    case = _golden()[cname]
+    d, X, y = _case_design(case)
+    for qc in case["quantile"].values():
+        tau = qc["tau"]
+        m = sg.quantreg(case["formula"], d, tau=tau, max_iter=300,
+                        config=F64)
+        assert m.converged
+        assert m.family == f"quantile({tau:.10g})"
+        b = np.asarray(m.coefficients)
+        obj = _check_loss(X, y, b, tau)
+        assert obj >= qc["objective"] * (1.0 - 1e-9)  # oracle is optimal
+        assert obj - qc["objective"] <= 1e-4 * qc["objective"]
+        np.testing.assert_allclose(b, qc["coefficients"], atol=5e-2)
+        assert m.deviance == pytest.approx(2.0 * obj, rel=1e-5)
+        # pseudo-stat contract: loglik/AIC are NaN for robust fits
+        assert math.isnan(m.loglik) and math.isnan(m.aic)
+
+
+@pytest.mark.parametrize("cname", ["robust_gaussian", "robust_skewed"])
+def test_huber_matches_exact_irls_oracle(cname):
+    """``family="huber(k)"`` (ABSOLUTE k, response units) vs the
+    exact-weight Huber IRLS oracle — the smoothed optimum lands on the
+    exact one to near machine precision (the Huber loss is smooth at
+    the floor, unlike the check loss)."""
+    case = _golden()[cname]
+    d, X, y = _case_design(case)
+    for hc in case["huber"].values():
+        k = hc["k"]
+        m = sg.glm(case["formula"], d, family=f"huber({k:.10g})",
+                   config=F64)
+        assert m.converged
+        b = np.asarray(m.coefficients)
+        np.testing.assert_allclose(b, hc["coefficients"], atol=1e-8)
+        obj = _huber_loss(X, y, b, k)
+        assert abs(obj - hc["objective"]) <= 1e-9 * hc["objective"] + 1e-12
+
+
+def test_robust_family_parsing():
+    assert robust_spec("quantile(0.9)") == ("quantile", 0.9)
+    assert robust_spec("huber") == ("huber", HUBER_K_DEFAULT)
+    assert robust_spec("huber(2.5)") == ("huber", 2.5)
+    assert robust_spec("l1") == ("l1", 0.0)
+    assert robust_spec("gaussian") is None
+    assert robust_family("l1").name == "l1"
+    with pytest.raises(ValueError, match="not a robust family"):
+        robust_family("binomial")
+    with pytest.raises(ValueError, match="tau must be in"):
+        quantile_family(1.5)
+    with pytest.raises(ValueError, match="k must be positive"):
+        huber_family(-1.0)
+    with pytest.raises(ValueError, match="Smoothing needs"):
+        Smoothing(eps0=-0.1)
+    with pytest.raises(ValueError, match="Smoothing needs"):
+        Smoothing(eps0=1e-8, eps_min=1e-6)
+
+
+def test_l1_equals_median_quantile():
+    """l1 is quantile(0.5) up to a uniform weight scale IRLS is
+    invariant to — same coefficients on the same data."""
+    case = _golden()["robust_skewed"]
+    d, _, _ = _case_design(case)
+    m_l1 = sg.glm(case["formula"], d, family="l1", config=F64,
+                  max_iter=300)
+    m_q = sg.quantreg(case["formula"], d, tau=0.5, config=F64,
+                      max_iter=300)
+    np.testing.assert_allclose(np.asarray(m_l1.coefficients),
+                               np.asarray(m_q.coefficients), atol=1e-6)
+
+
+@pytest.mark.filterwarnings("ignore:IRLS did not converge")
+def test_linf_bounds_residuals(rng):
+    """Chebyshev fit: the minimax residual must undercut the OLS max
+    residual on data with asymmetric outliers."""
+    n = 300
+    x = rng.standard_normal(n)
+    y = 1.0 + 2.0 * x + rng.uniform(-1.0, 1.0, n)
+    y[:8] += 4.0  # one-sided outliers pull OLS, bound linf
+    m = sg.glm("y ~ x", {"y": y, "x": x}, family="linf", config=F64,
+               max_iter=300)
+    ols = sg.lm("y ~ x", {"y": y, "x": x}, config=F64)
+    X = np.column_stack([np.ones(n), x])
+    r_inf = np.max(np.abs(y - X @ np.asarray(m.coefficients)))
+    r_ols = np.max(np.abs(y - X @ np.asarray(ols.coefficients)))
+    assert r_inf < r_ols
+    # reported deviance for linf IS the max |r| (host f64, eps-free)
+    assert m.deviance == pytest.approx(r_inf, rel=1e-6)
+
+
+# ---- the batched tau path ---------------------------------------------------
+
+
+@pytest.mark.parametrize("cname", ["robust_gaussian", "robust_skewed"])
+def test_tau_path_matches_solo_and_oracle(cname):
+    case = _golden()[cname]
+    d, X, y = _case_design(case)
+    taus = [0.5, 0.9, 0.99]
+    tp = sg.quantreg(case["formula"], d, tau=taus, max_iter=300,
+                     config=F64)
+    assert isinstance(tp, TauPath)
+    assert tp.taus == tuple(taus)
+    assert tp.converged.all()
+    assert tp.xnames == ("intercept", "x1", "x2")
+    for qc in case["quantile"].values():
+        tau = qc["tau"]
+        coef = tp.coef(tau)
+        assert set(coef) == set(tp.xnames)
+        b = np.asarray([coef[nm] for nm in tp.xnames])
+        obj = _check_loss(X, y, b, tau)
+        assert obj - qc["objective"] <= 1e-4 * qc["objective"]
+        # batched path vs the solo fit: both are eps_min-smoothed optima
+        solo = sg.quantreg(case["formula"], d, tau=tau, max_iter=300,
+                           config=F64)
+        np.testing.assert_allclose(b, np.asarray(solo.coefficients),
+                                   atol=5e-2)
+        k = tp._index(tau)
+        assert tp.deviance[k] == pytest.approx(2.0 * obj, rel=1e-5)
+    with pytest.raises(KeyError, match="not on the fitted grid"):
+        tp.coef(0.42)
+
+
+def test_tau_path_grid_refusals():
+    case = _golden()["robust_gaussian"]
+    d, _, _ = _case_design(case)
+    with pytest.raises(ValueError, match="mesh=None"):
+        sg.quantreg(case["formula"], d, tau=[0.5, 0.9], mesh=object())
+    with pytest.raises(ValueError, match="non-empty"):
+        sg.quantreg(case["formula"], d, tau=[])
+
+
+# ---- privacy: accountant + calibration --------------------------------------
+
+
+def test_zcdp_accountant_conversions():
+    # rho_for is the EXACT inverse of epsilon_of
+    for eps in (0.25, 1.0, 4.0):
+        for delta in (1e-5, 1e-8):
+            rho = ZCDPAccountant.rho_for(eps, delta)
+            assert ZCDPAccountant.epsilon_of(rho, delta) == \
+                pytest.approx(eps, rel=1e-12)
+    # hand-checked point: L = ln(1e6), rho = (sqrt(L+1) - sqrt(L))^2
+    L = math.log(1e6)
+    assert ZCDPAccountant.rho_for(1.0, 1e-6) == \
+        pytest.approx((math.sqrt(L + 1) - math.sqrt(L)) ** 2)
+    acc = ZCDPAccountant(delta=1e-6)
+    assert acc.epsilon() == 0.0
+    acc.spend(0.01)
+    acc.spend(0.01)
+    assert acc.releases == 2
+    assert acc.rho == pytest.approx(0.02)
+    assert acc.epsilon() == pytest.approx(
+        ZCDPAccountant.epsilon_of(0.02, 1e-6))
+    with pytest.raises(ValueError, match="non-negative"):
+        acc.spend(-1.0)
+    with pytest.raises(ValueError, match="delta must be in"):
+        ZCDPAccountant(delta=2.0)
+    with pytest.raises(ValueError, match="epsilon must be positive"):
+        ZCDPAccountant.rho_for(0.0, 1e-6)
+
+
+def test_calibrate_sigma_record():
+    spec = DPSpec(epsilon=2.0, delta=1e-6, clip=3.0, seed=11)
+    rec = calibrate_sigma(spec, 6)
+    rho = ZCDPAccountant.rho_for(2.0, 1e-6)
+    assert rec["mechanism"] == "gaussian-zcdp"
+    assert rec["releases"] == 6
+    assert rec["rho"] == pytest.approx(rho)
+    assert rec["rho_per_release"] == pytest.approx(rho / 6)
+    assert rec["sigma"] == pytest.approx(9.0 * math.sqrt(6 / (2 * rho)))
+    # the spent rho converts back to exactly the requested budget
+    assert rec["epsilon_spent"] == pytest.approx(2.0, rel=1e-12)
+    # more releases under the same budget => more noise per release
+    assert calibrate_sigma(spec, 12)["sigma"] > rec["sigma"]
+    with pytest.raises(ValueError, match="releases"):
+        calibrate_sigma(spec, 0)
+
+
+def test_dpspec_validation():
+    with pytest.raises(ValueError, match="epsilon must be positive"):
+        DPSpec(epsilon=0.0, delta=1e-6, clip=1.0)
+    with pytest.raises(ValueError, match="delta must be in"):
+        DPSpec(epsilon=1.0, delta=1.0, clip=1.0)
+    with pytest.raises(ValueError, match="clip must be positive"):
+        DPSpec(epsilon=1.0, delta=1e-6, clip=0.0)
+
+
+# ---- privacy: streaming fits ------------------------------------------------
+
+
+def _dp_design(n=2000, seed=2):
+    rng = np.random.default_rng(seed)
+    X = np.column_stack([np.ones(n), rng.standard_normal((n, 2))])
+    eta = X @ np.array([0.3, 0.8, -0.5])
+    yb = (rng.uniform(size=n) < 1 / (1 + np.exp(-eta))).astype(np.float64)
+    yg = eta + rng.standard_normal(n)
+
+    def src(y):
+        def s():
+            for i in range(0, n, 500):
+                yield (X[i:i + 500], y[i:i + 500], None, None)
+        return s
+    return X, yb, yg, src
+
+
+def test_privacy_none_bit_identical():
+    """``privacy=None`` takes none of the DP code paths: byte-identical
+    coefficients to a call that never mentions privacy, and no privacy
+    record in fit_info."""
+    _, yb, yg, src = _dp_design()
+    plain = sg.glm_fit_streaming(src(yb), family="binomial", config=F64)
+    none = sg.glm_fit_streaming(src(yb), family="binomial", privacy=None,
+                                config=F64)
+    assert np.asarray(plain.coefficients).tobytes() == \
+        np.asarray(none.coefficients).tobytes()
+    assert "privacy" not in (none.fit_info or {})
+    lp = sg.lm_fit_streaming(src(yg), config=F64)
+    ln = sg.lm_fit_streaming(src(yg), privacy=None, config=F64)
+    assert np.asarray(lp.coefficients).tobytes() == \
+        np.asarray(ln.coefficients).tobytes()
+
+
+def test_dp_glm_streaming():
+    """A DP GLM fit: composed (eps, delta) recorded, the FIXED
+    ``1 + max_iter`` release schedule (one ``dp_noise`` event each),
+    NaN data-dependent statistics, seeded reproducibility."""
+    _, yb, _, src = _dp_design()
+    spec = DPSpec(epsilon=4.0, delta=1e-6, clip=2.0, seed=7)
+    ring = RingBufferSink(4096)
+    m = sg.glm_fit_streaming(src(yb), family="binomial", privacy=spec,
+                             max_iter=5, trace=FitTracer(sinks=[ring]),
+                             config=F64)
+    priv = m.fit_info["privacy"]
+    assert priv["epsilon"] == 4.0 and priv["delta"] == 1e-6
+    assert priv["releases"] == 6  # init pass + max_iter IRLS passes
+    assert priv["epsilon_spent"] == pytest.approx(4.0, rel=1e-12)
+    noise_ev = [e for e in ring.events if e.kind == "dp_noise"]
+    assert len(noise_ev) == 6
+    assert {e.fields["release"] for e in noise_ev} == set(range(6))
+    # a data-dependent stop would be an unaccounted release: DP fits run
+    # the whole budgeted schedule and report NaN exact statistics
+    assert not m.converged and m.iterations == 5
+    assert math.isnan(m.deviance) and math.isnan(m.loglik)
+    assert np.all(np.isnan(m.std_errors))
+    # deterministic (seed, release) noise stream: refits are identical,
+    # a different seed is not
+    m2 = sg.glm_fit_streaming(src(yb), family="binomial", privacy=spec,
+                              max_iter=5, config=F64)
+    assert np.asarray(m.coefficients).tobytes() == \
+        np.asarray(m2.coefficients).tobytes()
+    m3 = sg.glm_fit_streaming(
+        src(yb), family="binomial", max_iter=5, config=F64,
+        privacy=DPSpec(epsilon=4.0, delta=1e-6, clip=2.0, seed=8))
+    assert np.asarray(m.coefficients).tobytes() != \
+        np.asarray(m3.coefficients).tobytes()
+    # accuracy sanity at this generous budget: near the non-private fit
+    plain = sg.glm_fit_streaming(src(yb), family="binomial", max_iter=25,
+                                 config=F64)
+    np.testing.assert_allclose(np.asarray(m.coefficients),
+                               np.asarray(plain.coefficients), atol=0.1)
+
+
+def test_dp_lm_streaming():
+    """The one-pass LM release: a single noised Gramian (releases=1,
+    one dp_noise event), NaN summary statistics."""
+    _, _, yg, src = _dp_design()
+    ring = RingBufferSink(1024)
+    m = sg.lm_fit_streaming(
+        src(yg), privacy=DPSpec(epsilon=2.0, delta=1e-6, clip=3.0, seed=3),
+        trace=FitTracer(sinks=[ring]), config=F64)
+    priv = m.fit_info["privacy"]
+    assert priv["releases"] == 1
+    assert len([e for e in ring.events if e.kind == "dp_noise"]) == 1
+    assert math.isnan(m.r_squared) and np.all(np.isnan(m.std_errors))
+    plain = sg.lm_fit_streaming(src(yg), config=F64)
+    np.testing.assert_allclose(np.asarray(m.coefficients),
+                               np.asarray(plain.coefficients), atol=0.3)
+
+
+def test_dp_and_robust_refusals(tmp_path):
+    _, yb, yg, src = _dp_design(n=600)
+    spec = DPSpec(epsilon=1.0, delta=1e-6, clip=2.0)
+    with pytest.raises(ValueError, match="cannot combine with robust"):
+        sg.glm_fit_streaming(src(yb), family="quantile(0.5)",
+                             privacy=spec, config=F64)
+    with pytest.raises(ValueError, match="checkpoint/resume"):
+        sg.glm_fit_streaming(src(yb), family="binomial", privacy=spec,
+                             checkpoint=str(tmp_path / "ck.npz"),
+                             config=F64)
+    with pytest.raises(ValueError, match="checkpoint/resume"):
+        sg.lm_fit_streaming(src(yg), privacy=spec,
+                            checkpoint=str(tmp_path / "ck2.npz"),
+                            config=F64)
+    with pytest.raises(TypeError, match="DPSpec"):
+        sg.glm_fit_streaming(src(yb), family="binomial", privacy=1.0,
+                             config=F64)
+    with pytest.raises(ValueError, match="exact streaming engine"):
+        sg.glm_fit_streaming(src(yb), family="binomial", privacy=spec,
+                             engine="sketch", config=F64)
+    with pytest.raises(ValueError, match="cannot stream"):
+        sg.glm_fit_streaming(src(yb), family="linf", config=F64)
+    with pytest.raises(ValueError, match="engine='sketch'"):
+        sg.glm_fit_streaming(src(yb), family="quantile(0.5)",
+                             engine="sketch", config=F64)
+
+
+# ---- composition ------------------------------------------------------------
+
+
+def test_streaming_robust_matches_resident():
+    """The per-host-pass eps schedule (streaming) and the in-loop
+    schedule (resident) land on the same eps_min optimum."""
+    rng = np.random.default_rng(11)
+    n = 900
+    x = rng.standard_normal(n)
+    y = 0.5 + 1.2 * x + 0.4 * (rng.exponential(1.0, n) - 1.0)
+    res = sg.glm("y ~ x", {"y": y, "x": x}, family="quantile(0.9)",
+                 config=F64, max_iter=200)
+    X = np.column_stack([np.ones(n), x])
+
+    def src():
+        for i in range(0, n, 300):
+            yield (X[i:i + 300], y[i:i + 300], None, None)
+
+    stream = sg.glm_fit_streaming(src, family="quantile(0.9)", config=F64,
+                                  max_iter=200)
+    assert res.converged and stream.converged
+    np.testing.assert_allclose(np.asarray(stream.coefficients),
+                               np.asarray(res.coefficients), atol=1e-4)
+    assert stream.deviance == pytest.approx(res.deviance, rel=1e-5)
+
+
+def test_fleet_quantile_matches_solo():
+    """``glm_fleet(..., family="quantile", tau=)`` — each tenant's
+    batched fit agrees with its solo ``sg.quantreg`` (same pseudo-family,
+    same schedule; the vmapped kernel vs the sharded resident one)."""
+    rng = np.random.default_rng(3)
+    K, per = 4, 500
+    g = np.repeat([f"t{k}" for k in range(K)], per)
+    x = rng.standard_normal(K * per)
+    scale = np.repeat([0.5, 1.0, 1.5, 2.0], per)
+    y = 1.0 + 0.7 * x + scale * (rng.exponential(1.0, K * per) - 1.0)
+    data = {"y": y, "x": x, "tenant": g}
+    fleet = sg.glm_fleet("y ~ x", data, groups="tenant",
+                         family="quantile", tau=0.9, config=F64)
+    assert fleet["t0"].family == "quantile(0.9)"
+    for k in range(K):
+        m = g == f"t{k}"
+        solo = sg.quantreg("y ~ x", {"y": y[m], "x": x[m]}, tau=0.9,
+                           config=F64)
+        fc = np.asarray(fleet[f"t{k}"].coefficients)
+        np.testing.assert_allclose(fc, np.asarray(solo.coefficients),
+                                   atol=5e-4)
+
+
+def test_fleet_tau_misuse_refused():
+    data = {"y": np.arange(8.0), "x": np.arange(8.0),
+            "g": ["a"] * 4 + ["b"] * 4}
+    with pytest.raises(ValueError, match="not twice"):
+        sg.glm_fleet("y ~ x", data, groups="g", family="quantile(0.9)",
+                     tau=0.9)
+    with pytest.raises(ValueError, match="robust pseudo-family"):
+        sg.glm_fleet("y ~ x", data, groups="g", family="binomial",
+                     tau=0.9)
+
+
+@pytest.mark.filterwarnings("ignore:.*fleet members did not converge")
+def test_online_loop_refreshes_quantile_fleet():
+    """A quantile(0.9) fleet served through the online loop: drifted
+    tenants take the warm-refit path (no closed form for robust
+    families), pass the gate, and auto-deploy a new version."""
+    from sparkglm_tpu.fleet import glm_fit_fleet
+    from sparkglm_tpu.online import OnlineLoop
+    from sparkglm_tpu.serve import ModelFamily
+
+    P, K = 3, 4
+    labels = tuple(f"t{i}" for i in range(K))
+    rng = np.random.default_rng(5)
+    beta_a = rng.normal(size=(K, P))
+    beta_b = beta_a + 2.5
+
+    def chunk(beta, rows_per, seed):
+        r = np.random.default_rng(seed)
+        ten, Xs, ys = [], [], []
+        for k, t in enumerate(labels):
+            Xk = r.normal(size=(rows_per, P))
+            ten.extend([t] * rows_per)
+            Xs.append(Xk)
+            ys.append(Xk @ beta[k]
+                      + 0.3 * (r.exponential(1.0, rows_per) - 1.0))
+        return np.array(ten), np.concatenate(Xs), np.concatenate(ys)
+
+    X0 = rng.normal(size=(K, 64, P))
+    y0 = np.stack([X0[k] @ beta_a[k]
+                   + 0.3 * (rng.exponential(1.0, 64) - 1.0)
+                   for k in range(K)])
+    fleet = glm_fit_fleet(X0, y0, family="quantile(0.9)", link="identity",
+                          labels=labels)
+    fam = ModelFamily.from_fleet(fleet, "p90")
+    ring = RingBufferSink(4096)
+    loop = OnlineLoop(fam, rho=0.4, window_rows=64, drift_threshold=0.6,
+                      reference_chunks=2, window_chunks=2, min_count=4,
+                      watch_chunks=2, trace=ring)
+    assert not loop.is_closed_form  # robust => warm refit, never suffstat
+    for c in range(4):
+        out = loop.step(*chunk(beta_a, 16, 100 + c))
+        assert out["drifted"] == ()
+    deployed = ()
+    for c in range(4):
+        out = loop.step(*chunk(beta_b, 16, 200 + c))
+        deployed = deployed or out["deployed"]
+    assert deployed, "quantile fleet never redeployed under drift"
+    kinds = [e.kind for e in ring.events]
+    assert "refresh_end" in kinds and "auto_deploy" in kinds
+    assert all(fam.deployed_version(t) > 1 for t in deployed)
+
+
+def test_retrying_source_forwards_sharded_surface():
+    """robust/retry.py: wrapping a ShardedSource must come back as a
+    RetryingSource that FORWARDS subset/with_workers/__len__/
+    process_parallel (narrowing re-wraps, keeping retry), and streams
+    the identical chunks."""
+    from sparkglm_tpu.data.ingest import ShardedSource
+    from sparkglm_tpu.robust import (RetryPolicy, RetryingSource,
+                                     retrying_source)
+
+    rng = np.random.default_rng(9)
+    n, p, nchunks = 800, 3, 8
+    X = np.column_stack([np.ones(n), rng.standard_normal((n, p - 1))])
+    y = X @ np.array([0.2, 1.0, -0.7]) + rng.standard_normal(n)
+    rows = n // nchunks
+
+    def read_chunk(i):
+        s = i * rows
+        return (X[s:s + rows], y[s:s + rows], None, None)
+
+    base = ShardedSource(nchunks, read_chunk)
+    policy = RetryPolicy(max_retries=2, base_delay=0.0)
+    wrapped = retrying_source(base, policy)
+    assert isinstance(wrapped, RetryingSource)
+    assert len(wrapped) == nchunks
+    assert wrapped.process_parallel == base.process_parallel
+    sub = wrapped.subset([0, 2, 4])
+    assert isinstance(sub, RetryingSource) and len(sub) == 3
+    rebound = wrapped.with_workers(0)
+    assert isinstance(rebound, RetryingSource)
+    assert rebound.with_workers(1).process_parallel
+    # a plain generator factory still gets the generator wrapper
+    assert not isinstance(retrying_source(lambda: iter(()), policy),
+                          RetryingSource)
+    # and the wrapped source streams the same fit, byte for byte
+    ref = sg.lm_fit_streaming(base, config=F64)
+    out = sg.lm_fit_streaming(wrapped, config=F64)
+    assert np.asarray(ref.coefficients).tobytes() == \
+        np.asarray(out.coefficients).tobytes()
+
+
+def test_glm_path_midpath_resume_bit_identical(tmp_path):
+    """Penalized streaming checkpoint/resume: kill the fit mid-path
+    (after a few lambda boundaries), resume, and match the
+    uninterrupted run bit for bit."""
+    from sparkglm_tpu.penalized import ElasticNet
+    from sparkglm_tpu.penalized import stream as pen_stream
+
+    rng = np.random.default_rng(7)
+    n, p = 1200, 6
+    X = np.column_stack([np.ones(n), rng.standard_normal((n, p))])
+    beta = np.array([-0.3, 1.0, -0.5, 0, 0, 0.8, 0])
+    eta = X @ beta
+    yb = (rng.uniform(size=n) < 1 / (1 + np.exp(-eta))).astype(np.float64)
+    xnames = ("(Intercept)",) + tuple(f"x{i}" for i in range(p))
+
+    def factory():
+        for i in range(0, n, 300):
+            yield (X[i:i + 300], yb[i:i + 300], None, None)
+
+    class Bomb(Exception):
+        pass
+
+    def bomb_factory():
+        count = [0]
+
+        def src():
+            for i in range(0, n, 300):
+                count[0] += 1
+                if count[0] > 60:  # several lambdas in, then die
+                    raise Bomb("interrupted")
+                yield (X[i:i + 300], yb[i:i + 300], None, None)
+        return src
+
+    gkw = dict(family="binomial", penalty=ElasticNet(alpha=0.6, n_lambda=8),
+               xnames=xnames, has_intercept=True, config=F64)
+    ref = pen_stream.glm_path_streaming(factory, **gkw)
+    ck = str(tmp_path / "glm_path.npz")
+    with pytest.raises(Bomb):
+        pen_stream.glm_path_streaming(bomb_factory(), checkpoint=ck, **gkw)
+    st = np.load(ck)
+    k_saved = int(st["k"])
+    st.close()
+    assert 0 < k_saved < 8  # genuinely mid-path
+    res = pen_stream.glm_path_streaming(factory, checkpoint=ck,
+                                        resume=True, **gkw)
+    np.testing.assert_array_equal(np.asarray(res.coefficients),
+                                  np.asarray(ref.coefficients))
+    np.testing.assert_array_equal(np.asarray(res.deviance),
+                                  np.asarray(ref.deviance))
+    np.testing.assert_array_equal(np.asarray(res.lambdas),
+                                  np.asarray(ref.lambdas))
+    # resuming under a different family is an identity violation
+    with pytest.raises(ValueError, match="binomial/logit path"):
+        pen_stream.glm_path_streaming(
+            factory, family="poisson", link="log",
+            penalty=ElasticNet(alpha=0.6, n_lambda=8), xnames=xnames,
+            has_intercept=True, config=F64, checkpoint=ck, resume=True)
