@@ -8,8 +8,9 @@ CXXFLAGS ?= -O3 -std=c++17 -Wall -Wextra
 SO := sparkglm_tpu/data/_libsparkglm_io.so
 
 .PHONY: all native test bench robust obs pipeline serve serve_async \
-        categorical penalized elastic sketch fleet hotloop online \
-        obsplane chaos elastic_tenancy observatory ingest robustreg clean
+        categorical penalized elastic sketch fleet fleet_lattice hotloop \
+        online obsplane chaos elastic_tenancy observatory ingest robustreg \
+        clean
 
 all: native
 
@@ -88,6 +89,16 @@ sketch:
 # the fleet_fit bench block (fleet vs K sequential solo fits s/model)
 fleet:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fleet
+	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
+
+# the capability lattice + PR 20 fleet scale axes (sparkglm_tpu/
+# capabilities.py, fleet/path.py, fleet/kernel.py): exhaustive
+# fit-or-pointed-error walk of every design x engine x penalty x execution
+# cell, penalized-fleet bit-identity vs solo lambda paths, sketch-fleet
+# seed parity, mesh-fleet bit-identity + serialization byte-identity —
+# plus the fleet_lambda_path and fleet_mesh_scaling bench blocks
+fleet_lattice:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fleet_lattice
 	SPARKGLM_BENCH_NO_TUNNEL=1 BENCH_FORCE_CPU=1 python bench.py
 
 # resident IRLS hot loop (sparkglm_tpu/ops/fused.py v2 + ops/autotune.py):

@@ -18,7 +18,9 @@ from .api import (TermsPrediction, confint_profile, glm, glm_fleet,
                   glm_from_csv, glm_from_json, glm_from_parquet, glm_nb, lm,
                   lm_from_csv, lm_from_json, lm_from_parquet, online_fleet,
                   predict, quantreg, update)
-from .fleet import FleetModel, fit_many, glm_fit_fleet
+from .capabilities import CapabilityError, capability_lattice, capability_refusal
+from .fleet import (FleetModel, FleetPathModel, fit_many, glm_fit_fleet,
+                    glm_fit_fleet_path)
 from .data.json import read_json, scan_json_levels, scan_json_schema
 from .data.parquet import (read_parquet, scan_parquet_levels,
                            scan_parquet_schema)
@@ -99,6 +101,8 @@ __all__ = [
     "serve", "ModelRegistry", "Scorer", "MicroBatcher", "BatchPolicy",
     "AsyncEngine", "EnginePolicy", "ReplicatedScorer",
     "fleet", "fit_many", "glm_fit_fleet", "glm_fleet", "FleetModel",
+    "FleetPathModel", "glm_fit_fleet_path",
+    "CapabilityError", "capability_lattice", "capability_refusal",
     "ModelFamily", "FamilyScorer",
     "online", "online_fleet", "OnlineLoop", "OnlineSuffStats", "DriftGate",
     "robustreg", "quantreg", "quantile_tau_path", "TauPath",

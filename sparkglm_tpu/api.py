@@ -163,105 +163,36 @@ def _design(formula: str, data, *, na_omit: bool, dtype, extra_cols=(),
 def _reject_penalty_args(*, mesh=None, engine="auto", beta0=None,
                          on_iteration=None, checkpoint_every=0,
                          prefetch=0):
-    """Penalized paths run their own compiled solvers; the options that
-    parameterize the unpenalized IRLS/solve machinery have no meaning
-    there.  Refuse them loudly rather than silently ignoring them.
-    (``retry=`` is NOT rejected: the penalized streaming drivers honor it
-    on every chunk pass.  ``checkpoint=``/``resume=`` are NOT rejected
-    either: the drivers checkpoint at lambda-path boundaries — after each
-    grid point for GLM paths, after the single Gramian data pass for
-    gaussian paths — and resume bit-identically; see penalized/stream.py.)"""
-    if mesh is not None:
-        raise ValueError("penalty= does not support mesh= (sharded "
-                         "penalized fits are not implemented yet)")
-    if engine == "sketch":
-        raise ValueError(
-            "penalty= does not support engine='sketch': the coordinate-"
-            "descent lambda path screens and checks KKT conditions against "
-            "exact Gramian columns, and a sketched X'WX would bias every "
-            "one of them — fit the penalized path with engine='auto'")
-    if engine not in ("auto", "einsum"):
-        raise ValueError(
-            f"penalty= requires the einsum/structured Gramian engine; "
-            f"engine={engine!r} does not apply to the penalized path")
-    if beta0 is not None or on_iteration is not None or checkpoint_every:
-        raise ValueError("penalty= does not support beta0=/on_iteration=/"
-                         "checkpoint_every= (the path warm-starts itself)")
-    if prefetch:
-        raise ValueError("penalty= does not support prefetch= yet (path "
-                         "passes stream sequentially)")
+    """Thin wrapper over the declarative capability table
+    (sparkglm_tpu/capabilities.py) — the single place every refusal is
+    declared.  Raises :class:`~sparkglm_tpu.capabilities.CapabilityError`
+    (a ValueError) with the pointed reason."""
+    from .capabilities import check_penalized
+    check_penalized(mesh=mesh, engine=engine, beta0=beta0,
+                    on_iteration=on_iteration,
+                    checkpoint_every=checkpoint_every, prefetch=prefetch)
 
 
 def _reject_elastic_args(*, penalty=None, beta0=None, on_iteration=None,
                          resume=False, engine="elastic"):
-    """Options that conflict with the elastic shard scheduler.  Everything
-    else (retry=, checkpoint=, prefetch=, trace=, metrics=, mesh=) flows
-    through to the shard fits."""
-    if engine == "sketch":
-        raise ValueError(
-            "workers= (the elastic shard scheduler) does not support "
-            "engine='sketch': the one-shot shard combine is Gramian-"
-            "additive and needs exact per-shard X'WX — drop workers= to "
-            "stream a sketched fit on a single controller")
-    if penalty is not None:
-        raise ValueError(
-            "penalty= does not support engine='elastic' (the lambda path "
-            "has no shard combine rule yet); fit the penalized path on a "
-            "single controller")
-    if beta0 is not None or on_iteration is not None:
-        raise ValueError(
-            "engine='elastic' does not support beta0=/on_iteration= (the "
-            "combine step warm-starts the polish pass itself)")
-    if resume:
-        raise ValueError(
-            "engine='elastic' resumes implicitly from the checkpoint= "
-            "shard directory after a restart; drop resume=")
+    """Thin wrapper over capabilities.check_elastic (see
+    ``_reject_penalty_args``)."""
+    from .capabilities import check_elastic
+    check_elastic(penalty=penalty, beta0=beta0, on_iteration=on_iteration,
+                  resume=resume, engine=engine)
 
 
 def _reject_fleet_args(*, engine="auto", penalty=None, design="dense",
                        mesh=None, beta0=None, on_iteration=None,
-                       checkpoint_every=0):
-    """Options that have no meaning on the fleet path — each per-segment
-    model is a small single-device IRLS mapped over the model axis, so the
-    solo fit's scale-out machinery does not apply.  Refuse loudly rather
-    than silently ignoring (same contract as ``_reject_penalty_args``)."""
-    if engine == "sketch":
-        raise ValueError(
-            "fleet fitting does not support engine='sketch': per-segment "
-            "models are SMALL (the whole point of batching them), so a "
-            "sketched Gramian would trade exactness for a speedup that "
-            "isn't there — fit the fleet with engine='auto'")
-    if engine == "elastic":
-        raise ValueError(
-            "fleet fitting does not support engine='elastic': the fleet "
-            "kernel already IS the parallel axis (one executable over all "
-            "models); shard-parallel workers would nest parallelism to no "
-            "benefit — drop engine='elastic'")
-    if engine not in ("auto", "einsum"):
-        raise ValueError(
-            f"fleet fitting requires the einsum Gramian engine; "
-            f"engine={engine!r} does not apply to the fleet path")
-    if penalty is not None:
-        raise ValueError(
-            "fleet fitting does not support penalty= (no batched lambda-"
-            "path kernel yet); fit penalized models one segment at a time "
-            "with glm(..., penalty=...)")
-    if design == "structured":
-        raise ValueError(
-            "fleet fitting does not support design='structured': the "
-            "segment-sum Gramian engine batches over factor levels, which "
-            "conflicts with batching over the model axis — use the dense "
-            "design (per-segment models are narrow)")
-    if mesh is not None:
-        raise ValueError(
-            "fleet fitting does not support mesh= (each per-segment model "
-            "is single-device; the model axis is the parallel dimension)")
-    if beta0 is not None or on_iteration is not None or checkpoint_every:
-        raise ValueError(
-            "fleet fitting does not support beta0=/on_iteration=/"
-            "checkpoint_every= (the fleet kernel runs all models to "
-            "convergence in one pass) — to warm-start a refit pass "
-            "stacked (K, p) coefficients via start= instead")
+                       checkpoint_every=0, start=None):
+    """Thin wrapper over capabilities.check_fleet (see
+    ``_reject_penalty_args``).  Since PR 20 ``engine='sketch'``,
+    ``penalty=`` and ``mesh=`` are LEGAL fleet axes; what remains refused
+    lives in the capability table."""
+    from .capabilities import check_fleet
+    check_fleet(engine=engine, penalty=penalty, design=design, mesh=mesh,
+                beta0=beta0, on_iteration=on_iteration,
+                checkpoint_every=checkpoint_every, start=start)
 
 
 def lm(formula: str, data, *, weights=None, offset=None,
@@ -446,10 +377,20 @@ def glm_fleet(formula: str, data, *, groups, family="binomial", link=None,
 
     ``batch``/``bucket`` tune the fleet kernel (see fleet/); ``start``
     warm-starts every member from stacked (K, p) coefficients in group
-    order — the online refresh path (``sparkglm_tpu/online``).  Solo-fit
-    scale-out options (``engine='sketch'/'elastic'``, ``penalty=``,
-    ``design='structured'``, ``mesh=``, ``beta0=``/checkpoint hooks) do
-    not apply and are rejected loudly.
+    order — the online refresh path (``sparkglm_tpu/online``).
+
+    Three orthogonal scale axes compose here (PR 20):
+    ``penalty=ElasticNet(...)`` fits one elastic-net lambda path per
+    group in a single batched kernel call and returns a
+    :class:`~sparkglm_tpu.fleet.FleetPathModel`;
+    ``mesh=`` shards the MODEL axis over the device mesh (K=thousands in
+    one pass — ``sg.make_mesh()``); ``engine="sketch"`` runs the r13
+    sketched Gramian per member for wide per-tenant designs (same seed
+    semantics as the solo fit; NaN standard errors).  Combinations with
+    no implementation (penalty + sketch/mesh, ``engine='elastic'``,
+    ``design='structured'``, ``beta0=``/checkpoint hooks) are refused
+    through the central capability table
+    (:mod:`sparkglm_tpu.capabilities`).
 
     ``family="quantile", tau=0.99`` fits one conditional-quantile model
     per tenant in the same batched kernel call — the per-tenant p99
@@ -459,7 +400,7 @@ def glm_fleet(formula: str, data, *, groups, family="binomial", link=None,
     """
     _reject_fleet_args(engine=engine, penalty=penalty, design=design,
                        mesh=mesh, beta0=beta0, on_iteration=on_iteration,
-                       checkpoint_every=checkpoint_every)
+                       checkpoint_every=checkpoint_every, start=start)
     if tau is not None or smoothing is not None:
         if not (isinstance(family, str)
                 and family.split("(")[0] in ("quantile", "huber",
@@ -511,7 +452,8 @@ def glm_fleet(formula: str, data, *, groups, family="binomial", link=None,
         group_name=group_name, family=family, link=link, tol=tol,
         max_iter=max_iter, criterion=criterion, xnames=terms.xnames,
         yname=f.response, has_intercept=f.intercept, batch=batch,
-        bucket=bucket, start=start, verbose=verbose, trace=trace,
+        bucket=bucket, start=start, engine=engine, penalty=penalty,
+        mesh=mesh, verbose=verbose, trace=trace,
         metrics=metrics, config=config)
     import dataclasses
     return dataclasses.replace(fleet, formula=str(f), terms=terms)

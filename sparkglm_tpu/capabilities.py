@@ -1,0 +1,284 @@
+"""The capability lattice, declared in ONE place.
+
+Every combination the system refuses lives in this module's tables; the
+front-end guard functions (api.py ``_reject_*``) are thin translators
+into :func:`check_penalized` / :func:`check_elastic` / :func:`check_fleet`
+and every refusal raises the same typed error, :class:`CapabilityError`
+(a ``ValueError`` — existing ``pytest.raises(ValueError, match=...)``
+callers keep working, and the reason text is preserved verbatim).
+
+Two layers:
+
+  * The 4-axis LATTICE — design x Gramian engine x penalty x execution —
+    declared in :data:`LATTICE_RULES` and queried by :func:`refusal`.
+    A cell with no matching rule FITS; a matching rule carries the
+    pointed reason (why the combination is genuinely impossible or not
+    yet built, and what to do instead).  ``tests/test_fleet_lattice.py``
+    walks every cell and asserts fit-or-pointed-error — no silent
+    ignores.
+  * OPTION rules — keyword combinations with no lattice meaning
+    (``beta0=`` on a path fit, ``resume=`` on the elastic scheduler…)
+    that the per-front-end check functions refuse with the same error
+    type.
+
+Vocabulary: the lattice speaks the paper's axis names.  ``engine="exact"``
+is the einsum/fused/qr exact-Gramian family, ``"segment-sum"`` is the
+factor-aware Gramian a structured design runs (the two are one choice:
+naming either implies the other), ``"sketch"`` is the r13
+sketch-and-precondition engine.  ``execution="mesh"`` is a row-sharded
+solo fit; a MEMBER-sharded fleet (``glm_fleet(mesh=)``) is the fleet
+execution with the ``mesh`` option, checked by :func:`check_fleet`.
+"""
+
+from __future__ import annotations
+
+__all__ = ["AXES", "LATTICE_RULES", "CapabilityError", "refusal", "check",
+           "check_penalized", "check_elastic", "check_fleet", "lattice",
+           "capability_lattice", "capability_refusal"]
+
+AXES = dict(
+    design=("dense", "structured", "sparse"),
+    engine=("exact", "segment-sum", "sketch"),
+    penalty=("none", "elastic-net"),
+    execution=("solo", "fleet", "streaming", "elastic", "mesh"),
+)
+
+
+class CapabilityError(ValueError):
+    """A refused capability-lattice cell.
+
+    One typed format for every refusal: ``cell`` (the axis/option values
+    that matched), ``reason`` (the pointed explanation, always naming the
+    supported alternative).  ``str(e)`` carries both.
+    """
+
+    def __init__(self, cell: dict, reason: str):
+        self.cell = dict(cell)
+        self.reason = str(reason)
+        tag = " ".join(f"{k}={v}" for k, v in self.cell.items())
+        super().__init__(f"unsupported capability [{tag}]: {reason}")
+
+
+def _matches(cell: dict, when: dict) -> bool:
+    for k, v in when.items():
+        alts = v if isinstance(v, tuple) else (v,)
+        if cell.get(k) not in alts:
+            return False
+    return True
+
+
+# (when, reason) — FIRST matching rule wins; no match means the cell fits.
+# Reasons keep the exact wording the front-ends have always raised (guard
+# tests match substrings of them).
+LATTICE_RULES: tuple[tuple[dict, str], ...] = (
+    # -- design x engine structural identities ----------------------------
+    (dict(engine="segment-sum", design=("dense", "sparse")),
+     "segment-sum is the structured design's Gramian engine; a "
+     "dense/sparse design has no factor segments to sum — use "
+     "design='structured' or engine='exact'"),
+    (dict(engine="exact", design="structured"),
+     "design='structured' IS the segment-sum engine (a structured design "
+     "always assembles its Gramian by factor segment sums); name the cell "
+     "engine='segment-sum'"),
+    (dict(engine="sketch", design="structured"),
+     "engine='sketch' has no structured form — the per-iteration sketch "
+     "draws row combinations, which densifies every factor block; fit "
+     "with design='dense' or engine='segment-sum'"),
+    # -- sketch engine ----------------------------------------------------
+    (dict(engine="sketch", penalty="elastic-net"),
+     "penalty= does not support engine='sketch': the coordinate-descent "
+     "lambda path screens and checks KKT conditions against exact "
+     "Gramian columns, and a sketched X'WX would bias every one of them "
+     "— fit the penalized path with engine='auto'"),
+    (dict(engine="sketch", execution="elastic"),
+     "workers= (the elastic shard scheduler) does not support "
+     "engine='sketch': the one-shot shard combine is Gramian-additive "
+     "and needs exact per-shard X'WX — drop workers= to stream a "
+     "sketched fit on a single controller"),
+    (dict(engine="sketch", execution="mesh"),
+     "engine='sketch' is single-controller: the per-iteration sketch "
+     "draw has no row-sharded form yet — drop mesh= or use "
+     "engine='auto'"),
+    # -- penalty ----------------------------------------------------------
+    (dict(penalty="elastic-net", execution="mesh"),
+     "penalty= does not support mesh= (sharded penalized fits are not "
+     "implemented yet) — drop mesh= and fit the path on a single "
+     "controller"),
+    (dict(penalty="elastic-net", execution="elastic"),
+     "penalty= does not support engine='elastic' (the lambda path has no "
+     "shard combine rule yet); fit the penalized path on a single "
+     "controller"),
+    # -- fleet ------------------------------------------------------------
+    (dict(execution="fleet", design="structured"),
+     "fleet fitting does not support design='structured': the "
+     "segment-sum Gramian engine batches over factor levels, which "
+     "conflicts with batching over the model axis — use the dense "
+     "design (per-segment models are narrow)"),
+    (dict(execution="fleet", design="sparse"),
+     "fleet designs are stacked dense (K, n, p) arrays; a SparseDesign "
+     "has no stacked form — densify per-segment designs (they are "
+     "narrow) or fit solo"),
+    (dict(design="sparse", penalty="elastic-net"),
+     "penalized paths take dense or structured designs (the formula "
+     "front-ends build both); a SparseDesign has no penalized entry "
+     "point — densify or drop penalty="),
+    # -- streaming --------------------------------------------------------
+    (dict(execution="streaming", design="sparse",
+          engine=("exact", "segment-sum")),
+     "sparse chunk sources stream through the sketched solver only (the "
+     "exact streaming Gramian accumulates dense chunk blocks) — pass "
+     "engine='sketch'"),
+    (dict(execution="streaming", design="structured"),
+     "the streaming drivers parse dense chunk designs; structured "
+     "factor designs are resident-only — fit resident with "
+     "design='structured'"),
+    (dict(execution="elastic", design=("structured", "sparse")),
+     "the elastic shard scheduler combines exact dense per-shard "
+     "Gramians; structured/sparse designs are single-controller — drop "
+     "workers="),
+    (dict(execution="mesh", design="sparse"),
+     "sparse designs cannot be feature- or row-sharded (the ELL layout "
+     "is single-device) — densify first or drop mesh="),
+)
+
+
+def refusal(*, design: str = "dense", engine: str = "exact",
+            penalty: str = "none", execution: str = "solo") -> str | None:
+    """The pointed reason the cell is refused, or None when it fits."""
+    for ax, val in (("design", design), ("engine", engine),
+                    ("penalty", penalty), ("execution", execution)):
+        if val not in AXES[ax]:
+            raise ValueError(f"{ax} must be one of {AXES[ax]}, got {val!r}")
+    cell = dict(design=design, engine=engine, penalty=penalty,
+                execution=execution)
+    for when, reason in LATTICE_RULES:
+        if _matches(cell, when):
+            return reason
+    return None
+
+
+def check(**cell) -> None:
+    """Raise :class:`CapabilityError` when the lattice refuses ``cell``."""
+    r = refusal(**cell)
+    if r is not None:
+        full = dict(design="dense", engine="exact", penalty="none",
+                    execution="solo")
+        full.update(cell)
+        raise CapabilityError(full, r)
+
+
+def lattice():
+    """Every (design, engine, penalty, execution) cell with its status —
+    the doc matrix and the exhaustive-walk test both iterate this."""
+    for d in AXES["design"]:
+        for e in AXES["engine"]:
+            for pn in AXES["penalty"]:
+                for ex in AXES["execution"]:
+                    yield (d, e, pn, ex), refusal(design=d, engine=e,
+                                                  penalty=pn, execution=ex)
+
+
+# public aliases (the package namespace re-exports these names)
+capability_refusal = refusal
+capability_lattice = lattice
+
+
+def _opt(cell: dict, reason: str) -> None:
+    raise CapabilityError(cell, reason)
+
+
+# ---------------------------------------------------------------------------
+# front-end check functions (what api.py's _reject_* wrappers call)
+
+
+def check_penalized(*, mesh=None, engine: str = "auto", beta0=None,
+                    on_iteration=None, checkpoint_every: int = 0,
+                    prefetch: int = 0) -> None:
+    """Guards for ``penalty=`` on the solo/streaming front-ends.
+
+    ``retry=`` is NOT rejected (the penalized streaming drivers honor it
+    on every chunk pass) and neither are ``checkpoint=``/``resume=`` (the
+    drivers checkpoint at lambda-path boundaries and resume
+    bit-identically; penalized/stream.py).
+    """
+    if mesh is not None:
+        check(penalty="elastic-net", execution="mesh")
+    if engine == "sketch":
+        check(penalty="elastic-net", engine="sketch")
+    if engine not in ("auto", "einsum"):
+        _opt(dict(penalty="elastic-net", engine=engine),
+             f"penalty= requires the einsum/structured Gramian engine; "
+             f"engine={engine!r} does not apply to the penalized path")
+    if beta0 is not None or on_iteration is not None or checkpoint_every:
+        _opt(dict(penalty="elastic-net"),
+             "penalty= does not support beta0=/on_iteration=/"
+             "checkpoint_every= (the path warm-starts itself)")
+    if prefetch:
+        _opt(dict(penalty="elastic-net", execution="streaming"),
+             "penalty= does not support prefetch= yet (path passes "
+             "stream sequentially)")
+
+
+def check_elastic(*, penalty=None, beta0=None, on_iteration=None,
+                  resume: bool = False, engine: str = "elastic") -> None:
+    """Guards for the elastic shard scheduler (``workers=`` /
+    ``engine='elastic'``).  Everything else (retry=, checkpoint=,
+    prefetch=, trace=, metrics=, mesh=) flows through to the shard
+    fits."""
+    if engine == "sketch":
+        check(engine="sketch", execution="elastic")
+    if penalty is not None:
+        check(penalty="elastic-net", execution="elastic")
+    if beta0 is not None or on_iteration is not None:
+        _opt(dict(execution="elastic"),
+             "engine='elastic' does not support beta0=/on_iteration= (the "
+             "combine step warm-starts the polish pass itself)")
+    if resume:
+        _opt(dict(execution="elastic"),
+             "engine='elastic' resumes implicitly from the checkpoint= "
+             "shard directory after a restart; drop resume=")
+
+
+def check_fleet(*, engine: str = "auto", penalty=None,
+                design: str = "dense", mesh=None, beta0=None,
+                on_iteration=None, checkpoint_every: int = 0,
+                start=None) -> None:
+    """Guards for :func:`sparkglm_tpu.glm_fleet`.
+
+    ``engine='sketch'``, ``penalty=ElasticNet(...)`` and ``mesh=`` are
+    LEGAL fleet axes (PR 20 — batched lambda-path, member-sharded mesh
+    kernel, per-member sketch engine); what remains refused is declared
+    here and nowhere else.
+    """
+    if engine == "elastic":
+        _opt(dict(execution="fleet", engine="elastic"),
+             "fleet fitting does not support engine='elastic': the fleet "
+             "kernel already IS the parallel axis (one executable over "
+             "all models); shard-parallel workers would nest parallelism "
+             "to no benefit — drop engine='elastic'")
+    if engine not in ("auto", "einsum", "sketch"):
+        _opt(dict(execution="fleet", engine=engine),
+             f"fleet fitting requires the einsum or sketch Gramian "
+             f"engine; engine={engine!r} does not apply to the fleet "
+             f"path")
+    if design == "structured":
+        check(execution="fleet", design="structured",
+              engine="segment-sum")
+    if penalty is not None:
+        if engine == "sketch":
+            check(penalty="elastic-net", engine="sketch")
+        if mesh is not None:
+            _opt(dict(execution="fleet", penalty="elastic-net"),
+                 "penalized fleets run the batched lambda-path kernel on "
+                 "a single device; mesh= sharding of the path kernel is "
+                 "not implemented yet — drop mesh= or penalty=")
+        if start is not None:
+            _opt(dict(execution="fleet", penalty="elastic-net"),
+                 "penalized fleets do not support start= (each member's "
+                 "lambda path warm-starts itself point-to-point)")
+    if beta0 is not None or on_iteration is not None or checkpoint_every:
+        _opt(dict(execution="fleet"),
+             "fleet fitting does not support beta0=/on_iteration=/"
+             "checkpoint_every= (the fleet kernel runs all models to "
+             "convergence in one pass) — to warm-start a refit pass "
+             "stacked (K, p) coefficients via start= instead")

@@ -22,8 +22,11 @@ from ..data.groups import MIN_BUCKET, next_bucket, stack_groups
 from .fitting import fit_many, glm_fit_fleet
 from .kernel import fleet_kernel_cache_size
 from .model import FleetModel
+from .path import (FleetPathModel, fleet_path_kernel_cache_size,
+                   glm_fit_fleet_path)
 
 __all__ = [
     "fit_many", "glm_fit_fleet", "FleetModel", "stack_groups",
     "next_bucket", "MIN_BUCKET", "fleet_kernel_cache_size",
+    "FleetPathModel", "glm_fit_fleet_path", "fleet_path_kernel_cache_size",
 ]

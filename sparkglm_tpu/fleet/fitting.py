@@ -29,14 +29,14 @@ from ..config import (DEFAULT, NumericConfig, effective_tol, x64_enabled,
 from ..data.groups import MIN_BUCKET, next_bucket, stack_groups
 from ..families.families import resolve
 from ..obs import trace as _obs_trace
-from .kernel import (BATCH_MODES, _irls_fleet_kernel,
-                     fleet_kernel_cache_size)
+from .kernel import (BATCH_MODES, FLEET_ENGINES, _irls_fleet_kernel,
+                     _irls_fleet_kernel_mesh, fleet_kernel_cache_size)
 from .model import FleetModel
 
 
 def fit_many(y, X, groups=None, *, weights=None, offset=None,
              n_rows: int | None = None, sort: bool = True,
-             group_name: str = "group", **kw) -> FleetModel:
+             group_name: str = "group", **kw):
     """Fit one GLM per group in a single compiled fleet pass.
 
     Long-format entry: ``y`` (n,), ``X`` (n, p) — a SHARED design layout
@@ -82,11 +82,14 @@ def glm_fit_fleet(
     bucket: int | None = None,
     min_bucket: int = MIN_BUCKET,
     start=None,
+    engine: str = "auto",
+    penalty=None,
+    mesh=None,
     verbose: bool = False,
     trace=None,
     metrics=None,
     config: NumericConfig = DEFAULT,
-) -> FleetModel:
+):
     """Fit K stacked GLMs — X (K, n, p); y/weights/offset/m (K, n).
 
     All models share the design layout, family/link and convergence
@@ -107,7 +110,33 @@ def glm_fit_fleet(
     solo fit would: they come back with NaN coefficients, converged=False
     and ``fleet.singular[k]`` set — refit offenders solo with
     ``singular='drop'`` for R-style aliasing.
+
+    Three orthogonal axes over the same carry pytree (PR 20):
+    ``engine="sketch"`` maps the r13 sketched solo core per member (wide
+    per-tenant designs — same seed as the solo fit, NaN standard errors);
+    ``mesh=`` shards the MODEL axis over the mesh's data axis via
+    shard_map (power-of-2 member buckets per shard, trash models
+    shard-local-inert, results gathered to host so indexing and
+    serialization never change); ``penalty=ElasticNet(...)`` routes to
+    the batched lambda-path driver (fleet/path.py) and returns a
+    :class:`~sparkglm_tpu.fleet.path.FleetPathModel` instead.
     """
+    from ..capabilities import check_fleet
+    check_fleet(engine=engine, penalty=penalty, mesh=mesh, start=start)
+    if engine == "auto":
+        engine = "einsum"
+    if engine not in FLEET_ENGINES:
+        raise ValueError(
+            f"engine must be one of {FLEET_ENGINES}, got {engine!r}")
+    if penalty is not None:
+        from .path import glm_fit_fleet_path
+        return glm_fit_fleet_path(
+            X, y, penalty=penalty, family=family, link=link,
+            weights=weights, offset=offset, m=m, xnames=xnames,
+            yname=yname, has_intercept=has_intercept, labels=labels,
+            group_name=group_name, batch=batch, bucket=bucket,
+            min_bucket=min_bucket, verbose=verbose, trace=trace,
+            metrics=metrics, config=config)
     if criterion not in ("absolute", "relative"):
         raise ValueError(
             f"criterion must be 'absolute' or 'relative', got {criterion!r}")
@@ -183,8 +212,21 @@ def glm_fit_fleet(
 
     # model-axis bucket: power-of-2 padding with all-weight-0 trash models
     # (their first Gramian is singular; the per-model loop exits after one
-    # iteration and the results are sliced off below)
-    B = next_bucket(K, min_bucket) if bucket is None else int(bucket)
+    # iteration and the results are sliced off below).  Under mesh= the
+    # bucket is n_shards x a power-of-2 PER-SHARD block, so every device
+    # holds an equal member slab and trash models stay shard-local-inert.
+    n_shards = 1
+    if mesh is not None:
+        from ..parallel import mesh as meshlib
+        n_shards = int(mesh.shape[meshlib.DATA_AXIS])
+    if bucket is None:
+        B = n_shards * next_bucket(-(-K // n_shards), min_bucket)
+    else:
+        B = int(bucket)
+        if B % n_shards:
+            raise ValueError(
+                f"bucket={B} must divide evenly over the mesh's "
+                f"{n_shards} data shards")
     if B < K:
         raise ValueError(f"bucket={B} is smaller than the fleet (K={K})")
     Xb = np.zeros((B, n, p), dtype)
@@ -207,23 +249,41 @@ def glm_fit_fleet(
         bb = np.zeros((B, p), dtype)
         bb[:K] = start.astype(dtype)
 
+    # per-member sketch engine: one SHARED base key, so member k's sketch
+    # sequence is the solo engine="sketch" fit's at the same seed
+    sk_key = None
+    m_run = 64
+    if engine == "sketch":
+        from ..ops.sketch import sketch_dim as _sketch_dim
+        m_run = _sketch_dim(n, p, config.sketch_dim)
+        sk_key = jax.random.PRNGKey(int(config.sketch_seed))
+
     if tracer is not None:
         tracer.emit("fleet_start", models=K, bucket=B, n_rows=n, p=p,
                     family=fam.name, link=lnk.name, batch=batch,
-                    engine="einsum")
+                    engine=engine, shards=n_shards)
 
     tol_dev = jnp.asarray(tol_run, dev_dtype)
     mi = jnp.asarray(max_iter, jnp.int32)
     jit_ = jnp.asarray(config.jitter, dtype)
+    kern_kwargs = dict(
+        family=fam, link=lnk, criterion=criterion,
+        refine_steps=config.refine_steps,
+        precision=config.matmul_precision, batch=batch,
+        fam_param=fam_param, engine=engine, sketch_key=sk_key,
+        m=int(m_run), sketch_refine=int(config.sketch_refine),
+        sketch_method=config.sketch_method)
     n_exec0 = fleet_kernel_cache_size()
     from ..obs import timing as _obs_timing
     with _obs_timing.span("fleet_kernel", tracer, device=True) as _sp:
-        out = _irls_fleet_kernel(
-            Xb, yb, wb, ob, tol_dev, mi, jit_,
-            family=fam, link=lnk, criterion=criterion,
-            refine_steps=config.refine_steps,
-            precision=config.matmul_precision, batch=batch,
-            fam_param=fam_param, beta0=bb, warm=warm)
+        if mesh is not None:
+            out = _irls_fleet_kernel_mesh(
+                Xb, yb, wb, ob, tol_dev, mi, jit_, mesh=mesh,
+                beta0=bb, warm=warm, **kern_kwargs)
+        else:
+            out = _irls_fleet_kernel(
+                Xb, yb, wb, ob, tol_dev, mi, jit_,
+                beta0=bb, warm=warm, **kern_kwargs)
         _sp.watch(out)
     out = jax.tree.map(np.asarray, out)
     executables = fleet_kernel_cache_size() - n_exec0
@@ -271,12 +331,20 @@ def glm_fit_fleet(
         # GLM honouring it — one more fleet pass on a ones design (its own
         # pass flavor: same kernel, p=1 shapes)
         ones_b = np.ones((B, n, 1), dtype)
-        null_out = _irls_fleet_kernel(
-            ones_b, yb, wb, ob, tol_dev, mi, jit_,
+        null_kwargs = dict(
             family=fam, link=lnk, criterion=criterion,
             refine_steps=config.refine_steps,
             precision=config.matmul_precision, batch=batch,
             fam_param=fam_param)
+        # the null model always runs the exact engine, as the solo sketch
+        # path does (models/glm.py: null pass via _irls_kernel)
+        if mesh is not None:
+            null_out = _irls_fleet_kernel_mesh(
+                ones_b, yb, wb, ob, tol_dev, mi, jit_, mesh=mesh,
+                **null_kwargs)
+        else:
+            null_out = _irls_fleet_kernel(
+                ones_b, yb, wb, ob, tol_dev, mi, jit_, **null_kwargs)
         eta_null = np.asarray(null_out["eta"])[:K].astype(np.float64)
 
     coefs = out["beta"][:K].astype(np.float64)
@@ -352,4 +420,8 @@ def glm_fit_fleet(
         n_obs=n, n_params=p, tol=tol, criterion=criterion,
         has_intercept=bool(has_intercept),
         dispersion_fixed=bool(fam.dispersion_fixed), batch=batch,
-        bucket=B, fit_info=fit_info)
+        bucket=B, fit_info=fit_info, engine=engine,
+        sketch_dim=int(m_run) if engine == "sketch" else None,
+        sketch_refine=(int(config.sketch_refine) if engine == "sketch"
+                       else None),
+        n_member_shards=n_shards)

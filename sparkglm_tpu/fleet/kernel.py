@@ -24,10 +24,27 @@ static-arg) flavor:
     GEMM's reduction order differs from the unbatched one.  This is the
     throughput mode for batched hardware (MXU-friendly (K,n,p) einsums).
 
+Two Gramian engines per member (PR 20): ``engine="einsum"`` maps
+``_irls_core`` (the exact engine), ``engine="sketch"`` maps
+``_irls_sketch_core`` — the r13 sketch-and-precondition path for WIDE
+per-tenant designs — with one SHARED base key, so each member's
+per-iteration sketch sequence is exactly the solo ``engine="sketch"``
+fit's at the same seed.
+
+``_mesh_fleet_call`` shards the MODEL axis of the same map over a device
+mesh via ``shard_map`` (parallel/mesh.py): each device runs the identical
+per-member graph on its contiguous member block, so K=thousands fits in
+one pass with zero cross-device collectives (members are independent).
+The compiled callable is cached per (mesh, static-flavor) so warm refits
+at a fixed bucket compile nothing, preserving the fleet compile contract.
+
 Padding contracts (data/groups.py): trash ROWS carry weight 0 — inert in
 every sum via the core's ``_sanitize``/valid masking; trash MODELS (fleet
-bucket padding) carry all-zero weights — their first Gramian is singular,
-the loop exits after one iteration, and the driver slices them off.
+bucket padding) carry all-zero weights — their first Gramian is singular
+(exact engine) or their residual is identically zero (sketch engine), the
+loop exits after one iteration, and the driver slices them off.  Under
+the mesh both stay SHARD-LOCAL-inert: a device whose block is all trash
+finishes its map immediately.
 """
 
 from __future__ import annotations
@@ -36,39 +53,28 @@ from functools import partial
 
 import jax
 
-from ..models.glm import _irls_core
+from ..models.glm import _irls_core, _irls_sketch_core
 
 BATCH_MODES = ("exact", "vmap")
+FLEET_ENGINES = ("einsum", "sketch")
 
 
-@partial(jax.jit, static_argnames=("family", "link", "criterion",
-                                   "refine_steps", "precision", "batch",
-                                   "warm"))
-def _irls_fleet_kernel(
-    X, y, wt, offset,
-    tol, max_iter, jitter,
-    family, link,
-    criterion: str = "relative",
-    refine_steps: int = 1,
-    precision=None,
-    batch: str = "exact",
-    fam_param=None,
-    beta0=None,
-    warm: bool = False,
-):
-    """Run IRLS for a stacked fleet: X (K, n, p); y/wt/offset (K, n).
-
-    ``warm=True`` starts every member from its row of ``beta0`` (K, p)
-    instead of the family init — the online refresh path
-    (sparkglm_tpu/online): a warm fleet refit at a fixed bucket shares one
-    executable with every later refresh.  Trash models (all-zero weights)
-    pass a zero beta0 row and stay inert exactly as in the cold path.
-
-    Returns the solo kernel's output dict with a leading (K,) axis on every
-    leaf (beta (K, p), cov_inv (K, p, p), dev/iters/converged/singular/
-    pivot (K,), eta (K, n), XtWX0 (K, p, p)).
-    """
+def _fleet_map(X, y, wt, offset, tol, max_iter, jitter, *,
+               family, link, criterion, refine_steps, precision, batch,
+               fam_param, beta0, warm, engine, sketch_key, m,
+               sketch_refine, sketch_method):
+    """The shared member map: solo core per member under lax.map/vmap.
+    Called from the jitted single-device kernel AND from inside each
+    shard of the mesh kernel (where it sees only the local member
+    block)."""
     def one(Xk, yk, wk, ok, bk=None):
+        if engine == "sketch":
+            return _irls_sketch_core(
+                Xk, yk, wk, ok, sketch_key, tol, max_iter, jitter,
+                family=family, link=link, criterion=criterion, m=m,
+                sketch_refine=sketch_refine, sketch_method=sketch_method,
+                trace=False, precision=precision, beta0=bk, warm=warm,
+                fam_param=fam_param)
         return _irls_core(
             Xk, yk, wk, ok, tol, max_iter, jitter,
             family=family, link=link, criterion=criterion,
@@ -82,8 +88,146 @@ def _irls_fleet_kernel(
     return jax.lax.map(lambda o: one(*o), ops)
 
 
+@partial(jax.jit, static_argnames=("family", "link", "criterion",
+                                   "refine_steps", "precision", "batch",
+                                   "warm", "engine", "m", "sketch_refine",
+                                   "sketch_method"))
+def _irls_fleet_kernel(
+    X, y, wt, offset,
+    tol, max_iter, jitter,
+    family, link,
+    criterion: str = "relative",
+    refine_steps: int = 1,
+    precision=None,
+    batch: str = "exact",
+    fam_param=None,
+    beta0=None,
+    warm: bool = False,
+    engine: str = "einsum",
+    sketch_key=None,
+    m: int = 64,
+    sketch_refine: int = 8,
+    sketch_method: str = "countsketch",
+):
+    """Run IRLS for a stacked fleet: X (K, n, p); y/wt/offset (K, n).
+
+    ``warm=True`` starts every member from its row of ``beta0`` (K, p)
+    instead of the family init — the online refresh path
+    (sparkglm_tpu/online): a warm fleet refit at a fixed bucket shares one
+    executable with every later refresh.  Trash models (all-zero weights)
+    pass a zero beta0 row and stay inert exactly as in the cold path.
+
+    ``engine="sketch"`` maps the sketched solo core instead; the base
+    ``sketch_key`` is SHARED across members (each member folds in its own
+    iteration counter exactly as the solo kernel does), so member k's fit
+    is the solo sketched fit of the same layout and seed.
+
+    Returns the solo kernel's output dict with a leading (K,) axis on every
+    leaf (beta (K, p), cov_inv (K, p, p), dev/iters/converged/singular/
+    pivot (K,), eta (K, n), XtWX0 (K, p, p)).
+    """
+    return _fleet_map(
+        X, y, wt, offset, tol, max_iter, jitter,
+        family=family, link=link, criterion=criterion,
+        refine_steps=refine_steps, precision=precision, batch=batch,
+        fam_param=fam_param, beta0=beta0, warm=warm, engine=engine,
+        sketch_key=sketch_key, m=m, sketch_refine=sketch_refine,
+        sketch_method=sketch_method)
+
+
+_MESH_CALLS: dict = {}
+
+
+def _mesh_fleet_call(mesh, family, link, criterion, refine_steps,
+                     precision, batch, warm, engine, m, sketch_refine,
+                     sketch_method, has_fam_param):
+    """Compiled member-sharded fleet kernel for ``mesh`` — the fleet's
+    scale axis (b) of PR 20.
+
+    The member (bucket) axis shards over the mesh's ``"data"`` axis; every
+    other operand replicates.  Inside each shard the body is
+    :func:`_fleet_map` on the LOCAL member block — the per-member graph is
+    the single-device kernel's exactly (members are independent, so there
+    are no collectives and no batching-order change), which is what the
+    mesh-vs-unsharded parity tests lean on.  The callable is cached per
+    (mesh, static flavor): refits at a fixed per-shard bucket reuse the
+    executable, preserving the fleet compile contract under the mesh.
+    """
+    key = (mesh, family, link, criterion, refine_steps, precision, batch,
+           warm, engine, m, sketch_refine, sketch_method, has_fam_param)
+    fn = _MESH_CALLS.get(key)
+    if fn is not None:
+        return fn
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS, shard_map
+
+    mspec = P(DATA_AXIS)   # leading member axis sharded; prefix spec
+    rep = P()              # covers trailing axes of every output leaf
+
+    n_ops = 4 + (1 if warm else 0)
+    in_specs = ((mspec,) * n_ops + (rep, rep, rep)
+                + ((rep,) if has_fam_param else ())
+                + ((rep,) if engine == "sketch" else ()))
+
+    def local(*args):
+        X, y, wt, offset = args[:4]
+        i = 4
+        beta0 = None
+        if warm:
+            beta0 = args[i]
+            i += 1
+        tol, max_iter, jitter = args[i:i + 3]
+        i += 3
+        fam_param = None
+        if has_fam_param:
+            fam_param = args[i]
+            i += 1
+        sketch_key = args[i] if engine == "sketch" else None
+        return _fleet_map(
+            X, y, wt, offset, tol, max_iter, jitter,
+            family=family, link=link, criterion=criterion,
+            refine_steps=refine_steps, precision=precision, batch=batch,
+            fam_param=fam_param, beta0=beta0, warm=warm, engine=engine,
+            sketch_key=sketch_key, m=m, sketch_refine=sketch_refine,
+            sketch_method=sketch_method)
+
+    fn = jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                           out_specs=mspec))
+    _MESH_CALLS[key] = fn
+    return fn
+
+
+def _irls_fleet_kernel_mesh(
+    X, y, wt, offset, tol, max_iter, jitter, *, mesh,
+    family, link, criterion="relative", refine_steps=1, precision=None,
+    batch="exact", fam_param=None, beta0=None, warm=False,
+    engine="einsum", sketch_key=None, m=64, sketch_refine=8,
+    sketch_method="countsketch",
+):
+    """Dispatch a fleet pass member-sharded over ``mesh``.  The caller
+    guarantees the bucket axis is ``per_shard_bucket * n_data_shards``
+    (fleet/fitting.py sizes it)."""
+    fn = _mesh_fleet_call(mesh, family, link, criterion, refine_steps,
+                          precision, batch, warm, engine, m, sketch_refine,
+                          sketch_method, fam_param is not None)
+    args = (X, y, wt, offset) + ((beta0,) if warm else ())
+    args = args + (tol, max_iter, jitter)
+    if fam_param is not None:
+        args = args + (fam_param,)
+    if engine == "sketch":
+        args = args + (sketch_key,)
+    return fn(*args)
+
+
 def fleet_kernel_cache_size() -> int:
     """Compiled-executable count for the fleet kernel — the contract-test
     and bench probe (one executable per pass flavor; warm refits at any
-    K <= bucket add nothing)."""
-    return int(_irls_fleet_kernel._cache_size())
+    K <= bucket add nothing).  Counts the single-device kernel AND every
+    cached mesh-sharded flavor, so the mesh path rides the same
+    zero-recompile contract."""
+    n = int(_irls_fleet_kernel._cache_size())
+    for fn in _MESH_CALLS.values():
+        n += int(fn._cache_size())
+    return n

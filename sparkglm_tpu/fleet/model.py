@@ -58,6 +58,14 @@ class FleetModel:
     formula: str | None = None
     terms: object | None = None
     fit_info: dict | None = None
+    # per-member Gramian engine (PR 20): "einsum" (exact) | "sketch"
+    engine: str = "einsum"
+    sketch_dim: int | None = None   # engine="sketch" only
+    sketch_refine: int | None = None
+    # member-axis shard count the fleet pass ran with (mesh=); results are
+    # gathered to host at fit time, so indexing/serialization never sees
+    # the sharding — members stay byte-identical to an unsharded fit
+    n_member_shards: int = 1
 
     @property
     def n_models(self) -> int:
@@ -84,6 +92,12 @@ class FleetModel:
 
     def __getitem__(self, key) -> GLMModel:
         k = self.index_of(key)
+        sketch = self.engine == "sketch"
+        # sketch members mirror the solo sketched model: no exact
+        # covariance exists (models/glm.py), so cov_unscaled is None and
+        # vcov() raises instead of scaling a biased sketched inverse
+        cov_k = (None if sketch
+                 else np.asarray(self.cov_unscaled[k], np.float64))
         return GLMModel(
             coefficients=np.asarray(self.coefficients[k], np.float64),
             std_errors=np.asarray(self.std_errors[k], np.float64),
@@ -101,10 +115,12 @@ class FleetModel:
             n_obs=int(self.n_obs), n_params=int(self.n_params),
             n_shards=1, tol=float(self.tol),
             has_intercept=bool(self.has_intercept),
-            cov_unscaled=np.asarray(self.cov_unscaled[k], np.float64),
+            cov_unscaled=cov_k,
             has_offset=bool(self.has_offset[k]),
             dispersion_fixed=bool(self.dispersion_fixed),
-            gramian_engine="einsum")
+            gramian_engine=self.engine,
+            sketch_dim=self.sketch_dim if sketch else None,
+            sketch_refine=self.sketch_refine if sketch else None)
 
     def models(self):
         """Iterate ``(label, GLMModel)`` over the fleet."""

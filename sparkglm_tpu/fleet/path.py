@@ -1,0 +1,563 @@
+"""Penalized fleets: the elastic-net lambda path batched over the model
+axis (PR 20 tentpole (a)).
+
+``_fleet_glm_path_kernel`` maps the SOLO path core
+(penalized/path._glm_path_core — the exact scan every resident
+``glm(penalty=)`` compiles) over a stacked (K, n, p) model axis, exactly
+as fleet/kernel.py maps ``_irls_core``; gaussian/identity members run
+``_fleet_gram_path_kernel`` instead: the one-data-pass stats core feeding
+the accumulated-Gramian path core, both per member inside ONE executable
+(the solo pair is two).  Under ``batch="exact"`` (lax.map) each member is
+the UNBATCHED solo graph, so member k's whole path — its lambda grid
+included — is bit-identical to a solo ``fit_path`` of the same padded row
+layout; ``batch="vmap"`` batches the scan across members for throughput
+(roundoff-level agreement, same iteration counts via the masked
+while_loop batching rule).
+
+Per-member lambda grids on a shared log-schedule come for free: the core
+derives each member's lambda_max from ITS null-model gradient and lays
+``n_lambda`` points down to ``lambda_min_ratio`` of it, with
+n_lambda/ratio shared by the whole fleet (the ElasticNet spec is fleet
+metadata, like family/link).  An explicit ``penalty.lambdas`` grid is
+shared verbatim.
+
+Trash members (all-zero weights, fleet bucket padding) stay inert in both
+kernels: the GLM core sees zero working weights everywhere (the Gramian,
+gradient and lambda_max collapse to 0/_TINY and every inner loop exits on
+its first test), the gram core's NaN moments fail every ``>`` predicate
+(one CD sweep per point, no admission rounds) — no member hangs, and the
+driver slices them off.
+
+``FleetPathModel`` keeps everything stacked — ``fleet_path[k]`` is an
+ordinary :class:`~sparkglm_tpu.penalized.model.PathModel`, and
+``select(lambda_=|criterion=)`` collapses every member's path point into
+a :class:`~sparkglm_tpu.fleet.model.FleetModel`, so serving
+(serve.ModelFamily.from_fleet) and continuous learning (online.OnlineLoop)
+compose with penalized fleets through the existing plumbing with zero new
+code paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import warnings
+
+import jax
+import numpy as np
+
+from ..config import (DEFAULT, NumericConfig, resolve_matmul_precision,
+                      x64_enabled)
+from ..data.groups import MIN_BUCKET, next_bucket
+from ..families.families import resolve
+from ..obs import trace as _obs_trace
+from ..penalized.model import PathModel
+from ..penalized.path import (_KKT_ROUNDS, _glm_path_core, _gram_path_core,
+                              _quad_stats_core, intercept_col,
+                              resolve_penalty_vector)
+from ..penalized.penalty import ElasticNet
+from .kernel import BATCH_MODES
+from .model import FleetModel
+
+__all__ = ["FleetPathModel", "glm_fit_fleet_path",
+           "fleet_path_kernel_cache_size"]
+
+_FLEET_GLM_STATICS = ("family", "link", "auto_grid", "n_lambda",
+                      "standardize", "icol", "max_iter", "cd_max_sweeps",
+                      "kkt_rounds", "precision", "batch")
+
+
+@functools.partial(jax.jit, static_argnames=_FLEET_GLM_STATICS)
+def _fleet_glm_path_kernel(X, y, wt, off, lambdas, lmr, alpha, pf, tol,
+                           cd_tol, fam_param, *, family, link, auto_grid,
+                           n_lambda, standardize, icol, max_iter,
+                           cd_max_sweeps, kkt_rounds, precision, batch):
+    """K whole lambda paths in one executable: X (K, n, p); y/wt/off
+    (K, n); the penalty operands (grid, ratio, alpha, factors, tols) are
+    SHARED — the fleet contract, as with family/link on the IRLS fleet.
+    Returns the solo path dict with a leading (K,) axis on every leaf."""
+    def one(Xk, yk, wk, ok):
+        return _glm_path_core(
+            Xk, yk, wk, ok, lambdas, lmr, alpha, pf, tol, cd_tol,
+            fam_param, family=family, link=link, auto_grid=auto_grid,
+            n_lambda=n_lambda, standardize=standardize, icol=icol,
+            max_iter=max_iter, cd_max_sweeps=cd_max_sweeps,
+            kkt_rounds=kkt_rounds, precision=precision, trace=False)
+
+    ops = (X, y, wt, off)
+    if batch == "vmap":
+        return jax.vmap(one)(*ops)
+    return jax.lax.map(lambda o: one(*o), ops)
+
+
+_FLEET_GRAM_STATICS = ("auto_grid", "n_lambda", "standardize", "icol",
+                       "cd_max_sweeps", "kkt_rounds", "precision", "batch")
+
+
+@functools.partial(jax.jit, static_argnames=_FLEET_GRAM_STATICS)
+def _fleet_gram_path_kernel(X, y, wt, off, lambdas, lmr, alpha, pf, cd_tol,
+                            *, auto_grid, n_lambda, standardize, icol,
+                            cd_max_sweeps, kkt_rounds, precision, batch):
+    """Gaussian/identity fleet paths: per member, the one-data-pass stats
+    core feeds the accumulated-Gramian path core — the solo TWO-executable
+    pair fused into one fleet executable (the quadratic objective never
+    re-weights, so after the stats pass everything is p x p work)."""
+    def one(Xk, yk, wk, ok):
+        st = _quad_stats_core(Xk, yk, wk, ok, precision=precision)
+        return _gram_path_core(
+            st["A"], st["b"], st["s1"], st["yty"], st["wsum"], lambdas,
+            lmr, alpha, pf, cd_tol, auto_grid=auto_grid,
+            n_lambda=n_lambda, standardize=standardize, icol=icol,
+            cd_max_sweeps=cd_max_sweeps, kkt_rounds=kkt_rounds,
+            trace=False)
+
+    ops = (X, y, wt, off)
+    if batch == "vmap":
+        return jax.vmap(one)(*ops)
+    return jax.lax.map(lambda o: one(*o), ops)
+
+
+def fleet_path_kernel_cache_size() -> int:
+    """Compiled-executable count across both fleet path kernels — the
+    bench/contract probe (a warm refit at a fixed bucket adds zero)."""
+    return (int(_fleet_glm_path_kernel._cache_size())
+            + int(_fleet_gram_path_kernel._cache_size()))
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetPathModel:
+    """K stacked elastic-net lambda paths fitted in one fleet kernel call.
+
+    ``fleet_path[k]`` / ``fleet_path["label"]`` materializes an ordinary
+    :class:`PathModel` (field-for-field what a solo ``fit_path`` of the
+    member's padded row layout produces under ``batch="exact"``);
+    :meth:`select` collapses one path point per member into a
+    :class:`FleetModel` for batched serving.
+    """
+
+    # stacked per-member path results (leading axis K)
+    lambdas: np.ndarray          # (K, L) descending, per-member grids
+    coefficients: np.ndarray     # (K, L, p) ORIGINAL scale
+    df: np.ndarray               # (K, L) int64
+    deviance: np.ndarray         # (K, L)
+    dev_ratio: np.ndarray        # (K, L)
+    null_deviance: np.ndarray    # (K,)
+    converged: np.ndarray        # (K, L) bool, per path point
+    kkt_clean: np.ndarray        # (K, L) bool
+    iterations: np.ndarray       # (K, L) int64 IRLS iters per point
+    sweeps: np.ndarray           # (K, L) int64 CD sweeps per point
+    n_ok: np.ndarray             # (K,) int64
+    has_offset: np.ndarray       # (K,) bool
+    # shared metadata
+    alpha: float
+    group_names: tuple
+    group_name: str
+    xnames: tuple
+    yname: str
+    family: str
+    link: str
+    n_obs: int                   # padded per-member row count
+    n_params: int
+    has_intercept: bool
+    standardize: bool
+    penalty: object              # the shared ElasticNet spec
+    dispersion_fixed: bool
+    batch: str
+    bucket: int
+    kind: str = "glm"
+    formula: str | None = None
+    terms: object | None = None
+    fit_info: dict | None = None
+
+    @property
+    def n_models(self) -> int:
+        return len(self.group_names)
+
+    @property
+    def n_lambda(self) -> int:
+        return int(self.lambdas.shape[1])
+
+    def __len__(self) -> int:
+        return self.n_models
+
+    def index_of(self, key) -> int:
+        """Model index for a group label (or pass an int through)."""
+        if isinstance(key, (int, np.integer)):
+            k = int(key)
+            if not -self.n_models <= k < self.n_models:
+                raise IndexError(
+                    f"model index {k} out of range for fleet of "
+                    f"{self.n_models}")
+            return k % self.n_models
+        try:
+            return self.group_names.index(key)
+        except ValueError:
+            raise KeyError(
+                f"{key!r} is not a fleet group (first few: "
+                f"{list(self.group_names[:5])!r})") from None
+
+    def __getitem__(self, key) -> PathModel:
+        k = self.index_of(key)
+        return PathModel(
+            lambdas=np.asarray(self.lambdas[k], np.float64),
+            alpha=float(self.alpha),
+            coefficients=np.asarray(self.coefficients[k], np.float64),
+            df=np.asarray(self.df[k], np.int64),
+            deviance=np.asarray(self.deviance[k], np.float64),
+            dev_ratio=np.asarray(self.dev_ratio[k], np.float64),
+            null_deviance=float(self.null_deviance[k]),
+            family=self.family, link=self.link, xnames=tuple(self.xnames),
+            yname=self.yname, n_obs=int(self.n_obs), n_ok=int(self.n_ok[k]),
+            n_params=int(self.n_params),
+            has_intercept=bool(self.has_intercept),
+            standardize=bool(self.standardize), penalty=self.penalty,
+            converged=bool(self.converged[k].all()),
+            kkt_clean=bool(self.kkt_clean[k].all()),
+            iterations=int(self.iterations[k].sum()),
+            dispersion_fixed=bool(self.dispersion_fixed), kind=self.kind,
+            has_offset=bool(self.has_offset[k]),
+            gramian_engine="einsum")
+
+    def models(self):
+        """Iterate ``(label, PathModel)`` over the fleet."""
+        for k, name in enumerate(self.group_names):
+            yield name, self[k]
+
+    def _indices(self, lambda_=None, criterion=None) -> np.ndarray:
+        """Per-member selected path-point index."""
+        if (lambda_ is None) == (criterion is None):
+            raise ValueError(
+                "pass exactly one of lambda_= or criterion='aic'|'bic'")
+        K = self.n_models
+        if lambda_ is not None:
+            lam = float(lambda_)
+            if not np.isfinite(lam) or lam < 0:
+                raise ValueError(
+                    f"lambda_ must be finite and >= 0, got {lambda_!r}")
+            # per-member grids: nearest point in log distance per member,
+            # matching PathModel.lambda_index
+            grid = np.maximum(np.asarray(self.lambdas[:K], np.float64),
+                              1e-300)
+            return np.argmin(np.abs(np.log(grid)
+                                    - np.log(max(lam, 1e-300))), axis=1)
+        if criterion not in ("aic", "bic"):
+            raise ValueError(
+                f"criterion must be 'aic' or 'bic', got {criterion!r}")
+        ic = 1.0 if self.has_intercept else 0.0
+        dev = np.asarray(self.deviance[:K], np.float64)
+        dft = np.asarray(self.df[:K], np.float64) + ic
+        if criterion == "aic":
+            kfac = np.full(K, 2.0)
+        else:
+            kfac = np.log(np.maximum(self.n_ok[:K].astype(np.float64), 2.0))
+        return np.argmin(dev + kfac[:, None] * dft, axis=1)
+
+    def select(self, lambda_: float | None = None,
+               criterion: str | None = None) -> FleetModel:
+        """Collapse one path point per member into a :class:`FleetModel`.
+
+        Selection semantics are :meth:`PathModel.select`'s applied per
+        member (nearest grid point on the MEMBER's grid, or the member's
+        own aic/bic minimizer).  The result serves and learns through
+        every existing fleet surface — ``ModelFamily.from_fleet``,
+        ``FamilyScorer``, ``OnlineLoop`` — with NaN standard errors (no
+        post-selection inference, penalized/model.py docstring).
+        """
+        idx = self._indices(lambda_, criterion)
+        K = self.n_models
+        p = int(self.n_params)
+        ar = np.arange(K)
+        beta = np.asarray(self.coefficients[ar, idx], np.float64)
+        dev = np.asarray(self.deviance[ar, idx], np.float64)
+        df_used = (self.df[ar, idx].astype(np.int64)
+                   + (1 if self.has_intercept else 0))
+        df_resid = np.maximum(self.n_ok.astype(np.int64) - df_used, 0)
+        df_null = self.n_ok.astype(np.int64) - (1 if self.has_intercept
+                                                else 0)
+        nan_v = np.full(K, np.nan)
+        disp = (np.ones(K) if self.dispersion_fixed else np.full(K, np.nan))
+        sel = {
+            "penalized": {
+                "alpha": float(self.alpha),
+                "criterion": criterion,
+                "lambda": [float(v) for v in self.lambdas[ar, idx]],
+                "lambda_index": [int(i) for i in idx],
+                "n_lambda": self.n_lambda,
+                "df": [int(d) for d in self.df[ar, idx]],
+                "standardize": bool(self.standardize),
+            }
+        }
+        return FleetModel(
+            coefficients=beta, std_errors=np.full((K, p), np.nan),
+            cov_unscaled=np.full((K, p, p), np.nan), deviance=dev,
+            null_deviance=np.asarray(self.null_deviance, np.float64),
+            pearson_chi2=nan_v, loglik=nan_v.copy(), aic=nan_v.copy(),
+            dispersion=disp, df_residual=df_resid, df_null=df_null,
+            iterations=self.iterations.sum(axis=1).astype(np.int64),
+            converged=self.converged.all(axis=1),
+            singular=np.zeros(K, bool),
+            n_ok=self.n_ok.astype(np.int64),
+            has_offset=self.has_offset.astype(bool),
+            group_names=self.group_names, group_name=self.group_name,
+            xnames=tuple(self.xnames), yname=self.yname,
+            family=self.family, link=self.link, n_obs=int(self.n_obs),
+            n_params=p,
+            tol=float(self.penalty.tol if self.penalty is not None
+                      else 1e-7),
+            criterion="relative", has_intercept=bool(self.has_intercept),
+            dispersion_fixed=bool(self.dispersion_fixed), batch=self.batch,
+            bucket=int(self.bucket), formula=self.formula,
+            terms=self.terms, fit_info=sel)
+
+    def fit_report(self) -> dict:
+        return self.fit_info or {}
+
+    def summary(self) -> str:
+        """Compact per-member path census — one line per fleet member."""
+        lines = [
+            f"Penalized fleet: {self.n_models} x {self.family}({self.link}) "
+            f"paths [alpha={self.alpha:g}, n_lambda={self.n_lambda}, "
+            f"bucket={self.bucket}, batch={self.batch}]",
+            f"{self.group_name:>16}  n_ok  lam_max    lam_min    df_max  "
+            "dev_ratio_max",
+        ]
+        for k, name in enumerate(self.group_names):
+            lines.append(
+                f"{str(name):>16}  {int(self.n_ok[k]):4d}  "
+                f"{float(self.lambdas[k, 0]):<9.4g}  "
+                f"{float(self.lambdas[k, -1]):<9.4g}  "
+                f"{int(self.df[k].max(initial=0)):6d}  "
+                f"{float(np.max(self.dev_ratio[k], initial=0.0)):.4f}")
+        return "\n".join(lines)
+
+    def save(self, path) -> None:
+        from ..models.serialize import save_model
+        save_model(self, path)
+
+
+def glm_fit_fleet_path(
+    X, y, *,
+    penalty,
+    family="gaussian",
+    link=None,
+    weights=None,
+    offset=None,
+    m=None,
+    xnames=None,
+    yname: str = "y",
+    has_intercept: bool | None = None,
+    labels=None,
+    group_name: str = "group",
+    batch: str = "exact",
+    bucket: int | None = None,
+    min_bucket: int = MIN_BUCKET,
+    kind: str = "glm",
+    verbose: bool = False,
+    trace=None,
+    metrics=None,
+    config: NumericConfig = DEFAULT,
+) -> FleetPathModel:
+    """Fit K stacked elastic-net lambda paths — X (K, n, p); y/weights/
+    offset/m (K, n) — in one compiled fleet-path kernel call.
+
+    The penalized arm of :func:`~sparkglm_tpu.fleet.glm_fit_fleet`
+    (``glm_fleet(..., penalty=ElasticNet(...))`` routes here).  Validation,
+    padding and bucketing mirror the IRLS fleet driver; convergence policy
+    (tol/max_iter/cd tolerances) comes from the shared ElasticNet spec,
+    exactly as on the solo path.
+    """
+    if not isinstance(penalty, ElasticNet):
+        raise TypeError(
+            f"penalty must be an ElasticNet instance, got {type(penalty)!r}")
+    if batch not in BATCH_MODES:
+        raise ValueError(
+            f"batch must be one of {BATCH_MODES}, got {batch!r}")
+    fam, lnk = resolve(family, link)
+    tracer = _obs_trace.as_tracer(trace, verbose=verbose, metrics=metrics)
+
+    X = np.asarray(X)
+    y = np.asarray(y)
+    if X.ndim != 3:
+        raise ValueError(
+            f"fleet design must be stacked (K, n, p), got shape {X.shape} — "
+            "use fit_many(y, X, groups=...) to stack a long-format frame")
+    K, n, p = X.shape
+    if y.shape != (K, n):
+        raise ValueError(f"y must be (K, n) = ({K}, {n}), got {y.shape}")
+    if labels is None:
+        labels = tuple(range(K))
+    labels = tuple(labels)
+    if len(labels) != K:
+        raise ValueError(f"labels must have length K={K}, got {len(labels)}")
+    if xnames is None:
+        xnames = tuple(f"x{i}" for i in range(p))
+    xnames = tuple(xnames)
+
+    def _check2(v, what):
+        v = np.asarray(v)
+        if v.shape != (K, n):
+            raise ValueError(f"{what} must be (K, n) = ({K}, {n}), "
+                             f"got {v.shape}")
+        return v
+
+    use_f64 = X.dtype == np.float64 and x64_enabled()
+    dtype = np.float64 if use_f64 else np.dtype(config.dtype)
+
+    wt64 = (np.ones((K, n), np.float64) if weights is None
+            else _check2(weights, "weights").astype(np.float64))
+    y64 = y.astype(np.float64, copy=True)
+    off64 = (np.zeros((K, n), np.float64) if offset is None
+             else _check2(offset, "offset").astype(np.float64))
+    from ..models.validate import check_finite_vector, check_response_domain
+    valid64 = wt64 > 0
+    check_finite_vector("y", y64[valid64])
+    check_finite_vector("weights", wt64)
+    check_finite_vector("offset", off64)
+    if m is not None:
+        m64 = _check2(m, "m").astype(np.float64)
+        check_finite_vector("m", m64)
+        if fam.name not in ("binomial", "quasibinomial"):
+            raise ValueError(
+                "group sizes m only apply to the (quasi)binomial family")
+        y64 = y64 / np.maximum(m64, 1e-30)
+        wt64 = wt64 * m64
+        valid64 = wt64 > 0
+    check_response_domain(fam.name, y64[valid64])
+    per_wsum = wt64.sum(axis=1)
+    if (per_wsum <= 0.0).any():
+        bad = [str(labels[k]) for k in np.flatnonzero(per_wsum <= 0.0)[:5]]
+        raise ValueError(
+            f"fleet members with zero total weight cannot fit a lambda "
+            f"path (first few: {bad}) — drop them before stacking")
+    if has_intercept is None:
+        from ..models.lm import _detect_intercept
+        has_intercept = (_detect_intercept(X[0][valid64[0]], xnames)
+                         if valid64[0].any() else False)
+    icol = intercept_col(list(xnames), has_intercept)
+
+    pfv = resolve_penalty_vector(penalty, list(xnames), has_intercept, icol)
+    explicit = penalty.resolved_lambdas()
+    auto_grid = explicit is None
+    n_lambda = penalty.grid_size()
+    lmr = penalty.min_ratio(n, p - (1 if icol is not None else 0))
+
+    on_tpu = jax.default_backend() == "tpu"
+    mmp = resolve_matmul_precision(config, n, p, on_tpu)
+
+    # model-axis bucket, as on the IRLS fleet: power-of-2 padding with
+    # all-weight-0 trash models (inert in both path cores — module
+    # docstring) sliced off below
+    B = next_bucket(K, min_bucket) if bucket is None else int(bucket)
+    if B < K:
+        raise ValueError(f"bucket={B} is smaller than the fleet (K={K})")
+    Xb = np.zeros((B, n, p), dtype)
+    yb = np.zeros((B, n), dtype)
+    wb = np.zeros((B, n), dtype)
+    ob = np.zeros((B, n), dtype)
+    Xb[:K] = X.astype(dtype, copy=False)
+    yb[:K] = y64.astype(dtype)
+    wb[:K] = wt64.astype(dtype)
+    ob[:K] = off64.astype(dtype)
+
+    alpha_in = np.asarray(penalty.alpha, dtype)
+    pf_in = pfv.astype(dtype)
+    lam_in = (np.zeros((n_lambda,), dtype) if auto_grid
+              else explicit.astype(dtype))
+    lmr_in = np.asarray(lmr, dtype)
+    cd_tol_in = np.asarray(penalty.cd_tol, dtype)
+    gaussian_identity = fam.name == "gaussian" and lnk.name == "identity"
+
+    if tracer is not None:
+        tracer.emit("fleet_path_start", models=K, bucket=B, n_rows=n, p=p,
+                    family=fam.name, link=lnk.name, batch=batch,
+                    alpha=float(penalty.alpha), n_lambda=n_lambda)
+
+    n_exec0 = fleet_path_kernel_cache_size()
+    from ..obs import timing as _obs_timing
+    with _obs_timing.span("fleet_path_kernel", tracer, device=True) as _sp:
+        if gaussian_identity:
+            out = _fleet_gram_path_kernel(
+                Xb, yb, wb, ob, lam_in, lmr_in, alpha_in, pf_in, cd_tol_in,
+                auto_grid=auto_grid, n_lambda=n_lambda,
+                standardize=penalty.standardize, icol=icol,
+                cd_max_sweeps=penalty.cd_max_sweeps,
+                kkt_rounds=_KKT_ROUNDS, precision=mmp, batch=batch)
+            target = "fleet_gram_path"
+        else:
+            out = _fleet_glm_path_kernel(
+                Xb, yb, wb, ob, lam_in, lmr_in, alpha_in, pf_in,
+                np.asarray(penalty.tol, dtype), cd_tol_in,
+                fam.param_operand(dtype), family=fam, link=lnk,
+                auto_grid=auto_grid, n_lambda=n_lambda,
+                standardize=penalty.standardize, icol=icol,
+                max_iter=penalty.max_iter,
+                cd_max_sweeps=penalty.cd_max_sweeps,
+                kkt_rounds=_KKT_ROUNDS, precision=mmp, batch=batch)
+            target = "fleet_glm_path"
+        _sp.watch(out)
+    out = jax.tree.map(np.asarray, out)
+    executables = fleet_path_kernel_cache_size() - n_exec0
+    if tracer is not None:
+        if executables:
+            tracer.emit("compile", target=target, seconds=_sp.seconds,
+                        gramian_engine="fleet", models=B, rows=n, cols=p)
+        tracer.emit("solve", target=target,
+                    iters=int(out["iters"][:K].sum()) if K else 0,
+                    seconds=_sp.seconds, gramian_engine="fleet",
+                    models=B, rows=n, cols=p)
+
+    lambdas = out["lambdas"][:K].astype(np.float64)
+    betas = out["beta"][:K].astype(np.float64)
+    dev = out["dev"][:K].astype(np.float64)
+    null_dev = out["null_dev"][:K].astype(np.float64)
+    df = out["df"][:K].astype(np.int64)
+    conv = out["conv"][:K].astype(bool)
+    kkt_ok = out["kkt_ok"][:K].astype(bool)
+    iters = out["iters"][:K].astype(np.int64)
+    sweeps = out["sweeps"][:K].astype(np.int64)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        dev_ratio = np.where(null_dev[:, None] > 0,
+                             1.0 - dev / null_dev[:, None], 0.0)
+    n_ok = (wt64 > 0).sum(axis=1).astype(np.int64)
+    has_off_k = (np.array([bool(np.any(off64[k] != 0)) for k in range(K)])
+                 if offset is not None else np.zeros(K, bool))
+
+    bad_members = int((~conv.all(axis=1)).sum())
+    if bad_members:
+        warnings.warn(
+            f"penalized fleet: {bad_members}/{K} members have lambda "
+            f"points that hit the iteration cap "
+            f"(max_iter={penalty.max_iter}, "
+            f"cd_max_sweeps={penalty.cd_max_sweeps}) before reaching "
+            f"tol={penalty.tol:g}; estimates there may be loose",
+            stacklevel=2)
+
+    fit_info = None
+    if tracer is not None:
+        tracer.emit("fleet_path_end", models=K, bucket=B,
+                    converged=int(conv.all(axis=1).sum()),
+                    kkt_clean=int(kkt_ok.all(axis=1).sum()),
+                    executables=int(executables),
+                    irls_iters_total=int(iters.sum()),
+                    cd_sweeps_total=int(sweeps.sum()), batch=batch)
+        fit_info = tracer.report()
+        fit_info["fleet_path"] = {
+            "models": int(K), "bucket": int(B),
+            "n_lambda": int(n_lambda), "alpha": float(penalty.alpha),
+            "executables": int(executables),
+            "irls_iters_total": int(iters.sum()),
+            "cd_sweeps_total": int(sweeps.sum()),
+        }
+
+    return FleetPathModel(
+        lambdas=lambdas, coefficients=betas, df=df, deviance=dev,
+        dev_ratio=np.asarray(dev_ratio, np.float64),
+        null_deviance=null_dev, converged=conv, kkt_clean=kkt_ok,
+        iterations=iters, sweeps=sweeps, n_ok=n_ok, has_offset=has_off_k,
+        alpha=float(penalty.alpha), group_names=labels,
+        group_name=group_name, xnames=xnames, yname=yname, family=fam.name,
+        link=lnk.name, n_obs=n, n_params=p,
+        has_intercept=bool(has_intercept),
+        standardize=bool(penalty.standardize), penalty=penalty,
+        dispersion_fixed=bool(fam.dispersion_fixed), batch=batch,
+        bucket=B, kind=kind, fit_info=fit_info)

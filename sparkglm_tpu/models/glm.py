@@ -373,10 +373,7 @@ def _segmented_irls(run_kernel, *, p, dtype, max_iter: int,
     return out
 
 
-@partial(jax.jit, static_argnames=("family", "link", "criterion", "trace",
-                                   "precision", "warm", "m", "sketch_refine",
-                                   "sketch_method"))
-def _irls_sketch_kernel(
+def _irls_sketch_core(
     X, y, wt, offset, key,
     tol, max_iter, jitter,
     family: Family, link: Link,
@@ -393,6 +390,12 @@ def _irls_sketch_kernel(
 ):
     """Sketched IRLS (sketch-and-precondition Hessian solves) to
     convergence in one compiled while_loop — ``engine="sketch"``.
+
+    Undecorated, like :func:`_irls_core`: :func:`_irls_sketch_kernel`
+    jits it for the solo path, and the fleet kernel
+    (fleet/kernel.py) maps it over the model axis with a SHARED base
+    key, so a fleet member's sketch sequence is the solo fit's with the
+    same seed.
 
     Per iteration the exact weighted Gramian ``G = X'WX`` is never formed.
     Instead the Gramian of a seeded m-row sketch of ``sqrt(W) X``
@@ -569,6 +572,34 @@ def _irls_sketch_kernel(
                 converged=converged, singular=s["singular"],
                 pivot=s["pivot"],
                 XtWX0=jnp.zeros((p, p), acc))
+
+
+@partial(jax.jit, static_argnames=("family", "link", "criterion", "trace",
+                                   "precision", "warm", "m", "sketch_refine",
+                                   "sketch_method"))
+def _irls_sketch_kernel(
+    X, y, wt, offset, key,
+    tol, max_iter, jitter,
+    family: Family, link: Link,
+    criterion: str = "absolute",
+    m: int = 64,
+    sketch_refine: int = 8,
+    sketch_method: str = "countsketch",
+    trace: bool = False,
+    precision=None,
+    beta0=None,
+    warm: bool = False,
+    it_base=None,
+    fam_param=None,
+):
+    """The jitted solo entry over :func:`_irls_sketch_core` — one
+    executable per (shape, static-arg) flavor, mirroring
+    ``_irls_core``/``_irls_kernel``."""
+    return _irls_sketch_core(
+        X, y, wt, offset, key, tol, max_iter, jitter, family, link,
+        criterion=criterion, m=m, sketch_refine=sketch_refine,
+        sketch_method=sketch_method, trace=trace, precision=precision,
+        beta0=beta0, warm=warm, it_base=it_base, fam_param=fam_param)
 
 
 @partial(jax.jit, static_argnames=("family", "link", "mesh", "steps"))

@@ -146,6 +146,7 @@ def _build(cls, meta: dict, arrays: dict):
 
 def load_model(path: str):
     from ..fleet.model import FleetModel
+    from ..fleet.path import FleetPathModel
     from ..online.loop import OnlineLoop
     from ..serve.registry import ModelFamily
 
@@ -156,6 +157,7 @@ def load_model(path: str):
     fmt = meta.pop("__format__", 1)
     schema = int(meta.pop("schema_version", fmt))
     classes = dict(_member_classes(), FleetModel=FleetModel,
+                   FleetPathModel=FleetPathModel,
                    ModelFamily=ModelFamily, OnlineLoop=OnlineLoop)
     if cls_name not in classes:
         raise ValueError(

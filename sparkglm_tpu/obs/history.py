@@ -86,6 +86,13 @@ BLOCKS: dict[str, dict] = {
                      "kind": "value"},
     "fleet_fit": {"metric": "speedup_s_per_model", "direction": "higher",
                   "kind": "value"},
+    # r20 fleet scale axes: the batched lambda-path kernel vs K
+    # sequential solo paths, and the member-sharded mesh fleet vs the
+    # single-device fleet at the same bucket
+    "fleet_lambda_path": {"metric": "speedup_vs_solo_paths",
+                          "direction": "higher", "kind": "value"},
+    "fleet_mesh_scaling": {"metric": "speedup_vs_unsharded",
+                           "direction": "higher", "kind": "value"},
     "online_refresh": {"metric": "chunks_per_s_sustained",
                        "direction": "higher", "kind": "value"},
     "capacity_observatory": {"metric": "overhead_frac", "direction": "lower",

@@ -183,12 +183,13 @@ _GLM_STATICS = ("family", "link", "auto_grid", "n_lambda", "standardize",
                 "precision", "trace")
 
 
-@functools.partial(jax.jit, static_argnames=_GLM_STATICS)
-def _glm_path_kernel(X, y, wt, off, lambdas, lmr, alpha, pf, tol, cd_tol,
-                     fam_param, *, family, link, auto_grid, n_lambda,
-                     standardize, icol, max_iter, cd_max_sweeps,
-                     kkt_rounds, precision, trace):
-    """The whole GLM lambda-path in one executable (module docstring)."""
+def _glm_path_core(X, y, wt, off, lambdas, lmr, alpha, pf, tol, cd_tol,
+                   fam_param, *, family, link, auto_grid, n_lambda,
+                   standardize, icol, max_iter, cd_max_sweeps,
+                   kkt_rounds, precision, trace):
+    """The whole GLM lambda-path (module docstring) — undecorated so the
+    fleet path kernel (fleet/path.py) can map it over a stacked model
+    axis; :func:`_glm_path_kernel` is the jitted solo entry."""
     family = family.with_param(fam_param)
     dt = X.dtype
     acc = jnp.float64 if dt == jnp.float64 else jnp.float32
@@ -316,14 +317,27 @@ def _glm_path_kernel(X, y, wt, off, lambdas, lmr, alpha, pf, tol, cd_tol,
     return dict(lambdas=lams, null_dev=null_dev, b0=b0, sd=sd, **ys)
 
 
+@functools.partial(jax.jit, static_argnames=_GLM_STATICS)
+def _glm_path_kernel(X, y, wt, off, lambdas, lmr, alpha, pf, tol, cd_tol,
+                     fam_param, *, family, link, auto_grid, n_lambda,
+                     standardize, icol, max_iter, cd_max_sweeps,
+                     kkt_rounds, precision, trace):
+    """The whole GLM lambda-path in one executable (module docstring)."""
+    return _glm_path_core(
+        X, y, wt, off, lambdas, lmr, alpha, pf, tol, cd_tol, fam_param,
+        family=family, link=link, auto_grid=auto_grid, n_lambda=n_lambda,
+        standardize=standardize, icol=icol, max_iter=max_iter,
+        cd_max_sweeps=cd_max_sweeps, kkt_rounds=kkt_rounds,
+        precision=precision, trace=trace)
+
+
 _GRAM_STATICS = ("auto_grid", "n_lambda", "standardize", "icol",
                  "cd_max_sweeps", "kkt_rounds", "trace")
 
 
-@functools.partial(jax.jit, static_argnames=_GRAM_STATICS)
-def _gram_path_kernel(A, b, s1, yty, wsum, lambdas, lmr, alpha, pf, cd_tol,
-                      *, auto_grid, n_lambda, standardize, icol,
-                      cd_max_sweeps, kkt_rounds, trace):
+def _gram_path_core(A, b, s1, yty, wsum, lambdas, lmr, alpha, pf, cd_tol,
+                    *, auto_grid, n_lambda, standardize, icol,
+                    cd_max_sweeps, kkt_rounds, trace):
     """Gaussian/identity lambda-path from an ACCUMULATED weighted Gramian.
 
     ``A = X'WX``, ``b = X'Wz``, ``s1 = X'W1``, ``yty = z'Wz`` with
@@ -419,11 +433,23 @@ def _gram_path_kernel(A, b, s1, yty, wsum, lambdas, lmr, alpha, pf, cd_tol,
                 b0=b0, sd=sd, **ys)
 
 
-@functools.partial(jax.jit, static_argnames=("precision",))
-def _quad_stats_kernel(X, y, wt, off, *, precision):
+@functools.partial(jax.jit, static_argnames=_GRAM_STATICS)
+def _gram_path_kernel(A, b, s1, yty, wsum, lambdas, lmr, alpha, pf, cd_tol,
+                      *, auto_grid, n_lambda, standardize, icol,
+                      cd_max_sweeps, kkt_rounds, trace):
+    """Jitted solo entry over :func:`_gram_path_core` (docstring there)."""
+    return _gram_path_core(
+        A, b, s1, yty, wsum, lambdas, lmr, alpha, pf, cd_tol,
+        auto_grid=auto_grid, n_lambda=n_lambda, standardize=standardize,
+        icol=icol, cd_max_sweeps=cd_max_sweeps, kkt_rounds=kkt_rounds,
+        trace=trace)
+
+
+def _quad_stats_core(X, y, wt, off, *, precision):
     """Single data pass feeding :func:`_gram_path_kernel` for resident
     gaussian/identity fits: the averaged Gramian, column means, response
-    quadratic and raw weight sum."""
+    quadratic and raw weight sum.  Undecorated for the fleet path kernel;
+    :func:`_quad_stats_kernel` is the jitted solo entry."""
     dt = X.dtype
     acc = jnp.float64 if dt == jnp.float64 else jnp.float32
     wsum = jnp.sum(wt.astype(acc))
@@ -435,6 +461,12 @@ def _quad_stats_kernel(X, y, wt, off, *, precision):
     yty = jnp.sum(wp.astype(acc) * za * za)
     return dict(A=A.astype(acc), b=b.astype(acc), s1=s1.astype(acc),
                 yty=yty, wsum=wsum)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _quad_stats_kernel(X, y, wt, off, *, precision):
+    """Jitted solo entry over :func:`_quad_stats_core`."""
+    return _quad_stats_core(X, y, wt, off, precision=precision)
 
 
 @functools.partial(jax.jit, static_argnames=("cd_max_sweeps",))

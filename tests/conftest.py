@@ -80,6 +80,11 @@ def pytest_configure(config):
         "resume, reader-death re-reads (`make ingest` selects these; "
         "still tier-1 by default)")
     config.addinivalue_line(
+        "markers", "fleet_lattice: the capability lattice + PR 20 fleet "
+        "axes — exhaustive fit-or-pointed-error walk, penalized/sketch/"
+        "mesh fleet parity (`make fleet_lattice` selects these; still "
+        "tier-1 by default)")
+    config.addinivalue_line(
         "markers", "robustreg: robust/quantile pseudo-families, the "
         "batched tau path, and differentially private Gramians (`make "
         "robustreg` selects these; still tier-1 by default — distinct "

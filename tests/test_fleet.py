@@ -217,21 +217,34 @@ class TestIngestion:
                                       fleet[1].coefficients)
 
     def test_front_end_guards(self, rng):
+        # PR 20 legalized engine="sketch", penalty= and mesh= as fleet
+        # axes; what REMAINS refused flows through the capability table
+        # (sparkglm_tpu/capabilities.py) as a typed CapabilityError —
+        # still a ValueError, so existing match= idioms keep working.
         n = 60
         data = {"y": (rng.random(n) < 0.5).astype(float),
                 "x1": rng.normal(size=n),
                 "seg": rng.choice(["a", "b"], n)}
-        with pytest.raises(ValueError, match="sketch"):
-            sg.glm_fleet("y ~ x1", data, groups="seg", engine="sketch")
-        with pytest.raises(ValueError, match="elastic"):
+        enet = sg.ElasticNet(alpha=1.0)
+        with pytest.raises(sg.CapabilityError, match="elastic"):
             sg.glm_fleet("y ~ x1", data, groups="seg", engine="elastic")
-        with pytest.raises(ValueError, match="penalty"):
-            sg.glm_fleet("y ~ x1", data, groups="seg",
-                         penalty=sg.ElasticNet(alpha=1.0))
-        with pytest.raises(ValueError, match="structured"):
+        with pytest.raises(sg.CapabilityError, match="engine"):
+            sg.glm_fleet("y ~ x1", data, groups="seg", engine="qr")
+        with pytest.raises(sg.CapabilityError, match="structured"):
             sg.glm_fleet("y ~ x1", data, groups="seg", design="structured")
-        with pytest.raises(ValueError, match="mesh"):
-            sg.glm_fleet("y ~ x1", data, groups="seg", mesh=object())
+        # the still-refused PAIRWISE combos of the new axes
+        with pytest.raises(sg.CapabilityError, match="mesh"):
+            sg.glm_fleet("y ~ x1", data, groups="seg", penalty=enet,
+                         mesh=sg.single_device_mesh())
+        with pytest.raises(sg.CapabilityError, match="sketch"):
+            sg.glm_fleet("y ~ x1", data, groups="seg", penalty=enet,
+                         engine="sketch")
+        with pytest.raises(sg.CapabilityError, match="start"):
+            sg.glm_fleet("y ~ x1", data, groups="seg", penalty=enet,
+                         start=np.zeros((2, 2)))
+        with pytest.raises(sg.CapabilityError, match="beta0"):
+            sg.glm_fleet("y ~ x1", data, groups="seg",
+                         beta0=np.zeros(2))
         with pytest.raises(KeyError, match="nope"):
             sg.glm_fleet("y ~ x1", data, groups="nope")
 
@@ -252,6 +265,32 @@ class TestSerialization:
             a, b = tmp_path / f"a{k}.npz", tmp_path / f"b{k}.npz"
             sg.save_model(fleet[k], str(a))
             sg.save_model(back[k], str(b))
+            assert a.read_bytes() == b.read_bytes()
+
+    def test_mesh_fleet_members_serialize_byte_identical(self, rng,
+                                                         tmp_path):
+        # the r14 byte-determinism contract extended to the mesh axis
+        # (PR 20): a MEMBER-sharded fleet gathers its results to host at
+        # fit time, so indexing and serialization never see the sharding
+        # — sg.save_model(mesh_fleet[k]) is byte-for-byte the unsharded
+        # fleet's member at the same bucket
+        groups, X, y = _segments(rng, [120, 80, 100])
+        mesh = sg.make_mesh()
+        n_dev = mesh.shape["data"]
+        bucket = max(8, n_dev)  # divisible by the shard count
+        sharded = fit_many(y, X, groups=groups, family="binomial",
+                           has_intercept=True, mesh=mesh, bucket=bucket)
+        plain = fit_many(y, X, groups=groups, family="binomial",
+                         has_intercept=True, bucket=bucket)
+        assert sharded.n_member_shards == n_dev
+        assert plain.n_member_shards == 1
+        np.testing.assert_array_equal(sharded.coefficients,
+                                      plain.coefficients)
+        np.testing.assert_array_equal(sharded.iterations, plain.iterations)
+        for k in range(len(plain)):
+            a, b = tmp_path / f"m{k}.npz", tmp_path / f"u{k}.npz"
+            sg.save_model(sharded[k], str(a))
+            sg.save_model(plain[k], str(b))
             assert a.read_bytes() == b.read_bytes()
 
     def test_family_roundtrip_with_deploy_history(self, rng, tmp_path):

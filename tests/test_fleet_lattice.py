@@ -1,0 +1,338 @@
+"""The capability lattice + the PR 20 fleet scale axes.
+
+Two subsystems under one marker (``fleet_lattice``):
+
+  * ``sparkglm_tpu/capabilities.py`` — EVERY refusal in the system lives
+    in one declarative table.  The exhaustive walk iterates all
+    design x engine x penalty x execution cells and asserts
+    fit-or-pointed-error: a refused cell's reason names what to do
+    instead, a fitting cell has no rule, and the fleet slice is driven
+    through :func:`sparkglm_tpu.glm_fit_fleet` for real (no cell is
+    silently ignored).
+  * the three fleet axes the lattice legalized — ``penalty=ElasticNet``
+    (batched lambda-path kernel), ``engine="sketch"`` (per-member
+    sketched Gramian), ``mesh=`` (member-sharded fleet) — each proven
+    against its solo oracle: penalized members BIT-identical to
+    ``fit_path`` at the padded layout with identical lambda grids,
+    sketch members matching the solo sketch fit at the same seed,
+    mesh fleets bit-identical to the single-device fleet with equal
+    iteration counts.  Serving and serialization compose with zero new
+    code paths.
+"""
+
+import numpy as np
+import pytest
+
+import sparkglm_tpu as sg
+from sparkglm_tpu import capabilities as caps
+from sparkglm_tpu.data.groups import stack_groups
+from sparkglm_tpu.fleet import (FleetModel, FleetPathModel, glm_fit_fleet,
+                                fit_many)
+from sparkglm_tpu.penalized.path import fit_path
+from sparkglm_tpu.serve import ModelFamily
+
+pytestmark = pytest.mark.fleet_lattice
+
+
+def _segments(rng, sizes, p=3):
+    groups, Xr, yr = [], [], []
+    for g, size in enumerate(sizes):
+        X = np.column_stack([np.ones(size),
+                             rng.normal(size=(size, p - 1))])
+        beta = rng.normal(size=p) * (0.3 + 0.6 * g)
+        eta = X @ beta
+        y = (rng.random(size) < 1 / (1 + np.exp(-eta))).astype(float)
+        groups += [f"g{g}"] * size
+        Xr.append(X)
+        yr.append(y)
+    return np.array(groups), np.vstack(Xr), np.concatenate(yr)
+
+
+def _stacked(rng, K=3, n=80, p=3):
+    X = np.zeros((K, n, p))
+    X[..., 0] = 1.0
+    X[..., 1:] = rng.normal(size=(K, n, p - 1))
+    beta = rng.normal(size=(K, p)) * 0.7
+    eta = np.einsum("knp,kp->kn", X, beta)
+    y = (rng.random((K, n)) < 1 / (1 + np.exp(-eta))).astype(float)
+    return X, y
+
+
+class TestLatticeTable:
+    def test_walk_is_exhaustive_and_every_refusal_is_pointed(self):
+        cells = dict(caps.lattice())
+        # the full cross product, no cell missing
+        n_expected = (len(caps.AXES["design"]) * len(caps.AXES["engine"])
+                      * len(caps.AXES["penalty"])
+                      * len(caps.AXES["execution"]))
+        assert len(cells) == n_expected
+        for cell, reason in cells.items():
+            if reason is None:
+                continue  # fits
+            # a POINTED refusal: explains the why and names the
+            # supported alternative (use/drop/fit/pass/densify/name)
+            assert isinstance(reason, str) and len(reason) > 40, cell
+            assert any(w in reason for w in (
+                "use ", "drop ", "fit ", "pass ", "densify", "name ",
+                "stream", "engine=")), (cell, reason)
+
+    def test_known_cells(self):
+        # the three combos PR 20 legalized all FIT
+        assert caps.refusal(execution="fleet", penalty="elastic-net") is None
+        assert caps.refusal(execution="fleet", engine="sketch") is None
+        assert caps.refusal(execution="fleet") is None
+        # structural identities stay refused
+        assert caps.refusal(design="dense", engine="segment-sum")
+        assert caps.refusal(design="structured", engine="exact")
+        assert caps.refusal(design="structured", engine="sketch")
+        # solo exact dense is the origin cell
+        assert caps.refusal() is None
+
+    def test_capability_error_is_typed_and_legible(self):
+        with pytest.raises(caps.CapabilityError) as ei:
+            caps.check(penalty="elastic-net", execution="mesh")
+        e = ei.value
+        assert isinstance(e, ValueError)  # old match= idioms keep working
+        assert e.cell["penalty"] == "elastic-net"
+        assert e.cell["execution"] == "mesh"
+        assert e.reason in str(e)
+        assert "unsupported capability" in str(e)
+        # axis vocabulary is validated, not silently accepted
+        with pytest.raises(ValueError, match="engine must be one of"):
+            caps.refusal(engine="warp-drive")
+
+    def test_package_exports(self):
+        assert sg.CapabilityError is caps.CapabilityError
+        assert dict(sg.capability_lattice()) == dict(caps.lattice())
+        assert sg.capability_refusal(execution="fleet",
+                                     design="sparse") is not None
+
+    def test_fleet_slice_fit_or_refuse(self, rng):
+        # drive the fleet execution slice for REAL: every
+        # (engine, penalty, mesh) combination either fits to the
+        # documented model type or raises the table's CapabilityError
+        X, y = _stacked(rng, K=2, n=60)
+        enet = sg.ElasticNet(alpha=1.0, n_lambda=6)
+        mesh = sg.single_device_mesh()
+        kw = dict(family="binomial", has_intercept=True)
+        # fits
+        assert isinstance(glm_fit_fleet(X, y, **kw), FleetModel)
+        assert isinstance(glm_fit_fleet(X, y, engine="sketch", **kw),
+                          FleetModel)
+        assert isinstance(glm_fit_fleet(X, y, mesh=mesh, **kw), FleetModel)
+        assert isinstance(glm_fit_fleet(X, y, engine="sketch", mesh=mesh,
+                                        **kw), FleetModel)
+        assert isinstance(glm_fit_fleet(X, y, penalty=enet, **kw),
+                          FleetPathModel)
+        # refusals, all through the central table
+        with pytest.raises(caps.CapabilityError, match="mesh"):
+            glm_fit_fleet(X, y, penalty=enet, mesh=mesh, **kw)
+        with pytest.raises(caps.CapabilityError, match="sketch"):
+            glm_fit_fleet(X, y, penalty=enet, engine="sketch", **kw)
+        with pytest.raises(caps.CapabilityError, match="elastic"):
+            glm_fit_fleet(X, y, engine="elastic", **kw)
+
+
+class TestPenalizedFleetParity:
+    def test_members_bit_identical_to_solo_paths_at_padded_layout(
+            self, rng):
+        # the tentpole contract: the batched lambda-path kernel is the
+        # SOLO path kernel vmapped — at float64 with batch="exact" every
+        # member's grid, coefficients and deviance equal a solo fit_path
+        # of the same padded row layout EXACTLY
+        groups, X, y = _segments(rng, [90, 60, 75])
+        labels, Xs, ys, ws, offs, n_real = stack_groups(groups, X, y)
+        enet = sg.ElasticNet(alpha=0.9, n_lambda=12)
+        fleet = glm_fit_fleet(Xs, ys, weights=ws, penalty=enet,
+                              family="binomial", has_intercept=True,
+                              labels=labels)
+        assert isinstance(fleet, FleetPathModel)
+        assert fleet.n_lambda == 12
+        for k in range(fleet.n_models):
+            solo = fit_path(Xs[k], ys[k], weights=ws[k], penalty=enet,
+                            family="binomial", has_intercept=True)
+            np.testing.assert_array_equal(fleet.lambdas[k], solo.lambdas)
+            np.testing.assert_array_equal(fleet.coefficients[k],
+                                          solo.coefficients)
+            np.testing.assert_array_equal(fleet.deviance[k], solo.deviance)
+            np.testing.assert_array_equal(fleet.df[k], solo.df)
+            assert fleet.null_deviance[k] == solo.null_deviance
+            # the indexed member is an ordinary PathModel with the same
+            # path and the same selection behavior
+            pm = fleet[k]
+            np.testing.assert_array_equal(pm.coefficients,
+                                          solo.coefficients)
+            for crit in ("aic", "bic"):
+                a = pm.select(criterion=crit)
+                b = solo.select(criterion=crit)
+                np.testing.assert_array_equal(a.coefficients,
+                                              b.coefficients)
+
+    def test_gaussian_gram_branch_matches_solo(self, rng):
+        # gaussian/identity takes the fused quad-stats + Gramian-path
+        # kernel pair; same bit-identity contract
+        K, n, p = 3, 70, 4
+        X = np.zeros((K, n, p))
+        X[..., 0] = 1.0
+        X[..., 1:] = rng.normal(size=(K, n, p - 1))
+        y = np.einsum("knp,kp->kn", X, rng.normal(size=(K, p)))
+        y += 0.3 * rng.normal(size=(K, n))
+        enet = sg.ElasticNet(alpha=1.0, n_lambda=10)
+        fleet = glm_fit_fleet(X, y, penalty=enet, family="gaussian",
+                              has_intercept=True)
+        for k in range(K):
+            solo = fit_path(X[k], y[k], penalty=enet, family="gaussian",
+                            has_intercept=True)
+            np.testing.assert_array_equal(fleet.lambdas[k], solo.lambdas)
+            np.testing.assert_array_equal(fleet.coefficients[k],
+                                          solo.coefficients)
+
+    def test_formula_front_end_matches_solo_glm(self, rng):
+        # glm_fleet(penalty=) member vs sg.glm(penalty=) on the member's
+        # own rows: lambda grids identical, coefficients <= 1e-10 (the
+        # solo fit runs at the UNPADDED layout, so bit-identity is not
+        # the claim here — PARITY.md "layout-held bit-identity")
+        n = 240
+        seg = rng.choice(["a", "b", "c"], n)
+        data = {"x1": rng.normal(size=n), "x2": rng.normal(size=n),
+                "seg": seg}
+        eta = 0.4 + 0.8 * data["x1"] - 0.5 * data["x2"]
+        data["y"] = (rng.random(n) < 1 / (1 + np.exp(-eta))).astype(float)
+        enet = sg.ElasticNet(alpha=1.0, n_lambda=8)
+        fleet = sg.glm_fleet("y ~ x1 + x2", data, groups="seg",
+                             family="binomial", penalty=enet)
+        assert isinstance(fleet, FleetPathModel)
+        assert fleet.formula == "y ~ x1 + x2"
+        for lbl in fleet.group_names:
+            rows = seg == lbl
+            sub = {k: np.asarray(v)[rows] for k, v in data.items()}
+            solo = sg.glm("y ~ x1 + x2", sub, family="binomial",
+                          penalty=enet)
+            k = fleet.index_of(lbl)
+            np.testing.assert_allclose(fleet.lambdas[k], solo.lambdas,
+                                       rtol=1e-10)
+            np.testing.assert_allclose(fleet.coefficients[k],
+                                       solo.coefficients,
+                                       rtol=1e-10, atol=1e-10)
+
+    def test_select_composes_with_serving(self, rng):
+        # select() -> FleetModel -> ModelFamily: ZERO new serving code
+        groups, X, y = _segments(rng, [100, 80])
+        enet = sg.ElasticNet(alpha=1.0, n_lambda=8)
+        path = fit_many(y, X, groups=groups, family="binomial",
+                        has_intercept=True, penalty=enet)
+        best = path.select(criterion="bic")
+        assert isinstance(best, FleetModel)
+        assert np.isnan(best.std_errors).all()  # no post-selection Wald
+        fam = ModelFamily.from_fleet(best, "lasso")
+        Xn = np.column_stack([np.ones(6), rng.normal(size=(6, 2))])
+        out = fam.scorer(type="link").score(["g1"] * 6, Xn)
+        ref = best.predict(Xn, "g1")
+        np.testing.assert_allclose(out, ref, rtol=1e-12)
+
+    def test_roundtrip(self, rng, tmp_path):
+        groups, X, y = _segments(rng, [80, 60])
+        enet = sg.ElasticNet(alpha=0.8, n_lambda=7)
+        path = fit_many(y, X, groups=groups, family="binomial",
+                        has_intercept=True, penalty=enet)
+        fp = tmp_path / "fleetpath.npz"
+        path.save(str(fp))
+        back = sg.load_model(str(fp))
+        assert isinstance(back, FleetPathModel)
+        assert back.group_names == path.group_names
+        assert back.penalty.alpha == enet.alpha
+        np.testing.assert_array_equal(back.lambdas, path.lambdas)
+        np.testing.assert_array_equal(back.coefficients, path.coefficients)
+        # a member indexed out of the restored path selects identically
+        a = back[0].select(criterion="aic")
+        b = path[0].select(criterion="aic")
+        np.testing.assert_array_equal(a.coefficients, b.coefficients)
+
+
+class TestSketchFleetParity:
+    def test_members_match_solo_sketch_same_seed(self, rng):
+        # the fleet shares ONE base sketch key across members (each
+        # member folds in its own iteration counter) — exactly the solo
+        # seed semantics, so member k equals a solo engine="sketch" fit
+        # of the same padded layout at the same config seed
+        X, y = _stacked(rng, K=4, n=90, p=4)
+        fleet = glm_fit_fleet(X, y, family="binomial", engine="sketch",
+                              has_intercept=True)
+        assert fleet.engine == "sketch"
+        assert fleet.sketch_dim is not None
+        for k in range(len(fleet)):
+            solo = sg.glm_fit(X[k], y[k], family="binomial",
+                              engine="sketch", has_intercept=True)
+            np.testing.assert_allclose(fleet.coefficients[k],
+                                       solo.coefficients,
+                                       rtol=1e-10, atol=1e-12)
+            assert int(fleet.iterations[k]) == int(solo.iterations)
+            m = fleet[k]
+            assert m.gramian_engine == "sketch"
+            assert m.sketch_dim == solo.sketch_dim
+            assert np.isnan(m.std_errors).all()  # sketch = point estimates
+            assert m.cov_unscaled is None
+
+    def test_sketch_fleet_serves(self, rng):
+        X, y = _stacked(rng, K=3, n=80)
+        fleet = glm_fit_fleet(X, y, family="binomial", engine="sketch",
+                              has_intercept=True,
+                              labels=("a", "b", "c"))
+        fam = ModelFamily.from_fleet(fleet, "sketchy")
+        Xn = np.column_stack([np.ones(5), rng.normal(size=(5, 2))])
+        out = fam.scorer(type="response").score(["b"] * 5, Xn)
+        ref = fleet.predict(Xn, "b", type="response")
+        np.testing.assert_allclose(out, ref, rtol=1e-12)
+
+
+class TestMeshFleetParity:
+    def test_mesh_fleet_bit_identical_to_single_device(self, rng):
+        # shard_map over the member axis runs the SAME per-member graph
+        # as the single-device kernel — coefficients are bit-identical
+        # and iteration counts equal, at any member count (the bucket is
+        # rounded up to a per-shard power of two)
+        X, y = _stacked(rng, K=5, n=70, p=4)
+        mesh = sg.make_mesh()
+        n_dev = mesh.shape["data"]
+        sharded = glm_fit_fleet(X, y, family="binomial",
+                                has_intercept=True, mesh=mesh)
+        plain = glm_fit_fleet(X, y, family="binomial", has_intercept=True,
+                              bucket=sharded.bucket)
+        assert sharded.n_member_shards == n_dev
+        assert sharded.bucket % n_dev == 0
+        np.testing.assert_array_equal(sharded.coefficients,
+                                      plain.coefficients)
+        np.testing.assert_array_equal(sharded.std_errors, plain.std_errors)
+        np.testing.assert_array_equal(sharded.iterations, plain.iterations)
+        np.testing.assert_array_equal(sharded.converged, plain.converged)
+        # indexing gathers from the owning shard transparently
+        for k in (0, 4):
+            np.testing.assert_array_equal(sharded[k].coefficients,
+                                          plain[k].coefficients)
+
+    def test_mesh_composes_with_sketch_engine(self, rng):
+        X, y = _stacked(rng, K=3, n=80)
+        mesh = sg.make_mesh()
+        ms = glm_fit_fleet(X, y, family="binomial", engine="sketch",
+                           has_intercept=True, mesh=mesh)
+        ss = glm_fit_fleet(X, y, family="binomial", engine="sketch",
+                           has_intercept=True, bucket=ms.bucket)
+        np.testing.assert_array_equal(ms.coefficients, ss.coefficients)
+        np.testing.assert_array_equal(ms.iterations, ss.iterations)
+
+    def test_mesh_fleet_online_update_composes(self, rng):
+        # the online warm-start path (start=) rides the mesh axis with
+        # zero new code: refit warm on the same mesh, same answer as the
+        # unsharded warm refit
+        X, y = _stacked(rng, K=3, n=80)
+        mesh = sg.make_mesh()
+        cold = glm_fit_fleet(X, y, family="binomial", has_intercept=True,
+                             mesh=mesh)
+        warm_m = glm_fit_fleet(X, y, family="binomial", has_intercept=True,
+                               mesh=mesh, start=cold.coefficients)
+        warm_s = glm_fit_fleet(X, y, family="binomial", has_intercept=True,
+                               bucket=cold.bucket,
+                               start=cold.coefficients)
+        np.testing.assert_array_equal(warm_m.coefficients,
+                                      warm_s.coefficients)
+        np.testing.assert_array_equal(warm_m.iterations, warm_s.iterations)

@@ -364,8 +364,8 @@ def test_bench_history_reports_ok_flips_as_warnings_only():
     assert report["regressions"] == [] and report["ok"]
 
 
-def test_blocks_registry_matches_r19_detail():
-    with open(os.path.join(REPO, "benchmarks", "BENCH_r19.json")) as f:
+def test_blocks_registry_matches_r20_detail():
+    with open(os.path.join(REPO, "benchmarks", "BENCH_r20.json")) as f:
         detail = json.load(f)
     for name, spec in BLOCKS.items():
         if spec["metric"] is None:
